@@ -1,0 +1,18 @@
+"""Test configuration.
+
+Forces JAX onto the host CPU platform with 8 virtual devices BEFORE jax is
+first imported anywhere in the test session — the standard JAX fake-cluster
+trick (SURVEY.md §4) — so mesh/pjit/collective tests run without TPU
+hardware. Bench and real-TPU runs do not go through this file.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+# Keep single-core CI boxes responsive.
+os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "3")

@@ -1,0 +1,75 @@
+"""CLI tests with click.testing.CliRunner (SURVEY.md §4 'CLI tests')."""
+
+from click.testing import CliRunner
+
+from zookeeper_tpu import Field, task
+from zookeeper_tpu.core.cli import cli
+
+RESULTS = {}
+
+
+@task
+class GreetTask:
+    """Greets someone."""
+
+    name: str = Field("world")
+    times: int = Field(1)
+
+    def run(self):
+        RESULTS["greeting"] = " ".join([f"hello {self.name}"] * self.times)
+
+
+@task
+class NeedsValueTask:
+    x: int = Field()
+
+    def run(self):
+        RESULTS["x"] = self.x
+
+
+def test_task_runs_with_defaults():
+    runner = CliRunner()
+    result = runner.invoke(cli, ["GreetTask"])
+    assert result.exit_code == 0, result.output
+    assert RESULTS["greeting"] == "hello world"
+    # The resolved config tree is printed before running.
+    assert "GreetTask(" in result.output
+
+
+def test_key_value_args_parsed_and_applied():
+    runner = CliRunner()
+    result = runner.invoke(cli, ["GreetTask", "name=tpu", "times=2"])
+    assert result.exit_code == 0, result.output
+    assert RESULTS["greeting"] == "hello tpu hello tpu"
+
+
+def test_missing_value_fails_without_interactive():
+    runner = CliRunner()
+    result = runner.invoke(cli, ["NeedsValueTask"])
+    assert result.exit_code != 0
+
+
+def test_interactive_prompts_for_missing(monkeypatch):
+    runner = CliRunner()
+    result = runner.invoke(cli, ["NeedsValueTask", "-i"], input="42\n")
+    assert result.exit_code == 0, result.output
+    assert RESULTS["x"] == 42
+
+
+def test_bad_config_arg_reports_error():
+    runner = CliRunner()
+    result = runner.invoke(cli, ["GreetTask", "notakeyvalue"])
+    assert result.exit_code != 0
+    assert "key=value" in result.output
+
+
+def test_unknown_task_fails():
+    runner = CliRunner()
+    result = runner.invoke(cli, ["NoSuchTask"])
+    assert result.exit_code != 0
+
+
+def test_typo_key_fails_with_helpful_error():
+    runner = CliRunner()
+    result = runner.invoke(cli, ["GreetTask", "nmae=x"])
+    assert result.exit_code != 0

@@ -1,0 +1,534 @@
+"""Tests for the @component/configure contract (SURVEY.md §3.2).
+
+Covers the reference test surface (SURVEY.md §4 'component_test.py is by far
+the largest'): configure precedence, scope inheritance, subclass lookup,
+immutability, type-check failures, tree printing.
+"""
+
+import pytest
+
+from zookeeper_tpu import (
+    ComponentField,
+    ConfigurationError,
+    Field,
+    component,
+    configure,
+    pretty_print,
+)
+
+
+@component
+class Child:
+    a: int = Field()
+    b: str = Field("child_default_b")
+
+
+@component
+class GrandParent:
+    pass
+
+
+@component
+class Parent:
+    a: int = Field(10)
+    child: Child = ComponentField(Child)
+
+
+def test_simple_configure_and_defaults():
+    @component
+    class C:
+        x: int = Field(3)
+        y: str = Field()
+
+    c = C()
+    configure(c, {"y": "hello"})
+    assert c.x == 3
+    assert c.y == "hello"
+
+
+def test_conf_overrides_default():
+    @component
+    class C:
+        x: int = Field(3)
+
+    c = C()
+    configure(c, {"x": 7})
+    assert c.x == 7
+
+
+def test_missing_value_raises():
+    @component
+    class C:
+        x: int = Field()
+
+    with pytest.raises(ConfigurationError, match="x"):
+        configure(C(), {})
+
+
+def test_allow_missing():
+    @component
+    class C:
+        x: int = Field(allow_missing=True)
+
+    c = C()
+    configure(c, {})
+    with pytest.raises(AttributeError):
+        _ = c.x
+
+
+def test_type_check_failure():
+    @component
+    class C:
+        x: int = Field()
+
+    with pytest.raises(TypeError, match="x"):
+        configure(C(), {"x": "not an int"})
+
+
+def test_type_check_on_assignment():
+    @component
+    class C:
+        x: int = Field()
+
+    c = C()
+    with pytest.raises(TypeError):
+        c.x = "nope"
+
+
+def test_scope_inheritance_parent_value_reaches_child():
+    p = Parent()
+    configure(p, {"a": 5})
+    assert p.a == 5
+    assert p.child.a == 5  # Child has no own value: inherits parent's set a.
+
+
+def test_scope_inheritance_parent_default_reaches_child():
+    p = Parent()
+    configure(p, {})
+    # Parent's default a=10 flows to the child, which has no default.
+    assert p.child.a == 10
+
+
+def test_scoped_key_beats_unscoped():
+    p = Parent()
+    configure(p, {"a": 5, "child.a": 99})
+    assert p.a == 5
+    assert p.child.a == 99
+
+
+def test_child_own_default_beats_parent_default():
+    @component
+    class Kid:
+        b: str = Field("kid_b")
+
+    @component
+    class Pa:
+        b: str = Field("pa_b")
+        kid: Kid = ComponentField(Kid)
+
+    p = Pa()
+    configure(p, {})
+    assert p.b == "pa_b"
+    assert p.kid.b == "kid_b"  # Own default wins over ancestor default.
+
+
+def test_parent_set_value_beats_child_default():
+    @component
+    class Kid:
+        b: str = Field("kid_b")
+
+    @component
+    class Pa:
+        b: str = Field("pa_b")
+        kid: Kid = ComponentField(Kid)
+
+    p = Pa()
+    configure(p, {"b": "explicit"})
+    # Explicit beats implicit: configured ancestor value overrides the
+    # child's default (SURVEY.md §3.2 precedence).
+    assert p.kid.b == "explicit"
+
+
+def test_deep_inheritance_through_chain():
+    @component
+    class Leaf:
+        size: int = Field()
+
+    @component
+    class Mid:
+        leaf: Leaf = ComponentField(Leaf)
+
+    @component
+    class Root:
+        size: int = Field(128)
+        mid: Mid = ComponentField(Mid)
+
+    r = Root()
+    configure(r, {})
+    assert r.mid.leaf.size == 128
+
+
+def test_subclass_by_name_lookup():
+    @component
+    class Base:
+        tag: str = Field("base")
+
+    @component
+    class Special(Base):
+        tag: str = Field("special")
+
+    @component
+    class Host:
+        item: Base = ComponentField(Base)
+
+    h = Host()
+    configure(h, {"item": "Special"})
+    assert type(h.item).__name__ == "Special"
+    assert h.item.tag == "special"
+
+
+def test_subclass_by_snake_case_name():
+    @component
+    class Vehicle:
+        pass
+
+    @component
+    class FastCar(Vehicle):
+        pass
+
+    @component
+    class Garage:
+        v: Vehicle = ComponentField(Vehicle)
+
+    g = Garage()
+    configure(g, {"v": "fast_car"})
+    assert type(g.v).__name__ == "FastCar"
+
+
+def test_unknown_subclass_name_raises():
+    @component
+    class AnimalZ:
+        pass
+
+    @component
+    class FarmZ:
+        a: AnimalZ = ComponentField(AnimalZ)
+
+    with pytest.raises(ConfigurationError, match="Nope"):
+        configure(FarmZ(), {"a": "Nope"})
+
+
+def test_component_field_no_default_raises():
+    @component
+    class Thing:
+        pass
+
+    @component
+    class Holder:
+        t: Thing = ComponentField()
+
+    with pytest.raises(ConfigurationError, match="t"):
+        configure(Holder(), {})
+
+
+def test_immutability_after_configure():
+    @component
+    class C:
+        x: int = Field(1)
+
+    c = C()
+    configure(c, {})
+    with pytest.raises(AttributeError, match="immutable"):
+        c.x = 5
+
+
+def test_cannot_reconfigure():
+    @component
+    class C:
+        x: int = Field(1)
+
+    c = C()
+    configure(c, {})
+    with pytest.raises(ConfigurationError, match="already configured"):
+        configure(c, {})
+
+
+def test_preassigned_value_used_when_not_in_conf():
+    @component
+    class C:
+        x: int = Field()
+
+    c = C(x=9)
+    configure(c, {})
+    assert c.x == 9
+
+
+def test_conf_overrides_preassigned():
+    @component
+    class C:
+        x: int = Field()
+
+    c = C(x=9)
+    configure(c, {"x": 2})
+    assert c.x == 2
+
+
+def test_lazy_default_with_self():
+    @component
+    class C:
+        base: int = Field(4)
+        derived: int = Field(lambda self: self.base * 3)
+
+    c = C()
+    configure(c, {})
+    assert c.derived == 12
+
+
+def test_field_decorator_form():
+    @component
+    class C:
+        n: int = Field(2)
+
+        @Field
+        def doubled(self) -> int:
+            return self.n * 2
+
+    c = C()
+    configure(c, {})
+    assert c.doubled == 4
+
+
+def test_lazy_default_cached():
+    calls = []
+
+    @component
+    class C:
+        @Field
+        def v(self) -> int:
+            calls.append(1)
+            return 42
+
+    c = C()
+    configure(c, {})
+    assert c.v == 42
+    assert c.v == 42
+    assert len(calls) == 1
+
+
+def test_unused_conf_key_raises():
+    @component
+    class C:
+        x: int = Field(1)
+
+    with pytest.raises(ConfigurationError, match="typo_key"):
+        configure(C(), {"typo_key": 5})
+
+
+def test_field_inheritance_from_base_class():
+    @component
+    class BaseC:
+        x: int = Field(5)
+
+    @component
+    class DerivedC(BaseC):
+        y: int = Field(6)
+
+    d = DerivedC()
+    configure(d, {})
+    assert d.x == 5 and d.y == 6
+
+
+def test_field_override_in_subclass():
+    @component
+    class BaseD:
+        x: int = Field(5)
+
+    @component
+    class DerivedD(BaseD):
+        x: int = Field(7)
+
+    d = DerivedD()
+    configure(d, {})
+    assert d.x == 7
+
+
+def test_component_may_not_define_init():
+    with pytest.raises(TypeError, match="__init__"):
+
+        @component
+        class Bad:
+            def __init__(self):
+                pass
+
+
+def test_nested_component_instance_in_conf():
+    @component
+    class Inner:
+        x: int = Field(1)
+
+    @component
+    class Outer:
+        inner: Inner = ComponentField()
+
+    inst = Inner()
+    o = Outer()
+    configure(o, {"inner": inst})
+    assert o.inner is inst
+    assert o.inner.x == 1
+
+
+def test_component_field_kwarg_overrides():
+    @component
+    class Opt:
+        lr: float = Field(0.1)
+
+    @component
+    class Exp:
+        opt: Opt = ComponentField(Opt, lr=0.5)
+
+    e = Exp()
+    configure(e, {})
+    assert e.opt.lr == 0.5
+
+    e2 = Exp()
+    configure(e2, {"opt.lr": 0.9})
+    assert e2.opt.lr == 0.9  # Explicit conf still beats the pre-bound value.
+
+
+def test_pretty_print_renders_tree():
+    p = Parent()
+    configure(p, {"child.a": 2})
+    text = pretty_print(p, color=False)
+    assert "Parent(" in text
+    assert "Child(" in text
+    assert "a=2" in text
+    assert "child_default_b" in text
+
+
+def test_str_of_component_is_tree():
+    p = Parent()
+    configure(p, {})
+    assert "Parent(" in str(p)
+
+
+def test_wrong_component_type_raises():
+    @component
+    class NotADataset:
+        pass
+
+    @component
+    class NeedsChild:
+        child: Child = ComponentField()
+
+    with pytest.raises((TypeError, ConfigurationError)):
+        configure(NeedsChild(), {"child": NotADataset()})
+
+
+def test_generated_init_rejects_unknown_kwargs():
+    @component
+    class C:
+        x: int = Field(1)
+
+    with pytest.raises(TypeError, match="unexpected keyword"):
+        C(zzz=1)
+
+
+# --- Regression tests from round-1 code review -----------------------------
+
+
+def test_scoped_key_propagation_order_independent():
+    """A key scoped to an ancestor must reach grandchildren regardless of
+    the intermediate component's field declaration order."""
+
+    @component
+    class Prep1:
+        size: int = Field()
+
+    @component
+    class Data1:
+        prep: Prep1 = ComponentField(Prep1)  # ComponentField declared first
+        size: int = Field()
+
+    @component
+    class Exp1:
+        dataset: Data1 = ComponentField(Data1)
+
+    e = Exp1()
+    configure(e, {"dataset.size": 4})
+    assert e.dataset.size == 4
+    assert e.dataset.prep.size == 4
+
+
+def test_run_can_set_plain_attributes_after_configure():
+    @component
+    class T:
+        x: int = Field(1)
+
+    t = T()
+    configure(t, {})
+    t.result = 99  # Non-Field attribute: allowed post-configure.
+    assert t.result == 99
+    with pytest.raises(AttributeError):
+        t.x = 2  # Declared Field: still immutable.
+
+
+def test_overrides_not_forced_onto_sibling_subclass():
+    @component
+    class OptR:
+        pass
+
+    @component
+    class AdamR(OptR):
+        lr: float = Field(1e-3)
+
+    @component
+    class SgdR(OptR):
+        pass
+
+    @component
+    class ExpR:
+        opt: OptR = ComponentField(AdamR, lr=1e-2)
+
+    e = ExpR()
+    configure(e, {"opt": "SgdR"})  # Must not crash on unknown 'lr'.
+    assert type(e.opt).__name__ == "SgdR"
+
+    e2 = ExpR()
+    configure(e2, {})
+    assert e2.opt.lr == 1e-2
+
+
+def test_mutable_default_not_shared_between_instances():
+    @component
+    class M:
+        layers: list = Field([1])
+
+    a, b = M(), M()
+    configure(a, {})
+    configure(b, {})
+    a.layers.append(99)
+    assert b.layers == [1]
+
+
+def test_bad_concrete_default_rejected_at_declaration():
+    with pytest.raises(TypeError, match="Default"):
+
+        @component
+        class BadDefault:
+            x: int = Field("oops")
+
+
+def test_partial_component_conf_value_merges_field_overrides():
+    from zookeeper_tpu import PartialComponent
+
+    @component
+    class AdamP:
+        lr: float = Field(1e-3)
+
+    @component
+    class ExpP:
+        opt: AdamP = ComponentField(AdamP, lr=5.0)
+
+    e = ExpP()
+    configure(e, {"opt": PartialComponent(AdamP)})
+    assert e.opt.lr == 5.0

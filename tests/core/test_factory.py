@@ -1,0 +1,85 @@
+"""Tests for @factory (SURVEY.md §2.1 'Factory system', §3.5)."""
+
+import pytest
+
+from zookeeper_tpu import ConfigurationError, Field, component, configure, factory
+
+
+class Schedule:
+    def __init__(self, values):
+        self.values = values
+
+
+@factory
+class ConstantSchedule:
+    value: float = Field(1.0)
+
+    def build(self) -> Schedule:
+        return Schedule([self.value])
+
+
+@factory
+class RampSchedule:
+    steps: int = Field()
+
+    def build(self) -> Schedule:
+        return Schedule(list(range(self.steps)))
+
+
+def test_factory_by_name():
+    @component
+    class Exp:
+        schedule: Schedule = Field()
+
+    e = Exp()
+    configure(e, {"schedule": "ConstantSchedule", "schedule.value": 2.5})
+    assert isinstance(e.schedule, Schedule)
+    assert e.schedule.values == [2.5]
+
+
+def test_factory_fields_configured_from_scoped_keys():
+    @component
+    class Exp:
+        schedule: Schedule = Field()
+
+    e = Exp()
+    configure(e, {"schedule": "RampSchedule", "schedule.steps": 3})
+    assert e.schedule.values == [0, 1, 2]
+
+
+def test_factory_scope_inheritance_from_host():
+    @component
+    class Exp:
+        steps: int = Field(4)
+        schedule: Schedule = Field()
+
+    e = Exp()
+    # RampSchedule.steps has no value of its own: inherits Exp.steps.
+    configure(e, {"schedule": "RampSchedule", "steps": 4})
+    assert e.schedule.values == [0, 1, 2, 3]
+
+
+def test_factory_missing_field_raises():
+    @component
+    class Exp:
+        schedule: Schedule = Field()
+
+    with pytest.raises(ConfigurationError, match="steps"):
+        configure(Exp(), {"schedule": "RampSchedule"})
+
+
+def test_unknown_factory_name_raises():
+    @component
+    class Exp:
+        schedule: Schedule = Field()
+
+    with pytest.raises((TypeError, ConfigurationError)):
+        configure(Exp(), {"schedule": "NoSuchFactory"})
+
+
+def test_factory_requires_build():
+    with pytest.raises(TypeError, match="build"):
+
+        @factory
+        class Bad:
+            pass

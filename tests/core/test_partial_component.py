@@ -1,0 +1,66 @@
+"""Tests for PartialComponent (SURVEY.md §2.1)."""
+
+import pytest
+
+from zookeeper_tpu import (
+    ComponentField,
+    Field,
+    PartialComponent,
+    component,
+    configure,
+)
+
+
+@component
+class Opt:
+    lr: float = Field(0.1)
+    momentum: float = Field(0.9)
+
+
+def test_partial_binds_fields():
+    p = PartialComponent(Opt, lr=0.5)
+    inst = p()
+    configure(inst, {})
+    assert inst.lr == 0.5
+    assert inst.momentum == 0.9
+
+
+def test_partial_as_component_field_default():
+    @component
+    class Exp:
+        opt: Opt = ComponentField(PartialComponent(Opt, lr=0.25))
+
+    e = Exp()
+    configure(e, {})
+    assert e.opt.lr == 0.25
+
+
+def test_conf_overrides_partial_binding():
+    @component
+    class Exp:
+        opt: Opt = ComponentField(PartialComponent(Opt, lr=0.25))
+
+    e = Exp()
+    configure(e, {"opt.lr": 0.75})
+    assert e.opt.lr == 0.75
+
+
+def test_nested_partial_merging():
+    p1 = PartialComponent(Opt, lr=0.5)
+    p2 = PartialComponent(p1, momentum=0.99)
+    inst = p2()
+    configure(inst, {})
+    assert inst.lr == 0.5 and inst.momentum == 0.99
+
+
+def test_partial_rejects_unknown_field():
+    with pytest.raises(TypeError, match="zzz"):
+        PartialComponent(Opt, zzz=1)
+
+
+def test_partial_rejects_non_component():
+    class Plain:
+        pass
+
+    with pytest.raises(TypeError):
+        PartialComponent(Plain, x=1)

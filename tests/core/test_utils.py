@@ -1,0 +1,90 @@
+"""Tests for core utils (SURVEY.md §2.1 'Utilities')."""
+
+from typing import Dict, List, Optional, Union
+
+import pytest
+
+from zookeeper_tpu.core import utils
+
+
+def test_missing_singleton():
+    assert utils.missing is utils._Missing()
+    assert not utils.missing
+    assert repr(utils.missing) == "<missing>"
+
+
+@pytest.mark.parametrize(
+    "value,annotation,ok",
+    [
+        (1, int, True),
+        ("a", int, False),
+        (1.5, float, True),
+        ([1, 2], List[int], True),
+        (["a"], List[int], False),
+        ({"a": 1}, Dict[str, int], True),
+        (None, Optional[int], True),
+        (3, Optional[int], True),
+        ("x", Union[int, str], True),
+        (1.0, Union[int, str], False),
+    ],
+)
+def test_type_check(value, annotation, ok):
+    assert utils.type_check(value, annotation) is ok
+
+
+@pytest.mark.parametrize(
+    "camel,snake",
+    [
+        ("QuickNet", "quick_net"),
+        ("QuickNetLarge", "quick_net_large"),
+        ("BinaryAlexNet", "binary_alex_net"),
+        ("Mnist", "mnist"),
+        ("TFDSDataset", "tfds_dataset"),
+    ],
+)
+def test_snake_case(camel, snake):
+    assert utils.convert_to_snake_case(camel) == snake
+
+
+def test_generate_subclasses():
+    class A:
+        pass
+
+    class B(A):
+        pass
+
+    class C(B):
+        pass
+
+    subs = set(utils.generate_subclasses(A))
+    assert subs == {A, B, C}
+
+
+def test_find_subclass_by_name():
+    class Base2:
+        pass
+
+    class Leaf2(Base2):
+        pass
+
+    assert utils.find_subclass_by_name(Base2, "Leaf2") is Leaf2
+    assert utils.find_subclass_by_name(Base2, "leaf2") is Leaf2
+    with pytest.raises(utils.ConfigurationError):
+        utils.find_subclass_by_name(Base2, "Nope")
+
+
+@pytest.mark.parametrize(
+    "raw,expected",
+    [
+        ("10", 10),
+        ("1e-3", 1e-3),
+        ("True", True),
+        ("None", None),
+        ("(1, 2)", (1, 2)),
+        ("[1, 'a']", [1, "a"]),
+        ("mnist", "mnist"),
+        ("'quoted'", "quoted"),
+    ],
+)
+def test_parse_value(raw, expected):
+    assert utils.parse_value(raw) == expected

@@ -1,0 +1,44 @@
+"""zookeeper_tpu: a TPU-native experiment framework.
+
+A brand-new JAX/XLA/pjit/Pallas framework with the capabilities of the
+reference ``AdamHillier/zookeeper`` (see SURVEY.md): a typed, composable
+``@component``/``Field`` configuration system with scoped field
+inheritance, subclass-by-name wiring, factories, and a ``key=value`` task
+CLI — driving ``Dataset``/``Preprocessing``/``Model``/``Experiment``
+components where ``Model.build()`` produces Flax modules and
+``Experiment.run()`` drives an explicit jitted training step over a TPU
+device mesh.
+
+The ``core`` package is pure Python (no ML deps). Heavier subsystems
+(``data``, ``models``, ``ops``, ``parallel``, ``training``) import JAX and
+are imported lazily by user code.
+"""
+
+from zookeeper_tpu.core import (
+    ComponentField,
+    ConfigurationError,
+    Field,
+    PartialComponent,
+    cli,
+    component,
+    configure,
+    factory,
+    pretty_print,
+    task,
+)
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "ComponentField",
+    "ConfigurationError",
+    "Field",
+    "PartialComponent",
+    "cli",
+    "component",
+    "configure",
+    "factory",
+    "pretty_print",
+    "task",
+    "__version__",
+]

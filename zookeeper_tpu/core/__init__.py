@@ -1,0 +1,41 @@
+"""Core component/config system — pure Python, framework-agnostic.
+
+Re-exports the public API surface of the reference's ``zookeeper/core``
+(SURVEY.md §1 L1/L2).
+"""
+
+from .cli import ConfigParam, cli
+from .component import (
+    component,
+    component_path,
+    configure,
+    is_component_class,
+    is_component_instance,
+    pretty_print,
+)
+from .factory import FACTORY_REGISTRY, factory
+from .field import ComponentField, Field
+from .partial_component import PartialComponent
+from .task import TASK_REGISTRY, get_task, task
+from .utils import ConfigurationError, missing
+
+__all__ = [
+    "ConfigParam",
+    "cli",
+    "component",
+    "component_path",
+    "configure",
+    "is_component_class",
+    "is_component_instance",
+    "pretty_print",
+    "FACTORY_REGISTRY",
+    "factory",
+    "ComponentField",
+    "Field",
+    "PartialComponent",
+    "TASK_REGISTRY",
+    "get_task",
+    "task",
+    "ConfigurationError",
+    "missing",
+]
