@@ -1,0 +1,189 @@
+"""Regression tests for configure() precedence subtleties (code-review
+findings): ancestor explicit values vs own defaults for ComponentFields,
+declaration-order independence, inherited-value type checking, override
+typo detection, and PEP 563 string annotations."""
+
+import pytest
+
+from zookeeper_tpu.core import (
+    ComponentField,
+    ConfigurationError,
+    Field,
+    component,
+    configure,
+)
+
+
+@component
+class Optimizer:
+    lr: float = Field(0.1)
+
+
+@component
+class Sgd(Optimizer):
+    momentum: float = Field(0.9)
+
+
+@component
+class Adam(Optimizer):
+    b1: float = Field(0.9)
+
+
+def test_ancestor_explicit_component_beats_child_default():
+    @component
+    class Inner:
+        optimizer: Optimizer = ComponentField(Sgd)
+
+    @component
+    class Root:
+        optimizer: Optimizer = ComponentField()
+        inner: Inner = ComponentField(Inner)
+
+    root = Root(optimizer=Adam())
+    configure(root, {}, name="root")
+    # The parent's explicitly-set Adam wins over Inner's own Sgd default.
+    assert isinstance(root.inner.optimizer, Adam)
+    assert root.inner.optimizer is root.optimizer
+
+
+def test_child_default_beats_ancestor_default():
+    @component
+    class Inner2:
+        optimizer: Optimizer = ComponentField(Sgd)
+
+    @component
+    class Root2:
+        optimizer: Optimizer = ComponentField(Adam)
+        inner: Inner2 = ComponentField(Inner2)
+
+    root = Root2()
+    configure(root, {}, name="root")
+    # Both defaults: each component gets its own default (explicit beats
+    # implicit; a mere ancestor default does not override).
+    assert isinstance(root.inner.optimizer, Sgd)
+    assert isinstance(root.optimizer, Adam)
+
+
+def test_component_inheritance_independent_of_declaration_order():
+    @component
+    class Inner3:
+        optimizer: Optimizer = ComponentField()
+
+    @component
+    class Root3:
+        # Child declared BEFORE the sibling it must inherit from.
+        inner: Inner3 = ComponentField(Inner3)
+        optimizer: Optimizer = ComponentField(Adam)
+
+    root = Root3()
+    configure(root, {}, name="root")
+    assert isinstance(root.inner.optimizer, Adam)
+
+
+def test_plain_field_inheritance_order_independent():
+    @component
+    class Leaf:
+        batch_size: int = Field()
+
+    @component
+    class Root4:
+        leaf: Leaf = ComponentField(Leaf)
+        batch_size: int = Field(64)
+
+    root = Root4()
+    configure(root, {"batch_size": 32}, name="root")
+    assert root.leaf.batch_size == 32
+
+
+def test_inherited_value_type_checked_at_configure():
+    @component
+    class Leaf2:
+        n: int = Field()
+
+    @component
+    class Mid2:
+        leaf: Leaf2 = ComponentField(Leaf2)
+        n: str = Field()
+
+    @component
+    class Root6:
+        n: str = Field()
+        mid: Mid2 = ComponentField(Mid2)
+
+    # Pre-assign at the root only: mid.n inherits "hello" fine (str), but
+    # leaf.n declares int and must fail AT CONFIGURE TIME, not at access.
+    root = Root6(n="hello")
+    with pytest.raises(TypeError, match="inherits"):
+        configure(root, {}, name="root")
+
+
+def test_inherited_component_type_checked_at_configure():
+    @component
+    class NotAnOptimizer:
+        x: int = Field(1)
+
+    @component
+    class Inner4:
+        optimizer: Optimizer = ComponentField()
+
+    @component
+    class Root7:
+        optimizer: NotAnOptimizer = ComponentField()
+        inner: Inner4 = ComponentField(Inner4)
+
+    root = Root7(optimizer=NotAnOptimizer())
+    with pytest.raises(TypeError, match="inherits"):
+        configure(root, {}, name="root")
+
+
+def test_override_typo_raises_at_declaration():
+    with pytest.raises(TypeError, match="learning_rte"):
+
+        @component
+        class Root8:
+            optimizer: Optimizer = ComponentField(Adam, learning_rte=1e-2)
+
+
+def test_override_soft_default_filtered_for_selected_subclass():
+    @component
+    class Root9:
+        optimizer: Optimizer = ComponentField(Sgd, momentum=0.5)
+
+    root = Root9()
+    # Adam has no 'momentum'; the override is a soft default and is dropped.
+    configure(root, {"optimizer": "Adam"}, name="root")
+    assert isinstance(root.optimizer, Adam)
+    root2 = Root9()
+    configure(root2, {}, name="root")
+    assert root2.optimizer.momentum == 0.5
+
+
+def test_pep563_string_annotations_resolve():
+    # Simulate `from __future__ import annotations` with explicit strings.
+    @component
+    class Root10:
+        optimizer: "Optimizer" = ComponentField(Sgd)
+        lr: "float" = Field(0.2)
+
+    root = Root10()
+    configure(root, {"optimizer": "Adam"}, name="root")
+    assert isinstance(root.optimizer, Adam)
+    with pytest.raises(TypeError):
+        configure(Root10(), {"lr": "high"}, name="root")
+
+
+def test_factory_unresolvable_return_annotation_does_not_crash():
+    from zookeeper_tpu import factory
+
+    @factory
+    class MakesMystery:
+        def build(self) -> "SomeUndefinedType":  # noqa: F821
+            return 42
+
+    @component
+    class Root11:
+        n: int = Field()
+
+    root = Root11()
+    configure(root, {"n": "MakesMystery"}, name="root")
+    assert root.n == 42

@@ -1,0 +1,71 @@
+import numpy as np
+import pytest
+
+from zookeeper_tpu.core import configure
+from zookeeper_tpu.data import (
+    ArrayDataset,
+    SyntheticCifar10,
+    SyntheticImageClassification,
+    SyntheticMnist,
+)
+
+
+def test_array_dataset():
+    ds = ArrayDataset().with_data(
+        train={"image": np.zeros((8, 4, 4, 1)), "label": np.zeros(8, np.int32)},
+        validation={"image": np.zeros((2, 4, 4, 1)), "label": np.zeros(2, np.int32)},
+    )
+    assert ds.num_examples("train") == 8
+    assert ds.num_examples("validation") == 2
+    assert ds.train()[0]["image"].shape == (4, 4, 1)
+
+
+def test_array_dataset_without_validation():
+    ds = ArrayDataset().with_data(
+        train={"image": np.zeros((8, 4, 4, 1)), "label": np.zeros(8, np.int32)}
+    )
+    assert ds.validation() is None
+    with pytest.raises(ValueError):
+        ds.num_examples("validation")
+
+
+def test_synthetic_shapes_and_determinism():
+    ds = SyntheticImageClassification()
+    configure(ds, {"num_train_examples": 64, "num_classes": 7}, name="ds")
+    train = ds.train()
+    assert len(train) == 64
+    ex = train[0]
+    assert ex["image"].shape == (32, 32, 3)
+    assert ex["image"].dtype == np.uint8
+    assert 0 <= ex["label"] < 7
+    # Deterministic across constructions.
+    ds2 = SyntheticImageClassification()
+    configure(ds2, {"num_train_examples": 64, "num_classes": 7}, name="ds2")
+    np.testing.assert_array_equal(ds.train()[5]["image"], ds2.train()[5]["image"])
+    # Validation split differs from train split.
+    assert not np.array_equal(ds.train()[0]["image"], ds.validation()[0]["image"])
+
+
+def test_synthetic_mnist_cifar_shapes():
+    m = SyntheticMnist()
+    configure(m, {}, name="m")
+    assert m.train()[0]["image"].shape == (28, 28, 1)
+    c = SyntheticCifar10()
+    configure(c, {}, name="c")
+    assert c.train()[0]["image"].shape == (32, 32, 3)
+
+
+def test_synthetic_is_learnable_signal():
+    # Images of the same class are more similar than across classes
+    # (sanity check that the synthetic data has class-dependent signal).
+    ds = SyntheticImageClassification()
+    configure(ds, {"num_train_examples": 256, "num_classes": 2}, name="ds")
+    src = ds.train()
+    by_class = {0: [], 1: []}
+    for i in range(len(src)):
+        ex = src[i]
+        by_class[int(ex["label"])].append(ex["image"].astype(np.float32).ravel())
+    m0 = np.mean(by_class[0], axis=0)
+    m1 = np.mean(by_class[1], axis=0)
+    # Class means should differ noticeably more than sampling noise.
+    assert np.abs(m0 - m1).mean() > 1.0

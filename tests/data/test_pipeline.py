@@ -1,0 +1,176 @@
+import numpy as np
+import pytest
+
+from zookeeper_tpu.core import configure
+from zookeeper_tpu.data import (
+    ArraySource,
+    DataLoader,
+    SyntheticMnist,
+    ImageClassificationPreprocessing,
+    PassThroughPreprocessing,
+    batch_iterator,
+    prefetch_to_device,
+)
+
+
+def make_source(n=32):
+    return ArraySource(
+        {
+            "image": np.arange(n, dtype=np.float32)[:, None, None, None]
+            * np.ones((1, 4, 4, 1), np.float32),
+            "label": np.arange(n, dtype=np.int32) % 10,
+        }
+    )
+
+
+def collect_inputs(batches):
+    return np.concatenate([b["input"][:, 0, 0, 0] for b in batches])
+
+
+def test_batch_shapes_and_drop_remainder():
+    pre = PassThroughPreprocessing()
+    configure(pre, {"input_key": "image", "target_key": "label"}, name="pre")
+    batches = list(
+        batch_iterator(make_source(30), pre, 8, training=False, shuffle=False)
+    )
+    assert len(batches) == 3  # 30 // 8, remainder dropped
+    assert batches[0]["input"].shape == (8, 4, 4, 1)
+    assert batches[0]["target"].shape == (8,)
+    batches = list(
+        batch_iterator(
+            make_source(30), pre, 8, training=False, shuffle=False,
+            drop_remainder=False,
+        )
+    )
+    assert len(batches) == 4
+    assert batches[-1]["input"].shape[0] == 6
+
+
+def test_shuffle_deterministic_per_epoch():
+    pre = PassThroughPreprocessing()
+    configure(pre, {}, name="pre")
+    kw = dict(training=True, shuffle=True, seed=7)
+    a = collect_inputs(batch_iterator(make_source(), pre, 8, epoch=0, **kw))
+    b = collect_inputs(batch_iterator(make_source(), pre, 8, epoch=0, **kw))
+    c = collect_inputs(batch_iterator(make_source(), pre, 8, epoch=1, **kw))
+    np.testing.assert_array_equal(a, b)
+    assert not np.array_equal(a, c)
+    assert sorted(a) == sorted(c)  # same examples, different order
+
+
+def test_host_sharding_partitions_global_batch():
+    pre = PassThroughPreprocessing()
+    configure(pre, {}, name="pre")
+    kw = dict(training=True, shuffle=True, seed=3, epoch=0)
+    # 2 hosts, per-host batch 4 => global batch 8 over 32 examples.
+    h0 = list(batch_iterator(make_source(), pre, 4, host_index=0, host_count=2, **kw))
+    h1 = list(batch_iterator(make_source(), pre, 4, host_index=1, host_count=2, **kw))
+    assert len(h0) == len(h1) == 4
+    merged = np.concatenate(
+        [np.concatenate([a["input"], b["input"]]) for a, b in zip(h0, h1)]
+    )[:, 0, 0, 0]
+    single = collect_inputs(batch_iterator(make_source(), pre, 8, **kw))
+    np.testing.assert_array_equal(np.sort(merged), np.sort(single))
+    # Same global order: each global batch has the same example set.
+    for a, b, idx in zip(h0, h1, range(4)):
+        got = set(np.concatenate([a["input"], b["input"]])[:, 0, 0, 0])
+        want = set(single[idx * 8 : (idx + 1) * 8])
+        assert got == want
+
+
+def test_num_workers_matches_serial():
+    pre = PassThroughPreprocessing()
+    configure(pre, {}, name="pre")
+    kw = dict(training=True, shuffle=True, seed=5)
+    serial = collect_inputs(batch_iterator(make_source(), pre, 8, **kw))
+    threaded = collect_inputs(
+        batch_iterator(make_source(), pre, 8, num_workers=4, **kw)
+    )
+    np.testing.assert_array_equal(serial, threaded)
+
+
+def test_preprocessing_scaling_and_augment_determinism():
+    pre = ImageClassificationPreprocessing()
+    configure(
+        pre,
+        {"height": 4, "width": 4, "channels": 1, "augment": True, "pad_pixels": 1},
+        name="pre",
+    )
+    src = ArraySource(
+        {
+            "image": (np.arange(16, dtype=np.uint8).reshape(1, 4, 4, 1))
+            * np.ones((8, 1, 1, 1), np.uint8),
+            "label": np.zeros(8, np.int64),
+        }
+    )
+    out1 = list(batch_iterator(src, pre, 4, training=True, shuffle=False))
+    out2 = list(batch_iterator(src, pre, 4, training=True, shuffle=False))
+    np.testing.assert_array_equal(out1[0]["input"], out2[0]["input"])
+    assert out1[0]["input"].min() >= -1.0 and out1[0]["input"].max() <= 1.0
+    assert out1[0]["target"].dtype == np.int32
+
+
+def test_prefetch_to_device_yields_device_arrays():
+    import jax
+
+    pre = PassThroughPreprocessing()
+    configure(pre, {}, name="pre")
+    it = batch_iterator(make_source(16), pre, 4, training=False, shuffle=False)
+    out = list(prefetch_to_device(it, size=2))
+    assert len(out) == 4
+    assert isinstance(out[0]["input"], jax.Array)
+    np.testing.assert_allclose(
+        np.asarray(out[0]["input"])[:, 0, 0, 0], [0, 1, 2, 3]
+    )
+
+
+def test_prefetch_propagates_errors():
+    def bad_iter():
+        yield {"x": np.zeros(1)}
+        raise RuntimeError("boom")
+
+    with pytest.raises(RuntimeError, match="boom"):
+        list(prefetch_to_device(bad_iter(), size=1))
+
+
+def test_dataloader_end_to_end():
+    loader = DataLoader()
+    configure(
+        loader,
+        {
+            "dataset": "SyntheticMnist",
+            "dataset.num_train_examples": 64,
+            "preprocessing": "ImageClassificationPreprocessing",
+            "preprocessing.height": 28,
+            "preprocessing.width": 28,
+            "preprocessing.channels": 1,
+            "batch_size": 16,
+            "host_index": 0,
+            "host_count": 1,
+            "prefetch": 0,
+        },
+        name="loader",
+    )
+    assert isinstance(loader.dataset, SyntheticMnist)
+    assert loader.steps_per_epoch("train") == 4
+    batches = list(loader.batches("train", epoch=0))
+    assert len(batches) == 4
+    assert batches[0]["input"].shape == (16, 28, 28, 1)
+    assert batches[0]["target"].shape == (16,)
+
+
+def test_dataloader_batch_size_divisibility():
+    loader = DataLoader()
+    configure(
+        loader,
+        {
+            "dataset": "SyntheticMnist",
+            "preprocessing": "PassThroughPreprocessing",
+            "batch_size": 5,
+            "host_index": 0,
+            "host_count": 2,
+        },
+        name="loader",
+    )
+    with pytest.raises(ValueError, match="not divisible"):
+        loader.per_host_batch_size

@@ -1,0 +1,62 @@
+import numpy as np
+import pytest
+
+from zookeeper_tpu.data import ArraySource, ConcatSource
+
+
+def make_source(n=10, offset=0):
+    return ArraySource(
+        {
+            "x": np.arange(offset, offset + n, dtype=np.float32),
+            "y": np.arange(offset, offset + n, dtype=np.int32) * 2,
+        }
+    )
+
+
+def test_array_source_basics():
+    s = make_source(10)
+    assert len(s) == 10
+    ex = s[3]
+    assert ex["x"] == 3.0 and ex["y"] == 6
+    assert s[-1]["x"] == 9.0
+    with pytest.raises(IndexError):
+        s[10]
+
+
+def test_array_source_unequal_lengths():
+    with pytest.raises(ValueError):
+        ArraySource({"a": np.zeros(3), "b": np.zeros(4)})
+
+
+def test_map_and_iter():
+    s = make_source(5).map(lambda e: {"x": e["x"] + 1, "y": e["y"]})
+    assert [e["x"] for e in s] == [1, 2, 3, 4, 5]
+
+
+def test_slice_and_negative_index():
+    s = make_source(10).slice(2, 6)
+    assert len(s) == 4
+    assert s[0]["x"] == 2.0
+    assert s[-1]["x"] == 5.0
+    with pytest.raises(IndexError):
+        s[4]
+
+
+def test_shard_partitions_exactly():
+    s = make_source(10)
+    shards = [s.shard(i, 3) for i in range(3)]
+    seen = [e["x"] for sh in shards for e in sh]
+    assert sorted(seen) == list(range(10))
+    with pytest.raises(ValueError):
+        s.shard(3, 3)
+
+
+def test_concat_source():
+    c = ConcatSource([make_source(3, 0), make_source(4, 100)])
+    assert len(c) == 7
+    assert c[0]["x"] == 0.0
+    assert c[2]["x"] == 2.0
+    assert c[3]["x"] == 100.0
+    assert c[-1]["x"] == 103.0
+    with pytest.raises(IndexError):
+        c[7]

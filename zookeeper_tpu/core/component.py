@@ -28,6 +28,7 @@ framework-agnostic).
 from __future__ import annotations
 
 import inspect
+import sys
 from typing import Any, Dict, Mapping, Optional
 
 from . import utils
@@ -145,6 +146,24 @@ def _collect_fields(cls: type) -> Dict[str, Field]:
     fields: Dict[str, Field] = {}
     for klass in reversed(cls.__mro__):
         annotations = klass.__dict__.get("__annotations__", {})
+        # PEP 563 (`from __future__ import annotations`) leaves annotations
+        # as strings; resolve them against the defining module so
+        # ComponentField base types are real classes. Resolution is
+        # per-annotation: one unresolvable name (e.g. TYPE_CHECKING-only)
+        # degrades only its own field, not the whole class.
+        if any(isinstance(v, str) for v in annotations.values()):
+            module = sys.modules.get(klass.__module__)
+            globalns = getattr(module, "__dict__", {})
+            localns = dict(vars(klass))
+            resolved = {}
+            for k, v in annotations.items():
+                if isinstance(v, str):
+                    try:
+                        v = eval(v, globalns, localns)  # noqa: S307
+                    except Exception:
+                        pass
+                resolved[k] = v
+            annotations = resolved
         for attr_name, attr_value in vars(klass).items():
             if isinstance(attr_value, Field):
                 attr_value.attach(
@@ -320,14 +339,18 @@ def _configure_component(
 
     cls = type(instance)
     values = _state(instance, _VALUES)
+    cached = _state(instance, _CACHED)
 
-    # Two passes: plain Fields first, ComponentFields (which recurse) after —
-    # so every value of THIS component is set before any descendant tries to
-    # inherit it, independent of field declaration order.
+    # Three phases: (A) plain Fields, (B) ComponentField instantiation +
+    # parent attachment, (C) recursion into children. All of THIS
+    # component's fields (including later-declared sibling components) are
+    # set before any descendant configures, so scope inheritance is
+    # independent of field declaration order.
     ordered = sorted(
         cls.__component_fields__.items(),
         key=lambda kv: isinstance(kv[1], ComponentField),
     )
+    recurse: list = []
     for name, field in ordered:
         key, conf_value = _scoped_lookup(conf, path, name)
         if key is not None:
@@ -336,15 +359,34 @@ def _configure_component(
 
         if isinstance(field, ComponentField):
             child = _resolve_component_target(field, conf_value, interactive)
+            defaulted = False
             if child is missing:
                 if name in values:
                     child = values[name]
                     if inspect.isclass(child):
-                        child = child(**field.field_overrides)
+                        child = child(**_applicable_overrides(field, child))
+                elif _inherited_from_ancestor(instance, name):
+                    # An ancestor's *explicitly-set* same-named component is
+                    # shared by scope inheritance (beats our own default —
+                    # explicit beats implicit). Type-check it now.
+                    inherited = _inherited_value(instance, name)
+                    if (
+                        field.type is not None
+                        and inspect.isclass(field.type)
+                        and not isinstance(inherited, field.type)
+                    ):
+                        raise TypeError(
+                            f"Component field '{child_path}' expects an "
+                            f"instance of '{utils.type_name(field.type)}', "
+                            "but inherits "
+                            f"'{type(inherited).__name__}' from an ancestor."
+                        )
+                    continue
                 elif field.has_default:
                     child = field.instantiate_default()
-                elif _inherited_from_ancestor(instance, name):
-                    continue  # Resolved through scope inheritance at access.
+                    defaulted = True
+                elif _ancestor_has_default(instance, name):
+                    continue  # Ancestor's default resolves at access time.
                 elif interactive:
                     candidates = [
                         c
@@ -375,10 +417,17 @@ def _configure_component(
                         f"of '{utils.type_name(field.type)}', got "
                         f"'{type(child).__name__}'."
                     )
-            values[name] = child
+            # A default-instantiated child lives in the lazy-default cache,
+            # not in values: a *descendant's* own default must not be
+            # overridden by this mere default (explicit beats implicit),
+            # mirroring how plain-Field defaults stay out of _VALUES.
+            if defaulted:
+                cached[name] = child
+            else:
+                values[name] = child
             object.__setattr__(child, _PARENT, instance)
             object.__setattr__(child, _NAME, name)
-            _configure_component(child, conf, child_path, interactive, used_keys)
+            recurse.append((child, child_path))
             continue
 
         # Plain Field.
@@ -400,10 +449,20 @@ def _configure_component(
             values[name] = conf_value
         elif name in values:
             pass  # Pre-assigned before configure; already type-checked.
-        elif _inherited_from_ancestor(instance, name) or field.has_default:
+        elif _inherited_from_ancestor(instance, name):
+            # Explicitly-set ancestor value: resolved lazily at access, but
+            # type-checked against THIS field's annotation now so bad
+            # inherited types fail at configure time, not deep in training.
+            inherited = _inherited_value(instance, name)
+            if not field.check_type(inherited):
+                raise TypeError(
+                    f"Field '{child_path}' expects type "
+                    f"'{utils.type_name(field.type)}', but inherits "
+                    f"{inherited!r} of type '{type(inherited).__name__}' "
+                    "from an ancestor component."
+                )
+        elif field.has_default or _ancestor_has_default(instance, name):
             pass  # Resolved lazily at access time.
-        elif _ancestor_has_default(instance, name):
-            pass
         elif interactive:
             value = utils.prompt_for_value(child_path, field.type)
             if not field.check_type(value):
@@ -423,18 +482,29 @@ def _configure_component(
                 f"'{child_path}=<value>') or run with --interactive."
             )
 
+    # Phase C: recurse into children only after every field of this
+    # component is resolved, so descendants can inherit later-declared
+    # sibling values.
+    for child, child_path in recurse:
+        _configure_component(child, conf, child_path, interactive, used_keys)
+
     object.__setattr__(instance, _CONFIGURED, True)
 
 
-def _inherited_from_ancestor(instance: Any, name: str) -> bool:
+def _inherited_value(instance: Any, name: str) -> Any:
+    """The nearest ancestor's explicitly-set value for ``name`` (or missing)."""
     parent = _state(instance, _PARENT)
     while parent is not None:
-        if name in type(parent).__component_fields__ and name in _state(
-            parent, _VALUES
-        ):
-            return True
+        if name in type(parent).__component_fields__:
+            pvalues = _state(parent, _VALUES)
+            if name in pvalues:
+                return pvalues[name]
         parent = _state(parent, _PARENT)
-    return False
+    return missing
+
+
+def _inherited_from_ancestor(instance: Any, name: str) -> bool:
+    return _inherited_value(instance, name) is not missing
 
 
 def _ancestor_has_default(instance: Any, name: str) -> bool:

@@ -39,7 +39,14 @@ def factory(cls: type) -> type:
         raise TypeError(
             f"@factory class {cls.__name__} must define a build(self) method."
         )
-    return_type = typing.get_type_hints(build).get("return", missing)
+    try:
+        return_type = typing.get_type_hints(build).get("return", missing)
+    except Exception:
+        # PEP 563 string annotations naming TYPE_CHECKING-only (or otherwise
+        # unresolvable) types must not crash registration; the return-type
+        # precheck is simply skipped and build() output is still checked
+        # against the field annotation at configure time.
+        return_type = missing
     if not is_component_class(cls):
         cls = component(cls)
     cls.__component_factory_return_type__ = return_type
@@ -64,12 +71,7 @@ def try_build_factory_value(
     """
     from .component import _NAME, _PARENT, _configure_component  # noqa: PLC0415
 
-    fcls = FACTORY_REGISTRY.get(name_value)
-    if fcls is None:
-        for candidate in FACTORY_REGISTRY.values():
-            if utils.convert_to_snake_case(candidate.__name__) == name_value:
-                fcls = candidate
-                break
+    fcls = utils.registry_lookup(FACTORY_REGISTRY, name_value, "Factory")
     if fcls is None:
         return missing
     ret = fcls.__component_factory_return_type__

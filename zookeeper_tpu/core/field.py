@@ -82,7 +82,9 @@ class Field:
             self.name = name
         if self.host_component_class is None:
             self.host_component_class = owner
-        if self._type is missing and annotation is not missing:
+        if annotation is not missing and (
+            self._type is missing or isinstance(self._type, str)
+        ):
             self._type = annotation
 
     @property
@@ -172,6 +174,19 @@ class ComponentField(Field):
                 "ComponentField default must be a class or PartialComponent, "
                 f"got {default_class!r}."
             )
+        # Catch override typos at declaration time (consistent with
+        # PartialComponent): overrides must name fields the default class
+        # declares. For conf-selected sibling subclasses they still act as
+        # soft defaults, filtered to the fields that class declares.
+        dc = self.default_class
+        declared = getattr(dc, "__component_fields__", None)
+        if self.field_overrides and declared is not None:
+            unknown = sorted(k for k in self.field_overrides if k not in declared)
+            if unknown:
+                raise TypeError(
+                    f"ComponentField override(s) {unknown} are not declared "
+                    f"Fields of default class '{dc.__name__}'."
+                )
 
     @staticmethod
     def _is_acceptable_default(value: Any) -> bool:

@@ -36,11 +36,9 @@ def task(cls: type) -> type:
 def get_task(name: str) -> type:
     from . import utils
 
-    if name in TASK_REGISTRY:
-        return TASK_REGISTRY[name]
-    for cls in TASK_REGISTRY.values():
-        if utils.convert_to_snake_case(cls.__name__) == name:
-            return cls
+    cls = utils.registry_lookup(TASK_REGISTRY, name, "Task")
+    if cls is not None:
+        return cls
     raise KeyError(
         f"No task named '{name}'. Registered tasks: "
         f"{sorted(TASK_REGISTRY)}."

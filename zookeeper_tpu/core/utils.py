@@ -146,6 +146,23 @@ def find_subclass_by_name(base: type, name: str) -> Type:
     return matches[0]
 
 
+def registry_lookup(registry: dict, name: str, kind: str) -> Optional[type]:
+    """Resolve ``name`` in a class registry, accepting the exact class name
+    or its snake-case form, with an ambiguity check (shared by the task and
+    factory registries so the matching rules cannot drift)."""
+    if name in registry:
+        return registry[name]
+    matches = [
+        c for c in registry.values() if convert_to_snake_case(c.__name__) == name
+    ]
+    if len(matches) > 1:
+        raise ConfigurationError(
+            f"{kind} name '{name}' is ambiguous: "
+            f"{sorted(c.__module__ + '.' + c.__name__ for c in matches)}."
+        )
+    return matches[0] if matches else None
+
+
 def parse_value(string: str) -> Any:
     """Parse a CLI/prompt value: ``ast.literal_eval`` with string fallback.
 

@@ -1,0 +1,61 @@
+"""Host-side data subsystem.
+
+Capability parity with the reference's ``zookeeper/tf/dataset.py`` and
+``zookeeper/tf/preprocessing.py`` (SURVEY.md §2.2), redesigned for a JAX/TPU
+stack: instead of ``tf.data`` graphs, datasets expose simple indexable
+*sources* of numpy examples, and the pipeline stage does deterministic
+shuffling, batching, and double-buffered prefetch onto (possibly sharded)
+device memory. TFDS remains supported when ``tensorflow_datasets`` is
+installed; synthetic in-memory datasets are always available (this
+environment has no network and no tfds).
+"""
+
+from zookeeper_tpu.data.source import (
+    ArraySource,
+    ConcatSource,
+    DataSource,
+    MappedSource,
+    SliceSource,
+)
+from zookeeper_tpu.data.dataset import (
+    ArrayDataset,
+    Dataset,
+    MultiTFDSDataset,
+    SyntheticCifar10,
+    SyntheticImageNet,
+    SyntheticImageClassification,
+    SyntheticMnist,
+    TFDSDataset,
+)
+from zookeeper_tpu.data.preprocessing import (
+    ImageClassificationPreprocessing,
+    PassThroughPreprocessing,
+    Preprocessing,
+)
+from zookeeper_tpu.data.pipeline import (
+    DataLoader,
+    batch_iterator,
+    prefetch_to_device,
+)
+
+__all__ = [
+    "ArrayDataset",
+    "ArraySource",
+    "ConcatSource",
+    "DataLoader",
+    "DataSource",
+    "Dataset",
+    "ImageClassificationPreprocessing",
+    "MappedSource",
+    "MultiTFDSDataset",
+    "PassThroughPreprocessing",
+    "Preprocessing",
+    "SliceSource",
+    "SyntheticCifar10",
+    "SyntheticImageNet",
+    "SyntheticImageClassification",
+    "SyntheticMnist",
+    "TFDSDataset",
+    "batch_iterator",
+    "prefetch_to_device",
+]

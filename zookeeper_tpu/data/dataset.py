@@ -1,0 +1,287 @@
+"""Dataset components.
+
+Capability parity with the reference's ``zookeeper/tf/dataset.py``
+(SURVEY.md §2.2 [unverified]): an abstract ``Dataset`` component with
+``train()`` / ``validation()`` accessors and ``num_examples(split)``, plus
+TFDS-backed implementations (``TFDSDataset``, ``MultiTFDSDataset``). Here
+the accessors return :class:`~zookeeper_tpu.data.source.DataSource` objects
+instead of ``tf.data.Dataset`` graphs.
+
+``tensorflow_datasets`` is an *optional* dependency (not installed in this
+environment): the TFDS components raise a clear error at use time when it is
+absent. The ``Synthetic*`` datasets are always available and provide
+deterministic procedurally-generated image-classification data shaped like
+MNIST / CIFAR-10 / ImageNet, so the full training stack (and the benchmark)
+runs without any network or disk dataset.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from zookeeper_tpu.core import Field, component
+from zookeeper_tpu.data.source import ArraySource, ConcatSource, DataSource
+
+
+@component
+class Dataset:
+    """Abstract dataset component.
+
+    Subclasses implement ``train()`` and (optionally) ``validation()``
+    returning a :class:`DataSource`, and may override ``num_examples``.
+    """
+
+    def train(self) -> DataSource:
+        raise NotImplementedError("Dataset subclasses must implement train().")
+
+    def validation(self) -> Optional[DataSource]:
+        return None
+
+    def num_examples(self, split: str) -> int:
+        if split == "train":
+            return len(self.train())
+        if split in ("validation", "test"):
+            val = self.validation()
+            if val is None:
+                raise ValueError(f"Dataset has no '{split}' split.")
+            return len(val)
+        raise ValueError(f"Unknown split {split!r}.")
+
+
+@component
+class ArrayDataset(Dataset):
+    """A dataset over in-memory arrays, supplied post-construction via
+    ``with_data`` or by subclassing. Useful for tests and user code that
+    already has numpy data."""
+
+    _train_arrays: Optional[Dict[str, np.ndarray]] = None
+    _validation_arrays: Optional[Dict[str, np.ndarray]] = None
+
+    def with_data(
+        self,
+        train: Dict[str, np.ndarray],
+        validation: Optional[Dict[str, np.ndarray]] = None,
+    ) -> "ArrayDataset":
+        self._train_arrays = train
+        self._validation_arrays = validation
+        return self
+
+    def train(self) -> DataSource:
+        if self._train_arrays is None:
+            raise ValueError("ArrayDataset has no data; call with_data() first.")
+        return ArraySource(self._train_arrays)
+
+    def validation(self) -> Optional[DataSource]:
+        if self._validation_arrays is None:
+            return None
+        return ArraySource(self._validation_arrays)
+
+
+def _synthetic_image_classification(
+    num_examples: int,
+    image_shape: Tuple[int, int, int],
+    num_classes: int,
+    seed: int,
+) -> Dict[str, np.ndarray]:
+    """Deterministic procedurally generated image-classification data.
+
+    Images are class-dependent smooth gradients plus seeded noise, so a
+    small model can actually fit them (useful for end-to-end "loss goes
+    down / accuracy goes up" tests without real data).
+    """
+    rng = np.random.default_rng(seed)
+    h, w, c = image_shape
+    labels = rng.integers(0, num_classes, size=(num_examples,), dtype=np.int32)
+    yy, xx = np.meshgrid(
+        np.linspace(0, 1, h, dtype=np.float32),
+        np.linspace(0, 1, w, dtype=np.float32),
+        indexing="ij",
+    )
+    # Per-class signature pattern: a distinct orientation/frequency per class.
+    angles = np.linspace(0.0, np.pi, num_classes, endpoint=False)
+    patterns = np.stack(
+        [
+            np.sin(
+                2 * np.pi * (2 + k % 3) * (np.cos(a) * xx + np.sin(a) * yy)
+            ).astype(np.float32)
+            for k, a in enumerate(angles)
+        ]
+    )  # [num_classes, h, w]
+    base = patterns[labels][..., None]  # [n, h, w, 1]
+    noise = rng.normal(0.0, 0.6, size=(num_examples, h, w, c)).astype(np.float32)
+    images = np.clip((base + noise) * 0.25 + 0.5, 0.0, 1.0)
+    images = (images * 255.0).astype(np.uint8)
+    return {"image": images, "label": labels}
+
+
+@component
+class SyntheticImageClassification(Dataset):
+    """Always-available synthetic image-classification dataset.
+
+    Fields mirror what the real TFDS-backed datasets expose so the rest of
+    the stack is agnostic to where the pixels came from.
+    """
+
+    num_train_examples: int = Field(1024)
+    num_validation_examples: int = Field(256)
+    image_height: int = Field(32)
+    image_width: int = Field(32)
+    image_channels: int = Field(3)
+    num_classes: int = Field(10)
+    seed: int = Field(0)
+
+    def _arrays(self, n: int, seed: int) -> Dict[str, np.ndarray]:
+        return _synthetic_image_classification(
+            n,
+            (self.image_height, self.image_width, self.image_channels),
+            self.num_classes,
+            seed,
+        )
+
+    def train(self) -> DataSource:
+        return ArraySource(self._arrays(self.num_train_examples, self.seed))
+
+    def validation(self) -> DataSource:
+        return ArraySource(
+            self._arrays(self.num_validation_examples, self.seed + 1)
+        )
+
+
+@component
+class SyntheticMnist(SyntheticImageClassification):
+    """MNIST-shaped synthetic data (28x28x1, 10 classes)."""
+
+    image_height: int = Field(28)
+    image_width: int = Field(28)
+    image_channels: int = Field(1)
+    num_classes: int = Field(10)
+
+
+@component
+class SyntheticCifar10(SyntheticImageClassification):
+    """CIFAR-10-shaped synthetic data (32x32x3, 10 classes)."""
+
+    image_height: int = Field(32)
+    image_width: int = Field(32)
+    image_channels: int = Field(3)
+    num_classes: int = Field(10)
+
+
+@component
+class SyntheticImageNet(SyntheticImageClassification):
+    """ImageNet-shaped synthetic data (224x224x3, 1000 classes) for
+    benchmarking the input+compute pipeline at real shapes."""
+
+    image_height: int = Field(224)
+    image_width: int = Field(224)
+    image_channels: int = Field(3)
+    num_classes: int = Field(1000)
+    num_train_examples: int = Field(2048)
+    num_validation_examples: int = Field(256)
+
+
+def _require_tfds():
+    try:
+        import tensorflow_datasets as tfds  # type: ignore
+
+        return tfds
+    except ImportError as e:  # pragma: no cover - environment-dependent
+        raise ImportError(
+            "tensorflow_datasets is not installed in this environment. "
+            "TFDSDataset/MultiTFDSDataset require it; use the Synthetic* "
+            "datasets or ArrayDataset instead."
+        ) from e
+
+
+class _TFDSSource(DataSource):  # pragma: no cover - requires tfds
+    """Random-access adapter over a TFDS builder split using
+    ``tfds.data_source`` (ArrayRecord random access) when available, falling
+    back to eager materialization for small datasets."""
+
+    def __init__(self, name: str, split: str, data_dir: Optional[str]):
+        tfds = _require_tfds()
+        try:
+            self._source = tfds.data_source(name, split=split, data_dir=data_dir)
+            self._materialized = None
+        except Exception:
+            builder = tfds.builder(name, data_dir=data_dir)
+            builder.download_and_prepare()
+            ds = builder.as_dataset(split=split)
+            self._materialized = list(tfds.as_numpy(ds))
+            self._source = None
+
+    def __len__(self) -> int:
+        if self._materialized is not None:
+            return len(self._materialized)
+        return len(self._source)
+
+    def __getitem__(self, index: int):
+        if self._materialized is not None:
+            ex = self._materialized[index]
+        else:
+            ex = self._source[index]
+        return {k: np.asarray(v) for k, v in ex.items()}
+
+
+@component
+class TFDSDataset(Dataset):
+    """A TFDS-backed dataset (reference: ``TFDSDataset`` with fields
+    ``name`` / ``train_split`` / ``validation_split`` / ``data_dir``,
+    SURVEY.md §2.2 [unverified])."""
+
+    name: str = Field(allow_missing=True)
+    train_split: str = Field("train")
+    validation_split: str = Field(allow_missing=True)
+    data_dir: Optional[str] = Field(None)
+
+    def load(self, split: str) -> DataSource:
+        return _TFDSSource(self.name, split, self.data_dir)  # pragma: no cover
+
+    def train(self) -> DataSource:
+        return self.load(self.train_split)  # pragma: no cover
+
+    def validation(self) -> Optional[DataSource]:  # pragma: no cover
+        try:
+            split = self.validation_split
+        except AttributeError:
+            return None
+        return self.load(split)
+
+    def num_examples(self, split: str) -> int:  # pragma: no cover
+        tfds = _require_tfds()
+        builder = tfds.builder(self.name, data_dir=self.data_dir)
+        actual = {"train": self.train_split}.get(split, split)
+        if split in ("validation", "test"):
+            try:
+                actual = self.validation_split
+            except AttributeError:
+                pass
+        return builder.info.splits[actual].num_examples
+
+
+@component
+class MultiTFDSDataset(Dataset):
+    """Merges several TFDS datasets into one stream (reference:
+    ``MultiTFDSDataset``, SURVEY.md §2.2 [MED])."""
+
+    names: List[str] = Field(allow_missing=True)
+    train_split: str = Field("train")
+    validation_split: str = Field(allow_missing=True)
+    data_dir: Optional[str] = Field(None)
+
+    def _load_all(self, split: str) -> DataSource:  # pragma: no cover
+        return ConcatSource(
+            [_TFDSSource(name, split, self.data_dir) for name in self.names]
+        )
+
+    def train(self) -> DataSource:
+        return self._load_all(self.train_split)  # pragma: no cover
+
+    def validation(self) -> Optional[DataSource]:  # pragma: no cover
+        try:
+            split = self.validation_split
+        except AttributeError:
+            return None
+        return self._load_all(split)

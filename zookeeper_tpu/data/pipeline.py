@@ -1,0 +1,220 @@
+"""Batching + device prefetch.
+
+The JAX-native replacement for the reference example's
+``.cache().shuffle().batch().prefetch()`` tf.data chain (SURVEY.md §3.3):
+
+- :func:`batch_iterator` — deterministic per-epoch global shuffle, host
+  sharding for multi-host pods, per-example preprocessing (optionally on a
+  thread pool), stacking into numpy batches;
+- :func:`prefetch_to_device` — a double-buffered background thread that
+  moves batches into (possibly sharded) device memory with
+  ``jax.device_put``, overlapping host work with TPU steps;
+- :class:`DataLoader` — the component tying a ``Dataset`` + ``Preprocessing``
+  + batch settings together.
+
+Determinism contract: given (seed, epoch, global example count), every host
+computes the same global permutation and reads only its own contiguous slice
+of each global batch — exact-resume and multi-host-consistent by
+construction (SURVEY.md §7 "input pipeline at pod scale").
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Dict, Iterator, Optional
+
+import numpy as np
+
+from zookeeper_tpu.core import ComponentField, Field, component
+from zookeeper_tpu.data.dataset import Dataset
+from zookeeper_tpu.data.preprocessing import Preprocessing
+from zookeeper_tpu.data.source import DataSource
+
+Batch = Dict[str, np.ndarray]
+
+
+def batch_iterator(
+    source: DataSource,
+    preprocessing: Optional[Preprocessing],
+    batch_size: int,
+    *,
+    training: bool,
+    shuffle: bool = True,
+    seed: int = 0,
+    epoch: int = 0,
+    drop_remainder: bool = True,
+    host_index: int = 0,
+    host_count: int = 1,
+    num_workers: int = 0,
+) -> Iterator[Batch]:
+    """Yield batches of stacked numpy arrays from ``source``.
+
+    ``batch_size`` is the *per-host* batch size; with ``host_count > 1`` each
+    global batch of ``batch_size * host_count`` examples is split
+    contiguously and this host materializes slice ``host_index``.
+    """
+    n = len(source)
+    if n == 0:
+        return
+    global_batch = batch_size * host_count
+    if shuffle:
+        order = np.random.default_rng(
+            np.random.SeedSequence([seed, epoch])
+        ).permutation(n)
+    else:
+        order = np.arange(n)
+
+    num_batches = n // global_batch if drop_remainder else -(-n // global_batch)
+
+    def fetch(global_index: int) -> Dict[str, np.ndarray]:
+        idx = int(order[global_index])
+        example = dict(source[idx])
+        example.setdefault("_index", np.int64(idx))
+        if preprocessing is not None:
+            example = preprocessing(example, training)
+        return example
+
+    pool = ThreadPoolExecutor(num_workers) if num_workers > 0 else None
+    try:
+        for b in range(num_batches):
+            start = b * global_batch + host_index * batch_size
+            stop = min(start + batch_size, n, (b + 1) * global_batch)
+            indices = range(start, stop)
+            if pool is not None:
+                examples = list(pool.map(fetch, indices))
+            else:
+                examples = [fetch(i) for i in indices]
+            if not examples:
+                continue
+            keys = examples[0].keys()
+            yield {k: np.stack([e[k] for e in examples]) for k in keys}
+    finally:
+        if pool is not None:
+            pool.shutdown(wait=False)
+
+
+_END = object()
+
+
+def prefetch_to_device(
+    iterator: Iterator[Batch],
+    *,
+    size: int = 2,
+    sharding: Optional[Any] = None,
+) -> Iterator[Any]:
+    """Asynchronously stage host batches into device memory.
+
+    A background thread pulls from ``iterator`` and calls
+    ``jax.device_put(batch, sharding)``; the main thread yields device
+    buffers while the next transfer is in flight. With a
+    ``jax.sharding.NamedSharding`` whose batch axis spans the mesh's data
+    axis, this is the host→HBM half of data parallelism — XLA never sees a
+    host transfer inside the step.
+    """
+    import jax
+
+    q: "queue.Queue[Any]" = queue.Queue(maxsize=max(1, size))
+    err: list[BaseException] = []
+
+    def producer():
+        try:
+            for batch in iterator:
+                if sharding is not None:
+                    batch = jax.device_put(batch, sharding)
+                else:
+                    batch = jax.device_put(batch)
+                q.put(batch)
+        except BaseException as e:  # propagate into consumer
+            err.append(e)
+        finally:
+            q.put(_END)
+
+    thread = threading.Thread(target=producer, daemon=True)
+    thread.start()
+    while True:
+        item = q.get()
+        if item is _END:
+            if err:
+                raise err[0]
+            return
+        yield item
+
+
+@component
+class DataLoader:
+    """Component bundling dataset + preprocessing + batching policy.
+
+    ``batch_size`` is the GLOBAL batch size (reference semantics: the
+    experiment's ``batch_size`` field, inherited by scope into the loader).
+    Per-host slicing happens automatically from ``jax.process_index()``
+    unless overridden (tests inject ``host_index``/``host_count``).
+    """
+
+    dataset: Dataset = ComponentField()
+    preprocessing: Preprocessing = ComponentField()
+    batch_size: int = Field(32)
+    shuffle: bool = Field(True)
+    seed: int = Field(0)
+    drop_remainder: bool = Field(True)
+    num_workers: int = Field(0)
+    prefetch: int = Field(2)
+    host_index: int = Field(-1)  # -1 => jax.process_index()
+    host_count: int = Field(-1)  # -1 => jax.process_count()
+
+    def _hosts(self):
+        hi, hc = self.host_index, self.host_count
+        if hi < 0 or hc < 0:
+            import jax
+
+            hi = jax.process_index() if hi < 0 else hi
+            hc = jax.process_count() if hc < 0 else hc
+        return hi, hc
+
+    @property
+    def per_host_batch_size(self) -> int:
+        _, hc = self._hosts()
+        if self.batch_size % hc != 0:
+            raise ValueError(
+                f"Global batch size {self.batch_size} not divisible by "
+                f"host count {hc}."
+            )
+        return self.batch_size // hc
+
+    def batches(
+        self,
+        split: str = "train",
+        *,
+        epoch: int = 0,
+        sharding: Optional[Any] = None,
+    ) -> Iterator[Any]:
+        training = split == "train"
+        source = self.dataset.train() if training else self.dataset.validation()
+        if source is None:
+            raise ValueError(f"Dataset has no '{split}' split.")
+        hi, hc = self._hosts()
+        it = batch_iterator(
+            source,
+            self.preprocessing,
+            self.per_host_batch_size,
+            training=training,
+            shuffle=self.shuffle and training,
+            seed=self.seed,
+            epoch=epoch,
+            drop_remainder=self.drop_remainder or training,
+            host_index=hi,
+            host_count=hc,
+            num_workers=self.num_workers,
+        )
+        if self.prefetch > 0:
+            return prefetch_to_device(it, size=self.prefetch, sharding=sharding)
+        return it
+
+    def steps_per_epoch(self, split: str = "train") -> int:
+        source = (
+            self.dataset.train() if split == "train" else self.dataset.validation()
+        )
+        if source is None:
+            raise ValueError(f"Dataset has no '{split}' split.")
+        return len(source) // self.batch_size

@@ -1,0 +1,137 @@
+"""Preprocessing components.
+
+Capability parity with the reference's ``zookeeper/tf/preprocessing.py``
+(SURVEY.md §2.2 [MED]): a component mapping raw dataset feature dicts to
+``(model_input, target)`` pairs, with per-split behavior via a ``training``
+flag and an ``input_shape`` consumed by ``Model.build``.
+
+Preprocessing here runs on host CPU in numpy, per example, *before*
+batching; anything batch-level and compute-heavy belongs in the jitted train
+step instead (TPU time is cheaper than host time at pod scale).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import numpy as np
+
+from zookeeper_tpu.core import Field, component
+from zookeeper_tpu.data.source import Example
+
+
+@component
+class Preprocessing:
+    """Abstract preprocessing component.
+
+    ``input(example, training)`` returns the model input array;
+    ``output(example, training)`` returns the target. ``input_shape`` is the
+    per-example input shape (no batch dim).
+    """
+
+    def input(self, example: Example, training: bool) -> np.ndarray:
+        raise NotImplementedError
+
+    def output(self, example: Example, training: bool) -> np.ndarray:
+        raise NotImplementedError
+
+    @property
+    def input_shape(self) -> Tuple[int, ...]:
+        raise NotImplementedError
+
+    def __call__(self, example: Example, training: bool) -> Example:
+        return {
+            "input": np.asarray(self.input(example, training)),
+            "target": np.asarray(self.output(example, training)),
+        }
+
+
+@component
+class PassThroughPreprocessing(Preprocessing):
+    """Forwards ``example[input_key]`` / ``example[target_key]`` unchanged."""
+
+    input_key: str = Field("image")
+    target_key: str = Field("label")
+
+    def input(self, example: Example, training: bool) -> np.ndarray:
+        return example[self.input_key]
+
+    def output(self, example: Example, training: bool) -> np.ndarray:
+        return example[self.target_key]
+
+
+@component
+class ImageClassificationPreprocessing(Preprocessing):
+    """Standard image-classification preprocessing: scale uint8 pixels to
+    [-1, 1] (or [0, 1]), optional train-time augmentation (random crop after
+    padding + horizontal flip — the CIFAR/larq recipe), integer label out.
+
+    Augmentation is seeded per-example from a stable hash so the pipeline
+    stays deterministic and resumable (same example index + epoch => same
+    augmentation), which is a correctness requirement for multi-host
+    pipelines where every host must agree on the global batch.
+    """
+
+    image_key: str = Field("image")
+    label_key: str = Field("label")
+    height: int = Field(32)
+    width: int = Field(32)
+    channels: int = Field(3)
+    zero_center: bool = Field(True)
+    augment: bool = Field(False)
+    pad_pixels: int = Field(4)
+    random_flip: bool = Field(True)
+
+    @property
+    def input_shape(self) -> Tuple[int, ...]:
+        return (self.height, self.width, self.channels)
+
+    def _augment(self, image: np.ndarray, rng: np.random.Generator) -> np.ndarray:
+        p = self.pad_pixels
+        if p > 0:
+            padded = np.pad(image, ((p, p), (p, p), (0, 0)), mode="reflect")
+            oy = int(rng.integers(0, 2 * p + 1))
+            ox = int(rng.integers(0, 2 * p + 1))
+            image = padded[oy : oy + self.height, ox : ox + self.width]
+        if self.random_flip and rng.integers(0, 2) == 1:
+            image = image[:, ::-1]
+        return image
+
+    def input(self, example: Example, training: bool) -> np.ndarray:
+        image = np.asarray(example[self.image_key])
+        if image.dtype == np.uint8:
+            image = image.astype(np.float32) / 255.0
+        else:
+            image = image.astype(np.float32)
+        if image.ndim == 2:
+            image = image[..., None]
+        if training and self.augment:
+            seed = int(example.get("_index", 0)) * 2654435761 % (2**31)
+            rng = np.random.default_rng(seed)
+            image = self._augment(image, rng)
+        if image.shape[:2] != (self.height, self.width):
+            image = _center_crop_or_pad(image, self.height, self.width)
+        if self.zero_center:
+            image = image * 2.0 - 1.0
+        return np.ascontiguousarray(image)
+
+    def output(self, example: Example, training: bool) -> np.ndarray:
+        return np.asarray(example[self.label_key], dtype=np.int32)
+
+
+def _center_crop_or_pad(image: np.ndarray, height: int, width: int) -> np.ndarray:
+    h, w = image.shape[:2]
+    if h > height:
+        top = (h - height) // 2
+        image = image[top : top + height]
+    if w > width:
+        left = (w - width) // 2
+        image = image[:, left : left + width]
+    h, w = image.shape[:2]
+    if h < height or w < width:
+        image = np.pad(
+            image,
+            ((0, height - h), (0, width - w), (0, 0)),
+            mode="constant",
+        )
+    return image

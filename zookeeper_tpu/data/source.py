@@ -1,0 +1,142 @@
+"""Indexable example sources.
+
+The JAX-native replacement for the reference's ``tf.data.Dataset`` objects
+(SURVEY.md §2.2 `zookeeper/tf/dataset.py` [unverified]): a ``DataSource`` is
+a random-access sequence of *examples*, where an example is a flat
+``dict[str, np.ndarray]`` of features. Random access (rather than a stream)
+is what makes deterministic global shuffling, per-host sharding, and exact
+resume trivially correct on a multi-host TPU pod — each host computes the
+same permutation and reads only its own slice.
+
+Sources are pure host-side Python/numpy; nothing here imports JAX or TF.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Sequence
+
+import numpy as np
+
+Example = Dict[str, np.ndarray]
+
+
+class DataSource:
+    """Abstract random-access source of examples.
+
+    Subclasses implement ``__len__`` and ``__getitem__`` returning a dict of
+    numpy arrays (or scalars) per example.
+    """
+
+    def __len__(self) -> int:
+        raise NotImplementedError
+
+    def __getitem__(self, index: int) -> Example:
+        raise NotImplementedError
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    # -- combinators ------------------------------------------------------
+
+    def map(self, fn: Callable[[Example], Example]) -> "MappedSource":
+        return MappedSource(self, fn)
+
+    def slice(self, start: int, stop: int) -> "SliceSource":
+        return SliceSource(self, start, stop)
+
+    def shard(self, shard_index: int, shard_count: int) -> "SliceSource":
+        """Contiguous per-host shard (used for multi-host input pipelines:
+        each process reads ``source.shard(jax.process_index(),
+        jax.process_count())``)."""
+        n = len(self)
+        if not 0 <= shard_index < shard_count:
+            raise ValueError(f"shard_index {shard_index} not in [0, {shard_count}).")
+        start = (n * shard_index) // shard_count
+        stop = (n * (shard_index + 1)) // shard_count
+        return SliceSource(self, start, stop)
+
+
+class ArraySource(DataSource):
+    """A source backed by a dict of equal-length numpy arrays, where axis 0
+    indexes examples. The in-memory workhorse for tests, synthetic data, and
+    small datasets (MNIST/CIFAR fit comfortably in host RAM)."""
+
+    def __init__(self, arrays: Dict[str, np.ndarray]):
+        if not arrays:
+            raise ValueError("ArraySource requires at least one feature array.")
+        lengths = {k: len(v) for k, v in arrays.items()}
+        if len(set(lengths.values())) != 1:
+            raise ValueError(f"Feature arrays have unequal lengths: {lengths}.")
+        self.arrays = {k: np.asarray(v) for k, v in arrays.items()}
+        self._length = next(iter(lengths.values()))
+
+    def __len__(self) -> int:
+        return self._length
+
+    def __getitem__(self, index: int) -> Example:
+        if not -self._length <= index < self._length:
+            raise IndexError(index)
+        return {k: v[index] for k, v in self.arrays.items()}
+
+
+class MappedSource(DataSource):
+    """Applies ``fn`` to each example on access (lazy, like
+    ``tf.data.Dataset.map`` but without a graph)."""
+
+    def __init__(self, parent: DataSource, fn: Callable[[Example], Example]):
+        self.parent = parent
+        self.fn = fn
+
+    def __len__(self) -> int:
+        return len(self.parent)
+
+    def __getitem__(self, index: int) -> Example:
+        return self.fn(self.parent[index])
+
+
+class SliceSource(DataSource):
+    """A contiguous sub-range of a parent source."""
+
+    def __init__(self, parent: DataSource, start: int, stop: int):
+        n = len(parent)
+        start = max(0, min(start, n))
+        stop = max(start, min(stop, n))
+        self.parent = parent
+        self.start = start
+        self.stop = stop
+
+    def __len__(self) -> int:
+        return self.stop - self.start
+
+    def __getitem__(self, index: int) -> Example:
+        n = len(self)
+        if not -n <= index < n:
+            raise IndexError(index)
+        if index < 0:
+            index += n
+        return self.parent[self.start + index]
+
+
+class ConcatSource(DataSource):
+    """Concatenation of several sources — the replacement for the
+    reference's ``MultiTFDSDataset`` merge-several-datasets-into-one-stream
+    behavior (SURVEY.md §2.2 [MED])."""
+
+    def __init__(self, sources: Sequence[DataSource]):
+        if not sources:
+            raise ValueError("ConcatSource requires at least one source.")
+        self.sources = list(sources)
+        self._offsets = np.cumsum([0] + [len(s) for s in self.sources])
+
+    def __len__(self) -> int:
+        return int(self._offsets[-1])
+
+    def __getitem__(self, index: int) -> Example:
+        n = len(self)
+        if not -n <= index < n:
+            raise IndexError(index)
+        if index < 0:
+            index += n
+        src = int(np.searchsorted(self._offsets, index, side="right")) - 1
+        return self.sources[src][index - int(self._offsets[src])]
