@@ -1,0 +1,94 @@
+"""Benchmark: training throughput on the flagship model, real hardware.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+The reference publishes no numbers (BASELINE.md); ``vs_baseline`` is the
+ratio of measured images/sec/chip to BASELINE.md's working target for this
+stage (see TARGET below), so >1.0 means ahead of target.
+"""
+
+import json
+import time
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from zookeeper_tpu.core import configure
+    from zookeeper_tpu.models import SimpleCnn
+    from zookeeper_tpu.training import TrainState, make_train_step
+
+    # CIFAR-shape training step on the end-to-end slice model. Will move to
+    # QuickNet ImageNet shapes once the binary zoo + Pallas kernels land.
+    input_shape = (32, 32, 3)
+    batch_size = 512
+    num_classes = 10
+    TARGET = 20_000.0  # images/sec/chip working target for this stage.
+
+    model = SimpleCnn()
+    configure(
+        model,
+        {
+            "features": (64, 128, 256),
+            "dense_units": (256,),
+            "compute_dtype": "bfloat16",
+        },
+        name="model",
+    )
+    module = model.build(input_shape, num_classes=num_classes)
+    params, model_state = model.initialize(module, input_shape)
+    state = TrainState.create(
+        apply_fn=module.apply,
+        params=params,
+        model_state=model_state,
+        tx=optax.adam(1e-3),
+    )
+    step = jax.jit(make_train_step(), donate_argnums=(0,))
+
+    rng = np.random.default_rng(0)
+    batch = {
+        "input": jnp.asarray(
+            rng.normal(size=(batch_size, *input_shape)), jnp.bfloat16
+        ),
+        "target": jnp.asarray(rng.integers(0, num_classes, batch_size)),
+    }
+
+    def run_chain(n, st):
+        """n chained steps ended by a scalar host readback (device_get is
+        the only reliable completion barrier through the remote-TPU
+        tunnel; block_until_ready returns early there)."""
+        t0 = time.perf_counter()
+        for _ in range(n):
+            st, metrics = step(st, batch)
+        float(jax.device_get(metrics["loss"]))
+        return time.perf_counter() - t0, st
+
+    # Compile + warmup.
+    _, state = run_chain(2, state)
+
+    # The tunnel adds ~100ms fixed sync latency per readback; measure
+    # marginal step time with two chain lengths and subtract.
+    n1, n2 = 10, 60
+    t1, state = run_chain(n1, state)
+    t2, state = run_chain(n2, state)
+    dt = max(t2 - t1, 1e-9)
+
+    n_chips = jax.device_count()
+    images_per_sec_per_chip = (n2 - n1) * batch_size / dt / max(1, n_chips)
+    print(
+        json.dumps(
+            {
+                "metric": "train_images_per_sec_per_chip",
+                "value": round(images_per_sec_per_chip, 1),
+                "unit": "images/sec/chip",
+                "vs_baseline": round(images_per_sec_per_chip / TARGET, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,42 @@
+"""End-to-end MNIST-scale experiment (BASELINE config #1).
+
+The TPU-native counterpart of the reference's ``examples/larq_experiment.py``
+(SURVEY.md §2.3 [unverified]): dataset + preprocessing + model + experiment
+wired purely through components, runnable from the CLI::
+
+    python examples/mnist_experiment.py TrainMnist epochs=2 batch_size=64
+    python examples/mnist_experiment.py TrainMnist model=Mlp "model.hidden_units=(256,)"
+    python examples/mnist_experiment.py TrainMnist optimizer=Sgd optimizer.schedule.base_lr=0.01
+
+Uses the synthetic MNIST-shaped dataset so it runs without network/TFDS;
+swap ``dataset=TFDSDataset dataset.name=mnist`` on a machine with TFDS.
+"""
+
+from zookeeper_tpu import ComponentField, Field, PartialComponent, cli, task
+from zookeeper_tpu.data import (
+    DataLoader,
+    ImageClassificationPreprocessing,
+    SyntheticMnist,
+)
+from zookeeper_tpu.models import Model, SimpleCnn
+from zookeeper_tpu.training import TrainingExperiment
+
+MnistPreprocessing = PartialComponent(
+    ImageClassificationPreprocessing, height=28, width=28, channels=1
+)
+
+
+@task
+class TrainMnist(TrainingExperiment):
+    loader: DataLoader = ComponentField(
+        DataLoader,
+        dataset=SyntheticMnist,
+        preprocessing=MnistPreprocessing,
+    )
+    model: Model = ComponentField(SimpleCnn)
+    epochs: int = Field(2)
+    batch_size: int = Field(64)
+
+
+if __name__ == "__main__":
+    cli()

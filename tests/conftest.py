@@ -8,7 +8,9 @@ hardware. Bench and real-TPU runs do not go through this file.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force (not setdefault): the environment pre-sets JAX_PLATFORMS to the
+# real TPU platform, but tests must run on the virtual CPU mesh.
+os.environ["JAX_PLATFORMS"] = "cpu"
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
@@ -16,3 +18,10 @@ if "xla_force_host_platform_device_count" not in xla_flags:
     ).strip()
 # Keep single-core CI boxes responsive.
 os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "3")
+
+# The machine's sitecustomize registers the real TPU backend
+# programmatically (overriding JAX_PLATFORMS from the environment), so the
+# platform must also be reset at the config level.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

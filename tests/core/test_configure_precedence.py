@@ -187,3 +187,25 @@ def test_factory_unresolvable_return_annotation_does_not_crash():
     root = Root11()
     configure(root, {"n": "MakesMystery"}, name="root")
     assert root.n == 42
+
+
+def test_preassigned_partial_component_keeps_field_overrides():
+    from zookeeper_tpu import PartialComponent
+
+    @component
+    class Child:
+        a: int = Field(1)
+        b: int = Field(2)
+
+    @component
+    class Root12:
+        child: Child = ComponentField(Child, a=99)
+
+    # Same PartialComponent via pre-assignment and via conf must configure
+    # identically (field overrides act as soft defaults in both).
+    r1 = Root12()
+    r1.child = PartialComponent(Child, b=5)
+    configure(r1, {}, name="r1")
+    r2 = Root12()
+    configure(r2, {"child": PartialComponent(Child, b=5)}, name="r2")
+    assert (r1.child.a, r1.child.b) == (r2.child.a, r2.child.b) == (99, 5)

@@ -174,3 +174,43 @@ def test_dataloader_batch_size_divisibility():
     )
     with pytest.raises(ValueError, match="not divisible"):
         loader.per_host_batch_size
+
+
+def test_prefetch_early_stop_terminates_producer():
+    import threading
+    import time
+
+    pre = PassThroughPreprocessing()
+    configure(pre, {}, name="pre")
+
+    def run_once():
+        it = batch_iterator(
+            make_source(32), pre, 4, training=False, shuffle=False
+        )
+        gen = prefetch_to_device(it, size=1)
+        next(gen)
+        gen.close()  # Early stop: consumer abandons mid-iteration.
+
+    before = threading.active_count()
+    for _ in range(5):
+        run_once()
+    deadline = time.time() + 5.0
+    while threading.active_count() > before and time.time() < deadline:
+        time.sleep(0.05)
+    # Producer threads must terminate, not accumulate.
+    assert threading.active_count() <= before + 1
+
+
+def test_multihost_forces_drop_remainder():
+    pre = PassThroughPreprocessing()
+    configure(pre, {}, name="pre")
+    # 10 examples, global batch 8, drop_remainder=False requested: both
+    # hosts must still agree on the batch count (partial batch dropped).
+    kw = dict(
+        training=False, shuffle=False, drop_remainder=False, host_count=2
+    )
+    src = make_source(10)
+    h0 = list(batch_iterator(src, pre, 4, host_index=0, **kw))
+    h1 = list(batch_iterator(src, pre, 4, host_index=1, **kw))
+    assert len(h0) == len(h1) == 1
+    assert h0[0]["input"].shape[0] == h1[0]["input"].shape[0] == 4

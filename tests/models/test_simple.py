@@ -1,0 +1,51 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from zookeeper_tpu.core import configure
+from zookeeper_tpu.models import Mlp, SimpleCnn
+
+
+def test_simple_cnn_build_and_forward():
+    m = SimpleCnn()
+    configure(m, {"features": (8, 8), "dense_units": (16,)}, name="m")
+    module = m.build((28, 28, 1), num_classes=10)
+    params, model_state = m.initialize(module, (28, 28, 1))
+    assert "batch_stats" in model_state
+    x = jnp.zeros((4, 28, 28, 1))
+    logits = module.apply({"params": params, **model_state}, x, training=False)
+    assert logits.shape == (4, 10)
+    assert logits.dtype == jnp.float32
+
+
+def test_mlp_bfloat16_compute():
+    m = Mlp()
+    configure(m, {"compute_dtype": "bfloat16"}, name="m")
+    module = m.build((8, 8, 1), num_classes=5)
+    params, model_state = m.initialize(module, (8, 8, 1))
+    assert model_state == {}
+    # Params stay float32 (mixed precision: bf16 compute, fp32 master).
+    kernel_dtypes = {
+        str(leaf.dtype) for leaf in jax.tree.leaves(params)
+    }
+    assert kernel_dtypes == {"float32"}
+    logits = module.apply({"params": params}, jnp.zeros((2, 8, 8, 1)))
+    assert logits.shape == (2, 5)
+    assert logits.dtype == jnp.float32
+
+
+def test_cnn_batch_stats_update():
+    m = SimpleCnn()
+    configure(m, {"features": (4,), "dense_units": ()}, name="m")
+    module = m.build((8, 8, 1), num_classes=3)
+    params, model_state = m.initialize(module, (8, 8, 1))
+    x = jnp.asarray(np.random.default_rng(0).normal(size=(4, 8, 8, 1)), jnp.float32)
+    _, updates = module.apply(
+        {"params": params, **model_state},
+        x,
+        training=True,
+        mutable=["batch_stats"],
+    )
+    old = jax.tree.leaves(model_state["batch_stats"])
+    new = jax.tree.leaves(updates["batch_stats"])
+    assert any(not np.allclose(a, b) for a, b in zip(old, new))

@@ -1,0 +1,69 @@
+import pytest
+
+from zookeeper_tpu.core import configure
+from zookeeper_tpu.training import TrainingExperiment
+
+
+def make_experiment(extra_conf=None):
+    exp = TrainingExperiment()
+    conf = {
+        "loader.dataset": "SyntheticMnist",
+        "loader.dataset.num_train_examples": 256,
+        "loader.dataset.num_validation_examples": 64,
+        "loader.preprocessing": "ImageClassificationPreprocessing",
+        "loader.preprocessing.height": 28,
+        "loader.preprocessing.width": 28,
+        "loader.preprocessing.channels": 1,
+        "loader.host_index": 0,
+        "loader.host_count": 1,
+        "model": "Mlp",
+        "model.hidden_units": (32,),
+        "batch_size": 32,
+        "epochs": 2,
+        "verbose": False,
+        **(extra_conf or {}),
+    }
+    configure(exp, conf, name="experiment")
+    return exp
+
+
+def test_experiment_end_to_end_learns():
+    exp = make_experiment()
+    history = exp.run()
+    assert len(history["train"]) == 2
+    assert len(history["validation"]) == 2
+    # Synthetic data has real signal: accuracy should clearly beat chance.
+    assert history["validation"][-1]["accuracy"] > 0.3
+    assert history["train"][1]["loss"] < history["train"][0]["loss"]
+    assert history["train"][0]["examples_per_sec"] > 0
+
+
+def test_experiment_batch_size_inherited_by_loader():
+    exp = make_experiment()
+    assert exp.loader.batch_size == 32
+    assert exp.loader.per_host_batch_size == 32
+
+
+def test_experiment_steps_per_epoch_cap():
+    exp = make_experiment({"steps_per_epoch": 2, "epochs": 1})
+    history = exp.run()
+    assert len(history["train"]) == 1
+    # 2 steps * 32 per batch.
+    assert exp._steps_per_epoch() == 2
+
+
+def test_experiment_data_parallel_on_cpu_mesh():
+    import jax
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs multi-device (conftest forces 8 CPU devices)")
+    exp = make_experiment(
+        {"partitioner": "DataParallelPartitioner", "epochs": 1}
+    )
+    history = exp.run()
+    assert history["validation"][-1]["accuracy"] > 0.2
+
+
+def test_experiment_num_classes_derived_from_dataset():
+    exp = make_experiment({"loader.dataset.num_classes": 7})
+    assert exp.num_classes == 7

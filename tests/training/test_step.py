@@ -1,0 +1,101 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from zookeeper_tpu.core import configure
+from zookeeper_tpu.models import Mlp, SimpleCnn
+from zookeeper_tpu.training import (
+    TrainState,
+    make_eval_step,
+    make_train_step,
+)
+
+
+def make_state(model_cls=Mlp, conf=None, input_shape=(6, 6, 1), num_classes=4):
+    m = model_cls()
+    configure(m, conf or {}, name="m")
+    module = m.build(input_shape, num_classes=num_classes)
+    params, model_state = m.initialize(module, input_shape)
+    return TrainState.create(
+        apply_fn=module.apply,
+        params=params,
+        model_state=model_state,
+        tx=optax.adam(1e-2),
+    )
+
+
+def toy_batch(n=16, input_shape=(6, 6, 1), num_classes=4, seed=0):
+    rng = np.random.default_rng(seed)
+    labels = rng.integers(0, num_classes, n)
+    x = rng.normal(size=(n, *input_shape)).astype(np.float32)
+    # Make inputs label-dependent so the model can learn.
+    x += labels[:, None, None, None] * 0.5
+    return {"input": jnp.asarray(x), "target": jnp.asarray(labels)}
+
+
+def test_train_step_reduces_loss():
+    state = make_state()
+    step = jax.jit(make_train_step())
+    batch = toy_batch()
+    losses = []
+    for _ in range(30):
+        state, metrics = step(state, batch)
+        losses.append(float(metrics["loss"]))
+    assert losses[-1] < losses[0] * 0.5
+    assert int(state.step) == 30
+
+
+def test_train_step_updates_batch_stats():
+    state = make_state(SimpleCnn, {"features": (4,), "dense_units": ()})
+    step = jax.jit(make_train_step())
+    batch = toy_batch()
+    new_state, _ = step(state, batch)
+    before = jax.tree.leaves(state.model_state["batch_stats"])
+    after = jax.tree.leaves(new_state.model_state["batch_stats"])
+    assert any(not np.allclose(a, b) for a, b in zip(before, after))
+
+
+def test_train_step_deterministic():
+    batch = toy_batch()
+    outs = []
+    for _ in range(2):
+        state = make_state()
+        step = jax.jit(make_train_step(rng_seed=3))
+        state, metrics = step(state, batch)
+        outs.append(float(metrics["loss"]))
+    assert outs[0] == outs[1]
+
+
+def test_eval_step_metrics():
+    state = make_state()
+    eval_step = jax.jit(make_eval_step())
+    metrics = eval_step(state, toy_batch())
+    assert set(metrics) == {"loss", "accuracy"}
+    assert 0.0 <= float(metrics["accuracy"]) <= 1.0
+
+
+def test_metrics_contents():
+    state = make_state()
+    step = jax.jit(make_train_step())
+    _, metrics = step(state, toy_batch())
+    assert set(metrics) == {"loss", "accuracy", "grad_norm"}
+    assert float(metrics["grad_norm"]) > 0
+
+
+def test_weight_decay_applies_to_all_optimizers():
+    from zookeeper_tpu.core import configure as _configure
+    from zookeeper_tpu.training import Momentum, Sgd
+
+    for cls in (Sgd, Momentum):
+        opt = cls()
+        _configure(opt, {"weight_decay": 0.1, "schedule.base_lr": 1.0}, name="o")
+        tx = opt.build(total_steps=10)
+        params = {"w": jnp.ones((3,))}
+        state = tx.init(params)
+        zero_grads = {"w": jnp.zeros((3,))}
+        updates, _ = tx.update(zero_grads, state, params)
+        new = optax.apply_updates(params, updates)
+        # With zero gradients, weight decay alone must shrink the params.
+        assert float(new["w"][0]) < 1.0, cls.__name__

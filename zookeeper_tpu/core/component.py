@@ -187,6 +187,10 @@ def _collect_fields(cls: type) -> Dict[str, Field]:
     return fields
 
 
+def _component_init_subclass(cls: type, **kwargs: Any) -> None:
+    cls.__component_fields__ = _collect_fields(cls)
+
+
 def _component_init(self: Any, **kwargs: Any) -> None:
     object.__setattr__(self, _VALUES, {})
     object.__setattr__(self, _CACHED, {})
@@ -254,8 +258,9 @@ def component(cls: type) -> type:
     """Class decorator that turns a plain class into a component."""
     if not inspect.isclass(cls):
         raise TypeError("@component can only be applied to classes.")
-    if getattr(cls, "__component__", False) and "__component_fields__" in vars(cls):
+    if "__component_decorated__" in vars(cls):
         raise TypeError(f"{cls.__name__} is already a component.")
+    cls.__component_decorated__ = True
     if "__init__" in vars(cls):
         raise TypeError(
             f"Component {cls.__name__} must not define __init__: field "
@@ -266,6 +271,9 @@ def component(cls: type) -> type:
     cls.__component_fields__ = _collect_fields(cls)
     cls.__init__ = _component_init
     cls.__setattr__ = _component_setattr
+    # Subclasses declare new/overriding Fields without re-decorating (e.g.
+    # an @task subclass of a component base): re-collect on subclassing.
+    cls.__init_subclass__ = classmethod(_component_init_subclass)
     if "__str__" not in vars(cls):
         cls.__str__ = _component_str
     if "__repr__" not in vars(cls):
@@ -362,9 +370,12 @@ def _configure_component(
             defaulted = False
             if child is missing:
                 if name in values:
-                    child = values[name]
-                    if inspect.isclass(child):
-                        child = child(**_applicable_overrides(field, child))
+                    # Pre-assigned values resolve exactly like conf values
+                    # (class / PartialComponent / instance all behave the
+                    # same through either entry point).
+                    child = _resolve_component_target(
+                        field, values[name], interactive
+                    )
                 elif _inherited_from_ancestor(instance, name):
                     # An ancestor's *explicitly-set* same-named component is
                     # shared by scope inheritance (beats our own default —

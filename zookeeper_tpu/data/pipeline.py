@@ -59,6 +59,12 @@ def batch_iterator(
     if n == 0:
         return
     global_batch = batch_size * host_count
+    # Multi-host pods MUST drop the final partial global batch: a batch
+    # present on some hosts but not others would desync the lockstep jitted
+    # step (one host enters the gradient all-reduce, the rest never join —
+    # pod-wide hang), and shape-changing partial batches would recompile.
+    if host_count > 1:
+        drop_remainder = True
     if shuffle:
         order = np.random.default_rng(
             np.random.SeedSequence([seed, epoch])
@@ -116,7 +122,17 @@ def prefetch_to_device(
     import jax
 
     q: "queue.Queue[Any]" = queue.Queue(maxsize=max(1, size))
+    stop = threading.Event()
     err: list[BaseException] = []
+
+    def put_or_stop(item) -> bool:
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
 
     def producer():
         try:
@@ -125,21 +141,28 @@ def prefetch_to_device(
                     batch = jax.device_put(batch, sharding)
                 else:
                     batch = jax.device_put(batch)
-                q.put(batch)
+                if not put_or_stop(batch):
+                    return  # Consumer gone: drop refs, free device buffers.
         except BaseException as e:  # propagate into consumer
             err.append(e)
         finally:
-            q.put(_END)
+            put_or_stop(_END)
 
     thread = threading.Thread(target=producer, daemon=True)
     thread.start()
-    while True:
-        item = q.get()
-        if item is _END:
-            if err:
-                raise err[0]
-            return
-        yield item
+    try:
+        while True:
+            item = q.get()
+            if item is _END:
+                if err:
+                    raise err[0]
+                return
+            yield item
+    finally:
+        # Consumer stopped early (e.g. steps_per_epoch cap): unblock and
+        # terminate the producer so threads/HBM buffers don't accumulate
+        # across epochs.
+        stop.set()
 
 
 @component
@@ -154,7 +177,10 @@ class DataLoader:
 
     dataset: Dataset = ComponentField()
     preprocessing: Preprocessing = ComponentField()
-    batch_size: int = Field(32)
+    #: No default on purpose: inherits the experiment's ``batch_size`` by
+    #: scoped field inheritance (a default here would shadow it — child
+    #: defaults beat ancestor defaults).
+    batch_size: int = Field()
     shuffle: bool = Field(True)
     seed: int = Field(0)
     drop_remainder: bool = Field(True)

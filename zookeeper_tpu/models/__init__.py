@@ -1,0 +1,26 @@
+"""Model components.
+
+Capability parity with the reference's ``zookeeper/tf/model.py``
+(SURVEY.md §2.2): an abstract ``Model`` component whose ``build(...)``
+returns the framework-native network object — here a ``flax.linen.Module``
+instead of a ``tf.keras.Model``. Architectures (the larq-zoo-equivalent
+families) live in submodules and register themselves as ``Model``
+subclasses for subclass-by-name configuration (``model=QuickNet``).
+"""
+
+from zookeeper_tpu.models.base import Model
+from zookeeper_tpu.models.simple import Mlp, SimpleCnn
+
+__all__ = ["Model", "Mlp", "SimpleCnn"]
+
+
+def _register_zoo() -> None:
+    """Import zoo submodules for their registration side effects (subclass
+    trees must be populated before subclass-by-name lookup)."""
+    from zookeeper_tpu.models import binary, resnet  # noqa: F401
+
+
+try:  # Zoo families require the quant ops; keep base importable regardless.
+    _register_zoo()
+except ImportError:  # pragma: no cover
+    pass

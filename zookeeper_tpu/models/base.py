@@ -1,0 +1,50 @@
+"""Abstract Model component."""
+
+from typing import Any, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from zookeeper_tpu.core import Field, component
+
+
+@component
+class Model:
+    """A component that builds a ``flax.linen.Module``.
+
+    Reference contract (SURVEY.md §2.2 `zookeeper/tf/model.py`
+    [unverified]): pure interface; all architecture lives in subclasses.
+    Modules built here follow one call convention:
+
+        module.apply(variables, x, training=bool, mutable=[...])
+
+    with ``x`` batched NHWC (or [batch, features]) and ``training``
+    switching BatchNorm/dropout behavior.
+    """
+
+    #: Compute dtype for activations. Params stay float32; bfloat16 here is
+    #: the standard TPU mixed-precision recipe (MXU-native, no loss scaling
+    #: needed thanks to the float32 accumulate + wide exponent).
+    compute_dtype: str = Field("float32")
+
+    def build(self, input_shape: Sequence[int], num_classes: int) -> nn.Module:
+        raise NotImplementedError("Model subclasses must implement build().")
+
+    def dtype(self):
+        return jnp.dtype(self.compute_dtype)
+
+    def initialize(
+        self,
+        module: nn.Module,
+        input_shape: Sequence[int],
+        seed: int = 0,
+    ) -> Tuple[Any, Any]:
+        """Init variables with a dummy batch; returns (params, model_state)
+        where model_state holds the non-trainable collections (e.g.
+        BatchNorm's ``batch_stats``)."""
+        rng = jax.random.PRNGKey(seed)
+        dummy = jnp.zeros((1, *input_shape), self.dtype())
+        variables = module.init(rng, dummy, training=False)
+        params = variables.pop("params")
+        return params, variables

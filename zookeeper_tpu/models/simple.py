@@ -1,0 +1,85 @@
+"""Small reference architectures (MNIST/CIFAR scale).
+
+The TPU-native counterpart of the reference example's small Keras CNN
+(SURVEY.md §2.3 `examples/larq_experiment.py` [unverified]): enough model
+to prove the whole component contract drives a real JAX training loop
+(BASELINE config #1).
+"""
+
+from typing import Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from zookeeper_tpu.core import Field, component
+from zookeeper_tpu.models.base import Model
+
+
+class _CnnModule(nn.Module):
+    features: Tuple[int, ...]
+    dense_units: Tuple[int, ...]
+    num_classes: int
+    use_batch_norm: bool
+    dtype: jnp.dtype
+
+    @nn.compact
+    def __call__(self, x, training: bool = False):
+        x = x.astype(self.dtype)
+        for i, f in enumerate(self.features):
+            x = nn.Conv(f, (3, 3), padding="SAME", dtype=self.dtype)(x)
+            if self.use_batch_norm:
+                x = nn.BatchNorm(use_running_average=not training)(x)
+            x = nn.relu(x)
+            if i % 2 == 1:
+                x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        for u in self.dense_units:
+            x = nn.Dense(u, dtype=self.dtype)(x)
+            x = nn.relu(x)
+        x = nn.Dense(self.num_classes, dtype=self.dtype)(x)
+        return x.astype(jnp.float32)
+
+
+class _MlpModule(nn.Module):
+    hidden_units: Tuple[int, ...]
+    num_classes: int
+    dtype: jnp.dtype
+
+    @nn.compact
+    def __call__(self, x, training: bool = False):
+        x = x.astype(self.dtype).reshape((x.shape[0], -1))
+        for u in self.hidden_units:
+            x = nn.relu(nn.Dense(u, dtype=self.dtype)(x))
+        return nn.Dense(self.num_classes, dtype=self.dtype)(x).astype(jnp.float32)
+
+
+@component
+class SimpleCnn(Model):
+    """Small conv net: [conv(-bn)-relu]xN with pooling, dense head."""
+
+    features: Sequence[int] = Field((32, 64))
+    dense_units: Sequence[int] = Field((128,))
+    use_batch_norm: bool = Field(True)
+
+    def build(self, input_shape, num_classes: int) -> nn.Module:
+        return _CnnModule(
+            features=tuple(self.features),
+            dense_units=tuple(self.dense_units),
+            num_classes=num_classes,
+            use_batch_norm=self.use_batch_norm,
+            dtype=self.dtype(),
+        )
+
+
+@component
+class Mlp(Model):
+    """Flatten + dense stack, the minimal smoke-test model."""
+
+    hidden_units: Sequence[int] = Field((128,))
+
+    def build(self, input_shape, num_classes: int) -> nn.Module:
+        return _MlpModule(
+            hidden_units=tuple(self.hidden_units),
+            num_classes=num_classes,
+            dtype=self.dtype(),
+        )

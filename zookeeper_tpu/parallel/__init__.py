@@ -1,0 +1,27 @@
+"""Distribution subsystem: device meshes, shardings, partitioners.
+
+The TPU-native replacement for the reference GPU baseline's
+``tf.distribute.MirroredStrategy`` + NCCL (SURVEY.md §2.5): a
+``Partitioner`` component owns the ``jax.sharding.Mesh`` and the placement
+of state and data; the training step itself stays a pure function and XLA
+inserts all collectives (gradient all-reduce over ICI for data parallelism,
+all-gathers for tensor-parallel params) from sharding annotations alone —
+no hand-written communication layer, by design.
+"""
+
+from zookeeper_tpu.parallel.partitioner import (
+    DataParallelPartitioner,
+    MeshPartitioner,
+    Partitioner,
+    SingleDevicePartitioner,
+)
+from zookeeper_tpu.parallel.rules import PartitionRule, match_partition_rules
+
+__all__ = [
+    "DataParallelPartitioner",
+    "MeshPartitioner",
+    "Partitioner",
+    "PartitionRule",
+    "SingleDevicePartitioner",
+    "match_partition_rules",
+]

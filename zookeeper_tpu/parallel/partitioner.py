@@ -1,0 +1,193 @@
+"""Partitioner components: who owns the mesh and the shardings.
+
+SNIPPETS.md [3]-style ``Partitioner`` abstraction (public pattern): the
+training loop asks the partitioner to (a) place the initial state, (b)
+provide the batch sharding for host->device prefetch, and (c) compile the
+step function. Everything else — collectives, replication, donation — is
+derived by XLA from the shardings.
+
+- ``SingleDevicePartitioner``: plain ``jax.jit`` on the default device
+  (BASELINE config #1, CPU/1-chip path).
+- ``DataParallelPartitioner``: 1-D mesh over all devices, batch sharded on
+  the ``data`` axis, state replicated; XLA inserts the gradient all-reduce
+  over ICI (the MirroredStrategy+NCCL equivalent, SURVEY.md §2.5).
+- ``MeshPartitioner``: general N-D mesh (``data``/``fsdp``/``model`` axes)
+  with regex partition rules for tensor-parallel / FSDP layouts and batch
+  sharded over all data-like axes.
+"""
+
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from zookeeper_tpu.core import Field, component
+from zookeeper_tpu.parallel.rules import PartitionRule, match_partition_rules
+
+
+@component
+class Partitioner:
+    """Abstract distribution strategy."""
+
+    def setup(self) -> None:
+        """Create the mesh (if any). Idempotent."""
+
+    @property
+    def mesh(self) -> Optional[Mesh]:
+        return None
+
+    def batch_sharding(self) -> Optional[NamedSharding]:
+        """Sharding for host->device prefetch of batches (None = default
+        device placement)."""
+        return None
+
+    def shard_state(self, state: Any) -> Any:
+        """Place the freshly-initialized state onto devices."""
+        return state
+
+    def state_sharding(self, state: Any) -> Any:
+        """Sharding pytree (or prefix) describing the placed state."""
+        return None
+
+    def compile_step(
+        self, step_fn: Callable, state: Any, *, donate_state: bool = True
+    ) -> Callable:
+        """Compile ``(state, batch) -> (state, metrics)``."""
+        raise NotImplementedError
+
+    def compile_eval(self, eval_fn: Callable, state: Any) -> Callable:
+        """Compile ``(state, batch) -> metrics``."""
+        raise NotImplementedError
+
+
+@component
+class SingleDevicePartitioner(Partitioner):
+    """Plain jit on the default device."""
+
+    def compile_step(self, step_fn, state, *, donate_state: bool = True):
+        return jax.jit(step_fn, donate_argnums=(0,) if donate_state else ())
+
+    def compile_eval(self, eval_fn, state):
+        return jax.jit(eval_fn)
+
+
+def _device_mesh(axis_sizes: Sequence[int], axis_names: Sequence[str]) -> Mesh:
+    """Build a mesh over all addressable+global devices. ``-1`` in
+    ``axis_sizes`` infers that axis from the device count (like reshape)."""
+    devices = np.asarray(jax.devices())
+    n = devices.size
+    sizes = list(axis_sizes)
+    if sizes.count(-1) > 1:
+        raise ValueError("At most one mesh axis may be -1.")
+    known = int(np.prod([s for s in sizes if s != -1])) if sizes else 1
+    if -1 in sizes:
+        if n % known != 0:
+            raise ValueError(
+                f"Device count {n} not divisible by fixed axes {known}."
+            )
+        sizes[sizes.index(-1)] = n // known
+    if int(np.prod(sizes)) != n:
+        raise ValueError(
+            f"Mesh {dict(zip(axis_names, sizes))} needs "
+            f"{int(np.prod(sizes))} devices, have {n}."
+        )
+    try:
+        from jax.experimental import mesh_utils
+
+        dev_array = mesh_utils.create_device_mesh(sizes)
+    except Exception:
+        dev_array = devices.reshape(sizes)
+    return Mesh(dev_array, tuple(axis_names))
+
+
+@component
+class MeshPartitioner(Partitioner):
+    """General N-D mesh partitioner.
+
+    ``mesh_shape``/``mesh_axes`` define the mesh (e.g. ``(-1, 8)`` with
+    ``('data', 'model')``); ``data_axes`` names the axes the batch dimension
+    is sharded over (DP and FSDP axes both carry batch); ``rules`` maps
+    param paths to PartitionSpecs (empty = fully replicated params).
+    """
+
+    mesh_shape: Sequence[int] = Field((-1,))
+    mesh_axes: Sequence[str] = Field(("data",))
+    data_axes: Sequence[str] = Field(("data",))
+
+    _mesh: Optional[Mesh] = None
+    _rules: List[PartitionRule] = []
+
+    def with_rules(self, rules: Sequence[PartitionRule]) -> "MeshPartitioner":
+        """Set param partition rules (programmatic, since PartitionSpecs are
+        not CLI-expressible). Returns self for chaining."""
+        object.__setattr__(self, "_rules_override", list(rules))
+        return self
+
+    @property
+    def rules(self) -> List[PartitionRule]:
+        return getattr(self, "_rules_override", self._rules)
+
+    def setup(self) -> None:
+        if self._mesh is None:
+            object.__setattr__(
+                self,
+                "_mesh",
+                _device_mesh(tuple(self.mesh_shape), tuple(self.mesh_axes)),
+            )
+
+    @property
+    def mesh(self) -> Optional[Mesh]:
+        self.setup()
+        return self._mesh
+
+    def batch_sharding(self) -> NamedSharding:
+        return NamedSharding(self.mesh, PartitionSpec(tuple(self.data_axes)))
+
+    def state_sharding(self, state: Any) -> Any:
+        """Per-leaf shardings for the whole TrainState.
+
+        The partition rules are matched against full state paths
+        (``params/Dense_0/kernel``, ``opt_state/0/mu/Dense_0/kernel``), so
+        a rule like ``("kernel", P(None, "model"))`` shards the parameter
+        AND its Adam moments identically — which is exactly the invariant
+        sharded optimizers need. Unmatched leaves (step, batch_stats,
+        counters) replicate.
+        """
+        mesh = self.mesh
+        specs = match_partition_rules(self.rules, state)
+        return jax.tree.map(lambda s: NamedSharding(mesh, s), specs)
+
+    def shard_state(self, state: Any) -> Any:
+        sharding = self.state_sharding(state)
+        return jax.tree.map(
+            lambda x, s: jax.device_put(x, s),
+            state,
+            sharding,
+        )
+
+    def compile_step(self, step_fn, state, *, donate_state: bool = True):
+        state_sh = self.state_sharding(state)
+        batch_sh = self.batch_sharding()
+        metrics_sh = NamedSharding(self.mesh, PartitionSpec())
+        return jax.jit(
+            step_fn,
+            in_shardings=(state_sh, batch_sh),
+            out_shardings=(state_sh, metrics_sh),
+            donate_argnums=(0,) if donate_state else (),
+        )
+
+    def compile_eval(self, eval_fn, state):
+        state_sh = self.state_sharding(state)
+        batch_sh = self.batch_sharding()
+        return jax.jit(
+            eval_fn,
+            in_shardings=(state_sh, batch_sh),
+            out_shardings=NamedSharding(self.mesh, PartitionSpec()),
+        )
+
+
+@component
+class DataParallelPartitioner(MeshPartitioner):
+    """Pure DP: 1-D mesh, batch on 'data', everything replicated (the
+    MeshPartitioner defaults, under the name users reach for)."""

@@ -1,0 +1,47 @@
+"""Regex partition rules: param path -> PartitionSpec.
+
+The standard JAX pattern for declaring how each parameter shards over the
+mesh (SNIPPETS.md [1] `match_partition_rules`-style, public pattern): rules
+are (regex, PartitionSpec) pairs matched against the '/'-joined param path;
+first match wins. Used by MeshPartitioner for tensor-parallel / FSDP
+layouts while data parallelism needs no rules at all.
+"""
+
+import re
+from typing import Any, Sequence, Tuple
+
+import jax
+from jax.sharding import PartitionSpec
+
+PartitionRule = Tuple[str, PartitionSpec]
+
+
+def _path_str(path) -> str:
+    parts = []
+    for p in path:
+        if hasattr(p, "key"):  # DictKey
+            parts.append(str(p.key))
+        elif hasattr(p, "idx"):  # SequenceKey
+            parts.append(str(p.idx))
+        elif hasattr(p, "name"):  # GetAttrKey (dataclass fields)
+            parts.append(str(p.name))
+        else:
+            parts.append(str(p))
+    return "/".join(parts)
+
+
+def match_partition_rules(
+    rules: Sequence[PartitionRule], tree: Any
+) -> Any:
+    """Map every leaf of ``tree`` to the PartitionSpec of the first rule
+    whose regex searches its '/'-joined path; unmatched leaves replicate
+    (``PartitionSpec()``)."""
+
+    def assign(path, leaf):
+        path_s = _path_str(path)
+        for pattern, spec in rules:
+            if re.search(pattern, path_s):
+                return spec
+        return PartitionSpec()
+
+    return jax.tree_util.tree_map_with_path(assign, tree)

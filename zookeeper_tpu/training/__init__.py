@@ -1,0 +1,48 @@
+"""Training subsystem.
+
+The TPU-native replacement for the reference's
+``Experiment.run() -> keras compile/fit`` path (SURVEY.md §3.3): an
+explicit, jittable functional train step over an immutable ``TrainState``,
+optax optimizers/schedules wired as configurable components, and an
+``Experiment`` component owning the loop. Distribution is delegated to a
+``Partitioner`` component (``zookeeper_tpu.parallel``) so the same loop
+runs single-device, data-parallel, or model-parallel.
+"""
+
+from zookeeper_tpu.training.experiment import Experiment, TrainingExperiment
+from zookeeper_tpu.training.optimizer import (
+    Adam,
+    AdamW,
+    Momentum,
+    Optimizer,
+    Rmsprop,
+    Sgd,
+)
+from zookeeper_tpu.training.schedule import (
+    ConstantSchedule,
+    CosineDecay,
+    Schedule,
+    StepDecay,
+    WarmupCosine,
+)
+from zookeeper_tpu.training.state import TrainState
+from zookeeper_tpu.training.step import make_eval_step, make_train_step
+
+__all__ = [
+    "Adam",
+    "AdamW",
+    "ConstantSchedule",
+    "CosineDecay",
+    "Experiment",
+    "Momentum",
+    "Optimizer",
+    "Rmsprop",
+    "Schedule",
+    "Sgd",
+    "StepDecay",
+    "TrainState",
+    "TrainingExperiment",
+    "WarmupCosine",
+    "make_eval_step",
+    "make_train_step",
+]

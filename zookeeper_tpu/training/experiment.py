@@ -1,0 +1,161 @@
+"""Experiment components: the configurable training loop.
+
+Reference contract (SURVEY.md §2.2/§3.3): ``Experiment`` is an abstract
+``@task``-style component whose ``run()`` owns training. The canonical
+``TrainingExperiment`` here replaces the Keras compile/fit path with:
+
+    loader.batches() ──prefetch──► device memory (sharded)
+    state = TrainState(params, opt_state, batch_stats)
+    step  = partitioner.compile_step(make_train_step(...))   # jit/pjit
+    for epoch: for batch: state, metrics = step(state, batch)
+
+Throughput (examples/sec) is measured natively since images/sec/chip is the
+north-star metric (BASELINE.md).
+"""
+
+import time
+from typing import Any, Dict, List, Optional
+
+from zookeeper_tpu.core import ComponentField, Field, component, pretty_print
+from zookeeper_tpu.data.pipeline import DataLoader
+from zookeeper_tpu.models.base import Model
+from zookeeper_tpu.parallel.partitioner import Partitioner, SingleDevicePartitioner
+from zookeeper_tpu.training.optimizer import Adam, Optimizer
+from zookeeper_tpu.training.state import TrainState
+from zookeeper_tpu.training.step import make_eval_step, make_train_step
+
+
+@component
+class Experiment:
+    """Abstract experiment: subclasses implement run()."""
+
+    def run(self) -> Any:
+        raise NotImplementedError("Experiment subclasses must implement run().")
+
+
+@component
+class TrainingExperiment(Experiment):
+    """Supervised-classification training loop.
+
+    ``batch_size`` declared here is inherited by the loader through scoped
+    field inheritance (the reference's signature config-reuse mechanism):
+    set it once on the experiment.
+    """
+
+    loader: DataLoader = ComponentField(DataLoader)
+    model: Model = ComponentField()
+    optimizer: Optimizer = ComponentField(Adam)
+    partitioner: Partitioner = ComponentField(SingleDevicePartitioner)
+
+    epochs: int = Field(1)
+    batch_size: int = Field(32)
+    seed: int = Field(0)
+    #: Cap on steps per epoch (smoke tests / benchmarking); -1 = full epoch.
+    steps_per_epoch: int = Field(-1)
+    validate: bool = Field(True)
+    log_every: int = Field(0)  # Steps between progress lines; 0 = epoch only.
+    verbose: bool = Field(True)
+
+    @Field
+    def num_classes(self) -> int:
+        return int(self.loader.dataset.num_classes)
+
+    def _log(self, msg: str) -> None:
+        if self.verbose:
+            print(msg, flush=True)
+
+    def build_state(self) -> TrainState:
+        """Build module + optimizer and initialize the TrainState."""
+        input_shape = self.loader.preprocessing.input_shape
+        module = self.model.build(input_shape, self.num_classes)
+        params, model_state = self.model.initialize(
+            module, input_shape, seed=self.seed
+        )
+        spe = self._steps_per_epoch()
+        tx = self.optimizer.build(total_steps=max(1, spe * self.epochs))
+        return TrainState.create(
+            apply_fn=module.apply,
+            params=params,
+            model_state=model_state,
+            tx=tx,
+        )
+
+    def _steps_per_epoch(self) -> int:
+        spe = self.loader.steps_per_epoch("train")
+        if self.steps_per_epoch > 0:
+            spe = min(spe, self.steps_per_epoch)
+        return spe
+
+    def run(self) -> Dict[str, List[Dict[str, float]]]:
+        import jax
+        import numpy as np
+
+        self._log(pretty_print(self))
+        partitioner = self.partitioner
+        partitioner.setup()
+        state = partitioner.shard_state(self.build_state())
+        train_step = partitioner.compile_step(
+            make_train_step(rng_seed=self.seed), state
+        )
+        eval_step = partitioner.compile_eval(make_eval_step(), state)
+        batch_sharding = partitioner.batch_sharding()
+
+        spe = self._steps_per_epoch()
+        history: Dict[str, List[Dict[str, float]]] = {"train": [], "validation": []}
+        for epoch in range(self.epochs):
+            t0 = time.perf_counter()
+            accum: List[Any] = []
+            for step_idx, batch in enumerate(
+                self.loader.batches("train", epoch=epoch, sharding=batch_sharding)
+            ):
+                if step_idx >= spe:
+                    break
+                state, metrics = train_step(state, batch)
+                accum.append(metrics)
+                if self.log_every and (step_idx + 1) % self.log_every == 0:
+                    m = {k: float(v) for k, v in metrics.items()}
+                    self._log(
+                        f"  step {step_idx + 1}/{spe} "
+                        f"loss={m['loss']:.4f} acc={m['accuracy']:.4f}"
+                    )
+            # One host sync per epoch: pull all accumulated device scalars
+            # in a single device_get (each separate transfer pays the full
+            # host<->device round trip, ~100ms on remote-tunnel TPUs).
+            host_accum = jax.device_get(accum)
+            epoch_metrics = {
+                k: float(np.mean([m[k] for m in host_accum]))
+                for k in (host_accum[0] if host_accum else {})
+            }
+            dt = time.perf_counter() - t0
+            examples = len(accum) * self.loader.batch_size
+            epoch_metrics["examples_per_sec"] = examples / dt if dt > 0 else 0.0
+            history["train"].append(epoch_metrics)
+            line = (
+                f"epoch {epoch + 1}/{self.epochs} "
+                f"loss={epoch_metrics.get('loss', float('nan')):.4f} "
+                f"acc={epoch_metrics.get('accuracy', float('nan')):.4f} "
+                f"({epoch_metrics['examples_per_sec']:.0f} ex/s)"
+            )
+
+            if self.validate and self.loader.dataset.validation() is not None:
+                vaccum = jax.device_get(
+                    [
+                        eval_step(state, batch)
+                        for batch in self.loader.batches(
+                            "validation", epoch=epoch, sharding=batch_sharding
+                        )
+                    ]
+                )
+                vmetrics = {
+                    k: float(np.mean([m[k] for m in vaccum]))
+                    for k in (vaccum[0] if vaccum else {})
+                }
+                history["validation"].append(vmetrics)
+                line += (
+                    f" | val_loss={vmetrics.get('loss', float('nan')):.4f} "
+                    f"val_acc={vmetrics.get('accuracy', float('nan')):.4f}"
+                )
+            self._log(line)
+
+        self.final_state = state
+        return history

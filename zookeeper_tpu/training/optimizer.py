@@ -1,0 +1,93 @@
+"""Optimizer components (optax-backed).
+
+The reference example compiles a Keras ``Adam`` (SURVEY.md §3.3); here
+optimizers are components building ``optax.GradientTransformation``s, with
+the learning-rate schedule as a nested component.
+"""
+
+from typing import Optional
+
+import optax
+
+from zookeeper_tpu.core import ComponentField, Field, component
+from zookeeper_tpu.training.schedule import ConstantSchedule, Schedule
+
+
+@component
+class Optimizer:
+    """Builds an ``optax.GradientTransformation``.
+
+    ``schedule`` supplies the per-step learning rate; ``weight_decay`` and
+    ``global_clip_norm`` are common enough across experiments to live on
+    the base component.
+    """
+
+    schedule: Schedule = ComponentField(ConstantSchedule)
+    weight_decay: float = Field(0.0)
+    global_clip_norm: float = Field(0.0)
+
+    #: Subclasses whose _core already applies weight_decay (AdamW path) set
+    #: this so the base chain does not double-apply it.
+    _core_handles_weight_decay = False
+
+    def _core(self, lr) -> optax.GradientTransformation:
+        raise NotImplementedError
+
+    def build(self, total_steps: int) -> optax.GradientTransformation:
+        lr = self.schedule.build(total_steps)
+        chain = []
+        if self.global_clip_norm > 0:
+            chain.append(optax.clip_by_global_norm(self.global_clip_norm))
+        if self.weight_decay > 0 and not self._core_handles_weight_decay:
+            chain.append(optax.add_decayed_weights(self.weight_decay))
+        chain.append(self._core(lr))
+        return optax.chain(*chain) if len(chain) > 1 else chain[0]
+
+
+@component
+class Sgd(Optimizer):
+    def _core(self, lr):
+        return optax.sgd(lr)
+
+
+@component
+class Momentum(Optimizer):
+    momentum: float = Field(0.9)
+    nesterov: bool = Field(False)
+
+    def _core(self, lr):
+        return optax.sgd(lr, momentum=self.momentum, nesterov=self.nesterov)
+
+
+@component
+class Adam(Optimizer):
+    b1: float = Field(0.9)
+    b2: float = Field(0.999)
+    eps: float = Field(1e-8)
+
+    _core_handles_weight_decay = True  # Decoupled (adamw) when wd > 0.
+
+    def _core(self, lr):
+        if self.weight_decay > 0:
+            return optax.adamw(
+                lr, b1=self.b1, b2=self.b2, eps=self.eps,
+                weight_decay=self.weight_decay,
+            )
+        return optax.adam(lr, b1=self.b1, b2=self.b2, eps=self.eps)
+
+
+@component
+class AdamW(Adam):
+    weight_decay: float = Field(1e-4)
+
+
+@component
+class Rmsprop(Optimizer):
+    decay: float = Field(0.9)
+    eps: float = Field(1e-8)
+    momentum: float = Field(0.0)
+
+    def _core(self, lr):
+        return optax.rmsprop(
+            lr, decay=self.decay, eps=self.eps, momentum=self.momentum
+        )

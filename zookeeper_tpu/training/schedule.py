@@ -1,0 +1,73 @@
+"""Learning-rate schedule components (optax-backed).
+
+The reference delegates schedules to user Keras code; here they are
+first-class components so ``lr`` policy is part of the printed config tree
+and CLI-overridable (``schedule=WarmupCosine schedule.warmup_steps=500``).
+"""
+
+from typing import Callable, List
+
+import optax
+
+from zookeeper_tpu.core import Field, component
+
+
+@component
+class Schedule:
+    """Builds an ``optax`` schedule: step -> learning rate."""
+
+    base_lr: float = Field(1e-3)
+
+    def build(self, total_steps: int) -> Callable:
+        raise NotImplementedError
+
+
+@component
+class ConstantSchedule(Schedule):
+    def build(self, total_steps: int) -> Callable:
+        return optax.constant_schedule(self.base_lr)
+
+
+@component
+class CosineDecay(Schedule):
+    alpha: float = Field(0.0)  # Final LR fraction.
+
+    def build(self, total_steps: int) -> Callable:
+        return optax.cosine_decay_schedule(
+            self.base_lr, decay_steps=max(1, total_steps), alpha=self.alpha
+        )
+
+
+@component
+class WarmupCosine(Schedule):
+    warmup_steps: int = Field(0)
+    warmup_fraction: float = Field(0.0)  # Used when warmup_steps == 0.
+    alpha: float = Field(0.0)
+
+    def build(self, total_steps: int) -> Callable:
+        warmup = self.warmup_steps or int(total_steps * self.warmup_fraction)
+        warmup = min(warmup, max(0, total_steps - 1))
+        return optax.warmup_cosine_decay_schedule(
+            init_value=0.0,
+            peak_value=self.base_lr,
+            warmup_steps=max(1, warmup),
+            decay_steps=max(2, total_steps),
+            end_value=self.base_lr * self.alpha,
+        )
+
+
+@component
+class StepDecay(Schedule):
+    """Piecewise-constant decay at fractional boundaries of training."""
+
+    boundaries: List[float] = Field([0.5, 0.75])
+    factor: float = Field(0.1)
+
+    def build(self, total_steps: int) -> Callable:
+        return optax.piecewise_constant_schedule(
+            self.base_lr,
+            {
+                max(1, int(b * total_steps)): self.factor
+                for b in self.boundaries
+            },
+        )

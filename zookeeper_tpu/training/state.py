@@ -1,0 +1,52 @@
+"""Immutable training state pytree.
+
+Replaces the mutable Keras model/optimizer objects of the reference's fit
+loop with a single pytree threaded through the jitted step — the functional
+idiom XLA compiles best (donated in, new state out, all updates fused
+on-device).
+"""
+
+from typing import Any, Callable
+
+import flax.struct
+import jax
+import optax
+
+
+@flax.struct.dataclass
+class TrainState:
+    """Params + optimizer state + non-trainable model state (batch_stats).
+
+    ``apply_fn``/``tx`` are static (not traced); everything else is a leaf.
+    """
+
+    step: jax.Array
+    params: Any
+    model_state: Any  # e.g. {"batch_stats": ...}; {} for stateless models.
+    opt_state: Any
+    apply_fn: Callable = flax.struct.field(pytree_node=False)
+    tx: optax.GradientTransformation = flax.struct.field(pytree_node=False)
+
+    @classmethod
+    def create(cls, *, apply_fn, params, model_state, tx) -> "TrainState":
+        import jax.numpy as jnp
+
+        return cls(
+            step=jnp.zeros((), jnp.int32),
+            params=params,
+            model_state=dict(model_state),
+            opt_state=tx.init(params),
+            apply_fn=apply_fn,
+            tx=tx,
+        )
+
+    def apply_gradients(self, grads) -> "TrainState":
+        updates, new_opt_state = self.tx.update(
+            grads, self.opt_state, self.params
+        )
+        new_params = optax.apply_updates(self.params, updates)
+        return self.replace(
+            step=self.step + 1,
+            params=new_params,
+            opt_state=new_opt_state,
+        )
