@@ -209,3 +209,24 @@ def test_preassigned_partial_component_keeps_field_overrides():
     r2 = Root12()
     configure(r2, {"child": PartialComponent(Child, b=5)}, name="r2")
     assert (r1.child.a, r1.child.b) == (r2.child.a, r2.child.b) == (99, 5)
+
+
+def test_init_subclass_cooperative_chaining():
+    registry = []
+
+    class RegistryMixin:
+        def __init_subclass__(cls, **kwargs):
+            super().__init_subclass__(**kwargs)
+            registry.append(cls.__name__)
+
+    @component
+    class Base13(RegistryMixin):
+        a: int = Field(1)
+
+    class Sub13(Base13):
+        b: int = Field(2)
+
+    # The mixin's registration hook must still run for component subclasses.
+    assert "Sub13" in registry
+    # And the subclass's own fields are collected.
+    assert set(Sub13.__component_fields__) == {"a", "b"}

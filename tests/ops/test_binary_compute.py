@@ -1,0 +1,124 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from zookeeper_tpu.ops import (
+    int8_conv,
+    int8_matmul,
+    pack_bits,
+    unpack_bits,
+    xnor_matmul,
+)
+
+
+def random_signs(shape, seed=0):
+    rng = np.random.default_rng(seed)
+    return jnp.asarray(rng.choice([-1.0, 1.0], size=shape), jnp.float32)
+
+
+def test_pack_unpack_roundtrip():
+    x = random_signs((4, 64))
+    packed = pack_bits(x)
+    assert packed.shape == (4, 2)
+    assert packed.dtype == jnp.int32
+    np.testing.assert_array_equal(np.asarray(unpack_bits(packed, 64)), np.asarray(x))
+
+
+def test_pack_bits_axis():
+    x = random_signs((32, 5))
+    packed = pack_bits(x, axis=0)
+    assert packed.shape == (1, 5)
+    np.testing.assert_array_equal(
+        np.asarray(unpack_bits(packed, 32, axis=0)), np.asarray(x)
+    )
+
+
+def test_pack_bits_requires_multiple_of_32():
+    with pytest.raises(ValueError, match="multiple of 32"):
+        pack_bits(random_signs((4, 33)))
+
+
+def test_xnor_matmul_matches_float(interpret=True):
+    a = random_signs((17, 96), seed=1)
+    b = random_signs((96, 23), seed=2)
+    expected = np.asarray(a @ b)
+    got = np.asarray(xnor_matmul(a, b, interpret=True, block_m=8, block_n=8))
+    np.testing.assert_array_equal(got, expected)
+
+
+def test_xnor_matmul_k_padding():
+    # K not a multiple of 32: symmetric padding must cancel exactly.
+    a = random_signs((5, 40), seed=3)
+    b = random_signs((40, 7), seed=4)
+    expected = np.asarray(a @ b)
+    got = np.asarray(xnor_matmul(a, b, interpret=True, block_m=8, block_n=8))
+    np.testing.assert_array_equal(got, expected)
+
+
+def test_int8_matmul_matches_float():
+    a = random_signs((16, 64), seed=5)
+    b = random_signs((64, 8), seed=6)
+    np.testing.assert_array_equal(
+        np.asarray(int8_matmul(a, b)), np.asarray(a @ b)
+    )
+
+
+def test_int8_conv_matches_float_conv():
+    x = random_signs((2, 8, 8, 16), seed=7)
+    k = random_signs((3, 3, 16, 8), seed=8)
+    expected = jax.lax.conv_general_dilated(
+        x, k, (1, 1), "SAME", dimension_numbers=("NHWC", "HWIO", "NHWC")
+    )
+    got = int8_conv(x, k, (1, 1), "SAME")
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(expected))
+
+
+def test_int8_conv_gradients_match_float_conv():
+    x = random_signs((1, 6, 6, 4), seed=9)
+    k = random_signs((3, 3, 4, 2), seed=10)
+
+    def loss_int8(x, k):
+        return (int8_conv(x, k, (1, 1), "SAME") ** 2).sum()
+
+    def loss_float(x, k):
+        y = jax.lax.conv_general_dilated(
+            x, k, (1, 1), "SAME", dimension_numbers=("NHWC", "HWIO", "NHWC")
+        )
+        return (y**2).sum()
+
+    gx1, gk1 = jax.grad(loss_int8, argnums=(0, 1))(x, k)
+    gx2, gk2 = jax.grad(loss_float, argnums=(0, 1))(x, k)
+    np.testing.assert_allclose(np.asarray(gx1), np.asarray(gx2), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(gk1), np.asarray(gk2), rtol=1e-5)
+
+
+def test_quant_conv_int8_path_matches_mxu_path():
+    from zookeeper_tpu.ops import QuantConv
+
+    x = jnp.asarray(
+        np.random.default_rng(11).normal(size=(2, 8, 8, 8)), jnp.float32
+    )
+    kwargs = dict(
+        features=4, kernel_size=(3, 3), input_quantizer="ste_sign",
+        kernel_quantizer="ste_sign",
+    )
+    mxu = QuantConv(**kwargs, binary_compute="mxu")
+    i8 = QuantConv(**kwargs, binary_compute="int8")
+    params = mxu.init(jax.random.PRNGKey(0), x)
+    y1 = mxu.apply(params, x)
+    y2 = i8.apply(params, x)
+    np.testing.assert_array_equal(np.asarray(y1), np.asarray(y2))
+    # Gradients agree too (STE through both paths).
+    g1 = jax.grad(lambda p: (mxu.apply(p, x) ** 2).sum())(params)
+    g2 = jax.grad(lambda p: (i8.apply(p, x) ** 2).sum())(params)
+    for a, b in zip(jax.tree.leaves(g1), jax.tree.leaves(g2)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5)
+
+
+def test_xnor_matmul_large_shapes_interpret():
+    # Multi-block grid path (block 128 with 150x260 output).
+    a = random_signs((150, 128), seed=12)
+    b = random_signs((128, 260), seed=13)
+    got = np.asarray(xnor_matmul(a, b, interpret=True))
+    np.testing.assert_array_equal(got, np.asarray(a @ b))

@@ -1,0 +1,112 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from zookeeper_tpu.ops import QuantConv, QuantDense
+
+
+def test_quant_dense_binary_forward():
+    layer = QuantDense(
+        features=4, input_quantizer="ste_sign", kernel_quantizer="ste_sign",
+        use_bias=False,
+    )
+    x = jnp.asarray(np.random.default_rng(0).normal(size=(2, 8)), jnp.float32)
+    params = layer.init(jax.random.PRNGKey(0), x)
+    y = layer.apply(params, x)
+    # Output of +-1 inputs dot +-1 kernel over 8 terms: even ints in [-8, 8].
+    vals = np.asarray(y)
+    assert np.all(np.abs(vals) <= 8)
+    assert np.allclose(vals, np.round(vals))
+    assert np.all(np.mod(vals, 2) == np.mod(8, 2) % 2)
+
+
+def test_quant_dense_latent_weights_fp32_and_trainable():
+    layer = QuantDense(features=3, kernel_quantizer="ste_sign")
+    x = jnp.ones((4, 5))
+    params = layer.init(jax.random.PRNGKey(0), x)
+    assert params["params"]["kernel"].dtype == jnp.float32
+
+    def loss(p):
+        return (layer.apply(p, x) ** 2).sum()
+
+    grads = jax.grad(loss)(params)
+    # STE: latent kernel receives nonzero gradient.
+    assert float(jnp.abs(grads["params"]["kernel"]).sum()) > 0
+
+
+def test_kernel_clip_projects_forward_only():
+    layer = QuantDense(features=2, kernel_quantizer=None, kernel_clip=True,
+                       use_bias=False)
+    x = jnp.ones((1, 2))
+    params = layer.init(jax.random.PRNGKey(0), x)
+    big = {"params": {"kernel": jnp.array([[3.0, -3.0], [0.5, -0.5]])}}
+    y = layer.apply(big, x)
+    # Forward sees clipped kernel: 1 + .5 = 1.5 ; -1 + -.5 = -1.5.
+    np.testing.assert_allclose(np.asarray(y)[0], [1.5, -1.5])
+    g = jax.grad(lambda p: layer.apply(p, x).sum())(big)
+    # Gradient passes straight through the clip.
+    np.testing.assert_allclose(np.asarray(g["params"]["kernel"]), 1.0)
+
+
+def test_quant_conv_matches_manual_sign_conv():
+    layer = QuantConv(
+        features=2, kernel_size=(3, 3), kernel_quantizer="ste_sign",
+        input_quantizer=None, padding="VALID",
+    )
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.normal(size=(1, 5, 5, 1)), jnp.float32)
+    params = layer.init(jax.random.PRNGKey(0), x)
+    y = layer.apply(params, x)
+    kernel = np.asarray(params["params"]["kernel"])
+    signk = np.where(np.clip(kernel, -1, 1) >= 0, 1.0, -1.0)
+    manual = jax.lax.conv_general_dilated(
+        x, jnp.asarray(signk), (1, 1), "VALID",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+    np.testing.assert_allclose(np.asarray(y), np.asarray(manual), rtol=1e-5)
+    assert y.shape == (1, 3, 3, 2)
+
+
+def test_quant_conv_bf16_compute():
+    layer = QuantConv(
+        features=4, kernel_size=(3, 3), input_quantizer="ste_sign",
+        kernel_quantizer="ste_sign", dtype=jnp.bfloat16,
+    )
+    x = jnp.ones((2, 8, 8, 3))
+    params = layer.init(jax.random.PRNGKey(0), x)
+    y = layer.apply(params, x)
+    assert y.dtype == jnp.bfloat16
+    assert params["params"]["kernel"].dtype == jnp.float32
+
+
+def test_binary_layer_trains():
+    import optax
+
+    layer = QuantDense(
+        features=2, input_quantizer="ste_sign", kernel_quantizer="ste_sign"
+    )
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(64, 16)), jnp.float32)
+    w_true = jnp.asarray(rng.normal(size=(16,)))
+    y_true = (x @ w_true > 0).astype(jnp.int32)
+    params = layer.init(jax.random.PRNGKey(0), x)
+    tx = optax.adam(0.01)
+    opt_state = tx.init(params)
+
+    @jax.jit
+    def step(params, opt_state):
+        def loss_fn(p):
+            logits = layer.apply(p, x)
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits, y_true
+            ).mean()
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = tx.update(grads, opt_state)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    losses = []
+    for _ in range(60):
+        params, opt_state, loss = step(params, opt_state)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.8

@@ -1,0 +1,110 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from zookeeper_tpu.ops import (
+    approx_sign,
+    dorefa,
+    get_quantizer,
+    magnitude_aware_sign,
+    ste_heaviside,
+    ste_sign,
+    ste_tern,
+    swish_sign,
+)
+
+
+def grad_at(fn, x):
+    return jax.vmap(jax.grad(lambda v: fn(v).sum()))(x[:, None])[:, 0]
+
+
+def test_ste_sign_forward():
+    x = jnp.array([-2.0, -0.5, 0.0, 0.5, 2.0])
+    np.testing.assert_array_equal(ste_sign(x), [-1, -1, 1, 1, 1])
+
+
+def test_ste_sign_gradient_clipped_identity():
+    x = jnp.array([-2.0, -0.99, 0.0, 0.99, 2.0])
+    g = jax.grad(lambda v: ste_sign(v).sum())(x)
+    np.testing.assert_array_equal(g, [0.0, 1.0, 1.0, 1.0, 0.0])
+
+
+def test_approx_sign_gradient_triangular():
+    x = jnp.array([-2.0, -0.5, 0.0, 0.5, 2.0])
+    g = jax.grad(lambda v: approx_sign(v).sum())(x)
+    np.testing.assert_allclose(g, [0.0, 1.0, 2.0, 1.0, 0.0])
+
+
+def test_swish_sign_gradient_peak_at_zero():
+    x = jnp.array([-3.0, 0.0, 3.0])
+    g = jax.grad(lambda v: swish_sign(v).sum())(x)
+    assert g[1] > g[0] and g[1] > g[2]
+    # d/dx SignSwish at 0 is exactly beta (default 5).
+    assert float(g[1]) == pytest.approx(5.0, rel=1e-3)
+
+
+def test_magnitude_aware_sign_scale():
+    w = jnp.array([[0.5, -1.0], [0.25, 2.0]])  # per-output-channel scale
+    out = magnitude_aware_sign(w)
+    # scale over all but last axis: col0 mean(|.5|,|.25|)=0.375, col1 1.5
+    np.testing.assert_allclose(out, [[0.375, -1.5], [0.375, 1.5]])
+    g = jax.grad(lambda v: magnitude_aware_sign(v).sum())(w)
+    np.testing.assert_allclose(g, [[0.375, 1.5], [0.375, 0.0]])
+
+
+def test_ste_tern_thresholds():
+    x = jnp.array([-1.0, -0.01, 0.0, 0.01, 1.0])
+    np.testing.assert_array_equal(
+        ste_tern(x, 0.05, False), [-1.0, 0.0, 0.0, 0.0, 1.0]
+    )
+    # TWN mode: threshold = 0.7 * mean|x|.
+    x2 = jnp.array([1.0, 1.0, 0.5, -1.0])  # mean=0.875, thr=0.6125
+    np.testing.assert_array_equal(ste_tern(x2, 0.05, True), [1, 1, 0, -1])
+
+
+def test_ste_heaviside():
+    x = jnp.array([-0.5, 0.0, 0.5])
+    np.testing.assert_array_equal(ste_heaviside(x), [0.0, 0.0, 1.0])
+    g = jax.grad(lambda v: ste_heaviside(v).sum())(jnp.array([-2.0, 0.5, 2.0]))
+    np.testing.assert_array_equal(g, [0.0, 1.0, 0.0])
+
+
+def test_dorefa_levels():
+    x = jnp.array([-0.5, 0.0, 0.3, 0.5, 1.0, 2.0])
+    out = dorefa(x, 1)  # 1 bit: levels {0, 1}
+    np.testing.assert_array_equal(out, [0, 0, 0, 1, 1, 1])
+    out2 = dorefa(x, 2)  # 2 bits: levels {0, 1/3, 2/3, 1}
+    np.testing.assert_allclose(out2, [0, 0, 1 / 3, 2 / 3, 1, 1], atol=1e-6)
+    g = jax.grad(lambda v: dorefa(v, 2).sum())(x)
+    np.testing.assert_array_equal(g, [0, 1, 1, 1, 1, 0])
+
+
+def test_quantizers_preserve_dtype_bf16():
+    x = jnp.array([-0.5, 0.5], jnp.bfloat16)
+    for fn in (ste_sign, approx_sign, ste_heaviside):
+        assert fn(x).dtype == jnp.bfloat16
+
+
+def test_get_quantizer_resolution():
+    assert get_quantizer("ste_sign") is ste_sign
+    assert get_quantizer(None) is None
+    assert get_quantizer(ste_sign) is ste_sign
+    with pytest.raises(ValueError, match="Unknown quantizer"):
+        get_quantizer("nope")
+
+
+def test_ste_sign_shard_transparent():
+    # Gradient parity: single-device vs 8-way sharded input.
+    import optax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 devices")
+    x = jnp.asarray(np.random.default_rng(0).normal(size=(16, 64)), jnp.float32)
+    f = lambda v: ste_sign(v).sum()
+    g1 = jax.grad(f)(x)
+    mesh = Mesh(np.array(jax.devices()).reshape(8), ("data",))
+    xs = jax.device_put(x, NamedSharding(mesh, PartitionSpec("data")))
+    g2 = jax.jit(jax.grad(f))(xs)
+    np.testing.assert_array_equal(np.asarray(g1), np.asarray(g2))

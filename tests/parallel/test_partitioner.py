@@ -137,3 +137,19 @@ def test_mesh_validation_errors():
     configure(mp, {"mesh_shape": (3,), "mesh_axes": ("data",)}, name="mp")
     with pytest.raises(ValueError):
         mp.setup()
+
+
+def test_mesh_num_devices_subset():
+    mp = MeshPartitioner()
+    configure(
+        mp,
+        {"mesh_shape": (2, 2), "mesh_axes": ("data", "model"),
+         "num_devices": 4},
+        name="mp",
+    )
+    mp.setup()
+    assert mp.mesh.devices.size == 4
+    with pytest.raises(ValueError, match="have"):
+        mp2 = MeshPartitioner()
+        configure(mp2, {"num_devices": 99}, name="mp2")
+        mp2.setup()

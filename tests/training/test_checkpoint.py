@@ -1,0 +1,104 @@
+import numpy as np
+import pytest
+
+from zookeeper_tpu.core import configure
+from zookeeper_tpu.training import Checkpointer, TrainingExperiment
+
+
+def make_experiment(tmp_path, extra=None):
+    exp = TrainingExperiment()
+    conf = {
+        "loader.dataset": "SyntheticMnist",
+        "loader.dataset.num_train_examples": 128,
+        "loader.dataset.num_validation_examples": 32,
+        "loader.preprocessing": "ImageClassificationPreprocessing",
+        "loader.preprocessing.height": 28,
+        "loader.preprocessing.width": 28,
+        "loader.preprocessing.channels": 1,
+        "loader.host_index": 0,
+        "loader.host_count": 1,
+        "model": "Mlp",
+        "model.hidden_units": (16,),
+        "batch_size": 32,
+        "epochs": 2,
+        "verbose": False,
+        "checkpointer.directory": str(tmp_path / "ckpt"),
+        "checkpointer.synchronous": True,
+        **(extra or {}),
+    }
+    configure(exp, conf, name="experiment")
+    return exp
+
+
+def test_checkpointer_disabled_by_default():
+    ckpt = Checkpointer()
+    configure(ckpt, {}, name="ckpt")
+    assert not ckpt.enabled
+    assert ckpt.save(None) is False
+    assert ckpt.restore_state("anything") == "anything"
+
+
+def test_save_and_restore_roundtrip(tmp_path):
+    exp = make_experiment(tmp_path)
+    exp.run()
+    ckpt = exp.checkpointer
+    assert ckpt.latest_step() == 8  # 2 epochs * 4 steps.
+
+    # A fresh experiment with the same directory resumes: epochs already
+    # done, so run() trains zero additional epochs and state matches.
+    exp2 = make_experiment(tmp_path)
+    history2 = exp2.run()
+    assert history2["train"] == []
+    import jax
+
+    assert int(jax.device_get(exp2.final_state.step)) == 8
+    for a, b in zip(
+        jax.tree.leaves(exp.final_state.params),
+        jax.tree.leaves(exp2.final_state.params),
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+    exp.checkpointer.close()
+    exp2.checkpointer.close()
+
+
+def test_resume_continues_training(tmp_path):
+    # Train 1 epoch, then "crash"; resume with epochs=3 trains 2 more.
+    exp = make_experiment(tmp_path, {"epochs": 1})
+    exp.run()
+    assert exp.checkpointer.latest_step() == 4
+    exp.checkpointer.close()
+
+    exp2 = make_experiment(tmp_path, {"epochs": 3})
+    history = exp2.run()
+    assert len(history["train"]) == 2  # Epochs 1 and 2 only.
+    import jax
+
+    assert int(jax.device_get(exp2.final_state.step)) == 12
+    exp2.checkpointer.close()
+
+
+def test_restore_disabled_starts_fresh(tmp_path):
+    exp = make_experiment(tmp_path, {"epochs": 1})
+    exp.run()
+    exp.checkpointer.close()
+    exp2 = make_experiment(
+        tmp_path, {"epochs": 1, "checkpointer.restore": False}
+    )
+    history = exp2.run()
+    assert len(history["train"]) == 1  # Trained from scratch.
+    exp2.checkpointer.close()
+
+
+def test_metrics_file_written(tmp_path):
+    import json
+
+    path = tmp_path / "metrics.jsonl"
+    exp = make_experiment(
+        tmp_path,
+        {"metrics_file": str(path), "checkpointer.directory": None},
+    )
+    exp.run()
+    lines = [json.loads(l) for l in path.read_text().splitlines()]
+    assert len(lines) == 2
+    assert {"epoch", "loss", "accuracy", "examples_per_sec"} <= set(lines[0])
+    assert "val_accuracy" in lines[0]

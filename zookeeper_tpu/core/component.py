@@ -188,6 +188,18 @@ def _collect_fields(cls: type) -> Dict[str, Field]:
 
 
 def _component_init_subclass(cls: type, **kwargs: Any) -> None:
+    # Cooperative chaining: invoke the next __init_subclass__ in the MRO
+    # that is not this hook (mixins doing their own subclass registration,
+    # and ultimately object's, which rejects stray class kwargs).
+    for base in cls.__mro__[1:]:
+        hook = base.__dict__.get("__init_subclass__")
+        if hook is None:
+            continue
+        func = getattr(hook, "__func__", hook)
+        if func is _component_init_subclass:
+            continue
+        func(cls, **kwargs)
+        break
     cls.__component_fields__ = _collect_fields(cls)
 
 

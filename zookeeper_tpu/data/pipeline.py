@@ -134,13 +134,29 @@ def prefetch_to_device(
                 continue
         return False
 
+    # Fixed for the generator's lifetime; computed once, not per batch.
+    mesh = getattr(sharding, "mesh", None)
+    multi_process = mesh is not None and any(
+        d.process_index != jax.process_index() for d in mesh.devices.flat
+    )
+
+    def stage(batch):
+        if sharding is None:
+            return jax.device_put(batch)
+        if multi_process:
+            # Each host holds only its slice of the global batch
+            # (batch_iterator contract); assemble the distributed global
+            # array from per-process shards.
+            return jax.tree.map(
+                lambda x: jax.make_array_from_process_local_data(sharding, x),
+                batch,
+            )
+        return jax.device_put(batch, sharding)
+
     def producer():
         try:
             for batch in iterator:
-                if sharding is not None:
-                    batch = jax.device_put(batch, sharding)
-                else:
-                    batch = jax.device_put(batch)
+                batch = stage(batch)
                 if not put_or_stop(batch):
                     return  # Consumer gone: drop refs, free device buffers.
         except BaseException as e:  # propagate into consumer

@@ -10,17 +10,27 @@ subclasses for subclass-by-name configuration (``model=QuickNet``).
 
 from zookeeper_tpu.models.base import Model
 from zookeeper_tpu.models.simple import Mlp, SimpleCnn
+from zookeeper_tpu.models.binary import (
+    BinaryAlexNet,
+    BinaryNet,
+    BiRealNet,
+    QuickNet,
+    QuickNetLarge,
+    QuickNetSmall,
+)
+from zookeeper_tpu.models.resnet import ResNet50, ResNet101, ResNet152
 
-__all__ = ["Model", "Mlp", "SimpleCnn"]
-
-
-def _register_zoo() -> None:
-    """Import zoo submodules for their registration side effects (subclass
-    trees must be populated before subclass-by-name lookup)."""
-    from zookeeper_tpu.models import binary, resnet  # noqa: F401
-
-
-try:  # Zoo families require the quant ops; keep base importable regardless.
-    _register_zoo()
-except ImportError:  # pragma: no cover
-    pass
+__all__ = [
+    "BinaryAlexNet",
+    "BinaryNet",
+    "BiRealNet",
+    "Mlp",
+    "Model",
+    "QuickNet",
+    "QuickNetLarge",
+    "QuickNetSmall",
+    "ResNet50",
+    "ResNet101",
+    "ResNet152",
+    "SimpleCnn",
+]

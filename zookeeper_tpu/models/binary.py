@@ -1,0 +1,294 @@
+"""Binarized model zoo (larq-zoo-equivalent families).
+
+TPU-native reconstructions of the workload ecosystem's binary
+architectures (SURVEY.md §2.4/§6: BinaryNet, BinaryAlexNet, Bi-Real-Net,
+QuickNet). Built from first principles against the published papers —
+NOT ports of larq_zoo code; block counts/widths follow the papers and the
+BASELINE.md accuracy table, with deviations noted per class.
+
+Common recipe: latent fp32 weights, ``ste_sign``-family quantizers with
+weight clipping, BatchNorm after every binary conv (binary nets are
+BN-hungry), first/last layers full-precision (standard practice — they
+carry too much information to binarize).
+"""
+
+from typing import Any, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from zookeeper_tpu.core import Field, component
+from zookeeper_tpu.models.base import Model
+from zookeeper_tpu.ops.layers import QuantConv, QuantDense
+
+
+def _bn(training: bool, dtype=jnp.float32):
+    return nn.BatchNorm(
+        use_running_average=not training, momentum=0.9, epsilon=1e-5,
+        dtype=dtype,
+    )
+
+
+class _BinaryNetModule(nn.Module):
+    """VGG-style BinaryNet (Courbariaux et al. 2016): the reference
+    example's CIFAR/MNIST capability (SURVEY.md §2.3)."""
+
+    features: Tuple[int, ...]
+    dense_units: Tuple[int, ...]
+    num_classes: int
+    dtype: Any
+
+    @nn.compact
+    def __call__(self, x, training: bool = False):
+        x = x.astype(self.dtype)
+        for i, f in enumerate(self.features):
+            quant_in = None if i == 0 else "ste_sign"  # First conv fp input.
+            x = QuantConv(
+                f, (3, 3), input_quantizer=quant_in,
+                kernel_quantizer="ste_sign", dtype=self.dtype,
+            )(x)
+            if i % 2 == 1:
+                x = nn.max_pool(x, (2, 2), strides=(2, 2))
+            x = _bn(training)(x)
+        x = x.reshape((x.shape[0], -1))
+        for u in self.dense_units:
+            x = QuantDense(
+                u, input_quantizer="ste_sign", kernel_quantizer="ste_sign",
+                use_bias=False, dtype=self.dtype,
+            )(x)
+            x = _bn(training)(x)
+        x = nn.Dense(self.num_classes, dtype=self.dtype)(x)
+        return x.astype(jnp.float32)
+
+
+@component
+class BinaryNet(Model):
+    """BinaryNet VGG for CIFAR-scale inputs."""
+
+    features: Sequence[int] = Field((128, 128, 256, 256, 512, 512))
+    dense_units: Sequence[int] = Field((1024, 1024))
+
+    def build(self, input_shape, num_classes: int) -> nn.Module:
+        return _BinaryNetModule(
+            features=tuple(self.features),
+            dense_units=tuple(self.dense_units),
+            num_classes=num_classes,
+            dtype=self.dtype(),
+        )
+
+
+class _BinaryAlexNetModule(nn.Module):
+    """Binary AlexNet (larq-zoo capability row; ~36.3% top-1 target)."""
+
+    num_classes: int
+    dtype: Any
+    inflation: int = 1
+
+    @nn.compact
+    def __call__(self, x, training: bool = False):
+        d = self.dtype
+        f = self.inflation
+        # Conv1: full precision (standard for binary nets).
+        x = nn.Conv(64 * f, (11, 11), strides=(4, 4), padding="SAME",
+                    use_bias=False, dtype=d)(x.astype(d))
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        x = _bn(training)(x)
+        for feat, k in ((192 * f, 5), (384 * f, 3), (384 * f, 3), (256 * f, 3)):
+            x = QuantConv(
+                feat, (k, k), input_quantizer="ste_sign",
+                kernel_quantizer="ste_sign", dtype=d,
+            )(x)
+            if feat in (192 * f, 256 * f):
+                x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+            x = _bn(training)(x)
+        x = x.reshape((x.shape[0], -1))
+        for u in (4096, 4096):
+            x = QuantDense(
+                u, input_quantizer="ste_sign", kernel_quantizer="ste_sign",
+                use_bias=False, dtype=d,
+            )(x)
+            x = _bn(training)(x)
+        x = nn.Dense(self.num_classes, dtype=d)(x)
+        return x.astype(jnp.float32)
+
+
+@component
+class BinaryAlexNet(Model):
+    """Binarized AlexNet for ImageNet (BASELINE config #2)."""
+
+    inflation: int = Field(1)
+
+    def build(self, input_shape, num_classes: int) -> nn.Module:
+        return _BinaryAlexNetModule(
+            num_classes=num_classes, dtype=self.dtype(),
+            inflation=self.inflation,
+        )
+
+
+class _BiRealBlock(nn.Module):
+    """One Bi-Real-Net block: sign -> binary 3x3 conv -> BN -> + identity.
+
+    The real-valued shortcut after EVERY binary conv is the signature of
+    Bi-Real-Net (Liu et al. 2018); activations use approx_sign, weights
+    magnitude_aware_sign.
+    """
+
+    features: int
+    strides: int
+    dtype: Any
+
+    @nn.compact
+    def __call__(self, x, training: bool = False):
+        shortcut = x
+        if self.strides > 1 or x.shape[-1] != self.features:
+            # Real-valued downsample shortcut: avgpool + fp 1x1 conv + BN.
+            shortcut = nn.avg_pool(
+                x, (2, 2), strides=(self.strides, self.strides), padding="SAME"
+            )
+            shortcut = nn.Conv(
+                self.features, (1, 1), use_bias=False, dtype=self.dtype
+            )(shortcut)
+            shortcut = _bn(training)(shortcut)
+        y = QuantConv(
+            self.features, (3, 3), strides=(self.strides, self.strides),
+            input_quantizer="approx_sign",
+            kernel_quantizer="magnitude_aware_sign", dtype=self.dtype,
+        )(x)
+        y = _bn(training)(y)
+        return y + shortcut
+
+
+class _BiRealNetModule(nn.Module):
+    """Bi-Real-Net-18: 7x7 fp stem, 4 sections of binary blocks."""
+
+    blocks_per_section: Tuple[int, ...]
+    section_features: Tuple[int, ...]
+    num_classes: int
+    dtype: Any
+
+    @nn.compact
+    def __call__(self, x, training: bool = False):
+        d = self.dtype
+        x = nn.Conv(64, (7, 7), strides=(2, 2), padding="SAME",
+                    use_bias=False, dtype=d)(x.astype(d))
+        x = _bn(training)(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for s, (n, feat) in enumerate(
+            zip(self.blocks_per_section, self.section_features)
+        ):
+            for b in range(n):
+                strides = 2 if (b == 0 and s > 0) else 1
+                x = _BiRealBlock(feat, strides, d)(x, training)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=d)(x)
+        return x.astype(jnp.float32)
+
+
+@component
+class BiRealNet(Model):
+    """Bi-Real-Net-18 (BASELINE config #3; ~56-57.5% top-1 target)."""
+
+    blocks_per_section: Sequence[int] = Field((4, 4, 4, 4))
+    section_features: Sequence[int] = Field((64, 128, 256, 512))
+
+    def build(self, input_shape, num_classes: int) -> nn.Module:
+        return _BiRealNetModule(
+            blocks_per_section=tuple(self.blocks_per_section),
+            section_features=tuple(self.section_features),
+            num_classes=num_classes,
+            dtype=self.dtype(),
+        )
+
+
+def _blur_pool(x: jax.Array, dtype) -> jax.Array:
+    """Anti-aliased stride-2 downsampling (Zhang 2019), used by QuickNet
+    transitions: fixed 3x3 binomial filter, depthwise, stride 2."""
+    c = x.shape[-1]
+    f = jnp.array([1.0, 2.0, 1.0], dtype)
+    k2d = jnp.outer(f, f)
+    k2d = k2d / k2d.sum()
+    kernel = jnp.tile(k2d[:, :, None, None], (1, 1, 1, c))  # HWIO, I=1 (dw)
+    return jax.lax.conv_general_dilated(
+        x, kernel, window_strides=(2, 2), padding="SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        feature_group_count=c,
+    )
+
+
+class _QuickNetModule(nn.Module):
+    """QuickNet family (Bannink et al. 2021, "Larq Compute Engine" /
+    larq-zoo sota): fp stem, sections of residual binary 3x3 convs, fp
+    pointwise transition with blurpool downsampling.
+
+    Reconstruction from the paper's description; exact stem/transition
+    minutiae may deviate from larq_zoo (documented deviation, SURVEY.md §6
+    accuracies are approximate targets).
+    """
+
+    blocks_per_section: Tuple[int, ...]
+    section_features: Tuple[int, ...]
+    num_classes: int
+    dtype: Any
+
+    @nn.compact
+    def __call__(self, x, training: bool = False):
+        d = self.dtype
+        # Stem: fp 3x3/2 to 8ch, then grouped 3x3/2 to first section width.
+        x = nn.Conv(8, (3, 3), strides=(2, 2), padding="SAME",
+                    use_bias=False, dtype=d)(x.astype(d))
+        x = _bn(training)(x)
+        x = nn.relu(x)
+        x = nn.Conv(
+            self.section_features[0], (3, 3), strides=(2, 2), padding="SAME",
+            use_bias=False, feature_group_count=4, dtype=d,
+        )(x)
+        x = _bn(training)(x)
+        for s, (n, feat) in enumerate(
+            zip(self.blocks_per_section, self.section_features)
+        ):
+            if s > 0:
+                # Transition: blurpool downsample + fp 1x1 conv to widen.
+                x = nn.relu(x)
+                x = _blur_pool(x, d)
+                x = nn.Conv(feat, (1, 1), use_bias=False, dtype=d)(x)
+                x = _bn(training)(x)
+            for _ in range(n):
+                y = QuantConv(
+                    feat, (3, 3), input_quantizer="ste_sign",
+                    kernel_quantizer="ste_sign", dtype=d,
+                )(x)
+                y = _bn(training)(y)
+                x = x + y  # Residual around every binary conv.
+        x = nn.relu(x)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=d)(x)
+        return x.astype(jnp.float32)
+
+
+@component
+class QuickNet(Model):
+    """QuickNet (~63.3% top-1 target; BASELINE configs #4)."""
+
+    blocks_per_section: Sequence[int] = Field((2, 3, 4, 4))
+    section_features: Sequence[int] = Field((64, 128, 256, 512))
+
+    def build(self, input_shape, num_classes: int) -> nn.Module:
+        return _QuickNetModule(
+            blocks_per_section=tuple(self.blocks_per_section),
+            section_features=tuple(self.section_features),
+            num_classes=num_classes,
+            dtype=self.dtype(),
+        )
+
+
+@component
+class QuickNetSmall(QuickNet):
+    section_features: Sequence[int] = Field((32, 64, 256, 512))
+
+
+@component
+class QuickNetLarge(QuickNet):
+    """QuickNet-Large (~66.9% top-1 target; the north-star workload)."""
+
+    blocks_per_section: Sequence[int] = Field((6, 8, 12, 6))

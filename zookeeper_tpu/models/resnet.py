@@ -1,0 +1,96 @@
+"""Full-precision ResNet family (BASELINE config #5: the non-binary path).
+
+Standard pre-activation-free ResNet-v1.5 bottleneck architecture (He et
+al. 2015, with the stride-on-3x3 variant) written directly in flax —
+public-domain architecture, no code ported.
+"""
+
+from typing import Any, Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from zookeeper_tpu.core import Field, component
+from zookeeper_tpu.models.base import Model
+
+
+class _Bottleneck(nn.Module):
+    features: int  # Bottleneck width; output is 4x.
+    strides: int
+    dtype: Any
+
+    @nn.compact
+    def __call__(self, x, training: bool = False):
+        d = self.dtype
+        bn = lambda: nn.BatchNorm(  # noqa: E731
+            use_running_average=not training, momentum=0.9, epsilon=1e-5,
+            dtype=d,
+        )
+        out_features = self.features * 4
+        shortcut = x
+        if x.shape[-1] != out_features or self.strides > 1:
+            shortcut = nn.Conv(
+                out_features, (1, 1), strides=(self.strides, self.strides),
+                use_bias=False, dtype=d,
+            )(x)
+            shortcut = bn()(shortcut)
+        y = nn.Conv(self.features, (1, 1), use_bias=False, dtype=d)(x)
+        y = nn.relu(bn()(y))
+        y = nn.Conv(
+            self.features, (3, 3), strides=(self.strides, self.strides),
+            padding="SAME", use_bias=False, dtype=d,
+        )(y)
+        y = nn.relu(bn()(y))
+        y = nn.Conv(out_features, (1, 1), use_bias=False, dtype=d)(y)
+        y = bn()(y)
+        return nn.relu(y + shortcut)
+
+
+class _ResNetModule(nn.Module):
+    blocks_per_section: Tuple[int, ...]
+    num_classes: int
+    dtype: Any
+    width: int = 64
+
+    @nn.compact
+    def __call__(self, x, training: bool = False):
+        d = self.dtype
+        x = nn.Conv(self.width, (7, 7), strides=(2, 2), padding="SAME",
+                    use_bias=False, dtype=d)(x.astype(d))
+        x = nn.BatchNorm(use_running_average=not training, momentum=0.9,
+                         epsilon=1e-5, dtype=d)(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for s, n in enumerate(self.blocks_per_section):
+            for b in range(n):
+                strides = 2 if (b == 0 and s > 0) else 1
+                x = _Bottleneck(self.width * (2**s), strides, d)(x, training)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=d)(x)
+        return x.astype(jnp.float32)
+
+
+@component
+class ResNet50(Model):
+    """ResNet-50 (~76% top-1 target, BASELINE.md)."""
+
+    blocks_per_section: Sequence[int] = Field((3, 4, 6, 3))
+    width: int = Field(64)
+
+    def build(self, input_shape, num_classes: int) -> nn.Module:
+        return _ResNetModule(
+            blocks_per_section=tuple(self.blocks_per_section),
+            num_classes=num_classes,
+            dtype=self.dtype(),
+            width=self.width,
+        )
+
+
+@component
+class ResNet101(ResNet50):
+    blocks_per_section: Sequence[int] = Field((3, 4, 23, 3))
+
+
+@component
+class ResNet152(ResNet50):
+    blocks_per_section: Sequence[int] = Field((3, 8, 36, 3))

@@ -1,0 +1,51 @@
+"""Quantization ops and layers.
+
+The TPU-native rebuild of the workload ecosystem's binarization surface
+(SURVEY.md §2.4: larq quantizers `SteSign`/`ste_heaviside` as TF custom
+gradients, `QuantConv2D`/`QuantDense` Keras layers, and
+larq-compute-engine's native kernels): straight-through-estimator
+quantizers as ``jax.custom_vjp`` functions, quantized flax linen layers
+with latent fp32 weights, and (``zookeeper_tpu.ops.pallas``) bit-packed
+XNOR-popcount kernels for the inference hot path.
+"""
+
+from zookeeper_tpu.ops.quantizers import (
+    QUANTIZERS,
+    approx_sign,
+    dorefa,
+    get_quantizer,
+    magnitude_aware_sign,
+    ste_heaviside,
+    ste_sign,
+    ste_tern,
+    swish_sign,
+)
+from zookeeper_tpu.ops.layers import QuantConv, QuantDense
+from zookeeper_tpu.ops.binary_compute import (
+    int8_conv,
+    int8_matmul,
+    pack_bits,
+    unpack_bits,
+    xnor_matmul,
+    xnor_matmul_packed,
+)
+
+__all__ = [
+    "int8_conv",
+    "int8_matmul",
+    "pack_bits",
+    "unpack_bits",
+    "xnor_matmul",
+    "xnor_matmul_packed",
+    "QUANTIZERS",
+    "QuantConv",
+    "QuantDense",
+    "approx_sign",
+    "dorefa",
+    "get_quantizer",
+    "magnitude_aware_sign",
+    "ste_heaviside",
+    "ste_sign",
+    "ste_tern",
+    "swish_sign",
+]

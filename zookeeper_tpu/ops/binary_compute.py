@@ -1,0 +1,224 @@
+"""Binary compute paths: bit-packing, XNOR-popcount Pallas GEMM, int8 MXU.
+
+The TPU-native answer to larq-compute-engine's native binary kernels
+(SURVEY.md §2.4). Three executable paths for a binary (+-1 x +-1) matmul,
+chosen by what the hardware rewards:
+
+1. **float/bf16 MXU** (default): XLA's conv/matmul on +-1.0 values — on
+   TPU the MXU is so much faster than the VPU that this is already the
+   best *training* path.
+2. **int8 MXU** (``int8_matmul``/``int8_conv``): +-1 as int8 with int32
+   accumulation — MXU int8 peak is 2x bf16, same accuracy (values exactly
+   representable), the TPU-idiomatic "binary" fast path.
+3. **XNOR-popcount Pallas kernel** (``xnor_matmul``): 32 binary values per
+   int32 lane, popcount on the VPU —
+   ``out = K - 2*popcount(a XOR b)``. This is the faithful LCE-style
+   bit-serial kernel: 32x weight compression and HBM-bandwidth-bound
+   workloads win; raw FLOP-bound workloads still prefer the MXU paths.
+   (See BASELINE.md notes: the kernel must *beat* the fallback to be
+   switched on by default, per SURVEY.md §7 "hard parts".)
+"""
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Array = jax.Array
+
+
+# -- bit packing ------------------------------------------------------------
+
+
+def pack_bits(x: Array, axis: int = -1) -> Array:
+    """Pack the sign bits of ``x`` along ``axis`` into int32 words.
+
+    bit=1 encodes x>=0 (+1), bit=0 encodes x<0 (-1); 32 values per lane,
+    little-endian within the word. The packed axis length must be a
+    multiple of 32 (pad with +1s beforehand; see ``xnor_matmul`` for why
+    symmetric padding cancels).
+    """
+    x = jnp.moveaxis(x, axis, -1)
+    k = x.shape[-1]
+    if k % 32 != 0:
+        raise ValueError(f"Packed axis must be a multiple of 32, got {k}.")
+    bits = (x >= 0).astype(jnp.uint32)
+    bits = bits.reshape(*x.shape[:-1], k // 32, 32)
+    shifts = jnp.arange(32, dtype=jnp.uint32)
+    words = jnp.sum(bits << shifts, axis=-1, dtype=jnp.uint32)
+    return jnp.moveaxis(words.astype(jnp.int32), -1, axis)
+
+
+def unpack_bits(packed: Array, k: int, axis: int = -1) -> Array:
+    """Inverse of :func:`pack_bits`: int32 words -> +-1.0 float32."""
+    words = jnp.moveaxis(packed, axis, -1).astype(jnp.uint32)
+    shifts = jnp.arange(32, dtype=jnp.uint32)
+    bits = (words[..., None] >> shifts) & jnp.uint32(1)
+    values = bits.astype(jnp.float32) * 2.0 - 1.0
+    values = values.reshape(*words.shape[:-1], words.shape[-1] * 32)[..., :k]
+    return jnp.moveaxis(values, -1, axis)
+
+
+# -- XNOR-popcount Pallas GEMM ---------------------------------------------
+
+
+def _popcount32(v: Array) -> Array:
+    """Parallel bit-count of int32 lanes (VPU integer ops only)."""
+    v = v.astype(jnp.uint32)
+    v = v - ((v >> 1) & jnp.uint32(0x55555555))
+    v = (v & jnp.uint32(0x33333333)) + ((v >> 2) & jnp.uint32(0x33333333))
+    v = (v + (v >> 4)) & jnp.uint32(0x0F0F0F0F)
+    return ((v * jnp.uint32(0x01010101)) >> 24).astype(jnp.int32)
+
+
+def _xnor_kernel(a_ref, b_ref, out_ref, *, k_true: int):
+    # a: [TM, Kp] int32, b: [TN, Kp] int32 (both packed along K).
+    a = a_ref[:]
+    b = b_ref[:]
+    x = jnp.bitwise_xor(a[:, None, :], b[None, :, :])  # [TM, TN, Kp]
+    mismatches = jnp.sum(_popcount32(x), axis=-1)  # [TM, TN]
+    out_ref[:] = (k_true - 2 * mismatches).astype(jnp.int32)
+
+
+def _round_up(x: int, m: int) -> int:
+    return (x + m - 1) // m * m
+
+
+@partial(jax.jit, static_argnames=("k_true", "block_m", "block_n", "interpret"))
+def xnor_matmul_packed(
+    a_packed: Array,
+    b_packed: Array,
+    *,
+    k_true: int,
+    block_m: int = 128,
+    block_n: int = 128,
+    interpret: bool = False,
+) -> Array:
+    """Binary GEMM on pre-packed operands.
+
+    ``a_packed``: [M, K/32] int32; ``b_packed``: [N, K/32] int32 (i.e. B
+    transposed then packed along K). Returns [M, N] int32 equal to
+    ``sign(A) @ sign(B^T)^T`` counted over ``k_true`` terms. K-padding is
+    harmless when both operands pad with the SAME bit value: XOR of equal
+    bits is 0 and contributes no mismatches.
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    m, kp = a_packed.shape
+    n, kp2 = b_packed.shape
+    if kp != kp2:
+        raise ValueError(f"Packed K mismatch: {kp} vs {kp2}.")
+    mp = _round_up(m, block_m)
+    np_ = _round_up(n, block_n)
+    # Pad rows with zero-words: their outputs are sliced away below.
+    a_pad = jnp.pad(a_packed, ((0, mp - m), (0, 0)))
+    b_pad = jnp.pad(b_packed, ((0, np_ - n), (0, 0)))
+
+    out = pl.pallas_call(
+        partial(_xnor_kernel, k_true=k_true),
+        out_shape=jax.ShapeDtypeStruct((mp, np_), jnp.int32),
+        grid=(mp // block_m, np_ // block_n),
+        in_specs=[
+            pl.BlockSpec(
+                (block_m, kp), lambda i, j: (i, 0), memory_space=pltpu.VMEM
+            ),
+            pl.BlockSpec(
+                (block_n, kp), lambda i, j: (j, 0), memory_space=pltpu.VMEM
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (block_m, block_n), lambda i, j: (i, j), memory_space=pltpu.VMEM
+        ),
+        interpret=interpret,
+    )(a_pad, b_pad)
+    return out[:m, :n]
+
+
+def xnor_matmul(
+    a: Array, b: Array, *, interpret: bool = False, block_m: int = 128,
+    block_n: int = 128,
+) -> Array:
+    """Binary GEMM of float +-1 operands via bit-packing: [M,K] @ [K,N].
+
+    Packs, runs the Pallas kernel, returns float32 (exact integers).
+    """
+    m, k = a.shape
+    k2, n = b.shape
+    if k != k2:
+        raise ValueError(f"Inner dims mismatch: {k} vs {k2}.")
+    k_pad = _round_up(k, 32)
+    if k_pad != k:
+        # Symmetric +1 padding cancels in K - 2*popcount(xor).
+        a = jnp.pad(a, ((0, 0), (0, k_pad - k)), constant_values=1.0)
+        b = jnp.pad(b, ((0, k_pad - k), (0, 0)), constant_values=1.0)
+    ap = pack_bits(a, axis=-1)
+    bp = pack_bits(b.T, axis=-1)
+    # k_true stays the ORIGINAL K: the symmetric +1 padding produces
+    # matching bits, i.e. zero mismatches, so K - 2*mismatches is exact.
+    out = xnor_matmul_packed(
+        ap, bp, k_true=k, block_m=block_m, block_n=block_n,
+        interpret=interpret,
+    )
+    return out.astype(jnp.float32)
+
+
+# -- int8 MXU path ----------------------------------------------------------
+
+
+def int8_matmul(a_sign: Array, b_sign: Array) -> Array:
+    """Binary GEMM on the MXU: +-1 as int8, int32 accumulation (2x bf16
+    MXU peak; exact)."""
+    a8 = jnp.sign(a_sign).astype(jnp.int8)
+    b8 = jnp.sign(b_sign).astype(jnp.int8)
+    return jax.lax.dot_general(
+        a8,
+        b8,
+        (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    ).astype(jnp.float32)
+
+
+def _int8_conv_forward(x_sign, k_sign, strides, padding):
+    x8 = jnp.sign(x_sign).astype(jnp.int8)
+    k8 = jnp.sign(k_sign).astype(jnp.int8)
+    out = jax.lax.conv_general_dilated(
+        x8, k8, window_strides=tuple(strides), padding=padding,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        preferred_element_type=jnp.int32,
+    )
+    return out.astype(jnp.float32)
+
+
+def _float_conv(x, k, strides, padding):
+    return jax.lax.conv_general_dilated(
+        x, k, window_strides=tuple(strides), padding=padding,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def int8_conv(x_sign: Array, k_sign: Array, strides: Tuple[int, int],
+              padding: str) -> Array:
+    """NHWC conv of +-1 operands on the int8 MXU path: exact vs the float
+    conv (values representable), with the float conv's gradients (the op
+    *is* that function on its domain)."""
+    return _int8_conv_forward(x_sign, k_sign, strides, padding)
+
+
+def _int8_conv_fwd(x_sign, k_sign, strides, padding):
+    return _int8_conv_forward(x_sign, k_sign, strides, padding), (
+        x_sign, k_sign,
+    )
+
+
+def _int8_conv_bwd(strides, padding, res, g):
+    x_sign, k_sign = res
+    _, vjp = jax.vjp(lambda x, k: _float_conv(x, k, strides, padding),
+                     x_sign, k_sign)
+    return vjp(g)
+
+
+int8_conv.defvjp(_int8_conv_fwd, _int8_conv_bwd)

@@ -1,0 +1,128 @@
+"""Quantized flax layers with latent full-precision weights.
+
+TPU-native `QuantDense` / `QuantConv` (the larq `QuantDense`/`QuantConv2D`
+capability, SURVEY.md §2.4): the *latent* kernel lives in fp32 and is
+quantized on the forward pass; gradients flow to the latent weights through
+the quantizer's STE. ``kernel_clip`` emulates larq's ``weight_clip``
+constraint by clamping latent weights into [-1, 1] inside the forward
+(projection happens on read, so the optimizer state stays untouched and
+the op fuses into the conv under XLA).
+
+The binary inference fast path (bit-packed XNOR-popcount via Pallas) swaps
+in behind the same module interface; training keeps the float path where
+XLA's MXU convs on +-1.0 values are already optimal.
+"""
+
+from typing import Any, Callable, Optional, Sequence, Tuple, Union
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from zookeeper_tpu.ops.quantizers import get_quantizer
+
+Quantizer = Union[str, Callable, None]
+
+
+def _apply_clip(kernel: jax.Array, clip: bool) -> jax.Array:
+    if not clip:
+        return kernel
+    # Straight-through projection: forward sees clipped weights, gradients
+    # pass through unclipped (larq weight_clip semantics: the constraint
+    # projects after each update; reading-time clamp + STE is equivalent at
+    # the fixed point and jit-friendly).
+    clipped = jnp.clip(kernel, -1.0, 1.0)
+    return kernel + jax.lax.stop_gradient(clipped - kernel)
+
+
+class QuantDense(nn.Module):
+    """Dense layer with optional input/kernel quantization."""
+
+    features: int
+    input_quantizer: Quantizer = None
+    kernel_quantizer: Quantizer = None
+    kernel_clip: bool = True
+    use_bias: bool = True
+    dtype: Any = jnp.float32
+    kernel_init: Callable = nn.initializers.glorot_normal()
+    bias_init: Callable = nn.initializers.zeros_init()
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        in_q = get_quantizer(self.input_quantizer)
+        k_q = get_quantizer(self.kernel_quantizer)
+        kernel = self.param(
+            "kernel", self.kernel_init, (x.shape[-1], self.features), jnp.float32
+        )
+        if in_q is not None:
+            x = in_q(x)
+        kernel = _apply_clip(kernel, self.kernel_clip)
+        if k_q is not None:
+            kernel = k_q(kernel)
+        y = jnp.dot(x.astype(self.dtype), kernel.astype(self.dtype))
+        if self.use_bias:
+            bias = self.param("bias", self.bias_init, (self.features,), jnp.float32)
+            y = y + bias.astype(self.dtype)
+        return y
+
+
+class QuantConv(nn.Module):
+    """2-D convolution with optional input/kernel quantization (NHWC).
+
+    ``binary_compute`` selects the executable path when BOTH operands are
+    binarized: "mxu" (default — XLA conv on +-1 values in ``dtype``) or
+    "int8" (int8 operands, int32 MXU accumulation — 2x bf16 MXU peak,
+    bit-exact, STE gradients preserved via custom_vjp).
+    """
+
+    features: int
+    kernel_size: Tuple[int, int] = (3, 3)
+    strides: Tuple[int, int] = (1, 1)
+    padding: Union[str, Sequence[Tuple[int, int]]] = "SAME"
+    input_quantizer: Quantizer = None
+    kernel_quantizer: Quantizer = None
+    kernel_clip: bool = True
+    use_bias: bool = False
+    dtype: Any = jnp.float32
+    binary_compute: str = "mxu"
+    kernel_init: Callable = nn.initializers.glorot_normal()
+    bias_init: Callable = nn.initializers.zeros_init()
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        in_q = get_quantizer(self.input_quantizer)
+        k_q = get_quantizer(self.kernel_quantizer)
+        kh, kw = self.kernel_size
+        kernel = self.param(
+            "kernel",
+            self.kernel_init,
+            (kh, kw, x.shape[-1], self.features),
+            jnp.float32,
+        )
+        if in_q is not None:
+            x = in_q(x)
+        kernel = _apply_clip(kernel, self.kernel_clip)
+        if k_q is not None:
+            kernel = k_q(kernel)
+        if (
+            self.binary_compute == "int8"
+            and in_q is not None
+            and k_q is not None
+            and isinstance(self.padding, str)
+        ):
+            from zookeeper_tpu.ops.binary_compute import int8_conv
+
+            y = int8_conv(x, kernel, tuple(self.strides), self.padding)
+            y = y.astype(self.dtype)
+        else:
+            y = jax.lax.conv_general_dilated(
+                x.astype(self.dtype),
+                kernel.astype(self.dtype),
+                window_strides=self.strides,
+                padding=self.padding,
+                dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            )
+        if self.use_bias:
+            bias = self.param("bias", self.bias_init, (self.features,), jnp.float32)
+            y = y + bias.astype(self.dtype)
+        return y

@@ -1,0 +1,230 @@
+"""Straight-through-estimator quantizers (``jax.custom_vjp``).
+
+TPU-native equivalents of the larq quantizer family (SURVEY.md §2.4 — the
+reference workload's `SteSign`, `ste_heaviside`, etc., implemented there as
+TF custom gradients). Forward passes produce exactly representable values
+(+-1, {0,1}, ternary, fixed-point); backward passes substitute a surrogate
+gradient, clipped to the active region, per the published STE recipes:
+
+- ``ste_sign``: sign forward, identity-within-[-1,1] backward
+  (Courbariaux et al., BinaryNet).
+- ``approx_sign``: sign forward, piecewise (2 - 2|x|) backward
+  (Liu et al., Bi-Real-Net).
+- ``swish_sign``: sign forward, scaled swish-derivative backward
+  (Darabi et al., BNN+).
+- ``magnitude_aware_sign``: channel-wise mean-|w| scaled sign (Bi-Real-Net
+  weight path).
+- ``ste_tern``: {-1, 0, +1} with threshold (Li & Liu, Ternary Weight
+  Networks).
+- ``ste_heaviside``: {0, 1} forward, clipped identity backward.
+- ``dorefa``: k-bit fixed-point in [0, 1] (Zhou et al., DoReFa-Net).
+
+All are shard-transparent: elementwise (or reduce over the channel axis
+only), so they compose with pjit/shard_map without resharding, and the
+custom VJPs keep XLA free to fuse them into adjacent matmuls/convs.
+"""
+
+from functools import partial
+from typing import Callable, Dict, Union
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+def _sign_pm1(x: Array) -> Array:
+    """sign with sign(0) = +1 (binary networks need two-valued outputs)."""
+    x = jnp.asarray(x)
+    return jnp.where(x >= 0, 1.0, -1.0).astype(x.dtype)
+
+
+# -- ste_sign ---------------------------------------------------------------
+
+
+@jax.custom_vjp
+def ste_sign(x: Array) -> Array:
+    return _sign_pm1(x)
+
+
+def _ste_sign_fwd(x):
+    return _sign_pm1(x), x
+
+
+def _ste_sign_bwd(x, g):
+    return (g * (jnp.abs(x) <= 1.0).astype(g.dtype),)
+
+
+ste_sign.defvjp(_ste_sign_fwd, _ste_sign_bwd)
+
+
+# -- approx_sign ------------------------------------------------------------
+
+
+@jax.custom_vjp
+def approx_sign(x: Array) -> Array:
+    return _sign_pm1(x)
+
+
+def _approx_sign_fwd(x):
+    return _sign_pm1(x), x
+
+
+def _approx_sign_bwd(x, g):
+    inside = jnp.abs(x) <= 1.0
+    surrogate = (2.0 - 2.0 * jnp.abs(x)) * inside.astype(g.dtype)
+    return (g * surrogate,)
+
+
+approx_sign.defvjp(_approx_sign_fwd, _approx_sign_bwd)
+
+
+# -- swish_sign -------------------------------------------------------------
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def swish_sign(x: Array, beta: float = 5.0) -> Array:
+    return _sign_pm1(x)
+
+
+# Note: custom_vjp fwd receives all primal args in their ORIGINAL order
+# (nondiff_argnums only changes bwd's signature, which takes them first).
+def _swish_sign_fwd(x, beta):
+    return _sign_pm1(x), x
+
+
+def _swish_sign_bwd(beta, x, g):
+    bx = beta * x
+    sig = jax.nn.sigmoid(bx)
+    surrogate = beta * (2.0 - bx * jnp.tanh(bx * 0.5)) * sig * (1.0 - sig) * 2.0
+    return (g * surrogate,)
+
+
+swish_sign.defvjp(_swish_sign_fwd, _swish_sign_bwd)
+
+
+# -- magnitude_aware_sign ---------------------------------------------------
+
+
+@jax.custom_vjp
+def magnitude_aware_sign(w: Array) -> Array:
+    scale = jnp.mean(jnp.abs(w), axis=tuple(range(w.ndim - 1)), keepdims=True)
+    return _sign_pm1(w) * jax.lax.stop_gradient(scale)
+
+
+def _ma_sign_fwd(w):
+    scale = jnp.mean(jnp.abs(w), axis=tuple(range(w.ndim - 1)), keepdims=True)
+    return _sign_pm1(w) * scale, (w, scale)
+
+
+def _ma_sign_bwd(res, g):
+    w, scale = res
+    # Bi-Real-Net: d out/d w ~ scale * 1_{|w|<=1} (scale treated constant).
+    return (g * scale * (jnp.abs(w) <= 1.0).astype(g.dtype),)
+
+
+magnitude_aware_sign.defvjp(_ma_sign_fwd, _ma_sign_bwd)
+
+
+# -- ste_tern ---------------------------------------------------------------
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def ste_tern(
+    x: Array, threshold_value: float = 0.05, ternary_weight_networks: bool = False
+) -> Array:
+    return _tern_forward(x, threshold_value, ternary_weight_networks)
+
+
+def _tern_forward(x, threshold_value, twn):
+    if twn:
+        # TWN: threshold = 0.7 * mean|x|.
+        thr = 0.7 * jnp.mean(jnp.abs(x))
+    else:
+        thr = threshold_value
+    return jnp.where(x > thr, 1.0, jnp.where(x < -thr, -1.0, 0.0)).astype(
+        x.dtype
+    )
+
+
+def _ste_tern_fwd(x, threshold_value, twn):
+    return _tern_forward(x, threshold_value, twn), x
+
+
+def _ste_tern_bwd(threshold_value, twn, x, g):
+    return (g * (jnp.abs(x) <= 1.0).astype(g.dtype),)
+
+
+ste_tern.defvjp(_ste_tern_fwd, _ste_tern_bwd)
+
+
+# -- ste_heaviside ----------------------------------------------------------
+
+
+@jax.custom_vjp
+def ste_heaviside(x: Array) -> Array:
+    return (x > 0).astype(x.dtype)
+
+
+def _ste_heaviside_fwd(x):
+    return (x > 0).astype(x.dtype), x
+
+
+def _ste_heaviside_bwd(x, g):
+    return (g * (jnp.abs(x) <= 1.0).astype(g.dtype),)
+
+
+ste_heaviside.defvjp(_ste_heaviside_fwd, _ste_heaviside_bwd)
+
+
+# -- dorefa -----------------------------------------------------------------
+
+
+def _dorefa_forward(x, k_bit):
+    n = float(2**k_bit - 1)
+    clipped = jnp.clip(x, 0.0, 1.0)
+    # Half-up rounding (jnp.round is half-to-even, which would put the
+    # midpoint level boundary on the wrong side).
+    return jnp.floor(clipped * n + 0.5) / n
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def dorefa(x: Array, k_bit: int = 2) -> Array:
+    return _dorefa_forward(x, k_bit)
+
+
+def _dorefa_fwd(x, k_bit):
+    return _dorefa_forward(x, k_bit), x
+
+
+def _dorefa_bwd(k_bit, x, g):
+    inside = (x >= 0.0) & (x <= 1.0)
+    return (g * inside.astype(g.dtype),)
+
+
+dorefa.defvjp(_dorefa_fwd, _dorefa_bwd)
+
+
+# -- registry ---------------------------------------------------------------
+
+QUANTIZERS: Dict[str, Callable] = {
+    "ste_sign": ste_sign,
+    "approx_sign": approx_sign,
+    "swish_sign": swish_sign,
+    "magnitude_aware_sign": magnitude_aware_sign,
+    "ste_tern": ste_tern,
+    "ste_heaviside": ste_heaviside,
+    "dorefa": dorefa,
+}
+
+
+def get_quantizer(q: Union[str, Callable, None]) -> Union[Callable, None]:
+    """Resolve a quantizer by name (config/CLI strings) or pass through a
+    callable / None."""
+    if q is None or callable(q):
+        return q
+    if q in QUANTIZERS:
+        return QUANTIZERS[q]
+    raise ValueError(
+        f"Unknown quantizer {q!r}. Known: {sorted(QUANTIZERS)}."
+    )

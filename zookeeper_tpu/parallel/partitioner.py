@@ -72,10 +72,22 @@ class SingleDevicePartitioner(Partitioner):
         return jax.jit(eval_fn)
 
 
-def _device_mesh(axis_sizes: Sequence[int], axis_names: Sequence[str]) -> Mesh:
-    """Build a mesh over all addressable+global devices. ``-1`` in
-    ``axis_sizes`` infers that axis from the device count (like reshape)."""
-    devices = np.asarray(jax.devices())
+def _device_mesh(
+    axis_sizes: Sequence[int],
+    axis_names: Sequence[str],
+    num_devices: int = -1,
+) -> Mesh:
+    """Build a mesh over the first ``num_devices`` devices (-1 = all).
+    ``-1`` in ``axis_sizes`` infers that axis from the device count (like
+    reshape)."""
+    all_devices = jax.devices()
+    if num_devices > 0:
+        if num_devices > len(all_devices):
+            raise ValueError(
+                f"Requested {num_devices} devices, have {len(all_devices)}."
+            )
+        all_devices = all_devices[:num_devices]
+    devices = np.asarray(all_devices)
     n = devices.size
     sizes = list(axis_sizes)
     if sizes.count(-1) > 1:
@@ -96,7 +108,18 @@ def _device_mesh(axis_sizes: Sequence[int], axis_names: Sequence[str]) -> Mesh:
         from jax.experimental import mesh_utils
 
         dev_array = mesh_utils.create_device_mesh(sizes)
-    except Exception:
+    except (ValueError, NotImplementedError) as e:
+        # Only the no-known-good-assignment case falls back; anything else
+        # should surface. The naive order loses ICI-topology awareness, so
+        # say so.
+        import warnings
+
+        warnings.warn(
+            f"mesh_utils.create_device_mesh failed ({e}); falling back to "
+            "enumeration-order device layout, which may place mesh "
+            "neighbors across slow ICI links.",
+            stacklevel=2,
+        )
         dev_array = devices.reshape(sizes)
     return Mesh(dev_array, tuple(axis_names))
 
@@ -114,6 +137,9 @@ class MeshPartitioner(Partitioner):
     mesh_shape: Sequence[int] = Field((-1,))
     mesh_axes: Sequence[str] = Field(("data",))
     data_axes: Sequence[str] = Field(("data",))
+    #: Use only the first N devices (-1 = all); lets dry runs build an
+    #: n-device mesh on hosts exposing more.
+    num_devices: int = Field(-1)
 
     _mesh: Optional[Mesh] = None
     _rules: List[PartitionRule] = []
@@ -133,7 +159,11 @@ class MeshPartitioner(Partitioner):
             object.__setattr__(
                 self,
                 "_mesh",
-                _device_mesh(tuple(self.mesh_shape), tuple(self.mesh_axes)),
+                _device_mesh(
+                    tuple(self.mesh_shape),
+                    tuple(self.mesh_axes),
+                    self.num_devices,
+                ),
             )
 
     @property

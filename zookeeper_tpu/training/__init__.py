@@ -9,6 +9,7 @@ optax optimizers/schedules wired as configurable components, and an
 runs single-device, data-parallel, or model-parallel.
 """
 
+from zookeeper_tpu.training.checkpoint import Checkpointer
 from zookeeper_tpu.training.experiment import Experiment, TrainingExperiment
 from zookeeper_tpu.training.optimizer import (
     Adam,
@@ -31,6 +32,7 @@ from zookeeper_tpu.training.step import make_eval_step, make_train_step
 __all__ = [
     "Adam",
     "AdamW",
+    "Checkpointer",
     "ConstantSchedule",
     "CosineDecay",
     "Experiment",

@@ -1,0 +1,114 @@
+"""Checkpoint/resume (orbax-backed).
+
+The reference delegates checkpointing to user-supplied Keras callbacks
+(SURVEY.md §5 "Checkpoint / resume: absent in framework"); here it is a
+first-class component: async, sharding-aware save/restore of the
+TrainState pytree via orbax, with retention and exact-resume (step counter
+and RNG folding live in the state, and the data pipeline is
+(seed, epoch)-deterministic — SURVEY.md §7).
+"""
+
+import os
+from typing import Any, Optional
+
+from zookeeper_tpu.core import Field, component
+
+
+def _state_pytree(state) -> dict:
+    """The persistable subtree of a TrainState (apply_fn/tx are static
+    code, not data)."""
+    return {
+        "step": state.step,
+        "params": state.params,
+        "model_state": state.model_state,
+        "opt_state": state.opt_state,
+    }
+
+
+@component
+class Checkpointer:
+    """Orbax CheckpointManager as a component.
+
+    ``directory=None`` disables checkpointing entirely (the default, so
+    experiments stay side-effect-free unless asked).
+    """
+
+    directory: Optional[str] = Field(None)
+    max_to_keep: int = Field(3)
+    save_every_epochs: int = Field(1)
+    #: Resume from the latest checkpoint in ``directory`` when present.
+    restore: bool = Field(True)
+    #: Block on save (tests); async otherwise.
+    synchronous: bool = Field(False)
+
+    @property
+    def enabled(self) -> bool:
+        return self.directory is not None
+
+    def _manager(self):
+        import orbax.checkpoint as ocp
+
+        if getattr(self, "_mgr", None) is None:
+            options = ocp.CheckpointManagerOptions(
+                max_to_keep=self.max_to_keep,
+                enable_async_checkpointing=not self.synchronous,
+            )
+            path = os.path.abspath(os.path.expanduser(self.directory))
+            os.makedirs(path, exist_ok=True)
+            object.__setattr__(
+                self, "_mgr", ocp.CheckpointManager(path, options=options)
+            )
+        return self._mgr
+
+    def save(self, state: Any, *, step: Optional[int] = None) -> bool:
+        if not self.enabled:
+            return False
+        import jax
+        import orbax.checkpoint as ocp
+
+        step = int(jax.device_get(state.step)) if step is None else int(step)
+        saved = self._manager().save(
+            step, args=ocp.args.StandardSave(_state_pytree(state))
+        )
+        return bool(saved)
+
+    def latest_step(self) -> Optional[int]:
+        if not self.enabled:
+            return None
+        return self._manager().latest_step()
+
+    def restore_state(self, state: Any) -> Any:
+        """Restore the latest checkpoint into (a copy of) ``state``;
+        returns ``state`` unchanged when disabled or no checkpoint exists.
+        Restored arrays adopt the sharding/placement of the target state
+        leaves."""
+        if not self.enabled or not self.restore:
+            return state
+        step = self._manager().latest_step()
+        if step is None:
+            return state
+        import jax
+        import orbax.checkpoint as ocp
+
+        target = jax.tree.map(
+            ocp.utils.to_shape_dtype_struct, _state_pytree(state)
+        )
+        restored = self._manager().restore(
+            step, args=ocp.args.StandardRestore(target)
+        )
+        return state.replace(
+            step=restored["step"],
+            params=restored["params"],
+            model_state=restored["model_state"],
+            opt_state=restored["opt_state"],
+        )
+
+    def wait(self) -> None:
+        """Block until pending async saves land (call before exit)."""
+        if self.enabled and getattr(self, "_mgr", None) is not None:
+            self._mgr.wait_until_finished()
+
+    def close(self) -> None:
+        if getattr(self, "_mgr", None) is not None:
+            self._mgr.close()
+            object.__setattr__(self, "_mgr", None)

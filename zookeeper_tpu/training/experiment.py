@@ -13,6 +13,7 @@ Throughput (examples/sec) is measured natively since images/sec/chip is the
 north-star metric (BASELINE.md).
 """
 
+import json
 import time
 from typing import Any, Dict, List, Optional
 
@@ -20,6 +21,7 @@ from zookeeper_tpu.core import ComponentField, Field, component, pretty_print
 from zookeeper_tpu.data.pipeline import DataLoader
 from zookeeper_tpu.models.base import Model
 from zookeeper_tpu.parallel.partitioner import Partitioner, SingleDevicePartitioner
+from zookeeper_tpu.training.checkpoint import Checkpointer
 from zookeeper_tpu.training.optimizer import Adam, Optimizer
 from zookeeper_tpu.training.state import TrainState
 from zookeeper_tpu.training.step import make_eval_step, make_train_step
@@ -46,6 +48,7 @@ class TrainingExperiment(Experiment):
     model: Model = ComponentField()
     optimizer: Optimizer = ComponentField(Adam)
     partitioner: Partitioner = ComponentField(SingleDevicePartitioner)
+    checkpointer: Checkpointer = ComponentField(Checkpointer)
 
     epochs: int = Field(1)
     batch_size: int = Field(32)
@@ -55,6 +58,10 @@ class TrainingExperiment(Experiment):
     validate: bool = Field(True)
     log_every: int = Field(0)  # Steps between progress lines; 0 = epoch only.
     verbose: bool = Field(True)
+    #: Append one JSON line of metrics per epoch when set.
+    metrics_file: Optional[str] = Field(None)
+    #: Capture a jax.profiler trace of a few steady-state steps when set.
+    profile_dir: Optional[str] = Field(None)
 
     @Field
     def num_classes(self) -> int:
@@ -94,6 +101,7 @@ class TrainingExperiment(Experiment):
         partitioner = self.partitioner
         partitioner.setup()
         state = partitioner.shard_state(self.build_state())
+        state = self.checkpointer.restore_state(state)
         train_step = partitioner.compile_step(
             make_train_step(rng_seed=self.seed), state
         )
@@ -101,17 +109,30 @@ class TrainingExperiment(Experiment):
         batch_sharding = partitioner.batch_sharding()
 
         spe = self._steps_per_epoch()
+        start_epoch = int(jax.device_get(state.step)) // max(1, spe)
+        if start_epoch > 0:
+            self._log(
+                f"resumed from checkpoint at step "
+                f"{int(jax.device_get(state.step))} (epoch {start_epoch})"
+            )
         history: Dict[str, List[Dict[str, float]]] = {"train": [], "validation": []}
-        for epoch in range(self.epochs):
+        for epoch in range(start_epoch, self.epochs):
             t0 = time.perf_counter()
             accum: List[Any] = []
+            profiling = self.profile_dir is not None and epoch == start_epoch
             for step_idx, batch in enumerate(
                 self.loader.batches("train", epoch=epoch, sharding=batch_sharding)
             ):
                 if step_idx >= spe:
                     break
+                if profiling and step_idx == min(4, spe - 1):
+                    jax.profiler.start_trace(self.profile_dir)
                 state, metrics = train_step(state, batch)
                 accum.append(metrics)
+                if profiling and step_idx == min(14, spe - 1):
+                    jax.block_until_ready(metrics["loss"])
+                    jax.profiler.stop_trace()
+                    profiling = False
                 if self.log_every and (step_idx + 1) % self.log_every == 0:
                     m = {k: float(v) for k, v in metrics.items()}
                     self._log(
@@ -157,5 +178,21 @@ class TrainingExperiment(Experiment):
                 )
             self._log(line)
 
+            if self.metrics_file:
+                record = {"epoch": epoch, **epoch_metrics}
+                if history["validation"]:
+                    record.update(
+                        {f"val_{k}": v for k, v in history["validation"][-1].items()}
+                    )
+                with open(self.metrics_file, "a") as f:
+                    f.write(json.dumps(record) + "\n")
+
+            if (
+                self.checkpointer.enabled
+                and (epoch + 1) % self.checkpointer.save_every_epochs == 0
+            ):
+                self.checkpointer.save(state)
+
+        self.checkpointer.wait()
         self.final_state = state
         return history

@@ -64,10 +64,10 @@ class StepDecay(Schedule):
     factor: float = Field(0.1)
 
     def build(self, total_steps: int) -> Callable:
-        return optax.piecewise_constant_schedule(
-            self.base_lr,
-            {
-                max(1, int(b * total_steps)): self.factor
-                for b in self.boundaries
-            },
-        )
+        # Boundaries that collapse onto the same step (short runs) must
+        # compound their factors, not silently overwrite each other.
+        boundaries: dict = {}
+        for b in self.boundaries:
+            step = max(1, int(b * total_steps))
+            boundaries[step] = boundaries.get(step, 1.0) * self.factor
+        return optax.piecewise_constant_schedule(self.base_lr, boundaries)
