@@ -1,0 +1,56 @@
+"""ImageNet-scale binary-network experiments (BASELINE configs #2-#4).
+
+Train the larq-zoo-equivalent binary families data-parallel over a TPU
+mesh. With no real ImageNet on this machine the default dataset is
+synthetic at ImageNet shapes (swap ``loader.dataset=TFDSDataset
+loader.dataset.name=imagenet2012`` where TFDS data is available)::
+
+    # QuickNet, pure data parallel over all chips:
+    python examples/imagenet_experiment.py TrainImageNet model=QuickNet
+
+    # Bi-Real-Net-18, 90-epoch cosine recipe:
+    python examples/imagenet_experiment.py TrainImageNet model=BiRealNet \\
+        epochs=90 optimizer.schedule=WarmupCosine \\
+        optimizer.schedule.base_lr=2.5e-3 optimizer.schedule.warmup_steps=1000
+
+    # Multi-host pod (per host):
+    python examples/imagenet_experiment.py TrainImageNet \\
+        runtime.coordinator_address=<host0>:8476 runtime.num_processes=16 \\
+        runtime.process_id=$WORKER_ID batch_size=8192
+"""
+
+from zookeeper_tpu import ComponentField, Field, PartialComponent, cli, task
+from zookeeper_tpu.data import (
+    DataLoader,
+    ImageClassificationPreprocessing,
+    SyntheticImageNet,
+)
+from zookeeper_tpu.models import Model, QuickNet
+from zookeeper_tpu.parallel import DataParallelPartitioner, Partitioner
+from zookeeper_tpu.training import Adam, Optimizer, TrainingExperiment, WarmupCosine
+
+ImageNetPreprocessing = PartialComponent(
+    ImageClassificationPreprocessing,
+    height=224, width=224, channels=3, augment=True, pad_pixels=16,
+)
+
+
+@task
+class TrainImageNet(TrainingExperiment):
+    loader: DataLoader = ComponentField(
+        DataLoader,
+        dataset=SyntheticImageNet,
+        preprocessing=ImageNetPreprocessing,
+        num_workers=8,
+    )
+    model: Model = ComponentField(QuickNet, compute_dtype="bfloat16")
+    optimizer: Optimizer = ComponentField(
+        Adam, schedule=PartialComponent(WarmupCosine, base_lr=1e-2)
+    )
+    partitioner: Partitioner = ComponentField(DataParallelPartitioner)
+    epochs: int = Field(120)
+    batch_size: int = Field(256)
+
+
+if __name__ == "__main__":
+    cli()

@@ -214,3 +214,37 @@ def test_multihost_forces_drop_remainder():
     h1 = list(batch_iterator(src, pre, 4, host_index=1, **kw))
     assert len(h0) == len(h1) == 1
     assert h0[0]["input"].shape[0] == h1[0]["input"].shape[0] == 4
+
+
+def test_native_fast_path_matches_per_example_path():
+    rng = np.random.default_rng(9)
+    src = ArraySource(
+        {
+            "image": rng.integers(0, 256, size=(32, 8, 8, 3), dtype=np.uint8),
+            "label": rng.integers(0, 10, size=(32,)).astype(np.int64),
+        }
+    )
+    pre = ImageClassificationPreprocessing()
+    configure(pre, {"height": 8, "width": 8, "channels": 3}, name="pre")
+    assert pre.native_batch_spec(training=False) is not None
+    kw = dict(training=False, shuffle=True, seed=11)
+    fast = list(batch_iterator(src, pre, 8, **kw))
+    # Force the per-example path by hiding the spec.
+    slow_pre = ImageClassificationPreprocessing()
+    configure(slow_pre, {"height": 8, "width": 8, "channels": 3}, name="p2")
+    object.__setattr__(slow_pre, "native_batch_spec", lambda training: None)
+    slow = list(batch_iterator(src, slow_pre, 8, **kw))
+    assert len(fast) == len(slow) == 4
+    for a, b in zip(fast, slow):
+        # Affine order differs ((x/255)*2-1 vs x*(2/255)-1): fp32 rounding.
+        np.testing.assert_allclose(a["input"], b["input"], atol=1e-4)
+        np.testing.assert_array_equal(a["target"], b["target"])
+        assert a["input"].dtype == np.float32
+        assert a["target"].dtype == np.int32
+
+
+def test_native_fast_path_skipped_when_augmenting():
+    pre = ImageClassificationPreprocessing()
+    configure(pre, {"augment": True}, name="pre")
+    assert pre.native_batch_spec(training=True) is None
+    assert pre.native_batch_spec(training=False) is not None

@@ -1,0 +1,66 @@
+import numpy as np
+import pytest
+
+from zookeeper_tpu import native
+
+
+def test_native_builds_and_loads():
+    # g++ is available in this environment; the lib must build.
+    assert native.available()
+
+
+def test_pack_bits_matches_numpy_fallback():
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(7, 96)).astype(np.float32)
+    fast = native.pack_bits(x)
+    # Independent reference.
+    bits = (x >= 0).astype(np.uint32).reshape(7, 3, 32)
+    ref = (bits << np.arange(32, dtype=np.uint32)).sum(axis=-1, dtype=np.uint32)
+    np.testing.assert_array_equal(fast, ref.astype(np.int32))
+    assert fast.shape == (7, 3)
+
+
+def test_pack_bits_multidim_and_errors():
+    x = np.ones((2, 3, 64), np.float32)
+    assert native.pack_bits(x).shape == (2, 3, 2)
+    with pytest.raises(ValueError, match="multiple of 32"):
+        native.pack_bits(np.ones((2, 31), np.float32))
+
+
+def test_gather_normalize_matches_numpy():
+    rng = np.random.default_rng(1)
+    store = rng.integers(0, 256, size=(10, 4, 4, 3), dtype=np.uint8)
+    idx = np.array([3, 0, 9, 3], np.int64)
+    out = native.gather_normalize(store, idx, 2.0 / 255.0, -1.0)
+    ref = store[idx].astype(np.float32) * (2.0 / 255.0) - 1.0
+    np.testing.assert_allclose(out, ref, rtol=1e-6)
+    assert out.dtype == np.float32
+    assert out.shape == (4, 4, 4, 3)
+
+
+def test_xnor_gemm_matches_float():
+    rng = np.random.default_rng(2)
+    a = rng.choice([-1.0, 1.0], size=(9, 64)).astype(np.float32)
+    b = rng.choice([-1.0, 1.0], size=(64, 5)).astype(np.float32)
+    ap = native.pack_bits(a)
+    bp = native.pack_bits(np.ascontiguousarray(b.T))
+    out = native.xnor_gemm(ap, bp, 64)
+    np.testing.assert_array_equal(out, (a @ b).astype(np.int32))
+
+
+def test_xnor_gemm_agrees_with_pallas_interpret():
+    from zookeeper_tpu.ops import xnor_matmul
+
+    rng = np.random.default_rng(3)
+    a = rng.choice([-1.0, 1.0], size=(17, 96)).astype(np.float32)
+    b = rng.choice([-1.0, 1.0], size=(96, 11)).astype(np.float32)
+    ap = native.pack_bits(a)
+    bp = native.pack_bits(np.ascontiguousarray(b.T))
+    cpu = native.xnor_gemm(ap, bp, 96)
+    import jax.numpy as jnp
+
+    pallas = np.asarray(
+        xnor_matmul(jnp.asarray(a), jnp.asarray(b), interpret=True,
+                    block_m=8, block_n=8)
+    )
+    np.testing.assert_array_equal(cpu, pallas.astype(np.int32))

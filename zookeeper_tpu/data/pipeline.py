@@ -30,7 +30,7 @@ import numpy as np
 from zookeeper_tpu.core import ComponentField, Field, component
 from zookeeper_tpu.data.dataset import Dataset
 from zookeeper_tpu.data.preprocessing import Preprocessing
-from zookeeper_tpu.data.source import DataSource
+from zookeeper_tpu.data.source import ArraySource, DataSource
 
 Batch = Dict[str, np.ndarray]
 
@@ -73,6 +73,41 @@ def batch_iterator(
         order = np.arange(n)
 
     num_batches = n // global_batch if drop_remainder else -(-n // global_batch)
+
+    # Native fast path: when preprocessing reduces to gather+affine over a
+    # uint8 in-memory store, assemble whole batches in one fused C++ call
+    # (threads, no per-example Python) — the LCE-equivalent host kernel.
+    native_spec = None
+    if preprocessing is not None and hasattr(preprocessing, "native_batch_spec"):
+        spec = preprocessing.native_batch_spec(training)
+        if spec is not None and isinstance(source, ArraySource):
+            img = source.arrays.get(spec["image_key"])
+            lbl = source.arrays.get(spec["label_key"])
+            if (
+                img is not None
+                and lbl is not None
+                and img.dtype == np.uint8
+                and tuple(img.shape[1:]) == tuple(spec["expected_shape"])
+            ):
+                native_spec = (spec, img, lbl)
+
+    if native_spec is not None:
+        from zookeeper_tpu import native
+
+        spec, img, lbl = native_spec
+        for b in range(num_batches):
+            start = b * global_batch + host_index * batch_size
+            stop = min(start + batch_size, n, (b + 1) * global_batch)
+            if stop <= start:
+                continue
+            idx = order[start:stop].astype(np.int64)
+            yield {
+                "input": native.gather_normalize(
+                    img, idx, spec["scale"], spec["shift"]
+                ),
+                "target": lbl[idx].astype(np.int32),
+            }
+        return
 
     def fetch(global_index: int) -> Dict[str, np.ndarray]:
         idx = int(order[global_index])

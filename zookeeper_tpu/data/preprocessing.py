@@ -45,6 +45,13 @@ class Preprocessing:
             "target": np.asarray(self.output(example, training)),
         }
 
+    def native_batch_spec(self, training: bool):
+        """When this preprocessing reduces (for the given mode) to a fused
+        gather+affine over a uint8 store, return the spec dict consumed by
+        the pipeline's native fast path (zookeeper_tpu.native); else None.
+        """
+        return None
+
 
 @component
 class PassThroughPreprocessing(Preprocessing):
@@ -117,6 +124,23 @@ class ImageClassificationPreprocessing(Preprocessing):
 
     def output(self, example: Example, training: bool) -> np.ndarray:
         return np.asarray(example[self.label_key], dtype=np.int32)
+
+    def native_batch_spec(self, training: bool):
+        # Augmentation is per-example/stateful; only the pure
+        # normalize-and-stack mode collapses to the native fused kernel.
+        if training and self.augment:
+            return None
+        if self.zero_center:
+            scale, shift = 2.0 / 255.0, -1.0
+        else:
+            scale, shift = 1.0 / 255.0, 0.0
+        return {
+            "image_key": self.image_key,
+            "label_key": self.label_key,
+            "scale": scale,
+            "shift": shift,
+            "expected_shape": self.input_shape,
+        }
 
 
 def _center_crop_or_pad(image: np.ndarray, height: int, width: int) -> np.ndarray:

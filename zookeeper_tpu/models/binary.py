@@ -38,6 +38,7 @@ class _BinaryNetModule(nn.Module):
     dense_units: Tuple[int, ...]
     num_classes: int
     dtype: Any
+    binary_compute: str = "mxu"
 
     @nn.compact
     def __call__(self, x, training: bool = False):
@@ -47,17 +48,18 @@ class _BinaryNetModule(nn.Module):
             x = QuantConv(
                 f, (3, 3), input_quantizer=quant_in,
                 kernel_quantizer="ste_sign", dtype=self.dtype,
+                binary_compute=self.binary_compute,
             )(x)
             if i % 2 == 1:
                 x = nn.max_pool(x, (2, 2), strides=(2, 2))
-            x = _bn(training)(x)
+            x = _bn(training, self.dtype)(x)
         x = x.reshape((x.shape[0], -1))
         for u in self.dense_units:
             x = QuantDense(
                 u, input_quantizer="ste_sign", kernel_quantizer="ste_sign",
                 use_bias=False, dtype=self.dtype,
             )(x)
-            x = _bn(training)(x)
+            x = _bn(training, self.dtype)(x)
         x = nn.Dense(self.num_classes, dtype=self.dtype)(x)
         return x.astype(jnp.float32)
 
@@ -68,6 +70,8 @@ class BinaryNet(Model):
 
     features: Sequence[int] = Field((128, 128, 256, 256, 512, 512))
     dense_units: Sequence[int] = Field((1024, 1024))
+    #: Binary matmul path: "mxu" (bf16/fp32) or "int8" (int32-accum MXU).
+    binary_compute: str = Field("mxu")
 
     def build(self, input_shape, num_classes: int) -> nn.Module:
         return _BinaryNetModule(
@@ -75,6 +79,7 @@ class BinaryNet(Model):
             dense_units=tuple(self.dense_units),
             num_classes=num_classes,
             dtype=self.dtype(),
+            binary_compute=self.binary_compute,
         )
 
 
@@ -84,6 +89,7 @@ class _BinaryAlexNetModule(nn.Module):
     num_classes: int
     dtype: Any
     inflation: int = 1
+    binary_compute: str = "mxu"
 
     @nn.compact
     def __call__(self, x, training: bool = False):
@@ -93,22 +99,23 @@ class _BinaryAlexNetModule(nn.Module):
         x = nn.Conv(64 * f, (11, 11), strides=(4, 4), padding="SAME",
                     use_bias=False, dtype=d)(x.astype(d))
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
-        x = _bn(training)(x)
+        x = _bn(training, self.dtype)(x)
         for feat, k in ((192 * f, 5), (384 * f, 3), (384 * f, 3), (256 * f, 3)):
             x = QuantConv(
                 feat, (k, k), input_quantizer="ste_sign",
                 kernel_quantizer="ste_sign", dtype=d,
+                binary_compute=self.binary_compute,
             )(x)
             if feat in (192 * f, 256 * f):
                 x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
-            x = _bn(training)(x)
+            x = _bn(training, self.dtype)(x)
         x = x.reshape((x.shape[0], -1))
         for u in (4096, 4096):
             x = QuantDense(
                 u, input_quantizer="ste_sign", kernel_quantizer="ste_sign",
                 use_bias=False, dtype=d,
             )(x)
-            x = _bn(training)(x)
+            x = _bn(training, self.dtype)(x)
         x = nn.Dense(self.num_classes, dtype=d)(x)
         return x.astype(jnp.float32)
 
@@ -118,11 +125,13 @@ class BinaryAlexNet(Model):
     """Binarized AlexNet for ImageNet (BASELINE config #2)."""
 
     inflation: int = Field(1)
+    binary_compute: str = Field("mxu")
 
     def build(self, input_shape, num_classes: int) -> nn.Module:
         return _BinaryAlexNetModule(
             num_classes=num_classes, dtype=self.dtype(),
             inflation=self.inflation,
+            binary_compute=self.binary_compute,
         )
 
 
@@ -137,6 +146,7 @@ class _BiRealBlock(nn.Module):
     features: int
     strides: int
     dtype: Any
+    binary_compute: str = "mxu"
 
     @nn.compact
     def __call__(self, x, training: bool = False):
@@ -149,13 +159,14 @@ class _BiRealBlock(nn.Module):
             shortcut = nn.Conv(
                 self.features, (1, 1), use_bias=False, dtype=self.dtype
             )(shortcut)
-            shortcut = _bn(training)(shortcut)
+            shortcut = _bn(training, self.dtype)(shortcut)
         y = QuantConv(
             self.features, (3, 3), strides=(self.strides, self.strides),
             input_quantizer="approx_sign",
             kernel_quantizer="magnitude_aware_sign", dtype=self.dtype,
+            binary_compute=self.binary_compute,
         )(x)
-        y = _bn(training)(y)
+        y = _bn(training, self.dtype)(y)
         return y + shortcut
 
 
@@ -166,20 +177,23 @@ class _BiRealNetModule(nn.Module):
     section_features: Tuple[int, ...]
     num_classes: int
     dtype: Any
+    binary_compute: str = "mxu"
 
     @nn.compact
     def __call__(self, x, training: bool = False):
         d = self.dtype
         x = nn.Conv(64, (7, 7), strides=(2, 2), padding="SAME",
                     use_bias=False, dtype=d)(x.astype(d))
-        x = _bn(training)(x)
+        x = _bn(training, self.dtype)(x)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
         for s, (n, feat) in enumerate(
             zip(self.blocks_per_section, self.section_features)
         ):
             for b in range(n):
                 strides = 2 if (b == 0 and s > 0) else 1
-                x = _BiRealBlock(feat, strides, d)(x, training)
+                x = _BiRealBlock(
+                    feat, strides, d, self.binary_compute
+                )(x, training)
         x = jnp.mean(x, axis=(1, 2))
         x = nn.Dense(self.num_classes, dtype=d)(x)
         return x.astype(jnp.float32)
@@ -191,6 +205,7 @@ class BiRealNet(Model):
 
     blocks_per_section: Sequence[int] = Field((4, 4, 4, 4))
     section_features: Sequence[int] = Field((64, 128, 256, 512))
+    binary_compute: str = Field("mxu")
 
     def build(self, input_shape, num_classes: int) -> nn.Module:
         return _BiRealNetModule(
@@ -198,6 +213,7 @@ class BiRealNet(Model):
             section_features=tuple(self.section_features),
             num_classes=num_classes,
             dtype=self.dtype(),
+            binary_compute=self.binary_compute,
         )
 
 
@@ -230,6 +246,7 @@ class _QuickNetModule(nn.Module):
     section_features: Tuple[int, ...]
     num_classes: int
     dtype: Any
+    binary_compute: str = "mxu"
 
     @nn.compact
     def __call__(self, x, training: bool = False):
@@ -237,13 +254,13 @@ class _QuickNetModule(nn.Module):
         # Stem: fp 3x3/2 to 8ch, then grouped 3x3/2 to first section width.
         x = nn.Conv(8, (3, 3), strides=(2, 2), padding="SAME",
                     use_bias=False, dtype=d)(x.astype(d))
-        x = _bn(training)(x)
+        x = _bn(training, self.dtype)(x)
         x = nn.relu(x)
         x = nn.Conv(
             self.section_features[0], (3, 3), strides=(2, 2), padding="SAME",
             use_bias=False, feature_group_count=4, dtype=d,
         )(x)
-        x = _bn(training)(x)
+        x = _bn(training, self.dtype)(x)
         for s, (n, feat) in enumerate(
             zip(self.blocks_per_section, self.section_features)
         ):
@@ -252,13 +269,14 @@ class _QuickNetModule(nn.Module):
                 x = nn.relu(x)
                 x = _blur_pool(x, d)
                 x = nn.Conv(feat, (1, 1), use_bias=False, dtype=d)(x)
-                x = _bn(training)(x)
+                x = _bn(training, self.dtype)(x)
             for _ in range(n):
                 y = QuantConv(
                     feat, (3, 3), input_quantizer="ste_sign",
                     kernel_quantizer="ste_sign", dtype=d,
+                    binary_compute=self.binary_compute,
                 )(x)
-                y = _bn(training)(y)
+                y = _bn(training, d)(y)
                 x = x + y  # Residual around every binary conv.
         x = nn.relu(x)
         x = jnp.mean(x, axis=(1, 2))
@@ -272,6 +290,7 @@ class QuickNet(Model):
 
     blocks_per_section: Sequence[int] = Field((2, 3, 4, 4))
     section_features: Sequence[int] = Field((64, 128, 256, 512))
+    binary_compute: str = Field("mxu")
 
     def build(self, input_shape, num_classes: int) -> nn.Module:
         return _QuickNetModule(
@@ -279,6 +298,7 @@ class QuickNet(Model):
             section_features=tuple(self.section_features),
             num_classes=num_classes,
             dtype=self.dtype(),
+            binary_compute=self.binary_compute,
         )
 
 

@@ -1,0 +1,160 @@
+"""ctypes bindings for the native host kernels (see src/zk_native.cpp).
+
+Loads a prebuilt ``libzk_native.so`` next to this file, or builds it on
+first use with g++ (cached). Every entry point has a numpy fallback so the
+framework works on machines without a toolchain — the native path is a
+host-throughput optimization, never a requirement.
+"""
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "src", "zk_native.cpp")
+_LIB = os.path.join(_HERE, "libzk_native.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _build() -> bool:
+    cmd = [
+        "g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread",
+        _SRC, "-o", _LIB,
+    ]
+    try:
+        subprocess.run(
+            cmd, check=True, capture_output=True, timeout=120
+        )
+        return True
+    except (OSError, subprocess.SubprocessError):
+        return False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if not os.path.exists(_LIB) or os.path.getmtime(_LIB) < os.path.getmtime(_SRC):
+            if not _build():
+                return None
+        try:
+            lib = ctypes.CDLL(_LIB)
+        except OSError:
+            return None
+        lib.zk_pack_bits_f32.argtypes = [
+            ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_int64, ctypes.c_int64,
+        ]
+        lib.zk_gather_normalize_u8.argtypes = [
+            ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_float), ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_float, ctypes.c_float,
+        ]
+        lib.zk_xnor_gemm_ref.argtypes = [
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int32), ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_int64, ctypes.c_int32,
+        ]
+        lib.zk_version.restype = ctypes.c_int
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def _ptr(arr: np.ndarray, ctype):
+    return arr.ctypes.data_as(ctypes.POINTER(ctype))
+
+
+def pack_bits(x: np.ndarray) -> np.ndarray:
+    """Pack sign bits of the last axis (length % 32 == 0) into int32 words."""
+    x = np.ascontiguousarray(x, dtype=np.float32)
+    rows = int(np.prod(x.shape[:-1])) if x.ndim > 1 else 1
+    cols = x.shape[-1]
+    if cols % 32 != 0:
+        raise ValueError(f"Packed axis must be a multiple of 32, got {cols}.")
+    out_shape = (*x.shape[:-1], cols // 32)
+    lib = _load()
+    if lib is None:  # numpy fallback
+        bits = (x.reshape(rows, cols) >= 0).astype(np.uint32)
+        bits = bits.reshape(rows, cols // 32, 32)
+        words = (bits << np.arange(32, dtype=np.uint32)).sum(
+            axis=-1, dtype=np.uint32
+        )
+        return words.astype(np.int32).reshape(out_shape)
+    out = np.empty((rows, cols // 32), dtype=np.int32)
+    lib.zk_pack_bits_f32(
+        _ptr(x.reshape(rows, cols), ctypes.c_float), _ptr(out, ctypes.c_int32),
+        rows, cols,
+    )
+    return out.reshape(out_shape)
+
+
+def gather_normalize(
+    store: np.ndarray, indices: np.ndarray, scale: float, shift: float
+) -> np.ndarray:
+    """Fused batch assembly: ``(scale * store[indices] + shift)`` as float32.
+
+    ``store``: [N, ...] uint8; returns [len(indices), ...] float32.
+    """
+    store = np.ascontiguousarray(store)
+    if store.dtype != np.uint8:
+        raise ValueError("gather_normalize expects a uint8 store.")
+    indices = np.ascontiguousarray(indices, dtype=np.int64)
+    example_shape = store.shape[1:]
+    example_size = int(np.prod(example_shape))
+    batch = len(indices)
+    lib = _load()
+    if lib is None:  # numpy fallback
+        return (
+            store[indices].astype(np.float32) * np.float32(scale)
+            + np.float32(shift)
+        )
+    out = np.empty((batch, example_size), dtype=np.float32)
+    lib.zk_gather_normalize_u8(
+        _ptr(store.reshape(store.shape[0], example_size), ctypes.c_uint8),
+        _ptr(indices, ctypes.c_int64),
+        _ptr(out, ctypes.c_float),
+        batch, example_size, float(scale), float(shift),
+    )
+    return out.reshape(batch, *example_shape)
+
+
+def xnor_gemm(
+    a_packed: np.ndarray, b_packed: np.ndarray, k_true: int
+) -> np.ndarray:
+    """CPU XNOR-popcount GEMM on packed operands (reference twin of the
+    Pallas TPU kernel): a [M, KP] int32, b [N, KP] int32 -> [M, N] int32."""
+    a_packed = np.ascontiguousarray(a_packed, dtype=np.int32)
+    b_packed = np.ascontiguousarray(b_packed, dtype=np.int32)
+    m, kp = a_packed.shape
+    n, kp2 = b_packed.shape
+    if kp != kp2:
+        raise ValueError(f"Packed K mismatch: {kp} vs {kp2}.")
+    lib = _load()
+    if lib is None:  # numpy fallback
+        xor = np.bitwise_xor(
+            a_packed[:, None, :].view(np.uint32),
+            b_packed[None, :, :].view(np.uint32),
+        )
+        mismatches = np.unpackbits(
+            xor.view(np.uint8), axis=-1, bitorder="little"
+        ).sum(axis=-1, dtype=np.int32)
+        return (k_true - 2 * mismatches).astype(np.int32)
+    out = np.empty((m, n), dtype=np.int32)
+    lib.zk_xnor_gemm_ref(
+        _ptr(a_packed, ctypes.c_int32), _ptr(b_packed, ctypes.c_int32),
+        _ptr(out, ctypes.c_int32), m, n, kp, int(k_true),
+    )
+    return out

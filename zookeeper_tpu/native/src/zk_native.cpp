@@ -1,0 +1,121 @@
+// Native host-side kernels (the larq-compute-engine-equivalent role,
+// SURVEY.md §2.4): the TPU owns all device compute via XLA/Pallas, but the
+// host input pipeline and bit-packing are plain CPU work where C++ with
+// threads beats per-example Python/numpy. Exposed as a C ABI for ctypes
+// (environment has no pybind11; see task brief).
+//
+// Functions:
+//   zk_pack_bits_f32     — pack float sign bits into int32 words (32x
+//                          weight/activation compression for the
+//                          XNOR-popcount path and packed checkpoints).
+//   zk_gather_normalize_u8 — fused batch assembly: gather examples by
+//                          index from a uint8 image store and emit
+//                          normalized float32 (scale*x + shift), the
+//                          inner loop of every epoch.
+//   zk_xnor_gemm_ref     — bit-serial XNOR-popcount GEMM on packed words;
+//                          CPU reference/validation twin of the Pallas
+//                          TPU kernel (and a usable host fallback).
+//
+// Build: see ../build.py (g++ -O3 -shared -fPIC, plain std::thread).
+
+#include <atomic>
+#include <cstdint>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+namespace {
+
+int hardware_threads() {
+  unsigned n = std::thread::hardware_concurrency();
+  return n == 0 ? 1 : static_cast<int>(n);
+}
+
+// Run fn(first, last) over [0, total) split across threads.
+template <typename Fn>
+void parallel_for(int64_t total, Fn fn, int max_threads = 0) {
+  int n_threads = max_threads > 0 ? max_threads : hardware_threads();
+  if (total < 1024 || n_threads <= 1) {
+    fn(static_cast<int64_t>(0), total);
+    return;
+  }
+  n_threads = static_cast<int>(
+      std::min<int64_t>(n_threads, (total + 1023) / 1024));
+  std::vector<std::thread> threads;
+  threads.reserve(n_threads);
+  int64_t chunk = (total + n_threads - 1) / n_threads;
+  for (int t = 0; t < n_threads; ++t) {
+    int64_t first = t * chunk;
+    int64_t last = std::min<int64_t>(first + chunk, total);
+    if (first >= last) break;
+    threads.emplace_back([=] { fn(first, last); });
+  }
+  for (auto& th : threads) th.join();
+}
+
+}  // namespace
+
+extern "C" {
+
+// in:  [rows, cols] float32, cols % 32 == 0.
+// out: [rows, cols/32] int32; bit j of word w is in[r, 32*w + j] >= 0.
+void zk_pack_bits_f32(const float* in, int32_t* out, int64_t rows,
+                      int64_t cols) {
+  const int64_t words = cols / 32;
+  parallel_for(rows, [=](int64_t r0, int64_t r1) {
+    for (int64_t r = r0; r < r1; ++r) {
+      const float* row = in + r * cols;
+      int32_t* orow = out + r * words;
+      for (int64_t w = 0; w < words; ++w) {
+        uint32_t acc = 0;
+        const float* src = row + w * 32;
+        for (int b = 0; b < 32; ++b) {
+          acc |= (src[b] >= 0.0f ? 1u : 0u) << b;
+        }
+        orow[w] = static_cast<int32_t>(acc);
+      }
+    }
+  });
+}
+
+// Gather batch rows by index from a uint8 store and normalize to float32.
+// store:   [num_examples, example_size] uint8 (contiguous per example)
+// indices: [batch] int64 row indices
+// out:     [batch, example_size] float32 = scale * x + shift
+void zk_gather_normalize_u8(const uint8_t* store, const int64_t* indices,
+                            float* out, int64_t batch, int64_t example_size,
+                            float scale, float shift) {
+  parallel_for(batch, [=](int64_t b0, int64_t b1) {
+    for (int64_t b = b0; b < b1; ++b) {
+      const uint8_t* src = store + indices[b] * example_size;
+      float* dst = out + b * example_size;
+      for (int64_t i = 0; i < example_size; ++i) {
+        dst[i] = scale * static_cast<float>(src[i]) + shift;
+      }
+    }
+  });
+}
+
+// Bit-serial binary GEMM on packed operands (CPU reference for the Pallas
+// kernel): out[m, n] = k_true - 2 * popcount(a[m, :] ^ b[n, :]).
+// a: [M, KP] int32, b: [N, KP] int32 (B transposed, packed along K).
+void zk_xnor_gemm_ref(const int32_t* a, const int32_t* b, int32_t* out,
+                      int64_t m, int64_t n, int64_t kp, int32_t k_true) {
+  parallel_for(m, [=](int64_t m0, int64_t m1) {
+    for (int64_t i = m0; i < m1; ++i) {
+      const uint32_t* arow = reinterpret_cast<const uint32_t*>(a) + i * kp;
+      for (int64_t j = 0; j < n; ++j) {
+        const uint32_t* brow = reinterpret_cast<const uint32_t*>(b) + j * kp;
+        int32_t mismatches = 0;
+        for (int64_t w = 0; w < kp; ++w) {
+          mismatches += __builtin_popcount(arow[w] ^ brow[w]);
+        }
+        out[i * n + j] = k_true - 2 * mismatches;
+      }
+    }
+  });
+}
+
+int zk_version() { return 1; }
+
+}  // extern "C"

@@ -193,9 +193,12 @@ def _int8_conv_forward(x_sign, k_sign, strides, padding):
 
 
 def _float_conv(x, k, strides, padding):
+    # Mixed precision: activations may be bf16 while latent kernels are
+    # fp32; compute the gradient conv in the wider dtype.
+    dtype = jnp.promote_types(x.dtype, k.dtype)
     return jax.lax.conv_general_dilated(
-        x, k, window_strides=tuple(strides), padding=padding,
-        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        x.astype(dtype), k.astype(dtype), window_strides=tuple(strides),
+        padding=padding, dimension_numbers=("NHWC", "HWIO", "NHWC"),
     )
 
 
@@ -218,7 +221,8 @@ def _int8_conv_bwd(strides, padding, res, g):
     x_sign, k_sign = res
     _, vjp = jax.vjp(lambda x, k: _float_conv(x, k, strides, padding),
                      x_sign, k_sign)
-    return vjp(g)
+    dx, dk = vjp(g.astype(jnp.promote_types(x_sign.dtype, k_sign.dtype)))
+    return dx.astype(x_sign.dtype), dk.astype(k_sign.dtype)
 
 
 int8_conv.defvjp(_int8_conv_fwd, _int8_conv_bwd)

@@ -16,12 +16,18 @@ from zookeeper_tpu.parallel.partitioner import (
     SingleDevicePartitioner,
 )
 from zookeeper_tpu.parallel.rules import PartitionRule, match_partition_rules
+from zookeeper_tpu.parallel.distributed import (
+    DistributedRuntime,
+    initialize_distributed,
+)
 
 __all__ = [
     "DataParallelPartitioner",
+    "DistributedRuntime",
     "MeshPartitioner",
     "Partitioner",
     "PartitionRule",
     "SingleDevicePartitioner",
+    "initialize_distributed",
     "match_partition_rules",
 ]

@@ -1,0 +1,77 @@
+"""Multi-host (pod) runtime initialization.
+
+The distributed communication backend (SURVEY.md §2.5): collectives are
+XLA-compiled from sharding annotations and ride ICI within a pod slice and
+DCN across slices — there is no hand-written NCCL/MPI-style layer, by
+design. What remains host-side is bootstrapping the JAX distributed
+runtime so all processes agree on topology, which this module owns, plus
+small helpers for process-level facts the data pipeline needs.
+
+Failure/recovery model (SURVEY.md §5): crash-restart with deterministic
+resume — a failed pod job restarts, ``jax.distributed.initialize`` re-forms
+the cluster, and the Experiment restores the latest orbax checkpoint; the
+(seed, epoch)-keyed data pipeline makes the replay exact.
+"""
+
+from typing import Optional
+
+from zookeeper_tpu.core import Field, component
+
+
+def initialize_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Initialize the JAX distributed runtime (idempotent).
+
+    With no arguments, relies on the TPU environment's auto-detection
+    (GCE metadata / megascale env), which is the normal path on Cloud TPU
+    pods. No-op when already initialized or when running single-process.
+    """
+    import jax
+
+    state = getattr(jax._src.distributed, "global_state", None)
+    if state is not None and getattr(state, "client", None) is not None:
+        return  # Already initialized.
+    kwargs = {}
+    if coordinator_address is not None:
+        kwargs["coordinator_address"] = coordinator_address
+    if num_processes is not None:
+        kwargs["num_processes"] = num_processes
+    if process_id is not None:
+        kwargs["process_id"] = process_id
+    try:
+        jax.distributed.initialize(**kwargs)
+    except (ValueError, RuntimeError) as e:
+        if coordinator_address is not None:
+            raise
+        # Auto-detection unavailable (single host, no cluster env): fine.
+        import logging
+
+        logging.getLogger(__name__).debug(
+            "jax.distributed.initialize skipped: %s", e
+        )
+
+
+@component
+class DistributedRuntime:
+    """Component wrapper so pod bootstrap is configurable from the CLI::
+
+        python train.py Exp runtime.coordinator_address=10.0.0.2:1234 \\
+            runtime.num_processes=8 runtime.process_id=0
+    """
+
+    coordinator_address: Optional[str] = Field(None)
+    num_processes: int = Field(-1)
+    process_id: int = Field(-1)
+    enabled: bool = Field(True)
+
+    def initialize(self) -> None:
+        if not self.enabled:
+            return
+        initialize_distributed(
+            coordinator_address=self.coordinator_address,
+            num_processes=None if self.num_processes < 0 else self.num_processes,
+            process_id=None if self.process_id < 0 else self.process_id,
+        )

@@ -20,6 +20,7 @@ from typing import Any, Dict, List, Optional
 from zookeeper_tpu.core import ComponentField, Field, component, pretty_print
 from zookeeper_tpu.data.pipeline import DataLoader
 from zookeeper_tpu.models.base import Model
+from zookeeper_tpu.parallel.distributed import DistributedRuntime
 from zookeeper_tpu.parallel.partitioner import Partitioner, SingleDevicePartitioner
 from zookeeper_tpu.training.checkpoint import Checkpointer
 from zookeeper_tpu.training.optimizer import Adam, Optimizer
@@ -49,6 +50,7 @@ class TrainingExperiment(Experiment):
     optimizer: Optimizer = ComponentField(Adam)
     partitioner: Partitioner = ComponentField(SingleDevicePartitioner)
     checkpointer: Checkpointer = ComponentField(Checkpointer)
+    runtime: DistributedRuntime = ComponentField(DistributedRuntime)
 
     epochs: int = Field(1)
     batch_size: int = Field(32)
@@ -98,6 +100,7 @@ class TrainingExperiment(Experiment):
         import numpy as np
 
         self._log(pretty_print(self))
+        self.runtime.initialize()  # Multi-host bootstrap; no-op single host.
         partitioner = self.partitioner
         partitioner.setup()
         state = partitioner.shard_state(self.build_state())
