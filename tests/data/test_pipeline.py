@@ -110,6 +110,39 @@ def test_preprocessing_scaling_and_augment_determinism():
     assert out1[0]["target"].dtype == np.int32
 
 
+def test_augmentation_varies_per_epoch():
+    """Same example must get a DIFFERENT (but deterministic) augmentation
+    each epoch — seeding from index alone would repeat the identical crop
+    every epoch and silently shrink augmentation diversity."""
+    pre = ImageClassificationPreprocessing()
+    configure(
+        pre,
+        {"height": 6, "width": 6, "channels": 1, "augment": True, "pad_pixels": 2},
+        name="pre",
+    )
+    rng = np.random.default_rng(3)
+    src = ArraySource(
+        {
+            "image": rng.integers(0, 255, (8, 6, 6, 1), dtype=np.uint8),
+            "label": np.zeros(8, np.int64),
+        }
+    )
+
+    def epoch_inputs(epoch):
+        return np.concatenate(
+            [
+                b["input"]
+                for b in batch_iterator(
+                    src, pre, 4, training=True, shuffle=False, epoch=epoch
+                )
+            ]
+        )
+
+    e0, e0_again, e1 = epoch_inputs(0), epoch_inputs(0), epoch_inputs(1)
+    np.testing.assert_array_equal(e0, e0_again)  # deterministic per epoch
+    assert not np.array_equal(e0, e1)  # varies across epochs
+
+
 def test_prefetch_to_device_yields_device_arrays():
     import jax
 

@@ -113,6 +113,7 @@ def batch_iterator(
         idx = int(order[global_index])
         example = dict(source[idx])
         example.setdefault("_index", np.int64(idx))
+        example.setdefault("_epoch", np.int64(epoch))
         if preprocessing is not None:
             example = preprocessing(example, training)
         return example

@@ -113,8 +113,14 @@ class ImageClassificationPreprocessing(Preprocessing):
         if image.ndim == 2:
             image = image[..., None]
         if training and self.augment:
-            seed = int(example.get("_index", 0)) * 2654435761 % (2**31)
-            rng = np.random.default_rng(seed)
+            # Seed from (example index, epoch): deterministic/resumable AND
+            # varying per epoch — the same crop every epoch would silently
+            # shrink augmentation diversity.
+            rng = np.random.default_rng(
+                np.random.SeedSequence(
+                    [int(example.get("_index", 0)), int(example.get("_epoch", 0))]
+                )
+            )
             image = self._augment(image, rng)
         if image.shape[:2] != (self.height, self.width):
             image = _center_crop_or_pad(image, self.height, self.width)

@@ -1,12 +1,16 @@
 """ctypes bindings for the native host kernels (see src/zk_native.cpp).
 
-Loads a prebuilt ``libzk_native.so`` next to this file, or builds it on
-first use with g++ (cached). Every entry point has a numpy fallback so the
-framework works on machines without a toolchain — the native path is a
-host-throughput optimization, never a requirement.
+Builds ``libzk_native-<srchash>.so`` on first use with g++ (cached by
+content hash: the binary filename embeds a hash of the source, so a stale
+or mismatched binary can never be picked up — git does not preserve mtimes,
+making mtime staleness checks unreliable after a clone). Every entry point
+has a numpy fallback so the framework works on machines without a
+toolchain — the native path is a host-throughput optimization, never a
+requirement. No prebuilt binary ships in the repo.
 """
 
 import ctypes
+import hashlib
 import os
 import subprocess
 import threading
@@ -16,24 +20,60 @@ import numpy as np
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_HERE, "src", "zk_native.cpp")
-_LIB = os.path.join(_HERE, "libzk_native.so")
 
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
 _tried = False
 
 
-def _build() -> bool:
+def _build_dirs():
+    """Candidate directories for the built binary: package dir first (warm
+    for every user of the checkout), then a per-user cache (covers
+    read-only site-packages installs)."""
+    yield _HERE
+    cache = os.environ.get("XDG_CACHE_HOME") or os.path.join(
+        os.path.expanduser("~"), ".cache"
+    )
+    yield os.path.join(cache, "zookeeper_tpu")
+
+
+def _src_digest() -> str:
+    with open(_SRC, "rb") as f:
+        return hashlib.sha256(f.read()).hexdigest()[:12]
+
+
+def _build(lib_path: str) -> bool:
+    # Unique temp per builder: concurrent processes must not interleave
+    # writes into one file (os.replace then promotes only complete builds).
+    tmp = f"{lib_path}.{os.getpid()}.tmp"
     cmd = [
         "g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread",
-        _SRC, "-o", _LIB,
+        _SRC, "-o", tmp,
     ]
     try:
+        os.makedirs(os.path.dirname(lib_path), exist_ok=True)
         subprocess.run(
             cmd, check=True, capture_output=True, timeout=120
         )
+        os.replace(tmp, lib_path)
+        # GC binaries for older source revisions (hash-named, never reused).
+        base = os.path.basename(lib_path)
+        for f in os.listdir(os.path.dirname(lib_path)):
+            if (
+                f.startswith("libzk_native-")
+                and f.endswith(".so")
+                and f != base
+            ):
+                try:
+                    os.unlink(os.path.join(os.path.dirname(lib_path), f))
+                except OSError:
+                    pass
         return True
     except (OSError, subprocess.SubprocessError):
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
         return False
 
 
@@ -43,12 +83,32 @@ def _load() -> Optional[ctypes.CDLL]:
         if _lib is not None or _tried:
             return _lib
         _tried = True
-        if not os.path.exists(_LIB) or os.path.getmtime(_LIB) < os.path.getmtime(_SRC):
-            if not _build():
-                return None
         try:
-            lib = ctypes.CDLL(_LIB)
+            digest = _src_digest()
         except OSError:
+            return None
+        lib = None
+        for d in _build_dirs():
+            lib_path = os.path.join(d, f"libzk_native-{digest}.so")
+            if not os.path.exists(lib_path):
+                if not _build(lib_path):
+                    continue
+            try:
+                lib = ctypes.CDLL(lib_path)
+                break
+            except OSError:
+                # Corrupt or wrong-arch binary: rebuild once, else move on.
+                try:
+                    os.unlink(lib_path)
+                except OSError:
+                    continue
+                if _build(lib_path):
+                    try:
+                        lib = ctypes.CDLL(lib_path)
+                        break
+                    except OSError:
+                        continue
+        if lib is None:
             return None
         lib.zk_pack_bits_f32.argtypes = [
             ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_int32),

@@ -107,7 +107,10 @@ def _device_mesh(
     try:
         from jax.experimental import mesh_utils
 
-        dev_array = mesh_utils.create_device_mesh(sizes)
+        # Pass the (possibly subset) device list explicitly: without it,
+        # create_device_mesh sizes itself against the full host and always
+        # fails for subsets, losing ICI-topology-aware placement.
+        dev_array = mesh_utils.create_device_mesh(sizes, devices=list(devices))
     except (ValueError, NotImplementedError) as e:
         # Only the no-known-good-assignment case falls back; anything else
         # should surface. The naive order loses ICI-topology awareness, so
