@@ -1,0 +1,312 @@
+"""Streaming disk-backed store: writer/reader round-trip, scale behavior,
+dataset integration, and the grain-protocol adapter."""
+
+import json
+import os
+import sys
+import types
+
+import numpy as np
+import pytest
+
+from zookeeper_tpu.core import configure
+from zookeeper_tpu.data import (
+    ArrayDataset,
+    MemmapDataset,
+    MemmapSource,
+    MemmapWriter,
+    TFDSDataset,
+    WrappedSource,
+    wrap_source,
+    write_store,
+)
+from zookeeper_tpu.data.source import DataSource
+
+
+def _write_split(directory, n, *, h=8, w=8, c=1, num_classes=5, chunk=64, seed=0):
+    """Stream a synthetic split to disk chunk-by-chunk (never materializes
+    the whole split in memory)."""
+    rng = np.random.default_rng(seed)
+    with MemmapWriter(directory) as writer:
+        done = 0
+        while done < n:
+            m = min(chunk, n - done)
+            writer.append(
+                {
+                    "image": rng.integers(0, 255, (m, h, w, c), dtype=np.uint8),
+                    "label": rng.integers(0, num_classes, (m,), dtype=np.int32),
+                }
+            )
+            done += m
+
+
+def test_writer_reader_round_trip(tmp_path):
+    d = str(tmp_path / "store")
+    rng = np.random.default_rng(1)
+    images = rng.integers(0, 255, (40, 4, 4, 3), dtype=np.uint8)
+    labels = rng.integers(0, 10, (40,), dtype=np.int64)
+    with MemmapWriter(d) as w:
+        w.append({"image": images[:15], "label": labels[:15]})
+        w.append({"image": images[15:], "label": labels[15:]})
+    src = MemmapSource(d)
+    assert len(src) == 40
+    for i in (0, 14, 15, 39, -1):
+        ex = src[i]
+        np.testing.assert_array_equal(ex["image"], images[i])
+        assert ex["label"] == labels[i]
+    # Examples are copies, not memmap views.
+    assert type(src[0]["image"]) is np.ndarray
+
+
+def test_writer_rejects_inconsistent_chunks(tmp_path):
+    w = MemmapWriter(str(tmp_path / "s"))
+    w.append({"x": np.zeros((2, 3), np.float32)})
+    with pytest.raises(ValueError, match="features"):
+        w.append({"y": np.zeros((2, 3), np.float32)})
+    with pytest.raises(ValueError, match="store is"):
+        w.append({"x": np.zeros((2, 4), np.float32)})
+    # unequal lengths across features
+    w2 = MemmapWriter(str(tmp_path / "s2"))
+    with pytest.raises(ValueError, match="unequal"):
+        w2.append(
+            {"a": np.zeros((2, 1), np.float32), "b": np.zeros((3,), np.int32)}
+        )
+
+
+def test_reader_requires_closed_store(tmp_path):
+    d = str(tmp_path / "unclosed")
+    w = MemmapWriter(d)
+    w.append({"x": np.zeros((2, 3), np.float32)})
+    with pytest.raises(FileNotFoundError, match="meta"):
+        MemmapSource(d)  # no meta.json until close()
+    w.close()
+    assert len(MemmapSource(d)) == 2
+
+
+def test_reader_detects_truncated_file(tmp_path):
+    d = str(tmp_path / "trunc")
+    write_store(d, {"x": np.arange(64, dtype=np.float32).reshape(8, 8)})
+    with open(os.path.join(d, "x.bin"), "r+b") as f:
+        f.truncate(100)
+    with pytest.raises(ValueError, match="bytes"):
+        MemmapSource(d)
+
+
+def test_store_streams_without_full_materialization(tmp_path):
+    """A store 10x bigger than any single chunk round-trips by random
+    access; only touched pages are read."""
+    d = str(tmp_path / "big")
+    _write_split(d, 2560, chunk=128)  # 20 chunks
+    src = MemmapSource(d)
+    assert len(src) == 2560
+    # Spot-check determinism against a fresh regeneration of chunk 0.
+    rng = np.random.default_rng(0)
+    first_images = rng.integers(0, 255, (128, 8, 8, 1), dtype=np.uint8)
+    np.testing.assert_array_equal(src[17]["image"], first_images[17])
+
+
+def test_memmap_dataset_trains_end_to_end(tmp_path):
+    """The VERDICT round-1 acceptance: a disk-backed dataset with many
+    batches drives the full TrainingExperiment loop (loss finite, steps
+    taken), with num_classes inferred from the label file."""
+    from zookeeper_tpu.training import TrainingExperiment
+
+    root = str(tmp_path / "ds")
+    _write_split(os.path.join(root, "train"), 640, num_classes=5, seed=0)
+    _write_split(os.path.join(root, "validation"), 128, num_classes=5, seed=1)
+
+    exp = TrainingExperiment()
+    configure(
+        exp,
+        {
+            "loader.dataset": "MemmapDataset",
+            "loader.dataset.directory": root,
+            "loader.preprocessing": "ImageClassificationPreprocessing",
+            "loader.preprocessing.height": 8,
+            "loader.preprocessing.width": 8,
+            "loader.preprocessing.channels": 1,
+            "loader.host_index": 0,
+            "loader.host_count": 1,
+            "model": "Mlp",
+            "model.hidden_units": (16,),
+            "batch_size": 64,
+            "epochs": 1,
+            "verbose": False,
+        },
+        name="experiment",
+    )
+    assert exp.num_classes == 5  # inferred by label scan
+    history = exp.run()
+    assert len(history["train"]) == 1
+    assert np.isfinite(history["train"][0]["loss"])
+    assert len(history["validation"]) == 1
+
+
+def test_array_dataset_infers_num_classes():
+    ds = ArrayDataset()
+    configure(ds, {}, name="dataset")
+    ds.with_data(
+        {
+            "image": np.zeros((10, 2, 2, 1), np.uint8),
+            "label": np.array([0, 1, 2, 3, 3, 2, 1, 0, 3, 2], np.int64),
+        }
+    )
+    assert ds.resolved_num_classes() == 4
+
+
+def test_memmap_dataset_explicit_num_classes_wins(tmp_path):
+    root = str(tmp_path / "ds")
+    _write_split(os.path.join(root, "train"), 64, num_classes=3)
+    ds = MemmapDataset()
+    configure(ds, {"directory": root, "num_classes": 11}, name="dataset")
+    assert ds.resolved_num_classes() == 11
+
+
+def test_wrap_source_adapts_grain_protocol():
+    """Anything with __len__/__getitem__ (grain's RandomAccessDataSource
+    protocol) plugs into the pipeline."""
+
+    class FakeGrainSource:  # deliberately NOT a DataSource subclass
+        def __len__(self):
+            return 4
+
+        def __getitem__(self, i):
+            return {"image": np.full((2, 2), i), "label": i % 2}
+
+    src = wrap_source(FakeGrainSource())
+    assert isinstance(src, WrappedSource)
+    assert len(src) == 4
+    np.testing.assert_array_equal(src[2]["image"], np.full((2, 2), 2))
+    # Non-dict values land under feature_name.
+    class Scalars:
+        def __len__(self):
+            return 3
+
+        def __getitem__(self, i):
+            return np.float32(i)
+
+    s2 = wrap_source(Scalars(), feature_name="x")
+    assert s2[1]["x"] == 1.0
+    # Pass-through for existing DataSources.
+    assert wrap_source(src) is src
+
+
+# -- TFDS path (mocked: tfds is not installed in this environment) ----------
+
+
+class _FakeTfdsArraySource:
+    """Mimics tfds.data_source(): random access, decode on demand."""
+
+    def __init__(self, n, num_classes):
+        self.n = n
+        self.num_classes = num_classes
+        self.accesses = []
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, i):
+        self.accesses.append(i)
+        rng = np.random.default_rng(i)
+        return {
+            "image": rng.integers(0, 255, (8, 8, 1), dtype=np.uint8),
+            "label": np.int64(i % self.num_classes),
+        }
+
+
+def _install_fake_tfds(monkeypatch, n=256, num_classes=5):
+    sources = {}
+
+    def data_source(name, split, data_dir=None):
+        key = (name, split)
+        if key not in sources:
+            sources[key] = _FakeTfdsArraySource(n, num_classes)
+        return sources[key]
+
+    class _Label:
+        pass
+
+    label = _Label()
+    label.num_classes = num_classes
+
+    class _Info:
+        features = {"label": label}
+        splits = {
+            "train": types.SimpleNamespace(num_examples=n),
+            "validation": types.SimpleNamespace(num_examples=n // 4),
+        }
+
+    def builder(name, data_dir=None):
+        return types.SimpleNamespace(info=_Info())
+
+    fake = types.ModuleType("tensorflow_datasets")
+    fake.data_source = data_source
+    fake.builder = builder
+    monkeypatch.setitem(sys.modules, "tensorflow_datasets", fake)
+    return sources
+
+
+def test_tfds_dataset_streams_and_reaches_train_loop(monkeypatch, tmp_path):
+    """TFDSDataset configured end-to-end: never materializes the split
+    (access pattern stays per-example) and drives the training loop."""
+    from zookeeper_tpu.training import TrainingExperiment
+
+    sources = _install_fake_tfds(monkeypatch, n=256, num_classes=5)
+    exp = TrainingExperiment()
+    configure(
+        exp,
+        {
+            "loader.dataset": "TFDSDataset",
+            "loader.dataset.name": "fake_ds",
+            "loader.preprocessing": "ImageClassificationPreprocessing",
+            "loader.preprocessing.height": 8,
+            "loader.preprocessing.width": 8,
+            "loader.preprocessing.channels": 1,
+            "loader.host_index": 0,
+            "loader.host_count": 1,
+            "model": "Mlp",
+            "model.hidden_units": (16,),
+            "batch_size": 32,
+            "epochs": 1,
+            "validate": False,
+            "verbose": False,
+        },
+        name="experiment",
+    )
+    assert exp.num_classes == 5  # from builder metadata, not a label scan
+    history = exp.run()
+    assert len(history["train"]) == 1
+    assert np.isfinite(history["train"][0]["loss"])
+    src = sources[("fake_ds", "train")]
+    # Streaming contract: each example fetched on demand, exactly once.
+    assert len(src.accesses) == 256
+    assert sorted(src.accesses) == list(range(256))
+
+
+def test_tfds_num_examples_from_builder(monkeypatch):
+    _install_fake_tfds(monkeypatch, n=256)
+    ds = TFDSDataset()
+    configure(
+        ds,
+        {"name": "fake_ds", "validation_split": "validation"},
+        name="dataset",
+    )
+    assert ds.num_examples("train") == 256
+    assert ds.num_examples("validation") == 64
+
+
+def test_tfds_missing_import_error_is_actionable(monkeypatch):
+    monkeypatch.setitem(sys.modules, "tensorflow_datasets", None)
+    ds = TFDSDataset()
+    configure(ds, {"name": "mnist"}, name="dataset")
+    with pytest.raises(ImportError, match="MemmapDataset"):
+        ds.train()
+
+
+def test_meta_json_is_human_readable(tmp_path):
+    d = str(tmp_path / "s")
+    write_store(d, {"x": np.zeros((3, 2), np.float32)})
+    with open(os.path.join(d, "meta.json")) as f:
+        meta = json.load(f)
+    assert meta["num_examples"] == 3
+    assert meta["features"]["x"] == {"dtype": "float32", "shape": [2]}

@@ -1,3 +1,5 @@
+import shutil
+
 import numpy as np
 import pytest
 
@@ -5,7 +7,8 @@ from zookeeper_tpu import native
 
 
 def test_native_builds_and_loads():
-    # g++ is available in this environment; the lib must build.
+    if shutil.which("g++") is None:
+        pytest.skip("no toolchain (numpy-fallback CI leg)")
     assert native.available()
 
 

@@ -17,9 +17,17 @@ from zookeeper_tpu.data.source import (
     MappedSource,
     SliceSource,
 )
+from zookeeper_tpu.data.store import (
+    MemmapSource,
+    MemmapWriter,
+    WrappedSource,
+    wrap_source,
+    write_store,
+)
 from zookeeper_tpu.data.dataset import (
     ArrayDataset,
     Dataset,
+    MemmapDataset,
     MultiTFDSDataset,
     SyntheticCifar10,
     SyntheticImageNet,
@@ -47,6 +55,9 @@ __all__ = [
     "Dataset",
     "ImageClassificationPreprocessing",
     "MappedSource",
+    "MemmapDataset",
+    "MemmapSource",
+    "MemmapWriter",
     "MultiTFDSDataset",
     "PassThroughPreprocessing",
     "Preprocessing",
@@ -56,6 +67,9 @@ __all__ = [
     "SyntheticImageClassification",
     "SyntheticMnist",
     "TFDSDataset",
+    "WrappedSource",
     "batch_iterator",
     "prefetch_to_device",
+    "wrap_source",
+    "write_store",
 ]

@@ -23,6 +23,7 @@ import numpy as np
 
 from zookeeper_tpu.core import Field, component
 from zookeeper_tpu.data.source import ArraySource, ConcatSource, DataSource
+from zookeeper_tpu.data.store import MemmapSource
 
 
 @component
@@ -31,6 +32,12 @@ class Dataset:
 
     Subclasses implement ``train()`` and (optionally) ``validation()``
     returning a :class:`DataSource`, and may override ``num_examples``.
+
+    Class-count contract: consumers (``TrainingExperiment``) call
+    :meth:`resolved_num_classes`, which prefers a ``num_classes`` field
+    when the subclass declares one (>0) and otherwise falls back to
+    :meth:`infer_num_classes` — so every dataset type works, not just the
+    ones that happen to declare the field.
     """
 
     def train(self) -> DataSource:
@@ -48,6 +55,22 @@ class Dataset:
                 raise ValueError(f"Dataset has no '{split}' split.")
             return len(val)
         raise ValueError(f"Unknown split {split!r}.")
+
+    def resolved_num_classes(self) -> int:
+        try:
+            nc = self.num_classes  # type: ignore[attr-defined]
+        except AttributeError:
+            nc = None
+        if isinstance(nc, int) and nc > 0:
+            return nc
+        return int(self.infer_num_classes())
+
+    def infer_num_classes(self) -> int:
+        raise ValueError(
+            f"{type(self).__name__} cannot infer its class count; set "
+            "`num_classes` on the experiment (e.g. `num_classes=1000`) or "
+            "on the dataset."
+        )
 
 
 @component
@@ -77,6 +100,40 @@ class ArrayDataset(Dataset):
         if self._validation_arrays is None:
             return None
         return ArraySource(self._validation_arrays)
+
+    def infer_num_classes(self) -> int:
+        if self._train_arrays is not None:
+            return _labels_to_num_classes(self._train_arrays, "ArrayDataset")
+        return super().infer_num_classes()
+
+
+def _labels_to_num_classes(arrays: Dict[str, np.ndarray], what: str) -> int:
+    """Infer class count as max(label)+1 from an integer 'label' feature.
+
+    Fallback when no 'label' key exists: the feature must be the ONLY
+    *scalar-per-example* integer feature (1-D over examples) — image-like
+    integer arrays (uint8 pixels) are never label candidates.
+    """
+    label = arrays.get("label")
+    if label is not None and not np.issubdtype(
+        np.asarray(label).dtype, np.integer
+    ):
+        label = None
+    if label is None:
+        candidates = {
+            k: v
+            for k, v in arrays.items()
+            if np.issubdtype(np.asarray(v).dtype, np.integer)
+            and np.asarray(v).ndim == 1
+        }
+        if len(candidates) == 1:
+            label = next(iter(candidates.values()))
+    if label is None:
+        raise ValueError(
+            f"{what} has no scalar integer 'label' feature to infer "
+            "num_classes from; set `num_classes` explicitly."
+        )
+    return int(np.max(label)) + 1
 
 
 def _synthetic_image_classification(
@@ -182,46 +239,74 @@ class SyntheticImageNet(SyntheticImageClassification):
     num_validation_examples: int = Field(256)
 
 
+@component
+class MemmapDataset(Dataset):
+    """Disk-backed streaming dataset over :class:`MemmapSource` stores.
+
+    ``directory`` holds one store sub-directory per split (``train/``,
+    ``validation/``). Examples are served by memory-mapped random access,
+    so the dataset can be arbitrarily larger than host RAM — this is the
+    framework's native answer to the reference's tf.data file formats
+    (SURVEY.md §2.2/§7 "input pipeline at pod scale"). Build stores with
+    :class:`zookeeper_tpu.data.store.MemmapWriter` (streaming, chunked).
+    """
+
+    directory: str = Field(allow_missing=True)
+    train_subdir: str = Field("train")
+    validation_subdir: str = Field("validation")
+    #: -1 = infer by scanning the (small) label feature once.
+    num_classes: int = Field(-1)
+
+    def _split_dir(self, subdir: str) -> str:
+        import os
+
+        return os.path.join(self.directory, subdir)
+
+    def train(self) -> DataSource:
+        return MemmapSource(self._split_dir(self.train_subdir))
+
+    def validation(self) -> Optional[DataSource]:
+        import os
+
+        path = self._split_dir(self.validation_subdir)
+        if not os.path.isdir(path):
+            return None
+        return MemmapSource(path)
+
+    def infer_num_classes(self) -> int:
+        return _labels_to_num_classes(self.train().features, "MemmapDataset")
+
+
 def _require_tfds():
     try:
         import tensorflow_datasets as tfds  # type: ignore
 
         return tfds
-    except ImportError as e:  # pragma: no cover - environment-dependent
+    except ImportError as e:
         raise ImportError(
             "tensorflow_datasets is not installed in this environment. "
-            "TFDSDataset/MultiTFDSDataset require it; use the Synthetic* "
-            "datasets or ArrayDataset instead."
+            "TFDSDataset/MultiTFDSDataset require it; use MemmapDataset "
+            "(streaming, any size), the Synthetic* datasets, or "
+            "ArrayDataset instead."
         ) from e
 
 
-class _TFDSSource(DataSource):  # pragma: no cover - requires tfds
-    """Random-access adapter over a TFDS builder split using
-    ``tfds.data_source`` (ArrayRecord random access) when available, falling
-    back to eager materialization for small datasets."""
+class _TFDSSource(DataSource):
+    """Random-access adapter over a TFDS split via ``tfds.data_source``
+    (ArrayRecord-backed random access). Never materializes the split:
+    examples are decoded on demand, so ImageNet-scale datasets stream from
+    disk with O(1) resident memory (the VERDICT round-1 fix: the old
+    fallback did ``list(tfds.as_numpy(ds))``, impossible at scale)."""
 
     def __init__(self, name: str, split: str, data_dir: Optional[str]):
         tfds = _require_tfds()
-        try:
-            self._source = tfds.data_source(name, split=split, data_dir=data_dir)
-            self._materialized = None
-        except Exception:
-            builder = tfds.builder(name, data_dir=data_dir)
-            builder.download_and_prepare()
-            ds = builder.as_dataset(split=split)
-            self._materialized = list(tfds.as_numpy(ds))
-            self._source = None
+        self._source = tfds.data_source(name, split=split, data_dir=data_dir)
 
     def __len__(self) -> int:
-        if self._materialized is not None:
-            return len(self._materialized)
         return len(self._source)
 
     def __getitem__(self, index: int):
-        if self._materialized is not None:
-            ex = self._materialized[index]
-        else:
-            ex = self._source[index]
+        ex = self._source[index]
         return {k: np.asarray(v) for k, v in ex.items()}
 
 
@@ -235,21 +320,23 @@ class TFDSDataset(Dataset):
     train_split: str = Field("train")
     validation_split: str = Field(allow_missing=True)
     data_dir: Optional[str] = Field(None)
+    #: -1 = read from the TFDS builder's feature metadata.
+    num_classes: int = Field(-1)
 
     def load(self, split: str) -> DataSource:
-        return _TFDSSource(self.name, split, self.data_dir)  # pragma: no cover
+        return _TFDSSource(self.name, split, self.data_dir)
 
     def train(self) -> DataSource:
-        return self.load(self.train_split)  # pragma: no cover
+        return self.load(self.train_split)
 
-    def validation(self) -> Optional[DataSource]:  # pragma: no cover
+    def validation(self) -> Optional[DataSource]:
         try:
             split = self.validation_split
         except AttributeError:
             return None
         return self.load(split)
 
-    def num_examples(self, split: str) -> int:  # pragma: no cover
+    def num_examples(self, split: str) -> int:
         tfds = _require_tfds()
         builder = tfds.builder(self.name, data_dir=self.data_dir)
         actual = {"train": self.train_split}.get(split, split)
@@ -259,6 +346,14 @@ class TFDSDataset(Dataset):
             except AttributeError:
                 pass
         return builder.info.splits[actual].num_examples
+
+    def infer_num_classes(self) -> int:
+        tfds = _require_tfds()
+        info = tfds.builder(self.name, data_dir=self.data_dir).info
+        label = info.features.get("label") if info.features else None
+        if label is None or not hasattr(label, "num_classes"):
+            return super().infer_num_classes()
+        return int(label.num_classes)
 
 
 @component
@@ -270,16 +365,17 @@ class MultiTFDSDataset(Dataset):
     train_split: str = Field("train")
     validation_split: str = Field(allow_missing=True)
     data_dir: Optional[str] = Field(None)
+    num_classes: int = Field(-1)
 
-    def _load_all(self, split: str) -> DataSource:  # pragma: no cover
+    def _load_all(self, split: str) -> DataSource:
         return ConcatSource(
             [_TFDSSource(name, split, self.data_dir) for name in self.names]
         )
 
     def train(self) -> DataSource:
-        return self._load_all(self.train_split)  # pragma: no cover
+        return self._load_all(self.train_split)
 
-    def validation(self) -> Optional[DataSource]:  # pragma: no cover
+    def validation(self) -> Optional[DataSource]:
         try:
             split = self.validation_split
         except AttributeError:

@@ -241,6 +241,21 @@ class DataLoader:
     host_index: int = Field(-1)  # -1 => jax.process_index()
     host_count: int = Field(-1)  # -1 => jax.process_count()
 
+    def _source(self, split: str) -> Optional[DataSource]:
+        """The split's DataSource, cached for the loader's lifetime: a
+        source may be expensive to materialize (synthetic generation, store
+        open, TFDS index), and rebuilding it every epoch / every
+        steps_per_epoch call is wasted host time at scale."""
+        cache = getattr(self, "_source_cache", None)
+        if cache is None:
+            cache = {}
+            object.__setattr__(self, "_source_cache", cache)
+        if split not in cache:
+            cache[split] = (
+                self.dataset.train() if split == "train" else self.dataset.validation()
+            )
+        return cache[split]
+
     def _hosts(self):
         hi, hc = self.host_index, self.host_count
         if hi < 0 or hc < 0:
@@ -268,7 +283,7 @@ class DataLoader:
         sharding: Optional[Any] = None,
     ) -> Iterator[Any]:
         training = split == "train"
-        source = self.dataset.train() if training else self.dataset.validation()
+        source = self._source(split)
         if source is None:
             raise ValueError(f"Dataset has no '{split}' split.")
         hi, hc = self._hosts()
@@ -290,9 +305,7 @@ class DataLoader:
         return it
 
     def steps_per_epoch(self, split: str = "train") -> int:
-        source = (
-            self.dataset.train() if split == "train" else self.dataset.validation()
-        )
+        source = self._source(split)
         if source is None:
             raise ValueError(f"Dataset has no '{split}' split.")
         return len(source) // self.batch_size

@@ -1,0 +1,220 @@
+"""Disk-backed streaming example store.
+
+The piece the reference delegates to tf.data's file formats (SURVEY.md
+§2.2: ``TFDSDataset.load`` wraps ``tfds.load``; §7 "input pipeline at pod
+scale"): a dataset LARGER THAN HOST RAM must still serve random-access
+examples, because the pipeline's determinism contract (global permutation,
+per-host contiguous slices, exact resume) is built on random access.
+
+Format: one flat binary file per feature (C-order fixed-shape records)
+plus a ``meta.json`` index::
+
+    store_dir/
+      meta.json           # {"num_examples": N, "features": {name: {dtype, shape}}}
+      image.bin           # N * prod(shape) * itemsize bytes
+      label.bin
+
+Readers ``np.memmap`` each feature file, so the OS page cache — not
+Python — decides what stays resident: examples are fetched on demand and
+a store 10x RAM streams fine. Writers append chunk-by-chunk, so the
+dataset never needs to exist in memory at once either.
+
+Interop: :class:`MemmapSource` satisfies grain's ``RandomAccessDataSource``
+protocol (``__len__`` + ``__getitem__``), and :func:`wrap_source` adapts
+any such random-access object (e.g. ``grain.python.ArrayRecordDataSource``)
+into the pipeline. No grain import is required — the protocol is duck-typed.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Callable, Dict, Mapping, Optional
+
+import numpy as np
+
+from zookeeper_tpu.data.source import DataSource, Example
+
+_META = "meta.json"
+
+
+class MemmapWriter:
+    """Streaming chunked writer for a :class:`MemmapSource` store.
+
+    Usage::
+
+        with MemmapWriter("/data/train") as w:
+            for chunk in produce_chunks():           # dict[str, np.ndarray]
+                w.append(chunk)                      # any chunk size
+        src = MemmapSource("/data/train")
+
+    Feature dtypes/shapes are fixed by the first appended chunk; the meta
+    index is written on ``close()`` (so a crashed writer leaves no
+    readable-but-truncated store: readers require ``meta.json``).
+    """
+
+    def __init__(self, directory: str):
+        self.directory = directory
+        os.makedirs(directory, exist_ok=True)
+        self._files: Dict[str, Any] = {}
+        self._specs: Dict[str, Dict[str, Any]] = {}
+        self._count = 0
+        self._closed = False
+
+    def append(self, chunk: Mapping[str, np.ndarray]) -> None:
+        if self._closed:
+            raise ValueError("Writer already closed.")
+        arrays = {k: np.ascontiguousarray(v) for k, v in chunk.items()}
+        ns = {k: len(v) for k, v in arrays.items()}
+        if len(set(ns.values())) != 1:
+            raise ValueError(f"Chunk features have unequal lengths: {ns}.")
+        n = next(iter(ns.values()))
+        if not self._specs:
+            for k, v in arrays.items():
+                self._specs[k] = {
+                    "dtype": str(v.dtype),
+                    "shape": list(v.shape[1:]),
+                }
+                self._files[k] = open(
+                    os.path.join(self.directory, f"{k}.bin"), "wb"
+                )
+        if set(arrays) != set(self._specs):
+            raise ValueError(
+                f"Chunk features {sorted(arrays)} != store features "
+                f"{sorted(self._specs)}."
+            )
+        for k, v in arrays.items():
+            spec = self._specs[k]
+            if str(v.dtype) != spec["dtype"] or list(v.shape[1:]) != spec["shape"]:
+                raise ValueError(
+                    f"Feature {k!r}: chunk is {v.dtype}{list(v.shape[1:])}, "
+                    f"store is {spec['dtype']}{spec['shape']}."
+                )
+            self._files[k].write(v.tobytes())
+        self._count += n
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        for f in self._files.values():
+            f.close()
+        meta = {"num_examples": self._count, "features": self._specs}
+        tmp = os.path.join(self.directory, f"{_META}.{os.getpid()}.tmp")
+        with open(tmp, "w") as f:
+            json.dump(meta, f, indent=1)
+        os.replace(tmp, os.path.join(self.directory, _META))
+
+    def __enter__(self) -> "MemmapWriter":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        if exc[0] is None:
+            self.close()
+        else:
+            # Close file handles but DON'T write meta.json: a store from a
+            # failed writer must stay unreadable (no-truncated-store
+            # contract), not leak fds.
+            self._closed = True
+            for f in self._files.values():
+                f.close()
+
+
+def write_store(directory: str, arrays: Mapping[str, np.ndarray]) -> None:
+    """Write in-memory arrays as a store in one shot (small-data helper)."""
+    with MemmapWriter(directory) as w:
+        w.append(arrays)
+
+
+class MemmapSource(DataSource):
+    """Random-access source over a :class:`MemmapWriter` store directory.
+
+    Feature files are memory-mapped read-only; an example fetch touches
+    only its own pages. Safe to share across threads and to reopen cheaply
+    in forked worker processes (the mapping, not the data, is copied).
+    """
+
+    def __init__(self, directory: str):
+        meta_path = os.path.join(directory, _META)
+        if not os.path.exists(meta_path):
+            raise FileNotFoundError(
+                f"No store at {directory!r} (missing {_META}; was the "
+                "writer closed?)."
+            )
+        with open(meta_path) as f:
+            meta = json.load(f)
+        self.directory = directory
+        self._length = int(meta["num_examples"])
+        self._maps: Dict[str, np.memmap] = {}
+        for name, spec in meta["features"].items():
+            shape = (self._length, *spec["shape"])
+            path = os.path.join(directory, f"{name}.bin")
+            expected = int(np.prod(shape)) * np.dtype(spec["dtype"]).itemsize
+            actual = os.path.getsize(path)
+            if actual != expected:
+                raise ValueError(
+                    f"Store {directory!r} feature {name!r}: file is "
+                    f"{actual} bytes, meta implies {expected}."
+                )
+            self._maps[name] = np.memmap(
+                path, dtype=spec["dtype"], mode="r", shape=shape
+            )
+
+    def __len__(self) -> int:
+        return self._length
+
+    @property
+    def features(self) -> Dict[str, np.memmap]:
+        """Read-only memmaps per feature (whole-column access, e.g. a
+        label scan, without pulling examples one by one)."""
+        return dict(self._maps)
+
+    def __getitem__(self, index: int) -> Example:
+        if not -self._length <= index < self._length:
+            raise IndexError(index)
+        # np.asarray copies the record out of the map: examples handed to
+        # preprocessing are ordinary arrays, never views pinning pages.
+        return {k: np.asarray(m[index]) for k, m in self._maps.items()}
+
+
+class WrappedSource(DataSource):
+    """Adapts any random-access object (grain's ``RandomAccessDataSource``
+    protocol: ``__len__`` + ``__getitem__``) into a :class:`DataSource`.
+
+    ``transform`` converts the wrapped object's per-example value into the
+    flat ``dict[str, np.ndarray]`` example contract; by default, dict
+    values pass through and non-dict values land under ``feature_name``.
+    """
+
+    def __init__(
+        self,
+        wrapped: Any,
+        transform: Optional[Callable[[Any], Example]] = None,
+        feature_name: str = "value",
+    ):
+        self.wrapped = wrapped
+        self.transform = transform
+        self.feature_name = feature_name
+
+    def __len__(self) -> int:
+        return len(self.wrapped)
+
+    def __getitem__(self, index: int) -> Example:
+        value = self.wrapped[index]
+        if self.transform is not None:
+            return self.transform(value)
+        if isinstance(value, Mapping):
+            return {k: np.asarray(v) for k, v in value.items()}
+        return {self.feature_name: np.asarray(value)}
+
+
+def wrap_source(
+    obj: Any,
+    transform: Optional[Callable[[Any], Example]] = None,
+    feature_name: str = "value",
+) -> DataSource:
+    """Return ``obj`` as a :class:`DataSource` (pass-through if it already
+    is one)."""
+    if isinstance(obj, DataSource):
+        return obj
+    return WrappedSource(obj, transform, feature_name)

@@ -67,7 +67,9 @@ class TrainingExperiment(Experiment):
 
     @Field
     def num_classes(self) -> int:
-        return int(self.loader.dataset.num_classes)
+        # Works for every dataset type: prefers a declared num_classes
+        # field, else the dataset infers (TFDS metadata / label scan).
+        return int(self.loader.dataset.resolved_num_classes())
 
     def _log(self, msg: str) -> None:
         if self.verbose:
