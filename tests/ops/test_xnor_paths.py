@@ -1,0 +1,396 @@
+"""Pallas packed binary paths: K-tiled kernels, conv wiring, packed
+inference, and the loud-fallback contract.
+
+All Pallas calls run in interpreter mode (CPU test suite); the bench
+exercises the compiled kernels on real TPU hardware.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from zookeeper_tpu.ops import (
+    QuantConv,
+    magnitude_aware_sign,
+    pack_conv_kernel,
+    pack_quantconv_params,
+    packed_conv_infer,
+    packed_weight_matmul,
+    xnor_conv,
+    xnor_matmul,
+)
+
+
+def random_signs(shape, seed=0):
+    rng = np.random.default_rng(seed)
+    return jnp.asarray(rng.choice([-1.0, 1.0], size=shape), jnp.float32)
+
+
+def float_conv(x, k, strides=(1, 1), padding="SAME"):
+    return jax.lax.conv_general_dilated(
+        x, k, strides, padding, dimension_numbers=("NHWC", "HWIO", "NHWC")
+    )
+
+
+# -- kernels ----------------------------------------------------------------
+
+
+def test_xnor_matmul_k_tiled_large_k():
+    """QuickNet's largest contraction (K=4608) through the K-tiled kernel:
+    the round-1 kernel kept full K per block and overflowed VMEM here."""
+    a = random_signs((32, 4608), seed=1)
+    b = random_signs((4608, 32), seed=2)
+    got = np.asarray(xnor_matmul(a, b, interpret=True, block_kw=16))
+    np.testing.assert_array_equal(got, np.asarray(a @ b))
+
+
+def test_xnor_matmul_k_tiling_is_exact_across_block_sizes():
+    a = random_signs((16, 256), seed=3)
+    b = random_signs((256, 16), seed=4)
+    expected = np.asarray(a @ b)
+    for block_kw in (1, 2, 8):
+        got = np.asarray(
+            xnor_matmul(a, b, interpret=True, block_kw=block_kw)
+        )
+        np.testing.assert_array_equal(got, expected)
+
+
+def test_packed_weight_matmul_matches_float_with_zeros():
+    """The MXU-unpack kernel: A may contain zeros (conv padding), only B
+    is packed — result exact vs the float GEMM."""
+    rng = np.random.default_rng(5)
+    a = jnp.asarray(rng.choice([-1.0, 0.0, 1.0], size=(48, 96)), jnp.float32)
+    b = random_signs((96, 40), seed=6)
+    from zookeeper_tpu.ops import pack_bits
+
+    bp = pack_bits(b, axis=0)
+    got = np.asarray(packed_weight_matmul(a, bp, interpret=True))
+    np.testing.assert_array_equal(got, np.asarray(a @ b).astype(np.int32))
+
+
+def test_packed_weight_matmul_k_tiled():
+    a = random_signs((8, 2048), seed=7)
+    b = random_signs((2048, 8), seed=8)
+    from zookeeper_tpu.ops import pack_bits
+
+    bp = pack_bits(b, axis=0)
+    got = np.asarray(
+        packed_weight_matmul(a, bp, interpret=True, block_kw=8)
+    )
+    np.testing.assert_array_equal(got, np.asarray(a @ b).astype(np.int32))
+
+
+# -- conv paths -------------------------------------------------------------
+
+
+@pytest.mark.parametrize("strides", [(1, 1), (2, 2)])
+@pytest.mark.parametrize("padding", ["SAME", "VALID"])
+def test_xnor_conv_bit_exact_vs_float(strides, padding):
+    x = random_signs((2, 9, 9, 40), seed=9)
+    k = random_signs((3, 3, 40, 8), seed=10)
+    expected = np.asarray(float_conv(x, k, strides, padding))
+    got = np.asarray(
+        xnor_conv(x, k, strides, padding, False, True)
+    )
+    np.testing.assert_array_equal(got, expected)
+
+
+def test_xnor_conv_popcount_valid_bit_exact():
+    x = random_signs((2, 8, 8, 64), seed=11)
+    k = random_signs((3, 3, 64, 8), seed=12)
+    expected = np.asarray(float_conv(x, k, (1, 1), "VALID"))
+    got = np.asarray(xnor_conv(x, k, (1, 1), "VALID", True, True))
+    np.testing.assert_array_equal(got, expected)
+
+
+def test_xnor_conv_popcount_same_uses_one_padding():
+    """Documented deviation: the bit-serial kernel one-pads SAME. Check
+    against a float conv on an explicitly +1-padded input."""
+    x = random_signs((1, 6, 6, 32), seed=13)
+    k = random_signs((3, 3, 32, 4), seed=14)
+    x_padded = jnp.pad(
+        x, ((0, 0), (1, 1), (1, 1), (0, 0)), constant_values=1.0
+    )
+    expected = np.asarray(float_conv(x_padded, k, (1, 1), "VALID"))
+    got = np.asarray(xnor_conv(x, k, (1, 1), "SAME", True, True))
+    np.testing.assert_array_equal(got, expected)
+
+
+def test_xnor_conv_magnitude_aware_scale():
+    """Kernel = sign x per-channel scale (Bi-Real-Net weight path) must be
+    handled exactly by the pack/scale split."""
+    rng = np.random.default_rng(15)
+    latent = jnp.asarray(rng.normal(size=(3, 3, 32, 8)), jnp.float32)
+    q = magnitude_aware_sign(latent)
+    x = random_signs((2, 6, 6, 32), seed=16)
+    expected = np.asarray(float_conv(x, q, (1, 1), "SAME"))
+    got = np.asarray(xnor_conv(x, q, (1, 1), "SAME", False, True))
+    # Not bit-identical to the float conv: the packed path computes the
+    # EXACT integer sum then scales once, while the float conv rounds
+    # per-element — the difference is float-associativity noise only.
+    np.testing.assert_allclose(got, expected, rtol=1e-5, atol=1e-5)
+
+
+def test_xnor_conv_gradients_match_float_conv():
+    x = random_signs((1, 6, 6, 32), seed=17)
+    k = random_signs((3, 3, 32, 4), seed=18)
+
+    def loss_xnor(x, k):
+        return (xnor_conv(x, k, (1, 1), "SAME", False, True) ** 2).sum()
+
+    def loss_float(x, k):
+        return (float_conv(x, k, (1, 1), "SAME") ** 2).sum()
+
+    gx1, gk1 = jax.grad(loss_xnor, argnums=(0, 1))(x, k)
+    gx2, gk2 = jax.grad(loss_float, argnums=(0, 1))(x, k)
+    np.testing.assert_allclose(np.asarray(gx1), np.asarray(gx2), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(gk1), np.asarray(gk2), rtol=1e-5)
+
+
+def test_packed_conv_infer_matches_training_forward():
+    x = random_signs((2, 7, 7, 32), seed=19)
+    k = random_signs((3, 3, 32, 8), seed=20)
+    packed, scale = pack_conv_kernel(k)
+    assert packed.shape == (3, 3, 1, 8)
+    y_train = np.asarray(xnor_conv(x, k, (1, 1), "SAME", False, True))
+    y_infer = np.asarray(
+        packed_conv_infer(x, packed, scale, (1, 1), "SAME", interpret=True)
+    )
+    np.testing.assert_array_equal(y_infer, y_train)
+
+
+# -- QuantConv wiring -------------------------------------------------------
+
+
+def _quantconv_pair(binary_compute, **extra):
+    kwargs = dict(
+        features=8,
+        kernel_size=(3, 3),
+        input_quantizer="ste_sign",
+        kernel_quantizer="ste_sign",
+        pallas_interpret=True,
+        **extra,
+    )
+    mxu = QuantConv(**kwargs, binary_compute="mxu")
+    other = QuantConv(**kwargs, binary_compute=binary_compute)
+    return mxu, other
+
+
+def test_quantconv_xnor_matches_mxu_bit_exact():
+    x = jnp.asarray(
+        np.random.default_rng(21).normal(size=(2, 8, 8, 32)), jnp.float32
+    )
+    mxu, xnor = _quantconv_pair("xnor")
+    params = mxu.init(jax.random.PRNGKey(0), x)
+    np.testing.assert_array_equal(
+        np.asarray(mxu.apply(params, x)), np.asarray(xnor.apply(params, x))
+    )
+    g1 = jax.grad(lambda p: (mxu.apply(p, x) ** 2).sum())(params)
+    g2 = jax.grad(lambda p: (xnor.apply(p, x) ** 2).sum())(params)
+    for a, b in zip(jax.tree.leaves(g1), jax.tree.leaves(g2)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5)
+
+
+def test_quantconv_loud_errors_no_silent_fallback():
+    x = jnp.zeros((1, 4, 4, 32), jnp.float32)
+    # Unusable int8: no quantizers.
+    conv = QuantConv(features=4, binary_compute="int8")
+    with pytest.raises(ValueError, match="never falls back silently"):
+        conv.init(jax.random.PRNGKey(0), x)
+    # Unusable int8: explicit pad tuples.
+    conv = QuantConv(
+        features=4, binary_compute="int8", input_quantizer="ste_sign",
+        kernel_quantizer="ste_sign", padding=((1, 1), (1, 1)),
+    )
+    with pytest.raises(ValueError, match="padding"):
+        conv.init(jax.random.PRNGKey(0), x)
+    # Non-sign kernel quantizer on a packed path.
+    conv = QuantConv(
+        features=4, binary_compute="xnor", input_quantizer="ste_sign",
+        kernel_quantizer="ste_tern",
+    )
+    with pytest.raises(ValueError, match="sign x per-channel"):
+        conv.init(jax.random.PRNGKey(0), x)
+    # Unknown mode.
+    conv = QuantConv(features=4, binary_compute="warp")
+    with pytest.raises(ValueError, match="unknown binary_compute"):
+        conv.init(jax.random.PRNGKey(0), x)
+    # packed_weights without a packed mode.
+    conv = QuantConv(
+        features=4, binary_compute="int8", input_quantizer="ste_sign",
+        kernel_quantizer="ste_sign", packed_weights=True,
+    )
+    with pytest.raises(ValueError, match="packed_weights"):
+        conv.init(jax.random.PRNGKey(0), x)
+
+
+def test_xnor_conv_popcount_same_gradients_match_one_padded_forward():
+    """The popcount backward must be the VJP of the function actually
+    computed (one-padded SAME), not the zero-padded float conv."""
+    x = random_signs((1, 5, 5, 32), seed=30)
+    k = random_signs((3, 3, 32, 4), seed=31)
+
+    def loss_pop(x, k):
+        return (xnor_conv(x, k, (1, 1), "SAME", True, True) ** 2).sum()
+
+    def loss_ref(x, k):
+        xp = jnp.pad(x, ((0, 0), (1, 1), (1, 1), (0, 0)), constant_values=1.0)
+        return (float_conv(xp, k, (1, 1), "VALID") ** 2).sum()
+
+    gx1, gk1 = jax.grad(loss_pop, argnums=(0, 1))(x, k)
+    gx2, gk2 = jax.grad(loss_ref, argnums=(0, 1))(x, k)
+    np.testing.assert_allclose(np.asarray(gx1), np.asarray(gx2), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(gk1), np.asarray(gk2), rtol=1e-5)
+
+
+def test_quantconv_input_quantizer_validation_for_packed_paths():
+    x = jnp.zeros((1, 4, 4, 32), jnp.float32)
+    # dorefa emits fractions: int8 cast would truncate on the xnor path.
+    conv = QuantConv(
+        features=4, binary_compute="xnor", input_quantizer="dorefa",
+        kernel_quantizer="ste_sign",
+    )
+    with pytest.raises(ValueError, match="non-integer"):
+        conv.init(jax.random.PRNGKey(0), x)
+    # ste_tern emits zeros: fine for xnor (0 * w = 0) ...
+    conv = QuantConv(
+        features=4, binary_compute="xnor", input_quantizer="ste_tern",
+        kernel_quantizer="ste_sign", pallas_interpret=True,
+    )
+    conv.init(jax.random.PRNGKey(0), x)
+    # ... but NOT for popcount (0 would pack as the +1 bit).
+    conv = QuantConv(
+        features=4, binary_compute="xnor_popcount", input_quantizer="ste_tern",
+        kernel_quantizer="ste_sign",
+    )
+    with pytest.raises(ValueError, match="other than \\+-1"):
+        conv.init(jax.random.PRNGKey(0), x)
+
+
+def test_packed_conv_infer_raises_under_differentiation():
+    from zookeeper_tpu.ops import pack_conv_kernel as pck
+
+    x = random_signs((1, 4, 4, 32), seed=32)
+    k = random_signs((3, 3, 32, 4), seed=33)
+    packed, scale = pck(k)
+
+    def loss(x):
+        return (
+            packed_conv_infer(x, packed, scale, (1, 1), "SAME", interpret=True)
+            ** 2
+        ).sum()
+
+    with pytest.raises(ValueError, match="inference-only"):
+        jax.grad(loss)(x)
+
+
+def test_binarynet_first_conv_stays_fp_under_binary_modes():
+    """BinaryNet's first conv takes fp input; requesting int8/xnor for the
+    model must not make that layer's validation explode."""
+    from zookeeper_tpu.core import configure
+    from zookeeper_tpu.models import BinaryNet
+
+    model = BinaryNet()
+    configure(
+        model,
+        {
+            "features": (16, 16),
+            "dense_units": (32,),
+            "binary_compute": "xnor",
+            "pallas_interpret": True,
+        },
+        name="model",
+    )
+    module = model.build((8, 8, 1), num_classes=4)
+    x = jnp.asarray(
+        np.random.default_rng(34).normal(size=(2, 8, 8, 1)), jnp.float32
+    )
+    variables = module.init(jax.random.PRNGKey(0), x, training=False)
+    y = module.apply(variables, x, training=False)
+    assert y.shape == (2, 4)
+    assert np.isfinite(np.asarray(y)).all()
+
+
+def test_quantconv_packed_weights_params_are_32x_smaller():
+    x = jnp.zeros((1, 8, 8, 64), jnp.float32)
+    conv = QuantConv(
+        features=16, binary_compute="xnor", input_quantizer="ste_sign",
+        kernel_quantizer="ste_sign", packed_weights=True,
+        pallas_interpret=True,
+    )
+    params = conv.init(jax.random.PRNGKey(0), x)["params"]
+    assert set(params) == {"kernel_packed", "kernel_scale"}
+    assert params["kernel_packed"].shape == (3, 3, 2, 16)  # 64/32 words
+    assert params["kernel_packed"].dtype == jnp.int32
+    float_bytes = 3 * 3 * 64 * 16 * 4
+    packed_bytes = params["kernel_packed"].size * 4 + 16 * 4
+    assert packed_bytes * 28 < float_bytes  # ~32x (scale overhead aside)
+
+
+def test_quicknet_large_inference_through_pallas_bit_exact():
+    """The flagship criterion: QuickNet-Large (full depth, reduced input
+    resolution for CPU runtime) runs inference through the Pallas packed
+    path bit-exactly vs the mxu path."""
+    from zookeeper_tpu.core import configure
+    from zookeeper_tpu.models import QuickNetLarge
+
+    def build(binary_compute):
+        model = QuickNetLarge()
+        configure(
+            model,
+            {"binary_compute": binary_compute, "pallas_interpret": True},
+            name="model",
+        )
+        return model.build((32, 32, 3), num_classes=1000)
+
+    x = jnp.asarray(
+        np.random.default_rng(23).normal(size=(1, 32, 32, 3)), jnp.float32
+    )
+    mxu_module = build("mxu")
+    variables = mxu_module.init(jax.random.PRNGKey(0), x, training=False)
+    y_mxu = mxu_module.apply(variables, x, training=False)
+    y_xnor = build("xnor").apply(variables, x, training=False)
+    np.testing.assert_array_equal(np.asarray(y_mxu), np.asarray(y_xnor))
+
+
+def test_pack_quantconv_params_round_trip_quicknet():
+    """The LCE-converter contract on the flagship family: train-float
+    params -> packed params, packed model output bit-exact vs float."""
+    from zookeeper_tpu.core import configure
+    from zookeeper_tpu.models import QuickNet
+
+    def build(packed):
+        model = QuickNet()
+        configure(
+            model,
+            {
+                "blocks_per_section": (1, 1),
+                "section_features": (32, 64),
+                "binary_compute": "xnor",
+                "packed_weights": packed,
+                "pallas_interpret": True,
+            },
+            name="model",
+        )
+        return model.build((32, 32, 3), num_classes=10)
+
+    x = jnp.asarray(
+        np.random.default_rng(22).normal(size=(2, 32, 32, 3)), jnp.float32
+    )
+    float_module = build(False)
+    variables = float_module.init(jax.random.PRNGKey(0), x, training=False)
+    y_float = float_module.apply(variables, x, training=False)
+
+    packed_module = build(True)
+    packed_params = pack_quantconv_params(variables["params"])
+    packed_vars = {**variables, "params": packed_params}
+    y_packed = packed_module.apply(packed_vars, x, training=False)
+    np.testing.assert_array_equal(np.asarray(y_float), np.asarray(y_packed))
+    # Structure matches what the packed module would declare.
+    ref = jax.eval_shape(
+        lambda: packed_module.init(jax.random.PRNGKey(0), x, training=False)
+    )
+    assert jax.tree_util.tree_structure(
+        ref["params"]
+    ) == jax.tree_util.tree_structure(packed_params)

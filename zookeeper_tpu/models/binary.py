@@ -39,16 +39,22 @@ class _BinaryNetModule(nn.Module):
     num_classes: int
     dtype: Any
     binary_compute: str = "mxu"
+    packed_weights: bool = False
+    pallas_interpret: bool = False
 
     @nn.compact
     def __call__(self, x, training: bool = False):
         x = x.astype(self.dtype)
         for i, f in enumerate(self.features):
-            quant_in = None if i == 0 else "ste_sign"  # First conv fp input.
+            # First conv: fp input (standard for binary nets) — it cannot
+            # run a binary compute path, so it stays on mxu explicitly.
+            quant_in = None if i == 0 else "ste_sign"
             x = QuantConv(
                 f, (3, 3), input_quantizer=quant_in,
                 kernel_quantizer="ste_sign", dtype=self.dtype,
-                binary_compute=self.binary_compute,
+                binary_compute="mxu" if i == 0 else self.binary_compute,
+                packed_weights=False if i == 0 else self.packed_weights,
+                pallas_interpret=self.pallas_interpret,
             )(x)
             if i % 2 == 1:
                 x = nn.max_pool(x, (2, 2), strides=(2, 2))
@@ -70,8 +76,14 @@ class BinaryNet(Model):
 
     features: Sequence[int] = Field((128, 128, 256, 256, 512, 512))
     dense_units: Sequence[int] = Field((1024, 1024))
-    #: Binary matmul path: "mxu" (bf16/fp32) or "int8" (int32-accum MXU).
+    #: Binary conv path: "mxu", "int8", "xnor", or "xnor_popcount"
+    #: (see QuantConv).
     binary_compute: str = Field("mxu")
+    #: Inference-only: params are the bit-packed kernels (32x smaller);
+    #: fill from a float checkpoint with ops.packed.pack_quantconv_params.
+    packed_weights: bool = Field(False)
+    #: Run Pallas kernels interpreted (CPU tests).
+    pallas_interpret: bool = Field(False)
 
     def build(self, input_shape, num_classes: int) -> nn.Module:
         return _BinaryNetModule(
@@ -80,6 +92,8 @@ class BinaryNet(Model):
             num_classes=num_classes,
             dtype=self.dtype(),
             binary_compute=self.binary_compute,
+            packed_weights=self.packed_weights,
+            pallas_interpret=self.pallas_interpret,
         )
 
 
@@ -90,6 +104,8 @@ class _BinaryAlexNetModule(nn.Module):
     dtype: Any
     inflation: int = 1
     binary_compute: str = "mxu"
+    packed_weights: bool = False
+    pallas_interpret: bool = False
 
     @nn.compact
     def __call__(self, x, training: bool = False):
@@ -105,6 +121,8 @@ class _BinaryAlexNetModule(nn.Module):
                 feat, (k, k), input_quantizer="ste_sign",
                 kernel_quantizer="ste_sign", dtype=d,
                 binary_compute=self.binary_compute,
+                packed_weights=self.packed_weights,
+                pallas_interpret=self.pallas_interpret,
             )(x)
             if feat in (192 * f, 256 * f):
                 x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
@@ -126,12 +144,16 @@ class BinaryAlexNet(Model):
 
     inflation: int = Field(1)
     binary_compute: str = Field("mxu")
+    packed_weights: bool = Field(False)
+    pallas_interpret: bool = Field(False)
 
     def build(self, input_shape, num_classes: int) -> nn.Module:
         return _BinaryAlexNetModule(
             num_classes=num_classes, dtype=self.dtype(),
             inflation=self.inflation,
             binary_compute=self.binary_compute,
+            packed_weights=self.packed_weights,
+            pallas_interpret=self.pallas_interpret,
         )
 
 
@@ -147,6 +169,8 @@ class _BiRealBlock(nn.Module):
     strides: int
     dtype: Any
     binary_compute: str = "mxu"
+    packed_weights: bool = False
+    pallas_interpret: bool = False
 
     @nn.compact
     def __call__(self, x, training: bool = False):
@@ -165,6 +189,8 @@ class _BiRealBlock(nn.Module):
             input_quantizer="approx_sign",
             kernel_quantizer="magnitude_aware_sign", dtype=self.dtype,
             binary_compute=self.binary_compute,
+            packed_weights=self.packed_weights,
+            pallas_interpret=self.pallas_interpret,
         )(x)
         y = _bn(training, self.dtype)(y)
         return y + shortcut
@@ -178,6 +204,8 @@ class _BiRealNetModule(nn.Module):
     num_classes: int
     dtype: Any
     binary_compute: str = "mxu"
+    packed_weights: bool = False
+    pallas_interpret: bool = False
 
     @nn.compact
     def __call__(self, x, training: bool = False):
@@ -192,7 +220,8 @@ class _BiRealNetModule(nn.Module):
             for b in range(n):
                 strides = 2 if (b == 0 and s > 0) else 1
                 x = _BiRealBlock(
-                    feat, strides, d, self.binary_compute
+                    feat, strides, d, self.binary_compute,
+                    self.packed_weights, self.pallas_interpret,
                 )(x, training)
         x = jnp.mean(x, axis=(1, 2))
         x = nn.Dense(self.num_classes, dtype=d)(x)
@@ -206,6 +235,8 @@ class BiRealNet(Model):
     blocks_per_section: Sequence[int] = Field((4, 4, 4, 4))
     section_features: Sequence[int] = Field((64, 128, 256, 512))
     binary_compute: str = Field("mxu")
+    packed_weights: bool = Field(False)
+    pallas_interpret: bool = Field(False)
 
     def build(self, input_shape, num_classes: int) -> nn.Module:
         return _BiRealNetModule(
@@ -214,6 +245,8 @@ class BiRealNet(Model):
             num_classes=num_classes,
             dtype=self.dtype(),
             binary_compute=self.binary_compute,
+            packed_weights=self.packed_weights,
+            pallas_interpret=self.pallas_interpret,
         )
 
 
@@ -247,6 +280,8 @@ class _QuickNetModule(nn.Module):
     num_classes: int
     dtype: Any
     binary_compute: str = "mxu"
+    packed_weights: bool = False
+    pallas_interpret: bool = False
 
     @nn.compact
     def __call__(self, x, training: bool = False):
@@ -275,6 +310,8 @@ class _QuickNetModule(nn.Module):
                     feat, (3, 3), input_quantizer="ste_sign",
                     kernel_quantizer="ste_sign", dtype=d,
                     binary_compute=self.binary_compute,
+                    packed_weights=self.packed_weights,
+                    pallas_interpret=self.pallas_interpret,
                 )(x)
                 y = _bn(training, d)(y)
                 x = x + y  # Residual around every binary conv.
@@ -291,6 +328,8 @@ class QuickNet(Model):
     blocks_per_section: Sequence[int] = Field((2, 3, 4, 4))
     section_features: Sequence[int] = Field((64, 128, 256, 512))
     binary_compute: str = Field("mxu")
+    packed_weights: bool = Field(False)
+    pallas_interpret: bool = Field(False)
 
     def build(self, input_shape, num_classes: int) -> nn.Module:
         return _QuickNetModule(
@@ -299,6 +338,8 @@ class QuickNet(Model):
             num_classes=num_classes,
             dtype=self.dtype(),
             binary_compute=self.binary_compute,
+            packed_weights=self.packed_weights,
+            pallas_interpret=self.pallas_interpret,
         )
 
 

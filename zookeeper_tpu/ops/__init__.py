@@ -25,16 +25,26 @@ from zookeeper_tpu.ops.binary_compute import (
     int8_conv,
     int8_matmul,
     pack_bits,
+    pack_conv_kernel,
+    packed_conv_infer,
+    packed_weight_matmul,
     unpack_bits,
+    xnor_conv,
     xnor_matmul,
     xnor_matmul_packed,
 )
+from zookeeper_tpu.ops.packed import pack_quantconv_params
 
 __all__ = [
     "int8_conv",
     "int8_matmul",
     "pack_bits",
+    "pack_conv_kernel",
+    "pack_quantconv_params",
+    "packed_conv_infer",
+    "packed_weight_matmul",
     "unpack_bits",
+    "xnor_conv",
     "xnor_matmul",
     "xnor_matmul_packed",
     "QUANTIZERS",

@@ -1,7 +1,7 @@
-"""Binary compute paths: bit-packing, XNOR-popcount Pallas GEMM, int8 MXU.
+"""Binary compute paths: bit-packing, Pallas packed kernels, int8 MXU.
 
 The TPU-native answer to larq-compute-engine's native binary kernels
-(SURVEY.md §2.4). Three executable paths for a binary (+-1 x +-1) matmul,
+(SURVEY.md §2.4). Executable paths for a binary (+-1 x +-1) matmul/conv,
 chosen by what the hardware rewards:
 
 1. **float/bf16 MXU** (default): XLA's conv/matmul on +-1.0 values — on
@@ -9,14 +9,31 @@ chosen by what the hardware rewards:
    best *training* path.
 2. **int8 MXU** (``int8_matmul``/``int8_conv``): +-1 as int8 with int32
    accumulation — MXU int8 peak is 2x bf16, same accuracy (values exactly
-   representable), the TPU-idiomatic "binary" fast path.
-3. **XNOR-popcount Pallas kernel** (``xnor_matmul``): 32 binary values per
-   int32 lane, popcount on the VPU —
-   ``out = K - 2*popcount(a XOR b)``. This is the faithful LCE-style
-   bit-serial kernel: 32x weight compression and HBM-bandwidth-bound
-   workloads win; raw FLOP-bound workloads still prefer the MXU paths.
-   (See BASELINE.md notes: the kernel must *beat* the fallback to be
-   switched on by default, per SURVEY.md §7 "hard parts".)
+   representable).
+3. **Packed-weight MXU Pallas kernel** (``packed_weight_matmul``): weights
+   live bit-packed in HBM (32x smaller), each tile is unpacked to int8
+   inside VMEM, and the contraction still runs on the MXU. This is the
+   TPU-first redesign of LCE's bit-packed kernels: in the HBM-bound regime
+   (small-batch inference, where weight reads dominate) it cuts weight
+   bandwidth 32x *without* giving up the systolic array. Bit-exact vs the
+   float path (0 and +-1 are exact in int8/int32).
+4. **XNOR-popcount VPU Pallas kernel** (``xnor_matmul``): both operands
+   bit-packed, ``out = K - 2*popcount(a XOR b)`` on the VPU over int32
+   lanes. The faithful LCE-style bit-serial kernel — 32x compression on
+   BOTH operands; loses to the MXU paths when FLOP-bound (BASELINE.md
+   measures the crossover). K-tiled with an in-output accumulator, so
+   VMEM stays bounded at any K.
+
+Convolutions decompose into per-tap GEMMs (``sum over (dy,dx) of
+shifted_x @ W[dy,dx]``) instead of materializing im2col patches: a 3x3
+im2col would write 9x the activation bytes to HBM, which is exactly the
+traffic the packed path is trying to save.
+
+Gradient story (SURVEY.md §7 "hard parts"): every binary conv/matmul op
+here equals the float conv on its +-1/0 domain, so each gets a
+``jax.custom_vjp`` whose backward is the float conv's VJP on the saved
+quantized operands — STE quantizer gradients compose outside, and the ops
+stay shard-transparent under pjit.
 """
 
 from functools import partial
@@ -24,9 +41,12 @@ from typing import Tuple
 
 import jax
 import jax.numpy as jnp
-import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 Array = jax.Array
+
+_MXU_WORDS = 16  # K-words per grid step in packed kernels (512 binary K).
 
 
 # -- bit packing ------------------------------------------------------------
@@ -37,8 +57,9 @@ def pack_bits(x: Array, axis: int = -1) -> Array:
 
     bit=1 encodes x>=0 (+1), bit=0 encodes x<0 (-1); 32 values per lane,
     little-endian within the word. The packed axis length must be a
-    multiple of 32 (pad with +1s beforehand; see ``xnor_matmul`` for why
-    symmetric padding cancels).
+    multiple of 32 (pad with +1s beforehand; symmetric padding cancels in
+    the popcount identity, zero-activation padding cancels in the MXU
+    path).
     """
     x = jnp.moveaxis(x, axis, -1)
     k = x.shape[-1]
@@ -61,32 +82,62 @@ def unpack_bits(packed: Array, k: int, axis: int = -1) -> Array:
     return jnp.moveaxis(values, -1, axis)
 
 
-# -- XNOR-popcount Pallas GEMM ---------------------------------------------
-
-
-def _popcount32(v: Array) -> Array:
-    """Parallel bit-count of int32 lanes (VPU integer ops only)."""
-    v = v.astype(jnp.uint32)
-    v = v - ((v >> 1) & jnp.uint32(0x55555555))
-    v = (v & jnp.uint32(0x33333333)) + ((v >> 2) & jnp.uint32(0x33333333))
-    v = (v + (v >> 4)) & jnp.uint32(0x0F0F0F0F)
-    return ((v * jnp.uint32(0x01010101)) >> 24).astype(jnp.int32)
-
-
-def _xnor_kernel(a_ref, b_ref, out_ref, *, k_true: int):
-    # a: [TM, Kp] int32, b: [TN, Kp] int32 (both packed along K).
-    a = a_ref[:]
-    b = b_ref[:]
-    x = jnp.bitwise_xor(a[:, None, :], b[None, :, :])  # [TM, TN, Kp]
-    mismatches = jnp.sum(_popcount32(x), axis=-1)  # [TM, TN]
-    out_ref[:] = (k_true - 2 * mismatches).astype(jnp.int32)
-
-
 def _round_up(x: int, m: int) -> int:
     return (x + m - 1) // m * m
 
 
-@partial(jax.jit, static_argnames=("k_true", "block_m", "block_n", "interpret"))
+# -- XNOR-popcount VPU Pallas GEMM (both operands packed) -------------------
+
+
+def _popcount32(v: Array) -> Array:
+    """Parallel bit-count of int32 lanes (VPU integer ops only).
+
+    Shift-add finish instead of the classic ``* 0x01010101 >> 24`` byte
+    sum: Mosaic cannot legalize the vectorized integer multiply."""
+    v = v.astype(jnp.uint32)
+    v = v - ((v >> 1) & jnp.uint32(0x55555555))
+    v = (v & jnp.uint32(0x33333333)) + ((v >> 2) & jnp.uint32(0x33333333))
+    v = (v + (v >> 4)) & jnp.uint32(0x0F0F0F0F)
+    v = v + (v >> 8)
+    v = v + (v >> 16)
+    return (v & jnp.uint32(0x3F)).astype(jnp.int32)
+
+
+def _xnor_kernel(a_ref, b_ref, out_ref, *, k_true: int):
+    """One (m, n, k) grid step: accumulate XOR-popcount mismatches for a
+    K-slab into the output block, finalizing ``K - 2*mismatches`` on the
+    last K step. VMEM high-water: the [bkw, bm, bn] xor intermediate —
+    bounded by the K tile, not the full K (the round-1 kernel kept full K
+    per block and overflowed VMEM at QuickNet's K=4608).
+
+    Both operands arrive K-words-major ([bkw, bm] / [bkw, bn]): Mosaic
+    requires lane (last) dims of 128 (or full-array), which the small
+    packed-word axis cannot satisfy when K-tiled — so the word axis lives
+    in sublanes and bm/bn take the lanes."""
+    k = pl.program_id(2)
+    a = a_ref[:]  # [bkw, bm] int32 (A packed along K, transposed)
+    b = b_ref[:]  # [bkw, bn] int32
+    x = jnp.bitwise_xor(a[:, :, None], b[:, None, :])  # [bkw, bm, bn]
+    mismatches = jnp.sum(_popcount32(x), axis=0)  # [bm, bn] int32
+
+    @pl.when(k == 0)
+    def _():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    out_ref[:] += mismatches
+
+    @pl.when(k == pl.num_programs(2) - 1)
+    def _():
+        # k_true - 2*mismatches, multiply-free (Mosaic has no vector
+        # integer multiply).
+        acc = out_ref[:]
+        out_ref[:] = k_true - (acc + acc)
+
+
+@partial(
+    jax.jit,
+    static_argnames=("k_true", "block_m", "block_n", "block_kw", "interpret"),
+)
 def xnor_matmul_packed(
     a_packed: Array,
     b_packed: Array,
@@ -94,43 +145,58 @@ def xnor_matmul_packed(
     k_true: int,
     block_m: int = 128,
     block_n: int = 128,
+    block_kw: int = _MXU_WORDS,
     interpret: bool = False,
 ) -> Array:
-    """Binary GEMM on pre-packed operands.
+    """Binary GEMM on pre-packed operands, K-tiled.
 
-    ``a_packed``: [M, K/32] int32; ``b_packed``: [N, K/32] int32 (i.e. B
-    transposed then packed along K). Returns [M, N] int32 equal to
-    ``sign(A) @ sign(B^T)^T`` counted over ``k_true`` terms. K-padding is
-    harmless when both operands pad with the SAME bit value: XOR of equal
-    bits is 0 and contributes no mismatches.
+    ``a_packed``: [M, Kw] int32 (packed along K); ``b_packed``: [Kw, N]
+    int32 (packed along K, i.e. pack_bits(B, axis=0)). Returns [M, N]
+    int32 equal to ``sign(A) @ sign(B)`` counted over ``k_true`` terms.
+    K-padding is harmless when both operands pad with the SAME bit value:
+    XOR of equal bits contributes no mismatches.
     """
-    from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
-
-    m, kp = a_packed.shape
-    n, kp2 = b_packed.shape
-    if kp != kp2:
-        raise ValueError(f"Packed K mismatch: {kp} vs {kp2}.")
+    m, kw = a_packed.shape
+    kw2, n = b_packed.shape
+    if kw != kw2:
+        raise ValueError(f"Packed K mismatch: {kw} vs {kw2}.")
+    if not interpret:
+        # Mosaic lane/sublane legality (see kernel docstring): lanes (bm,
+        # bn) in multiples of 128, word-axis sublanes in multiples of 8 —
+        # unless the block covers the full axis.
+        block_m = _round_up(block_m, 128)
+        block_n = _round_up(block_n, 128)
+        block_kw = _round_up(block_kw, 8)
+    block_m = min(block_m, _round_up(m, 8))
+    block_n = min(block_n, _round_up(n, 128))
+    block_kw = min(block_kw, kw)
     mp = _round_up(m, block_m)
     np_ = _round_up(n, block_n)
-    # Pad rows with zero-words: their outputs are sliced away below.
-    a_pad = jnp.pad(a_packed, ((0, mp - m), (0, 0)))
-    b_pad = jnp.pad(b_packed, ((0, np_ - n), (0, 0)))
+    kwp = _round_up(kw, block_kw)
+    # Row/col padding produces garbage rows sliced away below; K-word
+    # padding pads BOTH operands with zero-words (equal bits, no
+    # mismatches). A goes in K-words-major (see kernel docstring).
+    a_pad = jnp.pad(a_packed.T, ((0, kwp - kw), (0, mp - m)))
+    b_pad = jnp.pad(b_packed, ((0, kwp - kw), (0, np_ - n)))
 
     out = pl.pallas_call(
         partial(_xnor_kernel, k_true=k_true),
         out_shape=jax.ShapeDtypeStruct((mp, np_), jnp.int32),
-        grid=(mp // block_m, np_ // block_n),
+        grid=(mp // block_m, np_ // block_n, kwp // block_kw),
         in_specs=[
             pl.BlockSpec(
-                (block_m, kp), lambda i, j: (i, 0), memory_space=pltpu.VMEM
+                (block_kw, block_m),
+                lambda i, j, k: (k, i),
+                memory_space=pltpu.VMEM,
             ),
             pl.BlockSpec(
-                (block_n, kp), lambda i, j: (j, 0), memory_space=pltpu.VMEM
+                (block_kw, block_n),
+                lambda i, j, k: (k, j),
+                memory_space=pltpu.VMEM,
             ),
         ],
         out_specs=pl.BlockSpec(
-            (block_m, block_n), lambda i, j: (i, j), memory_space=pltpu.VMEM
+            (block_m, block_n), lambda i, j, k: (i, j), memory_space=pltpu.VMEM
         ),
         interpret=interpret,
     )(a_pad, b_pad)
@@ -138,12 +204,18 @@ def xnor_matmul_packed(
 
 
 def xnor_matmul(
-    a: Array, b: Array, *, interpret: bool = False, block_m: int = 128,
+    a: Array,
+    b: Array,
+    *,
+    interpret: bool = False,
+    block_m: int = 128,
     block_n: int = 128,
+    block_kw: int = _MXU_WORDS,
 ) -> Array:
     """Binary GEMM of float +-1 operands via bit-packing: [M,K] @ [K,N].
 
-    Packs, runs the Pallas kernel, returns float32 (exact integers).
+    Packs, runs the VPU popcount kernel, returns float32 (exact
+    integers).
     """
     m, k = a.shape
     k2, n = b.shape
@@ -155,14 +227,362 @@ def xnor_matmul(
         a = jnp.pad(a, ((0, 0), (0, k_pad - k)), constant_values=1.0)
         b = jnp.pad(b, ((0, k_pad - k), (0, 0)), constant_values=1.0)
     ap = pack_bits(a, axis=-1)
-    bp = pack_bits(b.T, axis=-1)
+    bp = pack_bits(b, axis=0)
     # k_true stays the ORIGINAL K: the symmetric +1 padding produces
     # matching bits, i.e. zero mismatches, so K - 2*mismatches is exact.
     out = xnor_matmul_packed(
         ap, bp, k_true=k, block_m=block_m, block_n=block_n,
-        interpret=interpret,
+        block_kw=block_kw, interpret=interpret,
     )
     return out.astype(jnp.float32)
+
+
+# -- Packed-weight MXU Pallas GEMM (weights packed, MXU contraction) --------
+
+
+def _pw_kernel(a_ref, b_ref, out_ref, *, out_dtype):
+    """One (m, n, k) grid step: unpack a packed-weight K-slab to +-1 int8
+    in VMEM, contract on the MXU, accumulate into the output block.
+
+    The HBM win: ``b_ref`` blocks arrive packed (32x fewer bytes than the
+    int8 weights they encode); only the VMEM-resident tile is ever
+    unpacked."""
+    k = pl.program_id(2)
+    a = a_ref[:]  # [bm, bk] int8 (+-1 or 0 from spatial padding)
+    bw = b_ref[:].astype(jnp.uint32)  # [bkw, bn] packed words
+    shifts = jnp.arange(32, dtype=jnp.uint32)
+    bits = (bw[:, None, :] >> shifts[None, :, None]) & jnp.uint32(1)
+    # [bkw, 32, bn] -> [bk, bn]; row r = word r//32, bit r%32 (pack order).
+    # Pure arithmetic +-1 decode (b+b-1): Mosaic has no vector integer
+    # multiply, and i1 select masks hit relayout limits at this shape.
+    bi = bits.astype(jnp.int32)
+    b = (bi + bi - 1).reshape(-1, bw.shape[-1]).astype(jnp.int8)
+
+    # Precision pinned: int8 contraction is exact at any precision, and
+    # a global jax_default_matmul_precision="highest" would otherwise tag
+    # this dot with an fp32 contract Mosaic cannot honor for int8.
+    acc = jax.lax.dot_general(
+        a,
+        b,
+        (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32,
+        precision=jax.lax.Precision.DEFAULT,
+    )
+
+    @pl.when(k == 0)
+    def _():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    out_ref[:] += acc.astype(out_dtype)
+
+
+@partial(
+    jax.jit,
+    static_argnames=("block_m", "block_n", "block_kw", "interpret"),
+)
+def packed_weight_matmul(
+    a: Array,
+    b_packed: Array,
+    *,
+    block_m: int = 128,
+    block_n: int = 128,
+    block_kw: int = _MXU_WORDS,
+    interpret: bool = False,
+) -> Array:
+    """GEMM with bit-packed weights: [M, K] (+-1/0 values) @ packed [Kw, N].
+
+    ``a`` may contain zeros (conv zero-padding) — only the WEIGHTS are
+    packed, so the result is bit-exact with the float GEMM against the
+    unpacked +-1 weights. Returns int32 [M, N].
+    """
+    m, k = a.shape
+    kw, n = b_packed.shape
+    if kw * 32 != _round_up(k, 32):
+        raise ValueError(
+            f"Packed weight K-words {kw} inconsistent with A's K {k}."
+        )
+    a8 = a.astype(jnp.int8)
+    if not interpret:
+        # Mosaic legality: int8 sublanes in multiples of 32, lanes in
+        # multiples of 128 (the K-tile is a lane dim for A at
+        # block_kw*32), unless a block covers its full axis.
+        block_m = _round_up(block_m, 32)
+        block_n = _round_up(block_n, 128)
+        block_kw = _round_up(block_kw, 8)
+    block_m = min(block_m, _round_up(m, 32))
+    block_n = min(block_n, _round_up(n, 128))
+    block_kw = min(block_kw, kw)
+    mp = _round_up(m, block_m)
+    np_ = _round_up(n, block_n)
+    kwp = _round_up(kw, block_kw)
+    # A pads K with ZEROS: whatever bits the padded weight words decode to
+    # (+-1), 0 * (+-1) contributes nothing — exact.
+    a_pad = jnp.pad(a8, ((0, mp - m), (0, kwp * 32 - k)))
+    b_pad = jnp.pad(b_packed, ((0, kwp - kw), (0, np_ - n)))
+
+    out = pl.pallas_call(
+        partial(_pw_kernel, out_dtype=jnp.int32),
+        out_shape=jax.ShapeDtypeStruct((mp, np_), jnp.int32),
+        grid=(mp // block_m, np_ // block_n, kwp // block_kw),
+        in_specs=[
+            pl.BlockSpec(
+                (block_m, block_kw * 32),
+                lambda i, j, k: (i, k),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (block_kw, block_n),
+                lambda i, j, k: (k, j),
+                memory_space=pltpu.VMEM,
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (block_m, block_n), lambda i, j, k: (i, j), memory_space=pltpu.VMEM
+        ),
+        interpret=interpret,
+    )(a_pad, b_pad)
+    return out[:m, :n]
+
+
+# -- packed conv kernels (weights pre-packed per tap) -----------------------
+
+
+def pack_conv_kernel(q_kernel: Array) -> Tuple[Array, Array]:
+    """Pack a quantized HWIO conv kernel for the binary conv paths.
+
+    ``q_kernel`` [kh, kw, ci, co] must be ``sign x per-output-channel
+    scale`` (what ``ste_sign``/``approx_sign`` [scale=1] and
+    ``magnitude_aware_sign`` [scale=mean|w| per co] produce). Returns
+    ``(packed [kh, kw, ceil(ci/32), co] int32, scale [co] float32)``:
+    32x weight compression; the scale is re-applied to the integer GEMM
+    output.
+    """
+    kh, kw, ci, co = q_kernel.shape
+    scale = jnp.max(jnp.abs(q_kernel), axis=(0, 1, 2)).astype(jnp.float32)
+    # Guard all-zero channels (degenerate but possible pre-training).
+    safe = jnp.where(scale > 0, scale, 1.0)
+    signs = q_kernel / safe  # exactly +-1 by the quantizer contract
+    ci_pad = _round_up(ci, 32)
+    if ci_pad != ci:
+        signs = jnp.pad(
+            signs, ((0, 0), (0, 0), (0, ci_pad - ci), (0, 0)),
+            constant_values=1.0,
+        )
+    packed = pack_bits(signs, axis=2)  # [kh, kw, ci_pad/32, co]
+    return packed, scale
+
+
+def _spatial_pad(
+    x: Array, kh: int, kw: int, strides: Tuple[int, int], padding: str,
+    pad_value: float,
+) -> Tuple[Array, int, int]:
+    """Pad NHWC input per XLA SAME/VALID semantics; returns (padded, Ho, Wo)."""
+    _, h, w, _ = x.shape
+    sh, sw = strides
+    if padding == "VALID":
+        ho = (h - kh) // sh + 1
+        wo = (w - kw) // sw + 1
+        return x, ho, wo
+    if padding != "SAME":
+        raise ValueError(f"Unsupported padding {padding!r} (SAME/VALID).")
+    ho = -(-h // sh)
+    wo = -(-w // sw)
+    pad_h = max((ho - 1) * sh + kh - h, 0)
+    pad_w = max((wo - 1) * sw + kw - w, 0)
+    x = jnp.pad(
+        x,
+        ((0, 0), (pad_h // 2, pad_h - pad_h // 2),
+         (pad_w // 2, pad_w - pad_w // 2), (0, 0)),
+        constant_values=pad_value,
+    )
+    return x, ho, wo
+
+
+def _packed_conv_forward(
+    x: Array,
+    packed: Array,
+    scale: Array,
+    strides: Tuple[int, int],
+    padding: str,
+    *,
+    ci: int,
+    use_popcount: bool,
+    interpret: bool,
+) -> Array:
+    """Conv as a sum of per-tap GEMMs against pre-packed weights.
+
+    No im2col materialization: each tap reads a shifted view of ``x``
+    (XLA slices, fused) and contracts K=ci on the chosen Pallas kernel.
+    ``use_popcount=False``: packed-weight MXU kernel, zero-padding, exact
+    vs the float conv. ``use_popcount=True``: both operands packed, VPU
+    popcount kernel — spatial padding must then be +-1, so SAME uses
+    ONE-padding (the LCE-style fast semantics; documented, and exact for
+    VALID).
+    """
+    kh, kw, ciw, co = packed.shape
+    b, _, _, _ = x.shape
+    pad_value = 1.0 if use_popcount else 0.0
+    xp, ho, wo = _spatial_pad(x, kh, kw, strides, padding, pad_value)
+    sh, sw = strides
+    m = b * ho * wo
+
+    if use_popcount:
+        ci_pad = ciw * 32
+        acc = None
+        for dy in range(kh):
+            for dx in range(kw):
+                tap = xp[:, dy : dy + (ho - 1) * sh + 1 : sh,
+                         dx : dx + (wo - 1) * sw + 1 : sw, :]
+                flat = tap.reshape(m, ci)
+                if ci_pad != ci:
+                    flat = jnp.pad(
+                        flat, ((0, 0), (0, ci_pad - ci)), constant_values=1.0
+                    )
+                ap = pack_bits(flat, axis=-1)
+                out = xnor_matmul_packed(
+                    ap, packed[dy, dx], k_true=ci, interpret=interpret
+                )
+                acc = out if acc is None else acc + out
+    else:
+        acc = None
+        for dy in range(kh):
+            for dx in range(kw):
+                tap = xp[:, dy : dy + (ho - 1) * sh + 1 : sh,
+                         dx : dx + (wo - 1) * sw + 1 : sw, :]
+                flat = tap.reshape(m, ci)
+                out = packed_weight_matmul(
+                    flat, packed[dy, dx], interpret=interpret
+                )
+                acc = out if acc is None else acc + out
+    y = acc.astype(jnp.float32) * scale[None, :]
+    return y.reshape(b, ho, wo, co)
+
+
+def _float_conv(x, k, strides, padding):
+    # Mixed precision: activations may be bf16 while latent kernels are
+    # fp32; compute the gradient conv in the wider dtype.
+    dtype = jnp.promote_types(x.dtype, k.dtype)
+    return jax.lax.conv_general_dilated(
+        x.astype(dtype), k.astype(dtype), window_strides=tuple(strides),
+        padding=padding, dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+
+
+def _reference_conv(x, k, strides, padding, use_popcount):
+    """The float function each binary conv path equals on its domain —
+    including the popcount path's ONE-padded SAME semantics, so VJPs taken
+    of this function match the executed forward exactly (jnp.pad's VJP
+    slices the interior, handling the border gradient)."""
+    if use_popcount and padding == "SAME":
+        kh, kw = k.shape[:2]
+        xp, _, _ = _spatial_pad(x, kh, kw, tuple(strides), "SAME", 1.0)
+        return _float_conv(xp, k, strides, "VALID")
+    return _float_conv(x, k, strides, padding)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5))
+def xnor_conv(
+    x: Array,
+    q_kernel: Array,
+    strides: Tuple[int, int],
+    padding: str,
+    use_popcount: bool = False,
+    interpret: bool = False,
+) -> Array:
+    """NHWC binary conv through the Pallas packed kernels.
+
+    ``x`` must be quantized (+-1 values); ``q_kernel`` [kh, kw, ci, co]
+    must be sign x per-channel scale (quantizer output). Forward packs the
+    weights and runs per-tap packed GEMMs; backward is the float conv's
+    VJP on the saved quantized operands (the op IS that function on its
+    domain), so STE gradients compose exactly as on the mxu/int8 paths.
+
+    ``use_popcount=False`` (packed-weight MXU kernel) is bit-exact vs the
+    float conv incl. SAME zero-padding. ``use_popcount=True`` (bit-serial
+    VPU kernel) uses ONE-padding for SAME — exact for VALID, documented
+    deviation for SAME.
+    """
+    ci = x.shape[-1]
+    packed, scale = pack_conv_kernel(q_kernel)
+    return _packed_conv_forward(
+        x, packed, scale, strides, padding,
+        ci=ci, use_popcount=use_popcount, interpret=interpret,
+    )
+
+
+def _xnor_conv_fwd(x, q_kernel, strides, padding, use_popcount, interpret):
+    packed, scale = pack_conv_kernel(q_kernel)
+    y = _packed_conv_forward(
+        x, packed, scale, strides, padding,
+        ci=x.shape[-1], use_popcount=use_popcount, interpret=interpret,
+    )
+    return y, (x, q_kernel)
+
+
+def _xnor_conv_bwd(strides, padding, use_popcount, interpret, res, g):
+    x, q_kernel = res
+    _, vjp = jax.vjp(
+        lambda xx, kk: _reference_conv(xx, kk, strides, padding, use_popcount),
+        x, q_kernel,
+    )
+    dx, dk = vjp(g.astype(jnp.promote_types(x.dtype, q_kernel.dtype)))
+    return dx.astype(x.dtype), dk.astype(q_kernel.dtype)
+
+
+xnor_conv.defvjp(_xnor_conv_fwd, _xnor_conv_bwd)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _packed_conv_infer_vjp(x, packed, scale, strides, padding, use_popcount,
+                           interpret):
+    return _packed_conv_forward(
+        x, packed, scale, strides, padding,
+        ci=x.shape[-1], use_popcount=use_popcount, interpret=interpret,
+    )
+
+
+def _packed_infer_fwd(x, packed, scale, strides, padding, use_popcount,
+                      interpret):
+    y = _packed_conv_forward(
+        x, packed, scale, strides, padding,
+        ci=x.shape[-1], use_popcount=use_popcount, interpret=interpret,
+    )
+    return y, None
+
+
+def _packed_infer_bwd(strides, padding, use_popcount, interpret, res, g):
+    raise ValueError(
+        "packed_conv_infer is inference-only: packed weights carry no "
+        "latent parameters to train. Differentiate the float model "
+        "(xnor_conv packs on the fly) and convert with "
+        "pack_quantconv_params for deployment."
+    )
+
+
+_packed_conv_infer_vjp.defvjp(_packed_infer_fwd, _packed_infer_bwd)
+
+
+def packed_conv_infer(
+    x: Array,
+    packed: Array,
+    scale: Array,
+    strides: Tuple[int, int],
+    padding: str,
+    *,
+    use_popcount: bool = False,
+    interpret: bool = False,
+) -> Array:
+    """Inference conv from PRE-PACKED weights (32x less weight HBM).
+
+    This is the deployment path: weights never exist unpacked on device.
+    INFERENCE-ONLY: differentiating through it raises (a silent zero
+    gradient would let a packed model "train" to nothing); quantized
+    training uses :func:`xnor_conv`, which packs latent weights on the
+    fly.
+    """
+    return _packed_conv_infer_vjp(
+        x, packed, scale, strides, padding, use_popcount, interpret
+    )
 
 
 # -- int8 MXU path ----------------------------------------------------------
@@ -190,16 +610,6 @@ def _int8_conv_forward(x_sign, k_sign, strides, padding):
         preferred_element_type=jnp.int32,
     )
     return out.astype(jnp.float32)
-
-
-def _float_conv(x, k, strides, padding):
-    # Mixed precision: activations may be bf16 while latent kernels are
-    # fp32; compute the gradient conv in the wider dtype.
-    dtype = jnp.promote_types(x.dtype, k.dtype)
-    return jax.lax.conv_general_dilated(
-        x.astype(dtype), k.astype(dtype), window_strides=tuple(strides),
-        padding=padding, dimension_numbers=("NHWC", "HWIO", "NHWC"),
-    )
 
 
 @partial(jax.custom_vjp, nondiff_argnums=(2, 3))

@@ -8,9 +8,11 @@ constraint by clamping latent weights into [-1, 1] inside the forward
 (projection happens on read, so the optimizer state stays untouched and
 the op fuses into the conv under XLA).
 
-The binary inference fast path (bit-packed XNOR-popcount via Pallas) swaps
-in behind the same module interface; training keeps the float path where
-XLA's MXU convs on +-1.0 values are already optimal.
+``binary_compute`` selects the executable path when both operands are
+binarized — see :class:`QuantConv`. Requesting a binary path that the
+layer's configuration cannot honor raises immediately instead of silently
+running the float path (a user benchmarking "int8" must never actually be
+measuring bf16).
 """
 
 from typing import Any, Callable, Optional, Sequence, Tuple, Union
@@ -23,6 +25,23 @@ from zookeeper_tpu.ops.quantizers import get_quantizer
 
 Quantizer = Union[str, Callable, None]
 
+#: Kernel quantizers whose output is sign x per-output-channel scale — the
+#: contract the packed binary kernels require.
+_SIGN_KERNEL_QUANTIZERS = frozenset(
+    {"ste_sign", "approx_sign", "swish_sign", "magnitude_aware_sign"}
+)
+#: Input quantizers safe for the packed-weight MXU path: values must be
+#: exact small integers ({-1, 0, +1}) because activations are cast to
+#: int8 (dorefa's fractions / magnitude_aware's scales would truncate).
+_INT_INPUT_QUANTIZERS = frozenset(
+    {"ste_sign", "approx_sign", "swish_sign", "ste_tern", "ste_heaviside"}
+)
+#: Input quantizers safe for the bit-serial popcount path: strictly +-1
+#: (a 0 would be packed as the +1 bit and silently miscounted).
+_PM1_INPUT_QUANTIZERS = frozenset({"ste_sign", "approx_sign", "swish_sign"})
+
+BINARY_COMPUTE_MODES = ("mxu", "int8", "xnor", "xnor_popcount")
+
 
 def _apply_clip(kernel: jax.Array, clip: bool) -> jax.Array:
     if not clip:
@@ -33,6 +52,66 @@ def _apply_clip(kernel: jax.Array, clip: bool) -> jax.Array:
     # the fixed point and jit-friendly).
     clipped = jnp.clip(kernel, -1.0, 1.0)
     return kernel + jax.lax.stop_gradient(clipped - kernel)
+
+
+def _check_binary_compute(
+    mode: str,
+    in_q,
+    k_q,
+    input_quantizer: Quantizer,
+    kernel_quantizer: Quantizer,
+    padding,
+    layer: str,
+) -> None:
+    """Loud validation: a requested binary path must be executable as
+    requested, never silently degraded. Quantizers passed as callables are
+    trusted to honor the documented value contracts."""
+    if mode not in BINARY_COMPUTE_MODES:
+        raise ValueError(
+            f"{layer}: unknown binary_compute {mode!r}; "
+            f"choose from {BINARY_COMPUTE_MODES}."
+        )
+    if mode == "mxu":
+        return
+    problems = []
+    if in_q is None:
+        problems.append("input_quantizer is None (inputs are not binarized)")
+    if k_q is None:
+        problems.append("kernel_quantizer is None (kernel is not binarized)")
+    if not isinstance(padding, str):
+        problems.append(
+            f"padding {padding!r} is not a named mode (SAME/VALID)"
+        )
+    if mode in ("xnor", "xnor_popcount") and isinstance(kernel_quantizer, str):
+        if kernel_quantizer not in _SIGN_KERNEL_QUANTIZERS:
+            problems.append(
+                f"kernel_quantizer {kernel_quantizer!r} does not produce "
+                "sign x per-channel scale (packed kernels require one of "
+                f"{sorted(_SIGN_KERNEL_QUANTIZERS)})"
+            )
+    if isinstance(input_quantizer, str):
+        if mode == "xnor" and input_quantizer not in _INT_INPUT_QUANTIZERS:
+            problems.append(
+                f"input_quantizer {input_quantizer!r} can emit non-integer "
+                "values, which the int8 activation cast would truncate "
+                f"(xnor requires one of {sorted(_INT_INPUT_QUANTIZERS)})"
+            )
+        if (
+            mode == "xnor_popcount"
+            and input_quantizer not in _PM1_INPUT_QUANTIZERS
+        ):
+            problems.append(
+                f"input_quantizer {input_quantizer!r} can emit values other "
+                "than +-1, which bit-packing would miscount (xnor_popcount "
+                f"requires one of {sorted(_PM1_INPUT_QUANTIZERS)})"
+            )
+    if problems:
+        raise ValueError(
+            f"{layer}: binary_compute={mode!r} requested but unusable: "
+            + "; ".join(problems)
+            + ". Fix the configuration or set binary_compute='mxu' "
+            "explicitly — this layer never falls back silently."
+        )
 
 
 class QuantDense(nn.Module):
@@ -70,9 +149,23 @@ class QuantConv(nn.Module):
     """2-D convolution with optional input/kernel quantization (NHWC).
 
     ``binary_compute`` selects the executable path when BOTH operands are
-    binarized: "mxu" (default — XLA conv on +-1 values in ``dtype``) or
-    "int8" (int8 operands, int32 MXU accumulation — 2x bf16 MXU peak,
-    bit-exact, STE gradients preserved via custom_vjp).
+    binarized:
+
+    - ``"mxu"`` (default): XLA conv on +-1 values in ``dtype`` — the best
+      TRAINING path (MXU bf16).
+    - ``"int8"``: int8 operands, int32 MXU accumulation — 2x bf16 MXU
+      peak, bit-exact, STE gradients preserved via custom_vjp.
+    - ``"xnor"``: Pallas packed-weight kernel — weights bit-packed in HBM
+      (32x less weight bandwidth), unpacked per-tile in VMEM, contraction
+      on the MXU. Bit-exact vs "mxu" incl. SAME zero-padding. The
+      INFERENCE fast path for the HBM-bound regime; with
+      ``packed_weights=True`` the packed form is the stored parameter.
+    - ``"xnor_popcount"``: Pallas bit-serial VPU kernel (both operands
+      packed, XOR+popcount) — the faithful LCE-style kernel. SAME padding
+      uses ONE-padding (documented deviation; VALID is bit-exact).
+
+    A requested binary path that the configuration cannot honor raises at
+    call time — no silent fallback to the float path.
     """
 
     features: int
@@ -85,43 +178,88 @@ class QuantConv(nn.Module):
     use_bias: bool = False
     dtype: Any = jnp.float32
     binary_compute: str = "mxu"
+    #: Store ONLY the bit-packed kernel (+ per-channel scale) as params —
+    #: inference-only deployment mode (32x smaller weights on device).
+    #: Requires a packed binary_compute mode; fill the params from a
+    #: trained float checkpoint with ops.packed.pack_quantconv_params.
+    packed_weights: bool = False
+    #: Run Pallas kernels in interpreter mode (CPU tests).
+    pallas_interpret: bool = False
     kernel_init: Callable = nn.initializers.glorot_normal()
     bias_init: Callable = nn.initializers.zeros_init()
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
+        from zookeeper_tpu.ops.binary_compute import (
+            int8_conv,
+            packed_conv_infer,
+            xnor_conv,
+        )
+
         in_q = get_quantizer(self.input_quantizer)
         k_q = get_quantizer(self.kernel_quantizer)
-        kh, kw = self.kernel_size
-        kernel = self.param(
-            "kernel",
-            self.kernel_init,
-            (kh, kw, x.shape[-1], self.features),
-            jnp.float32,
+        _check_binary_compute(
+            self.binary_compute, in_q, k_q, self.input_quantizer,
+            self.kernel_quantizer, self.padding, type(self).__name__,
         )
-        if in_q is not None:
-            x = in_q(x)
-        kernel = _apply_clip(kernel, self.kernel_clip)
-        if k_q is not None:
-            kernel = k_q(kernel)
-        if (
-            self.binary_compute == "int8"
-            and in_q is not None
-            and k_q is not None
-            and isinstance(self.padding, str)
-        ):
-            from zookeeper_tpu.ops.binary_compute import int8_conv
+        kh, kw = self.kernel_size
+        ci = x.shape[-1]
 
-            y = int8_conv(x, kernel, tuple(self.strides), self.padding)
-            y = y.astype(self.dtype)
-        else:
-            y = jax.lax.conv_general_dilated(
-                x.astype(self.dtype),
-                kernel.astype(self.dtype),
-                window_strides=self.strides,
-                padding=self.padding,
-                dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        if self.packed_weights:
+            if self.binary_compute not in ("xnor", "xnor_popcount"):
+                raise ValueError(
+                    "packed_weights=True requires binary_compute='xnor' or "
+                    f"'xnor_popcount', got {self.binary_compute!r}."
+                )
+            ciw = -(-ci // 32)
+            packed = self.param(
+                "kernel_packed",
+                nn.initializers.zeros_init(),
+                (kh, kw, ciw, self.features),
+                jnp.int32,
             )
+            kscale = self.param(
+                "kernel_scale",
+                nn.initializers.ones_init(),
+                (self.features,),
+                jnp.float32,
+            )
+            if in_q is not None:
+                x = in_q(x)
+            y = packed_conv_infer(
+                x, packed, kscale, tuple(self.strides), self.padding,
+                use_popcount=self.binary_compute == "xnor_popcount",
+                interpret=self.pallas_interpret,
+            ).astype(self.dtype)
+        else:
+            kernel = self.param(
+                "kernel",
+                self.kernel_init,
+                (kh, kw, ci, self.features),
+                jnp.float32,
+            )
+            if in_q is not None:
+                x = in_q(x)
+            kernel = _apply_clip(kernel, self.kernel_clip)
+            if k_q is not None:
+                kernel = k_q(kernel)
+            if self.binary_compute == "int8":
+                y = int8_conv(x, kernel, tuple(self.strides), self.padding)
+                y = y.astype(self.dtype)
+            elif self.binary_compute in ("xnor", "xnor_popcount"):
+                y = xnor_conv(
+                    x, kernel, tuple(self.strides), self.padding,
+                    self.binary_compute == "xnor_popcount",
+                    self.pallas_interpret,
+                ).astype(self.dtype)
+            else:
+                y = jax.lax.conv_general_dilated(
+                    x.astype(self.dtype),
+                    kernel.astype(self.dtype),
+                    window_strides=self.strides,
+                    padding=self.padding,
+                    dimension_numbers=("NHWC", "HWIO", "NHWC"),
+                )
         if self.use_bias:
             bias = self.param("bias", self.bias_init, (self.features,), jnp.float32)
             y = y + bias.astype(self.dtype)

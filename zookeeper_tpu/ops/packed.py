@@ -1,0 +1,62 @@
+"""Packed-weight deployment: convert trained float checkpoints.
+
+The LCE-equivalent model converter (SURVEY.md §2.4: larq-compute-engine
+ships trained-float -> packed-binary conversion for deployment): a model
+trained with latent fp32 kernels is converted once, after which the
+on-device parameters for every binary conv are the bit-packed kernel
+(int32, 32x smaller) plus a per-output-channel scale. The converted tree
+matches the parameter structure a ``QuantConv(packed_weights=True)``
+module declares, so ``module.apply`` works unchanged.
+"""
+
+from typing import Any, Callable, Mapping, Union
+
+import jax.numpy as jnp
+
+from zookeeper_tpu.ops.binary_compute import pack_conv_kernel
+from zookeeper_tpu.ops.layers import _apply_clip
+from zookeeper_tpu.ops.quantizers import get_quantizer
+
+
+def pack_quantconv_params(
+    params: Mapping[str, Any],
+    kernel_quantizer: Union[str, Callable] = "ste_sign",
+    kernel_clip: bool = True,
+) -> dict:
+    """Convert a float params tree to the packed-weights structure.
+
+    Every 4-D ``kernel`` under a module scope named ``QuantConv*`` is
+    quantized with ``kernel_quantizer`` (+ the layer's read-time clip,
+    matching the training forward) and replaced by ``kernel_packed`` /
+    ``kernel_scale``; everything else (BN, Dense, stems) passes through
+    unchanged. The result loads into the same model built with
+    ``packed_weights=True``.
+
+    ``kernel_quantizer`` must match what the model trained with (each zoo
+    family uses one kernel quantizer throughout: QuickNet/BinaryNet
+    ``ste_sign``, Bi-Real-Net ``magnitude_aware_sign``).
+    """
+    k_q = get_quantizer(kernel_quantizer)
+    if k_q is None:
+        raise ValueError("pack_quantconv_params requires a kernel quantizer.")
+
+    def convert(node: Any, in_quantconv: bool) -> Any:
+        if isinstance(node, Mapping):
+            out = {}
+            for key, child in node.items():
+                child_is_qc = in_quantconv or key.startswith("QuantConv")
+                if (
+                    in_quantconv
+                    and key == "kernel"
+                    and getattr(child, "ndim", 0) == 4
+                ):
+                    q = k_q(_apply_clip(jnp.asarray(child), kernel_clip))
+                    packed, scale = pack_conv_kernel(q)
+                    out["kernel_packed"] = packed
+                    out["kernel_scale"] = scale
+                else:
+                    out[key] = convert(child, child_is_qc)
+            return out
+        return node
+
+    return convert(params, False)
