@@ -15,7 +15,11 @@ from zookeeper_tpu.parallel.partitioner import (
     Partitioner,
     SingleDevicePartitioner,
 )
-from zookeeper_tpu.parallel.rules import PartitionRule, match_partition_rules
+from zookeeper_tpu.parallel.rules import (
+    PartitionRule,
+    conv_model_tp_rules,
+    match_partition_rules,
+)
 from zookeeper_tpu.parallel.distributed import (
     DistributedRuntime,
     initialize_distributed,
@@ -28,6 +32,7 @@ __all__ = [
     "Partitioner",
     "PartitionRule",
     "SingleDevicePartitioner",
+    "conv_model_tp_rules",
     "initialize_distributed",
     "match_partition_rules",
 ]

@@ -8,12 +8,40 @@ layouts while data parallelism needs no rules at all.
 """
 
 import re
-from typing import Any, Sequence, Tuple
+from typing import Any, List, Sequence, Tuple
 
 import jax
 from jax.sharding import PartitionSpec
 
 PartitionRule = Tuple[str, PartitionSpec]
+
+
+def conv_model_tp_rules(model_axis: str = "model") -> List[PartitionRule]:
+    """Tensor-parallel rules for the conv model zoo (QuickNet, Bi-Real-Net,
+    BinaryNet, SimpleCnn, ResNet).
+
+    Every conv/dense kernel shards its OUTPUT-feature dim over
+    ``model_axis``; per-channel BatchNorm params and batch_stats co-shard
+    on the same axis (activations downstream of a sharded conv are
+    channel-sharded, so the stats reductions stay local to the shard).
+    XLA inserts the input-channel contraction all-reduces per layer —
+    standard conv TP over ICI. Rules are matched against full state paths,
+    so Adam moments co-shard with their parameters automatically.
+    """
+    P = PartitionSpec
+    return [
+        # Packed binary kernels [kh, kw, ci_words, co]: shard co.
+        (r"kernel_packed$", P(None, None, None, model_axis)),
+        (r"kernel_scale$", P(model_axis)),
+        # HWIO conv kernels: shard output features.
+        (r"(QuantConv|Conv)_\d+/kernel$", P(None, None, None, model_axis)),
+        # Dense kernels [in, out]: shard out (incl. the classifier head).
+        (r"(QuantDense|Dense)_\d+/kernel$", P(None, model_axis)),
+        (r"(QuantDense|Dense)_\d+/bias$", P(model_axis)),
+        # Per-channel BN params + running stats co-shard with channels.
+        (r"BatchNorm_\d+/(scale|bias)$", P(model_axis)),
+        (r"batch_stats/.*/(mean|var)$", P(model_axis)),
+    ]
 
 
 def _path_str(path) -> str:

@@ -99,6 +99,7 @@ class TrainingExperiment(Experiment):
 
     def run(self) -> Dict[str, List[Dict[str, float]]]:
         import jax
+        import jax.numpy as jnp
         import numpy as np
 
         self._log(pretty_print(self))
@@ -164,18 +165,31 @@ class TrainingExperiment(Experiment):
             )
 
             if self.validate and self.loader.dataset.validation() is not None:
-                vaccum = jax.device_get(
-                    [
-                        eval_step(state, batch)
-                        for batch in self.loader.batches(
-                            "validation", epoch=epoch, sharding=batch_sharding
-                        )
-                    ]
+                # Accumulate eval metrics ON DEVICE (one tiny add per
+                # batch) and sync one scalar dict at the end — no
+                # per-batch Python list of device buffers to hold alive,
+                # and the single device_get moves O(metrics) bytes
+                # regardless of eval length.
+                vaccum = None
+                vcount = 0
+                for batch in self.loader.batches(
+                    "validation", epoch=epoch, sharding=batch_sharding
+                ):
+                    m = eval_step(state, batch)
+                    vaccum = (
+                        m
+                        if vaccum is None
+                        else jax.tree.map(jnp.add, vaccum, m)
+                    )
+                    vcount += 1
+                vmetrics = (
+                    {
+                        k: float(v) / vcount
+                        for k, v in jax.device_get(vaccum).items()
+                    }
+                    if vcount
+                    else {}
                 )
-                vmetrics = {
-                    k: float(np.mean([m[k] for m in vaccum]))
-                    for k in (vaccum[0] if vaccum else {})
-                }
                 history["validation"].append(vmetrics)
                 line += (
                     f" | val_loss={vmetrics.get('loss', float('nan')):.4f} "
