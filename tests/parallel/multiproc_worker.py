@@ -1,0 +1,136 @@
+"""Worker process for the real multi-process distributed tests.
+
+Launched by test_multiprocess.py: N processes, each exposing 4 virtual
+CPU devices, form one JAX cluster (4N global devices) through
+``jax.distributed.initialize`` — the same bootstrap a TPU pod uses, minus
+the ICI. Exercises the code paths single-process simulation cannot:
+
+- cross-process global-array assembly (``make_array_from_process_local_data``
+  inside ``prefetch_to_device``),
+- per-host pipeline sharding (each process materializes only its slice),
+- a jitted global reduction over the multi-process mesh,
+- a sharded orbax save / restore round trip.
+
+Writes one JSON line of results; the parent asserts on it.
+"""
+
+import json
+import sys
+
+
+def main() -> None:
+    process_id = int(sys.argv[1])
+    num_processes = int(sys.argv[2])
+    coordinator = sys.argv[3]
+    out_path = sys.argv[4]
+    ckpt_dir = sys.argv[5]
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from zookeeper_tpu.parallel import initialize_distributed
+
+    initialize_distributed(
+        coordinator_address=coordinator,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    assert jax.process_index() == process_id
+    assert jax.process_count() == num_processes
+
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+    from zookeeper_tpu.core import configure
+    from zookeeper_tpu.data import DataLoader
+    from zookeeper_tpu.data.pipeline import prefetch_to_device
+
+    n_global = len(jax.devices())
+    n_local = len(jax.local_devices())
+    mesh = Mesh(np.asarray(jax.devices()), ("data",))
+    batch_sharding = NamedSharding(mesh, PartitionSpec("data"))
+
+    # Deterministic per-host pipeline: every process computes the same
+    # global permutation and reads ONLY its own contiguous slice.
+    loader = DataLoader()
+    configure(
+        loader,
+        {
+            "dataset": "SyntheticMnist",
+            "dataset.num_train_examples": 64,
+            "preprocessing": "PassThroughPreprocessing",
+            "batch_size": 16,  # global; 8 per host
+            "shuffle": False,
+            "prefetch": 2,
+        },
+        name="loader",
+    )
+    assert loader.per_host_batch_size == 16 // num_processes
+
+    # prefetch_to_device sees a mesh spanning remote devices and must
+    # assemble distributed global arrays from process-local shards.
+    batches = list(loader.batches("train", epoch=0, sharding=batch_sharding))
+    first = batches[0]["input"]
+    assert first.shape[0] == 16, first.shape  # GLOBAL batch dimension
+    assert not first.is_fully_addressable  # spans both processes
+
+    # Jitted global reduction across the multi-process mesh: both hosts
+    # must see the same global mean (collective over DCN-equivalent).
+    @jax.jit
+    def global_mean(x):
+        return jnp.mean(x.astype(jnp.float32))
+
+    means = [float(jax.device_get(global_mean(b["input"]))) for b in batches]
+
+    # Sharded orbax round trip on the global mesh.
+    import orbax.checkpoint as ocp
+
+    tree = {
+        "w": jax.device_put(
+            jnp.arange(n_global * 4, dtype=jnp.float32).reshape(n_global, 4),
+            NamedSharding(mesh, PartitionSpec("data", None)),
+        ),
+        "step": jax.device_put(
+            jnp.int32(7), NamedSharding(mesh, PartitionSpec())
+        ),
+    }
+    ckptr = ocp.CheckpointManager(
+        ckpt_dir,
+        options=ocp.CheckpointManagerOptions(max_to_keep=1),
+    )
+    ckptr.save(0, args=ocp.args.StandardSave(tree))
+    ckptr.wait_until_finished()
+
+    abstract = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding),
+        tree,
+    )
+    restored = ckptr.restore(0, args=ocp.args.StandardRestore(abstract))
+    from jax.experimental import multihost_utils
+
+    np.testing.assert_array_equal(
+        np.asarray(multihost_utils.process_allgather(restored["w"], tiled=True)),
+        np.asarray(multihost_utils.process_allgather(tree["w"], tiled=True)),
+    )
+    assert int(jax.device_get(restored["step"])) == 7
+    restored_sharded = not restored["w"].is_fully_addressable
+
+    with open(out_path, "w") as f:
+        f.write(
+            json.dumps(
+                {
+                    "process_id": process_id,
+                    "n_global_devices": n_global,
+                    "n_local_devices": n_local,
+                    "num_batches": len(batches),
+                    "means": means,
+                    "restored_sharded": restored_sharded,
+                    "ok": True,
+                }
+            )
+        )
+
+
+if __name__ == "__main__":
+    main()
