@@ -29,6 +29,7 @@ from zookeeper_tpu.data.dataset import (
     Dataset,
     MemmapDataset,
     MultiTFDSDataset,
+    SklearnDigits,
     SyntheticCifar10,
     SyntheticImageNet,
     SyntheticImageClassification,
@@ -61,6 +62,7 @@ __all__ = [
     "MultiTFDSDataset",
     "PassThroughPreprocessing",
     "Preprocessing",
+    "SklearnDigits",
     "SliceSource",
     "SyntheticCifar10",
     "SyntheticImageNet",
