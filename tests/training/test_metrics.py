@@ -1,0 +1,155 @@
+"""Metrics-writer components (SURVEY §5 metrics/observability row).
+
+The reference's metrics story is Keras callbacks (TensorBoard); here the
+sink is a configurable component. These tests pin: jsonl format, the
+no-op-when-unconfigured contract, real TensorBoard event files on disk,
+and the experiment wiring (per-epoch always, per-step under log_every).
+"""
+
+import glob
+import json
+
+import pytest
+
+from zookeeper_tpu.core import configure
+from zookeeper_tpu.training import (
+    CompositeMetricsWriter,
+    JsonlMetricsWriter,
+    MetricsWriter,
+    TensorBoardMetricsWriter,
+    TrainingExperiment,
+)
+
+
+def make_experiment(tmp_path, extra=None):
+    exp = TrainingExperiment()
+    conf = {
+        "loader.dataset": "SyntheticMnist",
+        "loader.dataset.num_train_examples": 128,
+        "loader.dataset.num_validation_examples": 32,
+        "loader.preprocessing": "ImageClassificationPreprocessing",
+        "loader.preprocessing.height": 28,
+        "loader.preprocessing.width": 28,
+        "loader.preprocessing.channels": 1,
+        "loader.host_index": 0,
+        "loader.host_count": 1,
+        "model": "Mlp",
+        "model.hidden_units": (16,),
+        "batch_size": 32,
+        "epochs": 2,
+        "verbose": False,
+        **(extra or {}),
+    }
+    configure(exp, conf, name="experiment")
+    return exp
+
+
+def test_null_writer_is_noop():
+    w = MetricsWriter()
+    configure(w, {}, name="writer")
+    w.write_scalars(0, {"loss": 1.0})
+    w.flush()
+    w.close()
+
+
+def test_jsonl_writer(tmp_path):
+    path = tmp_path / "m.jsonl"
+    w = JsonlMetricsWriter()
+    configure(w, {"path": str(path)}, name="writer")
+    w.write_scalars(1, {"loss": 0.5, "acc": 0.9})
+    w.write_scalars(2, {"loss": 0.25})
+    w.close()
+    lines = [json.loads(l) for l in path.read_text().splitlines()]
+    assert lines == [
+        {"step": 1, "loss": 0.5, "acc": 0.9},
+        {"step": 2, "loss": 0.25},
+    ]
+
+
+def test_jsonl_writer_unconfigured_is_noop(tmp_path):
+    w = JsonlMetricsWriter()
+    configure(w, {}, name="writer")
+    w.write_scalars(1, {"loss": 0.5})  # Must not raise or write anywhere.
+    w.close()
+
+
+def _read_tb_scalars(log_dir):
+    """Parse scalar summaries back out of TensorBoard event files."""
+    import tensorflow as tf
+
+    out = {}
+    for path in glob.glob(f"{log_dir}/**/events.out.tfevents*", recursive=True):
+        for raw in tf.data.TFRecordDataset(path):
+            event = tf.compat.v1.Event.FromString(raw.numpy())
+            for value in event.summary.value:
+                if value.HasField("simple_value"):
+                    out[(event.step, value.tag)] = value.simple_value
+                elif value.HasField("tensor"):
+                    out[(event.step, value.tag)] = float(
+                        tf.make_ndarray(value.tensor)
+                    )
+    return out
+
+
+def test_tensorboard_writer_round_trip(tmp_path):
+    log_dir = str(tmp_path / "tb")
+    w = TensorBoardMetricsWriter()
+    configure(w, {"log_dir": log_dir}, name="writer")
+    w.write_scalars(3, {"train/loss": 0.125})
+    w.close()
+    w.write_scalars(4, {"train/loss": 0.5})  # Post-close: contract says no-op.
+    scalars = _read_tb_scalars(log_dir)
+    assert scalars[(3, "train/loss")] == pytest.approx(0.125)
+    assert (4, "train/loss") not in scalars
+
+
+def test_composite_writer_fans_out(tmp_path):
+    w = CompositeMetricsWriter()
+    configure(
+        w,
+        {
+            "jsonl.path": str(tmp_path / "m.jsonl"),
+            "tensorboard.log_dir": str(tmp_path / "tb"),
+        },
+        name="writer",
+    )
+    w.write_scalars(7, {"loss": 2.0})
+    w.close()
+    assert json.loads((tmp_path / "m.jsonl").read_text()) == {
+        "step": 7,
+        "loss": 2.0,
+    }
+    assert _read_tb_scalars(str(tmp_path / "tb"))[(7, "loss")] == 2.0
+
+
+def test_experiment_writes_metrics(tmp_path):
+    """End-to-end: the training loop feeds the writer per epoch and (with
+    log_every) per step, with train/ and val/ prefixes."""
+    exp = make_experiment(
+        tmp_path,
+        {
+            "log_every": 2,
+            "writer.jsonl.path": str(tmp_path / "m.jsonl"),
+            "writer.tensorboard.log_dir": str(tmp_path / "tb"),
+        },
+    )
+    exp.run()
+    lines = [json.loads(l) for l in (tmp_path / "m.jsonl").read_text().splitlines()]
+    # 2 epochs x 4 steps with log_every=2 -> 2 step-records + 1 epoch-record
+    # per epoch = 6 lines total.
+    assert len(lines) == 6
+    epoch_records = [l for l in lines if "val/accuracy" in l]
+    assert len(epoch_records) == 2
+    assert {
+        "train_epoch/loss",
+        "train_epoch/accuracy",
+        "train_epoch/examples_per_sec",
+    } <= set(epoch_records[0])
+    assert epoch_records[0]["step"] == 4  # Steps-per-epoch granularity.
+    step_records = [l for l in lines if "val/accuracy" not in l]
+    assert [r["step"] for r in step_records] == [2, 4, 6, 8]
+
+    scalars = _read_tb_scalars(str(tmp_path / "tb"))
+    assert (4, "train/loss") in scalars
+    assert (4, "train_epoch/loss") in scalars
+    assert (8, "val/accuracy") in scalars

@@ -11,6 +11,12 @@ runs single-device, data-parallel, or model-parallel.
 
 from zookeeper_tpu.training.checkpoint import Checkpointer
 from zookeeper_tpu.training.experiment import Experiment, TrainingExperiment
+from zookeeper_tpu.training.metrics import (
+    CompositeMetricsWriter,
+    JsonlMetricsWriter,
+    MetricsWriter,
+    TensorBoardMetricsWriter,
+)
 from zookeeper_tpu.training.optimizer import (
     Adam,
     AdamW,
@@ -33,9 +39,13 @@ __all__ = [
     "Adam",
     "AdamW",
     "Checkpointer",
+    "CompositeMetricsWriter",
     "ConstantSchedule",
     "CosineDecay",
     "Experiment",
+    "JsonlMetricsWriter",
+    "MetricsWriter",
+    "TensorBoardMetricsWriter",
     "Momentum",
     "Optimizer",
     "Rmsprop",

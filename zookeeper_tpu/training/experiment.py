@@ -23,6 +23,7 @@ from zookeeper_tpu.models.base import Model
 from zookeeper_tpu.parallel.distributed import DistributedRuntime
 from zookeeper_tpu.parallel.partitioner import Partitioner, SingleDevicePartitioner
 from zookeeper_tpu.training.checkpoint import Checkpointer
+from zookeeper_tpu.training.metrics import CompositeMetricsWriter, MetricsWriter
 from zookeeper_tpu.training.optimizer import Adam, Optimizer
 from zookeeper_tpu.training.state import TrainState
 from zookeeper_tpu.training.step import make_eval_step, make_train_step
@@ -51,6 +52,9 @@ class TrainingExperiment(Experiment):
     partitioner: Partitioner = ComponentField(SingleDevicePartitioner)
     checkpointer: Checkpointer = ComponentField(Checkpointer)
     runtime: DistributedRuntime = ComponentField(DistributedRuntime)
+    #: Pluggable metrics sink (SURVEY §5): no-op until a leg is configured,
+    #: e.g. ``writer.tensorboard.log_dir=/tmp/tb writer.jsonl.path=m.jsonl``.
+    writer: MetricsWriter = ComponentField(CompositeMetricsWriter)
 
     epochs: int = Field(1)
     batch_size: int = Field(32)
@@ -60,7 +64,9 @@ class TrainingExperiment(Experiment):
     validate: bool = Field(True)
     log_every: int = Field(0)  # Steps between progress lines; 0 = epoch only.
     verbose: bool = Field(True)
-    #: Append one JSON line of metrics per epoch when set.
+    #: Legacy epoch-record JSONL (``{"epoch": N, ..., "val_*": ...}``).
+    #: Prefer ``writer.jsonl.path`` (step-keyed, shared schema with the
+    #: other sinks); this field is kept for config back-compat.
     metrics_file: Optional[str] = Field(None)
     #: Capture a jax.profiler trace of a few steady-state steps when set.
     profile_dir: Optional[str] = Field(None)
@@ -122,96 +128,119 @@ class TrainingExperiment(Experiment):
                 f"{int(jax.device_get(state.step))} (epoch {start_epoch})"
             )
         history: Dict[str, List[Dict[str, float]]] = {"train": [], "validation": []}
-        for epoch in range(start_epoch, self.epochs):
-            t0 = time.perf_counter()
-            accum: List[Any] = []
-            profiling = self.profile_dir is not None and epoch == start_epoch
-            for step_idx, batch in enumerate(
-                self.loader.batches("train", epoch=epoch, sharding=batch_sharding)
-            ):
-                if step_idx >= spe:
-                    break
-                if profiling and step_idx == min(4, spe - 1):
-                    jax.profiler.start_trace(self.profile_dir)
-                state, metrics = train_step(state, batch)
-                accum.append(metrics)
-                if profiling and step_idx == min(14, spe - 1):
-                    jax.block_until_ready(metrics["loss"])
-                    jax.profiler.stop_trace()
-                    profiling = False
-                if self.log_every and (step_idx + 1) % self.log_every == 0:
-                    m = {k: float(v) for k, v in metrics.items()}
-                    self._log(
-                        f"  step {step_idx + 1}/{spe} "
-                        f"loss={m['loss']:.4f} acc={m['accuracy']:.4f}"
-                    )
-            # One host sync per epoch: pull all accumulated device scalars
-            # in a single device_get (each separate transfer pays the full
-            # host<->device round trip, ~100ms on remote-tunnel TPUs).
-            host_accum = jax.device_get(accum)
-            epoch_metrics = {
-                k: float(np.mean([m[k] for m in host_accum]))
-                for k in (host_accum[0] if host_accum else {})
-            }
-            dt = time.perf_counter() - t0
-            examples = len(accum) * self.loader.batch_size
-            epoch_metrics["examples_per_sec"] = examples / dt if dt > 0 else 0.0
-            history["train"].append(epoch_metrics)
-            line = (
-                f"epoch {epoch + 1}/{self.epochs} "
-                f"loss={epoch_metrics.get('loss', float('nan')):.4f} "
-                f"acc={epoch_metrics.get('accuracy', float('nan')):.4f} "
-                f"({epoch_metrics['examples_per_sec']:.0f} ex/s)"
-            )
-
-            if self.validate and self.loader.dataset.validation() is not None:
-                # Accumulate eval metrics ON DEVICE (one tiny add per
-                # batch) and sync one scalar dict at the end — no
-                # per-batch Python list of device buffers to hold alive,
-                # and the single device_get moves O(metrics) bytes
-                # regardless of eval length.
-                vaccum = None
-                vcount = 0
-                for batch in self.loader.batches(
-                    "validation", epoch=epoch, sharding=batch_sharding
+        try:
+            for epoch in range(start_epoch, self.epochs):
+                t0 = time.perf_counter()
+                accum: List[Any] = []
+                profiling = self.profile_dir is not None and epoch == start_epoch
+                for step_idx, batch in enumerate(
+                    self.loader.batches("train", epoch=epoch, sharding=batch_sharding)
                 ):
-                    m = eval_step(state, batch)
-                    vaccum = (
-                        m
-                        if vaccum is None
-                        else jax.tree.map(jnp.add, vaccum, m)
-                    )
-                    vcount += 1
-                vmetrics = (
-                    {
-                        k: float(v) / vcount
-                        for k, v in jax.device_get(vaccum).items()
-                    }
-                    if vcount
-                    else {}
+                    if step_idx >= spe:
+                        break
+                    if profiling and step_idx == min(4, spe - 1):
+                        jax.profiler.start_trace(self.profile_dir)
+                    state, metrics = train_step(state, batch)
+                    accum.append(metrics)
+                    if profiling and step_idx == min(14, spe - 1):
+                        jax.block_until_ready(metrics["loss"])
+                        jax.profiler.stop_trace()
+                        profiling = False
+                    if self.log_every and (step_idx + 1) % self.log_every == 0:
+                        m = {k: float(v) for k, v in metrics.items()}
+                        self._log(
+                            f"  step {step_idx + 1}/{spe} "
+                            f"loss={m['loss']:.4f} acc={m['accuracy']:.4f}"
+                        )
+                        # Per-step scalars ride the host pull that log_every
+                        # already paid for — finer than epoch granularity at
+                        # zero extra device syncs.
+                        self.writer.write_scalars(
+                            epoch * spe + step_idx + 1,
+                            {f"train/{k}": v for k, v in m.items()},
+                        )
+                # One host sync per epoch: pull all accumulated device scalars
+                # in a single device_get (each separate transfer pays the full
+                # host<->device round trip, ~100ms on remote-tunnel TPUs).
+                host_accum = jax.device_get(accum)
+                epoch_metrics = {
+                    k: float(np.mean([m[k] for m in host_accum]))
+                    for k in (host_accum[0] if host_accum else {})
+                }
+                dt = time.perf_counter() - t0
+                examples = len(accum) * self.loader.batch_size
+                epoch_metrics["examples_per_sec"] = examples / dt if dt > 0 else 0.0
+                history["train"].append(epoch_metrics)
+                line = (
+                    f"epoch {epoch + 1}/{self.epochs} "
+                    f"loss={epoch_metrics.get('loss', float('nan')):.4f} "
+                    f"acc={epoch_metrics.get('accuracy', float('nan')):.4f} "
+                    f"({epoch_metrics['examples_per_sec']:.0f} ex/s)"
                 )
-                history["validation"].append(vmetrics)
-                line += (
-                    f" | val_loss={vmetrics.get('loss', float('nan')):.4f} "
-                    f"val_acc={vmetrics.get('accuracy', float('nan')):.4f}"
-                )
-            self._log(line)
 
-            if self.metrics_file:
-                record = {"epoch": epoch, **epoch_metrics}
-                if history["validation"]:
-                    record.update(
-                        {f"val_{k}": v for k, v in history["validation"][-1].items()}
+                if self.validate and self.loader.dataset.validation() is not None:
+                    # Accumulate eval metrics ON DEVICE (one tiny add per
+                    # batch) and sync one scalar dict at the end — no
+                    # per-batch Python list of device buffers to hold alive,
+                    # and the single device_get moves O(metrics) bytes
+                    # regardless of eval length.
+                    vaccum = None
+                    vcount = 0
+                    for batch in self.loader.batches(
+                        "validation", epoch=epoch, sharding=batch_sharding
+                    ):
+                        m = eval_step(state, batch)
+                        vaccum = (
+                            m
+                            if vaccum is None
+                            else jax.tree.map(jnp.add, vaccum, m)
+                        )
+                        vcount += 1
+                    vmetrics = (
+                        {
+                            k: float(v) / vcount
+                            for k, v in jax.device_get(vaccum).items()
+                        }
+                        if vcount
+                        else {}
                     )
-                with open(self.metrics_file, "a") as f:
-                    f.write(json.dumps(record) + "\n")
+                    history["validation"].append(vmetrics)
+                    line += (
+                        f" | val_loss={vmetrics.get('loss', float('nan')):.4f} "
+                        f"val_acc={vmetrics.get('accuracy', float('nan')):.4f}"
+                    )
+                self._log(line)
 
-            if (
-                self.checkpointer.enabled
-                and (epoch + 1) % self.checkpointer.save_every_epochs == 0
-            ):
-                self.checkpointer.save(state)
+                if self.metrics_file:
+                    record = {"epoch": epoch, **epoch_metrics}
+                    if history["validation"]:
+                        record.update(
+                            {f"val_{k}": v for k, v in history["validation"][-1].items()}
+                        )
+                    with open(self.metrics_file, "a") as f:
+                        f.write(json.dumps(record) + "\n")
 
-        self.checkpointer.wait()
+                # Epoch aggregates use a distinct prefix so they never collide
+                # with the per-step train/ tags at the same global step (two
+                # different values on one TensorBoard tag renders as a zigzag).
+                scalars = {f"train_epoch/{k}": v for k, v in epoch_metrics.items()}
+                if self.validate and history["validation"]:
+                    scalars.update(
+                        {f"val/{k}": v for k, v in history["validation"][-1].items()}
+                    )
+                self.writer.write_scalars((epoch + 1) * spe, scalars)
+
+                if (
+                    self.checkpointer.enabled
+                    and (epoch + 1) % self.checkpointer.save_every_epochs == 0
+                ):
+                    self.checkpointer.save(state)
+
+        finally:
+            # Crash-safe teardown: pending async checkpoint saves
+            # complete and buffered metrics (TensorBoard events)
+            # flush even when an epoch raises mid-run.
+            self.checkpointer.wait()
+            self.writer.close()
         self.final_state = state
         return history

@@ -1,0 +1,162 @@
+"""Metrics writers: pluggable observability for the training loop.
+
+SURVEY.md §5 (metrics/logging row): the reference delegates metrics to
+Keras ``fit`` progress plus user callbacks (e.g.
+``tf.keras.callbacks.TensorBoard``); the TPU-native replacement makes the
+writer a first-class configurable component so ``TrainingExperiment``
+emits scalars to any sink without owning file formats itself.
+
+Writers receive **host floats** (the loop performs one ``device_get`` per
+epoch — see ``experiment.py``); nothing here touches device buffers, so a
+writer can never add host<->device syncs to the hot loop.
+
+- ``MetricsWriter`` — base component and the null sink (safe default).
+- ``JsonlMetricsWriter`` — one JSON object per line; the round-1
+  ``metrics_file`` behavior, now a component.
+- ``TensorBoardMetricsWriter`` — TensorBoard event files via
+  ``clu.metric_writers`` when available, else ``tf.summary`` directly
+  (both are host-side TF/CLU code; JAX arrays were already pulled to
+  host).
+- ``CompositeMetricsWriter`` — fan-out to jsonl + TensorBoard from one
+  config node.
+"""
+
+import json
+import os
+from typing import Any, Mapping, Optional
+
+from zookeeper_tpu.core import ComponentField, Field, component
+
+__all__ = [
+    "CompositeMetricsWriter",
+    "JsonlMetricsWriter",
+    "MetricsWriter",
+    "TensorBoardMetricsWriter",
+]
+
+
+@component
+class MetricsWriter:
+    """Null metrics sink; base class for real writers.
+
+    The contract (all writers):
+
+    - ``write_scalars(step, values)``: record a flat ``{name: float}``
+      mapping at an integer global step. Names may be dotted/slashed for
+      grouping (``train/loss``).
+    - ``flush()``: make everything written so far durable.
+    - ``close()``: flush and release resources; further writes are no-ops.
+    """
+
+    def write_scalars(self, step: int, values: Mapping[str, float]) -> None:
+        pass
+
+    def flush(self) -> None:
+        pass
+
+    def close(self) -> None:
+        self.flush()
+
+
+@component
+class JsonlMetricsWriter(MetricsWriter):
+    """Appends one ``{"step": N, ...values}`` JSON line per write.
+
+    With ``path=None`` the writer is a no-op, so it can sit in a config
+    tree unconditionally and be switched on with one CLI key
+    (``writer.path=metrics.jsonl``).
+    """
+
+    path: Optional[str] = Field(None)
+
+    def write_scalars(self, step: int, values: Mapping[str, float]) -> None:
+        if not self.path:
+            return
+        record = {"step": int(step)}
+        record.update({k: float(v) for k, v in values.items()})
+        with open(self.path, "a") as f:
+            f.write(json.dumps(record) + "\n")
+
+
+@component
+class TensorBoardMetricsWriter(MetricsWriter):
+    """TensorBoard event-file writer.
+
+    Prefers ``clu.metric_writers`` (the standard JAX-ecosystem layer,
+    installed here) and falls back to raw ``tf.summary``; both produce
+    identical event files. With ``log_dir=None`` the writer is a no-op.
+    """
+
+    log_dir: Optional[str] = Field(None)
+
+    def _writer(self) -> Any:
+        w = getattr(self, "_writer_cache", None)
+        if w is not None:
+            return w
+        if not self.log_dir or getattr(self, "_closed", False):
+            return None
+        os.makedirs(self.log_dir, exist_ok=True)
+        try:
+            from clu import metric_writers
+
+            w = ("clu", metric_writers.SummaryWriter(self.log_dir))
+        except ImportError:  # pragma: no cover - clu is installed here
+            import tensorflow as tf
+
+            w = ("tf", tf.summary.create_file_writer(self.log_dir))
+        object.__setattr__(self, "_writer_cache", w)
+        return w
+
+    def write_scalars(self, step: int, values: Mapping[str, float]) -> None:
+        w = self._writer()
+        if w is None:
+            return
+        kind, writer = w
+        floats = {k: float(v) for k, v in values.items()}
+        if kind == "clu":
+            writer.write_scalars(int(step), floats)
+        else:  # pragma: no cover - exercised only without clu
+            import tensorflow as tf
+
+            with writer.as_default(step=int(step)):
+                for k, v in floats.items():
+                    tf.summary.scalar(k, v)
+
+    def flush(self) -> None:
+        w = getattr(self, "_writer_cache", None)
+        if w is not None:
+            w[1].flush()
+
+    def close(self) -> None:
+        w = getattr(self, "_writer_cache", None)
+        if w is not None:
+            w[1].flush()
+            w[1].close()
+            object.__setattr__(self, "_writer_cache", None)
+        object.__setattr__(self, "_closed", True)
+
+
+@component
+class CompositeMetricsWriter(MetricsWriter):
+    """Fans every call out to a jsonl and a TensorBoard writer.
+
+    Either leg disables itself when unconfigured (``path=None`` /
+    ``log_dir=None``), so this is a safe default sink for
+    ``TrainingExperiment``: zero overhead until a CLI key turns a leg on
+    (``writer.jsonl.path=... writer.tensorboard.log_dir=...``).
+    """
+
+    jsonl: JsonlMetricsWriter = ComponentField(JsonlMetricsWriter)
+    tensorboard: TensorBoardMetricsWriter = ComponentField(TensorBoardMetricsWriter)
+
+    def write_scalars(self, step: int, values: Mapping[str, float]) -> None:
+        self.jsonl.write_scalars(step, values)
+        self.tensorboard.write_scalars(step, values)
+
+    def flush(self) -> None:
+        self.jsonl.flush()
+        self.tensorboard.flush()
+
+    def close(self) -> None:
+        self.jsonl.close()
+        self.tensorboard.close()
