@@ -12,25 +12,41 @@ from zookeeper_tpu.models.base import Model
 from zookeeper_tpu.models.simple import Mlp, SimpleCnn
 from zookeeper_tpu.models.binary import (
     BinaryAlexNet,
+    BinaryDenseNet28,
+    BinaryDenseNet37,
+    BinaryDenseNet37Dilated,
+    BinaryDenseNet45,
     BinaryNet,
+    BinaryResNetE18,
     BiRealNet,
+    DoReFaNet,
     QuickNet,
     QuickNetLarge,
     QuickNetSmall,
+    RealToBinaryNet,
+    XNORNet,
 )
 from zookeeper_tpu.models.resnet import ResNet50, ResNet101, ResNet152
 
 __all__ = [
     "BinaryAlexNet",
+    "BinaryDenseNet28",
+    "BinaryDenseNet37",
+    "BinaryDenseNet37Dilated",
+    "BinaryDenseNet45",
     "BinaryNet",
+    "BinaryResNetE18",
     "BiRealNet",
+    "DoReFaNet",
     "Mlp",
     "Model",
     "QuickNet",
     "QuickNetLarge",
     "QuickNetSmall",
+    "RealToBinaryNet",
     "ResNet50",
     "ResNet101",
     "ResNet152",
     "SimpleCnn",
+    "XNORNet",
 ]

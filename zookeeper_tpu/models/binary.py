@@ -12,6 +12,7 @@ BN-hungry), first/last layers full-precision (standard practice — they
 carry too much information to binarize).
 """
 
+from functools import partial
 from typing import Any, Sequence, Tuple
 
 import flax.linen as nn
@@ -21,6 +22,7 @@ import jax.numpy as jnp
 from zookeeper_tpu.core import Field, component
 from zookeeper_tpu.models.base import Model
 from zookeeper_tpu.ops.layers import QuantConv, QuantDense
+from zookeeper_tpu.ops.quantizers import dorefa
 
 
 def _bn(training: bool, dtype=jnp.float32):
@@ -353,3 +355,464 @@ class QuickNetLarge(QuickNet):
     """QuickNet-Large (~66.9% top-1 target; the north-star workload)."""
 
     blocks_per_section: Sequence[int] = Field((6, 8, 12, 6))
+
+
+class _ResNetEBlock(nn.Module):
+    """BinaryResNetE block (Bethge et al. 2019, "Back to Simplicity"):
+    sign -> binary 3x3 conv -> BN -> + shortcut, where the downsample
+    shortcut is PARAMETER-FREE: 2x2 average pool + channel duplication
+    (concat), keeping the skip path fully real-valued without fp convs.
+    """
+
+    features: int
+    strides: int
+    dtype: Any
+    binary_compute: str = "mxu"
+    packed_weights: bool = False
+    pallas_interpret: bool = False
+
+    @nn.compact
+    def __call__(self, x, training: bool = False):
+        shortcut = x
+        if self.strides > 1:
+            shortcut = nn.avg_pool(
+                x, (2, 2), strides=(self.strides, self.strides), padding="SAME"
+            )
+        if shortcut.shape[-1] != self.features:
+            assert self.features % shortcut.shape[-1] == 0
+            reps = self.features // shortcut.shape[-1]
+            shortcut = jnp.concatenate([shortcut] * reps, axis=-1)
+        y = QuantConv(
+            self.features, (3, 3), strides=(self.strides, self.strides),
+            input_quantizer="ste_sign", kernel_quantizer="ste_sign",
+            dtype=self.dtype, binary_compute=self.binary_compute,
+            packed_weights=self.packed_weights,
+            pallas_interpret=self.pallas_interpret,
+        )(x)
+        y = _bn(training, self.dtype)(y)
+        return y + shortcut
+
+
+class _BinaryResNetEModule(nn.Module):
+    """BinaryResNetE18: 7x7 fp stem, 4 sections of ResNetE blocks."""
+
+    blocks_per_section: Tuple[int, ...]
+    section_features: Tuple[int, ...]
+    num_classes: int
+    dtype: Any
+    binary_compute: str = "mxu"
+    packed_weights: bool = False
+    pallas_interpret: bool = False
+
+    @nn.compact
+    def __call__(self, x, training: bool = False):
+        d = self.dtype
+        x = nn.Conv(self.section_features[0], (7, 7), strides=(2, 2),
+                    padding="SAME", use_bias=False, dtype=d)(x.astype(d))
+        x = _bn(training, d)(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for s, (n, feat) in enumerate(
+            zip(self.blocks_per_section, self.section_features)
+        ):
+            for b in range(n):
+                strides = 2 if (b == 0 and s > 0) else 1
+                x = _ResNetEBlock(
+                    feat, strides, d, self.binary_compute,
+                    self.packed_weights, self.pallas_interpret,
+                )(x, training)
+        x = nn.relu(x)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=d)(x)
+        return x.astype(jnp.float32)
+
+
+@component
+class BinaryResNetE18(Model):
+    """BinaryResNetE18 (larq-zoo literature family; ~58% top-1 target).
+
+    Distinguishing feature vs Bi-Real-Net: parameter-free downsample
+    shortcuts (avgpool + channel duplication) and plain ste_sign on both
+    activations and weights.
+    """
+
+    blocks_per_section: Sequence[int] = Field((4, 4, 4, 4))
+    section_features: Sequence[int] = Field((64, 128, 256, 512))
+    binary_compute: str = Field("mxu")
+    packed_weights: bool = Field(False)
+    pallas_interpret: bool = Field(False)
+
+    def build(self, input_shape, num_classes: int) -> nn.Module:
+        return _BinaryResNetEModule(
+            blocks_per_section=tuple(self.blocks_per_section),
+            section_features=tuple(self.section_features),
+            num_classes=num_classes,
+            dtype=self.dtype(),
+            binary_compute=self.binary_compute,
+            packed_weights=self.packed_weights,
+            pallas_interpret=self.pallas_interpret,
+        )
+
+
+def _round_channels(c: float, multiple: int = 32) -> int:
+    return max(multiple, int(c / multiple + 0.5) * multiple)
+
+
+class _BinaryDenseNetModule(nn.Module):
+    """BinaryDenseNet (Bethge et al. 2019): dense blocks of binary 3x3
+    convs whose outputs CONCATENATE onto the feature stack (growth), with
+    full-precision 1x1 reduction convs at block transitions.
+
+    Dense connectivity sidesteps the information bottleneck of binary
+    residual adds: every layer sees all earlier feature maps at full
+    value resolution. Transitions follow the paper: BN -> relu ->
+    (maxpool if downsampling) -> fp 1x1 conv with reduction rate;
+    reduced widths are rounded to multiples of 32 (documented deviation
+    — keeps every GEMM MXU-tile-aligned).
+    """
+
+    layers_per_block: Tuple[int, ...]
+    reduction: Tuple[float, ...]
+    dilation: Tuple[int, ...]
+    growth_rate: int
+    initial_features: int
+    num_classes: int
+    dtype: Any
+    binary_compute: str = "mxu"
+    packed_weights: bool = False
+    pallas_interpret: bool = False
+
+    @nn.compact
+    def __call__(self, x, training: bool = False):
+        d = self.dtype
+        x = nn.Conv(self.initial_features, (7, 7), strides=(2, 2),
+                    padding="SAME", use_bias=False, dtype=d)(x.astype(d))
+        x = _bn(training, d)(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for block, n_layers in enumerate(self.layers_per_block):
+            dil = self.dilation[block]
+            for _ in range(n_layers):
+                y = _bn(training, d)(x)
+                y = QuantConv(
+                    self.growth_rate, (3, 3),
+                    kernel_dilation=(dil, dil),
+                    input_quantizer="ste_sign", kernel_quantizer="ste_sign",
+                    dtype=d, binary_compute=self.binary_compute,
+                    packed_weights=self.packed_weights,
+                    pallas_interpret=self.pallas_interpret,
+                )(y)
+                x = jnp.concatenate([x, y], axis=-1)
+            if block < len(self.layers_per_block) - 1:
+                x = _bn(training, d)(x)
+                x = nn.relu(x)
+                if self.dilation[block + 1] == 1:
+                    x = nn.max_pool(x, (2, 2), strides=(2, 2), padding="SAME")
+                x = nn.Conv(
+                    _round_channels(x.shape[-1] / self.reduction[block]),
+                    (1, 1), use_bias=False, dtype=d,
+                )(x)
+        x = _bn(training, d)(x)
+        x = nn.relu(x)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=d)(x)
+        return x.astype(jnp.float32)
+
+
+@component
+class BinaryDenseNet28(Model):
+    """BinaryDenseNet-28 (~60.7% top-1 target)."""
+
+    layers_per_block: Sequence[int] = Field((6, 6, 6, 5))
+    reduction: Sequence[float] = Field((2.7, 2.7, 2.2))
+    #: Per-block conv dilation; blocks with dilation > 1 skip the
+    #: transition downsample (the dilated variants trade stride for
+    #: receptive field).
+    dilation: Sequence[int] = Field((1, 1, 1, 1))
+    growth_rate: int = Field(64)
+    initial_features: int = Field(64)
+    binary_compute: str = Field("mxu")
+    packed_weights: bool = Field(False)
+    pallas_interpret: bool = Field(False)
+
+    def build(self, input_shape, num_classes: int) -> nn.Module:
+        return _BinaryDenseNetModule(
+            layers_per_block=tuple(self.layers_per_block),
+            reduction=tuple(self.reduction),
+            dilation=tuple(self.dilation),
+            growth_rate=self.growth_rate,
+            initial_features=self.initial_features,
+            num_classes=num_classes,
+            dtype=self.dtype(),
+            binary_compute=self.binary_compute,
+            packed_weights=self.packed_weights,
+            pallas_interpret=self.pallas_interpret,
+        )
+
+
+@component
+class BinaryDenseNet37(BinaryDenseNet28):
+    """BinaryDenseNet-37 (~62.5% top-1 target)."""
+
+    layers_per_block: Sequence[int] = Field((6, 8, 12, 6))
+    reduction: Sequence[float] = Field((3.3, 3.3, 4.0))
+
+
+@component
+class BinaryDenseNet37Dilated(BinaryDenseNet37):
+    """BinaryDenseNet-37 with dilated (stride-free) last two stages
+    (~63.7% top-1 target; more FLOPs at higher resolution)."""
+
+    dilation: Sequence[int] = Field((1, 1, 2, 4))
+
+
+@component
+class BinaryDenseNet45(BinaryDenseNet28):
+    """BinaryDenseNet-45 (~63.7% top-1 target)."""
+
+    layers_per_block: Sequence[int] = Field((6, 12, 14, 8))
+    reduction: Sequence[float] = Field((2.7, 3.3, 4.0))
+
+
+class _XnorNetModule(nn.Module):
+    """XNOR-Net (Rastegari et al. 2016): binarized AlexNet where binary
+    weights carry a per-output-filter fp scale alpha = mean|W| (exactly
+    the magnitude_aware_sign quantizer's scaling) and the layer order is
+    re-arranged to BN -> binarize -> conv -> pool."""
+
+    num_classes: int
+    dtype: Any
+    binary_compute: str = "mxu"
+    packed_weights: bool = False
+    pallas_interpret: bool = False
+
+    @nn.compact
+    def __call__(self, x, training: bool = False):
+        d = self.dtype
+
+        def qconv(x, feat, k, **kw):
+            return QuantConv(
+                feat, (k, k), input_quantizer="ste_sign",
+                kernel_quantizer="magnitude_aware_sign", dtype=d,
+                binary_compute=self.binary_compute,
+                packed_weights=self.packed_weights,
+                pallas_interpret=self.pallas_interpret, **kw,
+            )(x)
+
+        # Stem: fp conv (never binarized), then the XNOR-Net BN->sign->conv
+        # ordering for every binary layer.
+        x = nn.Conv(96, (11, 11), strides=(4, 4), padding="VALID",
+                    use_bias=False, dtype=d)(x.astype(d))
+        x = _bn(training, d)(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        x = qconv(x, 256, 5)
+        x = _bn(training, d)(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        x = qconv(x, 384, 3)
+        x = _bn(training, d)(x)
+        x = qconv(x, 384, 3)
+        x = _bn(training, d)(x)
+        x = qconv(x, 256, 3)
+        x = _bn(training, d)(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        x = x.reshape((x.shape[0], -1))
+        for u in (4096, 4096):
+            x = QuantDense(
+                u, input_quantizer="ste_sign",
+                kernel_quantizer="magnitude_aware_sign",
+                use_bias=False, dtype=d,
+            )(x)
+            x = _bn(training, d)(x)
+        x = nn.Dense(self.num_classes, dtype=d)(x)
+        return x.astype(jnp.float32)
+
+
+@component
+class XNORNet(Model):
+    """XNOR-Net AlexNet (~44-45% top-1 target)."""
+
+    binary_compute: str = Field("mxu")
+    packed_weights: bool = Field(False)
+    pallas_interpret: bool = Field(False)
+
+    def build(self, input_shape, num_classes: int) -> nn.Module:
+        return _XnorNetModule(
+            num_classes=num_classes, dtype=self.dtype(),
+            binary_compute=self.binary_compute,
+            packed_weights=self.packed_weights,
+            pallas_interpret=self.pallas_interpret,
+        )
+
+
+class _DoReFaNetModule(nn.Module):
+    """DoReFa-Net (Zhou et al. 2016), w1/a2 configuration: 1-bit scaled
+    weights, 2-bit uniform activations (the ``dorefa`` quantizer: clip to
+    [0,1], quantize to 2^k-1 levels, STE gradient).
+
+    Weight scaling uses magnitude_aware_sign (per-output-filter mean|W|);
+    the paper scales by the LAYER mean — documented deviation (per-filter
+    is strictly more expressive and costs nothing on the MXU path).
+    Multi-bit activations preclude the packed binary compute paths, so the
+    convs run mxu/int8 only.
+    """
+
+    num_classes: int
+    dtype: Any
+    activation_bits: int = 2
+
+    @nn.compact
+    def __call__(self, x, training: bool = False):
+        d = self.dtype
+        act_q = partial(dorefa, k_bit=self.activation_bits)
+
+        def qconv(x, feat, k, **kw):
+            return QuantConv(
+                feat, (k, k), input_quantizer=act_q,
+                kernel_quantizer="magnitude_aware_sign", dtype=d, **kw,
+            )(x)
+
+        x = nn.Conv(96, (12, 12), strides=(4, 4), padding="VALID",
+                    use_bias=False, dtype=d)(x.astype(d))
+        x = _bn(training, d)(x)
+        x = qconv(x, 256, 5)
+        x = _bn(training, d)(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        x = qconv(x, 384, 3)
+        x = _bn(training, d)(x)
+        x = qconv(x, 384, 3)
+        x = _bn(training, d)(x)
+        x = qconv(x, 256, 3)
+        x = _bn(training, d)(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        x = x.reshape((x.shape[0], -1))
+        for u in (4096, 4096):
+            x = QuantDense(
+                u, input_quantizer=act_q,
+                kernel_quantizer="magnitude_aware_sign",
+                use_bias=False, dtype=d,
+            )(x)
+            x = _bn(training, d)(x)
+        x = nn.Dense(self.num_classes, dtype=d)(x)
+        return x.astype(jnp.float32)
+
+
+@component
+class DoReFaNet(Model):
+    """DoReFa-Net w1/a2 (~53% top-1 target)."""
+
+    activation_bits: int = Field(2)
+
+    def build(self, input_shape, num_classes: int) -> nn.Module:
+        return _DoReFaNetModule(
+            num_classes=num_classes, dtype=self.dtype(),
+            activation_bits=self.activation_bits,
+        )
+
+
+class _R2BBlock(nn.Module):
+    """Real-to-Binary block (Martinez et al. 2020): each binary 3x3 conv
+    output is rescaled by a DATA-DRIVEN per-channel gate computed from the
+    conv's real-valued input (squeeze-and-excite shaped: global avgpool ->
+    fp bottleneck MLP -> sigmoid), then joined by a Bi-Real-style
+    real-valued shortcut.
+    """
+
+    features: int
+    strides: int
+    dtype: Any
+    gate_reduction: int = 8
+    binary_compute: str = "mxu"
+    packed_weights: bool = False
+    pallas_interpret: bool = False
+
+    @nn.compact
+    def __call__(self, x, training: bool = False):
+        d = self.dtype
+        shortcut = x
+        if self.strides > 1 or x.shape[-1] != self.features:
+            if self.strides > 1:
+                shortcut = nn.avg_pool(
+                    shortcut, (2, 2), strides=(self.strides, self.strides),
+                    padding="SAME",
+                )
+            shortcut = nn.Conv(
+                self.features, (1, 1), use_bias=False, dtype=d
+            )(shortcut)
+            shortcut = _bn(training, d)(shortcut)
+        # Gate from the REAL input (cheap fp path, O(C^2/r) params).
+        g = jnp.mean(x, axis=(1, 2))
+        g = nn.Dense(
+            max(1, x.shape[-1] // self.gate_reduction), dtype=d
+        )(g)
+        g = nn.relu(g)
+        g = nn.Dense(self.features, dtype=d)(g)
+        g = jax.nn.sigmoid(g)
+        y = QuantConv(
+            self.features, (3, 3), strides=(self.strides, self.strides),
+            input_quantizer="ste_sign", kernel_quantizer="ste_sign",
+            dtype=d, binary_compute=self.binary_compute,
+            packed_weights=self.packed_weights,
+            pallas_interpret=self.pallas_interpret,
+        )(x)
+        y = y * g[:, None, None, :]
+        y = _bn(training, d)(y)
+        return y + shortcut
+
+
+class _RealToBinaryNetModule(nn.Module):
+    """Real-to-Binary-Net: ResNet-18 topology of R2B blocks (one shortcut
+    per binary conv, as in Bi-Real)."""
+
+    blocks_per_section: Tuple[int, ...]
+    section_features: Tuple[int, ...]
+    num_classes: int
+    dtype: Any
+    gate_reduction: int = 8
+    binary_compute: str = "mxu"
+    packed_weights: bool = False
+    pallas_interpret: bool = False
+
+    @nn.compact
+    def __call__(self, x, training: bool = False):
+        d = self.dtype
+        x = nn.Conv(self.section_features[0], (7, 7), strides=(2, 2),
+                    padding="SAME", use_bias=False, dtype=d)(x.astype(d))
+        x = _bn(training, d)(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for s, (n, feat) in enumerate(
+            zip(self.blocks_per_section, self.section_features)
+        ):
+            for b in range(n):
+                strides = 2 if (b == 0 and s > 0) else 1
+                x = _R2BBlock(
+                    feat, strides, d, self.gate_reduction,
+                    self.binary_compute, self.packed_weights,
+                    self.pallas_interpret,
+                )(x, training)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=d)(x)
+        return x.astype(jnp.float32)
+
+
+@component
+class RealToBinaryNet(Model):
+    """Real-to-Binary-Net (~65% top-1 target with the paper's multi-stage
+    KD recipe; the architecture alone trains standalone here)."""
+
+    blocks_per_section: Sequence[int] = Field((4, 4, 4, 4))
+    section_features: Sequence[int] = Field((64, 128, 256, 512))
+    gate_reduction: int = Field(8)
+    binary_compute: str = Field("mxu")
+    packed_weights: bool = Field(False)
+    pallas_interpret: bool = Field(False)
+
+    def build(self, input_shape, num_classes: int) -> nn.Module:
+        return _RealToBinaryNetModule(
+            blocks_per_section=tuple(self.blocks_per_section),
+            section_features=tuple(self.section_features),
+            num_classes=num_classes,
+            dtype=self.dtype(),
+            gate_reduction=self.gate_reduction,
+            binary_compute=self.binary_compute,
+            packed_weights=self.packed_weights,
+            pallas_interpret=self.pallas_interpret,
+        )

@@ -172,6 +172,10 @@ class QuantConv(nn.Module):
     kernel_size: Tuple[int, int] = (3, 3)
     strides: Tuple[int, int] = (1, 1)
     padding: Union[str, Sequence[Tuple[int, int]]] = "SAME"
+    #: Atrous/dilated conv (e.g. the dilated BinaryDenseNet variants).
+    #: Supported on the "mxu" path only; the specialized int8/packed
+    #: kernels reject it loudly.
+    kernel_dilation: Tuple[int, int] = (1, 1)
     input_quantizer: Quantizer = None
     kernel_quantizer: Quantizer = None
     kernel_clip: bool = True
@@ -202,6 +206,13 @@ class QuantConv(nn.Module):
             self.binary_compute, in_q, k_q, self.input_quantizer,
             self.kernel_quantizer, self.padding, type(self).__name__,
         )
+        if tuple(self.kernel_dilation) != (1, 1) and self.binary_compute != "mxu":
+            raise ValueError(
+                f"{type(self).__name__}: kernel_dilation="
+                f"{tuple(self.kernel_dilation)} is only supported with "
+                f"binary_compute='mxu' (got {self.binary_compute!r}) — "
+                "no silent fallback."
+            )
         kh, kw = self.kernel_size
         ci = x.shape[-1]
 
@@ -258,6 +269,7 @@ class QuantConv(nn.Module):
                     kernel.astype(self.dtype),
                     window_strides=self.strides,
                     padding=self.padding,
+                    rhs_dilation=tuple(self.kernel_dilation),
                     dimension_numbers=("NHWC", "HWIO", "NHWC"),
                 )
         if self.use_bias:

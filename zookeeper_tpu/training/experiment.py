@@ -238,9 +238,11 @@ class TrainingExperiment(Experiment):
 
         finally:
             # Crash-safe teardown: pending async checkpoint saves
-            # complete and buffered metrics (TensorBoard events)
-            # flush even when an epoch raises mid-run.
+            # complete and buffered metrics (TensorBoard events) become
+            # durable even when an epoch raises mid-run. flush, not
+            # close: the writer is a long-lived component and run() may
+            # be called again on the same experiment.
             self.checkpointer.wait()
-            self.writer.close()
+            self.writer.flush()
         self.final_state = state
         return history

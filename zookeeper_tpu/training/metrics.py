@@ -70,12 +70,15 @@ class JsonlMetricsWriter(MetricsWriter):
     path: Optional[str] = Field(None)
 
     def write_scalars(self, step: int, values: Mapping[str, float]) -> None:
-        if not self.path:
+        if not self.path or getattr(self, "_closed", False):
             return
         record = {"step": int(step)}
         record.update({k: float(v) for k, v in values.items()})
         with open(self.path, "a") as f:
             f.write(json.dumps(record) + "\n")
+
+    def close(self) -> None:
+        object.__setattr__(self, "_closed", True)
 
 
 @component
