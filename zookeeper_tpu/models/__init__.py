@@ -27,8 +27,11 @@ from zookeeper_tpu.models.binary import (
     XNORNet,
 )
 from zookeeper_tpu.models.resnet import ResNet50, ResNet101, ResNet152
+from zookeeper_tpu.models.summary import ModelSummary, model_summary
 
 __all__ = [
+    "ModelSummary",
+    "model_summary",
     "BinaryAlexNet",
     "BinaryDenseNet28",
     "BinaryDenseNet37",

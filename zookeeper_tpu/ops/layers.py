@@ -42,6 +42,13 @@ _PM1_INPUT_QUANTIZERS = frozenset({"ste_sign", "approx_sign", "swish_sign"})
 
 BINARY_COMPUTE_MODES = ("mxu", "int8", "xnor", "xnor_popcount")
 
+#: Flat param-path regex matching the latent sign-read kernels of the
+#: Quant* layers defined in this module (flax auto-names: "QuantConv_3").
+#: The single source of truth for "which params are binary" — the Bop
+#: optimizer split, the flip-ratio metric, and the model summary's 1-bit
+#: deployment accounting all import it from here.
+BINARY_KERNEL_PATTERN = r"Quant[A-Za-z]*_\d+/kernel$"
+
 
 def _apply_clip(kernel: jax.Array, clip: bool) -> jax.Array:
     if not clip:

@@ -18,12 +18,15 @@ from zookeeper_tpu.training.metrics import (
     TensorBoardMetricsWriter,
 )
 from zookeeper_tpu.training.optimizer import (
+    BINARY_KERNEL_PATTERN,
     Adam,
     AdamW,
+    Bop,
     Momentum,
     Optimizer,
     Rmsprop,
     Sgd,
+    scale_by_bop,
 )
 from zookeeper_tpu.training.schedule import (
     ConstantSchedule,
@@ -38,7 +41,10 @@ from zookeeper_tpu.training.step import make_eval_step, make_train_step
 __all__ = [
     "Adam",
     "AdamW",
+    "BINARY_KERNEL_PATTERN",
+    "Bop",
     "Checkpointer",
+    "scale_by_bop",
     "CompositeMetricsWriter",
     "ConstantSchedule",
     "CosineDecay",

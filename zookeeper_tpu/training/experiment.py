@@ -70,6 +70,9 @@ class TrainingExperiment(Experiment):
     metrics_file: Optional[str] = Field(None)
     #: Capture a jax.profiler trace of a few steady-state steps when set.
     profile_dir: Optional[str] = Field(None)
+    #: Report the per-step sign-flip fraction of binary kernels
+    #: (larq FlipRatio capability) in the train metrics.
+    track_flip_ratio: bool = Field(False)
 
     @Field
     def num_classes(self) -> int:
@@ -114,8 +117,16 @@ class TrainingExperiment(Experiment):
         partitioner.setup()
         state = partitioner.shard_state(self.build_state())
         state = self.checkpointer.restore_state(state)
+        from zookeeper_tpu.training.optimizer import BINARY_KERNEL_PATTERN
+
         train_step = partitioner.compile_step(
-            make_train_step(rng_seed=self.seed), state
+            make_train_step(
+                rng_seed=self.seed,
+                flip_ratio_pattern=(
+                    BINARY_KERNEL_PATTERN if self.track_flip_ratio else None
+                ),
+            ),
+            state,
         )
         eval_step = partitioner.compile_eval(make_eval_step(), state)
         batch_sharding = partitioner.batch_sharding()

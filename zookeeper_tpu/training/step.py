@@ -37,12 +37,26 @@ def make_train_step(
     *,
     rng_seed: int = 0,
     has_aux_state: bool = True,
+    flip_ratio_pattern: str = None,
 ) -> Callable[[TrainState, Batch], Tuple[TrainState, Metrics]]:
     """Build the pure train step. Works unjitted (debugging), under
     ``jax.jit``, or under ``pjit``/``shard_map`` — no collectives are
     hand-written here; with a sharded batch XLA inserts the gradient
     all-reduce automatically from the sharding annotations.
+
+    ``flip_ratio_pattern``: when set (a regex over flat param paths, e.g.
+    ``training.optimizer.BINARY_KERNEL_PATTERN``), the step also reports
+    ``flip_ratio`` — the fraction of matched weights whose SIGN changed
+    this step (larq ``FlipRatio`` capability). Binary nets only learn
+    through sign flips, so a collapsed-to-zero or exploding flip ratio is
+    the primary training-health signal. Computed fully on device from
+    params already in HBM (two sign compares; no extra host syncs).
     """
+    flip_paths = None
+    if flip_ratio_pattern is not None:
+        import re
+
+        flip_paths = re.compile(flip_ratio_pattern)
 
     def train_step(state: TrainState, batch: Batch) -> Tuple[TrainState, Metrics]:
         # Per-step RNG derived from the step counter: deterministic,
@@ -81,6 +95,33 @@ def make_train_step(
             "accuracy": accuracy(logits, batch["target"]),
             "grad_norm": optax.global_norm(grads),
         }
+        if flip_paths is not None:
+            from flax import traverse_util
+
+            old_flat = traverse_util.flatten_dict(state.params, sep="/")
+            new_flat = traverse_util.flatten_dict(new_state.params, sep="/")
+            flips = jnp.zeros((), jnp.float32)
+            total = 0
+            for path, old in old_flat.items():
+                if flip_paths.search(path):
+                    flips = flips + jnp.sum(
+                        (jnp.sign(old) != jnp.sign(new_flat[path])).astype(
+                            jnp.float32
+                        )
+                    )
+                    total += old.size
+            if total == 0:
+                # Raises at TRACE time (paths are static): a pattern that
+                # matches nothing would otherwise report a permanent 0.0 —
+                # indistinguishable from collapsed binary training, the
+                # exact failure the metric exists to catch.
+                raise ValueError(
+                    f"flip_ratio_pattern {flip_paths.pattern!r} matched no "
+                    "parameter path. Is the model actually binarized "
+                    "(Quant* layers), or is the pattern misspelled? "
+                    f"Available paths: {sorted(old_flat)[:8]}..."
+                )
+            metrics["flip_ratio"] = flips / total
         return new_state, metrics
 
     return train_step
