@@ -296,7 +296,7 @@ def test_quantconv_dilation_mxu_matches_manual():
     params = conv.init(jax.random.key(0), x)
     y = conv.apply(params, x)
     ref = jax.lax.conv_general_dilated(
-        x, params["params"]["kernel"], (1, 1), "SAME",
+        x, params["params"]["kernel_fp"], (1, 1), "SAME",
         rhs_dilation=(2, 2), dimension_numbers=("NHWC", "HWIO", "NHWC"),
     )
     np.testing.assert_allclose(np.asarray(y), np.asarray(ref), rtol=1e-6)

@@ -39,13 +39,15 @@ def test_kernel_clip_projects_forward_only():
                        use_bias=False)
     x = jnp.ones((1, 2))
     params = layer.init(jax.random.PRNGKey(0), x)
-    big = {"params": {"kernel": jnp.array([[3.0, -3.0], [0.5, -0.5]])}}
+    # Unquantized kernels register as kernel_fp (excluded from the binary
+    # param pattern).
+    big = {"params": {"kernel_fp": jnp.array([[3.0, -3.0], [0.5, -0.5]])}}
     y = layer.apply(big, x)
     # Forward sees clipped kernel: 1 + .5 = 1.5 ; -1 + -.5 = -1.5.
     np.testing.assert_allclose(np.asarray(y)[0], [1.5, -1.5])
     g = jax.grad(lambda p: layer.apply(p, x).sum())(big)
     # Gradient passes straight through the clip.
-    np.testing.assert_allclose(np.asarray(g["params"]["kernel"]), 1.0)
+    np.testing.assert_allclose(np.asarray(g["params"]["kernel_fp"]), 1.0)
 
 
 def test_quant_conv_matches_manual_sign_conv():

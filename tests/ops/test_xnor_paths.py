@@ -394,3 +394,39 @@ def test_pack_quantconv_params_round_trip_quicknet():
     assert jax.tree_util.tree_structure(
         ref["params"]
     ) == jax.tree_util.tree_structure(packed_params)
+
+
+def test_fused_and_per_tap_schedules_bit_identical():
+    """The auto-fused (one launch, tap-major K) and per-tap (streamed)
+    schedules of the packed conv must agree bit-for-bit, for both kernels
+    and both paddings."""
+    import numpy as np
+
+    from zookeeper_tpu.ops.binary_compute import (
+        _packed_conv_forward,
+        pack_conv_kernel,
+    )
+
+    rng = np.random.default_rng(11)
+    x = jnp.asarray(
+        np.sign(rng.normal(size=(2, 9, 9, 40))).astype(np.float32)
+    )
+    k = jnp.asarray(
+        np.sign(rng.normal(size=(3, 3, 40, 8))).astype(np.float32)
+    )
+    packed, scale = pack_conv_kernel(k)
+    for use_pc in (False, True):
+        for padding in ("SAME", "VALID"):
+            for strides in ((1, 1), (2, 2)):
+                fused = _packed_conv_forward(
+                    x, packed, scale, strides, padding, ci=40,
+                    use_popcount=use_pc, interpret=True, fuse_taps=True,
+                )
+                per_tap = _packed_conv_forward(
+                    x, packed, scale, strides, padding, ci=40,
+                    use_popcount=use_pc, interpret=True, fuse_taps=False,
+                )
+                np.testing.assert_array_equal(
+                    np.asarray(fused), np.asarray(per_tap),
+                    err_msg=f"{use_pc=} {padding=} {strides=}",
+                )

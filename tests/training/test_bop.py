@@ -221,3 +221,69 @@ def test_flip_ratio_raises_when_pattern_matches_nothing():
     }
     with pytest.raises(ValueError, match="matched no"):
         step(state, batch)  # Mlp has no Quant* layers.
+
+
+def test_bop_rejects_configured_schedule():
+    opt = Bop()
+    configure(opt, {"schedule.base_lr": 0.1}, name="opt")
+    with pytest.raises(ValueError, match="fp_optimizer.schedule"):
+        opt.build(total_steps=10)
+
+
+def test_unquantized_quant_kernel_named_fp_and_skipped_by_bop():
+    """A Quant layer with kernel_quantizer=None (activation-only
+    quantization) registers its kernel as kernel_fp, so the binary
+    pattern never routes it to Bop / flip-ratio / 1-bit accounting."""
+    import re
+
+    from zookeeper_tpu.ops.layers import QuantDense
+
+    from flax import traverse_util
+
+    layer = QuantDense(4, input_quantizer="ste_sign", kernel_quantizer=None)
+    params = layer.init(jax.random.key(0), jnp.zeros((2, 8)))
+    flat = traverse_util.flatten_dict(params["params"], sep="/")
+    assert "kernel_fp" in flat and "kernel" not in flat
+    assert not any(re.search(BINARY_KERNEL_PATTERN, f"QuantDense_0/{p}") for p in flat)
+
+    # Multi-level kernel quantizers are not sign-family either.
+    layer2 = QuantDense(4, input_quantizer="ste_sign", kernel_quantizer="ste_tern")
+    params2 = layer2.init(jax.random.key(0), jnp.zeros((2, 8)))
+    flat2 = traverse_util.flatten_dict(params2["params"], sep="/")
+    assert "kernel_fp" in flat2 and "kernel" not in flat2
+
+
+def test_model_summary_packed_counts_true_weights():
+    """Packed deployment stores int32 lanes; the summary must report the
+    LOGICAL weight count (32x the lanes) so train and packed forms of the
+    same model agree on 'params'."""
+    from zookeeper_tpu.core import configure
+    from zookeeper_tpu.models import QuickNet, model_summary
+
+    def build(extra):
+        m = QuickNet()
+        configure(
+            m,
+            {"blocks_per_section": (1, 1), "section_features": (32, 64),
+             **extra},
+            name="m",
+        )
+        return m.build((32, 32, 3), num_classes=10)
+
+    s_train = model_summary(build({}), (32, 32, 3))
+    s_packed = model_summary(
+        build({"binary_compute": "xnor", "packed_weights": True,
+               "pallas_interpret": True}),
+        (32, 32, 3),
+    )
+    assert s_packed.binary_params == s_train.binary_params
+    # The packed form additionally stores per-channel scales; totals match
+    # once those fp scales are accounted.
+    scales = sum(
+        r.count for r in s_packed.rows if r.path.endswith("kernel_scale")
+    )
+    assert s_packed.total_params == s_train.total_params + scales
+    # Deployment bytes for the binary kernels agree between forms (1 bit).
+    packed_dep = sum(r.deploy_bytes for r in s_packed.rows if r.binary)
+    train_dep = sum(r.deploy_bytes for r in s_train.rows if r.binary)
+    assert packed_dep == train_dep

@@ -9,6 +9,7 @@ from .component import (
     component,
     component_path,
     configure,
+    configured_field_names,
     is_component_class,
     is_component_instance,
     pretty_print,
@@ -25,6 +26,7 @@ __all__ = [
     "component",
     "component_path",
     "configure",
+    "configured_field_names",
     "is_component_class",
     "is_component_instance",
     "pretty_print",

@@ -298,6 +298,18 @@ def pretty_print(instance: Any, color: bool = True) -> str:
     return _render_component(instance, 0, color=color)
 
 
+def configured_field_names(instance: Any) -> frozenset:
+    """Names of fields EXPLICITLY set on this component — by configure()
+    keys, pre-bound PartialComponent overrides, or direct assignment —
+    as opposed to defaults or scope inheritance.
+
+    Lets a component distinguish "the user asked for this" from "this is
+    just the default" (e.g. to reject configuration that it would
+    otherwise silently ignore).
+    """
+    return frozenset(_state(instance, _VALUES))
+
+
 # ---------------------------------------------------------------------------
 # configure()
 # ---------------------------------------------------------------------------

@@ -32,10 +32,19 @@ class ParamRow:
     path: str
     shape: Tuple[int, ...]
     dtype: str
+    #: STORED elements (int32 lanes for pre-packed kernels).
     count: int
     #: Bits per weight in the packed deployment form.
     deploy_bits: int
     binary: bool
+    #: True for kernel_packed rows: each stored int32 lane carries 32
+    #: binary weights, so weight_count = 32 * count.
+    packed: bool = False
+
+    @property
+    def weight_count(self) -> int:
+        """Logical weights represented (what "params" means to a user)."""
+        return self.count * 32 if self.packed else self.count
 
     @property
     def train_bytes(self) -> int:
@@ -45,7 +54,7 @@ class ParamRow:
 
     @property
     def deploy_bytes(self) -> float:
-        return self.count * self.deploy_bits / 8
+        return self.weight_count * self.deploy_bits / 8
 
 
 @dataclass
@@ -57,11 +66,11 @@ class ModelSummary:
 
     @property
     def total_params(self) -> int:
-        return sum(r.count for r in self.rows)
+        return sum(r.weight_count for r in self.rows)
 
     @property
     def binary_params(self) -> int:
-        return sum(r.count for r in self.rows if r.binary)
+        return sum(r.weight_count for r in self.rows if r.binary)
 
     @property
     def fp_params(self) -> int:
@@ -81,7 +90,7 @@ class ModelSummary:
         for r in self.rows:
             shape = "x".join(str(s) for s in r.shape) or "scalar"
             lines.append(
-                f"{r.path:<58}{shape:<20}{r.dtype:<10}{r.count:>12,}"
+                f"{r.path:<58}{shape:<20}{r.dtype:<10}{r.weight_count:>12,}"
                 f"{r.deploy_bits:>6}"
             )
         lines.append("-" * len(header))
@@ -99,16 +108,16 @@ class ModelSummary:
         return "\n".join(lines)
 
 
-def _classify(path: str, dtype_bits: int) -> Tuple[int, bool]:
-    """(deploy_bits, is_binary) for one param path."""
+def _classify(path: str, dtype_bits: int) -> Tuple[int, bool, bool]:
+    """(deploy_bits, is_binary, is_packed) for one param path."""
     if _PACKED_KERNEL_PATTERN.search(path):
-        # Stored packed: int32 lanes ARE the deployment form; each stored
-        # element carries 32 binary weights, so bits/stored-element = 32
-        # but the row's count is of int32 lanes — report 32 and binary.
-        return 32, True
+        # Already in deployment form: int32 lanes, 32 binary weights per
+        # stored element (1 bit/weight). weight_count accounting restores
+        # the true parameter count.
+        return 1, True, True
     if _BINARY_KERNEL_PATTERN.search(path):
-        return 1, True
-    return dtype_bits, False
+        return 1, True, False
+    return dtype_bits, False, False
 
 
 def model_summary(
@@ -139,7 +148,7 @@ def model_summary(
         traverse_util.flatten_dict(params, sep="/").items()
     ):
         dtype = jnp.dtype(leaf.dtype)
-        deploy_bits, binary = _classify(path, dtype.itemsize * 8)
+        deploy_bits, binary, packed = _classify(path, dtype.itemsize * 8)
         rows.append(
             ParamRow(
                 path=path,
@@ -148,6 +157,7 @@ def model_summary(
                 count=int(leaf.size),
                 deploy_bits=deploy_bits,
                 binary=binary,
+                packed=packed,
             )
         )
 

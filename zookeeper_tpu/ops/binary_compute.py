@@ -398,6 +398,14 @@ def _spatial_pad(
     return x, ho, wo
 
 
+#: Auto tap-fusion threshold: fuse when the tap-major patch matrix
+#: ([M, kh*kw*ci_pad] int8-equivalent) stays under this many bytes.
+#: Covers the whole latency-critical small-batch inference regime (the
+#: only regime where the packed path wins — BASELINE.md) while the
+#: training-shape fallback streams taps to bound peak memory.
+_FUSE_TAPS_MAX_BYTES = 32 * 2**20
+
+
 def _packed_conv_forward(
     x: Array,
     packed: Array,
@@ -408,11 +416,30 @@ def _packed_conv_forward(
     ci: int,
     use_popcount: bool,
     interpret: bool,
+    fuse_taps: bool = None,
 ) -> Array:
-    """Conv as a sum of per-tap GEMMs against pre-packed weights.
+    """Conv against pre-packed weights, as tap GEMMs on a Pallas kernel.
 
-    No im2col materialization: each tap reads a shifted view of ``x``
-    (XLA slices, fused) and contracts K=ci on the chosen Pallas kernel.
+    Two schedules over the ``sum over (dy,dx) of shifted_x @ W[dy,dx]``
+    decomposition, chosen by ``fuse_taps`` (default: auto by patch size):
+
+    - **Fused** (small M — the batch-1/low-latency inference regime): the
+      kh*kw shifted views concatenate along K into one tap-major patch
+      matrix and ONE K-tiled kernel launch contracts all taps. Kernel
+      launch overhead stops multiplying by kh*kw — this is what lets the
+      conv-level latency approach the GEMM-level packed win (the round-2
+      known-gap fix, BASELINE.md).
+    - **Per-tap** (large M, training shapes): each tap launches its own
+      GEMM so peak memory stays at one [M, ci] slice instead of a
+      kh*kw-times-larger patch matrix (im2col traffic is exactly what
+      this path exists to avoid at scale).
+
+    Both schedules are bit-identical: the tap-major K layout matches
+    ``pack_conv_kernel``'s [kh, kw, ciw, co] word order reshaped to
+    [kh*kw*ciw, co], per-tap K-padding included (A pads zeros on the MXU
+    path — contributing nothing against any weight bit — and +1s on the
+    popcount path, matching the weight pad bits, i.e. zero mismatches).
+
     ``use_popcount=False``: packed-weight MXU kernel, zero-padding, exact
     vs the float conv. ``use_popcount=True``: both operands packed, VPU
     popcount kernel — spatial padding must then be +-1, so SAME uses
@@ -425,20 +452,42 @@ def _packed_conv_forward(
     xp, ho, wo = _spatial_pad(x, kh, kw, strides, padding, pad_value)
     sh, sw = strides
     m = b * ho * wo
+    ci_pad = ciw * 32
 
-    if use_popcount:
-        ci_pad = ciw * 32
+    if fuse_taps is None:
+        # The patch matrix materializes in x's dtype before the kernel's
+        # int8/packed cast, so the guard must count real bytes.
+        itemsize = jnp.dtype(x.dtype).itemsize
+        fuse_taps = m * kh * kw * ci_pad * itemsize <= _FUSE_TAPS_MAX_BYTES
+
+    def tap_slice(dy, dx):
+        tap = xp[:, dy : dy + (ho - 1) * sh + 1 : sh,
+                 dx : dx + (wo - 1) * sw + 1 : sw, :]
+        flat = tap.reshape(m, ci)
+        if ci_pad != ci:
+            flat = jnp.pad(
+                flat, ((0, 0), (0, ci_pad - ci)), constant_values=pad_value
+            )
+        return flat
+
+    if fuse_taps:
+        patches = jnp.concatenate(
+            [tap_slice(dy, dx) for dy in range(kh) for dx in range(kw)],
+            axis=-1,
+        )  # [M, kh*kw*ci_pad], tap-major K.
+        b_all = packed.reshape(kh * kw * ciw, co)
+        if use_popcount:
+            ap = pack_bits(patches, axis=-1)  # word-aligned per tap
+            acc = xnor_matmul_packed(
+                ap, b_all, k_true=kh * kw * ci, interpret=interpret
+            )
+        else:
+            acc = packed_weight_matmul(patches, b_all, interpret=interpret)
+    elif use_popcount:
         acc = None
         for dy in range(kh):
             for dx in range(kw):
-                tap = xp[:, dy : dy + (ho - 1) * sh + 1 : sh,
-                         dx : dx + (wo - 1) * sw + 1 : sw, :]
-                flat = tap.reshape(m, ci)
-                if ci_pad != ci:
-                    flat = jnp.pad(
-                        flat, ((0, 0), (0, ci_pad - ci)), constant_values=1.0
-                    )
-                ap = pack_bits(flat, axis=-1)
+                ap = pack_bits(tap_slice(dy, dx), axis=-1)
                 out = xnor_matmul_packed(
                     ap, packed[dy, dx], k_true=ci, interpret=interpret
                 )
@@ -447,11 +496,8 @@ def _packed_conv_forward(
         acc = None
         for dy in range(kh):
             for dx in range(kw):
-                tap = xp[:, dy : dy + (ho - 1) * sh + 1 : sh,
-                         dx : dx + (wo - 1) * sw + 1 : sw, :]
-                flat = tap.reshape(m, ci)
                 out = packed_weight_matmul(
-                    flat, packed[dy, dx], interpret=interpret
+                    tap_slice(dy, dx), packed[dy, dx], interpret=interpret
                 )
                 acc = out if acc is None else acc + out
     y = acc.astype(jnp.float32) * scale[None, :]

@@ -46,8 +46,30 @@ BINARY_COMPUTE_MODES = ("mxu", "int8", "xnor", "xnor_popcount")
 #: Quant* layers defined in this module (flax auto-names: "QuantConv_3").
 #: The single source of truth for "which params are binary" — the Bop
 #: optimizer split, the flip-ratio metric, and the model summary's 1-bit
-#: deployment accounting all import it from here.
+#: deployment accounting all import it from here. SOUND because the
+#: layers encode binariness in the param NAME: the latent kernel is
+#: registered as "kernel" only when the kernel quantizer is sign-family
+#: (1-bit deployable); otherwise (None, or a multi-level quantizer like
+#: ste_tern/dorefa) it is registered as "kernel_fp", which this pattern
+#: does not match — so an activation-only-quantized Quant layer can never
+#: be sign-flipped by Bop or miscounted as 1-bit.
 BINARY_KERNEL_PATTERN = r"Quant[A-Za-z]*_\d+/kernel$"
+
+
+def _kernel_param_name(kernel_quantizer: Quantizer) -> str:
+    """Param name for the latent kernel — "kernel" iff sign-family (what
+    BINARY_KERNEL_PATTERN treats as binary). Callables are trusted to be
+    sign-family (the documented contract for custom quantizers on the
+    packed paths); string quantizers are checked against the registry."""
+    if kernel_quantizer is None:
+        return "kernel_fp"
+    if callable(kernel_quantizer):
+        return "kernel"
+    return (
+        "kernel"
+        if kernel_quantizer in _SIGN_KERNEL_QUANTIZERS
+        else "kernel_fp"
+    )
 
 
 def _apply_clip(kernel: jax.Array, clip: bool) -> jax.Array:
@@ -138,7 +160,10 @@ class QuantDense(nn.Module):
         in_q = get_quantizer(self.input_quantizer)
         k_q = get_quantizer(self.kernel_quantizer)
         kernel = self.param(
-            "kernel", self.kernel_init, (x.shape[-1], self.features), jnp.float32
+            _kernel_param_name(self.kernel_quantizer),
+            self.kernel_init,
+            (x.shape[-1], self.features),
+            jnp.float32,
         )
         if in_q is not None:
             x = in_q(x)
@@ -251,7 +276,7 @@ class QuantConv(nn.Module):
             ).astype(self.dtype)
         else:
             kernel = self.param(
-                "kernel",
+                _kernel_param_name(self.kernel_quantizer),
                 self.kernel_init,
                 (kh, kw, ci, self.features),
                 jnp.float32,

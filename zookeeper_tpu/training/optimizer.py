@@ -191,6 +191,17 @@ class Bop(Optimizer):
                 "fp_optimizer.weight_decay / fp_optimizer.global_clip_norm "
                 "for the full-precision parameters instead."
             )
+        from zookeeper_tpu.core import configured_field_names
+
+        if type(self.schedule) is not ConstantSchedule or configured_field_names(
+            self.schedule
+        ):
+            raise ValueError(
+                "Bop has no learning rate, so a schedule configured on Bop "
+                "itself would be silently dead. Schedule the fp side via "
+                "fp_optimizer.schedule.* (Bop's own knobs are gamma/"
+                "threshold)."
+            )
         pattern = re.compile(self.binary_param_pattern)
         fp_tx = self.fp_optimizer.build(total_steps)
         bop_tx = scale_by_bop(self.threshold, self.gamma)
