@@ -18,8 +18,8 @@ from zookeeper_tpu.data import (
     ImageClassificationPreprocessing,
     SyntheticMnist,
 )
-from zookeeper_tpu.models import Model, SimpleCnn
-from zookeeper_tpu.training import TrainingExperiment
+from zookeeper_tpu.models import BinaryNet, Model, SimpleCnn
+from zookeeper_tpu.training import DistillationExperiment, TrainingExperiment
 
 MnistPreprocessing = PartialComponent(
     ImageClassificationPreprocessing, height=28, width=28, channels=1
@@ -34,6 +34,27 @@ class TrainMnist(TrainingExperiment):
         preprocessing=MnistPreprocessing,
     )
     model: Model = ComponentField(SimpleCnn)
+    epochs: int = Field(2)
+    batch_size: int = Field(64)
+
+
+@task
+class DistillMnist(DistillationExperiment):
+    """Stage-2 of the KD recipe: distill a binary student from an
+    exported teacher (train the teacher first with
+    ``TrainMnist export_model_to=/tmp/teacher``)::
+
+        python examples/mnist_experiment.py DistillMnist \\
+            teacher_checkpoint=/tmp/teacher alpha=0.4
+    """
+
+    loader: DataLoader = ComponentField(
+        DataLoader,
+        dataset=SyntheticMnist,
+        preprocessing=MnistPreprocessing,
+    )
+    model: Model = ComponentField(BinaryNet)
+    teacher: Model = ComponentField(SimpleCnn)
     epochs: int = Field(2)
     batch_size: int = Field(64)
 

@@ -1,0 +1,140 @@
+"""Knowledge distillation: kd loss, model export/restore, the staged
+teacher->student recipe end-to-end (Real-to-Binary capability)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from zookeeper_tpu.core import configure
+from zookeeper_tpu.training import (
+    DistillationExperiment,
+    TrainingExperiment,
+    load_model,
+    save_model,
+)
+from zookeeper_tpu.training.step import kd_divergence
+
+
+def test_kd_divergence_zero_iff_logits_match():
+    a = jnp.asarray(np.random.default_rng(0).normal(size=(4, 10)), jnp.float32)
+    assert float(kd_divergence(a, a, 2.0)) == pytest.approx(0.0, abs=1e-6)
+    b = a + 1.0  # Uniform logit shift: softmax-invariant, still zero KL.
+    assert float(kd_divergence(b, a, 2.0)) == pytest.approx(0.0, abs=1e-5)
+    c = a.at[:, 0].add(3.0)
+    assert float(kd_divergence(c, a, 2.0)) > 0.01
+
+
+def test_save_load_model_roundtrip(tmp_path):
+    params = {"dense": {"kernel": jnp.arange(6.0).reshape(2, 3)}}
+    model_state = {"batch_stats": {"bn": {"mean": jnp.ones((3,))}}}
+    save_model(str(tmp_path / "m"), params, model_state)
+    p2, s2 = load_model(str(tmp_path / "m"), params, model_state)
+    np.testing.assert_array_equal(
+        np.asarray(p2["dense"]["kernel"]), np.arange(6.0).reshape(2, 3)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(s2["batch_stats"]["bn"]["mean"]), np.ones((3,))
+    )
+
+
+def _base_conf(extra=None):
+    return {
+        "loader.dataset": "SyntheticMnist",
+        "loader.dataset.num_train_examples": 128,
+        "loader.dataset.num_validation_examples": 32,
+        "loader.preprocessing": "ImageClassificationPreprocessing",
+        "loader.preprocessing.height": 28,
+        "loader.preprocessing.width": 28,
+        "loader.preprocessing.channels": 1,
+        "loader.host_index": 0,
+        "loader.host_count": 1,
+        "model": "Mlp",
+        "model.hidden_units": (16,),
+        "batch_size": 32,
+        "epochs": 1,
+        "verbose": False,
+        **(extra or {}),
+    }
+
+
+def test_distillation_end_to_end(tmp_path):
+    """Stage 1 trains+exports a teacher; stage 2 distills a student from
+    it. The student's step reports kd_loss and the loop runs to the end."""
+    teacher_path = str(tmp_path / "teacher")
+    t_exp = TrainingExperiment()
+    configure(
+        t_exp,
+        _base_conf({"epochs": 2, "export_model_to": teacher_path}),
+        name="teacher_exp",
+    )
+    t_exp.run()
+
+    s_conf = _base_conf()
+    del s_conf["model.hidden_units"]
+    s_exp = DistillationExperiment()
+    configure(
+        s_exp,
+        {
+            **s_conf,
+            **{
+                "model": "BinaryNet",
+                "model.features": (8, 8),
+                "model.dense_units": (16,),
+                "teacher": "Mlp",
+                "teacher.hidden_units": (16,),
+                "teacher_checkpoint": teacher_path,
+                "alpha": 0.5,
+                "temperature": 2.0,
+                "metrics_file": str(tmp_path / "m.jsonl"),
+            },
+        },
+        name="student_exp",
+    )
+    history = s_exp.run()
+    epoch = history["train"][-1]
+    assert "kd_loss" in epoch and np.isfinite(epoch["kd_loss"])
+    assert np.isfinite(epoch["loss"])
+
+
+def test_distillation_requires_teacher_checkpoint():
+    s_exp = DistillationExperiment()
+    configure(
+        s_exp,
+        _base_conf({"teacher": "Mlp", "teacher.hidden_units": (8,)}),
+        name="student_exp",
+    )
+    with pytest.raises(ValueError, match="teacher_checkpoint"):
+        s_exp.run()
+
+
+def test_distillation_pulls_student_toward_teacher(tmp_path):
+    """With alpha=0 (pure KD) the student's KD loss to the teacher drops
+    over training — the gradient really flows from the teacher term."""
+    teacher_path = str(tmp_path / "teacher")
+    t_exp = TrainingExperiment()
+    configure(
+        t_exp,
+        _base_conf({"epochs": 2, "export_model_to": teacher_path}),
+        name="teacher_exp",
+    )
+    t_exp.run()
+
+    s_exp = DistillationExperiment()
+    configure(
+        s_exp,
+        _base_conf(
+            {
+                "epochs": 4,
+                "teacher": "Mlp",
+                "teacher.hidden_units": (16,),
+                "teacher_checkpoint": teacher_path,
+                "alpha": 0.0,
+            }
+        ),
+        name="student_exp",
+    )
+    history = s_exp.run()
+    kd_first = history["train"][0]["kd_loss"]
+    kd_last = history["train"][-1]["kd_loss"]
+    assert kd_last < kd_first

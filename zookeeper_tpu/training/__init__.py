@@ -9,7 +9,12 @@ optax optimizers/schedules wired as configurable components, and an
 runs single-device, data-parallel, or model-parallel.
 """
 
-from zookeeper_tpu.training.checkpoint import Checkpointer
+from zookeeper_tpu.training.checkpoint import (
+    Checkpointer,
+    load_model,
+    save_model,
+)
+from zookeeper_tpu.training.distill import DistillationExperiment
 from zookeeper_tpu.training.experiment import Experiment, TrainingExperiment
 from zookeeper_tpu.training.metrics import (
     CompositeMetricsWriter,
@@ -48,7 +53,10 @@ __all__ = [
     "CompositeMetricsWriter",
     "ConstantSchedule",
     "CosineDecay",
+    "DistillationExperiment",
     "Experiment",
+    "load_model",
+    "save_model",
     "JsonlMetricsWriter",
     "MetricsWriter",
     "TensorBoardMetricsWriter",

@@ -112,3 +112,37 @@ class Checkpointer:
         if getattr(self, "_mgr", None) is not None:
             self._mgr.close()
             object.__setattr__(self, "_mgr", None)
+
+
+def save_model(path: str, params: Any, model_state: Any) -> None:
+    """Save a MODEL-ONLY checkpoint (params + batch stats, no optimizer
+    state): the deployment/teacher export format. Counterpart of the
+    reference ecosystem's saved-weights artifacts (larq-zoo pretrained
+    weights); ``load_model`` restores it into any structurally-matching
+    model, independent of how (or whether) it was trained."""
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(os.path.expanduser(path))
+    with ocp.StandardCheckpointer() as ckptr:
+        # force: re-exporting over a previous artifact must not crash a
+        # finished training run.
+        ckptr.save(
+            path, {"params": params, "model_state": model_state}, force=True
+        )
+
+
+def load_model(path: str, params_like: Any, model_state_like: Any):
+    """Restore a ``save_model`` checkpoint. ``*_like`` provide the target
+    structure/shardings (shape-dtype structs suffice); returns
+    ``(params, model_state)``."""
+    import jax
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(os.path.expanduser(path))
+    target = jax.tree.map(
+        ocp.utils.to_shape_dtype_struct,
+        {"params": params_like, "model_state": model_state_like},
+    )
+    with ocp.StandardCheckpointer() as ckptr:
+        restored = ckptr.restore(path, target)
+    return restored["params"], restored["model_state"]

@@ -1,0 +1,91 @@
+"""Knowledge-distillation experiment (the Real-to-Binary recipe).
+
+Binary nets reach their published accuracies with a full-precision
+teacher (Martinez et al. 2020 trains Real-to-Binary-Net in KD stages;
+SURVEY.md §6 accuracy ladder). ``DistillationExperiment`` extends the
+training loop with a frozen teacher whose temperature-softened logits
+join the loss:
+
+    loss = alpha * CE(student, labels)
+         + (1 - alpha) * T^2 * KL(teacher_T || student_T)
+
+The teacher is any ``Model`` component restored from a model-only
+checkpoint (``TrainingExperiment.export_model_to`` writes one), so a
+staged recipe is plain CLI composition:
+
+    # Stage 1: train the fp teacher, export it.
+    ... TrainImageNet model=ResNet50 export_model_to=/ckpt/teacher
+    # Stage 2: distill the binary student from it.
+    ... DistillImageNet model=RealToBinaryNet teacher=ResNet50 \\
+        teacher_checkpoint=/ckpt/teacher alpha=0.4 temperature=2.0
+"""
+
+from typing import Optional
+
+from zookeeper_tpu.core import ComponentField, Field, component
+from zookeeper_tpu.models.base import Model
+from zookeeper_tpu.training.experiment import TrainingExperiment
+from zookeeper_tpu.training.step import make_train_step
+
+__all__ = ["DistillationExperiment"]
+
+
+@component
+class DistillationExperiment(TrainingExperiment):
+    """TrainingExperiment + frozen-teacher KD loss.
+
+    The teacher runs inside the jitted train step (eval mode, gradients
+    stopped), so it shards with the batch under any partitioner; its
+    params are closed over as constants — replicated, not donated.
+    """
+
+    teacher: Model = ComponentField()
+    #: Model-only checkpoint (``save_model`` format) holding the teacher
+    #: weights. None trains against a RANDOM teacher — almost certainly a
+    #: mistake, so it must be opted into explicitly.
+    teacher_checkpoint: Optional[str] = Field(None)
+    #: Explicit opt-in for teacher_checkpoint=None (e.g. pipeline tests).
+    allow_random_teacher: bool = Field(False)
+    #: Weight on the hard-label CE term (1 - alpha goes to the KD term).
+    alpha: float = Field(0.5)
+    temperature: float = Field(2.0)
+
+    def _teacher_fn(self):
+        from zookeeper_tpu.training.checkpoint import load_model
+
+        if self.teacher_checkpoint is None and not self.allow_random_teacher:
+            raise ValueError(
+                "DistillationExperiment: teacher_checkpoint is not set — "
+                "distilling from a randomly initialized teacher is almost "
+                "never intended. Export the teacher with "
+                "export_model_to=... on its training run, or set "
+                "allow_random_teacher=True to proceed anyway."
+            )
+        import jax
+
+        input_shape = self.loader.preprocessing.input_shape
+        module = self.teacher.build(input_shape, self.num_classes)
+        if self.teacher_checkpoint is not None:
+            # Only the STRUCTURE is needed to restore: abstract init
+            # (zero allocation/compute, matters at ResNet50 teacher
+            # scale), then load the real weights.
+            abstract = jax.eval_shape(
+                lambda: self.teacher.initialize(
+                    module, input_shape, seed=self.seed
+                )
+            )
+            params, model_state = load_model(
+                self.teacher_checkpoint, abstract[0], abstract[1]
+            )
+        else:
+            params, model_state = self.teacher.initialize(
+                module, input_shape, seed=self.seed
+            )
+        variables = {"params": params, **model_state}
+        return lambda x: module.apply(variables, x, training=False)
+
+    def _train_step_fn(self):
+        return make_train_step(
+            **self._train_step_kwargs(),
+            distill=(self._teacher_fn(), self.alpha, self.temperature),
+        )

@@ -73,6 +73,10 @@ class TrainingExperiment(Experiment):
     #: Report the per-step sign-flip fraction of binary kernels
     #: (larq FlipRatio capability) in the train metrics.
     track_flip_ratio: bool = Field(False)
+    #: Save a model-only checkpoint (params + batch stats, no optimizer
+    #: state) here after training: the deployment/teacher export format
+    #: (see training.checkpoint.save_model / DistillationExperiment).
+    export_model_to: Optional[str] = Field(None)
 
     @Field
     def num_classes(self) -> int:
@@ -106,6 +110,23 @@ class TrainingExperiment(Experiment):
             spe = min(spe, self.steps_per_epoch)
         return spe
 
+    def _train_step_kwargs(self) -> Dict[str, Any]:
+        """The make_train_step wiring, exposed so subclasses extend it
+        (add kwargs) without re-deriving the base options."""
+        from zookeeper_tpu.training.optimizer import BINARY_KERNEL_PATTERN
+
+        return {
+            "rng_seed": self.seed,
+            "flip_ratio_pattern": (
+                BINARY_KERNEL_PATTERN if self.track_flip_ratio else None
+            ),
+        }
+
+    def _train_step_fn(self):
+        """The pure step the loop compiles — the subclass hook (e.g.
+        DistillationExperiment adds a teacher term)."""
+        return make_train_step(**self._train_step_kwargs())
+
     def run(self) -> Dict[str, List[Dict[str, float]]]:
         import jax
         import jax.numpy as jnp
@@ -117,17 +138,7 @@ class TrainingExperiment(Experiment):
         partitioner.setup()
         state = partitioner.shard_state(self.build_state())
         state = self.checkpointer.restore_state(state)
-        from zookeeper_tpu.training.optimizer import BINARY_KERNEL_PATTERN
-
-        train_step = partitioner.compile_step(
-            make_train_step(
-                rng_seed=self.seed,
-                flip_ratio_pattern=(
-                    BINARY_KERNEL_PATTERN if self.track_flip_ratio else None
-                ),
-            ),
-            state,
-        )
+        train_step = partitioner.compile_step(self._train_step_fn(), state)
         eval_step = partitioner.compile_eval(make_eval_step(), state)
         batch_sharding = partitioner.batch_sharding()
 
@@ -255,5 +266,9 @@ class TrainingExperiment(Experiment):
             # be called again on the same experiment.
             self.checkpointer.wait()
             self.writer.flush()
+        if self.export_model_to:
+            from zookeeper_tpu.training.checkpoint import save_model
+
+            save_model(self.export_model_to, state.params, state.model_state)
         self.final_state = state
         return history

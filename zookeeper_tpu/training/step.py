@@ -32,12 +32,26 @@ def accuracy(logits: jax.Array, labels: jax.Array) -> jax.Array:
     return (jnp.argmax(logits, axis=-1) == labels).mean()
 
 
+def kd_divergence(
+    student_logits: jax.Array, teacher_logits: jax.Array, temperature: float
+) -> jax.Array:
+    """Hinton knowledge-distillation loss: T^2-scaled KL(teacher || student)
+    over temperature-softened distributions (fp32 reduction)."""
+    sl = student_logits.astype(jnp.float32) / temperature
+    tl = teacher_logits.astype(jnp.float32) / temperature
+    p_t = jax.nn.softmax(tl)
+    return (temperature**2) * jnp.mean(
+        jnp.sum(p_t * (jax.nn.log_softmax(tl) - jax.nn.log_softmax(sl)), -1)
+    )
+
+
 def make_train_step(
     loss_fn: Callable[[jax.Array, jax.Array], jax.Array] = softmax_cross_entropy,
     *,
     rng_seed: int = 0,
     has_aux_state: bool = True,
     flip_ratio_pattern: str = None,
+    distill: Tuple[Callable[[jax.Array], jax.Array], float, float] = None,
 ) -> Callable[[TrainState, Batch], Tuple[TrainState, Metrics]]:
     """Build the pure train step. Works unjitted (debugging), under
     ``jax.jit``, or under ``pjit``/``shard_map`` — no collectives are
@@ -51,6 +65,14 @@ def make_train_step(
     through sign flips, so a collapsed-to-zero or exploding flip ratio is
     the primary training-health signal. Computed fully on device from
     params already in HBM (two sign compares; no extra host syncs).
+
+    ``distill``: optional ``(teacher_fn, alpha, temperature)`` —
+    knowledge distillation (the Real-to-Binary recipe's essential
+    ingredient). ``teacher_fn(batch_input) -> logits`` runs under
+    stop_gradient; total loss becomes ``alpha * hard_loss +
+    (1 - alpha) * kd_divergence``; metrics gain ``kd_loss``. The teacher
+    runs INSIDE the jitted step, so under pjit its (closed-over) params
+    replicate and its forward shards with the batch like the student's.
     """
     flip_paths = None
     if flip_ratio_pattern is not None:
@@ -82,9 +104,15 @@ def make_train_step(
             else:
                 logits, new_model_state = out, state.model_state
             loss = loss_fn(logits, batch["target"])
-            return loss, (logits, new_model_state)
+            kd = None
+            if distill is not None:
+                teacher_fn, alpha, temperature = distill
+                t_logits = jax.lax.stop_gradient(teacher_fn(batch["input"]))
+                kd = kd_divergence(logits, t_logits, temperature)
+                loss = alpha * loss + (1.0 - alpha) * kd
+            return loss, (logits, new_model_state, kd)
 
-        (loss, (logits, new_model_state)), grads = jax.value_and_grad(
+        (loss, (logits, new_model_state, kd)), grads = jax.value_and_grad(
             compute_loss, has_aux=True
         )(state.params)
         new_state = state.apply_gradients(grads).replace(
@@ -95,6 +123,8 @@ def make_train_step(
             "accuracy": accuracy(logits, batch["target"]),
             "grad_norm": optax.global_norm(grads),
         }
+        if kd is not None:
+            metrics["kd_loss"] = kd
         if flip_paths is not None:
             from flax import traverse_util
 
