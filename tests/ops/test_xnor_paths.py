@@ -430,3 +430,104 @@ def test_fused_and_per_tap_schedules_bit_identical():
                     np.asarray(fused), np.asarray(per_tap),
                     err_msg=f"{use_pc=} {padding=} {strides=}",
                 )
+
+
+def test_mixed_per_section_deployment_matches_float():
+    """Mixed deployment (BASELINE.md): deep sections packed, early
+    sections on the plain path. Template-aware packing converts ONLY the
+    layers the deployment model declares packed, and the mixed apply is
+    bit-exact vs the all-mxu float model."""
+    import jax
+    import numpy as np
+
+    from zookeeper_tpu.core import configure
+    from zookeeper_tpu.models import QuickNet
+    from zookeeper_tpu.ops.packed import pack_quantconv_params
+
+    def build(bc, pw):
+        m = QuickNet()
+        configure(
+            m,
+            {"blocks_per_section": (1, 1), "section_features": (32, 64),
+             "binary_compute": bc, "packed_weights": pw,
+             "pallas_interpret": True},
+            name="m",
+        )
+        module = m.build((16, 16, 3), num_classes=5)
+        return m, module
+
+    m_f, mod_f = build("mxu", False)
+    params, model_state = m_f.initialize(mod_f, (16, 16, 3))
+
+    _, mod_mixed = build(("mxu", "xnor"), (False, True))
+    abstract = jax.eval_shape(
+        lambda: mod_mixed.init(
+            jax.random.key(0), jnp.zeros((1, 16, 16, 3)), training=False
+        )
+    )
+    mixed_params = pack_quantconv_params(
+        params, template=abstract["params"]
+    )
+
+    # Only section-2 convs converted.
+    from flax import traverse_util
+
+    flat = traverse_util.flatten_dict(mixed_params, sep="/")
+    packed_keys = [k for k in flat if k.endswith("kernel_packed")]
+    latent_keys = [
+        k for k in flat if "QuantConv" in k and k.endswith("/kernel")
+    ]
+    assert len(packed_keys) == 1 and len(latent_keys) == 1
+
+    rng = np.random.default_rng(5)
+    x = jnp.asarray(rng.normal(size=(2, 16, 16, 3)), jnp.float32)
+    y_float = mod_f.apply({"params": params, **model_state}, x, training=False)
+    y_mixed = mod_mixed.apply(
+        {"params": mixed_params, **model_state}, x, training=False
+    )
+    np.testing.assert_array_equal(np.asarray(y_float), np.asarray(y_mixed))
+
+
+def test_per_section_tuple_length_validated():
+    from zookeeper_tpu.core import configure
+    from zookeeper_tpu.models import QuickNet
+
+    m = QuickNet()
+    configure(m, {"binary_compute": ("mxu", "xnor")}, name="m")  # 4 sections
+    with pytest.raises(ValueError, match="sections"):
+        m.build((32, 32, 3), num_classes=10)
+
+
+def test_pack_template_mismatch_raises():
+    import jax
+
+    from zookeeper_tpu.core import configure
+    from zookeeper_tpu.models import QuickNet
+    from zookeeper_tpu.ops.packed import pack_quantconv_params
+
+    m = QuickNet()
+    configure(
+        m,
+        {"blocks_per_section": (1, 1), "section_features": (32, 64),
+         "binary_compute": "xnor", "packed_weights": True,
+         "pallas_interpret": True},
+        name="m",
+    )
+    module = m.build((16, 16, 3), num_classes=5)
+    abstract = jax.eval_shape(
+        lambda: module.init(
+            jax.random.key(0), jnp.zeros((1, 16, 16, 3)), training=False
+        )
+    )
+    m_f = QuickNet()
+    configure(
+        m_f,
+        {"blocks_per_section": (1, 1), "section_features": (32, 64)},
+        name="m_f",
+    )
+    mod_f = m_f.build((16, 16, 3), num_classes=5)
+    params, _ = m_f.initialize(mod_f, (16, 16, 3))
+    # Whole eval_shape result instead of its ["params"] subtree: nothing
+    # matches, which must raise instead of silently packing nothing.
+    with pytest.raises(ValueError, match="structurally match"):
+        pack_quantconv_params(params, template=abstract)

@@ -13,7 +13,7 @@ carry too much information to binarize).
 """
 
 from functools import partial
-from typing import Any, Sequence, Tuple
+from typing import Any, Sequence, Tuple, Union
 
 import flax.linen as nn
 import jax
@@ -272,6 +272,14 @@ class _QuickNetModule(nn.Module):
     larq-zoo sota): fp stem, sections of residual binary 3x3 convs, fp
     pointwise transition with blurpool downsampling.
 
+    ``binary_compute``/``packed_weights`` may be PER-SECTION tuples for
+    mixed deployment: the packed path wins only where M (spatial
+    positions) is small and K large — the deep sections, which also hold
+    ~95% of the binary weights (BASELINE.md) — so e.g.
+    ``binary_compute=("int8","int8","xnor","xnor")`` with
+    ``packed_weights=(False,False,True,True)`` keeps early sections on
+    the fast plain-MXU path while the deep sections ship bit-packed.
+
     Reconstruction from the paper's description; exact stem/transition
     minutiae may deviate from larq_zoo (documented deviation, SURVEY.md §6
     accuracies are approximate targets).
@@ -281,9 +289,14 @@ class _QuickNetModule(nn.Module):
     section_features: Tuple[int, ...]
     num_classes: int
     dtype: Any
-    binary_compute: str = "mxu"
-    packed_weights: bool = False
+    binary_compute: Any = "mxu"  # str | per-section tuple of str
+    packed_weights: Any = False  # bool | per-section tuple of bool
     pallas_interpret: bool = False
+
+    def _section_opt(self, value, s: int):
+        if isinstance(value, (tuple, list)):
+            return value[s]
+        return value
 
     @nn.compact
     def __call__(self, x, training: bool = False):
@@ -311,8 +324,8 @@ class _QuickNetModule(nn.Module):
                 y = QuantConv(
                     feat, (3, 3), input_quantizer="ste_sign",
                     kernel_quantizer="ste_sign", dtype=d,
-                    binary_compute=self.binary_compute,
-                    packed_weights=self.packed_weights,
+                    binary_compute=self._section_opt(self.binary_compute, s),
+                    packed_weights=self._section_opt(self.packed_weights, s),
                     pallas_interpret=self.pallas_interpret,
                 )(x)
                 y = _bn(training, d)(y)
@@ -325,22 +338,38 @@ class _QuickNetModule(nn.Module):
 
 @component
 class QuickNet(Model):
-    """QuickNet (~63.3% top-1 target; BASELINE configs #4)."""
+    """QuickNet (~63.3% top-1 target; BASELINE configs #4).
+
+    ``binary_compute``/``packed_weights`` accept a single value or a
+    per-section tuple (mixed deployment — see _QuickNetModule)."""
 
     blocks_per_section: Sequence[int] = Field((2, 3, 4, 4))
     section_features: Sequence[int] = Field((64, 128, 256, 512))
-    binary_compute: str = Field("mxu")
-    packed_weights: bool = Field(False)
+    binary_compute: Union[str, Sequence[str]] = Field("mxu")
+    packed_weights: Union[bool, Sequence[bool]] = Field(False)
     pallas_interpret: bool = Field(False)
 
     def build(self, input_shape, num_classes: int) -> nn.Module:
+        n_sections = len(tuple(self.blocks_per_section))
+
+        def norm(v):
+            if isinstance(v, (list, tuple)):
+                if len(v) != n_sections:
+                    raise ValueError(
+                        f"Per-section value {tuple(v)!r} has {len(v)} "
+                        f"entries but the model has {n_sections} sections "
+                        "(one entry per blocks_per_section section)."
+                    )
+                return tuple(v)
+            return v
+
         return _QuickNetModule(
             blocks_per_section=tuple(self.blocks_per_section),
             section_features=tuple(self.section_features),
             num_classes=num_classes,
             dtype=self.dtype(),
-            binary_compute=self.binary_compute,
-            packed_weights=self.packed_weights,
+            binary_compute=norm(self.binary_compute),
+            packed_weights=norm(self.packed_weights),
             pallas_interpret=self.pallas_interpret,
         )
 

@@ -22,6 +22,7 @@ def pack_quantconv_params(
     params: Mapping[str, Any],
     kernel_quantizer: Union[str, Callable] = "ste_sign",
     kernel_clip: bool = True,
+    template: Mapping[str, Any] = None,
 ) -> dict:
     """Convert a float params tree to the packed-weights structure.
 
@@ -32,6 +33,14 @@ def pack_quantconv_params(
     unchanged. The result loads into the same model built with
     ``packed_weights=True``.
 
+    ``template``: the deployment model's params STRUCTURE (e.g. from
+    ``jax.eval_shape`` of its init — ShapeDtypeStructs suffice). When
+    given, a QuantConv kernel is packed only where the template declares
+    ``kernel_packed`` — the mixed per-layer deployment case (pack the
+    deep, HBM-bound layers; leave the early compute-bound layers on the
+    plain MXU paths, see BASELINE.md). Without a template every QuantConv
+    kernel is packed.
+
     ``kernel_quantizer`` must match what the model trained with (each zoo
     family uses one kernel quantizer throughout: QuickNet/BinaryNet
     ``ste_sign``, Bi-Real-Net ``magnitude_aware_sign``).
@@ -40,23 +49,59 @@ def pack_quantconv_params(
     if k_q is None:
         raise ValueError("pack_quantconv_params requires a kernel quantizer.")
 
-    def convert(node: Any, in_quantconv: bool) -> Any:
+    n_converted = 0
+
+    def convert(node: Any, in_quantconv: bool, tnode: Any) -> Any:
+        nonlocal n_converted
         if isinstance(node, Mapping):
             out = {}
             for key, child in node.items():
                 child_is_qc = in_quantconv or key.startswith("QuantConv")
+                tchild = (
+                    tnode.get(key) if isinstance(tnode, Mapping) else None
+                )
+                want_packed = template is None or (
+                    isinstance(tnode, Mapping) and "kernel_packed" in tnode
+                )
                 if (
                     in_quantconv
                     and key == "kernel"
                     and getattr(child, "ndim", 0) == 4
+                    and want_packed
                 ):
                     q = k_q(_apply_clip(jnp.asarray(child), kernel_clip))
                     packed, scale = pack_conv_kernel(q)
                     out["kernel_packed"] = packed
                     out["kernel_scale"] = scale
+                    n_converted += 1
                 else:
-                    out[key] = convert(child, child_is_qc)
+                    out[key] = convert(child, child_is_qc, tchild)
             return out
         return node
 
-    return convert(params, False)
+    out = convert(params, False, template)
+    if template is not None:
+        expected = sum(
+            1
+            for path in _flat_keys(template)
+            if path.endswith("kernel_packed")
+        )
+        if n_converted != expected:
+            raise ValueError(
+                f"Template declares {expected} packed kernel(s) but "
+                f"{n_converted} were converted — the template does not "
+                "structurally match the params (common mistake: passing "
+                "the whole eval_shape result instead of its ['params'] "
+                "subtree, or a template built with a different "
+                "architecture config)."
+            )
+    return out
+
+
+def _flat_keys(tree: Mapping[str, Any], prefix: str = ""):
+    for key, child in tree.items():
+        path = f"{prefix}/{key}" if prefix else str(key)
+        if isinstance(child, Mapping):
+            yield from _flat_keys(child, path)
+        else:
+            yield path

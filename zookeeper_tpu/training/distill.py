@@ -50,9 +50,7 @@ class DistillationExperiment(TrainingExperiment):
     alpha: float = Field(0.5)
     temperature: float = Field(2.0)
 
-    def _teacher_fn(self):
-        from zookeeper_tpu.training.checkpoint import load_model
-
+    def _validate_teacher_config(self) -> None:
         if self.teacher_checkpoint is None and not self.allow_random_teacher:
             raise ValueError(
                 "DistillationExperiment: teacher_checkpoint is not set — "
@@ -61,6 +59,17 @@ class DistillationExperiment(TrainingExperiment):
                 "export_model_to=... on its training run, or set "
                 "allow_random_teacher=True to proceed anyway."
             )
+
+    def run(self):
+        # Pure config validation up front: fail before device setup and
+        # student allocation, not deep inside step compilation.
+        self._validate_teacher_config()
+        return super().run()
+
+    def _teacher_fn(self):
+        from zookeeper_tpu.training.checkpoint import load_model
+
+        self._validate_teacher_config()
         import jax
 
         input_shape = self.loader.preprocessing.input_shape
