@@ -25,9 +25,15 @@ from zookeeper_tpu.data import (
     ImageClassificationPreprocessing,
     SyntheticImageNet,
 )
-from zookeeper_tpu.models import Model, QuickNet
+from zookeeper_tpu.models import Model, QuickNet, RealToBinaryNet, ResNet50
 from zookeeper_tpu.parallel import DataParallelPartitioner, Partitioner
-from zookeeper_tpu.training import Adam, Optimizer, TrainingExperiment, WarmupCosine
+from zookeeper_tpu.training import (
+    Adam,
+    DistillationExperiment,
+    Optimizer,
+    TrainingExperiment,
+    WarmupCosine,
+)
 
 ImageNetPreprocessing = PartialComponent(
     ImageClassificationPreprocessing,
@@ -49,6 +55,32 @@ class TrainImageNet(TrainingExperiment):
     )
     partitioner: Partitioner = ComponentField(DataParallelPartitioner)
     epochs: int = Field(120)
+    batch_size: int = Field(256)
+
+
+@task
+class DistillImageNet(DistillationExperiment):
+    """The Real-to-Binary staged recipe (Martinez et al. 2020) at
+    ImageNet scale: first train (or restore) a full-precision teacher
+    with ``TrainImageNet model=ResNet50 export_model_to=...``, then::
+
+        python examples/imagenet_experiment.py DistillImageNet \\
+            teacher_checkpoint=<path> alpha=0.4 temperature=2.0
+    """
+
+    loader: DataLoader = ComponentField(
+        DataLoader,
+        dataset=SyntheticImageNet,
+        preprocessing=ImageNetPreprocessing,
+        num_workers=8,
+    )
+    model: Model = ComponentField(RealToBinaryNet, compute_dtype="bfloat16")
+    teacher: Model = ComponentField(ResNet50, compute_dtype="bfloat16")
+    optimizer: Optimizer = ComponentField(
+        Adam, schedule=PartialComponent(WarmupCosine, base_lr=2.5e-3)
+    )
+    partitioner: Partitioner = ComponentField(DataParallelPartitioner)
+    epochs: int = Field(75)
     batch_size: int = Field(256)
 
 

@@ -9,7 +9,7 @@ matches the parameter structure a ``QuantConv(packed_weights=True)``
 module declares, so ``module.apply`` works unchanged.
 """
 
-from typing import Any, Callable, Mapping, Union
+from typing import Any, Callable, Mapping, Optional, Union
 
 import jax.numpy as jnp
 
@@ -22,7 +22,7 @@ def pack_quantconv_params(
     params: Mapping[str, Any],
     kernel_quantizer: Union[str, Callable] = "ste_sign",
     kernel_clip: bool = True,
-    template: Mapping[str, Any] = None,
+    template: Optional[Mapping[str, Any]] = None,
 ) -> dict:
     """Convert a float params tree to the packed-weights structure.
 
