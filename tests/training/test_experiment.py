@@ -67,3 +67,189 @@ def test_experiment_data_parallel_on_cpu_mesh():
 def test_experiment_num_classes_derived_from_dataset():
     exp = make_experiment({"loader.dataset.num_classes": 7})
     assert exp.num_classes == 7
+
+
+def test_ema_tracked_evaluated_and_exported(tmp_path):
+    """ema_decay wires EMA through state, train step, validation, export,
+    and checkpoint resume."""
+    import jax
+    import numpy as np
+
+    from zookeeper_tpu.core import configure
+    from zookeeper_tpu.training import TrainingExperiment, load_model
+
+    export = str(tmp_path / "ema_export")
+    exp = TrainingExperiment()
+    configure(
+        exp,
+        {
+            "loader.dataset": "SyntheticMnist",
+            "loader.dataset.num_train_examples": 64,
+            "loader.dataset.num_validation_examples": 32,
+            "loader.preprocessing": "ImageClassificationPreprocessing",
+            "loader.preprocessing.height": 28,
+            "loader.preprocessing.width": 28,
+            "loader.preprocessing.channels": 1,
+            "loader.host_index": 0,
+            "loader.host_count": 1,
+            "model": "Mlp",
+            "model.hidden_units": (16,),
+            "batch_size": 32,
+            "epochs": 2,
+            "verbose": False,
+            "ema_decay": 0.9,
+            "export_model_to": export,
+            "checkpointer.directory": str(tmp_path / "ckpt"),
+            "checkpointer.synchronous": True,
+        },
+        name="experiment",
+    )
+    history = exp.run()
+    state = exp.final_state
+    assert state.ema_params is not None
+    # EMA lags the raw params (decay 0.9 over 4 steps — must differ).
+    diffs = [
+        float(np.abs(np.asarray(e) - np.asarray(p)).max())
+        for e, p in zip(
+            jax.tree.leaves(state.ema_params), jax.tree.leaves(state.params)
+        )
+    ]
+    assert max(diffs) > 0
+    # Export holds the EMA params, not the raw ones.
+    exported, _ = load_model(export, state.params, state.model_state)
+    for a, b in zip(jax.tree.leaves(exported), jax.tree.leaves(state.ema_params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert history["validation"]  # Validation ran (on EMA weights).
+
+    # Resume restores the EMA buffer exactly.
+    exp2 = TrainingExperiment()
+    configure(
+        exp2,
+        {
+            "loader.dataset": "SyntheticMnist",
+            "loader.dataset.num_train_examples": 64,
+            "loader.dataset.num_validation_examples": 32,
+            "loader.preprocessing": "ImageClassificationPreprocessing",
+            "loader.preprocessing.height": 28,
+            "loader.preprocessing.width": 28,
+            "loader.preprocessing.channels": 1,
+            "loader.host_index": 0,
+            "loader.host_count": 1,
+            "model": "Mlp",
+            "model.hidden_units": (16,),
+            "batch_size": 32,
+            "epochs": 2,
+            "verbose": False,
+            "ema_decay": 0.9,
+            "checkpointer.directory": str(tmp_path / "ckpt"),
+            "checkpointer.synchronous": True,
+        },
+        name="experiment",
+    )
+    exp2.run()  # 0 additional epochs; restores state.
+    for a, b in zip(
+        jax.tree.leaves(exp2.final_state.ema_params),
+        jax.tree.leaves(state.ema_params),
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    exp.checkpointer.close()
+    exp2.checkpointer.close()
+
+
+def test_ema_math_single_step():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from zookeeper_tpu.core import configure
+    from zookeeper_tpu.models import Mlp
+    from zookeeper_tpu.training import TrainState, make_train_step
+
+    m = Mlp()
+    configure(m, {"hidden_units": (8,)}, name="m")
+    module = m.build((4, 4, 1), num_classes=2)
+    params, model_state = m.initialize(module, (4, 4, 1))
+    state = TrainState.create(
+        apply_fn=module.apply, params=params, model_state=model_state,
+        tx=optax.sgd(0.1), ema=True,
+    )
+    step = jax.jit(make_train_step(ema_decay=0.5))
+    batch = {
+        "input": jnp.ones((4, 4, 4, 1), jnp.float32),
+        "target": jnp.zeros((4,), jnp.int32),
+    }
+    new_state, _ = step(state, batch)
+    # ema_1 = 0.5 * params_0 + 0.5 * params_1 (ema_0 == params_0).
+    for e, p0, p1 in zip(
+        jax.tree.leaves(new_state.ema_params),
+        jax.tree.leaves(state.params),
+        jax.tree.leaves(new_state.params),
+    ):
+        np.testing.assert_allclose(
+            np.asarray(e), 0.5 * np.asarray(p0) + 0.5 * np.asarray(p1),
+            rtol=1e-6,
+        )
+
+
+def _ema_toggle_conf(tmp_path, ema_decay):
+    return {
+        "loader.dataset": "SyntheticMnist",
+        "loader.dataset.num_train_examples": 64,
+        "loader.dataset.num_validation_examples": 32,
+        "loader.preprocessing": "ImageClassificationPreprocessing",
+        "loader.preprocessing.height": 28,
+        "loader.preprocessing.width": 28,
+        "loader.preprocessing.channels": 1,
+        "loader.host_index": 0,
+        "loader.host_count": 1,
+        "model": "Mlp",
+        "model.hidden_units": (16,),
+        "batch_size": 32,
+        "epochs": 2,
+        "verbose": False,
+        "ema_decay": ema_decay,
+        "checkpointer.directory": str(tmp_path / "ckpt"),
+        "checkpointer.synchronous": True,
+    }
+
+
+@pytest.mark.parametrize("first,second", [(0.0, 0.9), (0.9, 0.0)])
+def test_ema_toggle_across_resume(tmp_path, first, second):
+    """Toggling ema_decay between runs sharing a checkpoint directory
+    must restore gracefully (on->off drops the buffer; off->on seeds the
+    EMA from the restored params)."""
+    import jax
+    import numpy as np
+
+    from zookeeper_tpu.core import configure
+    from zookeeper_tpu.training import TrainingExperiment
+
+    exp = TrainingExperiment()
+    configure(exp, _ema_toggle_conf(tmp_path, first), name="experiment")
+    exp.run()
+    exp.checkpointer.close()
+
+    exp2 = TrainingExperiment()
+    conf = _ema_toggle_conf(tmp_path, second)
+    conf["epochs"] = 3  # One more epoch so the resumed run trains.
+    configure(exp2, conf, name="experiment")
+    history = exp2.run()
+    assert len(history["train"]) == 1
+    if second > 0:
+        assert exp2.final_state.ema_params is not None
+        for leaf in jax.tree.leaves(exp2.final_state.ema_params):
+            assert np.all(np.isfinite(np.asarray(leaf)))
+    else:
+        assert exp2.final_state.ema_params is None
+    exp2.checkpointer.close()
+
+
+def test_ema_decay_out_of_range_rejected(tmp_path):
+    from zookeeper_tpu.core import configure
+    from zookeeper_tpu.training import TrainingExperiment
+
+    exp = TrainingExperiment()
+    configure(exp, _ema_toggle_conf(tmp_path, 1.0), name="experiment")
+    with pytest.raises(ValueError, match="ema_decay"):
+        exp.run()

@@ -27,6 +27,7 @@ from zookeeper_tpu.data.store import (
 from zookeeper_tpu.data.dataset import (
     ArrayDataset,
     Dataset,
+    GrainDataset,
     MemmapDataset,
     MultiTFDSDataset,
     SklearnDigits,
@@ -54,6 +55,7 @@ __all__ = [
     "DataLoader",
     "DataSource",
     "Dataset",
+    "GrainDataset",
     "ImageClassificationPreprocessing",
     "MappedSource",
     "MemmapDataset",

@@ -434,3 +434,86 @@ class MultiTFDSDataset(Dataset):
         except AttributeError:
             return None
         return self._load_all(split)
+
+
+@component
+class GrainDataset(Dataset):
+    """Adapter for ``grain``, the JAX-ecosystem host-data library
+    (SURVEY.md §7 names it as the intended pod-scale pipeline library).
+
+    Zero translation needed: this framework's :class:`DataSource`
+    protocol (``__len__`` + ``__getitem__`` of dict examples) IS grain's
+    random-access protocol, so any grain source plugs in directly —
+    ``grain.python.ArrayRecordDataSource`` over ArrayRecord files, a
+    ``grain.MapDataset`` pipeline with its ``.map``/``.filter`` stages,
+    or any custom random-access source. Batching, per-host sharding,
+    (seed, epoch)-deterministic shuffling, and device prefetch stay with
+    this framework's DataLoader (which already does them deterministically
+    per SURVEY §7); grain supplies storage and per-example transforms.
+
+    Sources are live Python objects, not config leaves: supply them
+    post-construction via :meth:`with_sources` (the ``ArrayDataset``
+    pattern). Examples must be ``dict``s of numpy-convertible features.
+    """
+
+    #: Set when known; otherwise inferred by scanning 'label' over the
+    #: first ``infer_scan_limit`` examples (bounded: grain sources may be
+    #: disk-backed and huge).
+    num_classes: int = Field(-1)
+    infer_scan_limit: int = Field(1024)
+
+    _train_source: Optional[DataSource] = None
+    _validation_source: Optional[DataSource] = None
+
+    def with_sources(
+        self, train, validation=None
+    ) -> "GrainDataset":
+        for name, src in (("train", train), ("validation", validation)):
+            if src is None:
+                continue
+            if not (hasattr(src, "__len__") and hasattr(src, "__getitem__")):
+                raise TypeError(
+                    f"GrainDataset {name} source {type(src).__name__} does "
+                    "not implement the random-access protocol "
+                    "(__len__/__getitem__)."
+                )
+        self._train_source = train
+        self._validation_source = validation
+        return self
+
+    def train(self) -> DataSource:
+        if self._train_source is None:
+            raise ValueError(
+                "GrainDataset has no sources; call with_sources() first."
+            )
+        return self._train_source
+
+    def validation(self) -> Optional[DataSource]:
+        return self._validation_source
+
+    def infer_num_classes(self) -> int:
+        if self._train_source is None:
+            return super().infer_num_classes()
+        n = min(len(self._train_source), self.infer_scan_limit)
+        labels = []
+        for i in range(n):
+            ex = self._train_source[i]
+            if "label" not in ex:
+                return super().infer_num_classes()
+            labels.append(ex["label"])
+        if not labels:
+            return super().infer_num_classes()
+        if n < len(self._train_source):
+            import warnings
+
+            warnings.warn(
+                f"GrainDataset inferred num_classes from the first {n} of "
+                f"{len(self._train_source)} examples; set num_classes "
+                "explicitly if higher labels exist beyond the scan limit.",
+                stacklevel=2,
+            )
+        # Shared scan logic: keeps the integer-dtype guard (float labels
+        # must not silently truncate) and the clear error message.
+        return _labels_to_num_classes(
+            {"label": np.asarray(labels)}, "GrainDataset"
+        )

@@ -17,12 +17,15 @@ from zookeeper_tpu.core import Field, component
 def _state_pytree(state) -> dict:
     """The persistable subtree of a TrainState (apply_fn/tx are static
     code, not data)."""
-    return {
+    tree = {
         "step": state.step,
         "params": state.params,
         "model_state": state.model_state,
         "opt_state": state.opt_state,
     }
+    if getattr(state, "ema_params", None) is not None:
+        tree["ema_params"] = state.ema_params
+    return tree
 
 
 @component
@@ -93,14 +96,54 @@ class Checkpointer:
         target = jax.tree.map(
             ocp.utils.to_shape_dtype_struct, _state_pytree(state)
         )
-        restored = self._manager().restore(
-            step, args=ocp.args.StandardRestore(target)
-        )
+        # EMA may have been toggled between the saving run and this one;
+        # the restore target must match the ON-DISK structure, not the
+        # live state's. Metadata is not reliably inspectable on a fresh
+        # manager (handler not yet registered), so: restore with the live
+        # structure, and on the specific ema_params structure mismatch
+        # retry once with the target adjusted to the disk's shape.
+        def do_restore(tgt):
+            return self._manager().restore(
+                step, args=ocp.args.StandardRestore(tgt)
+            )
+
+        try:
+            restored = do_restore(target)
+        except ValueError as first_err:
+            # No message sniffing (orbax wording is version-brittle):
+            # retry once with the ema-toggled target shape, and surface
+            # the ORIGINAL error if the retry fails too.
+            if "ema_params" in target:
+                # Saved without EMA, resuming with: restore what exists;
+                # the EMA buffer seeds from the restored params below.
+                target = {k: v for k, v in target.items() if k != "ema_params"}
+            else:
+                # Saved with EMA, resuming without: restore it (and drop
+                # it below). One wasted params-sized read, only on this
+                # rare toggle path — ocp.PLACEHOLDER would skip the read
+                # but the installed orbax's StandardRestore rejects it.
+                target = {**target, "ema_params": target["params"]}
+            try:
+                restored = do_restore(target)
+            except Exception:
+                raise first_err from None
+        ema = state.ema_params
+        if ema is not None:
+            # Prefer the saved buffer; else seed from restored params so
+            # the average starts at the resumed weights, not random init.
+            # COPY when seeding: aliasing params would donate the same
+            # buffer twice in the donated train step.
+            import jax.numpy as jnp
+
+            ema = restored.get("ema_params")
+            if ema is None:
+                ema = jax.tree.map(jnp.copy, restored["params"])
         return state.replace(
             step=restored["step"],
             params=restored["params"],
             model_state=restored["model_state"],
             opt_state=restored["opt_state"],
+            ema_params=ema,
         )
 
     def wait(self) -> None:

@@ -76,7 +76,14 @@ class TrainingExperiment(Experiment):
     #: Save a model-only checkpoint (params + batch stats, no optimizer
     #: state) here after training: the deployment/teacher export format
     #: (see training.checkpoint.save_model / DistillationExperiment).
+    #: Exports the EMA weights when ema_decay is on (they are the ship
+    #: artifact).
     export_model_to: Optional[str] = Field(None)
+    #: Exponential-moving-average of params (0 = off). When on, the train
+    #: step maintains the average, validation evaluates it, and
+    #: export_model_to ships it. Standard for long binary-net recipes:
+    #: late sign flips make raw weights oscillate; the average does not.
+    ema_decay: float = Field(0.0)
 
     @Field
     def num_classes(self) -> int:
@@ -102,6 +109,7 @@ class TrainingExperiment(Experiment):
             params=params,
             model_state=model_state,
             tx=tx,
+            ema=self.ema_decay > 0,
         )
 
     def _steps_per_epoch(self) -> int:
@@ -120,6 +128,7 @@ class TrainingExperiment(Experiment):
             "flip_ratio_pattern": (
                 BINARY_KERNEL_PATTERN if self.track_flip_ratio else None
             ),
+            "ema_decay": self.ema_decay if self.ema_decay > 0 else None,
         }
 
     def _train_step_fn(self):
@@ -132,6 +141,12 @@ class TrainingExperiment(Experiment):
         import jax.numpy as jnp
         import numpy as np
 
+        if not 0.0 <= self.ema_decay < 1.0:
+            raise ValueError(
+                f"ema_decay={self.ema_decay} is outside [0, 1): 0 disables "
+                "EMA; 1.0 would freeze the average at initialization "
+                "forever (common typo for 0.999)."
+            )
         self._log(pretty_print(self))
         self.runtime.initialize()  # Multi-host bootstrap; no-op single host.
         partitioner = self.partitioner
@@ -139,7 +154,9 @@ class TrainingExperiment(Experiment):
         state = partitioner.shard_state(self.build_state())
         state = self.checkpointer.restore_state(state)
         train_step = partitioner.compile_step(self._train_step_fn(), state)
-        eval_step = partitioner.compile_eval(make_eval_step(), state)
+        eval_step = partitioner.compile_eval(
+            make_eval_step(use_ema=self.ema_decay > 0), state
+        )
         batch_sharding = partitioner.batch_sharding()
 
         spe = self._steps_per_epoch()
@@ -269,6 +286,11 @@ class TrainingExperiment(Experiment):
         if self.export_model_to:
             from zookeeper_tpu.training.checkpoint import save_model
 
-            save_model(self.export_model_to, state.params, state.model_state)
+            export_params = (
+                state.ema_params
+                if self.ema_decay > 0 and state.ema_params is not None
+                else state.params
+            )
+            save_model(self.export_model_to, export_params, state.model_state)
         self.final_state = state
         return history

@@ -26,9 +26,12 @@ class TrainState:
     opt_state: Any
     apply_fn: Callable = flax.struct.field(pytree_node=False)
     tx: optax.GradientTransformation = flax.struct.field(pytree_node=False)
+    #: Exponential moving average of params (None when EMA is off).
+    #: Maintained by the train step (``ema_decay``), read by eval/export.
+    ema_params: Any = None
 
     @classmethod
-    def create(cls, *, apply_fn, params, model_state, tx) -> "TrainState":
+    def create(cls, *, apply_fn, params, model_state, tx, ema=False) -> "TrainState":
         import jax.numpy as jnp
 
         return cls(
@@ -36,6 +39,7 @@ class TrainState:
             params=params,
             model_state=dict(model_state),
             opt_state=tx.init(params),
+            ema_params=jax.tree.map(jnp.copy, params) if ema else None,
             apply_fn=apply_fn,
             tx=tx,
         )

@@ -52,6 +52,7 @@ def make_train_step(
     has_aux_state: bool = True,
     flip_ratio_pattern: str = None,
     distill: Tuple[Callable[[jax.Array], jax.Array], float, float] = None,
+    ema_decay: float = None,
 ) -> Callable[[TrainState, Batch], Tuple[TrainState, Metrics]]:
     """Build the pure train step. Works unjitted (debugging), under
     ``jax.jit``, or under ``pjit``/``shard_map`` — no collectives are
@@ -118,6 +119,19 @@ def make_train_step(
         new_state = state.apply_gradients(grads).replace(
             model_state=dict(new_model_state)
         )
+        if ema_decay is not None:
+            if state.ema_params is None:
+                raise ValueError(
+                    "ema_decay is set but the TrainState has no ema_params; "
+                    "build it with TrainState.create(..., ema=True)."
+                )
+            new_state = new_state.replace(
+                ema_params=jax.tree.map(
+                    lambda e, p: ema_decay * e + (1.0 - ema_decay) * p,
+                    state.ema_params,
+                    new_state.params,
+                )
+            )
         metrics = {
             "loss": loss,
             "accuracy": accuracy(logits, batch["target"]),
@@ -159,9 +173,23 @@ def make_train_step(
 
 def make_eval_step(
     loss_fn: Callable[[jax.Array, jax.Array], jax.Array] = softmax_cross_entropy,
+    *,
+    use_ema: bool = False,
 ) -> Callable[[TrainState, Batch], Metrics]:
+    """``use_ema``: evaluate the EMA weights instead of the raw params
+    (the averaged weights are what ships — standard for the long binary
+    recipes, where raw weights oscillate from late sign flips)."""
+
     def eval_step(state: TrainState, batch: Batch) -> Metrics:
-        variables = {"params": state.params, **state.model_state}
+        params = state.params
+        if use_ema:
+            if state.ema_params is None:
+                raise ValueError(
+                    "use_ema=True but the TrainState has no ema_params; "
+                    "build it with TrainState.create(..., ema=True)."
+                )
+            params = state.ema_params
+        variables = {"params": params, **state.model_state}
         logits = state.apply_fn(variables, batch["input"], training=False)
         return {
             "loss": loss_fn(logits, batch["target"]),
