@@ -1,6 +1,7 @@
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from zookeeper_tpu.ops import QuantConv, QuantDense
 
@@ -112,3 +113,117 @@ def test_binary_layer_trains():
         params, opt_state, loss = step(params, opt_state)
         losses.append(float(loss))
     assert losses[-1] < losses[0] * 0.8
+
+
+def test_quant_depthwise_conv_int8_matches_mxu():
+    from zookeeper_tpu.ops import QuantDepthwiseConv
+
+    rng = np.random.default_rng(31)
+    x = jnp.asarray(rng.normal(size=(2, 8, 8, 16)), jnp.float32)
+    kwargs = dict(
+        channel_multiplier=2, kernel_size=(3, 3),
+        input_quantizer="ste_sign", kernel_quantizer="ste_sign",
+    )
+    mxu = QuantDepthwiseConv(**kwargs, binary_compute="mxu")
+    i8 = QuantDepthwiseConv(**kwargs, binary_compute="int8")
+    params = mxu.init(jax.random.key(0), x)
+    y_mxu = mxu.apply(params, x)
+    y_i8 = i8.apply(params, x)
+    assert y_mxu.shape == (2, 8, 8, 32)
+    np.testing.assert_array_equal(np.asarray(y_mxu), np.asarray(y_i8))
+    # Gradients agree too (custom_vjp path).
+    g1 = jax.grad(lambda p: (mxu.apply(p, x) ** 2).sum())(params)
+    g2 = jax.grad(lambda p: (i8.apply(p, x) ** 2).sum())(params)
+    for a, b in zip(jax.tree.leaves(g1), jax.tree.leaves(g2)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5)
+
+
+def test_quant_depthwise_rejects_packed_modes():
+    from zookeeper_tpu.ops import QuantDepthwiseConv
+
+    x = jnp.zeros((1, 4, 4, 8), jnp.float32)
+    conv = QuantDepthwiseConv(
+        input_quantizer="ste_sign", kernel_quantizer="ste_sign",
+        binary_compute="xnor",
+    )
+    with pytest.raises(ValueError, match="depthwise"):
+        conv.init(jax.random.key(0), x)
+
+
+def test_quant_separable_conv_larq_dataflow():
+    """larq semantics: the depthwise output reaches the pointwise stage
+    UNQUANTIZED (magnitudes preserved) unless intermediate_quantizer is
+    set explicitly."""
+    from zookeeper_tpu.ops import QuantSeparableConv
+
+    rng = np.random.default_rng(33)
+    x = jnp.asarray(rng.normal(size=(2, 8, 8, 32)), jnp.float32)
+    sep = QuantSeparableConv(
+        features=24, kernel_size=(3, 3), strides=(2, 2),
+        input_quantizer="ste_sign", depthwise_quantizer="ste_sign",
+        pointwise_quantizer="ste_sign",
+    )
+    params = sep.init(jax.random.key(0), x)
+    y = sep.apply(params, x)
+    assert y.shape == (2, 4, 4, 24)
+    # With the intermediate re-binarized the result must differ (the
+    # depthwise output carries non-unit magnitudes).
+    sep_q = QuantSeparableConv(
+        features=24, kernel_size=(3, 3), strides=(2, 2),
+        input_quantizer="ste_sign", depthwise_quantizer="ste_sign",
+        pointwise_quantizer="ste_sign", intermediate_quantizer="ste_sign",
+    )
+    y_q = sep_q.apply(params, x)
+    assert not np.allclose(np.asarray(y), np.asarray(y_q))
+    # A binarized intermediate enables the packed pointwise stage, which
+    # must then match its mxu twin bit-for-bit.
+    sep_x = QuantSeparableConv(
+        features=24, kernel_size=(3, 3), strides=(2, 2),
+        input_quantizer="ste_sign", depthwise_quantizer="ste_sign",
+        pointwise_quantizer="ste_sign", intermediate_quantizer="ste_sign",
+        pointwise_compute="xnor", pallas_interpret=True,
+    )
+    y_x = sep_x.apply(params, x)
+    np.testing.assert_array_equal(np.asarray(y_q), np.asarray(y_x))
+    # Unquantized intermediate + a binary pointwise path must raise, not
+    # silently degrade.
+    sep_bad = QuantSeparableConv(
+        features=24, input_quantizer="ste_sign",
+        depthwise_quantizer="ste_sign", pointwise_quantizer="ste_sign",
+        pointwise_compute="int8",
+    )
+    with pytest.raises(ValueError, match="input_quantizer"):
+        sep_bad.apply(params, x)
+
+
+def test_int8_conv_exact_with_magnitude_aware_kernels():
+    """The int8 path must carry per-channel kernel scales exactly
+    (Bi-Real-Net's magnitude_aware_sign weights) instead of stripping
+    them with a bare sign cast."""
+    from zookeeper_tpu.ops import QuantConv
+
+    rng = np.random.default_rng(35)
+    x = jnp.asarray(rng.normal(size=(2, 8, 8, 16)), jnp.float32)
+    kwargs = dict(
+        features=8, kernel_size=(3, 3), input_quantizer="ste_sign",
+        kernel_quantizer="magnitude_aware_sign",
+    )
+    mxu = QuantConv(**kwargs, binary_compute="mxu")
+    i8 = QuantConv(**kwargs, binary_compute="int8")
+    params = mxu.init(jax.random.key(0), x)
+    y_mxu = np.asarray(mxu.apply(params, x))
+    y_i8 = np.asarray(i8.apply(params, x))
+    assert np.abs(y_mxu).max() > 0
+    np.testing.assert_allclose(y_i8, y_mxu, rtol=1e-5, atol=1e-5)
+
+
+def test_int8_rejects_fractional_input_quantizer():
+    from zookeeper_tpu.ops import QuantConv
+
+    x = jnp.zeros((1, 4, 4, 8), jnp.float32)
+    conv = QuantConv(
+        features=4, input_quantizer="dorefa", kernel_quantizer="ste_sign",
+        binary_compute="int8",
+    )
+    with pytest.raises(ValueError, match="non-integer"):
+        conv.init(jax.random.key(0), x)

@@ -399,3 +399,28 @@ def test_packed_inference_under_dp_sharding():
         lambda v, xx: module_p.apply(v, xx, training=False)
     )(variables, x_sharded)
     np.testing.assert_array_equal(np.asarray(y_ref), np.asarray(y_sharded))
+
+
+def test_tp_rules_replicate_depthwise_kernels():
+    """Depthwise kernels must NOT match the dense-conv TP rule (their
+    tied input/output channels make output-feature sharding wrong)."""
+    import numpy as np
+
+    from zookeeper_tpu.parallel import conv_model_tp_rules, match_partition_rules
+
+    tree = {
+        "params": {
+            "QuantConv_0": {"kernel": np.zeros((3, 3, 8, 16))},
+            "QuantDepthwiseConv_0": {
+                "QuantConv_0": {"kernel": np.zeros((3, 3, 1, 16))}
+            },
+        }
+    }
+    specs = match_partition_rules(conv_model_tp_rules(), tree)
+    assert specs["params"]["QuantConv_0"]["kernel"] == PartitionSpec(
+        None, None, None, "model"
+    )
+    assert (
+        specs["params"]["QuantDepthwiseConv_0"]["QuantConv_0"]["kernel"]
+        == PartitionSpec()
+    )

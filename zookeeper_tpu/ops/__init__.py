@@ -20,7 +20,12 @@ from zookeeper_tpu.ops.quantizers import (
     ste_tern,
     swish_sign,
 )
-from zookeeper_tpu.ops.layers import QuantConv, QuantDense
+from zookeeper_tpu.ops.layers import (
+    QuantConv,
+    QuantDense,
+    QuantDepthwiseConv,
+    QuantSeparableConv,
+)
 from zookeeper_tpu.ops.binary_compute import (
     int8_conv,
     int8_matmul,
@@ -50,6 +55,8 @@ __all__ = [
     "QUANTIZERS",
     "QuantConv",
     "QuantDense",
+    "QuantDepthwiseConv",
+    "QuantSeparableConv",
     "approx_sign",
     "dorefa",
     "get_quantizer",

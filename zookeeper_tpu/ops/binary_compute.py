@@ -504,13 +504,14 @@ def _packed_conv_forward(
     return y.reshape(b, ho, wo, co)
 
 
-def _float_conv(x, k, strides, padding):
+def _float_conv(x, k, strides, padding, groups=1):
     # Mixed precision: activations may be bf16 while latent kernels are
     # fp32; compute the gradient conv in the wider dtype.
     dtype = jnp.promote_types(x.dtype, k.dtype)
     return jax.lax.conv_general_dilated(
         x.astype(dtype), k.astype(dtype), window_strides=tuple(strides),
         padding=padding, dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        feature_group_count=groups,
     )
 
 
@@ -647,36 +648,52 @@ def int8_matmul(a_sign: Array, b_sign: Array) -> Array:
     ).astype(jnp.float32)
 
 
-def _int8_conv_forward(x_sign, k_sign, strides, padding):
-    x8 = jnp.sign(x_sign).astype(jnp.int8)
-    k8 = jnp.sign(k_sign).astype(jnp.int8)
+def _int8_conv_forward(x_sign, k_sign, strides, padding, groups):
+    # Kernel contract: sign x per-OUTPUT-channel scale (what the
+    # sign-family quantizers produce). Dividing by the channel max
+    # recovers exact {-1, 0, +1} int8 values — so magnitude_aware_sign
+    # kernels run exactly too (the scale re-applies to the int32 sums,
+    # ONE rounding instead of the float conv's per-element roundings).
+    kscale = jnp.max(jnp.abs(k_sign), axis=(0, 1, 2))
+    safe = jnp.where(kscale > 0, kscale, jnp.ones_like(kscale))
+    k8 = jnp.round(k_sign / safe).astype(jnp.int8)
+    # Inputs are exact small integers by the validated quantizer contract
+    # ({-1, 0, +1}); round (not sign) so a literal 0 stays 0.
+    x8 = jnp.round(x_sign).astype(jnp.int8)
     out = jax.lax.conv_general_dilated(
         x8, k8, window_strides=tuple(strides), padding=padding,
         dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        feature_group_count=groups,
         preferred_element_type=jnp.int32,
     )
-    return out.astype(jnp.float32)
+    return out.astype(jnp.float32) * safe.astype(jnp.float32)
 
 
-@partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+@partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
 def int8_conv(x_sign: Array, k_sign: Array, strides: Tuple[int, int],
-              padding: str) -> Array:
-    """NHWC conv of +-1 operands on the int8 MXU path: exact vs the float
-    conv (values representable), with the float conv's gradients (the op
-    *is* that function on its domain)."""
-    return _int8_conv_forward(x_sign, k_sign, strides, padding)
+              padding: str, groups: int = 1) -> Array:
+    """NHWC conv of quantized operands on the int8 MXU path.
+
+    Inputs must be exact small integers ({-1, 0, +1}); the kernel must be
+    sign x per-output-channel scale. Exact vs the float conv on that
+    domain (integer accumulation, one scale multiply), with the float
+    conv's gradients (the op *is* that function there). ``groups``
+    supports depthwise/grouped convs (QuantDepthwiseConv)."""
+    return _int8_conv_forward(x_sign, k_sign, strides, padding, groups)
 
 
-def _int8_conv_fwd(x_sign, k_sign, strides, padding):
-    return _int8_conv_forward(x_sign, k_sign, strides, padding), (
+def _int8_conv_fwd(x_sign, k_sign, strides, padding, groups):
+    return _int8_conv_forward(x_sign, k_sign, strides, padding, groups), (
         x_sign, k_sign,
     )
 
 
-def _int8_conv_bwd(strides, padding, res, g):
+def _int8_conv_bwd(strides, padding, groups, res, g):
     x_sign, k_sign = res
-    _, vjp = jax.vjp(lambda x, k: _float_conv(x, k, strides, padding),
-                     x_sign, k_sign)
+    _, vjp = jax.vjp(
+        lambda x, k: _float_conv(x, k, strides, padding, groups),
+        x_sign, k_sign,
+    )
     dx, dk = vjp(g.astype(jnp.promote_types(x_sign.dtype, k_sign.dtype)))
     return dx.astype(x_sign.dtype), dk.astype(k_sign.dtype)
 

@@ -30,12 +30,19 @@ Quantizer = Union[str, Callable, None]
 _SIGN_KERNEL_QUANTIZERS = frozenset(
     {"ste_sign", "approx_sign", "swish_sign", "magnitude_aware_sign"}
 )
-#: Input quantizers safe for the packed-weight MXU path: values must be
-#: exact small integers ({-1, 0, +1}) because activations are cast to
-#: int8 (dorefa's fractions / magnitude_aware's scales would truncate).
+#: Input quantizers safe for the int8 and packed-weight MXU paths: values
+#: must be exact small integers ({-1, 0, +1}) because activations are
+#: cast to int8 (dorefa's fractions would truncate).
 _INT_INPUT_QUANTIZERS = frozenset(
     {"ste_sign", "approx_sign", "swish_sign", "ste_tern", "ste_heaviside"}
 )
+#: Kernel quantizers the int8 path runs exactly: sign-family (sign x
+#: per-channel scale — the scale is re-applied after the integer conv)
+#: plus the exact-small-integer quantizers.
+_INT_KERNEL_QUANTIZERS = _SIGN_KERNEL_QUANTIZERS | {
+    "ste_tern",
+    "ste_heaviside",
+}
 #: Input quantizers safe for the bit-serial popcount path: strictly +-1
 #: (a 0 would be packed as the +1 bit and silently miscounted).
 _PM1_INPUT_QUANTIZERS = frozenset({"ste_sign", "approx_sign", "swish_sign"})
@@ -118,12 +125,22 @@ def _check_binary_compute(
                 "sign x per-channel scale (packed kernels require one of "
                 f"{sorted(_SIGN_KERNEL_QUANTIZERS)})"
             )
+    if mode == "int8" and isinstance(kernel_quantizer, str):
+        if kernel_quantizer not in _INT_KERNEL_QUANTIZERS:
+            problems.append(
+                f"kernel_quantizer {kernel_quantizer!r} does not produce "
+                "sign x per-channel scale or exact small integers "
+                f"(int8 requires one of {sorted(_INT_KERNEL_QUANTIZERS)})"
+            )
     if isinstance(input_quantizer, str):
-        if mode == "xnor" and input_quantizer not in _INT_INPUT_QUANTIZERS:
+        if (
+            mode in ("int8", "xnor")
+            and input_quantizer not in _INT_INPUT_QUANTIZERS
+        ):
             problems.append(
                 f"input_quantizer {input_quantizer!r} can emit non-integer "
                 "values, which the int8 activation cast would truncate "
-                f"(xnor requires one of {sorted(_INT_INPUT_QUANTIZERS)})"
+                f"({mode} requires one of {sorted(_INT_INPUT_QUANTIZERS)})"
             )
         if (
             mode == "xnor_popcount"
@@ -208,6 +225,9 @@ class QuantConv(nn.Module):
     #: Supported on the "mxu" path only; the specialized int8/packed
     #: kernels reject it loudly.
     kernel_dilation: Tuple[int, int] = (1, 1)
+    #: Grouped conv (mxu/int8 paths; packed kernels reject it).
+    #: -1 = depthwise (groups = input channels, resolved at call time).
+    feature_group_count: int = 1
     input_quantizer: Quantizer = None
     kernel_quantizer: Quantizer = None
     kernel_clip: bool = True
@@ -247,6 +267,20 @@ class QuantConv(nn.Module):
             )
         kh, kw = self.kernel_size
         ci = x.shape[-1]
+        groups = ci if self.feature_group_count == -1 else self.feature_group_count
+        if ci % groups != 0 or self.features % groups != 0:
+            raise ValueError(
+                f"{type(self).__name__}: feature_group_count={groups} must "
+                f"divide both input channels ({ci}) and features "
+                f"({self.features})."
+            )
+        if groups != 1 and self.binary_compute not in ("mxu", "int8"):
+            raise ValueError(
+                f"{type(self).__name__}: grouped conv (feature_group_count="
+                f"{groups}) supports binary_compute 'mxu'/'int8' only "
+                f"(got {self.binary_compute!r}) — the packed kernels "
+                "compress the K=ci contraction, which grouping removes."
+            )
 
         if self.packed_weights:
             if self.binary_compute not in ("xnor", "xnor_popcount"):
@@ -278,7 +312,7 @@ class QuantConv(nn.Module):
             kernel = self.param(
                 _kernel_param_name(self.kernel_quantizer),
                 self.kernel_init,
-                (kh, kw, ci, self.features),
+                (kh, kw, ci // groups, self.features),
                 jnp.float32,
             )
             if in_q is not None:
@@ -287,7 +321,9 @@ class QuantConv(nn.Module):
             if k_q is not None:
                 kernel = k_q(kernel)
             if self.binary_compute == "int8":
-                y = int8_conv(x, kernel, tuple(self.strides), self.padding)
+                y = int8_conv(
+                    x, kernel, tuple(self.strides), self.padding, groups
+                )
                 y = y.astype(self.dtype)
             elif self.binary_compute in ("xnor", "xnor_popcount"):
                 y = xnor_conv(
@@ -303,8 +339,121 @@ class QuantConv(nn.Module):
                     padding=self.padding,
                     rhs_dilation=tuple(self.kernel_dilation),
                     dimension_numbers=("NHWC", "HWIO", "NHWC"),
+                    feature_group_count=groups,
                 )
         if self.use_bias:
             bias = self.param("bias", self.bias_init, (self.features,), jnp.float32)
             y = y + bias.astype(self.dtype)
         return y
+
+
+class QuantDepthwiseConv(nn.Module):
+    """Depthwise 2-D conv with optional input/kernel quantization (NHWC)
+    — the larq ``QuantDepthwiseConv2D`` capability.
+
+    Kernel shape [kh, kw, 1, ci * channel_multiplier] (XLA grouped-conv
+    HWIO layout with ``feature_group_count=ci``). Depthwise contractions
+    are K=kh*kw per output (tiny), so there is no packed path to win
+    with: ``binary_compute`` supports "mxu" and "int8" only; the packed
+    modes are rejected loudly.
+    """
+
+    channel_multiplier: int = 1
+    kernel_size: Tuple[int, int] = (3, 3)
+    strides: Tuple[int, int] = (1, 1)
+    padding: Union[str, Sequence[Tuple[int, int]]] = "SAME"
+    input_quantizer: Quantizer = None
+    kernel_quantizer: Quantizer = None
+    kernel_clip: bool = True
+    use_bias: bool = False
+    dtype: Any = jnp.float32
+    binary_compute: str = "mxu"
+    kernel_init: Callable = nn.initializers.glorot_normal()
+    bias_init: Callable = nn.initializers.zeros_init()
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        if self.binary_compute not in ("mxu", "int8"):
+            raise ValueError(
+                f"{type(self).__name__}: binary_compute="
+                f"{self.binary_compute!r} unsupported (depthwise K is "
+                "kh*kw — nothing for the packed kernels to compress); "
+                "use 'mxu' or 'int8'."
+            )
+        # Thin delegate: all quantize/clip/dispatch plumbing lives ONCE
+        # in QuantConv; depthwise is its grouped case.
+        return QuantConv(
+            features=x.shape[-1] * self.channel_multiplier,
+            kernel_size=self.kernel_size,
+            strides=self.strides,
+            padding=self.padding,
+            feature_group_count=-1,
+            input_quantizer=self.input_quantizer,
+            kernel_quantizer=self.kernel_quantizer,
+            kernel_clip=self.kernel_clip,
+            use_bias=self.use_bias,
+            dtype=self.dtype,
+            binary_compute=self.binary_compute,
+            kernel_init=self.kernel_init,
+            bias_init=self.bias_init,
+        )(x)
+
+
+class QuantSeparableConv(nn.Module):
+    """Separable conv (depthwise then pointwise), both stages optionally
+    quantized — the larq ``QuantSeparableConv2D`` capability.
+
+    larq-faithful data flow: ``input_quantizer`` applies to the LAYER
+    input only; the depthwise output flows to the pointwise stage
+    unquantized (it carries integer accumulations whose magnitudes are
+    information). ``intermediate_quantizer`` (an extension, None by
+    default) re-binarizes the intermediate — required if the pointwise
+    stage is to run a binary compute path (int8/packed), since those
+    validate their input quantizer loudly.
+
+    Compute paths are per-stage and explicit — no silent mapping:
+    ``depthwise_compute`` ("mxu"/"int8") and ``pointwise_compute`` (full
+    QuantConv selection incl. packed deployment).
+    """
+
+    features: int
+    kernel_size: Tuple[int, int] = (3, 3)
+    strides: Tuple[int, int] = (1, 1)
+    padding: Union[str, Sequence[Tuple[int, int]]] = "SAME"
+    channel_multiplier: int = 1
+    input_quantizer: Quantizer = None
+    depthwise_quantizer: Quantizer = None
+    pointwise_quantizer: Quantizer = None
+    intermediate_quantizer: Quantizer = None
+    kernel_clip: bool = True
+    use_bias: bool = False
+    dtype: Any = jnp.float32
+    depthwise_compute: str = "mxu"
+    pointwise_compute: str = "mxu"
+    packed_weights: bool = False
+    pallas_interpret: bool = False
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        x = QuantDepthwiseConv(
+            channel_multiplier=self.channel_multiplier,
+            kernel_size=self.kernel_size,
+            strides=self.strides,
+            padding=self.padding,
+            input_quantizer=self.input_quantizer,
+            kernel_quantizer=self.depthwise_quantizer,
+            kernel_clip=self.kernel_clip,
+            dtype=self.dtype,
+            binary_compute=self.depthwise_compute,
+        )(x)
+        return QuantConv(
+            self.features, (1, 1),
+            input_quantizer=self.intermediate_quantizer,
+            kernel_quantizer=self.pointwise_quantizer,
+            kernel_clip=self.kernel_clip,
+            use_bias=self.use_bias,
+            dtype=self.dtype,
+            binary_compute=self.pointwise_compute,
+            packed_weights=self.packed_weights,
+            pallas_interpret=self.pallas_interpret,
+        )(x)

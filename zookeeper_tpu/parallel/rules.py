@@ -30,6 +30,10 @@ def conv_model_tp_rules(model_axis: str = "model") -> List[PartitionRule]:
     """
     P = PartitionSpec
     return [
+        # Depthwise kernels replicate (first match wins — their tied
+        # input/output channels would otherwise match the dense-conv
+        # rule below and force GSPMD resharding of the grouped conv).
+        (r"QuantDepthwiseConv_\d+/", P()),
         # Packed binary kernels [kh, kw, ci_words, co]: shard co.
         (r"kernel_packed$", P(None, None, None, model_axis)),
         (r"kernel_scale$", P(model_axis)),
