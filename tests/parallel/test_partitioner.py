@@ -404,9 +404,7 @@ def test_packed_inference_under_dp_sharding():
 def test_tp_rules_replicate_depthwise_kernels():
     """Depthwise kernels must NOT match the dense-conv TP rule (their
     tied input/output channels make output-feature sharding wrong)."""
-    import numpy as np
-
-    from zookeeper_tpu.parallel import conv_model_tp_rules, match_partition_rules
+    from zookeeper_tpu.parallel import conv_model_tp_rules
 
     tree = {
         "params": {

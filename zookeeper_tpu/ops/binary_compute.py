@@ -648,15 +648,21 @@ def int8_matmul(a_sign: Array, b_sign: Array) -> Array:
     ).astype(jnp.float32)
 
 
-def _int8_conv_forward(x_sign, k_sign, strides, padding, groups):
-    # Kernel contract: sign x per-OUTPUT-channel scale (what the
-    # sign-family quantizers produce). Dividing by the channel max
-    # recovers exact {-1, 0, +1} int8 values — so magnitude_aware_sign
-    # kernels run exactly too (the scale re-applies to the int32 sums,
-    # ONE rounding instead of the float conv's per-element roundings).
-    kscale = jnp.max(jnp.abs(k_sign), axis=(0, 1, 2))
-    safe = jnp.where(kscale > 0, kscale, jnp.ones_like(kscale))
-    k8 = jnp.round(k_sign / safe).astype(jnp.int8)
+def _int8_conv_forward(x_sign, k_sign, strides, padding, groups, scaled):
+    if scaled:
+        # Kernel contract: sign x per-OUTPUT-channel scale (what the
+        # sign-family quantizers produce). Dividing by the channel max
+        # recovers exact {-1, 0, +1} int8 values — so
+        # magnitude_aware_sign kernels run exactly too (the scale
+        # re-applies to the int32 sums, ONE rounding instead of the
+        # float conv's per-element roundings).
+        kscale = jnp.max(jnp.abs(k_sign), axis=(0, 1, 2))
+        safe = jnp.where(kscale > 0, kscale, jnp.ones_like(kscale))
+        k8 = jnp.round(k_sign / safe).astype(jnp.int8)
+    else:
+        # Statically known unscaled ({-1, 0, +1} values): skip the
+        # runtime scale extraction (measurable at train-step scale).
+        k8 = jnp.round(k_sign).astype(jnp.int8)
     # Inputs are exact small integers by the validated quantizer contract
     # ({-1, 0, +1}); round (not sign) so a literal 0 stays 0.
     x8 = jnp.round(x_sign).astype(jnp.int8)
@@ -666,29 +672,33 @@ def _int8_conv_forward(x_sign, k_sign, strides, padding, groups):
         feature_group_count=groups,
         preferred_element_type=jnp.int32,
     )
-    return out.astype(jnp.float32) * safe.astype(jnp.float32)
+    out = out.astype(jnp.float32)
+    return out * safe.astype(jnp.float32) if scaled else out
 
 
-@partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
+@partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5))
 def int8_conv(x_sign: Array, k_sign: Array, strides: Tuple[int, int],
-              padding: str, groups: int = 1) -> Array:
+              padding: str, groups: int = 1, scaled: bool = True) -> Array:
     """NHWC conv of quantized operands on the int8 MXU path.
 
     Inputs must be exact small integers ({-1, 0, +1}); the kernel must be
     sign x per-output-channel scale. Exact vs the float conv on that
     domain (integer accumulation, one scale multiply), with the float
     conv's gradients (the op *is* that function there). ``groups``
-    supports depthwise/grouped convs (QuantDepthwiseConv)."""
-    return _int8_conv_forward(x_sign, k_sign, strides, padding, groups)
+    supports depthwise/grouped convs (QuantDepthwiseConv); pass
+    ``scaled=False`` when the kernel is statically known to be pure
+    {-1, 0, +1} (skips the scale extraction)."""
+    return _int8_conv_forward(x_sign, k_sign, strides, padding, groups, scaled)
 
 
-def _int8_conv_fwd(x_sign, k_sign, strides, padding, groups):
-    return _int8_conv_forward(x_sign, k_sign, strides, padding, groups), (
-        x_sign, k_sign,
+def _int8_conv_fwd(x_sign, k_sign, strides, padding, groups, scaled):
+    return (
+        _int8_conv_forward(x_sign, k_sign, strides, padding, groups, scaled),
+        (x_sign, k_sign),
     )
 
 
-def _int8_conv_bwd(strides, padding, groups, res, g):
+def _int8_conv_bwd(strides, padding, groups, scaled, res, g):
     x_sign, k_sign = res
     _, vjp = jax.vjp(
         lambda x, k: _float_conv(x, k, strides, padding, groups),

@@ -267,6 +267,12 @@ class QuantConv(nn.Module):
             )
         kh, kw = self.kernel_size
         ci = x.shape[-1]
+        if self.feature_group_count != -1 and self.feature_group_count < 1:
+            raise ValueError(
+                f"{type(self).__name__}: feature_group_count="
+                f"{self.feature_group_count} invalid (>= 1, or -1 for "
+                "depthwise)."
+            )
         groups = ci if self.feature_group_count == -1 else self.feature_group_count
         if ci % groups != 0 or self.features % groups != 0:
             raise ValueError(
@@ -321,8 +327,16 @@ class QuantConv(nn.Module):
             if k_q is not None:
                 kernel = k_q(kernel)
             if self.binary_compute == "int8":
+                # Unscaled kernels are statically known for the pure
+                # {-1,0,+1} string quantizers; callables conservatively
+                # assume a scale (stays exact either way).
+                unscaled = (
+                    isinstance(self.kernel_quantizer, str)
+                    and self.kernel_quantizer != "magnitude_aware_sign"
+                )
                 y = int8_conv(
-                    x, kernel, tuple(self.strides), self.padding, groups
+                    x, kernel, tuple(self.strides), self.padding, groups,
+                    not unscaled,
                 )
                 y = y.astype(self.dtype)
             elif self.binary_compute in ("xnor", "xnor_popcount"):
