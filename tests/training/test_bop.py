@@ -287,3 +287,159 @@ def test_model_summary_packed_counts_true_weights():
     packed_dep = sum(r.deploy_bytes for r in s_packed.rows if r.binary)
     train_dep = sum(r.deploy_bytes for r in s_train.rows if r.binary)
     assert packed_dep == train_dep
+
+
+def test_gradient_accumulation_semantics():
+    """accumulate_steps=k: params move only on every k-th micro step, by
+    the update computed from the MEAN of the k microbatch gradients."""
+    from zookeeper_tpu.training import Sgd
+
+    opt = Sgd()
+    configure(
+        opt, {"schedule.base_lr": 0.5, "accumulate_steps": 2}, name="opt"
+    )
+    tx = opt.build(total_steps=10)
+    params = jnp.array([1.0, 2.0])
+    state = tx.init(params)
+    g1 = jnp.array([0.2, -0.4])
+    g2 = jnp.array([0.6, 0.0])
+    up1, state = tx.update(g1, state, params)
+    p1 = optax.apply_updates(params, up1)
+    np.testing.assert_array_equal(np.asarray(p1), np.asarray(params))
+    up2, state = tx.update(g2, state, p1)
+    p2 = optax.apply_updates(p1, up2)
+    expected = params - 0.5 * (g1 + g2) / 2.0
+    np.testing.assert_allclose(np.asarray(p2), np.asarray(expected), rtol=1e-6)
+
+
+def test_bop_with_accumulation_flips_on_boundary():
+    from zookeeper_tpu.training import make_train_step
+
+    opt = Bop()
+    configure(
+        opt, {"threshold": 0.0, "gamma": 0.1, "accumulate_steps": 2},
+        name="opt",
+    )
+    state, input_shape = _quicknet_tiny_state(opt)
+    step = jax.jit(make_train_step())
+    rng = np.random.default_rng(0)
+    batch = {
+        "input": jnp.asarray(rng.normal(size=(8, *input_shape)), jnp.float32),
+        "target": jnp.asarray(rng.integers(0, 4, 8)),
+    }
+    mid_state, _ = step(state, batch)
+    # Micro step 1: nothing applied yet.
+    for a, b in zip(
+        jax.tree.leaves(state.params), jax.tree.leaves(mid_state.params)
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    end_state, metrics = step(mid_state, batch)
+    assert np.isfinite(float(metrics["loss"]))
+    moved = any(
+        not np.allclose(np.asarray(a), np.asarray(b))
+        for a, b in zip(
+            jax.tree.leaves(state.params), jax.tree.leaves(end_state.params)
+        )
+    )
+    assert moved  # Boundary step applies the accumulated update.
+
+
+@pytest.mark.parametrize("cls_name", ["Lamb", "Lars"])
+def test_large_batch_optimizers_step(cls_name):
+    import zookeeper_tpu.training as tr
+    from zookeeper_tpu.training import make_train_step
+
+    opt = getattr(tr, cls_name)()
+    configure(opt, {"weight_decay": 1e-4}, name="opt")
+    state, input_shape = _quicknet_tiny_state(opt)
+    step = jax.jit(make_train_step())
+    rng = np.random.default_rng(0)
+    batch = {
+        "input": jnp.asarray(rng.normal(size=(8, *input_shape)), jnp.float32),
+        "target": jnp.asarray(rng.integers(0, 4, 8)),
+    }
+    new_state, metrics = step(state, batch)
+    assert np.isfinite(float(metrics["loss"]))
+    moved = any(
+        not np.allclose(np.asarray(a), np.asarray(b))
+        for a, b in zip(
+            jax.tree.leaves(state.params), jax.tree.leaves(new_state.params)
+        )
+    )
+    assert moved
+
+
+def test_accumulated_schedule_equals_reference_trajectory():
+    """accumulate_steps=k must be EQUIVALENT to an unaccumulated run on
+    the mean gradients with a schedule over the applied steps — pinning
+    both the mean semantics and the applied-units schedule (a
+    micro-step-built schedule would stretch the decay by k)."""
+    from zookeeper_tpu.training import Sgd
+
+    grads = [jnp.array([g]) for g in (0.3, -0.5, 0.2, 0.8, -0.1, 0.4, 0.6, -0.2)]
+
+    opt_acc = Sgd()
+    configure(
+        opt_acc,
+        {"schedule": "CosineDecay", "schedule.base_lr": 0.5,
+         "accumulate_steps": 2},
+        name="opt_acc",
+    )
+    tx = opt_acc.build(total_steps=8)  # 8 micro steps.
+    p = jnp.array([1.0])
+    st = tx.init(p)
+    for g in grads:
+        up, st = tx.update(g, st, p)
+        p = optax.apply_updates(p, up)
+
+    opt_ref = Sgd()
+    configure(
+        opt_ref,
+        {"schedule": "CosineDecay", "schedule.base_lr": 0.5},
+        name="opt_ref",
+    )
+    tx_ref = opt_ref.build(total_steps=4)  # 4 applied steps.
+    p_ref = jnp.array([1.0])
+    st_ref = tx_ref.init(p_ref)
+    for g1, g2 in zip(grads[::2], grads[1::2]):
+        up, st_ref = tx_ref.update((g1 + g2) / 2.0, st_ref, p_ref)
+        p_ref = optax.apply_updates(p_ref, up)
+
+    np.testing.assert_allclose(np.asarray(p), np.asarray(p_ref), rtol=1e-6)
+
+
+def test_bop_accumulation_fp_side_single_wrapped():
+    """The unscoped accumulate_steps key scope-inherits onto
+    fp_optimizer; Bop must still apply accumulation ONCE — fp params
+    move on micro step k, not k^2."""
+    from zookeeper_tpu.training import make_train_step
+
+    opt = Bop()
+    configure(
+        opt, {"threshold": 0.0, "gamma": 0.1, "accumulate_steps": 2},
+        name="opt",
+    )
+    assert opt.fp_optimizer.accumulate_steps == 2  # Inherited, by design.
+    state, input_shape = _quicknet_tiny_state(opt)
+    step = jax.jit(make_train_step())
+    rng = np.random.default_rng(0)
+    batch = {
+        "input": jnp.asarray(rng.normal(size=(8, *input_shape)), jnp.float32),
+        "target": jnp.asarray(rng.integers(0, 4, 8)),
+    }
+    s1, _ = step(state, batch)
+    s2, _ = step(s1, batch)
+
+    import re
+
+    from flax import traverse_util
+
+    pat = re.compile(BINARY_KERNEL_PATTERN)
+    old = traverse_util.flatten_dict(state.params, sep="/")
+    new = traverse_util.flatten_dict(s2.params, sep="/")
+    fp_moved = any(
+        not np.allclose(np.asarray(old[p]), np.asarray(new[p]))
+        for p in old
+        if not pat.search(p)
+    )
+    assert fp_moved  # At micro step 2 (the boundary), not step 4.

@@ -27,6 +27,8 @@ from zookeeper_tpu.training.optimizer import (
     Adam,
     AdamW,
     Bop,
+    Lamb,
+    Lars,
     Momentum,
     Optimizer,
     Rmsprop,
@@ -49,6 +51,8 @@ __all__ = [
     "BINARY_KERNEL_PATTERN",
     "Bop",
     "Checkpointer",
+    "Lamb",
+    "Lars",
     "scale_by_bop",
     "CompositeMetricsWriter",
     "ConstantSchedule",

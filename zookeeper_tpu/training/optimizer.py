@@ -25,6 +25,11 @@ class Optimizer:
     schedule: Schedule = ComponentField(ConstantSchedule)
     weight_decay: float = Field(0.0)
     global_clip_norm: float = Field(0.0)
+    #: Gradient accumulation: apply updates every N steps on the mean of
+    #: N microbatch gradients (optax.MultiSteps). Scales effective batch
+    #: size without memory — e.g. a pod-scale global batch rehearsed on a
+    #: small slice. state.step counts MICRO steps.
+    accumulate_steps: int = Field(1)
 
     #: Subclasses whose _core already applies weight_decay (AdamW path) set
     #: this so the base chain does not double-apply it.
@@ -33,15 +38,38 @@ class Optimizer:
     def _core(self, lr) -> optax.GradientTransformation:
         raise NotImplementedError
 
-    def build(self, total_steps: int) -> optax.GradientTransformation:
-        lr = self.schedule.build(total_steps)
+    def _applied_steps(self, total_steps: int) -> int:
+        """Optimizer-applied steps for a run of ``total_steps`` MICRO
+        steps: MultiSteps advances the inner transform (and thus the LR
+        schedule) only on accumulation boundaries, so schedules must be
+        built in applied units or their decay stretches by k."""
+        if self.accumulate_steps > 1:
+            return max(1, -(-total_steps // self.accumulate_steps))
+        return total_steps
+
+    def _wrap_accumulation(self, tx) -> optax.GradientTransformation:
+        if self.accumulate_steps > 1:
+            tx = optax.MultiSteps(
+                tx, every_k_schedule=self.accumulate_steps
+            ).gradient_transformation()
+        return tx
+
+    def build(
+        self, total_steps: int, *, _accumulate: bool = True
+    ) -> optax.GradientTransformation:
+        """``total_steps`` is in MICRO (per-batch) steps; the schedule is
+        built in applied units automatically. ``_accumulate=False`` is for
+        wrapping optimizers (Bop) that apply accumulation once around a
+        composite themselves."""
+        lr = self.schedule.build(self._applied_steps(total_steps))
         chain = []
         if self.global_clip_norm > 0:
             chain.append(optax.clip_by_global_norm(self.global_clip_norm))
         if self.weight_decay > 0 and not self._core_handles_weight_decay:
             chain.append(optax.add_decayed_weights(self.weight_decay))
         chain.append(self._core(lr))
-        return optax.chain(*chain) if len(chain) > 1 else chain[0]
+        tx = optax.chain(*chain) if len(chain) > 1 else chain[0]
+        return self._wrap_accumulation(tx) if _accumulate else tx
 
 
 @component
@@ -203,7 +231,10 @@ class Bop(Optimizer):
                 "threshold)."
             )
         pattern = re.compile(self.binary_param_pattern)
-        fp_tx = self.fp_optimizer.build(total_steps)
+        # Accumulation wraps ONCE around the whole binary/fp split (the
+        # unscoped accumulate_steps key scope-inherits onto fp_optimizer,
+        # which must therefore NOT wrap again — k^2 cadence otherwise).
+        fp_tx = self.fp_optimizer.build(total_steps, _accumulate=False)
         bop_tx = scale_by_bop(self.threshold, self.gamma)
 
         def labels(params):
@@ -215,6 +246,45 @@ class Bop(Optimizer):
             }
             return traverse_util.unflatten_dict(flat, sep="/")
 
-        return optax.multi_transform(
-            {"binary": bop_tx, "fp": fp_tx}, labels
+        tx = optax.multi_transform({"binary": bop_tx, "fp": fp_tx}, labels)
+        # Accumulation wraps the WHOLE split: Bop's gradient memory then
+        # sees the mean of the microbatch gradients, exactly as it would
+        # see a larger batch's gradient.
+        return self._wrap_accumulation(tx)
+
+
+@component
+class Lamb(Optimizer):
+    """LAMB (You et al. 2020): layerwise-adaptive Adam for LARGE-batch
+    training — the standard choice when DP scaling pushes global batch
+    into the tens of thousands (e.g. ImageNet in minutes on a pod)."""
+
+    b1: float = Field(0.9)
+    b2: float = Field(0.999)
+    eps: float = Field(1e-6)
+
+    _core_handles_weight_decay = True
+
+    def _core(self, lr):
+        return optax.lamb(
+            lr, b1=self.b1, b2=self.b2, eps=self.eps,
+            weight_decay=self.weight_decay,
+        )
+
+
+@component
+class Lars(Optimizer):
+    """LARS (You et al. 2017): layerwise-adaptive momentum SGD for
+    large-batch training."""
+
+    momentum: float = Field(0.9)
+    trust_coefficient: float = Field(0.001)
+
+    _core_handles_weight_decay = True
+
+    def _core(self, lr):
+        return optax.lars(
+            lr, weight_decay=self.weight_decay,
+            momentum=self.momentum,
+            trust_coefficient=self.trust_coefficient,
         )
