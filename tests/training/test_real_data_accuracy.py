@@ -1,0 +1,90 @@
+"""Real-data accuracy anchors (VERDICT round-1 missing #4; SURVEY §6).
+
+SklearnDigits is genuine handwritten-digit data (offline, bundled with
+scikit-learn). Training to high validation accuracy on it is evidence no
+loss/gradient/pipeline bug survives — for BOTH the fp stack and the
+binary (STE quantizer) stack.
+"""
+
+import numpy as np
+import pytest
+
+from zookeeper_tpu.core import configure
+from zookeeper_tpu.training import TrainingExperiment
+
+pytest.importorskip("sklearn")
+
+
+def _digits_conf(extra=None):
+    return {
+        "loader.dataset": "SklearnDigits",
+        "loader.preprocessing": "ImageClassificationPreprocessing",
+        "loader.preprocessing.height": 8,
+        "loader.preprocessing.width": 8,
+        "loader.preprocessing.channels": 1,
+        "loader.host_index": 0,
+        "loader.host_count": 1,
+        "batch_size": 64,
+        "verbose": False,
+        **(extra or {}),
+    }
+
+
+def test_fp_model_learns_real_digits():
+    """SimpleCnn reaches >=90% validation accuracy on real handwritten
+    digits in a few epochs — far above the 10% chance floor."""
+    exp = TrainingExperiment()
+    configure(
+        exp,
+        _digits_conf({
+            "model": "SimpleCnn",
+            "model.features": (16, 32),
+            "model.dense_units": (64,),
+            "epochs": 5,
+        }),
+        name="experiment",
+    )
+    history = exp.run()
+    val_acc = history["validation"][-1]["accuracy"]
+    assert val_acc >= 0.90, f"val accuracy {val_acc:.3f} < 0.90"
+
+
+def test_binary_model_learns_real_digits():
+    """BinaryNet (ste_sign activations AND weights, latent training)
+    reaches >=80% validation accuracy on real digits — the full STE
+    quantizer stack learns on actual data, not just synthetic."""
+    exp = TrainingExperiment()
+    configure(
+        exp,
+        _digits_conf({
+            "model": "BinaryNet",
+            "model.features": (32, 32),
+            "model.dense_units": (64,),
+            "epochs": 8,
+            "optimizer.schedule.base_lr": 5e-3,
+        }),
+        name="experiment",
+    )
+    history = exp.run()
+    val_acc = history["validation"][-1]["accuracy"]
+    assert val_acc >= 0.80, f"val accuracy {val_acc:.3f} < 0.80"
+
+
+def test_digits_split_is_deterministic_and_disjoint():
+    from zookeeper_tpu.data import SklearnDigits
+
+    ds = SklearnDigits()
+    configure(ds, {"seed": 3}, name="ds")
+    train, val = ds.train(), ds.validation()
+    assert len(train) + len(val) == 1797
+    assert ds.resolved_num_classes() == 10
+    # Deterministic: a second instance with the same seed yields the
+    # same examples.
+    ds2 = SklearnDigits()
+    configure(ds2, {"seed": 3}, name="ds2")
+    np.testing.assert_array_equal(
+        np.asarray(train[0]["image"]), np.asarray(ds2.train()[0]["image"])
+    )
+    np.testing.assert_array_equal(
+        np.asarray(train[0]["label"]), np.asarray(ds2.train()[0]["label"])
+    )
