@@ -1,0 +1,156 @@
+"""Packed-deployment converter (the larq-compute-engine converter
+capability, SURVEY.md §2.4, as a CLI task).
+
+Converts a trained float checkpoint (``TrainingExperiment
+export_model_to=...``) into the bit-packed deployment form: binary conv
+kernels stored as int32 lanes (32x smaller) + per-channel scales,
+loadable into the same model built with ``packed_weights=True``::
+
+    # 1. Train and export the float model:
+    python examples/mnist_experiment.py TrainMnist model=BinaryNet \\
+        export_model_to=/tmp/float_model
+
+    # 2. Convert (optionally per-section mixed for the QuickNet family):
+    python examples/convert_packed.py ConvertPacked model=BinaryNet \\
+        checkpoint=/tmp/float_model output=/tmp/packed_model
+
+The task prints before/after summaries (param counts, deployment MiB)
+and verifies the packed model's forward agrees with the float one on a
+probe batch before writing anything.
+"""
+
+from typing import Optional
+
+import numpy as np
+
+from zookeeper_tpu import ComponentField, Field, cli, task
+from zookeeper_tpu.core import component
+from zookeeper_tpu.models import Model, model_summary
+from zookeeper_tpu.training import Experiment, load_model, save_model
+
+
+@task
+class ConvertPacked(Experiment):
+    """Float checkpoint -> packed deployment checkpoint."""
+
+    model: Model = ComponentField()
+    #: Model-only checkpoint of the trained float model (save_model form).
+    checkpoint: str = Field()
+    #: Where the packed checkpoint is written.
+    output: str = Field()
+    #: Input shape the model was trained at.
+    height: int = Field(28)
+    width: int = Field(28)
+    channels: int = Field(1)
+    num_classes: int = Field(10)
+    #: Kernel quantizer the model trained with (per zoo family).
+    kernel_quantizer: str = Field("ste_sign")
+    #: Max |forward difference| tolerated in verification (binary conv
+    #: sums are integers — 0.0 is achievable and the default for pure
+    #: sign models; allow small slack for scaled kernels).
+    verify_atol: float = Field(0.0)
+    #: Run Pallas kernels interpreted (CPU verification).
+    pallas_interpret: bool = Field(True)
+
+    def run(self) -> Optional[str]:
+        import jax
+        import jax.numpy as jnp
+
+        from zookeeper_tpu.ops.packed import pack_quantconv_params
+
+        input_shape = (self.height, self.width, self.channels)
+
+        module_f = self.model.build(input_shape, self.num_classes)
+        params_f, model_state = self.model.initialize(module_f, input_shape)
+        params_f, model_state = load_model(
+            self.checkpoint, params_f, model_state
+        )
+
+        # Deployment twin: same architecture, packed weights. Uses the
+        # model component's own packed knobs when it has them.
+        for field_name, value in (
+            ("packed_weights", True),
+            ("binary_compute", "xnor"),
+            ("pallas_interpret", self.pallas_interpret),
+        ):
+            if not hasattr(type(self.model), field_name):
+                raise ValueError(
+                    f"{type(self.model).__name__} has no {field_name} "
+                    "field — not a packable model family."
+                )
+        deploy_model = type(self.model)()
+        from zookeeper_tpu.core import configure as _configure
+        from zookeeper_tpu.core import configured_field_names
+
+        # Clone the user's model config (widths, depths, dtype, ...) so
+        # the deployment twin is the SAME architecture, then flip the
+        # packed knobs.
+        conf = {
+            name: getattr(self.model, name)
+            for name in configured_field_names(self.model)
+        }
+        conf.update(
+            {
+                "packed_weights": True,
+                "binary_compute": "xnor",
+                "pallas_interpret": self.pallas_interpret,
+            }
+        )
+        _configure(deploy_model, conf, name="deploy_model")
+        module_p = deploy_model.build(input_shape, self.num_classes)
+        abstract = jax.eval_shape(
+            lambda: module_p.init(
+                jax.random.key(0),
+                jnp.zeros((1, *input_shape)),
+                training=False,
+            )
+        )
+        packed_params = pack_quantconv_params(
+            params_f,
+            kernel_quantizer=self.kernel_quantizer,
+            template=abstract["params"],
+        )
+
+        # Verify on a probe batch BEFORE writing.
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.normal(size=(4, *input_shape)), jnp.float32)
+        y_f = module_f.apply(
+            {"params": params_f, **model_state}, x, training=False
+        )
+        y_p = module_p.apply(
+            {"params": packed_params, **model_state}, x, training=False
+        )
+        max_diff = float(jnp.max(jnp.abs(y_f - y_p)))
+        if max_diff > self.verify_atol:
+            raise RuntimeError(
+                f"Packed model diverges from float model: max |diff| "
+                f"{max_diff} > verify_atol={self.verify_atol}. Wrong "
+                "kernel_quantizer for this family?"
+            )
+
+        save_model(self.output, packed_params, model_state)
+
+        s_f = model_summary(module_f, input_shape)
+        s_p = model_summary(module_p, input_shape)
+        conv_f = sum(
+            r.train_bytes for r in s_f.rows if r.binary and "Conv" in r.path
+        )
+        conv_p = sum(
+            r.train_bytes
+            for r in s_p.rows
+            if "kernel_packed" in r.path or "kernel_scale" in r.path
+        )
+        print(
+            f"converted {self.checkpoint} -> {self.output}\n"
+            f"  whole model: {s_f.train_bytes / 2**20:.2f} MiB -> "
+            f"{sum(r.train_bytes for r in s_p.rows) / 2**20:.2f} MiB\n"
+            f"  binary conv kernels: {conv_f / 2**10:.1f} KiB -> "
+            f"{conv_p / 2**10:.1f} KiB "
+            f"({conv_f / max(conv_p, 1):.1f}x)\n"
+            f"  verified max |forward diff| = {max_diff}"
+        )
+        return self.output
+
+
+if __name__ == "__main__":
+    cli()
