@@ -99,3 +99,66 @@ def test_weight_decay_applies_to_all_optimizers():
         new = optax.apply_updates(params, updates)
         # With zero gradients, weight decay alone must shrink the params.
         assert float(new["w"][0]) < 1.0, cls.__name__
+
+
+def test_remat_policies_match_no_remat_exactly():
+    """Remat changes WHEN activations exist, never WHAT is computed:
+    loss, metrics, and updated params must match the no-remat step
+    bit-for-bit-close for both policies, through BN mutation and the
+    custom_vjp quantizers."""
+    import numpy as np
+    import optax
+
+    from zookeeper_tpu.core import configure
+    from zookeeper_tpu.models import QuickNet
+    from zookeeper_tpu.training import TrainState, make_train_step
+
+    m = QuickNet()
+    configure(
+        m, {"blocks_per_section": (1, 1), "section_features": (16, 32)},
+        name="m",
+    )
+    input_shape = (16, 16, 3)
+    module = m.build(input_shape, num_classes=4)
+    params, model_state = m.initialize(module, input_shape)
+
+    def fresh_state():
+        return TrainState.create(
+            apply_fn=module.apply, params=params, model_state=model_state,
+            tx=optax.sgd(0.1),
+        )
+
+    rng = np.random.default_rng(0)
+    batch = {
+        "input": jnp.asarray(rng.normal(size=(4, *input_shape)), jnp.float32),
+        "target": jnp.asarray(rng.integers(0, 4, 4)),
+    }
+    base_state, base_metrics = jax.jit(make_train_step())(fresh_state(), batch)
+    for policy in ("dots", "full"):
+        st, mt = jax.jit(make_train_step(remat=policy))(fresh_state(), batch)
+        np.testing.assert_allclose(
+            float(mt["loss"]), float(base_metrics["loss"]), rtol=1e-6
+        )
+        for a, b in zip(
+            jax.tree.leaves(base_state.params), jax.tree.leaves(st.params)
+        ):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6,
+                err_msg=f"remat={policy}",
+            )
+        for a, b in zip(
+            jax.tree.leaves(base_state.model_state),
+            jax.tree.leaves(st.model_state),
+        ):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6
+            )
+
+
+def test_remat_unknown_policy_rejected():
+    import pytest
+
+    from zookeeper_tpu.training import make_train_step
+
+    with pytest.raises(ValueError, match="remat"):
+        make_train_step(remat="bogus")

@@ -84,6 +84,9 @@ class TrainingExperiment(Experiment):
     #: export_model_to ships it. Standard for long binary-net recipes:
     #: late sign flips make raw weights oscillate; the average does not.
     ema_decay: float = Field(0.0)
+    #: Rematerialization policy ("none"/"dots"/"full"): trade backward
+    #: recompute for activation HBM (see make_train_step).
+    remat: str = Field("none")
 
     @Field
     def num_classes(self) -> int:
@@ -129,6 +132,7 @@ class TrainingExperiment(Experiment):
                 BINARY_KERNEL_PATTERN if self.track_flip_ratio else None
             ),
             "ema_decay": self.ema_decay if self.ema_decay > 0 else None,
+            "remat": self.remat,
         }
 
     def _train_step_fn(self):
@@ -146,6 +150,11 @@ class TrainingExperiment(Experiment):
                 f"ema_decay={self.ema_decay} is outside [0, 1): 0 disables "
                 "EMA; 1.0 would freeze the average at initialization "
                 "forever (common typo for 0.999)."
+            )
+        if self.remat not in ("none", "dots", "full"):
+            # Pure config: fail before device setup / checkpoint restore.
+            raise ValueError(
+                f"remat={self.remat!r} unknown; choose none/dots/full."
             )
         self._log(pretty_print(self))
         self.runtime.initialize()  # Multi-host bootstrap; no-op single host.

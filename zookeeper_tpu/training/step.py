@@ -53,6 +53,7 @@ def make_train_step(
     flip_ratio_pattern: str = None,
     distill: Tuple[Callable[[jax.Array], jax.Array], float, float] = None,
     ema_decay: float = None,
+    remat: str = "none",
 ) -> Callable[[TrainState, Batch], Tuple[TrainState, Metrics]]:
     """Build the pure train step. Works unjitted (debugging), under
     ``jax.jit``, or under ``pjit``/``shard_map`` — no collectives are
@@ -74,17 +75,51 @@ def make_train_step(
     (1 - alpha) * kd_divergence``; metrics gain ``kd_loss``. The teacher
     runs INSIDE the jitted step, so under pjit its (closed-over) params
     replicate and its forward shards with the batch like the student's.
+
+    ``remat``: rematerialization policy trading recompute FLOPs for HBM
+    (the standard lever when activations, not params, bound the batch
+    size — e.g. 224^2 activations on big batches):
+
+    - ``"none"``: store all activations (default; fastest when it fits).
+    - ``"dots"``: ``jax.checkpoint`` saving only non-batch matmul
+      contractions (the transformer-style sweet spot; note XLA lowers
+      convs separately, so for conv nets this saves little more than
+      "full" — dense/attention-heavy models are where it shines).
+    - ``"full"``: save nothing from the forward; backward replays it
+      (max memory savings, ~1 extra forward of compute).
     """
     flip_paths = None
     if flip_ratio_pattern is not None:
         import re
 
         flip_paths = re.compile(flip_ratio_pattern)
+    if remat not in ("none", "dots", "full"):
+        raise ValueError(
+            f"Unknown remat policy {remat!r}; choose none/dots/full."
+        )
 
     def train_step(state: TrainState, batch: Batch) -> Tuple[TrainState, Metrics]:
         # Per-step RNG derived from the step counter: deterministic,
         # resume-stable, and identical across data-parallel replicas.
         rng = jax.random.fold_in(jax.random.PRNGKey(rng_seed), state.step)
+
+        def apply_model(variables, x, mutable):
+            return state.apply_fn(
+                variables,
+                x,
+                training=True,
+                mutable=mutable,
+                rngs={"dropout": rng},
+            )
+
+        if remat == "dots":
+            apply_model = jax.checkpoint(
+                apply_model,
+                policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+                static_argnums=(2,),
+            )
+        elif remat == "full":
+            apply_model = jax.checkpoint(apply_model, static_argnums=(2,))
 
         def compute_loss(params):
             variables = {"params": params, **state.model_state}
@@ -93,13 +128,7 @@ def make_train_step(
                 if has_aux_state and state.model_state
                 else False
             )
-            out = state.apply_fn(
-                variables,
-                batch["input"],
-                training=True,
-                mutable=mutable,
-                rngs={"dropout": rng},
-            )
+            out = apply_model(variables, batch["input"], tuple(mutable) if mutable else False)
             if mutable:
                 logits, new_model_state = out
             else:
