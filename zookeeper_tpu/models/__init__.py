@@ -24,6 +24,7 @@ from zookeeper_tpu.models.binary import (
     QuickNetLarge,
     QuickNetSmall,
     RealToBinaryNet,
+    ReActNet,
     XNORNet,
 )
 from zookeeper_tpu.models.resnet import ResNet50, ResNet101, ResNet152
@@ -46,6 +47,7 @@ __all__ = [
     "QuickNet",
     "QuickNetLarge",
     "QuickNetSmall",
+    "ReActNet",
     "RealToBinaryNet",
     "ResNet50",
     "ResNet101",

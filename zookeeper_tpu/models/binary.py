@@ -22,7 +22,7 @@ import jax.numpy as jnp
 from zookeeper_tpu.core import Field, component
 from zookeeper_tpu.models.base import Model
 from zookeeper_tpu.ops.layers import QuantConv, QuantDense
-from zookeeper_tpu.ops.quantizers import dorefa
+from zookeeper_tpu.ops.quantizers import dorefa, ste_sign
 
 
 def _bn(training: bool, dtype=jnp.float32):
@@ -843,5 +843,160 @@ class RealToBinaryNet(Model):
             gate_reduction=self.gate_reduction,
             binary_compute=self.binary_compute,
             packed_weights=self.packed_weights,
+            pallas_interpret=self.pallas_interpret,
+        )
+
+
+class RSign(nn.Module):
+    """ReActNet's learnable-threshold sign (Liu et al. 2020): per-channel
+    ``sign(x - alpha_c)``. Built on ``ste_sign``'s custom_vjp, so the STE
+    gradient flows to both x and the learned shift automatically."""
+
+    @nn.compact
+    def __call__(self, x):
+        alpha = self.param(
+            "alpha", nn.initializers.zeros_init(), (x.shape[-1],), jnp.float32
+        )
+        return ste_sign(x - alpha.astype(x.dtype))
+
+
+class RPReLU(nn.Module):
+    """ReActNet's shifted PReLU: ``PReLU(x - gamma_c) + zeta_c`` with
+    per-channel learnable shifts and slope — lets each channel reshape
+    and re-center its activation distribution, which is what makes
+    1-bit activations viable at MobileNet capacities."""
+
+    @nn.compact
+    def __call__(self, x):
+        c = x.shape[-1]
+        gamma = self.param("gamma", nn.initializers.zeros_init(), (c,), jnp.float32)
+        zeta = self.param("zeta", nn.initializers.zeros_init(), (c,), jnp.float32)
+        beta = self.param(
+            "beta", nn.initializers.constant(0.25), (c,), jnp.float32
+        )
+        d = x.dtype
+        y = x - gamma.astype(d)
+        y = jnp.where(y > 0, y, beta.astype(d) * y)
+        return y + zeta.astype(d)
+
+
+class _ReActBlock(nn.Module):
+    """One ReActNet-A unit: RSign -> binary 3x3 conv (stride s) -> BN ->
+    + shortcut -> RPReLU, then RSign -> binary 1x1 conv -> BN ->
+    + shortcut -> RPReLU. Channel doubling duplicates the 1x1 stage into
+    two parallel branches whose outputs concatenate (each with its own
+    shortcut), keeping the skip path real-valued throughout."""
+
+    features: int
+    strides: int
+    dtype: Any
+    binary_compute: str = "mxu"
+    pallas_interpret: bool = False
+
+    def _qconv(self, feat, k, strides=1):
+        # RSign (learnable shift) binarizes OUTSIDE the conv; the inner
+        # ste_sign is a forward identity on its +-1 output (and its STE
+        # backward is pass-through at +-1), kept so the binary compute
+        # paths validate and run.
+        return QuantConv(
+            feat, (k, k), strides=(strides, strides),
+            input_quantizer="ste_sign",
+            kernel_quantizer="ste_sign", dtype=self.dtype,
+            binary_compute=self.binary_compute,
+            pallas_interpret=self.pallas_interpret,
+        )
+
+    @nn.compact
+    def __call__(self, x, training: bool = False):
+        cin = x.shape[-1]
+        # 3x3 stage.
+        shortcut = x
+        if self.strides > 1:
+            shortcut = nn.avg_pool(
+                x, (2, 2), strides=(self.strides, self.strides), padding="SAME"
+            )
+        y = RSign()(x)
+        y = self._qconv(cin, 3, self.strides)(y)
+        y = _bn(training, self.dtype)(y)
+        x = RPReLU()(y + shortcut)
+        # 1x1 stage (doubling -> two branches + concat).
+        if self.features == cin:
+            y = RSign()(x)
+            y = self._qconv(cin, 1)(y)
+            y = _bn(training, self.dtype)(y)
+            x = RPReLU()(y + x)
+        elif self.features == 2 * cin:
+            outs = []
+            for _ in range(2):
+                y = RSign()(x)
+                y = self._qconv(cin, 1)(y)
+                y = _bn(training, self.dtype)(y)
+                outs.append(y + x)
+            x = RPReLU()(jnp.concatenate(outs, axis=-1))
+        else:
+            raise ValueError(
+                f"ReActNet block widens {cin} -> {self.features}; only "
+                "same-width or exact doubling is defined."
+            )
+        return x
+
+
+class _ReActNetModule(nn.Module):
+    """ReActNet-A: MobileNetV1 topology, every conv binarized, RSign/
+    RPReLU activation reshaping. Reconstruction from the paper; the
+    published 69.4% top-1 uses its two-stage KD recipe
+    (DistillationExperiment covers that training pattern)."""
+
+    features: Tuple[int, ...]
+    strides: Tuple[int, ...]
+    num_classes: int
+    dtype: Any
+    binary_compute: str = "mxu"
+    pallas_interpret: bool = False
+
+    @nn.compact
+    def __call__(self, x, training: bool = False):
+        d = self.dtype
+        x = nn.Conv(self.features[0], (3, 3), strides=(2, 2), padding="SAME",
+                    use_bias=False, dtype=d)(x.astype(d))
+        x = _bn(training, d)(x)
+        for feat, s in zip(self.features[1:], self.strides):
+            x = _ReActBlock(
+                feat, s, d, self.binary_compute, self.pallas_interpret
+            )(x, training)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=d)(x)
+        return x.astype(jnp.float32)
+
+
+@component
+class ReActNet(Model):
+    """ReActNet-A (~69.4% top-1 target with the paper's KD recipe —
+    beyond the larq-zoo families; demonstrates the stack extends to
+    current-generation BNNs)."""
+
+    features: Sequence[int] = Field(
+        (32, 64, 128, 128, 256, 256, 512, 512, 512, 512, 512, 512, 1024,
+         1024)
+    )
+    #: Stride of each block's 3x3 stage (len == len(features) - 1).
+    strides: Sequence[int] = Field(
+        (1, 2, 1, 2, 1, 2, 1, 1, 1, 1, 1, 2, 1)
+    )
+    binary_compute: str = Field("mxu")
+    pallas_interpret: bool = Field(False)
+
+    def build(self, input_shape, num_classes: int) -> nn.Module:
+        if len(self.strides) != len(self.features) - 1:
+            raise ValueError(
+                f"strides has {len(self.strides)} entries; expected "
+                f"{len(self.features) - 1} (one per block)."
+            )
+        return _ReActNetModule(
+            features=tuple(self.features),
+            strides=tuple(self.strides),
+            num_classes=num_classes,
+            dtype=self.dtype(),
+            binary_compute=self.binary_compute,
             pallas_interpret=self.pallas_interpret,
         )

@@ -103,7 +103,14 @@ def make_train_step(
         # resume-stable, and identical across data-parallel replicas.
         rng = jax.random.fold_in(jax.random.PRNGKey(rng_seed), state.step)
 
-        def apply_model(variables, x, mutable):
+        # Static across the step: which collections (batch_stats) mutate.
+        mutable = (
+            tuple(state.model_state.keys())
+            if has_aux_state and state.model_state
+            else False
+        )
+
+        def apply_model(variables, x):
             return state.apply_fn(
                 variables,
                 x,
@@ -116,19 +123,13 @@ def make_train_step(
             apply_model = jax.checkpoint(
                 apply_model,
                 policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
-                static_argnums=(2,),
             )
         elif remat == "full":
-            apply_model = jax.checkpoint(apply_model, static_argnums=(2,))
+            apply_model = jax.checkpoint(apply_model)
 
         def compute_loss(params):
             variables = {"params": params, **state.model_state}
-            mutable = (
-                list(state.model_state.keys())
-                if has_aux_state and state.model_state
-                else False
-            )
-            out = apply_model(variables, batch["input"], tuple(mutable) if mutable else False)
+            out = apply_model(variables, batch["input"])
             if mutable:
                 logits, new_model_state = out
             else:
