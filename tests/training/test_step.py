@@ -162,3 +162,24 @@ def test_remat_unknown_policy_rejected():
 
     with pytest.raises(ValueError, match="remat"):
         make_train_step(remat="bogus")
+
+
+def test_polynomial_and_linear_warmup_schedules():
+    import numpy as np
+
+    from zookeeper_tpu.core import configure
+    from zookeeper_tpu.training import LinearWarmup, PolynomialDecay
+
+    s = PolynomialDecay()
+    configure(s, {"base_lr": 1.0, "end_lr": 0.0, "power": 1.0}, name="s")
+    sched = s.build(total_steps=10)
+    np.testing.assert_allclose(float(sched(0)), 1.0)
+    np.testing.assert_allclose(float(sched(5)), 0.5)
+    np.testing.assert_allclose(float(sched(10)), 0.0)
+
+    w = LinearWarmup()
+    configure(w, {"base_lr": 2.0, "warmup_steps": 4}, name="w")
+    sched = w.build(total_steps=20)
+    np.testing.assert_allclose(float(sched(0)), 0.0)
+    np.testing.assert_allclose(float(sched(2)), 1.0)
+    assert float(sched(4)) == 2.0 and float(sched(19)) == 2.0

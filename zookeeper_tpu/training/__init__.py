@@ -38,6 +38,8 @@ from zookeeper_tpu.training.optimizer import (
 from zookeeper_tpu.training.schedule import (
     ConstantSchedule,
     CosineDecay,
+    LinearWarmup,
+    PolynomialDecay,
     Schedule,
     StepDecay,
     WarmupCosine,
@@ -64,8 +66,10 @@ __all__ = [
     "JsonlMetricsWriter",
     "MetricsWriter",
     "TensorBoardMetricsWriter",
+    "LinearWarmup",
     "Momentum",
     "Optimizer",
+    "PolynomialDecay",
     "Rmsprop",
     "Schedule",
     "Sgd",

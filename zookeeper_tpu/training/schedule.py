@@ -71,3 +71,40 @@ class StepDecay(Schedule):
             step = max(1, int(b * total_steps))
             boundaries[step] = boundaries.get(step, 1.0) * self.factor
         return optax.piecewise_constant_schedule(self.base_lr, boundaries)
+
+
+@component
+class PolynomialDecay(Schedule):
+    """Polynomial decay from base_lr to end_lr over training (power=1 is
+    the classic linear decay)."""
+
+    end_lr: float = Field(0.0)
+    power: float = Field(1.0)
+
+    def build(self, total_steps: int) -> Callable:
+        return optax.polynomial_schedule(
+            init_value=self.base_lr,
+            end_value=self.end_lr,
+            power=self.power,
+            transition_steps=max(1, total_steps),
+        )
+
+
+@component
+class LinearWarmup(Schedule):
+    """Linear 0 -> base_lr warmup, then constant — the common large-batch
+    DP ramp (pairs with accumulate_steps / LAMB)."""
+
+    warmup_steps: int = Field(0)
+    warmup_fraction: float = Field(0.05)  # Used when warmup_steps == 0.
+
+    def build(self, total_steps: int) -> Callable:
+        warmup = self.warmup_steps or int(total_steps * self.warmup_fraction)
+        warmup = max(1, min(warmup, total_steps))
+        return optax.join_schedules(
+            [
+                optax.linear_schedule(0.0, self.base_lr, warmup),
+                optax.constant_schedule(self.base_lr),
+            ],
+            boundaries=[warmup],
+        )
