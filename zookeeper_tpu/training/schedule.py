@@ -101,10 +101,6 @@ class LinearWarmup(Schedule):
     def build(self, total_steps: int) -> Callable:
         warmup = self.warmup_steps or int(total_steps * self.warmup_fraction)
         warmup = max(1, min(warmup, total_steps))
-        return optax.join_schedules(
-            [
-                optax.linear_schedule(0.0, self.base_lr, warmup),
-                optax.constant_schedule(self.base_lr),
-            ],
-            boundaries=[warmup],
+        return optax.warmup_constant_schedule(
+            init_value=0.0, peak_value=self.base_lr, warmup_steps=warmup
         )
