@@ -176,15 +176,25 @@ def save_model(path: str, params: Any, model_state: Any) -> None:
 
 def load_model(path: str, params_like: Any, model_state_like: Any):
     """Restore a ``save_model`` checkpoint. ``*_like`` provide the target
-    structure/shardings (shape-dtype structs suffice); returns
-    ``(params, model_state)``."""
+    structure/shardings (shape-dtype structs suffice; structs without
+    sharding — e.g. from ``jax.eval_shape`` — restore onto the default
+    device); returns ``(params, model_state)``."""
     import jax
     import orbax.checkpoint as ocp
 
+    default_sharding = jax.sharding.SingleDeviceSharding(jax.devices()[0])
+
+    def to_struct(leaf):
+        struct = ocp.utils.to_shape_dtype_struct(leaf)
+        if getattr(struct, "sharding", None) is None:
+            struct = jax.ShapeDtypeStruct(
+                struct.shape, struct.dtype, sharding=default_sharding
+            )
+        return struct
+
     path = os.path.abspath(os.path.expanduser(path))
     target = jax.tree.map(
-        ocp.utils.to_shape_dtype_struct,
-        {"params": params_like, "model_state": model_state_like},
+        to_struct, {"params": params_like, "model_state": model_state_like}
     )
     with ocp.StandardCheckpointer() as ckptr:
         restored = ckptr.restore(path, target)
