@@ -104,7 +104,7 @@ def main():
 
     # The tunnel adds ~100ms fixed sync latency per readback; measure
     # marginal step time with two chain lengths and subtract. Each chain
-    # length takes its min over 3 rounds INDEPENDENTLY (min over additive
+    # length takes its min over 5 rounds INDEPENDENTLY (min over additive
     # non-negative noise is sound), then the marginal is taken once —
     # min over per-round *differences* would be biased fast whenever a
     # jitter spike landed on a short chain.

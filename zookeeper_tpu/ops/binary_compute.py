@@ -505,11 +505,18 @@ def _packed_conv_forward(
 
 
 def _float_conv(x, k, strides, padding, groups=1):
-    # Mixed precision: activations may be bf16 while latent kernels are
-    # fp32; compute the gradient conv in the wider dtype.
-    dtype = jnp.promote_types(x.dtype, k.dtype)
+    # Gradient convs follow the model's COMPUTE dtype (x's dtype): the
+    # quantized kernel arrives fp32 (latent storage) even in bf16 mixed
+    # precision, and promoting the backward to fp32 would run the
+    # dgrad/wgrad convs at 1/8th MXU peak — measured 2.9x forward cost
+    # instead of the expected ~2x (BASELINE.md round-3 decomposition).
+    # The +-1 signs are exact in bf16 (per-channel scales round like any
+    # mixed-precision weight); the MXU accumulates in fp32 either way, so
+    # this is standard bf16 mixed-precision backward, and fp32 models are
+    # untouched (x is fp32 there).
+    dtype = x.dtype
     return jax.lax.conv_general_dilated(
-        x.astype(dtype), k.astype(dtype), window_strides=tuple(strides),
+        x, k.astype(dtype), window_strides=tuple(strides),
         padding=padding, dimension_numbers=("NHWC", "HWIO", "NHWC"),
         feature_group_count=groups,
     )
@@ -572,7 +579,7 @@ def _xnor_conv_bwd(strides, padding, use_popcount, interpret, res, g):
         lambda xx, kk: _reference_conv(xx, kk, strides, padding, use_popcount),
         x, q_kernel,
     )
-    dx, dk = vjp(g.astype(jnp.promote_types(x.dtype, q_kernel.dtype)))
+    dx, dk = vjp(g.astype(x.dtype))
     return dx.astype(x.dtype), dk.astype(q_kernel.dtype)
 
 
@@ -704,7 +711,7 @@ def _int8_conv_bwd(strides, padding, groups, scaled, res, g):
         lambda x, k: _float_conv(x, k, strides, padding, groups),
         x_sign, k_sign,
     )
-    dx, dk = vjp(g.astype(jnp.promote_types(x_sign.dtype, k_sign.dtype)))
+    dx, dk = vjp(g.astype(x_sign.dtype))
     return dx.astype(x_sign.dtype), dk.astype(k_sign.dtype)
 
 

@@ -182,7 +182,11 @@ def load_model(path: str, params_like: Any, model_state_like: Any):
     import jax
     import orbax.checkpoint as ocp
 
-    default_sharding = jax.sharding.SingleDeviceSharding(jax.devices()[0])
+    # local_devices: on non-zero processes of a multi-process run,
+    # jax.devices()[0] is process 0's device and not addressable here.
+    default_sharding = jax.sharding.SingleDeviceSharding(
+        jax.local_devices()[0]
+    )
 
     def to_struct(leaf):
         struct = ocp.utils.to_shape_dtype_struct(leaf)
