@@ -19,7 +19,11 @@ from zookeeper_tpu.data import (
     SyntheticMnist,
 )
 from zookeeper_tpu.models import BinaryNet, Model, SimpleCnn
-from zookeeper_tpu.training import DistillationExperiment, TrainingExperiment
+from zookeeper_tpu.training import (
+    DistillationExperiment,
+    EvalExperiment,
+    TrainingExperiment,
+)
 
 MnistPreprocessing = PartialComponent(
     ImageClassificationPreprocessing, height=28, width=28, channels=1
@@ -57,6 +61,22 @@ class DistillMnist(DistillationExperiment):
     teacher: Model = ComponentField(SimpleCnn)
     epochs: int = Field(2)
     batch_size: int = Field(64)
+
+
+@task
+class EvaluateMnist(EvalExperiment):
+    """Score an exported checkpoint (``TrainMnist export_model_to=...``)::
+
+        python examples/mnist_experiment.py EvaluateMnist \\
+            checkpoint=/tmp/model model=SimpleCnn
+    """
+
+    loader: DataLoader = ComponentField(
+        DataLoader,
+        dataset=SyntheticMnist,
+        preprocessing=MnistPreprocessing,
+    )
+    model: Model = ComponentField(SimpleCnn)
 
 
 if __name__ == "__main__":

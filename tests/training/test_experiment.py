@@ -253,3 +253,59 @@ def test_ema_decay_out_of_range_rejected(tmp_path):
     configure(exp, _ema_toggle_conf(tmp_path, 1.0), name="experiment")
     with pytest.raises(ValueError, match="ema_decay"):
         exp.run()
+
+
+def test_eval_experiment_scores_exported_model(tmp_path):
+    """Train -> export -> EvalExperiment reproduces the final validation
+    accuracy from the exported model-only checkpoint."""
+    import numpy as np
+
+    from zookeeper_tpu.core import configure as _cfg
+    from zookeeper_tpu.training import EvalExperiment, TrainingExperiment
+
+    export = str(tmp_path / "model")
+    exp = TrainingExperiment()
+    _cfg(
+        exp,
+        {
+            "loader.dataset": "SklearnDigits",
+            "loader.preprocessing": "ImageClassificationPreprocessing",
+            "loader.preprocessing.height": 8,
+            "loader.preprocessing.width": 8,
+            "loader.preprocessing.channels": 1,
+            "loader.host_index": 0,
+            "loader.host_count": 1,
+            "model": "Mlp",
+            "model.hidden_units": (32,),
+            "batch_size": 64,
+            "epochs": 2,
+            "verbose": False,
+            "export_model_to": export,
+        },
+        name="experiment",
+    )
+    history = exp.run()
+    trained_acc = history["validation"][-1]["accuracy"]
+
+    ev = EvalExperiment()
+    _cfg(
+        ev,
+        {
+            "loader.dataset": "SklearnDigits",
+            "loader.preprocessing": "ImageClassificationPreprocessing",
+            "loader.preprocessing.height": 8,
+            "loader.preprocessing.width": 8,
+            "loader.preprocessing.channels": 1,
+            "loader.host_index": 0,
+            "loader.host_count": 1,
+            "model": "Mlp",
+            "model.hidden_units": (32,),
+            "batch_size": 64,
+            "verbose": False,
+            "checkpoint": export,
+        },
+        name="eval",
+    )
+    metrics = ev.run()
+    assert metrics["accuracy"] == pytest.approx(trained_acc, abs=1e-6)
+    assert np.isfinite(metrics["loss"])

@@ -15,7 +15,11 @@ from zookeeper_tpu.training.checkpoint import (
     save_model,
 )
 from zookeeper_tpu.training.distill import DistillationExperiment
-from zookeeper_tpu.training.experiment import Experiment, TrainingExperiment
+from zookeeper_tpu.training.experiment import (
+    EvalExperiment,
+    Experiment,
+    TrainingExperiment,
+)
 from zookeeper_tpu.training.metrics import (
     CompositeMetricsWriter,
     JsonlMetricsWriter,
@@ -60,6 +64,7 @@ __all__ = [
     "ConstantSchedule",
     "CosineDecay",
     "DistillationExperiment",
+    "EvalExperiment",
     "Experiment",
     "load_model",
     "save_model",

@@ -203,3 +203,16 @@ def load_model(path: str, params_like: Any, model_state_like: Any):
     with ocp.StandardCheckpointer() as ckptr:
         restored = ckptr.restore(path, target)
     return restored["params"], restored["model_state"]
+
+
+def load_exported_model(path: str, model: Any, module: Any, input_shape,
+                        seed: int = 0):
+    """Restore a ``save_model`` checkpoint into a freshly built model via
+    abstract init (zero parameter allocation): the shared restore flow
+    for eval / teacher / deployment consumers."""
+    import jax
+
+    abstract = jax.eval_shape(
+        lambda: model.initialize(module, input_shape, seed=seed)
+    )
+    return load_model(path, abstract[0], abstract[1])

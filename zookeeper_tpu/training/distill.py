@@ -67,24 +67,15 @@ class DistillationExperiment(TrainingExperiment):
         return super().run()
 
     def _teacher_fn(self):
-        from zookeeper_tpu.training.checkpoint import load_model
+        from zookeeper_tpu.training.checkpoint import load_exported_model
 
         self._validate_teacher_config()
-        import jax
-
         input_shape = self.loader.preprocessing.input_shape
         module = self.teacher.build(input_shape, self.num_classes)
         if self.teacher_checkpoint is not None:
-            # Only the STRUCTURE is needed to restore: abstract init
-            # (zero allocation/compute, matters at ResNet50 teacher
-            # scale), then load the real weights.
-            abstract = jax.eval_shape(
-                lambda: self.teacher.initialize(
-                    module, input_shape, seed=self.seed
-                )
-            )
-            params, model_state = load_model(
-                self.teacher_checkpoint, abstract[0], abstract[1]
+            params, model_state = load_exported_model(
+                self.teacher_checkpoint, self.teacher, module, input_shape,
+                seed=self.seed,
             )
         else:
             params, model_state = self.teacher.initialize(

@@ -37,6 +37,31 @@ class Experiment:
         raise NotImplementedError("Experiment subclasses must implement run().")
 
 
+def run_weighted_eval(loader, split, eval_step, state, sharding, epoch=0):
+    """Shared eval loop: accumulate per-batch metric MEANS weighted by
+    batch example count, ON DEVICE (one multiply-add per batch, a single
+    device_get at the end), so a partial final batch does not skew the
+    reported score. Returns {} when the split yields no batches."""
+    import jax
+    import jax.numpy as jnp
+
+    accum = None
+    examples = 0
+    for batch in loader.batches(split, epoch=epoch, sharding=sharding):
+        n = int(batch["target"].shape[0])
+        m = eval_step(state, batch)
+        weighted = jax.tree.map(lambda v: v * n, m)
+        accum = (
+            weighted
+            if accum is None
+            else jax.tree.map(jnp.add, accum, weighted)
+        )
+        examples += n
+    if not examples:
+        return {}
+    return {k: float(v) / examples for k, v in jax.device_get(accum).items()}
+
+
 @component
 class TrainingExperiment(Experiment):
     """Supervised-classification training loop.
@@ -227,30 +252,9 @@ class TrainingExperiment(Experiment):
                 )
 
                 if self.validate and self.loader.dataset.validation() is not None:
-                    # Accumulate eval metrics ON DEVICE (one tiny add per
-                    # batch) and sync one scalar dict at the end — no
-                    # per-batch Python list of device buffers to hold alive,
-                    # and the single device_get moves O(metrics) bytes
-                    # regardless of eval length.
-                    vaccum = None
-                    vcount = 0
-                    for batch in self.loader.batches(
-                        "validation", epoch=epoch, sharding=batch_sharding
-                    ):
-                        m = eval_step(state, batch)
-                        vaccum = (
-                            m
-                            if vaccum is None
-                            else jax.tree.map(jnp.add, vaccum, m)
-                        )
-                        vcount += 1
-                    vmetrics = (
-                        {
-                            k: float(v) / vcount
-                            for k, v in jax.device_get(vaccum).items()
-                        }
-                        if vcount
-                        else {}
+                    vmetrics = run_weighted_eval(
+                        self.loader, "validation", eval_step, state,
+                        batch_sharding, epoch=epoch,
                     )
                     history["validation"].append(vmetrics)
                     line += (
@@ -303,3 +307,67 @@ class TrainingExperiment(Experiment):
             save_model(self.export_model_to, export_params, state.model_state)
         self.final_state = state
         return history
+
+
+@component
+class EvalExperiment(Experiment):
+    """Evaluate an exported model checkpoint on a dataset split — the
+    standard load-and-score workflow pairing with ``export_model_to``
+    (and with ``ConvertPacked`` output when the model component is built
+    with ``packed_weights=True``)."""
+
+    loader: DataLoader = ComponentField(DataLoader)
+    model: Model = ComponentField()
+    partitioner: Partitioner = ComponentField(SingleDevicePartitioner)
+    runtime: DistributedRuntime = ComponentField(DistributedRuntime)
+
+    #: Model-only checkpoint (save_model format).
+    checkpoint: str = Field()
+    split: str = Field("validation")
+    batch_size: int = Field(32)
+    seed: int = Field(0)
+    verbose: bool = Field(True)
+
+    @Field
+    def num_classes(self) -> int:
+        return int(self.loader.dataset.resolved_num_classes())
+
+    def run(self) -> Dict[str, float]:
+        from zookeeper_tpu.training.checkpoint import load_exported_model
+
+        if self.verbose:
+            print(pretty_print(self), flush=True)
+        self.runtime.initialize()
+        partitioner = self.partitioner
+        partitioner.setup()
+
+        input_shape = self.loader.preprocessing.input_shape
+        module = self.model.build(input_shape, self.num_classes)
+        params, model_state = load_exported_model(
+            self.checkpoint, self.model, module, input_shape, seed=self.seed
+        )
+        state = TrainState.create(
+            apply_fn=module.apply,
+            params=params,
+            model_state=model_state,
+            tx=_eval_noop_tx(),
+        )
+        state = partitioner.shard_state(state)
+        eval_step = partitioner.compile_eval(make_eval_step(), state)
+        metrics = run_weighted_eval(
+            self.loader, self.split, eval_step, state,
+            partitioner.batch_sharding(),
+        )
+        if not metrics:
+            raise ValueError(f"Split {self.split!r} produced no batches.")
+        if self.verbose:
+            line = " ".join(f"{k}={v:.4f}" for k, v in sorted(metrics.items()))
+            print(f"eval[{self.split}] {line}", flush=True)
+        return metrics
+
+
+def _eval_noop_tx():
+    """A do-nothing optax transformation (EvalExperiment never updates)."""
+    import optax
+
+    return optax.identity()
