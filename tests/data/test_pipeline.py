@@ -281,3 +281,35 @@ def test_native_fast_path_skipped_when_augmenting():
     configure(pre, {"augment": True}, name="pre")
     assert pre.native_batch_spec(training=True) is None
     assert pre.native_batch_spec(training=False) is not None
+
+
+def test_preprocessing_resize_nearest():
+    import numpy as np
+
+    from zookeeper_tpu.core import configure
+    from zookeeper_tpu.data import ImageClassificationPreprocessing
+
+    p = ImageClassificationPreprocessing()
+    configure(
+        p,
+        {"height": 16, "width": 16, "channels": 1, "resize": True,
+         "zero_center": False},
+        name="p",
+    )
+    src = np.arange(64, dtype=np.uint8).reshape(8, 8)
+    out = p.input({"image": src}, training=False)
+    assert out.shape == (16, 16, 1)
+    # Exact 2x upsample: each source pixel appears as a 2x2 block.
+    expected = np.repeat(np.repeat(src, 2, axis=0), 2, axis=1) / 255.0
+    np.testing.assert_allclose(out[..., 0], expected, rtol=1e-6)
+
+    # Downsample path too (16 -> 8 picks every other pixel).
+    p2 = ImageClassificationPreprocessing()
+    configure(
+        p2,
+        {"height": 4, "width": 4, "channels": 1, "resize": True,
+         "zero_center": False},
+        name="p2",
+    )
+    out2 = p2.input({"image": src}, training=False)
+    np.testing.assert_allclose(out2[..., 0], src[::2, ::2] / 255.0, rtol=1e-6)

@@ -298,6 +298,10 @@ def test_eval_experiment_scores_exported_model(tmp_path):
             "loader.preprocessing.channels": 1,
             "loader.host_index": 0,
             "loader.host_count": 1,
+            # Mirror the training loop's validation batching exactly
+            # (drop_remainder) so the scores must agree to the bit; the
+            # full-coverage default is pinned by the test below.
+            "loader.drop_remainder": True,
             "model": "Mlp",
             "model.hidden_units": (32,),
             "batch_size": 64,
@@ -309,3 +313,54 @@ def test_eval_experiment_scores_exported_model(tmp_path):
     metrics = ev.run()
     assert metrics["accuracy"] == pytest.approx(trained_acc, abs=1e-6)
     assert np.isfinite(metrics["loss"])
+
+
+def test_eval_experiment_full_coverage_and_train_split(tmp_path):
+    """EvalExperiment scores EVERY example (partial tail batch included)
+    and can score the train split in eval mode; unknown splits raise."""
+    import numpy as np
+
+    from zookeeper_tpu.core import configure as _cfg
+    from zookeeper_tpu.training import EvalExperiment, TrainingExperiment
+
+    export = str(tmp_path / "model")
+    conf = {
+        "loader.dataset": "SklearnDigits",
+        "loader.preprocessing": "ImageClassificationPreprocessing",
+        "loader.preprocessing.height": 8,
+        "loader.preprocessing.width": 8,
+        "loader.preprocessing.channels": 1,
+        "loader.host_index": 0,
+        "loader.host_count": 1,
+        "model": "Mlp",
+        "model.hidden_units": (32,),
+        "batch_size": 64,
+        "verbose": False,
+    }
+    exp = TrainingExperiment()
+    _cfg(exp, {**conf, "epochs": 1, "export_model_to": export}, name="e")
+    exp.run()
+
+    # 359 validation examples, batch 64: 5 full + 1 partial batch. The
+    # eval must consume all 359 (drop_remainder=False default).
+    ev = EvalExperiment()
+    _cfg(ev, {**conf, "checkpoint": export}, name="ev")
+    seen = 0
+    for batch in ev.loader.batches("validation", training=False):
+        seen += batch["target"].shape[0]
+    assert seen == ev.loader.dataset.num_examples("validation")
+    metrics = ev.run()
+    assert np.isfinite(metrics["loss"])
+
+    # Train split in eval mode works and is deterministic.
+    ev_train = EvalExperiment()
+    _cfg(ev_train, {**conf, "checkpoint": export, "split": "train"}, name="evt")
+    m1 = ev_train.run()
+    assert np.isfinite(m1["accuracy"])
+
+    ev_bad = EvalExperiment()
+    _cfg(ev_bad, {**conf, "checkpoint": export, "split": "test"}, name="evb")
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError, match="split"):
+        ev_bad.run()

@@ -102,3 +102,29 @@ def test_digits_split_is_deterministic_and_disjoint():
         np.asarray([train[i]["label"] for i in range(len(train))]),
         np.asarray([ds2.train()[i]["label"] for i in range(len(train))]),
     )
+
+
+@pytest.mark.slow
+def test_quicknet_flagship_learns_real_digits():
+    """The flagship family (QuickNet: residual binary convs, blurpool
+    transitions, synced BN) reaches >=85% validation accuracy on real
+    digits through the resize path — the full north-star training stack
+    learns on actual data."""
+    exp = TrainingExperiment()
+    configure(
+        exp,
+        _digits_conf({
+            "loader.preprocessing.height": 32,
+            "loader.preprocessing.width": 32,
+            "loader.preprocessing.resize": True,
+            "model": "QuickNet",
+            "model.blocks_per_section": (1, 1),
+            "model.section_features": (16, 32),
+            "epochs": 8,
+            "optimizer.schedule.base_lr": 3e-3,
+        }),
+        name="experiment",
+    )
+    history = exp.run()
+    best = max(v["accuracy"] for v in history["validation"])
+    assert best >= 0.85, f"best val accuracy {best:.3f} < 0.85"

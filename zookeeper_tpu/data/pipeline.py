@@ -281,8 +281,14 @@ class DataLoader:
         *,
         epoch: int = 0,
         sharding: Optional[Any] = None,
+        training: Optional[bool] = None,
     ) -> Iterator[Any]:
-        training = split == "train"
+        """``training=None`` infers train-mode behavior (shuffle, augment,
+        drop-remainder) from the split name; pass ``training=False`` to
+        iterate the train split in eval mode (e.g. scoring a checkpoint
+        on training data: deterministic order, no augmentation)."""
+        if training is None:
+            training = split == "train"
         source = self._source(split)
         if source is None:
             raise ValueError(f"Dataset has no '{split}' split.")

@@ -88,6 +88,11 @@ class ImageClassificationPreprocessing(Preprocessing):
     augment: bool = Field(False)
     pad_pixels: int = Field(4)
     random_flip: bool = Field(True)
+    #: Nearest-neighbor resize mismatched sources to (height, width)
+    #: instead of center crop/pad — e.g. feeding low-res corpora into
+    #: ImageNet-shaped stems. Python-path only; the native fused batch
+    #: kernel already requires shape-matched sources.
+    resize: bool = Field(False)
 
     @property
     def input_shape(self) -> Tuple[int, ...]:
@@ -112,6 +117,8 @@ class ImageClassificationPreprocessing(Preprocessing):
             image = image.astype(np.float32)
         if image.ndim == 2:
             image = image[..., None]
+        if self.resize and image.shape[:2] != (self.height, self.width):
+            image = _resize_nearest(image, self.height, self.width)
         if training and self.augment:
             # Seed from (example index, epoch): deterministic/resumable AND
             # varying per epoch — the same crop every epoch would silently
@@ -165,3 +172,13 @@ def _center_crop_or_pad(image: np.ndarray, height: int, width: int) -> np.ndarra
             mode="constant",
         )
     return image
+
+
+def _resize_nearest(image: np.ndarray, height: int, width: int) -> np.ndarray:
+    """Nearest-neighbor resize via integer index gather (pure numpy: no
+    image-library dependency, deterministic, exact for integer scale
+    factors)."""
+    h, w = image.shape[:2]
+    ys = (np.arange(height) * h) // height
+    xs = (np.arange(width) * w) // width
+    return image[ys][:, xs]

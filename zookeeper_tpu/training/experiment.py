@@ -47,7 +47,9 @@ def run_weighted_eval(loader, split, eval_step, state, sharding, epoch=0):
 
     accum = None
     examples = 0
-    for batch in loader.batches(split, epoch=epoch, sharding=sharding):
+    for batch in loader.batches(
+        split, epoch=epoch, sharding=sharding, training=False
+    ):
         n = int(batch["target"].shape[0])
         m = eval_step(state, batch)
         weighted = jax.tree.map(lambda v: v * n, m)
@@ -314,9 +316,15 @@ class EvalExperiment(Experiment):
     """Evaluate an exported model checkpoint on a dataset split — the
     standard load-and-score workflow pairing with ``export_model_to``
     (and with ``ConvertPacked`` output when the model component is built
-    with ``packed_weights=True``)."""
+    with ``packed_weights=True``).
 
-    loader: DataLoader = ComponentField(DataLoader)
+    The loader defaults to ``drop_remainder=False`` so the headline score
+    covers EVERY example of the split (weighted partial final batch);
+    multi-host eval should set ``loader.drop_remainder=True`` to keep
+    collectives in lockstep. ``split="train"`` iterates the training data
+    in eval mode (no shuffle/augmentation)."""
+
+    loader: DataLoader = ComponentField(DataLoader, drop_remainder=False)
     model: Model = ComponentField()
     partitioner: Partitioner = ComponentField(SingleDevicePartitioner)
     runtime: DistributedRuntime = ComponentField(DistributedRuntime)
@@ -335,6 +343,14 @@ class EvalExperiment(Experiment):
     def run(self) -> Dict[str, float]:
         from zookeeper_tpu.training.checkpoint import load_exported_model
 
+        if self.split not in ("train", "validation"):
+            # The loader maps any non-"train" name to the validation
+            # split; scoring "test" against validation data silently
+            # would misreport.
+            raise ValueError(
+                f"split={self.split!r} unknown; datasets here expose "
+                "'train' and 'validation'."
+            )
         if self.verbose:
             print(pretty_print(self), flush=True)
         self.runtime.initialize()
