@@ -1,0 +1,81 @@
+"""The example scripts ARE the reference's canonical capability demo
+(SURVEY §2.3): pin that each drives end-to-end from its CLI, in a real
+subprocess (fresh interpreter, arg parsing, task registry, exit code)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(__file__)))
+
+
+def run_example(script, *args, timeout=240):
+    env = dict(os.environ)
+    # Strip the TPU-relay activation vars: this machine's sitecustomize
+    # would otherwise call jax.config.update("jax_platforms", ...) at
+    # import, which BEATS the JAX_PLATFORMS env var below and would point
+    # these "CPU smoke" subprocesses at the real chip (same guard as the
+    # in-child config reset in tests/parallel/multiproc_worker.py).
+    for key in [k for k in env if k.startswith("PALLAS_AXON")]:
+        env.pop(key)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": REPO,
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+    })
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "examples", script), *args],
+        capture_output=True, text=True, timeout=timeout, env=env,
+        cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    return proc.stdout
+
+
+def test_mnist_train_export_eval_convert(tmp_path):
+    export = str(tmp_path / "model")
+    packed = str(tmp_path / "packed")
+    out = run_example(
+        "mnist_experiment.py", "TrainMnist",
+        "model=BinaryNet", "model.features=(8,8)", "model.dense_units=(16,)",
+        "epochs=1", "steps_per_epoch=2", "batch_size=16",
+        "loader.dataset.num_train_examples=32",
+        "loader.dataset.num_validation_examples=16",
+        f"export_model_to='{export}'",
+    )
+    assert "epoch 1/1" in out
+
+    out = run_example(
+        "mnist_experiment.py", "EvaluateMnist",
+        "model=BinaryNet", "model.features=(8,8)", "model.dense_units=(16,)",
+        "batch_size=16",
+        "loader.dataset.num_train_examples=32",
+        "loader.dataset.num_validation_examples=16",
+        f"checkpoint='{export}'",
+    )
+    assert "eval[validation]" in out
+
+    out = run_example(
+        "convert_packed.py", "ConvertPacked",
+        "model=BinaryNet", "model.features=(8,8)", "model.dense_units=(16,)",
+        f"checkpoint='{export}'", f"output='{packed}'",
+    )
+    assert "verified max |forward diff| = 0.0" in out
+
+
+def test_imagenet_task_compiles_tiny():
+    out = run_example(
+        "imagenet_experiment.py", "TrainImageNet",
+        "epochs=1", "steps_per_epoch=1", "batch_size=4", "validate=False",
+        "loader.dataset.num_train_examples=8",
+        "loader.dataset.num_validation_examples=4",
+        "loader.preprocessing.height=32", "loader.preprocessing.width=32",
+        "loader.num_workers=0",
+        "model.blocks_per_section=(1,1)", "model.section_features=(8,16)",
+        timeout=400,
+    )
+    assert "epoch 1/1" in out
