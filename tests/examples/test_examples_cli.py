@@ -22,9 +22,12 @@ def run_example(script, *args, timeout=240):
     # in-child config reset in tests/parallel/multiproc_worker.py).
     for key in [k for k in env if k.startswith("PALLAS_AXON")]:
         env.pop(key)
+    pythonpath = REPO
+    if env.get("PYTHONPATH"):
+        pythonpath = f"{REPO}{os.pathsep}{env['PYTHONPATH']}"
     env.update({
         "JAX_PLATFORMS": "cpu",
-        "PYTHONPATH": REPO,
+        "PYTHONPATH": pythonpath,
         "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
     })
     proc = subprocess.run(

@@ -413,3 +413,67 @@ def test_reactnet_trains_one_step_and_binary_paths():
     np.testing.assert_allclose(
         np.asarray(y_mxu), np.asarray(y_i8), rtol=1e-5, atol=1e-5
     )
+
+
+def test_meliusnet_shape_params_and_improvement_semantics():
+    from zookeeper_tpu.models import MeliusNet22
+    from zookeeper_tpu.models.binary import (
+        _MeliusDenseBlock,
+        _MeliusImprovementBlock,
+    )
+
+    # Improvement block: only the NEWEST `growth` channels change.
+    rng = np.random.default_rng(9)
+    x = jnp.asarray(rng.normal(size=(2, 8, 8, 24)), jnp.float32)
+    blk = _MeliusImprovementBlock(growth=8, dtype=jnp.float32)
+    params = blk.init(jax.random.key(0), x, training=False)
+    y = blk.apply(params, x, training=False)
+    assert y.shape == x.shape
+    np.testing.assert_array_equal(
+        np.asarray(y[..., :16]), np.asarray(x[..., :16])
+    )
+    assert not np.allclose(np.asarray(y[..., 16:]), np.asarray(x[..., 16:]))
+
+    # Dense block grows the stack by `growth`.
+    dblk = _MeliusDenseBlock(growth=8, dtype=jnp.float32)
+    dparams = dblk.init(jax.random.key(0), x, training=False)
+    dy = dblk.apply(dparams, x, training=False)
+    assert dy.shape == (2, 8, 8, 32)
+
+    # Full model at ImageNet shapes: right head shape, plausible params.
+    logits, params, *_ = build_and_forward(MeliusNet22, {}, (224, 224, 3), 1000)
+    assert logits.shape == (2, 1000)
+    n_params = sum(p.size for p in jax.tree.leaves(params))
+    # MeliusNet-22 is ~6.5M params (paper); loose reconstruction bounds.
+    assert 4e6 < n_params < 12e6
+
+
+def test_meliusnet_trains_one_step():
+    import optax
+
+    from zookeeper_tpu.core import configure
+    from zookeeper_tpu.models import MeliusNet22
+    from zookeeper_tpu.training import TrainState, make_train_step
+
+    m = MeliusNet22()
+    configure(
+        m,
+        {"blocks_per_section": (1, 1), "transition_features": (32,),
+         "growth": 16, "stem_features": 16},
+        name="m",
+    )
+    input_shape = (32, 32, 3)
+    module = m.build(input_shape, num_classes=4)
+    params, model_state = m.initialize(module, input_shape)
+    state = TrainState.create(
+        apply_fn=module.apply, params=params, model_state=model_state,
+        tx=optax.adam(1e-3),
+    )
+    step = jax.jit(make_train_step())
+    rng = np.random.default_rng(0)
+    batch = {
+        "input": jnp.asarray(rng.normal(size=(4, *input_shape)), jnp.float32),
+        "target": jnp.asarray(rng.integers(0, 4, 4)),
+    }
+    _, metrics = step(state, batch)
+    assert np.isfinite(float(metrics["loss"]))

@@ -20,6 +20,7 @@ from zookeeper_tpu.models.binary import (
     BinaryResNetE18,
     BiRealNet,
     DoReFaNet,
+    MeliusNet22,
     QuickNet,
     QuickNetLarge,
     QuickNetSmall,
@@ -42,6 +43,7 @@ __all__ = [
     "BinaryResNetE18",
     "BiRealNet",
     "DoReFaNet",
+    "MeliusNet22",
     "Mlp",
     "Model",
     "QuickNet",

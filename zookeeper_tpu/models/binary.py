@@ -1000,3 +1000,128 @@ class ReActNet(Model):
             binary_compute=self.binary_compute,
             pallas_interpret=self.pallas_interpret,
         )
+
+
+class _MeliusDenseBlock(nn.Module):
+    """MeliusNet (Bethge et al. 2020) Dense Block: BN -> sign -> binary
+    3x3 conv producing ``growth`` new channels, CONCATENATED onto the
+    feature stack (capacity increase)."""
+
+    growth: int
+    dtype: Any
+    binary_compute: str = "mxu"
+    pallas_interpret: bool = False
+
+    @nn.compact
+    def __call__(self, x, training: bool = False):
+        y = _bn(training, self.dtype)(x)
+        y = QuantConv(
+            self.growth, (3, 3), input_quantizer="ste_sign",
+            kernel_quantizer="ste_sign", dtype=self.dtype,
+            binary_compute=self.binary_compute,
+            pallas_interpret=self.pallas_interpret,
+        )(y)
+        return jnp.concatenate([x, y], axis=-1)
+
+
+class _MeliusImprovementBlock(nn.Module):
+    """MeliusNet Improvement Block: BN -> sign -> binary 3x3 conv whose
+    output ADDS onto the newest ``growth`` channels (quality increase for
+    the features the dense block just appended)."""
+
+    growth: int
+    dtype: Any
+    binary_compute: str = "mxu"
+    pallas_interpret: bool = False
+
+    @nn.compact
+    def __call__(self, x, training: bool = False):
+        y = _bn(training, self.dtype)(x)
+        y = QuantConv(
+            self.growth, (3, 3), input_quantizer="ste_sign",
+            kernel_quantizer="ste_sign", dtype=self.dtype,
+            binary_compute=self.binary_compute,
+            pallas_interpret=self.pallas_interpret,
+        )(y)
+        old, new = x[..., : -self.growth], x[..., -self.growth :]
+        return jnp.concatenate([old, new + y], axis=-1)
+
+
+class _MeliusNetModule(nn.Module):
+    """MeliusNet: sections of (Dense, Improvement) block pairs with fp
+    1x1 reduction + maxpool transitions. Reconstruction from the paper's
+    description (block counts/transition widths approximate, documented
+    deviation like the other zoo families)."""
+
+    blocks_per_section: Tuple[int, ...]
+    transition_features: Tuple[int, ...]
+    growth: int
+    stem_features: int
+    num_classes: int
+    dtype: Any
+    binary_compute: str = "mxu"
+    pallas_interpret: bool = False
+
+    @nn.compact
+    def __call__(self, x, training: bool = False):
+        d = self.dtype
+        x = nn.Conv(self.stem_features, (3, 3), strides=(2, 2),
+                    padding="SAME", use_bias=False, dtype=d)(x.astype(d))
+        x = _bn(training, d)(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for s, n_pairs in enumerate(self.blocks_per_section):
+            for _ in range(n_pairs):
+                x = _MeliusDenseBlock(
+                    self.growth, d, self.binary_compute,
+                    self.pallas_interpret,
+                )(x, training)
+                x = _MeliusImprovementBlock(
+                    self.growth, d, self.binary_compute,
+                    self.pallas_interpret,
+                )(x, training)
+            if s < len(self.blocks_per_section) - 1:
+                x = _bn(training, d)(x)
+                x = nn.relu(x)
+                x = nn.Conv(
+                    self.transition_features[s], (1, 1), use_bias=False,
+                    dtype=d,
+                )(x)
+                x = nn.max_pool(x, (2, 2), strides=(2, 2), padding="SAME")
+        x = _bn(training, d)(x)
+        x = nn.relu(x)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=d)(x)
+        return x.astype(jnp.float32)
+
+
+@component
+class MeliusNet22(Model):
+    """MeliusNet-22 (~63.6% top-1 target): the dense-then-improve BNN
+    family — capacity via concat growth, quality via residual refinement
+    of the newest channels."""
+
+    blocks_per_section: Sequence[int] = Field((4, 5, 4, 4))
+    transition_features: Sequence[int] = Field((160, 224, 256))
+    growth: int = Field(64)
+    stem_features: int = Field(64)
+    binary_compute: str = Field("mxu")
+    pallas_interpret: bool = Field(False)
+
+    def build(self, input_shape, num_classes: int) -> nn.Module:
+        if len(self.transition_features) != len(self.blocks_per_section) - 1:
+            raise ValueError(
+                f"transition_features has {len(self.transition_features)} "
+                f"entries; expected {len(self.blocks_per_section) - 1} "
+                "(one per section boundary)."
+            )
+        return _MeliusNetModule(
+            blocks_per_section=tuple(self.blocks_per_section),
+            transition_features=tuple(self.transition_features),
+            growth=self.growth,
+            stem_features=self.stem_features,
+            num_classes=num_classes,
+            dtype=self.dtype(),
+            binary_compute=self.binary_compute,
+            pallas_interpret=self.pallas_interpret,
+        )
