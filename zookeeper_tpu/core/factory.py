@@ -22,7 +22,7 @@ from __future__ import annotations
 
 import inspect
 import typing
-from typing import Any, Dict, List, Mapping
+from typing import Any, Dict, Mapping
 
 from . import utils
 from .component import component, is_component_class

@@ -24,7 +24,7 @@ child's default, but an ancestor's mere default does not.
 from __future__ import annotations
 
 import inspect
-from typing import Any, Callable, Optional, Type, TypeVar, Union
+from typing import Any, Optional, TypeVar, Union
 
 from . import utils
 from .utils import ConfigurationError, missing

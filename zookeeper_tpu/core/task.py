@@ -8,7 +8,7 @@ every registered task as a sub-command.
 
 from __future__ import annotations
 
-from typing import Any, Dict
+from typing import Dict
 
 from .component import component, is_component_class
 

@@ -13,7 +13,7 @@ Sources are pure host-side Python/numpy; nothing here imports JAX or TF.
 
 from __future__ import annotations
 
-from typing import Any, Callable, Dict, Sequence
+from typing import Callable, Dict, Sequence
 
 import numpy as np
 

@@ -15,7 +15,7 @@ running the float path (a user benchmarking "int8" must never actually be
 measuring bf16).
 """
 
-from typing import Any, Callable, Optional, Sequence, Tuple, Union
+from typing import Any, Callable, Sequence, Tuple, Union
 
 import flax.linen as nn
 import jax

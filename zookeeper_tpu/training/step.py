@@ -7,7 +7,7 @@ hot loop lives inside Keras ``fit``; here it is an explicit pure function
 input state donated so parameter updates happen in place in HBM.
 """
 
-from typing import Any, Callable, Dict, Tuple
+from typing import Callable, Dict, Tuple
 
 import jax
 import jax.numpy as jnp
