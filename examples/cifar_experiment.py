@@ -1,0 +1,47 @@
+"""CIFAR-scale binary-network experiment — the reference example's
+canonical workload (SURVEY.md §2.3: ``examples/larq_experiment.py``
+trains BinaryNet on CIFAR-10/MNIST; BASELINE config #1).
+
+Synthetic CIFAR-shaped data by default (no network); the full larq-style
+recipe is one CLI line::
+
+    python examples/cifar_experiment.py TrainCifar epochs=100 \\
+        optimizer=Bop track_flip_ratio=True ema_decay=0.999 \\
+        loader.preprocessing.augment=True
+
+Swap ``loader.dataset=TFDSDataset loader.dataset.name=cifar10`` where
+TFDS data exists, or ``optimizer=Adam`` for the latent-weight recipe.
+"""
+
+from zookeeper_tpu import ComponentField, Field, PartialComponent, cli, task
+from zookeeper_tpu.data import (
+    DataLoader,
+    ImageClassificationPreprocessing,
+    SyntheticCifar10,
+)
+from zookeeper_tpu.models import BinaryNet, Model
+from zookeeper_tpu.training import Adam, Optimizer, TrainingExperiment
+
+CifarPreprocessing = PartialComponent(
+    ImageClassificationPreprocessing,
+    height=32, width=32, channels=3, augment=True, pad_pixels=4,
+)
+
+
+@task
+class TrainCifar(TrainingExperiment):
+    loader: DataLoader = ComponentField(
+        DataLoader,
+        dataset=SyntheticCifar10,
+        preprocessing=CifarPreprocessing,
+    )
+    model: Model = ComponentField(
+        BinaryNet, features=(128, 128, 256, 256), dense_units=(512,)
+    )
+    optimizer: Optimizer = ComponentField(Adam)
+    epochs: int = Field(100)
+    batch_size: int = Field(128)
+
+
+if __name__ == "__main__":
+    cli()

@@ -82,3 +82,15 @@ def test_imagenet_task_compiles_tiny():
         timeout=400,
     )
     assert "epoch 1/1" in out
+
+
+def test_cifar_binarynet_task():
+    out = run_example(
+        "cifar_experiment.py", "TrainCifar",
+        "epochs=1", "steps_per_epoch=2", "batch_size=16",
+        "model.features=(8,8)", "model.dense_units=(16,)",
+        "loader.dataset.num_train_examples=32",
+        "loader.dataset.num_validation_examples=16",
+        "track_flip_ratio=True",
+    )
+    assert "epoch 1/1" in out
