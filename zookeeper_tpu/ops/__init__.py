@@ -22,12 +22,18 @@ from zookeeper_tpu.ops.quantizers import (
 )
 from zookeeper_tpu.ops.layers import (
     QuantConv,
+    QuantConv1D,
+    QuantConv3D,
+    QuantConvND,
+    QuantConvTranspose,
     QuantDense,
     QuantDepthwiseConv,
     QuantSeparableConv,
 )
 from zookeeper_tpu.ops.binary_compute import (
+    conv_dim_numbers,
     int8_conv,
+    int8_conv_transpose,
     int8_matmul,
     pack_bits,
     pack_conv_kernel,
@@ -41,7 +47,9 @@ from zookeeper_tpu.ops.binary_compute import (
 from zookeeper_tpu.ops.packed import pack_quantconv_params
 
 __all__ = [
+    "conv_dim_numbers",
     "int8_conv",
+    "int8_conv_transpose",
     "int8_matmul",
     "pack_bits",
     "pack_conv_kernel",
@@ -54,6 +62,10 @@ __all__ = [
     "xnor_matmul_packed",
     "QUANTIZERS",
     "QuantConv",
+    "QuantConv1D",
+    "QuantConv3D",
+    "QuantConvND",
+    "QuantConvTranspose",
     "QuantDense",
     "QuantDepthwiseConv",
     "QuantSeparableConv",

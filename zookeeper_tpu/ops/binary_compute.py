@@ -504,6 +504,16 @@ def _packed_conv_forward(
     return y.reshape(b, ho, wo, co)
 
 
+def conv_dim_numbers(spatial_rank: int) -> Tuple[str, str, str]:
+    """Channels-last dimension-number strings for a given spatial rank
+    (1 -> NWC/WIO, 2 -> NHWC/HWIO, 3 -> NDHWC/DHWIO). Channels-last is
+    the TPU-native layout: the channel contraction lands on MXU lanes."""
+    spatial = {1: "W", 2: "HW", 3: "DHW"}.get(spatial_rank)
+    if spatial is None:
+        raise ValueError(f"Unsupported spatial rank {spatial_rank} (1/2/3).")
+    return (f"N{spatial}C", f"{spatial}IO", f"N{spatial}C")
+
+
 def _float_conv(x, k, strides, padding, groups=1):
     # Gradient convs follow the model's COMPUTE dtype (x's dtype): the
     # quantized kernel arrives fp32 (latent storage) even in bf16 mixed
@@ -517,7 +527,7 @@ def _float_conv(x, k, strides, padding, groups=1):
     dtype = x.dtype
     return jax.lax.conv_general_dilated(
         x, k.astype(dtype), window_strides=tuple(strides),
-        padding=padding, dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        padding=padding, dimension_numbers=conv_dim_numbers(k.ndim - 2),
         feature_group_count=groups,
     )
 
@@ -663,7 +673,7 @@ def _int8_conv_forward(x_sign, k_sign, strides, padding, groups, scaled):
         # magnitude_aware_sign kernels run exactly too (the scale
         # re-applies to the int32 sums, ONE rounding instead of the
         # float conv's per-element roundings).
-        kscale = jnp.max(jnp.abs(k_sign), axis=(0, 1, 2))
+        kscale = jnp.max(jnp.abs(k_sign), axis=tuple(range(k_sign.ndim - 1)))
         safe = jnp.where(kscale > 0, kscale, jnp.ones_like(kscale))
         k8 = jnp.round(k_sign / safe).astype(jnp.int8)
     else:
@@ -675,7 +685,7 @@ def _int8_conv_forward(x_sign, k_sign, strides, padding, groups, scaled):
     x8 = jnp.round(x_sign).astype(jnp.int8)
     out = jax.lax.conv_general_dilated(
         x8, k8, window_strides=tuple(strides), padding=padding,
-        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        dimension_numbers=conv_dim_numbers(k_sign.ndim - 2),
         feature_group_count=groups,
         preferred_element_type=jnp.int32,
     )
@@ -684,9 +694,11 @@ def _int8_conv_forward(x_sign, k_sign, strides, padding, groups, scaled):
 
 
 @partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5))
-def int8_conv(x_sign: Array, k_sign: Array, strides: Tuple[int, int],
+def int8_conv(x_sign: Array, k_sign: Array, strides: Tuple[int, ...],
               padding: str, groups: int = 1, scaled: bool = True) -> Array:
-    """NHWC conv of quantized operands on the int8 MXU path.
+    """Channels-last conv of quantized operands on the int8 MXU path —
+    any spatial rank (1-D [N,W,C], 2-D NHWC, 3-D NDHWC; rank inferred
+    from the kernel).
 
     Inputs must be exact small integers ({-1, 0, +1}); the kernel must be
     sign x per-output-channel scale. Exact vs the float conv on that
@@ -716,3 +728,61 @@ def _int8_conv_bwd(strides, padding, groups, scaled, res, g):
 
 
 int8_conv.defvjp(_int8_conv_fwd, _int8_conv_bwd)
+
+
+def _float_conv_transpose(x, k, strides, padding):
+    dtype = x.dtype
+    return jax.lax.conv_transpose(
+        x, k.astype(dtype), strides=tuple(strides), padding=padding,
+        dimension_numbers=conv_dim_numbers(k.ndim - 2),
+    )
+
+
+def _int8_conv_transpose_forward(x_sign, k_sign, strides, padding, scaled):
+    if scaled:
+        kscale = jnp.max(jnp.abs(k_sign), axis=tuple(range(k_sign.ndim - 1)))
+        safe = jnp.where(kscale > 0, kscale, jnp.ones_like(kscale))
+        k8 = jnp.round(k_sign / safe).astype(jnp.int8)
+    else:
+        k8 = jnp.round(k_sign).astype(jnp.int8)
+    x8 = jnp.round(x_sign).astype(jnp.int8)
+    out = jax.lax.conv_transpose(
+        x8, k8, strides=tuple(strides), padding=padding,
+        dimension_numbers=conv_dim_numbers(k_sign.ndim - 2),
+        preferred_element_type=jnp.int32,
+    )
+    out = out.astype(jnp.float32)
+    return out * safe.astype(jnp.float32) if scaled else out
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
+def int8_conv_transpose(x_sign: Array, k_sign: Array,
+                        strides: Tuple[int, ...], padding: str,
+                        scaled: bool = True) -> Array:
+    """Channels-last TRANSPOSED conv of quantized operands on the int8
+    MXU path (any spatial rank; the fractionally-strided conv is still a
+    conv, so the same exactness argument as :func:`int8_conv` applies —
+    integer accumulation over {-1, 0, +1} values, one per-channel scale
+    multiply; inserted stride zeros are exact in int8)."""
+    return _int8_conv_transpose_forward(x_sign, k_sign, strides, padding,
+                                        scaled)
+
+
+def _int8_convt_fwd(x_sign, k_sign, strides, padding, scaled):
+    return (
+        _int8_conv_transpose_forward(x_sign, k_sign, strides, padding, scaled),
+        (x_sign, k_sign),
+    )
+
+
+def _int8_convt_bwd(strides, padding, scaled, res, g):
+    x_sign, k_sign = res
+    _, vjp = jax.vjp(
+        lambda x, k: _float_conv_transpose(x, k, strides, padding),
+        x_sign, k_sign,
+    )
+    dx, dk = vjp(g.astype(x_sign.dtype))
+    return dx.astype(x_sign.dtype), dk.astype(k_sign.dtype)
+
+
+int8_conv_transpose.defvjp(_int8_convt_fwd, _int8_convt_bwd)

@@ -60,7 +60,7 @@ BINARY_COMPUTE_MODES = ("mxu", "int8", "xnor", "xnor_popcount")
 #: ste_tern/dorefa) it is registered as "kernel_fp", which this pattern
 #: does not match — so an activation-only-quantized Quant layer can never
 #: be sign-flipped by Bop or miscounted as 1-bit.
-BINARY_KERNEL_PATTERN = r"Quant[A-Za-z]*_\d+/kernel$"
+BINARY_KERNEL_PATTERN = r"Quant[A-Za-z0-9]*_\d+/kernel$"
 
 
 def _kernel_param_name(kernel_quantizer: Quantizer) -> str:
@@ -76,6 +76,16 @@ def _kernel_param_name(kernel_quantizer: Quantizer) -> str:
         "kernel"
         if kernel_quantizer in _SIGN_KERNEL_QUANTIZERS
         else "kernel_fp"
+    )
+
+
+def _int8_kernel_is_unscaled(kernel_quantizer: Quantizer) -> bool:
+    """True when the kernel is statically known to be pure {-1, 0, +1}
+    (skips the int8 path's runtime scale extraction). Callables are
+    conservatively assumed scaled — stays exact either way."""
+    return (
+        isinstance(kernel_quantizer, str)
+        and kernel_quantizer != "magnitude_aware_sign"
     )
 
 
@@ -327,16 +337,9 @@ class QuantConv(nn.Module):
             if k_q is not None:
                 kernel = k_q(kernel)
             if self.binary_compute == "int8":
-                # Unscaled kernels are statically known for the pure
-                # {-1,0,+1} string quantizers; callables conservatively
-                # assume a scale (stays exact either way).
-                unscaled = (
-                    isinstance(self.kernel_quantizer, str)
-                    and self.kernel_quantizer != "magnitude_aware_sign"
-                )
                 y = int8_conv(
                     x, kernel, tuple(self.strides), self.padding, groups,
-                    not unscaled,
+                    not _int8_kernel_is_unscaled(self.kernel_quantizer),
                 )
                 y = y.astype(self.dtype)
             elif self.binary_compute in ("xnor", "xnor_popcount"):
@@ -346,17 +349,249 @@ class QuantConv(nn.Module):
                     self.pallas_interpret,
                 ).astype(self.dtype)
             else:
+                from zookeeper_tpu.ops.binary_compute import conv_dim_numbers
+
                 y = jax.lax.conv_general_dilated(
                     x.astype(self.dtype),
                     kernel.astype(self.dtype),
                     window_strides=self.strides,
                     padding=self.padding,
                     rhs_dilation=tuple(self.kernel_dilation),
-                    dimension_numbers=("NHWC", "HWIO", "NHWC"),
+                    dimension_numbers=conv_dim_numbers(2),
                     feature_group_count=groups,
                 )
         if self.use_bias:
             bias = self.param("bias", self.bias_init, (self.features,), jnp.float32)
+            y = y + bias.astype(self.dtype)
+        return y
+
+
+class QuantConvND(nn.Module):
+    """Channels-last N-D convolution with optional input/kernel
+    quantization — the larq ``QuantConv1D``/``QuantConv3D`` capability
+    (spatial rank inferred from ``kernel_size``; 2-D works too, but
+    :class:`QuantConv` is the 2-D layer with the full binary-path
+    selection).
+
+    ``binary_compute`` supports ``"mxu"`` and ``"int8"`` (rank-generic
+    MXU paths). The packed Pallas kernels are 2-D-only — requesting one
+    here raises loudly, pointing at :class:`QuantConv`.
+    """
+
+    features: int
+    kernel_size: Tuple[int, ...] = (3,)
+    strides: Tuple[int, ...] = None
+    padding: Union[str, Sequence[Tuple[int, int]]] = "SAME"
+    kernel_dilation: Tuple[int, ...] = None
+    feature_group_count: int = 1
+    input_quantizer: Quantizer = None
+    kernel_quantizer: Quantizer = None
+    kernel_clip: bool = True
+    use_bias: bool = False
+    dtype: Any = jnp.float32
+    binary_compute: str = "mxu"
+    kernel_init: Callable = nn.initializers.glorot_normal()
+    bias_init: Callable = nn.initializers.zeros_init()
+
+    #: Pinned by the 1-D/3-D subclasses; None = any rank.
+    _SPATIAL_RANK = None
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        from zookeeper_tpu.ops.binary_compute import int8_conv
+
+        rank = len(self.kernel_size)
+        if self._SPATIAL_RANK is not None and rank != self._SPATIAL_RANK:
+            raise ValueError(
+                f"{type(self).__name__}: kernel_size "
+                f"{tuple(self.kernel_size)} must have "
+                f"{self._SPATIAL_RANK} spatial dim(s)."
+            )
+        if x.ndim != rank + 2:
+            raise ValueError(
+                f"{type(self).__name__}: input rank {x.ndim} does not "
+                f"match a {rank}-D conv (expect [batch, *spatial, "
+                "channels])."
+            )
+        strides = tuple(self.strides) if self.strides else (1,) * rank
+        dilation = (
+            tuple(self.kernel_dilation) if self.kernel_dilation
+            else (1,) * rank
+        )
+        if len(strides) != rank or len(dilation) != rank:
+            raise ValueError(
+                f"{type(self).__name__}: strides {strides} / "
+                f"kernel_dilation {dilation} must match kernel_size rank "
+                f"{rank}."
+            )
+        if self.binary_compute not in ("mxu", "int8"):
+            raise ValueError(
+                f"{type(self).__name__}: binary_compute="
+                f"{self.binary_compute!r} unsupported — the packed Pallas "
+                "kernels are 2-D-specific; use QuantConv for packed "
+                "deployment, or 'mxu'/'int8' here."
+            )
+        in_q = get_quantizer(self.input_quantizer)
+        k_q = get_quantizer(self.kernel_quantizer)
+        _check_binary_compute(
+            self.binary_compute, in_q, k_q, self.input_quantizer,
+            self.kernel_quantizer, self.padding, type(self).__name__,
+        )
+        if dilation != (1,) * rank and self.binary_compute != "mxu":
+            raise ValueError(
+                f"{type(self).__name__}: kernel_dilation={dilation} is "
+                "only supported with binary_compute='mxu' — no silent "
+                "fallback."
+            )
+        ci = x.shape[-1]
+        groups = self.feature_group_count
+        if groups < 1:
+            raise ValueError(
+                f"{type(self).__name__}: feature_group_count={groups} "
+                "invalid (>= 1)."
+            )
+        if ci % groups != 0 or self.features % groups != 0:
+            raise ValueError(
+                f"{type(self).__name__}: feature_group_count={groups} "
+                f"must divide both input channels ({ci}) and features "
+                f"({self.features})."
+            )
+        kernel = self.param(
+            _kernel_param_name(self.kernel_quantizer),
+            self.kernel_init,
+            (*self.kernel_size, ci // groups, self.features),
+            jnp.float32,
+        )
+        if in_q is not None:
+            x = in_q(x)
+        kernel = _apply_clip(kernel, self.kernel_clip)
+        if k_q is not None:
+            kernel = k_q(kernel)
+        if self.binary_compute == "int8":
+            y = int8_conv(
+                x, kernel, strides, self.padding, groups,
+                not _int8_kernel_is_unscaled(self.kernel_quantizer),
+            ).astype(self.dtype)
+        else:
+            from zookeeper_tpu.ops.binary_compute import conv_dim_numbers
+
+            y = jax.lax.conv_general_dilated(
+                x.astype(self.dtype),
+                kernel.astype(self.dtype),
+                window_strides=strides,
+                padding=self.padding,
+                rhs_dilation=dilation,
+                dimension_numbers=conv_dim_numbers(rank),
+                feature_group_count=groups,
+            )
+        if self.use_bias:
+            bias = self.param(
+                "bias", self.bias_init, (self.features,), jnp.float32
+            )
+            y = y + bias.astype(self.dtype)
+        return y
+
+
+class QuantConv1D(QuantConvND):
+    """1-D quantized conv over [batch, width, channels] (larq
+    ``QuantConv1D``)."""
+
+    _SPATIAL_RANK = 1
+
+
+class QuantConv3D(QuantConvND):
+    """3-D quantized conv over [batch, depth, height, width, channels]
+    (larq ``QuantConv3D``)."""
+
+    kernel_size: Tuple[int, ...] = (3, 3, 3)
+    _SPATIAL_RANK = 3
+
+
+class QuantConvTranspose(nn.Module):
+    """Channels-last N-D TRANSPOSED conv with optional input/kernel
+    quantization — the larq ``QuantConv2DTranspose``/``QuantConv3DTranspose``
+    capability (spatial rank inferred from ``kernel_size``).
+
+    ``binary_compute``: ``"mxu"`` (default) or ``"int8"`` — the
+    fractionally-strided conv contracts exactly like a conv, so the int8
+    MXU path stays bit-exact on quantized operands. Packed modes are
+    2-D-forward-conv-specific and raise loudly.
+    """
+
+    features: int
+    kernel_size: Tuple[int, ...] = (3, 3)
+    strides: Tuple[int, ...] = None
+    padding: Union[str, Sequence[Tuple[int, int]]] = "SAME"
+    input_quantizer: Quantizer = None
+    kernel_quantizer: Quantizer = None
+    kernel_clip: bool = True
+    use_bias: bool = False
+    dtype: Any = jnp.float32
+    binary_compute: str = "mxu"
+    kernel_init: Callable = nn.initializers.glorot_normal()
+    bias_init: Callable = nn.initializers.zeros_init()
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        from zookeeper_tpu.ops.binary_compute import (
+            conv_dim_numbers,
+            int8_conv_transpose,
+        )
+
+        rank = len(self.kernel_size)
+        if x.ndim != rank + 2:
+            raise ValueError(
+                f"{type(self).__name__}: input rank {x.ndim} does not "
+                f"match a {rank}-D transposed conv (expect [batch, "
+                "*spatial, channels])."
+            )
+        strides = tuple(self.strides) if self.strides else (1,) * rank
+        if len(strides) != rank:
+            raise ValueError(
+                f"{type(self).__name__}: strides {strides} must match "
+                f"kernel_size rank {rank}."
+            )
+        if self.binary_compute not in ("mxu", "int8"):
+            raise ValueError(
+                f"{type(self).__name__}: binary_compute="
+                f"{self.binary_compute!r} unsupported (packed kernels "
+                "cover the 2-D forward conv only); use 'mxu' or 'int8'."
+            )
+        in_q = get_quantizer(self.input_quantizer)
+        k_q = get_quantizer(self.kernel_quantizer)
+        _check_binary_compute(
+            self.binary_compute, in_q, k_q, self.input_quantizer,
+            self.kernel_quantizer, self.padding, type(self).__name__,
+        )
+        ci = x.shape[-1]
+        kernel = self.param(
+            _kernel_param_name(self.kernel_quantizer),
+            self.kernel_init,
+            (*self.kernel_size, ci, self.features),
+            jnp.float32,
+        )
+        if in_q is not None:
+            x = in_q(x)
+        kernel = _apply_clip(kernel, self.kernel_clip)
+        if k_q is not None:
+            kernel = k_q(kernel)
+        if self.binary_compute == "int8":
+            y = int8_conv_transpose(
+                x, kernel, strides, self.padding,
+                not _int8_kernel_is_unscaled(self.kernel_quantizer),
+            ).astype(self.dtype)
+        else:
+            y = jax.lax.conv_transpose(
+                x.astype(self.dtype),
+                kernel.astype(self.dtype),
+                strides=strides,
+                padding=self.padding,
+                dimension_numbers=conv_dim_numbers(rank),
+            )
+        if self.use_bias:
+            bias = self.param(
+                "bias", self.bias_init, (self.features,), jnp.float32
+            )
             y = y + bias.astype(self.dtype)
         return y
 

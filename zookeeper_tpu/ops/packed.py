@@ -9,6 +9,7 @@ matches the parameter structure a ``QuantConv(packed_weights=True)``
 module declares, so ``module.apply`` works unchanged.
 """
 
+import re
 from typing import Any, Callable, Mapping, Optional, Union
 
 import jax.numpy as jnp
@@ -50,13 +51,18 @@ def pack_quantconv_params(
         raise ValueError("pack_quantconv_params requires a kernel quantizer.")
 
     n_converted = 0
+    # Only the 2-D QuantConv layer has a packed deployment structure;
+    # QuantConvTranspose/QuantConvND scopes also start with "QuantConv"
+    # but must pass through unchanged (their 4-D/5-D kernels have no
+    # packed_weights counterpart to load into).
+    qc_scope = re.compile(r"^QuantConv_\d+$")
 
     def convert(node: Any, in_quantconv: bool, tnode: Any) -> Any:
         nonlocal n_converted
         if isinstance(node, Mapping):
             out = {}
             for key, child in node.items():
-                child_is_qc = in_quantconv or key.startswith("QuantConv")
+                child_is_qc = in_quantconv or qc_scope.match(key) is not None
                 tchild = (
                     tnode.get(key) if isinstance(tnode, Mapping) else None
                 )
