@@ -1,0 +1,105 @@
+"""quantized_param_view: the larq ``quantized_scope`` capability as an
+explicit tree transform (params are explicit in JAX, so "enter the scope"
+becomes "map the tree")."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from zookeeper_tpu.ops import (
+    QuantConv,
+    QuantDense,
+    quantized_param_view,
+)
+
+
+def test_view_quantizes_only_latent_sign_kernels():
+    import flax.linen as nn
+
+    class Net(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            x = QuantConv(
+                features=4, kernel_size=(3, 3),
+                input_quantizer="ste_sign", kernel_quantizer="ste_sign",
+            )(x)
+            x = x.mean(axis=(1, 2))
+            x = QuantDense(features=3, kernel_quantizer="ste_sign")(x)
+            return nn.Dense(2)(x)
+
+    x = jnp.asarray(
+        np.random.default_rng(0).normal(size=(1, 6, 6, 2)), jnp.float32
+    )
+    params = Net().init(jax.random.PRNGKey(0), x)["params"]
+    view = quantized_param_view(params)
+
+    qconv = view["QuantConv_0"]["kernel"]
+    qdense = view["QuantDense_0"]["kernel"]
+    # Sign-family read: exactly +-1 everywhere.
+    np.testing.assert_array_equal(np.abs(np.asarray(qconv)), 1.0)
+    np.testing.assert_array_equal(np.abs(np.asarray(qdense)), 1.0)
+    # Signs agree with the latents.
+    np.testing.assert_array_equal(
+        np.sign(np.asarray(params["QuantConv_0"]["kernel"])),
+        np.asarray(qconv),
+    )
+    # Non-quant layers pass through untouched (same objects / values).
+    np.testing.assert_array_equal(
+        np.asarray(view["Dense_0"]["kernel"]),
+        np.asarray(params["Dense_0"]["kernel"]),
+    )
+    # Originals are not mutated.
+    assert not np.all(np.abs(np.asarray(params["QuantConv_0"]["kernel"])) == 1)
+
+
+def test_view_matches_layer_forward_read():
+    """The view must equal the value the forward pass contracts with:
+    applying the view's kernel through a no-quantizer layer reproduces
+    the quantized layer's output."""
+    layer = QuantConv(
+        features=3, kernel_size=(3, 3), kernel_quantizer="ste_sign",
+        padding="VALID",
+    )
+    plain = QuantConv(
+        features=3, kernel_size=(3, 3), kernel_quantizer=None,
+        kernel_clip=False, padding="VALID",
+    )
+    x = jnp.asarray(
+        np.random.default_rng(1).normal(size=(2, 5, 5, 2)), jnp.float32
+    )
+    params = layer.init(jax.random.PRNGKey(1), x)
+    y_q = layer.apply(params, x)
+    # A top-level layer's params carry no module scope; present them the
+    # way they appear inside a model tree.
+    view = quantized_param_view({"QuantConv_0": params["params"]})
+    # Unquantized kernels are stored under "kernel_fp".
+    y_plain = plain.apply(
+        {"params": {"kernel_fp": view["QuantConv_0"]["kernel"]}}, x
+    )
+    np.testing.assert_array_equal(np.asarray(y_q), np.asarray(y_plain))
+
+
+def test_view_magnitude_aware_keeps_per_channel_scale():
+    params = {
+        "QuantConv_0": {
+            "kernel": jnp.asarray(
+                np.random.default_rng(2).normal(size=(3, 3, 4, 2)),
+                jnp.float32,
+            )
+        }
+    }
+    view = quantized_param_view(
+        params, kernel_quantizer="magnitude_aware_sign", kernel_clip=False
+    )
+    q = np.asarray(view["QuantConv_0"]["kernel"])
+    # sign x per-output-channel scale: each channel has exactly one |value|.
+    for co in range(q.shape[-1]):
+        vals = np.unique(np.abs(q[..., co]))
+        assert len(vals) == 1
+    assert not np.allclose(np.abs(q), 1.0)
+
+
+def test_view_requires_quantizer():
+    with pytest.raises(ValueError, match="requires a kernel quantizer"):
+        quantized_param_view({}, kernel_quantizer=None)

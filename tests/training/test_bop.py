@@ -443,3 +443,70 @@ def test_bop_accumulation_fp_side_single_wrapped():
         if not pat.search(p)
     )
     assert fp_moved  # At micro step 2 (the boundary), not step 4.
+
+
+def test_scale_by_bop_scheduled_threshold_stops_flips():
+    """threshold/gamma accept optax-style schedules evaluated from the
+    state's own counter (larq HyperparameterScheduler capability): a
+    threshold that jumps high after step 0 blocks the step-1 flip that a
+    constant threshold would have made."""
+    sched = optax.piecewise_constant_schedule(0.1, {1: 1e6})
+    tx = scale_by_bop(threshold=sched, gamma=1.0)
+    w = jnp.array([1.0])
+    g = jnp.array([0.5])  # Same sign, |m| > 0.1 every step.
+    state = tx.init(w)
+    updates, state = tx.update(g, state, w)
+    w1 = optax.apply_updates(w, updates)
+    assert float(w1[0]) == -1.0  # Step 0: threshold 0.1 -> flip.
+    g2 = jnp.array([-0.5])  # Same sign as w1 now.
+    updates, state = tx.update(g2, state, w1)
+    w2 = optax.apply_updates(w1, updates)
+    assert float(w2[0]) == -1.0  # Step 1: threshold 1e6 -> no flip.
+
+
+def test_scale_by_bop_state_structure_stable_under_scheduling():
+    """Scheduled and constant Bop share one state structure, so
+    checkpoints are interchangeable between the two."""
+    w = {"k": jnp.ones((2,))}
+    s_const = scale_by_bop(threshold=0.1, gamma=0.5).init(w)
+    s_sched = scale_by_bop(
+        threshold=optax.constant_schedule(0.1), gamma=0.5
+    ).init(w)
+    assert jax.tree.structure(s_const) == jax.tree.structure(s_sched)
+
+
+def test_bop_component_gamma_schedule_runs():
+    """gamma_schedule configured by subclass name drives the binary side;
+    the step still trains end-to-end."""
+    opt = Bop()
+    configure(
+        opt,
+        {
+            "gamma_schedule": "PolynomialDecay",
+            "gamma_schedule.base_lr": 1e-2,
+            "gamma_schedule.end_lr": 1e-4,
+        },
+        name="opt",
+    )
+    from zookeeper_tpu.training import make_train_step
+
+    state, input_shape = _quicknet_tiny_state(opt)
+    step = jax.jit(make_train_step())
+    rng = np.random.default_rng(0)
+    batch = {
+        "input": jnp.asarray(rng.normal(size=(4, *input_shape)), jnp.float32),
+        "target": jnp.asarray(rng.integers(0, 4, 4)),
+    }
+    _, metrics = step(state, batch)
+    assert np.isfinite(float(metrics["loss"]))
+
+
+def test_bop_rejects_flat_knob_plus_schedule():
+    opt = Bop()
+    configure(
+        opt,
+        {"gamma": 1e-3, "gamma_schedule.base_lr": 1e-3},
+        name="opt",
+    )
+    with pytest.raises(ValueError, match="two sources of truth"):
+        opt.build(total_steps=10)

@@ -44,7 +44,7 @@ from zookeeper_tpu.ops.binary_compute import (
     xnor_matmul,
     xnor_matmul_packed,
 )
-from zookeeper_tpu.ops.packed import pack_quantconv_params
+from zookeeper_tpu.ops.packed import pack_quantconv_params, quantized_param_view
 
 __all__ = [
     "conv_dim_numbers",
@@ -56,6 +56,7 @@ __all__ = [
     "pack_quantconv_params",
     "packed_conv_infer",
     "packed_weight_matmul",
+    "quantized_param_view",
     "unpack_bits",
     "xnor_conv",
     "xnor_matmul",

@@ -104,6 +104,48 @@ def pack_quantconv_params(
     return out
 
 
+def quantized_param_view(
+    params: Mapping[str, Any],
+    kernel_quantizer: Union[str, Callable] = "ste_sign",
+    kernel_clip: bool = True,
+) -> dict:
+    """The larq ``quantized_scope`` capability: a params tree whose
+    latent sign-read kernels are replaced by the values the forward pass
+    actually computes with (quantizer(clip(latent)) — exactly the layer's
+    read path).
+
+    larq flips a thread-local scope so ``layer.get_weights()`` returns
+    quantized values; functionally that is a TREE TRANSFORM here — params
+    are explicit, so the "scope" is just a mapped copy. Use it for weight
+    export/analysis (e.g. inspecting the deployed +-1 x scale values) —
+    training always reads latents through the quantizer already.
+
+    Exactly the paths matching ``BINARY_KERNEL_PATTERN`` are mapped — the
+    same single source of truth the Bop split, the flip-ratio metric, and
+    the model summary key off — so the view can never diverge from what
+    the rest of the framework treats as binary; all other leaves pass
+    through unchanged.
+    """
+    from flax import traverse_util
+
+    from zookeeper_tpu.ops.layers import BINARY_KERNEL_PATTERN
+
+    k_q = get_quantizer(kernel_quantizer)
+    if k_q is None:
+        raise ValueError("quantized_param_view requires a kernel quantizer.")
+    pattern = re.compile(BINARY_KERNEL_PATTERN)
+    flat = traverse_util.flatten_dict(dict(params), sep="/")
+    out = {
+        path: (
+            k_q(_apply_clip(jnp.asarray(leaf), kernel_clip))
+            if pattern.search(path)
+            else leaf
+        )
+        for path, leaf in flat.items()
+    }
+    return traverse_util.unflatten_dict(out, sep="/")
+
+
 def _flat_keys(tree: Mapping[str, Any], prefix: str = ""):
     for key, child in tree.items():
         path = f"{prefix}/{key}" if prefix else str(key)

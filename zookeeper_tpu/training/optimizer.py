@@ -5,7 +5,7 @@ optimizers are components building ``optax.GradientTransformation``s, with
 the learning-rate schedule as a nested component.
 """
 
-from typing import Optional
+from typing import Any, NamedTuple
 
 import optax
 
@@ -132,8 +132,19 @@ def _flatten_paths(params):
 from zookeeper_tpu.ops.layers import BINARY_KERNEL_PATTERN  # noqa: E402
 
 
+class BopState(NamedTuple):
+    """State for :func:`scale_by_bop`. Module-level so every build yields
+    one pytree type: two separately-built Bop transforms (e.g. original
+    run and restart) have identical state STRUCTURES, scheduled or not."""
+
+    gradient_memory: Any
+    #: Applied-step counter driving the knob schedules; always present so
+    #: checkpoints stay interchangeable between scheduled and constant.
+    count: Any
+
+
 def scale_by_bop(
-    threshold: float = 1e-8, gamma: float = 1e-4
+    threshold=1e-8, gamma=1e-4
 ) -> "optax.GradientTransformation":
     """Bop (Helwegen et al. 2019, "Latent weights do not exist"): flip a
     binary weight's sign when the exponential moving average of its
@@ -142,6 +153,12 @@ def scale_by_bop(
         m_t = (1 - gamma) * m_{t-1} + gamma * g_t
         w  <- -w   if |m_t| > threshold and sign(m_t) == sign(w)
 
+    ``threshold`` and ``gamma`` each accept a float or an optax-style
+    schedule (step -> value) — the larq ``HyperparameterScheduler``
+    capability (its canonical use decays Bop's gamma/threshold over
+    training; on TPU the schedule evaluates inside the jitted step from
+    the state's own counter, not from a host callback).
+
     Expressed in optax's additive-update convention the transform emits
     ``-2w`` for flipped weights and ``0`` otherwise, so it composes with
     ``apply_updates``/``multi_transform``. Applied to LATENT kernels the
@@ -149,35 +166,36 @@ def scale_by_bop(
     weights through a sign quantizer, so only the sign matters, and the
     flip preserves magnitude exactly (no drift, no clipping interaction).
     """
-    from typing import Any, NamedTuple
-
     import jax
     import jax.numpy as jnp
 
-    class BopState(NamedTuple):
-        gradient_memory: Any
-
     def init_fn(params):
         return BopState(
-            gradient_memory=jax.tree.map(jnp.zeros_like, params)
+            gradient_memory=jax.tree.map(jnp.zeros_like, params),
+            count=jnp.zeros([], jnp.int32),
         )
 
     def update_fn(updates, state, params=None):
         if params is None:
             raise ValueError("scale_by_bop requires params (pass them to update).")
+        g = gamma(state.count) if callable(gamma) else gamma
+        t = threshold(state.count) if callable(threshold) else threshold
         m = jax.tree.map(
-            lambda m_, g: (1.0 - gamma) * m_ + gamma * g,
+            lambda m_, g_: (1.0 - g) * m_ + g * g_,
             state.gradient_memory,
             updates,
         )
 
         def delta(w, m_):
-            flip = (jnp.abs(m_) > threshold) & (
+            flip = (jnp.abs(m_) > t) & (
                 jnp.sign(m_) == jnp.sign(w)
             )
             return jnp.where(flip, -2.0 * w, jnp.zeros_like(w))
 
-        return jax.tree.map(delta, params, m), BopState(gradient_memory=m)
+        return (
+            jax.tree.map(delta, params, m),
+            BopState(gradient_memory=m, count=state.count + 1),
+        )
 
     return optax.GradientTransformation(init_fn, update_fn)
 
@@ -198,12 +216,42 @@ class Bop(Optimizer):
     field is unused here; schedule the fp side via
     ``fp_optimizer.schedule.*``. ``weight_decay``/``global_clip_norm``
     set directly on Bop raise (configure them on ``fp_optimizer``).
+
+    ``gamma_schedule`` / ``threshold_schedule`` decay the Bop knobs over
+    training (the larq ``HyperparameterScheduler`` capability — the
+    published long Bop recipes decay gamma alongside the fp learning
+    rate). When configured, the schedule's ``base_lr`` is the INITIAL
+    value of the knob and the flat ``gamma``/``threshold`` field must be
+    left unset (two sources of truth would pick a silent winner);
+    schedules run in applied (accumulation-boundary) units like the fp
+    side's.
     """
 
     threshold: float = Field(1e-8)
     gamma: float = Field(1e-4)
+    gamma_schedule: Schedule = ComponentField(ConstantSchedule)
+    threshold_schedule: Schedule = ComponentField(ConstantSchedule)
     binary_param_pattern: str = Field(BINARY_KERNEL_PATTERN)
     fp_optimizer: Optimizer = ComponentField(Adam)
+
+    def _knob(self, name: str, flat_value: float, sched, total_steps: int):
+        """Resolve a Bop knob: the configured schedule when present (its
+        base_lr is the initial value), else the flat float."""
+        from zookeeper_tpu.core import configured_field_names
+
+        configured = type(sched) is not ConstantSchedule or bool(
+            configured_field_names(sched)
+        )
+        if not configured:
+            return flat_value
+        if name in configured_field_names(self):
+            raise ValueError(
+                f"Both Bop.{name} and Bop.{name}_schedule are configured — "
+                f"set the initial value on {name}_schedule.base_lr and "
+                f"leave {name} unset (two sources of truth would pick a "
+                "silent winner)."
+            )
+        return sched.build(self._applied_steps(total_steps))
 
     def build(self, total_steps: int) -> optax.GradientTransformation:
         import re
@@ -228,14 +276,21 @@ class Bop(Optimizer):
                 "Bop has no learning rate, so a schedule configured on Bop "
                 "itself would be silently dead. Schedule the fp side via "
                 "fp_optimizer.schedule.* (Bop's own knobs are gamma/"
-                "threshold)."
+                "threshold, schedulable via gamma_schedule/"
+                "threshold_schedule)."
             )
         pattern = re.compile(self.binary_param_pattern)
         # Accumulation wraps ONCE around the whole binary/fp split (the
         # unscoped accumulate_steps key scope-inherits onto fp_optimizer,
         # which must therefore NOT wrap again — k^2 cadence otherwise).
         fp_tx = self.fp_optimizer.build(total_steps, _accumulate=False)
-        bop_tx = scale_by_bop(self.threshold, self.gamma)
+        bop_tx = scale_by_bop(
+            self._knob(
+                "threshold", self.threshold, self.threshold_schedule,
+                total_steps,
+            ),
+            self._knob("gamma", self.gamma, self.gamma_schedule, total_steps),
+        )
 
         def labels(params):
             from flax import traverse_util
