@@ -102,3 +102,74 @@ def test_metrics_file_written(tmp_path):
     assert len(lines) == 2
     assert {"epoch", "loss", "accuracy", "examples_per_sec"} <= set(lines[0])
     assert "val_accuracy" in lines[0]
+
+
+def _tiny_state(value: float, step: int):
+    """A minimal TrainState-shaped object for direct Checkpointer tests."""
+    import jax.numpy as jnp
+    import optax
+
+    from zookeeper_tpu.training import TrainState
+
+    state = TrainState.create(
+        apply_fn=lambda *a, **k: None,
+        params={"w": jnp.full((2,), value)},
+        model_state={},
+        tx=optax.sgd(0.1),
+    )
+    return state.replace(step=jnp.asarray(step))
+
+
+def test_keep_best_retention_and_best_step(tmp_path):
+    """keep_best_metric ranks checkpoints (Keras save_best_only parity):
+    max_to_keep=1 keeps the best-accuracy save, not the latest."""
+    ckpt = Checkpointer()
+    configure(
+        ckpt,
+        {
+            "directory": str(tmp_path / "best"),
+            "max_to_keep": 1,
+            "synchronous": True,
+            "keep_best_metric": "accuracy",
+        },
+        name="ckpt",
+    )
+    for step, acc in ((1, 0.2), (2, 0.9), (3, 0.5)):
+        ckpt.save(_tiny_state(float(step), step), metrics={"accuracy": acc})
+    ckpt.wait()
+    assert ckpt.best_step() == 2
+    # The best save survives retention and restores with its params.
+    restored = ckpt.restore_state(_tiny_state(0.0, 0))
+    assert int(np.asarray(restored.step)) == 2
+    np.testing.assert_allclose(np.asarray(restored.params["w"]), 2.0)
+    ckpt.close()
+
+
+def test_keep_best_requires_metrics(tmp_path):
+    ckpt = Checkpointer()
+    configure(
+        ckpt,
+        {
+            "directory": str(tmp_path / "best2"),
+            "synchronous": True,
+            "keep_best_metric": "accuracy",
+        },
+        name="ckpt",
+    )
+    with pytest.raises(ValueError, match="carries no such metric"):
+        ckpt.save(_tiny_state(1.0, 1))
+    with pytest.raises(ValueError, match="carries no such metric"):
+        ckpt.save(_tiny_state(1.0, 1), metrics={"loss": 0.5})
+    ckpt.close()
+
+
+def test_experiment_passes_metrics_to_best_checkpointing(tmp_path):
+    """End-to-end: a TrainingExperiment with keep_best_metric ranks epoch
+    saves by validation accuracy without erroring."""
+    exp = make_experiment(
+        tmp_path,
+        {"checkpointer.keep_best_metric": "accuracy"},
+    )
+    exp.run()
+    assert exp.checkpointer.best_step() is not None
+    exp.checkpointer.close()

@@ -43,6 +43,18 @@ class Checkpointer:
     restore: bool = Field(True)
     #: Block on save (tests); async otherwise.
     synchronous: bool = Field(False)
+    #: Keras ``ModelCheckpoint(save_best_only=...)`` capability: retention
+    #: ranks checkpoints by this metric (a key of the metrics dict passed
+    #: to ``save`` — the experiment passes validation metrics when a
+    #: validation split exists, else train epoch metrics, so "accuracy" /
+    #: "loss" are the usual choices). ``max_to_keep`` then keeps the BEST
+    #: N instead of the latest N. Crash resume restores the LATEST kept
+    #: step (training continuity; may be earlier than the last step
+    #: trained when retention dropped it); use ``best_step()`` to locate
+    #: the best model for evaluation/export.
+    keep_best_metric: Optional[str] = Field(None)
+    #: "max" (accuracy-like) or "min" (loss-like).
+    best_mode: str = Field("max")
 
     @property
     def enabled(self) -> bool:
@@ -52,9 +64,25 @@ class Checkpointer:
         import orbax.checkpoint as ocp
 
         if getattr(self, "_mgr", None) is None:
+            best = {}
+            if self.keep_best_metric is not None:
+                if self.best_mode not in ("max", "min"):
+                    raise ValueError(
+                        f"best_mode={self.best_mode!r} unknown; "
+                        "choose max/min."
+                    )
+                metric = self.keep_best_metric
+                best = dict(
+                    best_fn=lambda m: float(m[metric]),
+                    best_mode=self.best_mode,
+                    # A metric-less save would be unrankable and pinned
+                    # forever; with best-ranking on, every save must rank.
+                    keep_checkpoints_without_metrics=False,
+                )
             options = ocp.CheckpointManagerOptions(
                 max_to_keep=self.max_to_keep,
                 enable_async_checkpointing=not self.synchronous,
+                **best,
             )
             path = os.path.abspath(os.path.expanduser(self.directory))
             os.makedirs(path, exist_ok=True)
@@ -63,15 +91,32 @@ class Checkpointer:
             )
         return self._mgr
 
-    def save(self, state: Any, *, step: Optional[int] = None) -> bool:
+    def save(
+        self,
+        state: Any,
+        *,
+        step: Optional[int] = None,
+        metrics: Optional[dict] = None,
+    ) -> bool:
         if not self.enabled:
             return False
         import jax
         import orbax.checkpoint as ocp
 
+        if self.keep_best_metric is not None:
+            if not metrics or self.keep_best_metric not in metrics:
+                raise ValueError(
+                    f"keep_best_metric={self.keep_best_metric!r} but this "
+                    "save carries no such metric "
+                    f"(got {sorted(metrics or {})}). Pass metrics= to "
+                    "save(), or unset keep_best_metric."
+                )
+            metrics = {k: float(v) for k, v in metrics.items()}
         step = int(jax.device_get(state.step)) if step is None else int(step)
         saved = self._manager().save(
-            step, args=ocp.args.StandardSave(_state_pytree(state))
+            step,
+            args=ocp.args.StandardSave(_state_pytree(state)),
+            metrics=metrics,
         )
         return bool(saved)
 
@@ -79,6 +124,13 @@ class Checkpointer:
         if not self.enabled:
             return None
         return self._manager().latest_step()
+
+    def best_step(self) -> Optional[int]:
+        """Best saved step per ``keep_best_metric`` (None when best
+        ranking is off or nothing ranked yet)."""
+        if not self.enabled or self.keep_best_metric is None:
+            return None
+        return self._manager().best_step()
 
     def restore_state(self, state: Any) -> Any:
         """Restore the latest checkpoint into (a copy of) ``state``;

@@ -288,7 +288,13 @@ class TrainingExperiment(Experiment):
                     self.checkpointer.enabled
                     and (epoch + 1) % self.checkpointer.save_every_epochs == 0
                 ):
-                    self.checkpointer.save(state)
+                    # Best-checkpoint ranking (keep_best_metric) scores
+                    # validation metrics when a split exists, else train
+                    # epoch metrics.
+                    save_metrics = epoch_metrics
+                    if self.validate and history["validation"]:
+                        save_metrics = history["validation"][-1] or epoch_metrics
+                    self.checkpointer.save(state, metrics=save_metrics)
 
         finally:
             # Crash-safe teardown: pending async checkpoint saves
