@@ -22,6 +22,7 @@ count.
 """
 
 import json
+import os
 import time
 
 # Measured on this machine's v5e chip (BASELINE.md round-2 re-measurement:
@@ -31,6 +32,40 @@ import time
 BF16_PEAK_FLOPS = 184e12
 
 
+def resolve_bench_config(env=None):
+    """Bench workload from ZK_BENCH_* env overrides. The default (no
+    overrides) is the north-star config the driver runs: QuickNet-Large,
+    batch 128, int8 binary convs (BASELINE.md round-3 sweep: the per-chip
+    sweet spot — 75% MFU vs 64% for batch-256 bf16-mxu; int8 is bit-exact
+    vs the mxu path, so this changes nothing but speed). Overrides record
+    the other acceptance configs (ResNet50 bf16 — BASELINE config #5,
+    BinaryAlexNet — config #2) with the same harness.
+
+    Returns ``(model, model_name, batch_size, binary_compute)`` with the
+    model configured; ``binary_compute`` is None for fp models (no binary
+    path to select).
+    """
+    from zookeeper_tpu import models as zoo
+    from zookeeper_tpu.core import configure
+
+    env = os.environ if env is None else env
+    model_name = env.get("ZK_BENCH_MODEL", "QuickNetLarge")
+    batch_size = int(env.get("ZK_BENCH_BATCH", "128"))
+    binary_compute = env.get("ZK_BENCH_BINARY_COMPUTE", "int8")
+
+    model_cls = getattr(zoo, model_name, None)
+    if model_cls is None:
+        raise ValueError(f"ZK_BENCH_MODEL={model_name!r} is not in the zoo.")
+    model = model_cls()
+    conf = {"compute_dtype": "bfloat16"}
+    if "binary_compute" in type(model).__component_fields__:
+        conf["binary_compute"] = binary_compute
+    else:
+        binary_compute = None
+    configure(model, conf, name="model")
+    return model, model_name, batch_size, binary_compute
+
+
 def main():
     import jax
     import jax.numpy as jnp
@@ -38,23 +73,12 @@ def main():
     import optax
 
     from zookeeper_tpu.core import configure
-    from zookeeper_tpu.models import QuickNetLarge
     from zookeeper_tpu.parallel import DataParallelPartitioner
     from zookeeper_tpu.training import TrainState, make_train_step
 
     input_shape = (224, 224, 3)
     num_classes = 1000
-    # Round-3 sweep (BASELINE.md): batch 128 + int8 binary convs is the
-    # per-chip sweet spot (75% MFU vs 64% for batch-256 bf16-mxu); int8
-    # is bit-exact vs the mxu path, so this changes nothing but speed.
-    batch_size = 128
-
-    model = QuickNetLarge()
-    configure(
-        model,
-        {"compute_dtype": "bfloat16", "binary_compute": "int8"},
-        name="model",
-    )
+    model, model_name, batch_size, binary_compute = resolve_bench_config()
     module = model.build(input_shape, num_classes=num_classes)
     params, model_state = model.initialize(module, input_shape)
     state = TrainState.create(
@@ -133,9 +157,9 @@ def main():
         cost = None
 
     extras = {
-        "model": "QuickNetLarge",
+        "model": model_name,
         "batch_size": batch_size,
-        "binary_compute": "int8",
+        "binary_compute": binary_compute,
         "step_time_ms": round(step_time * 1e3, 2),
         "n_chips": n_chips,
     }
@@ -147,10 +171,18 @@ def main():
     else:
         vs_baseline = -1.0  # cost analysis unavailable; MFU unknown
 
+    # Stable name for the default north-star run (continuity across
+    # BENCH_r*.json); other models get a lowercased variant.
+    metric_model = {
+        "QuickNetLarge": "quicknet_large",
+        "QuickNet": "quicknet",
+        "ResNet50": "resnet50",
+        "BinaryAlexNet": "binary_alexnet",
+    }.get(model_name, model_name.lower())
     print(
         json.dumps(
             {
-                "metric": "quicknet_large_train_images_per_sec_per_chip",
+                "metric": f"{metric_model}_train_images_per_sec_per_chip",
                 "value": round(images_per_sec_per_chip, 1),
                 "unit": "images/sec/chip",
                 "vs_baseline": vs_baseline,

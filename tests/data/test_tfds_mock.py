@@ -43,7 +43,10 @@ class _FakeSource:
 def fake_tfds(monkeypatch):
     sources = {}
 
-    def data_source(name, split=None, data_dir=None):
+    seen_decoders = {}
+
+    def data_source(name, split=None, data_dir=None, **kwargs):
+        seen_decoders[(name, split)] = kwargs.get("decoders")
         key = (name, split)
         if key not in sources:
             n = {"train": 64, "validation": 16}.get(split, 8)
@@ -71,6 +74,7 @@ def fake_tfds(monkeypatch):
     module.data_source = data_source
     module.builder = lambda name, data_dir=None: _Builder()
     monkeypatch.setitem(sys.modules, "tensorflow_datasets", module)
+    sources["_decoders"] = seen_decoders
     return sources
 
 
@@ -154,3 +158,20 @@ def test_tfds_missing_dependency_error_is_actionable(monkeypatch):
     configure(ds, {"name": "whatever"}, name="ds")
     with pytest.raises(ImportError, match="MemmapDataset"):
         ds.train()
+
+
+def test_tfds_load_passes_decoders_through(fake_tfds):
+    """The reference ``load(split, decoders)`` capability: decoders reach
+    tfds.data_source (e.g. SkipDecoding to defer JPEG decode), and are
+    omitted entirely when not given (older-tfds compatibility)."""
+    from zookeeper_tpu.core import configure
+    from zookeeper_tpu.data import TFDSDataset
+
+    ds = TFDSDataset()
+    configure(ds, {"name": "fake1"}, name="ds")
+    ds.load("train")
+    assert fake_tfds["_decoders"][("fake1", "train")] is None
+
+    marker = {"image": "skip-decoding-marker"}
+    ds.load("train", decoders=marker)
+    assert fake_tfds["_decoders"][("fake1", "train")] == marker

@@ -1,0 +1,50 @@
+"""Repo-root script contracts (bench.py): pure-logic checks that the
+driver-facing entry points resolve their configuration correctly without
+needing TPU hardware."""
+
+import os
+import sys
+
+import pytest
+
+REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+def _resolve_bench_config():
+    sys.path.insert(0, REPO_ROOT)
+    try:
+        from bench import resolve_bench_config
+
+        return resolve_bench_config
+    finally:
+        sys.path.pop(0)
+
+
+def test_bench_config_resolution():
+    """bench.py's env-override resolution: the driver's default is the
+    north-star config; overrides select other acceptance-config models,
+    with binary_compute applied only where the model has the field."""
+    resolve_bench_config = _resolve_bench_config()
+
+    model, name, batch, bc = resolve_bench_config(env={})
+    assert (name, batch, bc) == ("QuickNetLarge", 128, "int8")
+    assert model.compute_dtype == "bfloat16"
+
+    model, name, batch, bc = resolve_bench_config(
+        env={"ZK_BENCH_MODEL": "ResNet50", "ZK_BENCH_BATCH": "256"}
+    )
+    assert (name, batch) == ("ResNet50", 256)
+    assert bc is None  # fp model: no binary path field
+
+    model, name, batch, bc = resolve_bench_config(
+        env={
+            "ZK_BENCH_MODEL": "BinaryAlexNet",
+            "ZK_BENCH_BINARY_COMPUTE": "mxu",
+        }
+    )
+    assert (name, bc) == ("BinaryAlexNet", "mxu")
+
+    with pytest.raises(ValueError, match="not in the zoo"):
+        resolve_bench_config(env={"ZK_BENCH_MODEL": "NoSuchNet"})

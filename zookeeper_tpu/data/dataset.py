@@ -351,9 +351,18 @@ class _TFDSSource(DataSource):
     disk with O(1) resident memory (the VERDICT round-1 fix: the old
     fallback did ``list(tfds.as_numpy(ds))``, impossible at scale)."""
 
-    def __init__(self, name: str, split: str, data_dir: Optional[str]):
+    def __init__(
+        self,
+        name: str,
+        split: str,
+        data_dir: Optional[str],
+        decoders=None,
+    ):
         tfds = _require_tfds()
-        self._source = tfds.data_source(name, split=split, data_dir=data_dir)
+        kwargs = {"decoders": decoders} if decoders is not None else {}
+        self._source = tfds.data_source(
+            name, split=split, data_dir=data_dir, **kwargs
+        )
 
     def __len__(self) -> int:
         return len(self._source)
@@ -376,8 +385,13 @@ class TFDSDataset(Dataset):
     #: -1 = read from the TFDS builder's feature metadata.
     num_classes: int = Field(-1)
 
-    def load(self, split: str) -> DataSource:
-        return _TFDSSource(self.name, split, self.data_dir)
+    def load(self, split: str, decoders=None) -> DataSource:
+        """Load a TFDS split as a streaming source. ``decoders`` passes
+        through to ``tfds.data_source`` (reference ``load(split,
+        decoders)`` capability — e.g. ``{"image":
+        tfds.decode.SkipDecoding()}`` to defer JPEG decode to
+        preprocessing)."""
+        return _TFDSSource(self.name, split, self.data_dir, decoders)
 
     def train(self) -> DataSource:
         return self.load(self.train_split)

@@ -11,12 +11,14 @@ no hand-written communication layer, by design.
 
 from zookeeper_tpu.parallel.partitioner import (
     DataParallelPartitioner,
+    FsdpPartitioner,
     MeshPartitioner,
     Partitioner,
     SingleDevicePartitioner,
 )
 from zookeeper_tpu.parallel.rules import (
     PartitionRule,
+    auto_fsdp_rules,
     conv_model_tp_rules,
     match_partition_rules,
 )
@@ -28,6 +30,8 @@ from zookeeper_tpu.parallel.distributed import (
 __all__ = [
     "DataParallelPartitioner",
     "DistributedRuntime",
+    "FsdpPartitioner",
+    "auto_fsdp_rules",
     "MeshPartitioner",
     "Partitioner",
     "PartitionRule",

@@ -187,8 +187,13 @@ class MeshPartitioner(Partitioner):
         sharded optimizers need. Unmatched leaves (step, batch_stats,
         counters) replicate.
         """
+        return self._sharding_from_rules(state, self.rules)
+
+    def _sharding_from_rules(
+        self, state: Any, rules: Sequence[PartitionRule]
+    ) -> Any:
         mesh = self.mesh
-        specs = match_partition_rules(self.rules, state)
+        specs = match_partition_rules(rules, state)
         return jax.tree.map(lambda s: NamedSharding(mesh, s), specs)
 
     def shard_state(self, state: Any) -> Any:
@@ -224,3 +229,40 @@ class MeshPartitioner(Partitioner):
 class DataParallelPartitioner(MeshPartitioner):
     """Pure DP: 1-D mesh, batch on 'data', everything replicated (the
     MeshPartitioner defaults, under the name users reach for)."""
+
+
+@component
+class FsdpPartitioner(MeshPartitioner):
+    """Turnkey FSDP: 1-D mesh, batch AND large weights sharded over the
+    same ``fsdp`` axis (ZeRO-3-style — see
+    :func:`zookeeper_tpu.parallel.rules.auto_fsdp_rules`). Per-device
+    param + optimizer memory drops ~N-fold for the sharded weights; XLA
+    inserts the per-layer weight all-gathers and gradient
+    reduce-scatters over ICI. Explicit ``with_rules`` overrides the
+    auto-generated layout.
+    """
+
+    mesh_shape: Sequence[int] = Field((-1,))
+    mesh_axes: Sequence[str] = Field(("fsdp",))
+    data_axes: Sequence[str] = Field(("fsdp",))
+    #: Parameters below this many ELEMENTS replicate (biases, BN):
+    #: sharding tiny tensors costs more collective latency than it saves.
+    min_weight_size: int = Field(2**15)
+
+    def state_sharding(self, state: Any) -> Any:
+        # An explicit with_rules (even an empty list = replicate all)
+        # always wins; otherwise rules derive from THIS state's params on
+        # every call — no caching, so reusing one partitioner across
+        # differently-shaped states cannot silently apply stale rules.
+        if getattr(self, "_rules_override", None) is not None:
+            return super().state_sharding(state)
+        from zookeeper_tpu.parallel.rules import auto_fsdp_rules
+
+        axis = tuple(self.mesh_axes)[0]
+        rules = auto_fsdp_rules(
+            state.params,
+            axis_size=self.mesh.shape[axis],
+            fsdp_axis=axis,
+            min_weight_size=self.min_weight_size,
+        )
+        return self._sharding_from_rules(state, rules)

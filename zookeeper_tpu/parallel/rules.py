@@ -48,6 +48,54 @@ def conv_model_tp_rules(model_axis: str = "model") -> List[PartitionRule]:
     ]
 
 
+def auto_fsdp_rules(
+    params: Any,
+    axis_size: int,
+    fsdp_axis: str = "fsdp",
+    min_weight_size: int = 2**15,
+) -> List[PartitionRule]:
+    """Generate ZeRO-3-style weight-sharding rules from a params tree.
+
+    Each parameter with at least ``min_weight_size`` elements shards its
+    largest ``axis_size``-divisible dimension over ``fsdp_axis`` (ties
+    prefer the trailing dim — output features, matching the TP layout
+    convention); everything smaller (biases, BN) replicates. Rules are
+    suffix-anchored on the params-relative path, so optimizer moments and
+    EMA copies co-shard with their parameter automatically.
+
+    This is the standard JAX FSDP recipe (scaling-book style): with the
+    batch sharded over the SAME mesh axis, XLA all-gathers each layer's
+    weights on use (fwd + bwd) and reduce-scatters its gradients —
+    per-device param/optimizer memory drops ~axis_size-fold for the
+    sharded weights, paid for with weight all-gather traffic over ICI.
+    """
+    from math import prod
+
+    from flax import traverse_util
+
+    flat = traverse_util.flatten_dict(params, sep="/")
+    rules: List[PartitionRule] = []
+    for path, leaf in flat.items():
+        shape = tuple(getattr(leaf, "shape", ()))
+        size = prod(shape) if shape else 0
+        if size < min_weight_size:
+            continue
+        best = None
+        for i, d in enumerate(shape):
+            if d % axis_size == 0 and (best is None or d >= shape[best]):
+                best = i
+        if best is None:
+            continue
+        spec = PartitionSpec(
+            *[fsdp_axis if i == best else None for i in range(len(shape))]
+        )
+        # Left segment boundary: without it, re.search would let e.g.
+        # "Dense_0/kernel$" capture "QuantDense_0/kernel" (first match
+        # wins), applying the wrong spec.
+        rules.append(((r"(^|/)" + re.escape(path) + "$"), spec))
+    return rules
+
+
 def _path_str(path) -> str:
     parts = []
     for p in path:
