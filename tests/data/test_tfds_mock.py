@@ -46,7 +46,9 @@ def fake_tfds(monkeypatch):
     seen_decoders = {}
 
     def data_source(name, split=None, data_dir=None, **kwargs):
-        seen_decoders[(name, split)] = kwargs.get("decoders")
+        # Sentinel distinguishes "kwarg omitted" (older-tfds compat) from
+        # an explicit decoders=None.
+        seen_decoders[(name, split)] = kwargs.get("decoders", "<omitted>")
         key = (name, split)
         if key not in sources:
             n = {"train": 64, "validation": 16}.get(split, 8)
@@ -170,7 +172,7 @@ def test_tfds_load_passes_decoders_through(fake_tfds):
     ds = TFDSDataset()
     configure(ds, {"name": "fake1"}, name="ds")
     ds.load("train")
-    assert fake_tfds["_decoders"][("fake1", "train")] is None
+    assert fake_tfds["_decoders"][("fake1", "train")] == "<omitted>"
 
     marker = {"image": "skip-decoding-marker"}
     ds.load("train", decoders=marker)

@@ -562,3 +562,26 @@ def test_fsdp_explicit_empty_rules_and_no_stale_cache():
         not leaf.sharding.is_fully_replicated
         for leaf in jax.tree.leaves(s2.params)
     )
+
+
+def test_auto_fsdp_rules_nested_scope_not_captured_by_root_suffix():
+    """A nested param whose path ENDS with another param's full path
+    ('Head_0/Dense_0/kernel' vs root 'Dense_0/kernel') must get its own
+    (replicate) rule, not the big root param's sharded spec."""
+    from zookeeper_tpu.parallel import auto_fsdp_rules
+
+    params = {
+        "Dense_0": {"kernel": np.zeros((256, 512))},
+        "Head_0": {"Dense_0": {"kernel": np.zeros((8, 3))}},
+    }
+    rules = auto_fsdp_rules(params, axis_size=8, min_weight_size=1024)
+    specs = match_partition_rules(rules, {"params": params})
+    assert specs["params"]["Dense_0"]["kernel"] == PartitionSpec(None, "fsdp")
+    assert specs["params"]["Head_0"]["Dense_0"]["kernel"] == PartitionSpec()
+    # Optimizer-moment co-sharding still works for both depths.
+    specs_mu = match_partition_rules(
+        rules, {"opt_state": {"0": {"mu": params}}}
+    )
+    mu = specs_mu["opt_state"]["0"]["mu"]
+    assert mu["Dense_0"]["kernel"] == PartitionSpec(None, "fsdp")
+    assert mu["Head_0"]["Dense_0"]["kernel"] == PartitionSpec()

@@ -364,3 +364,61 @@ def test_eval_experiment_full_coverage_and_train_split(tmp_path):
 
     with _pytest.raises(ValueError, match="split"):
         ev_bad.run()
+
+
+def test_early_stopping_halts_on_plateau():
+    """Keras EarlyStopping capability: an impossible min_delta means no
+    epoch ever 'improves', so training stops after exactly
+    1 (baseline) + patience epochs instead of running all 10."""
+    exp = make_experiment(
+        {
+            "epochs": 10,
+            "steps_per_epoch": 2,
+            "early_stop_metric": "loss",
+            "early_stop_patience": 2,
+            "early_stop_min_delta": 1e9,
+        }
+    )
+    history = exp.run()
+    assert len(history["train"]) == 3  # baseline epoch + 2 stale epochs
+
+
+def test_early_stopping_runs_to_completion_when_improving():
+    exp = make_experiment(
+        {
+            "epochs": 3,
+            "steps_per_epoch": 4,
+            "early_stop_metric": "accuracy",
+            "early_stop_patience": 3,
+        }
+    )
+    history = exp.run()
+    assert len(history["train"]) == 3
+
+
+def test_early_stopping_unknown_metric_raises():
+    exp = make_experiment(
+        {"epochs": 2, "steps_per_epoch": 1, "early_stop_metric": "f1"}
+    )
+    with pytest.raises(ValueError, match="not in epoch metrics"):
+        exp.run()
+
+
+def test_early_stopping_bad_mode_rejected():
+    exp = make_experiment({"early_stop_mode": "upwards"})
+    with pytest.raises(ValueError, match="early_stop_mode"):
+        exp.run()
+
+
+def test_print_model_summary_runs(capsys):
+    exp = make_experiment(
+        {
+            "epochs": 1,
+            "steps_per_epoch": 1,
+            "verbose": True,
+            "print_model_summary": True,
+        }
+    )
+    exp.run()
+    out = capsys.readouterr().out
+    assert "params" in out and "Dense_0/kernel" in out

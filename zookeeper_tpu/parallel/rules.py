@@ -78,21 +78,29 @@ def auto_fsdp_rules(
     for path, leaf in flat.items():
         shape = tuple(getattr(leaf, "shape", ()))
         size = prod(shape) if shape else 0
-        if size < min_weight_size:
-            continue
-        best = None
-        for i, d in enumerate(shape):
-            if d % axis_size == 0 and (best is None or d >= shape[best]):
-                best = i
-        if best is None:
-            continue
-        spec = PartitionSpec(
-            *[fsdp_axis if i == best else None for i in range(len(shape))]
-        )
-        # Left segment boundary: without it, re.search would let e.g.
-        # "Dense_0/kernel$" capture "QuantDense_0/kernel" (first match
-        # wins), applying the wrong spec.
+        spec = PartitionSpec()
+        if size >= min_weight_size:
+            best = None
+            for i, d in enumerate(shape):
+                if d % axis_size == 0 and (best is None or d >= shape[best]):
+                    best = i
+            if best is not None:
+                spec = PartitionSpec(
+                    *[
+                        fsdp_axis if i == best else None
+                        for i in range(len(shape))
+                    ]
+                )
+        # EVERY param gets its own explicit rule (small ones an explicit
+        # replicate), and rules sort deepest-first below: a nested path
+        # like "Head_0/Dense_0/kernel" then always hits its own rule
+        # before a shallower param's suffix rule ("Dense_0/kernel") could
+        # capture it. The (^|/) left boundary blocks same-segment prefix
+        # capture ("QuantDense_0" vs "Dense_0").
         rules.append(((r"(^|/)" + re.escape(path) + "$"), spec))
+    # Deepest-first: a path is never shadowed by a strict suffix of
+    # itself (which necessarily has fewer segments).
+    rules.sort(key=lambda r: -r[0].count("/"))
     return rules
 
 

@@ -114,6 +114,20 @@ class TrainingExperiment(Experiment):
     #: Rematerialization policy ("none"/"dots"/"full"): trade backward
     #: recompute for activation HBM (see make_train_step).
     remat: str = Field("none")
+    #: Keras ``EarlyStopping`` capability: stop when this metric (scored
+    #: on validation metrics when a split exists, else train epoch
+    #: metrics — the keep_best_metric convention) fails to improve by
+    #: ``early_stop_min_delta`` for ``early_stop_patience`` consecutive
+    #: epochs. None disables.
+    early_stop_metric: Optional[str] = Field(None)
+    early_stop_patience: int = Field(3)
+    early_stop_min_delta: float = Field(0.0)
+    #: "auto" infers direction from the name ("loss" -> min, else max);
+    #: or explicit "min"/"max".
+    early_stop_mode: str = Field("auto")
+    #: Print the quantization-aware parameter summary (per-layer bits,
+    #: deployment memory — models.summary) before training.
+    print_model_summary: bool = Field(False)
 
     @Field
     def num_classes(self) -> int:
@@ -183,7 +197,24 @@ class TrainingExperiment(Experiment):
             raise ValueError(
                 f"remat={self.remat!r} unknown; choose none/dots/full."
             )
+        if self.early_stop_mode not in ("auto", "min", "max"):
+            raise ValueError(
+                f"early_stop_mode={self.early_stop_mode!r} unknown; "
+                "choose auto/min/max."
+            )
         self._log(pretty_print(self))
+        if self.print_model_summary:
+            from zookeeper_tpu.models.summary import model_summary
+
+            input_shape = self.loader.preprocessing.input_shape
+            self._log(
+                str(
+                    model_summary(
+                        self.model.build(input_shape, self.num_classes),
+                        input_shape,
+                    )
+                )
+            )
         self.runtime.initialize()  # Multi-host bootstrap; no-op single host.
         partitioner = self.partitioner
         partitioner.setup()
@@ -203,6 +234,13 @@ class TrainingExperiment(Experiment):
                 f"{int(jax.device_get(state.step))} (epoch {start_epoch})"
             )
         history: Dict[str, List[Dict[str, float]]] = {"train": [], "validation": []}
+        es_best: Optional[float] = None
+        es_stale = 0
+        es_minimize = self.early_stop_mode == "min" or (
+            self.early_stop_mode == "auto"
+            and self.early_stop_metric is not None
+            and "loss" in self.early_stop_metric
+        )
         try:
             for epoch in range(start_epoch, self.epochs):
                 t0 = time.perf_counter()
@@ -284,17 +322,44 @@ class TrainingExperiment(Experiment):
                     )
                 self.writer.write_scalars((epoch + 1) * spe, scalars)
 
+                # The epoch's scored metrics — validation when a split
+                # exists, else train — shared by best-checkpoint ranking
+                # and early stopping so the two can never diverge on what
+                # they score.
+                scored = epoch_metrics
+                if self.validate and history["validation"]:
+                    scored = history["validation"][-1] or epoch_metrics
+
                 if (
                     self.checkpointer.enabled
                     and (epoch + 1) % self.checkpointer.save_every_epochs == 0
                 ):
-                    # Best-checkpoint ranking (keep_best_metric) scores
-                    # validation metrics when a split exists, else train
-                    # epoch metrics.
-                    save_metrics = epoch_metrics
-                    if self.validate and history["validation"]:
-                        save_metrics = history["validation"][-1] or epoch_metrics
-                    self.checkpointer.save(state, metrics=save_metrics)
+                    self.checkpointer.save(state, metrics=scored)
+
+                if self.early_stop_metric is not None:
+                    if self.early_stop_metric not in scored:
+                        raise ValueError(
+                            f"early_stop_metric={self.early_stop_metric!r} "
+                            f"not in epoch metrics {sorted(scored)}."
+                        )
+                    current = float(scored[self.early_stop_metric])
+                    improved = es_best is None or (
+                        es_best - current > self.early_stop_min_delta
+                        if es_minimize
+                        else current - es_best > self.early_stop_min_delta
+                    )
+                    if improved:
+                        es_best, es_stale = current, 0
+                    else:
+                        es_stale += 1
+                        if es_stale >= self.early_stop_patience:
+                            self._log(
+                                f"early stop at epoch {epoch + 1}: "
+                                f"{self.early_stop_metric} has not improved "
+                                f"for {es_stale} epoch(s) "
+                                f"(best {es_best:.6g})"
+                            )
+                            break
 
         finally:
             # Crash-safe teardown: pending async checkpoint saves
