@@ -134,7 +134,7 @@ def main():
     # jitter spike landed on a short chain.
     n1, n2 = 5, 25
     t1_min = t2_min = None
-    for _ in range(5):  # More rounds = better minima vs tunnel jitter.
+    for _ in range(8):  # More rounds = better minima vs tunnel jitter.
         t1, state = run_chain(n1, state)
         t2, state = run_chain(n2, state)
         t1_min = t1 if t1_min is None else min(t1_min, t1)

@@ -173,3 +173,25 @@ def test_experiment_passes_metrics_to_best_checkpointing(tmp_path):
     exp.run()
     assert exp.checkpointer.best_step() is not None
     exp.checkpointer.close()
+
+
+def test_keep_best_rank_saves_only_on_validated_epochs(tmp_path):
+    """With keep_best_metric + validate_every=2, non-validation epochs
+    must not rank-save (train metrics are not comparable to val metrics
+    on one scale): only validated epochs appear in the manager."""
+    exp = make_experiment(
+        tmp_path,
+        {
+            "epochs": 4,
+            "steps_per_epoch": 2,
+            "validate_every": 2,
+            "checkpointer.keep_best_metric": "accuracy",
+            "checkpointer.max_to_keep": 10,
+        },
+    )
+    exp.run()
+    mgr = exp.checkpointer._manager()
+    steps = sorted(mgr.all_steps())
+    # Saves at the end of epochs 2 and 4 only (2 steps/epoch -> 4, 8).
+    assert steps == [4, 8]
+    exp.checkpointer.close()

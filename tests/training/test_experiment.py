@@ -422,3 +422,41 @@ def test_print_model_summary_runs(capsys):
     exp.run()
     out = capsys.readouterr().out
     assert "params" in out and "Dense_0/kernel" in out
+
+
+def test_validate_every_cadence():
+    """Keras validation_freq capability: validation runs every N epochs;
+    best-checkpoint/early-stop scoring uses the latest (possibly stale)
+    validation metrics."""
+    exp = make_experiment(
+        {"epochs": 4, "steps_per_epoch": 2, "validate_every": 2}
+    )
+    history = exp.run()
+    assert len(history["train"]) == 4
+    assert len(history["validation"]) == 2
+
+
+def test_validate_every_does_not_burn_early_stop_patience():
+    """Skipped-validation epochs must not tick early-stop patience: with
+    validate_every=5 and patience=3 over 10 epochs, only epochs 5 and 10
+    are scored, so a never-improving metric still cannot stop before
+    epoch 10 (two scored epochs < patience 3)."""
+    exp = make_experiment(
+        {
+            "epochs": 10,
+            "steps_per_epoch": 1,
+            "validate_every": 5,
+            "early_stop_metric": "loss",
+            "early_stop_patience": 3,
+            "early_stop_min_delta": 1e9,
+        }
+    )
+    history = exp.run()
+    assert len(history["train"]) == 10
+    assert len(history["validation"]) == 2
+
+
+def test_validate_every_zero_rejected():
+    exp = make_experiment({"validate_every": 0})
+    with pytest.raises(ValueError, match="validate_every"):
+        exp.run()

@@ -89,6 +89,13 @@ class TrainingExperiment(Experiment):
     #: Cap on steps per epoch (smoke tests / benchmarking); -1 = full epoch.
     steps_per_epoch: int = Field(-1)
     validate: bool = Field(True)
+    #: Epochs between validations (Keras ``validation_freq`` capability):
+    #: validation runs on epochs where ``(epoch + 1) % validate_every ==
+    #: 0``. On skipped epochs nothing validation-derived happens: no
+    #: val_* records/scalars, no best-checkpoint rank-save, no early-stop
+    #: patience tick — stale metrics are never re-emitted or re-scored
+    #: (early-stop patience therefore counts VALIDATED epochs).
+    validate_every: int = Field(1)
     log_every: int = Field(0)  # Steps between progress lines; 0 = epoch only.
     verbose: bool = Field(True)
     #: Legacy epoch-record JSONL (``{"epoch": N, ..., "val_*": ...}``).
@@ -202,6 +209,14 @@ class TrainingExperiment(Experiment):
                 f"early_stop_mode={self.early_stop_mode!r} unknown; "
                 "choose auto/min/max."
             )
+        if self.validate_every < 1:
+            # Fail fast rather than guess: 0 commonly means "disable" in
+            # every-N conventions, but validate=False is the explicit
+            # switch for that here.
+            raise ValueError(
+                f"validate_every={self.validate_every} must be >= 1; "
+                "set validate=False to disable validation."
+            )
         self._log(pretty_print(self))
         if self.print_model_summary:
             from zookeeper_tpu.models.summary import model_summary
@@ -234,6 +249,11 @@ class TrainingExperiment(Experiment):
                 f"{int(jax.device_get(state.step))} (epoch {start_epoch})"
             )
         history: Dict[str, List[Dict[str, float]]] = {"train": [], "validation": []}
+        # One presence probe, not one per epoch: dataset.validation()
+        # may construct a real source (e.g. a TFDS reader).
+        has_val_split = self.validate and (
+            self.loader.dataset.validation() is not None
+        )
         es_best: Optional[float] = None
         es_stale = 0
         es_minimize = self.early_stop_mode == "min" or (
@@ -291,23 +311,30 @@ class TrainingExperiment(Experiment):
                     f"({epoch_metrics['examples_per_sec']:.0f} ex/s)"
                 )
 
-                if self.validate and self.loader.dataset.validation() is not None:
+                # vmetrics is non-None only when validation RAN this
+                # epoch (and produced batches): val_* records/scalars,
+                # best-checkpoint ranking, and early stopping all key off
+                # fresh measurements — stale values are never re-emitted
+                # or re-scored.
+                vmetrics = None
+                if has_val_split and (epoch + 1) % self.validate_every == 0:
                     vmetrics = run_weighted_eval(
                         self.loader, "validation", eval_step, state,
                         batch_sharding, epoch=epoch,
-                    )
-                    history["validation"].append(vmetrics)
-                    line += (
-                        f" | val_loss={vmetrics.get('loss', float('nan')):.4f} "
-                        f"val_acc={vmetrics.get('accuracy', float('nan')):.4f}"
-                    )
+                    ) or None
+                    if vmetrics is not None:
+                        history["validation"].append(vmetrics)
+                        line += (
+                            f" | val_loss={vmetrics.get('loss', float('nan')):.4f} "
+                            f"val_acc={vmetrics.get('accuracy', float('nan')):.4f}"
+                        )
                 self._log(line)
 
                 if self.metrics_file:
                     record = {"epoch": epoch, **epoch_metrics}
-                    if history["validation"]:
+                    if vmetrics is not None:
                         record.update(
-                            {f"val_{k}": v for k, v in history["validation"][-1].items()}
+                            {f"val_{k}": v for k, v in vmetrics.items()}
                         )
                     with open(self.metrics_file, "a") as f:
                         f.write(json.dumps(record) + "\n")
@@ -316,27 +343,31 @@ class TrainingExperiment(Experiment):
                 # with the per-step train/ tags at the same global step (two
                 # different values on one TensorBoard tag renders as a zigzag).
                 scalars = {f"train_epoch/{k}": v for k, v in epoch_metrics.items()}
-                if self.validate and history["validation"]:
-                    scalars.update(
-                        {f"val/{k}": v for k, v in history["validation"][-1].items()}
-                    )
+                if vmetrics is not None:
+                    scalars.update({f"val/{k}": v for k, v in vmetrics.items()})
                 self.writer.write_scalars((epoch + 1) * spe, scalars)
 
-                # The epoch's scored metrics — validation when a split
-                # exists, else train — shared by best-checkpoint ranking
-                # and early stopping so the two can never diverge on what
-                # they score.
-                scored = epoch_metrics
-                if self.validate and history["validation"]:
-                    scored = history["validation"][-1] or epoch_metrics
+                # The epoch's scored metrics: fresh validation when it
+                # ran; train metrics only when the run HAS no validation
+                # (never mixed — train and val values are not on one
+                # scale). None = nothing scoreable this epoch.
+                scored = vmetrics if has_val_split else epoch_metrics
 
                 if (
                     self.checkpointer.enabled
                     and (epoch + 1) % self.checkpointer.save_every_epochs == 0
                 ):
-                    self.checkpointer.save(state, metrics=scored)
+                    if (
+                        self.checkpointer.keep_best_metric is not None
+                        and scored is None
+                    ):
+                        # Best-ranking needs fresh comparable metrics:
+                        # rank-saves happen on validated epochs only.
+                        pass
+                    else:
+                        self.checkpointer.save(state, metrics=scored)
 
-                if self.early_stop_metric is not None:
+                if self.early_stop_metric is not None and scored is not None:
                     if self.early_stop_metric not in scored:
                         raise ValueError(
                             f"early_stop_metric={self.early_stop_metric!r} "
@@ -356,7 +387,7 @@ class TrainingExperiment(Experiment):
                             self._log(
                                 f"early stop at epoch {epoch + 1}: "
                                 f"{self.early_stop_metric} has not improved "
-                                f"for {es_stale} epoch(s) "
+                                f"for {es_stale} scored epoch(s) "
                                 f"(best {es_best:.6g})"
                             )
                             break
