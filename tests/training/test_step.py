@@ -134,7 +134,7 @@ def test_remat_policies_match_no_remat_exactly():
         "target": jnp.asarray(rng.integers(0, 4, 4)),
     }
     base_state, base_metrics = jax.jit(make_train_step())(fresh_state(), batch)
-    for policy in ("dots", "full"):
+    for policy in ("dots", "full", "quant"):
         st, mt = jax.jit(make_train_step(remat=policy))(fresh_state(), batch)
         np.testing.assert_allclose(
             float(mt["loss"]), float(base_metrics["loss"]), rtol=1e-6

@@ -49,6 +49,16 @@ _PM1_INPUT_QUANTIZERS = frozenset({"ste_sign", "approx_sign", "swish_sign"})
 
 BINARY_COMPUTE_MODES = ("mxu", "int8", "xnor", "xnor_popcount")
 
+#: jax.ad_checkpoint name tagged on every quantized layer input — the
+#: anchor for the "quant" rematerialization policy
+#: (``jax.checkpoint_policies.save_only_these_names``): binarized
+#: activations are the cheapest tensors in a binary net worth saving
+#: (they reconstruct the conv backward directly), so saving ONLY them
+#: and recomputing BN/ReLU/shortcut intermediates is the binary-specific
+#: memory/recompute sweet spot. checkpoint_name is the identity outside
+#: a checkpointed scope — zero cost when remat is off.
+QUANT_ACT_CHECKPOINT_NAME = "quant_act"
+
 #: Flat param-path regex matching the latent sign-read kernels of the
 #: Quant* layers defined in this module (flax auto-names: "QuantConv_3").
 #: The single source of truth for "which params are binary" — the Bop
@@ -77,6 +87,13 @@ def _kernel_param_name(kernel_quantizer: Quantizer) -> str:
         if kernel_quantizer in _SIGN_KERNEL_QUANTIZERS
         else "kernel_fp"
     )
+
+
+def _tag_quant_act(x: jax.Array) -> jax.Array:
+    """Tag a quantized activation for the "quant" remat policy."""
+    from jax.ad_checkpoint import checkpoint_name
+
+    return checkpoint_name(x, QUANT_ACT_CHECKPOINT_NAME)
 
 
 def _int8_kernel_is_unscaled(kernel_quantizer: Quantizer) -> bool:
@@ -193,7 +210,7 @@ class QuantDense(nn.Module):
             jnp.float32,
         )
         if in_q is not None:
-            x = in_q(x)
+            x = _tag_quant_act(in_q(x))
         kernel = _apply_clip(kernel, self.kernel_clip)
         if k_q is not None:
             kernel = k_q(kernel)
@@ -318,7 +335,7 @@ class QuantConv(nn.Module):
                 jnp.float32,
             )
             if in_q is not None:
-                x = in_q(x)
+                x = _tag_quant_act(in_q(x))
             y = packed_conv_infer(
                 x, packed, kscale, tuple(self.strides), self.padding,
                 use_popcount=self.binary_compute == "xnor_popcount",
@@ -332,7 +349,7 @@ class QuantConv(nn.Module):
                 jnp.float32,
             )
             if in_q is not None:
-                x = in_q(x)
+                x = _tag_quant_act(in_q(x))
             kernel = _apply_clip(kernel, self.kernel_clip)
             if k_q is not None:
                 kernel = k_q(kernel)
@@ -463,7 +480,7 @@ class QuantConvND(nn.Module):
             jnp.float32,
         )
         if in_q is not None:
-            x = in_q(x)
+            x = _tag_quant_act(in_q(x))
         kernel = _apply_clip(kernel, self.kernel_clip)
         if k_q is not None:
             kernel = k_q(kernel)
@@ -571,7 +588,7 @@ class QuantConvTranspose(nn.Module):
             jnp.float32,
         )
         if in_q is not None:
-            x = in_q(x)
+            x = _tag_quant_act(in_q(x))
         kernel = _apply_clip(kernel, self.kernel_clip)
         if k_q is not None:
             kernel = k_q(kernel)

@@ -118,8 +118,10 @@ class TrainingExperiment(Experiment):
     #: export_model_to ships it. Standard for long binary-net recipes:
     #: late sign flips make raw weights oscillate; the average does not.
     ema_decay: float = Field(0.0)
-    #: Rematerialization policy ("none"/"dots"/"full"): trade backward
-    #: recompute for activation HBM (see make_train_step).
+    #: Rematerialization policy ("none"/"dots"/"full"/"quant"): trade
+    #: backward recompute for activation HBM (see make_train_step —
+    #: "quant" saves only the tagged binarized activations; measured
+    #: guidance in BASELINE.md says remat="none" for the conv zoo).
     remat: str = Field("none")
     #: Keras ``EarlyStopping`` capability: stop when this metric (scored
     #: on validation metrics when a split exists, else train epoch
@@ -199,10 +201,10 @@ class TrainingExperiment(Experiment):
                 "EMA; 1.0 would freeze the average at initialization "
                 "forever (common typo for 0.999)."
             )
-        if self.remat not in ("none", "dots", "full"):
+        if self.remat not in ("none", "dots", "full", "quant"):
             # Pure config: fail before device setup / checkpoint restore.
             raise ValueError(
-                f"remat={self.remat!r} unknown; choose none/dots/full."
+                f"remat={self.remat!r} unknown; choose none/dots/full/quant."
             )
         if self.early_stop_mode not in ("auto", "min", "max"):
             raise ValueError(

@@ -87,15 +87,23 @@ def make_train_step(
       "full" — dense/attention-heavy models are where it shines).
     - ``"full"``: save nothing from the forward; backward replays it
       (max memory savings, ~1 extra forward of compute).
+    - ``"quant"``: save ONLY the binarized activations the Quant* layers
+      tag (``ops.layers.QUANT_ACT_CHECKPOINT_NAME``); BN/ReLU/shortcut
+      intermediates recompute. NOTE (measured, BASELINE.md round 4): at
+      the north-star QuickNet-Large shapes XLA's own scheduling already
+      rematerializes conv nets so well that every policy's temp memory
+      is within ~1% of "none" — and "quant" lands ~25% HIGHER (the
+      pinned saves constrain fusion). Policies are exactness-preserving
+      (pinned by test); measure before relying on one.
     """
     flip_paths = None
     if flip_ratio_pattern is not None:
         import re
 
         flip_paths = re.compile(flip_ratio_pattern)
-    if remat not in ("none", "dots", "full"):
+    if remat not in ("none", "dots", "full", "quant"):
         raise ValueError(
-            f"Unknown remat policy {remat!r}; choose none/dots/full."
+            f"Unknown remat policy {remat!r}; choose none/dots/full/quant."
         )
 
     def train_step(state: TrainState, batch: Batch) -> Tuple[TrainState, Metrics]:
@@ -126,6 +134,15 @@ def make_train_step(
             )
         elif remat == "full":
             apply_model = jax.checkpoint(apply_model)
+        elif remat == "quant":
+            from zookeeper_tpu.ops.layers import QUANT_ACT_CHECKPOINT_NAME
+
+            apply_model = jax.checkpoint(
+                apply_model,
+                policy=jax.checkpoint_policies.save_only_these_names(
+                    QUANT_ACT_CHECKPOINT_NAME
+                ),
+            )
 
         def compute_loss(params):
             variables = {"params": params, **state.model_state}
