@@ -460,3 +460,24 @@ def test_validate_every_zero_rejected():
     exp = make_experiment({"validate_every": 0})
     with pytest.raises(ValueError, match="validate_every"):
         exp.run()
+
+
+def test_profile_dir_captures_trace(tmp_path):
+    """SURVEY §5 tracing row: profile_dir captures a jax.profiler trace
+    of steady-state steps (works on CPU; produces a perfetto/xplane
+    artifact under plugins/profile)."""
+    import os
+
+    profile_dir = str(tmp_path / "trace")
+    exp = make_experiment(
+        {
+            "epochs": 1,
+            "steps_per_epoch": 6,
+            "profile_dir": profile_dir,
+        }
+    )
+    exp.run()
+    found = []
+    for root, _dirs, files in os.walk(profile_dir):
+        found.extend(os.path.join(root, f) for f in files)
+    assert found, f"no profiler artifacts under {profile_dir}"

@@ -27,7 +27,31 @@ from zookeeper_tpu.core import (
     task,
 )
 
-__version__ = "0.1.0"
+# Single-sourced from pyproject.toml: installed-package metadata first,
+# else (source checkout on sys.path, no dist-info) the adjacent
+# pyproject.toml itself. The last-resort sentinel is a deliberate
+# non-version so a stale hard-coded number can never masquerade as real.
+def _resolve_version() -> str:
+    from importlib.metadata import PackageNotFoundError, version
+
+    try:
+        return version("zookeeper-tpu")
+    except PackageNotFoundError:
+        pass
+    try:
+        import os
+        import tomllib
+
+        pyproject = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "..", "pyproject.toml"
+        )
+        with open(pyproject, "rb") as f:
+            return tomllib.load(f)["project"]["version"]
+    except (OSError, KeyError, ImportError, ValueError):
+        return "0.0.0+unknown"
+
+
+__version__ = _resolve_version()
 
 __all__ = [
     "ComponentField",
