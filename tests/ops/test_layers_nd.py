@@ -172,3 +172,62 @@ def test_packed_converter_skips_transpose_scopes():
     assert "kernel_packed" in out["QuantConv_0"]
     assert "kernel" in out["QuantConvTranspose_0"]
     assert "kernel_packed" not in out["QuantConvTranspose_0"]
+
+
+def test_separable_conv1d_matches_manual_composition():
+    """QuantSeparableConv1D == depthwise (groups=ci) then 1x1 pointwise,
+    with the larq data flow (intermediate unquantized by default)."""
+    from zookeeper_tpu.ops import QuantConvND, QuantSeparableConv1D
+
+    rng = np.random.default_rng(7)
+    x = jnp.asarray(rng.normal(size=(2, 12, 6)), jnp.float32)
+    layer = QuantSeparableConv1D(
+        features=5, kernel_size=(3,), input_quantizer="ste_sign",
+        depthwise_quantizer="ste_sign", pointwise_quantizer="ste_sign",
+    )
+    params = layer.init(jax.random.PRNGKey(7), x)
+    y = layer.apply(params, x)
+    assert y.shape == (2, 12, 5)
+
+    dw = QuantConvND(
+        features=6, kernel_size=(3,), feature_group_count=6,
+        input_quantizer="ste_sign", kernel_quantizer="ste_sign",
+    )
+    pw = QuantConvND(
+        features=5, kernel_size=(1,), kernel_quantizer="ste_sign",
+    )
+    inner = params["params"]
+    mid = dw.apply({"params": inner["QuantConvND_0"]}, x)
+    y2 = pw.apply({"params": inner["QuantConvND_1"]}, mid)
+    np.testing.assert_array_equal(np.asarray(y), np.asarray(y2))
+
+
+def test_separable_conv1d_int8_with_intermediate_quantizer():
+    """int8 pointwise requires a binarized intermediate; bit-exact vs the
+    mxu path under the same params."""
+    from zookeeper_tpu.ops import QuantSeparableConv1D
+
+    rng = np.random.default_rng(8)
+    x = jnp.asarray(rng.normal(size=(2, 10, 8)), jnp.float32)
+    kw = dict(
+        features=4, input_quantizer="ste_sign",
+        depthwise_quantizer="ste_sign", pointwise_quantizer="ste_sign",
+        intermediate_quantizer="ste_sign",
+    )
+    mxu = QuantSeparableConv1D(**kw)
+    i8 = QuantSeparableConv1D(
+        depthwise_compute="int8", pointwise_compute="int8", **kw
+    )
+    params = mxu.init(jax.random.PRNGKey(8), x)
+    np.testing.assert_array_equal(
+        np.asarray(mxu.apply(params, x)), np.asarray(i8.apply(params, x))
+    )
+
+
+def test_separable_conv1d_rejects_2d_kernel():
+    from zookeeper_tpu.ops import QuantSeparableConv1D
+
+    with pytest.raises(ValueError, match="must have 1 spatial dim"):
+        QuantSeparableConv1D(features=2, kernel_size=(3, 3)).init(
+            jax.random.PRNGKey(0), jnp.ones((1, 8, 8, 4))
+        )

@@ -29,6 +29,7 @@ from zookeeper_tpu.ops.layers import (
     QuantDense,
     QuantDepthwiseConv,
     QuantSeparableConv,
+    QuantSeparableConv1D,
 )
 from zookeeper_tpu.ops.binary_compute import (
     conv_dim_numbers,
@@ -70,6 +71,7 @@ __all__ = [
     "QuantDense",
     "QuantDepthwiseConv",
     "QuantSeparableConv",
+    "QuantSeparableConv1D",
     "approx_sign",
     "dorefa",
     "get_quantizer",

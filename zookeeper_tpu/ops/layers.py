@@ -613,6 +613,61 @@ class QuantConvTranspose(nn.Module):
         return y
 
 
+class QuantSeparableConv1D(nn.Module):
+    """1-D separable conv (depthwise then pointwise), both stages
+    optionally quantized — the larq ``QuantSeparableConv1D`` capability.
+    Same data-flow contract as :class:`QuantSeparableConv` (the 2-D
+    layer): ``input_quantizer`` applies to the layer input only; set
+    ``intermediate_quantizer`` to re-binarize between the stages.
+    Compute paths are "mxu"/"int8" (rank-generic MXU)."""
+
+    features: int
+    kernel_size: Tuple[int, ...] = (3,)
+    strides: Tuple[int, ...] = None
+    padding: Union[str, Sequence[Tuple[int, int]]] = "SAME"
+    channel_multiplier: int = 1
+    input_quantizer: Quantizer = None
+    depthwise_quantizer: Quantizer = None
+    pointwise_quantizer: Quantizer = None
+    intermediate_quantizer: Quantizer = None
+    kernel_clip: bool = True
+    use_bias: bool = False
+    dtype: Any = jnp.float32
+    depthwise_compute: str = "mxu"
+    pointwise_compute: str = "mxu"
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        if len(self.kernel_size) != 1:
+            raise ValueError(
+                f"{type(self).__name__}: kernel_size "
+                f"{tuple(self.kernel_size)} must have 1 spatial dim."
+            )
+        ci = x.shape[-1]
+        x = QuantConvND(
+            features=ci * self.channel_multiplier,
+            kernel_size=tuple(self.kernel_size),
+            strides=self.strides,
+            padding=self.padding,
+            feature_group_count=ci,
+            input_quantizer=self.input_quantizer,
+            kernel_quantizer=self.depthwise_quantizer,
+            kernel_clip=self.kernel_clip,
+            dtype=self.dtype,
+            binary_compute=self.depthwise_compute,
+        )(x)
+        return QuantConvND(
+            features=self.features,
+            kernel_size=(1,),
+            input_quantizer=self.intermediate_quantizer,
+            kernel_quantizer=self.pointwise_quantizer,
+            kernel_clip=self.kernel_clip,
+            use_bias=self.use_bias,
+            dtype=self.dtype,
+            binary_compute=self.pointwise_compute,
+        )(x)
+
+
 class QuantDepthwiseConv(nn.Module):
     """Depthwise 2-D conv with optional input/kernel quantization (NHWC)
     — the larq ``QuantDepthwiseConv2D`` capability.
