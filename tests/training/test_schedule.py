@@ -1,0 +1,117 @@
+"""Schedule components: trajectory endpoints and shapes.
+
+Each schedule builds an optax step->lr callable; these tests pin the
+contract points (initial value, peak, boundaries, final value) that the
+experiment's applied-units accounting depends on.
+"""
+
+import numpy as np
+import pytest
+
+from zookeeper_tpu.core import configure
+from zookeeper_tpu.training.schedule import (
+    ConstantSchedule,
+    CosineDecay,
+    LinearWarmup,
+    PolynomialDecay,
+    StepDecay,
+    WarmupCosine,
+)
+
+
+def build(cls, conf, total_steps=100):
+    s = cls()
+    configure(s, conf, name="s")
+    return s.build(total_steps)
+
+
+def test_constant():
+    fn = build(ConstantSchedule, {"base_lr": 0.25})
+    assert float(fn(0)) == 0.25
+    assert float(fn(99)) == 0.25
+
+
+def test_cosine_decay_endpoints():
+    fn = build(CosineDecay, {"base_lr": 1.0, "alpha": 0.1}, total_steps=100)
+    assert float(fn(0)) == pytest.approx(1.0)
+    # Cosine reaches alpha * base at the end of the decay.
+    assert float(fn(100)) == pytest.approx(0.1, rel=1e-5)
+    # Monotone decreasing on the decay interval.
+    vals = [float(fn(t)) for t in range(0, 101, 10)]
+    assert all(a >= b for a, b in zip(vals, vals[1:]))
+
+
+def test_warmup_cosine_ramps_then_decays():
+    fn = build(
+        WarmupCosine,
+        {"base_lr": 1.0, "warmup_steps": 10, "alpha": 0.0},
+        total_steps=100,
+    )
+    assert float(fn(0)) == pytest.approx(0.0, abs=1e-6)
+    peak = max(float(fn(t)) for t in range(101))
+    assert peak == pytest.approx(1.0, rel=1e-3)
+    assert float(fn(100)) < 0.01
+
+
+def test_step_decay_boundaries():
+    fn = build(
+        StepDecay,
+        {"base_lr": 1.0, "boundaries": [0.5, 0.75], "factor": 0.1},
+        total_steps=100,
+    )
+    assert float(fn(49)) == pytest.approx(1.0)
+    assert float(fn(60)) == pytest.approx(0.1, rel=1e-5)
+    assert float(fn(80)) == pytest.approx(0.01, rel=1e-5)
+
+
+def test_step_decay_collapsed_boundaries_compound():
+    """Short runs can collapse two boundaries onto one step: the factors
+    must compound, not overwrite."""
+    fn = build(
+        StepDecay,
+        {"base_lr": 1.0, "boundaries": [0.5, 0.6], "factor": 0.1},
+        total_steps=2,  # both boundaries -> step 1
+    )
+    assert float(fn(1)) == pytest.approx(0.01, rel=1e-5)
+
+
+def test_polynomial_decay_linear():
+    fn = build(
+        PolynomialDecay,
+        {"base_lr": 1.0, "end_lr": 0.0, "power": 1.0},
+        total_steps=100,
+    )
+    assert float(fn(0)) == pytest.approx(1.0)
+    assert float(fn(50)) == pytest.approx(0.5, rel=1e-5)
+    assert float(fn(100)) == pytest.approx(0.0, abs=1e-7)
+
+
+def test_linear_warmup_reaches_and_holds_peak():
+    fn = build(
+        LinearWarmup,
+        {"base_lr": 0.4, "warmup_steps": 20},
+        total_steps=100,
+    )
+    assert float(fn(0)) < 0.4
+    assert float(fn(20)) == pytest.approx(0.4, rel=1e-5)
+    assert float(fn(99)) == pytest.approx(0.4, rel=1e-5)
+    ramp = [float(fn(t)) for t in range(21)]
+    assert all(b >= a for a, b in zip(ramp, ramp[1:]))
+
+
+def test_warmup_fraction_fallback():
+    fn = build(
+        LinearWarmup,
+        {"base_lr": 1.0, "warmup_fraction": 0.1},
+        total_steps=50,
+    )
+    # warmup = 5 steps; before it, lr < peak.
+    assert float(fn(2)) < 1.0
+    assert float(fn(5)) == pytest.approx(1.0, rel=1e-5)
+
+
+def test_constant_schedule_after_configure_is_frozen():
+    s = ConstantSchedule()
+    configure(s, {"base_lr": 0.1}, name="s")
+    with pytest.raises(Exception):
+        s.base_lr = 0.2  # Components freeze after configure.

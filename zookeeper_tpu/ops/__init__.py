@@ -30,6 +30,7 @@ from zookeeper_tpu.ops.layers import (
     QuantDepthwiseConv,
     QuantSeparableConv,
     QuantSeparableConv1D,
+    QuantSeparableConvND,
 )
 from zookeeper_tpu.ops.binary_compute import (
     conv_dim_numbers,
@@ -72,6 +73,7 @@ __all__ = [
     "QuantDepthwiseConv",
     "QuantSeparableConv",
     "QuantSeparableConv1D",
+    "QuantSeparableConvND",
     "approx_sign",
     "dorefa",
     "get_quantizer",

@@ -613,13 +613,15 @@ class QuantConvTranspose(nn.Module):
         return y
 
 
-class QuantSeparableConv1D(nn.Module):
-    """1-D separable conv (depthwise then pointwise), both stages
-    optionally quantized — the larq ``QuantSeparableConv1D`` capability.
+class QuantSeparableConvND(nn.Module):
+    """N-D separable conv (depthwise then pointwise), both stages
+    optionally quantized, rank inferred from ``kernel_size`` (the larq
+    ``QuantSeparableConv1D`` capability and its higher-rank analogues).
     Same data-flow contract as :class:`QuantSeparableConv` (the 2-D
-    layer): ``input_quantizer`` applies to the layer input only; set
-    ``intermediate_quantizer`` to re-binarize between the stages.
-    Compute paths are "mxu"/"int8" (rank-generic MXU)."""
+    layer with the packed-deployment options): ``input_quantizer``
+    applies to the layer input only; set ``intermediate_quantizer`` to
+    re-binarize between the stages. Compute paths are "mxu"/"int8"
+    (rank-generic MXU)."""
 
     features: int
     kernel_size: Tuple[int, ...] = (3,)
@@ -636,12 +638,17 @@ class QuantSeparableConv1D(nn.Module):
     depthwise_compute: str = "mxu"
     pointwise_compute: str = "mxu"
 
+    #: Pinned by rank-specific subclasses; None = any rank.
+    _SPATIAL_RANK = None
+
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
-        if len(self.kernel_size) != 1:
+        rank = len(self.kernel_size)
+        if self._SPATIAL_RANK is not None and rank != self._SPATIAL_RANK:
             raise ValueError(
                 f"{type(self).__name__}: kernel_size "
-                f"{tuple(self.kernel_size)} must have 1 spatial dim."
+                f"{tuple(self.kernel_size)} must have "
+                f"{self._SPATIAL_RANK} spatial dim(s)."
             )
         ci = x.shape[-1]
         x = QuantConvND(
@@ -658,7 +665,7 @@ class QuantSeparableConv1D(nn.Module):
         )(x)
         return QuantConvND(
             features=self.features,
-            kernel_size=(1,),
+            kernel_size=(1,) * rank,
             input_quantizer=self.intermediate_quantizer,
             kernel_quantizer=self.pointwise_quantizer,
             kernel_clip=self.kernel_clip,
@@ -666,6 +673,13 @@ class QuantSeparableConv1D(nn.Module):
             dtype=self.dtype,
             binary_compute=self.pointwise_compute,
         )(x)
+
+
+class QuantSeparableConv1D(QuantSeparableConvND):
+    """1-D separable quant conv over [batch, width, channels] (larq
+    ``QuantSeparableConv1D``)."""
+
+    _SPATIAL_RANK = 1
 
 
 class QuantDepthwiseConv(nn.Module):
