@@ -481,3 +481,35 @@ def test_profile_dir_captures_trace(tmp_path):
     for root, _dirs, files in os.walk(profile_dir):
         found.extend(os.path.join(root, f) for f in files)
     assert found, f"no profiler artifacts under {profile_dir}"
+
+
+def test_label_smoothing_and_top5_in_loop():
+    exp = make_experiment(
+        {
+            "epochs": 1,
+            "steps_per_epoch": 3,
+            "label_smoothing": 0.1,
+            "track_top5": True,
+        }
+    )
+    history = exp.run()
+    assert "top5_accuracy" in history["validation"][0]
+    v = history["validation"][0]
+    assert v["top5_accuracy"] >= v["accuracy"] - 1e-6
+
+
+def test_track_top5_rejected_for_few_classes():
+    exp = make_experiment(
+        {
+            "loader.dataset.num_classes": 3,
+            "track_top5": True,
+        }
+    )
+    with pytest.raises(ValueError, match="track_top5"):
+        exp.run()
+
+
+def test_label_smoothing_out_of_range_rejected():
+    exp = make_experiment({"label_smoothing": 1.5})
+    with pytest.raises(ValueError, match="label_smoothing"):
+        exp.run()

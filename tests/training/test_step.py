@@ -183,3 +183,78 @@ def test_polynomial_and_linear_warmup_schedules():
     np.testing.assert_allclose(float(sched(0)), 0.0)
     np.testing.assert_allclose(float(sched(2)), 1.0)
     assert float(sched(4)) == 2.0 and float(sched(19)) == 2.0
+
+
+def test_smoothed_loss_matches_manual_and_zero_is_plain():
+    import numpy as np
+
+    from zookeeper_tpu.training.step import (
+        smoothed_softmax_cross_entropy,
+        softmax_cross_entropy,
+    )
+
+    rng = np.random.default_rng(0)
+    logits = jnp.asarray(rng.normal(size=(8, 10)), jnp.float32)
+    labels = jnp.asarray(rng.integers(0, 10, 8))
+
+    assert smoothed_softmax_cross_entropy(0.0) is softmax_cross_entropy
+
+    s = 0.1
+    loss = float(smoothed_softmax_cross_entropy(s)(logits, labels))
+    # Manual: CE against smoothed one-hots.
+    logp = jax.nn.log_softmax(logits)
+    onehot = jax.nn.one_hot(labels, 10)
+    targets = onehot * (1 - s) + s / 10
+    manual = float(-(targets * logp).sum(-1).mean())
+    np.testing.assert_allclose(loss, manual, rtol=1e-6)
+
+    import pytest
+
+    with pytest.raises(ValueError, match="smoothing"):
+        smoothed_softmax_cross_entropy(1.0)
+
+
+def test_top_k_accuracy_exact():
+    import numpy as np
+
+    from zookeeper_tpu.training.step import top_k_accuracy
+
+    logits = jnp.asarray(
+        [
+            [9.0, 5.0, 4.0, 3.0, 2.0, 1.0],  # label 1: in top-5, not top-1
+            [0.0, 1.0, 2.0, 3.0, 4.0, 5.0],  # label 0: not in top-5
+            [5.0, 4.0, 3.0, 2.0, 1.0, 0.0],  # label 0: top-1
+        ]
+    )
+    labels = jnp.asarray([1, 0, 0])
+    np.testing.assert_allclose(
+        float(top_k_accuracy(logits, labels, 5)), 2 / 3
+    )
+    np.testing.assert_allclose(
+        float(top_k_accuracy(logits, labels, 1)), 1 / 3
+    )
+
+
+def test_eval_step_top5_metric_present():
+    import numpy as np
+    import optax
+
+    from zookeeper_tpu.models import Mlp
+    from zookeeper_tpu.core import configure
+    from zookeeper_tpu.training import TrainState, make_eval_step
+
+    m = Mlp()
+    configure(m, {"hidden_units": (8,)}, name="m")
+    module = m.build((4, 4, 1), num_classes=6)
+    params, model_state = m.initialize(module, (4, 4, 1))
+    state = TrainState.create(
+        apply_fn=module.apply, params=params, model_state=model_state,
+        tx=optax.sgd(0.1),
+    )
+    batch = {
+        "input": jnp.zeros((4, 4, 4, 1)),
+        "target": jnp.asarray([0, 1, 2, 3]),
+    }
+    metrics = jax.jit(make_eval_step(top5=True))(state, batch)
+    assert "top5_accuracy" in metrics
+    assert 0.0 <= float(metrics["top5_accuracy"]) <= 1.0

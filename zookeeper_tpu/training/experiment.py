@@ -26,7 +26,11 @@ from zookeeper_tpu.training.checkpoint import Checkpointer
 from zookeeper_tpu.training.metrics import CompositeMetricsWriter, MetricsWriter
 from zookeeper_tpu.training.optimizer import Adam, Optimizer
 from zookeeper_tpu.training.state import TrainState
-from zookeeper_tpu.training.step import make_eval_step, make_train_step
+from zookeeper_tpu.training.step import (
+    make_eval_step,
+    make_train_step,
+    smoothed_softmax_cross_entropy,
+)
 
 
 @component
@@ -107,6 +111,14 @@ class TrainingExperiment(Experiment):
     #: Report the per-step sign-flip fraction of binary kernels
     #: (larq FlipRatio capability) in the train metrics.
     track_flip_ratio: bool = Field(False)
+    #: Label smoothing for the training loss (standard ImageNet recipe
+    #: regularizer; 0 = off). Validation uses the SAME smoothed loss
+    #: (Keras semantics: the compiled loss scores both splits) — accuracy
+    #: metrics are unaffected.
+    label_smoothing: float = Field(0.0)
+    #: Also report top-5 accuracy in validation metrics (the ImageNet
+    #: companion metric; requires >= 5 classes).
+    track_top5: bool = Field(False)
     #: Save a model-only checkpoint (params + batch stats, no optimizer
     #: state) here after training: the deployment/teacher export format
     #: (see training.checkpoint.save_model / DistillationExperiment).
@@ -177,6 +189,7 @@ class TrainingExperiment(Experiment):
         from zookeeper_tpu.training.optimizer import BINARY_KERNEL_PATTERN
 
         return {
+            "loss_fn": smoothed_softmax_cross_entropy(self.label_smoothing),
             "rng_seed": self.seed,
             "flip_ratio_pattern": (
                 BINARY_KERNEL_PATTERN if self.track_flip_ratio else None
@@ -219,6 +232,15 @@ class TrainingExperiment(Experiment):
                 f"validate_every={self.validate_every} must be >= 1; "
                 "set validate=False to disable validation."
             )
+        if not 0.0 <= self.label_smoothing < 1.0:
+            raise ValueError(
+                f"label_smoothing={self.label_smoothing} outside [0, 1)."
+            )
+        if self.track_top5 and self.num_classes < 5:
+            raise ValueError(
+                f"track_top5=True needs >= 5 classes "
+                f"(dataset has {self.num_classes})."
+            )
         self._log(pretty_print(self))
         if self.print_model_summary:
             from zookeeper_tpu.models.summary import model_summary
@@ -239,7 +261,12 @@ class TrainingExperiment(Experiment):
         state = self.checkpointer.restore_state(state)
         train_step = partitioner.compile_step(self._train_step_fn(), state)
         eval_step = partitioner.compile_eval(
-            make_eval_step(use_ema=self.ema_decay > 0), state
+            make_eval_step(
+                smoothed_softmax_cross_entropy(self.label_smoothing),
+                use_ema=self.ema_decay > 0,
+                top5=self.track_top5,
+            ),
+            state,
         )
         batch_sharding = partitioner.batch_sharding()
 
@@ -439,6 +466,8 @@ class EvalExperiment(Experiment):
     batch_size: int = Field(32)
     seed: int = Field(0)
     verbose: bool = Field(True)
+    #: Also report top-5 accuracy (ImageNet companion metric).
+    track_top5: bool = Field(False)
 
     @Field
     def num_classes(self) -> int:
@@ -454,6 +483,11 @@ class EvalExperiment(Experiment):
             raise ValueError(
                 f"split={self.split!r} unknown; datasets here expose "
                 "'train' and 'validation'."
+            )
+        if self.track_top5 and self.num_classes < 5:
+            raise ValueError(
+                f"track_top5=True needs >= 5 classes "
+                f"(dataset has {self.num_classes})."
             )
         if self.verbose:
             print(pretty_print(self), flush=True)
@@ -473,7 +507,9 @@ class EvalExperiment(Experiment):
             tx=_eval_noop_tx(),
         )
         state = partitioner.shard_state(state)
-        eval_step = partitioner.compile_eval(make_eval_step(), state)
+        eval_step = partitioner.compile_eval(
+            make_eval_step(top5=self.track_top5), state
+        )
         metrics = run_weighted_eval(
             self.loader, self.split, eval_step, state,
             partitioner.batch_sharding(),

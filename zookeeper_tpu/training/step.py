@@ -28,8 +28,39 @@ def softmax_cross_entropy(logits: jax.Array, labels: jax.Array) -> jax.Array:
     ).mean()
 
 
+def smoothed_softmax_cross_entropy(smoothing: float):
+    """Label-smoothed cross-entropy loss factory (the standard ImageNet
+    recipe regularizer): targets become ``(1 - smoothing)`` on the true
+    class and ``smoothing / num_classes`` elsewhere. ``smoothing=0``
+    returns the plain integer-label loss (identical compiled graph)."""
+    if not 0.0 <= smoothing < 1.0:
+        raise ValueError(
+            f"label smoothing {smoothing} outside [0, 1): 0 disables; "
+            "1.0 would erase the labels entirely."
+        )
+    if smoothing == 0.0:
+        return softmax_cross_entropy
+
+    def loss_fn(logits: jax.Array, labels: jax.Array) -> jax.Array:
+        logits = logits.astype(jnp.float32)
+        num_classes = logits.shape[-1]
+        targets = optax.smooth_labels(
+            jax.nn.one_hot(labels, num_classes), smoothing
+        )
+        return optax.softmax_cross_entropy(logits, targets).mean()
+
+    return loss_fn
+
+
 def accuracy(logits: jax.Array, labels: jax.Array) -> jax.Array:
     return (jnp.argmax(logits, axis=-1) == labels).mean()
+
+
+def top_k_accuracy(logits: jax.Array, labels: jax.Array, k: int) -> jax.Array:
+    """Fraction of examples whose true label is in the top-k logits (the
+    ImageNet top-5 companion metric)."""
+    _, top = jax.lax.top_k(logits.astype(jnp.float32), k)
+    return (top == labels[:, None]).any(axis=-1).mean()
 
 
 def kd_divergence(
@@ -222,10 +253,13 @@ def make_eval_step(
     loss_fn: Callable[[jax.Array, jax.Array], jax.Array] = softmax_cross_entropy,
     *,
     use_ema: bool = False,
+    top5: bool = False,
 ) -> Callable[[TrainState, Batch], Metrics]:
     """``use_ema``: evaluate the EMA weights instead of the raw params
     (the averaged weights are what ships — standard for the long binary
-    recipes, where raw weights oscillate from late sign flips)."""
+    recipes, where raw weights oscillate from late sign flips).
+    ``top5``: also report top-5 accuracy (the ImageNet companion metric
+    larq-zoo publishes alongside top-1)."""
 
     def eval_step(state: TrainState, batch: Batch) -> Metrics:
         params = state.params
@@ -238,9 +272,14 @@ def make_eval_step(
             params = state.ema_params
         variables = {"params": params, **state.model_state}
         logits = state.apply_fn(variables, batch["input"], training=False)
-        return {
+        metrics = {
             "loss": loss_fn(logits, batch["target"]),
             "accuracy": accuracy(logits, batch["target"]),
         }
+        if top5:
+            metrics["top5_accuracy"] = top_k_accuracy(
+                logits, batch["target"], k=5
+            )
+        return metrics
 
     return eval_step
