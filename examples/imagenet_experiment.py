@@ -37,7 +37,8 @@ from zookeeper_tpu.training import (
 
 ImageNetPreprocessing = PartialComponent(
     ImageClassificationPreprocessing,
-    height=224, width=224, channels=3, augment=True, pad_pixels=16,
+    height=224, width=224, channels=3, augment=True,
+    random_resized_crop=True,
 )
 
 
@@ -56,6 +57,9 @@ class TrainImageNet(TrainingExperiment):
     partitioner: Partitioner = ComponentField(DataParallelPartitioner)
     epochs: int = Field(120)
     batch_size: int = Field(256)
+    # ImageNet-recipe defaults: smoothed loss, top-1 + top-5 reporting.
+    label_smoothing: float = Field(0.1)
+    track_top5: bool = Field(True)
 
 
 @task
@@ -82,6 +86,7 @@ class DistillImageNet(DistillationExperiment):
     partitioner: Partitioner = ComponentField(DataParallelPartitioner)
     epochs: int = Field(75)
     batch_size: int = Field(256)
+    track_top5: bool = Field(True)
 
 
 if __name__ == "__main__":

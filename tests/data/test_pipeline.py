@@ -313,3 +313,123 @@ def test_preprocessing_resize_nearest():
     )
     out2 = p2.input({"image": src}, training=False)
     np.testing.assert_allclose(out2[..., 0], src[::2, ::2] / 255.0, rtol=1e-6)
+
+
+def test_random_resized_crop_shape_determinism_and_epoch_variation():
+    """Inception-style RandomResizedCrop: output is always (height, width),
+    the same (index, epoch) seed reproduces the same crop (resumability),
+    and different epochs produce different crops (augmentation variety)."""
+    from zookeeper_tpu.core import configure
+    from zookeeper_tpu.data import ImageClassificationPreprocessing
+
+    pp = ImageClassificationPreprocessing()
+    configure(
+        pp,
+        {
+            "height": 16,
+            "width": 16,
+            "channels": 3,
+            "augment": True,
+            "random_resized_crop": True,
+            "random_flip": False,
+        },
+        name="pp",
+    )
+    rng = np.random.default_rng(0)
+    image = rng.integers(0, 255, (48, 64, 3)).astype(np.uint8)
+
+    def run(index, epoch):
+        ex = {
+            "image": image,
+            "label": np.int32(0),
+            "_index": np.int64(index),
+            "_epoch": np.int64(epoch),
+        }
+        return pp(ex, training=True)["input"]
+
+    a = run(3, 0)
+    assert a.shape == (16, 16, 3)
+    np.testing.assert_array_equal(a, run(3, 0))  # deterministic
+    assert not np.array_equal(a, run(3, 1))  # varies per epoch
+    assert not np.array_equal(a, run(4, 0))  # varies per example
+    # Values come from the source image (nearest gather, then rescale).
+    src_vals = set(np.unique((image.astype(np.float32) / 255.0) * 2 - 1))
+    assert set(np.unique(a)).issubset(src_vals)
+
+
+def test_random_resized_crop_eval_path_unaffected():
+    from zookeeper_tpu.core import configure
+    from zookeeper_tpu.data import ImageClassificationPreprocessing
+
+    pp = ImageClassificationPreprocessing()
+    configure(
+        pp,
+        {
+            "height": 8,
+            "width": 8,
+            "channels": 1,
+            "augment": True,
+            "random_resized_crop": True,
+        },
+        name="pp",
+    )
+    img = np.zeros((12, 12, 1), np.uint8)
+    out = pp({"image": img, "label": np.int32(1)}, training=False)
+    # Eval ignores augmentation entirely: center crop to (8, 8).
+    assert out["input"].shape == (8, 8, 1)
+
+
+def test_random_resized_crop_invalid_ranges_fail_fast():
+    from zookeeper_tpu.core import configure
+    from zookeeper_tpu.data import ImageClassificationPreprocessing
+
+    pp = ImageClassificationPreprocessing()
+    configure(
+        pp,
+        {
+            "height": 8, "width": 8, "augment": True,
+            "random_resized_crop": True,
+            "crop_aspect_range": (0.0, 1.33),
+        },
+        name="pp",
+    )
+    ex = {"image": np.zeros((16, 16, 3), np.uint8), "label": np.int32(0)}
+    with pytest.raises(ValueError, match="RandomResizedCrop ranges"):
+        pp(ex, training=True)
+
+
+def test_random_resized_crop_skips_pre_resize():
+    """resize=True + RRC must crop from the FULL-res source, not a
+    pre-shrunk one: a crop from a 64x64 source with scale pinned to a
+    quarter of the area can only contain pixels from a 32x32 region —
+    impossible if the source had first been resized to 16x16."""
+    from zookeeper_tpu.core import configure
+    from zookeeper_tpu.data import ImageClassificationPreprocessing
+
+    pp = ImageClassificationPreprocessing()
+    configure(
+        pp,
+        {
+            "height": 16, "width": 16, "channels": 1, "resize": True,
+            "augment": True, "random_resized_crop": True,
+            "random_flip": False, "zero_center": False,
+            "crop_scale_range": (0.25, 0.25),
+            "crop_aspect_range": (1.0, 1.0),
+        },
+        name="pp",
+    )
+    # Source: a 64x64 gradient with 64 distinct row values. A 32x32 crop
+    # resized to 16 rows keeps ADJACENT-ROW spacing of 2 (nearest,
+    # stride 2); a pre-resize to 16 rows first would sample rows 4 apart.
+    img = np.tile(np.arange(64, dtype=np.uint8)[:, None, None], (1, 64, 1))
+    ex = {
+        "image": img, "label": np.int32(0),
+        "_index": np.int64(0), "_epoch": np.int64(0),
+    }
+    out = pp(ex, training=True)["input"]
+    rows = np.unique((out * 255.0).round().astype(np.int64)[..., 0], axis=1)
+    row_vals = rows[:, 0]
+    steps = np.diff(row_vals)
+    assert out.shape == (16, 16, 1)
+    # Full-res 32-row crop -> stride-2 row sampling.
+    assert set(np.unique(steps)) == {2}

@@ -88,6 +88,15 @@ class ImageClassificationPreprocessing(Preprocessing):
     augment: bool = Field(False)
     pad_pixels: int = Field(4)
     random_flip: bool = Field(True)
+    #: Inception-style RandomResizedCrop (the ImageNet training recipe):
+    #: sample a crop covering ``crop_scale_range`` of the source area at
+    #: an aspect ratio in ``crop_aspect_range``, then resize to
+    #: (height, width). Replaces the CIFAR-style pad+crop when on.
+    #: Resize is nearest-neighbor (library-free numpy; documented
+    #: deviation from bilinear).
+    random_resized_crop: bool = Field(False)
+    crop_scale_range: Tuple[float, float] = Field((0.08, 1.0))
+    crop_aspect_range: Tuple[float, float] = Field((0.75, 4.0 / 3.0))
     #: Nearest-neighbor resize mismatched sources to (height, width)
     #: instead of center crop/pad — e.g. feeding low-res corpora into
     #: ImageNet-shaped stems. Python-path only; the native fused batch
@@ -98,13 +107,51 @@ class ImageClassificationPreprocessing(Preprocessing):
     def input_shape(self) -> Tuple[int, ...]:
         return (self.height, self.width, self.channels)
 
+    def _random_resized_crop(
+        self, image: np.ndarray, rng: np.random.Generator
+    ) -> np.ndarray:
+        s_lo, s_hi = self.crop_scale_range
+        a_lo, a_hi = self.crop_aspect_range
+        if not (0.0 < s_lo <= s_hi <= 1.0) or not (0.0 < a_lo <= a_hi):
+            # Fail fast with the config values, not an OverflowError from
+            # np.log/rng.uniform deep inside a (possibly multi-worker)
+            # pipeline.
+            raise ValueError(
+                f"Invalid RandomResizedCrop ranges: crop_scale_range="
+                f"{(s_lo, s_hi)} must satisfy 0 < lo <= hi <= 1 and "
+                f"crop_aspect_range={(a_lo, a_hi)} must satisfy "
+                "0 < lo <= hi."
+            )
+        h, w = image.shape[:2]
+        area = float(h * w)
+        lo, hi = self.crop_scale_range
+        log_lo, log_hi = np.log(self.crop_aspect_range)
+        # Rejection-sample like the Inception reference (10 tries, then a
+        # deterministic center-square fallback).
+        for _ in range(10):
+            target_area = area * rng.uniform(lo, hi)
+            aspect = float(np.exp(rng.uniform(log_lo, log_hi)))
+            cw = int(round(np.sqrt(target_area * aspect)))
+            ch = int(round(np.sqrt(target_area / aspect)))
+            if 0 < cw <= w and 0 < ch <= h:
+                top = int(rng.integers(0, h - ch + 1))
+                left = int(rng.integers(0, w - cw + 1))
+                crop = image[top : top + ch, left : left + cw]
+                return _resize_nearest(crop, self.height, self.width)
+        side = min(h, w)
+        crop = _center_crop_or_pad(image, side, side)
+        return _resize_nearest(crop, self.height, self.width)
+
     def _augment(self, image: np.ndarray, rng: np.random.Generator) -> np.ndarray:
-        p = self.pad_pixels
-        if p > 0:
-            padded = np.pad(image, ((p, p), (p, p), (0, 0)), mode="reflect")
-            oy = int(rng.integers(0, 2 * p + 1))
-            ox = int(rng.integers(0, 2 * p + 1))
-            image = padded[oy : oy + self.height, ox : ox + self.width]
+        if self.random_resized_crop:
+            image = self._random_resized_crop(image, rng)
+        else:
+            p = self.pad_pixels
+            if p > 0:
+                padded = np.pad(image, ((p, p), (p, p), (0, 0)), mode="reflect")
+                oy = int(rng.integers(0, 2 * p + 1))
+                ox = int(rng.integers(0, 2 * p + 1))
+                image = padded[oy : oy + self.height, ox : ox + self.width]
         if self.random_flip and rng.integers(0, 2) == 1:
             image = image[:, ::-1]
         return image
@@ -117,7 +164,16 @@ class ImageClassificationPreprocessing(Preprocessing):
             image = image.astype(np.float32)
         if image.ndim == 2:
             image = image[..., None]
-        if self.resize and image.shape[:2] != (self.height, self.width):
+        # RandomResizedCrop consumes the FULL-resolution source (that is
+        # its point); pre-resizing would double-resample and destroy the
+        # crop diversity, so resize only applies on the paths that will
+        # not RRC.
+        will_rrc = training and self.augment and self.random_resized_crop
+        if (
+            self.resize
+            and not will_rrc
+            and image.shape[:2] != (self.height, self.width)
+        ):
             image = _resize_nearest(image, self.height, self.width)
         if training and self.augment:
             # Seed from (example index, epoch): deterministic/resumable AND
