@@ -95,6 +95,18 @@ def test_two_process_cluster_pipeline_and_sharded_checkpoint(tmp_path):
         assert r["n_local_devices"] == 4
         assert r["num_batches"] == 4  # 64 examples / 16 global batch
         assert r["restored_sharded"]
+        # FSDP leg: weights genuinely sharded across the process
+        # boundary, and the step's weight all-gather / grad
+        # reduce-scatter produced a finite loss.
+        assert r["fsdp_param_sharded"]
+        assert np.isfinite(r["fsdp_loss"])
     # The collective produced the SAME global means on both hosts — the
     # global batch was assembled correctly from per-host slices.
     np.testing.assert_allclose(results[0]["means"], results[1]["means"], rtol=1e-6)
+    # ...and the FSDP loss equals the single-device reference on the
+    # full global batch — a wrong per-host slice assembly (duplicated or
+    # swapped slices) would change it.
+    for r in results:
+        np.testing.assert_allclose(
+            r["fsdp_loss"], r["fsdp_ref_loss"], rtol=1e-5
+        )
