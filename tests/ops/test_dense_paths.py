@@ -1,0 +1,264 @@
+"""Binary DENSE compute paths: int8 MXU, packed-weight MXU, XNOR-popcount
+VPU, and packed deployment — the dense counterpart of the conv path suite
+(BinaryAlexNet's parameters are dominated by its binary dense layers, so
+the 32x packed compression matters most here).
+
+All paths run in Pallas interpret mode on CPU and must be bit-exact vs
+the float matmul on the quantized domain.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from zookeeper_tpu.ops import (
+    QuantDense,
+    pack_dense_kernel,
+    pack_quantconv_params,
+)
+
+
+def _binary_dense(**kw):
+    return QuantDense(
+        input_quantizer="ste_sign", kernel_quantizer="ste_sign",
+        use_bias=False, **kw,
+    )
+
+
+def _params(features=8, ki=70, seed=0):
+    layer = _binary_dense(features=features)
+    x = jnp.asarray(
+        np.random.default_rng(seed).normal(size=(4, ki)), jnp.float32
+    )
+    return layer.init(jax.random.PRNGKey(seed), x), x
+
+
+@pytest.mark.parametrize("mode", ["int8", "xnor", "xnor_popcount"])
+def test_dense_paths_bit_exact_vs_mxu(mode):
+    params, x = _params()
+    base = _binary_dense(features=8)
+    alt = _binary_dense(
+        features=8, binary_compute=mode, pallas_interpret=True
+    )
+    np.testing.assert_array_equal(
+        np.asarray(base.apply(params, x)), np.asarray(alt.apply(params, x))
+    )
+
+
+@pytest.mark.parametrize("mode", ["int8", "xnor"])
+def test_dense_gradients_match_mxu(mode):
+    params, x = _params()
+    base = _binary_dense(features=8)
+    alt = _binary_dense(
+        features=8, binary_compute=mode, pallas_interpret=True
+    )
+
+    def loss(layer, p):
+        return (layer.apply(p, x) ** 2).sum()
+
+    g_base = jax.grad(lambda p: loss(base, p))(params)
+    g_alt = jax.grad(lambda p: loss(alt, p))(params)
+    np.testing.assert_allclose(
+        np.asarray(g_base["params"]["kernel"]),
+        np.asarray(g_alt["params"]["kernel"]),
+        rtol=1e-5,
+    )
+
+
+def test_dense_magnitude_aware_scale_exact():
+    """Per-output-channel scaled kernels run exactly on the int8 path
+    (descale to +-1, integer GEMM, one rescale)."""
+    ki, n = 36, 6
+    rng = np.random.default_rng(3)
+    x = jnp.asarray(np.sign(rng.normal(size=(3, ki))), jnp.float32)
+    layer = QuantDense(
+        features=n, input_quantizer="ste_sign",
+        kernel_quantizer="magnitude_aware_sign", use_bias=False,
+        binary_compute="int8",
+    )
+    base = QuantDense(
+        features=n, input_quantizer="ste_sign",
+        kernel_quantizer="magnitude_aware_sign", use_bias=False,
+    )
+    params = layer.init(jax.random.PRNGKey(3), x)
+    # atol covers the FLOAT oracle's reassociation noise near zero (the
+    # int8 path is the exact one: integer sum, one scale multiply).
+    np.testing.assert_allclose(
+        np.asarray(base.apply(params, x)),
+        np.asarray(layer.apply(params, x)),
+        rtol=1e-6,
+        atol=1e-6,
+    )
+
+
+def test_packed_dense_deployment_bit_exact_and_32x_smaller():
+    """Float-trained params convert to the packed structure, load into a
+    packed_weights=True layer, and produce bit-identical outputs."""
+    features, ki = 8, 96
+    params, x = _params(features=features, ki=ki, seed=4)
+    float_layer = _binary_dense(features=features)
+    y_float = float_layer.apply(params, x)
+
+    packed_params = pack_quantconv_params(
+        {"QuantDense_0": params["params"]}
+    )["QuantDense_0"]
+    assert set(packed_params) == {"kernel_packed", "kernel_scale"}
+    assert packed_params["kernel_packed"].shape == (ki // 32, features)
+    # 32x compression on the kernel itself (int32 words vs fp32 floats).
+    assert (
+        packed_params["kernel_packed"].size * 32
+        == params["params"]["kernel"].size
+    )
+
+    for mode in ("xnor", "xnor_popcount"):
+        deployed = _binary_dense(
+            features=features, binary_compute=mode, packed_weights=True,
+            pallas_interpret=True,
+        )
+        y_packed = deployed.apply({"params": packed_params}, x)
+        np.testing.assert_array_equal(
+            np.asarray(y_float), np.asarray(y_packed), err_msg=mode
+        )
+
+
+def test_packed_dense_k_not_multiple_of_32():
+    """K padding: zeros on the MXU path, matching +1s on the popcount
+    path — both exact for any K."""
+    params, x = _params(features=4, ki=45, seed=5)
+    base = _binary_dense(features=4)
+    for mode in ("xnor", "xnor_popcount"):
+        alt = _binary_dense(
+            features=4, binary_compute=mode, pallas_interpret=True
+        )
+        np.testing.assert_array_equal(
+            np.asarray(base.apply(params, x)),
+            np.asarray(alt.apply(params, x)),
+            err_msg=mode,
+        )
+
+
+def test_packed_dense_infer_is_inference_only():
+    from zookeeper_tpu.ops import packed_dense_infer
+
+    kernel = jnp.asarray(
+        np.sign(np.random.default_rng(6).normal(size=(32, 4))), jnp.float32
+    )
+    packed, scale = pack_dense_kernel(kernel)
+    x = jnp.ones((2, 32))
+    with pytest.raises(ValueError, match="inference-only"):
+        jax.grad(
+            lambda xx: packed_dense_infer(
+                xx, packed, scale, 32, interpret=True
+            ).sum()
+        )(x)
+
+
+def test_dense_rejects_unusable_binary_path():
+    layer = QuantDense(features=4, binary_compute="int8")  # no quantizers
+    with pytest.raises(ValueError, match="never falls back silently"):
+        layer.init(jax.random.PRNGKey(0), jnp.ones((2, 16)))
+
+
+def test_higher_rank_dense_inputs():
+    """QuantDense accepts [..., K] inputs on every path (flatten/restore
+    inside the binary kernels)."""
+    layer = _binary_dense(features=6)
+    x = jnp.asarray(
+        np.random.default_rng(7).normal(size=(2, 3, 40)), jnp.float32
+    )
+    params = layer.init(jax.random.PRNGKey(7), x)
+    y_base = layer.apply(params, x)
+    assert y_base.shape == (2, 3, 6)
+    for mode in ("int8", "xnor"):
+        alt = _binary_dense(
+            features=6, binary_compute=mode, pallas_interpret=True
+        )
+        np.testing.assert_array_equal(
+            np.asarray(y_base), np.asarray(alt.apply(params, x))
+        )
+
+
+def test_binarynet_whole_model_packed_deployment_with_dense():
+    """BinaryNet float-trained params (convs + dense) convert to the
+    packed structure and the packed model apply is bit-identical —
+    the whole-model deployment path now covers the dense layers too."""
+    from zookeeper_tpu.core import configure
+    from zookeeper_tpu.models import BinaryNet
+
+    def build(packed):
+        model = BinaryNet()
+        configure(
+            model,
+            {
+                "features": (16, 16),
+                "dense_units": (64,),
+                "binary_compute": "xnor",
+                "packed_weights": packed,
+                "pallas_interpret": True,
+            },
+            name="model",
+        )
+        return model.build((8, 8, 1), num_classes=4)
+
+    float_module = build(packed=False)
+    x = jnp.asarray(
+        np.random.default_rng(40).normal(size=(2, 8, 8, 1)), jnp.float32
+    )
+    variables = float_module.init(jax.random.PRNGKey(1), x, training=False)
+    y_float = float_module.apply(variables, x, training=False)
+
+    packed_module = build(packed=True)
+    template = jax.eval_shape(
+        lambda: packed_module.init(jax.random.PRNGKey(1), x, training=False)
+    )["params"]
+    packed_params = pack_quantconv_params(
+        variables["params"], template=template
+    )
+    # Both a conv and the dense layer converted.
+    flat = str(sorted(packed_params))
+    assert "QuantDense_0" in flat
+    y_packed = packed_module.apply(
+        {**variables, "params": packed_params}, x, training=False
+    )
+    np.testing.assert_array_equal(np.asarray(y_float), np.asarray(y_packed))
+
+
+def test_xnornet_packed_deployment_includes_dense(tmp_path):
+    """XNORNet (magnitude-aware kernels) converts template-less and the
+    packed model loads — the regression the reviewer flagged: zoo models
+    with binary dense layers must declare the packed structure their
+    converted params produce."""
+    from zookeeper_tpu.core import configure
+    from zookeeper_tpu.models import XNORNet
+
+    def build(packed):
+        m = XNORNet()
+        configure(
+            m,
+            {
+                "binary_compute": "xnor",
+                "packed_weights": packed,
+                "pallas_interpret": True,
+            },
+            name="m",
+        )
+        return m.build((67, 67, 3), num_classes=5)
+
+    x = jnp.asarray(
+        np.random.default_rng(50).normal(size=(1, 67, 67, 3)), jnp.float32
+    )
+    float_module = build(packed=False)
+    variables = float_module.init(jax.random.PRNGKey(2), x, training=False)
+    y_float = float_module.apply(variables, x, training=False)
+
+    packed_params = pack_quantconv_params(
+        variables["params"], kernel_quantizer="magnitude_aware_sign"
+    )
+    packed_module = build(packed=True)
+    y_packed = packed_module.apply(
+        {**variables, "params": packed_params}, x, training=False
+    )
+    np.testing.assert_allclose(
+        np.asarray(y_float), np.asarray(y_packed), rtol=1e-5, atol=1e-5
+    )

@@ -66,6 +66,9 @@ class _BinaryNetModule(nn.Module):
             x = QuantDense(
                 u, input_quantizer="ste_sign", kernel_quantizer="ste_sign",
                 use_bias=False, dtype=self.dtype,
+                binary_compute=self.binary_compute,
+                packed_weights=self.packed_weights,
+                pallas_interpret=self.pallas_interpret,
             )(x)
             x = _bn(training, self.dtype)(x)
         x = nn.Dense(self.num_classes, dtype=self.dtype)(x)
@@ -131,9 +134,14 @@ class _BinaryAlexNetModule(nn.Module):
             x = _bn(training, self.dtype)(x)
         x = x.reshape((x.shape[0], -1))
         for u in (4096, 4096):
+            # The binary dense layers dominate BinaryAlexNet's parameter
+            # count — the packed deployment's biggest 32x win.
             x = QuantDense(
                 u, input_quantizer="ste_sign", kernel_quantizer="ste_sign",
                 use_bias=False, dtype=d,
+                binary_compute=self.binary_compute,
+                packed_weights=self.packed_weights,
+                pallas_interpret=self.pallas_interpret,
             )(x)
             x = _bn(training, self.dtype)(x)
         x = nn.Dense(self.num_classes, dtype=d)(x)
@@ -649,6 +657,9 @@ class _XnorNetModule(nn.Module):
                 u, input_quantizer="ste_sign",
                 kernel_quantizer="magnitude_aware_sign",
                 use_bias=False, dtype=d,
+                binary_compute=self.binary_compute,
+                packed_weights=self.packed_weights,
+                pallas_interpret=self.pallas_interpret,
             )(x)
             x = _bn(training, d)(x)
         x = nn.Dense(self.num_classes, dtype=d)(x)

@@ -36,13 +36,17 @@ from zookeeper_tpu.ops.binary_compute import (
     conv_dim_numbers,
     int8_conv,
     int8_conv_transpose,
+    int8_dense,
     int8_matmul,
     pack_bits,
     pack_conv_kernel,
+    pack_dense_kernel,
     packed_conv_infer,
+    packed_dense_infer,
     packed_weight_matmul,
     unpack_bits,
     xnor_conv,
+    xnor_dense,
     xnor_matmul,
     xnor_matmul_packed,
 )
@@ -52,15 +56,19 @@ __all__ = [
     "conv_dim_numbers",
     "int8_conv",
     "int8_conv_transpose",
+    "int8_dense",
     "int8_matmul",
     "pack_bits",
     "pack_conv_kernel",
+    "pack_dense_kernel",
     "pack_quantconv_params",
     "packed_conv_infer",
+    "packed_dense_infer",
     "packed_weight_matmul",
     "quantized_param_view",
     "unpack_bits",
     "xnor_conv",
+    "xnor_dense",
     "xnor_matmul",
     "xnor_matmul_packed",
     "QUANTIZERS",

@@ -649,6 +649,182 @@ def packed_conv_infer(
     )
 
 
+# -- dense (matmul) binary paths --------------------------------------------
+
+
+def pack_dense_kernel(q_kernel: Array) -> Tuple[Array, Array]:
+    """Pack a quantized dense kernel [K, N] (sign x per-output-channel
+    scale) into ``(packed [ceil(K/32), N] int32, scale [N] float32)`` —
+    the dense counterpart of :func:`pack_conv_kernel` (32x weight
+    compression; the scale re-applies to the integer GEMM output)."""
+    k, n = q_kernel.shape
+    scale = jnp.max(jnp.abs(q_kernel), axis=0).astype(jnp.float32)
+    safe = jnp.where(scale > 0, scale, 1.0)
+    signs = q_kernel / safe  # exactly +-1 by the quantizer contract
+    k_pad = _round_up(k, 32)
+    if k_pad != k:
+        signs = jnp.pad(signs, ((0, k_pad - k), (0, 0)), constant_values=1.0)
+    return pack_bits(signs, axis=0), scale
+
+
+def _flatten_leading(x: Array) -> Tuple[Array, Tuple[int, ...]]:
+    """[..., K] -> ([M, K], leading shape) for the 2-D GEMM kernels."""
+    lead = x.shape[:-1]
+    return x.reshape(-1, x.shape[-1]), lead
+
+
+def _packed_dense_forward(
+    x: Array, packed: Array, scale: Array, *, k_true: int,
+    use_popcount: bool, interpret: bool,
+) -> Array:
+    x2, lead = _flatten_leading(x)
+    if use_popcount:
+        # Both operands packed: K pads with +1s on BOTH sides (matching
+        # bits, zero mismatches — exact; requires +-1 inputs, validated
+        # by the layer).
+        k_pad = _round_up(k_true, 32)
+        if k_pad != k_true:
+            x2 = jnp.pad(
+                x2, ((0, 0), (0, k_pad - k_true)), constant_values=1.0
+            )
+        acc = xnor_matmul_packed(
+            pack_bits(x2, axis=-1), packed, k_true=k_true,
+            interpret=interpret,
+        )
+    else:
+        # Weights-only packed: A pads K with ZEROS (contribute nothing
+        # against any weight bit — exact for {-1, 0, +1} inputs).
+        acc = packed_weight_matmul(x2, packed, interpret=interpret)
+    y = acc.astype(jnp.float32) * scale[None, :]
+    return y.reshape(*lead, -1)
+
+
+def _float_dense(x, k):
+    dtype = x.dtype  # Backward follows compute dtype (see _float_conv).
+    return jnp.dot(x, k.astype(dtype))
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def xnor_dense(x: Array, q_kernel: Array, use_popcount: bool = False,
+               interpret: bool = False) -> Array:
+    """Binary dense layer [..., K] @ [K, N] through the Pallas packed
+    kernels, packing the latent-quantized kernel on the fly (the
+    training-compatible path; STE composes via the float-matmul VJP on
+    the saved quantized operands, exactly like :func:`xnor_conv`)."""
+    packed, scale = pack_dense_kernel(q_kernel)
+    return _packed_dense_forward(
+        x, packed, scale, k_true=q_kernel.shape[0],
+        use_popcount=use_popcount, interpret=interpret,
+    )
+
+
+def _xnor_dense_fwd(x, q_kernel, use_popcount, interpret):
+    packed, scale = pack_dense_kernel(q_kernel)
+    y = _packed_dense_forward(
+        x, packed, scale, k_true=q_kernel.shape[0],
+        use_popcount=use_popcount, interpret=interpret,
+    )
+    return y, (x, q_kernel)
+
+
+def _xnor_dense_bwd(use_popcount, interpret, res, g):
+    x, q_kernel = res
+    _, vjp = jax.vjp(_float_dense, x, q_kernel)
+    dx, dk = vjp(g.astype(x.dtype))
+    return dx.astype(x.dtype), dk.astype(q_kernel.dtype)
+
+
+xnor_dense.defvjp(_xnor_dense_fwd, _xnor_dense_bwd)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _packed_dense_infer_vjp(x, packed, scale, k_true, use_popcount,
+                            interpret):
+    return _packed_dense_forward(
+        x, packed, scale, k_true=k_true, use_popcount=use_popcount,
+        interpret=interpret,
+    )
+
+
+def _packed_dense_infer_fwd(x, packed, scale, k_true, use_popcount,
+                            interpret):
+    return (
+        _packed_dense_forward(
+            x, packed, scale, k_true=k_true, use_popcount=use_popcount,
+            interpret=interpret,
+        ),
+        None,
+    )
+
+
+def _packed_dense_infer_bwd(k_true, use_popcount, interpret, res, g):
+    raise ValueError(
+        "packed_dense_infer is inference-only: packed weights carry no "
+        "latent parameters to train. Differentiate the float model "
+        "(xnor_dense packs on the fly) and convert with "
+        "pack_quantconv_params for deployment."
+    )
+
+
+_packed_dense_infer_vjp.defvjp(_packed_dense_infer_fwd,
+                               _packed_dense_infer_bwd)
+
+
+def packed_dense_infer(
+    x: Array,
+    packed: Array,
+    scale: Array,
+    k_true: int,
+    *,
+    use_popcount: bool = False,
+    interpret: bool = False,
+) -> Array:
+    """Inference dense from PRE-PACKED weights (32x less weight HBM) —
+    the dense deployment path; differentiating through it raises."""
+    return _packed_dense_infer_vjp(
+        x, packed, scale, k_true, use_popcount, interpret
+    )
+
+
+def _int8_dense_forward(x_sign, k_sign, scaled):
+    if scaled:
+        kscale = jnp.max(jnp.abs(k_sign), axis=0)
+        safe = jnp.where(kscale > 0, kscale, jnp.ones_like(kscale))
+        k8 = jnp.round(k_sign / safe).astype(jnp.int8)
+    else:
+        k8 = jnp.round(k_sign).astype(jnp.int8)
+    x8 = jnp.round(x_sign).astype(jnp.int8)
+    x2, lead = _flatten_leading(x8)
+    out = jax.lax.dot_general(
+        x2, k8, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    ).astype(jnp.float32)
+    out = out.reshape(*lead, -1)
+    return out * safe.astype(jnp.float32) if scaled else out
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(2,))
+def int8_dense(x_sign: Array, k_sign: Array, scaled: bool = True) -> Array:
+    """Dense layer of quantized operands on the int8 MXU path — the
+    dense counterpart of :func:`int8_conv` (exact on {-1, 0, +1} inputs
+    x sign-per-channel-scale kernels, float-matmul gradients)."""
+    return _int8_dense_forward(x_sign, k_sign, scaled)
+
+
+def _int8_dense_fwd(x_sign, k_sign, scaled):
+    return _int8_dense_forward(x_sign, k_sign, scaled), (x_sign, k_sign)
+
+
+def _int8_dense_bwd(scaled, res, g):
+    x_sign, k_sign = res
+    _, vjp = jax.vjp(_float_dense, x_sign, k_sign)
+    dx, dk = vjp(g.astype(x_sign.dtype))
+    return dx.astype(x_sign.dtype), dk.astype(k_sign.dtype)
+
+
+int8_dense.defvjp(_int8_dense_fwd, _int8_dense_bwd)
+
+
 # -- int8 MXU path ----------------------------------------------------------
 
 

@@ -188,7 +188,16 @@ def _check_binary_compute(
 
 
 class QuantDense(nn.Module):
-    """Dense layer with optional input/kernel quantization."""
+    """Dense layer with optional input/kernel quantization.
+
+    ``binary_compute`` selects the executable path when BOTH operands
+    are binarized — same selection as :class:`QuantConv` ("mxu" default,
+    "int8" MXU, "xnor" packed-weight MXU Pallas, "xnor_popcount"
+    bit-serial VPU), with the same loud validation and no silent
+    fallback. ``packed_weights=True`` stores ONLY the bit-packed kernel
+    (+ per-channel scale): the deployment mode for the big binary dense
+    layers (e.g. BinaryAlexNet's, which dominate its parameters).
+    """
 
     features: int
     input_quantizer: Quantizer = None
@@ -196,25 +205,79 @@ class QuantDense(nn.Module):
     kernel_clip: bool = True
     use_bias: bool = True
     dtype: Any = jnp.float32
+    binary_compute: str = "mxu"
+    packed_weights: bool = False
+    pallas_interpret: bool = False
     kernel_init: Callable = nn.initializers.glorot_normal()
     bias_init: Callable = nn.initializers.zeros_init()
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
+        from zookeeper_tpu.ops.binary_compute import (
+            int8_dense,
+            packed_dense_infer,
+            xnor_dense,
+        )
+
         in_q = get_quantizer(self.input_quantizer)
         k_q = get_quantizer(self.kernel_quantizer)
-        kernel = self.param(
-            _kernel_param_name(self.kernel_quantizer),
-            self.kernel_init,
-            (x.shape[-1], self.features),
-            jnp.float32,
+        # Dense has no padding concept; "VALID" satisfies the shared
+        # named-padding check.
+        _check_binary_compute(
+            self.binary_compute, in_q, k_q, self.input_quantizer,
+            self.kernel_quantizer, "VALID", type(self).__name__,
         )
-        if in_q is not None:
-            x = _tag_quant_act(in_q(x))
-        kernel = _apply_clip(kernel, self.kernel_clip)
-        if k_q is not None:
-            kernel = k_q(kernel)
-        y = jnp.dot(x.astype(self.dtype), kernel.astype(self.dtype))
+        ki = x.shape[-1]
+        if self.packed_weights:
+            if self.binary_compute not in ("xnor", "xnor_popcount"):
+                raise ValueError(
+                    "packed_weights=True requires binary_compute='xnor' "
+                    f"or 'xnor_popcount', got {self.binary_compute!r}."
+                )
+            packed = self.param(
+                "kernel_packed",
+                nn.initializers.zeros_init(),
+                (-(-ki // 32), self.features),
+                jnp.int32,
+            )
+            kscale = self.param(
+                "kernel_scale",
+                nn.initializers.ones_init(),
+                (self.features,),
+                jnp.float32,
+            )
+            if in_q is not None:
+                x = _tag_quant_act(in_q(x))
+            y = packed_dense_infer(
+                x, packed, kscale, ki,
+                use_popcount=self.binary_compute == "xnor_popcount",
+                interpret=self.pallas_interpret,
+            ).astype(self.dtype)
+        else:
+            kernel = self.param(
+                _kernel_param_name(self.kernel_quantizer),
+                self.kernel_init,
+                (ki, self.features),
+                jnp.float32,
+            )
+            if in_q is not None:
+                x = _tag_quant_act(in_q(x))
+            kernel = _apply_clip(kernel, self.kernel_clip)
+            if k_q is not None:
+                kernel = k_q(kernel)
+            if self.binary_compute == "int8":
+                y = int8_dense(
+                    x, kernel,
+                    not _int8_kernel_is_unscaled(self.kernel_quantizer),
+                ).astype(self.dtype)
+            elif self.binary_compute in ("xnor", "xnor_popcount"):
+                y = xnor_dense(
+                    x, kernel,
+                    self.binary_compute == "xnor_popcount",
+                    self.pallas_interpret,
+                ).astype(self.dtype)
+            else:
+                y = jnp.dot(x.astype(self.dtype), kernel.astype(self.dtype))
         if self.use_bias:
             bias = self.param("bias", self.bias_init, (self.features,), jnp.float32)
             y = y + bias.astype(self.dtype)

@@ -14,7 +14,10 @@ from typing import Any, Callable, Mapping, Optional, Union
 
 import jax.numpy as jnp
 
-from zookeeper_tpu.ops.binary_compute import pack_conv_kernel
+from zookeeper_tpu.ops.binary_compute import (
+    pack_conv_kernel,
+    pack_dense_kernel,
+)
 from zookeeper_tpu.ops.layers import _apply_clip
 from zookeeper_tpu.ops.quantizers import get_quantizer
 
@@ -27,20 +30,25 @@ def pack_quantconv_params(
 ) -> dict:
     """Convert a float params tree to the packed-weights structure.
 
-    Every 4-D ``kernel`` under a module scope named ``QuantConv*`` is
-    quantized with ``kernel_quantizer`` (+ the layer's read-time clip,
-    matching the training forward) and replaced by ``kernel_packed`` /
-    ``kernel_scale``; everything else (BN, Dense, stems) passes through
-    unchanged. The result loads into the same model built with
+    Every 4-D ``kernel`` under a module scope named ``QuantConv_*`` and
+    every 2-D ``kernel`` under ``QuantDense_*`` is quantized with
+    ``kernel_quantizer`` (+ the layer's read-time clip, matching the
+    training forward) and replaced by ``kernel_packed`` /
+    ``kernel_scale``; everything else (BN, plain Dense, stems) passes
+    through unchanged. The result loads into the same model built with
     ``packed_weights=True``.
 
     ``template``: the deployment model's params STRUCTURE (e.g. from
     ``jax.eval_shape`` of its init — ShapeDtypeStructs suffice). When
-    given, a QuantConv kernel is packed only where the template declares
+    given, a kernel is packed only where the template declares
     ``kernel_packed`` — the mixed per-layer deployment case (pack the
     deep, HBM-bound layers; leave the early compute-bound layers on the
-    plain MXU paths, see BASELINE.md). Without a template every QuantConv
-    kernel is packed.
+    plain MXU paths, see BASELINE.md). Without a template every eligible
+    kernel is packed — which assumes the deployment model declares
+    ``packed_weights=True`` on every Quant layer with a sign-family
+    kernel; for models where some layers cannot run a packed path (e.g.
+    DoReFa-style fractional input quantizers), pass the deployment
+    template so only structurally-declared layers convert.
 
     ``kernel_quantizer`` must match what the model trained with (each zoo
     family uses one kernel quantizer throughout: QuickNet/BinaryNet
@@ -51,18 +59,25 @@ def pack_quantconv_params(
         raise ValueError("pack_quantconv_params requires a kernel quantizer.")
 
     n_converted = 0
-    # Only the 2-D QuantConv layer has a packed deployment structure;
+    # Exactly the layers with a packed deployment structure: the 2-D
+    # QuantConv (4-D kernels) and QuantDense (2-D kernels).
     # QuantConvTranspose/QuantConvND scopes also start with "QuantConv"
-    # but must pass through unchanged (their 4-D/5-D kernels have no
+    # but must pass through unchanged (their kernels have no
     # packed_weights counterpart to load into).
-    qc_scope = re.compile(r"^QuantConv_\d+$")
+    pack_scopes = {
+        re.compile(r"^QuantConv_\d+$"): 4,
+        re.compile(r"^QuantDense_\d+$"): 2,
+    }
 
-    def convert(node: Any, in_quantconv: bool, tnode: Any) -> Any:
+    def convert(node: Any, want_ndim: int, tnode: Any) -> Any:
         nonlocal n_converted
         if isinstance(node, Mapping):
             out = {}
             for key, child in node.items():
-                child_is_qc = in_quantconv or qc_scope.match(key) is not None
+                child_ndim = want_ndim
+                for scope, ndim in pack_scopes.items():
+                    if scope.match(key):
+                        child_ndim = ndim
                 tchild = (
                     tnode.get(key) if isinstance(tnode, Mapping) else None
                 )
@@ -70,22 +85,25 @@ def pack_quantconv_params(
                     isinstance(tnode, Mapping) and "kernel_packed" in tnode
                 )
                 if (
-                    in_quantconv
+                    want_ndim
                     and key == "kernel"
-                    and getattr(child, "ndim", 0) == 4
+                    and getattr(child, "ndim", 0) == want_ndim
                     and want_packed
                 ):
                     q = k_q(_apply_clip(jnp.asarray(child), kernel_clip))
-                    packed, scale = pack_conv_kernel(q)
+                    if want_ndim == 4:
+                        packed, scale = pack_conv_kernel(q)
+                    else:
+                        packed, scale = pack_dense_kernel(q)
                     out["kernel_packed"] = packed
                     out["kernel_scale"] = scale
                     n_converted += 1
                 else:
-                    out[key] = convert(child, child_is_qc, tchild)
+                    out[key] = convert(child, child_ndim, tchild)
             return out
         return node
 
-    out = convert(params, False, template)
+    out = convert(params, 0, template)
     if template is not None:
         expected = sum(
             1
