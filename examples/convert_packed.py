@@ -3,8 +3,9 @@ capability, SURVEY.md §2.4, as a CLI task).
 
 Converts a trained float checkpoint (``TrainingExperiment
 export_model_to=...``) into the bit-packed deployment form: binary conv
-kernels stored as int32 lanes (32x smaller) + per-channel scales,
-loadable into the same model built with ``packed_weights=True``::
+AND dense kernels stored as int32 lanes (32x smaller) + per-channel
+scales, loadable into the same model built with
+``packed_weights=True``::
 
     # 1. Train and export the float model:
     python examples/mnist_experiment.py TrainMnist model=BinaryNet \\
