@@ -262,3 +262,46 @@ def test_xnornet_packed_deployment_includes_dense(tmp_path):
     np.testing.assert_allclose(
         np.asarray(y_float), np.asarray(y_packed), rtol=1e-5, atol=1e-5
     )
+
+
+def test_binaryalexnet_dense_only_packed_deployment():
+    """The measured deployment sweet spot: bf16 convs + packed dense
+    (dense holds ~80% of BinaryAlexNet's params at M = batch). The
+    mixed template converts only the dense kernels and the mixed model
+    is bit-exact vs the float one."""
+    from zookeeper_tpu.core import configure
+    from zookeeper_tpu.models import BinaryAlexNet
+
+    def build(conf):
+        m = BinaryAlexNet()
+        configure(m, conf, name="m")
+        return m.build((67, 67, 3), num_classes=5)
+
+    x = jnp.asarray(
+        np.random.default_rng(60).normal(size=(1, 67, 67, 3)), jnp.float32
+    )
+    float_module = build({})
+    variables = float_module.init(jax.random.PRNGKey(3), x, training=False)
+    y_float = float_module.apply(variables, x, training=False)
+
+    mixed_module = build(
+        {
+            "dense_binary_compute": "xnor",
+            "dense_packed_weights": True,
+            "pallas_interpret": True,
+        }
+    )
+    template = jax.eval_shape(
+        lambda: mixed_module.init(jax.random.PRNGKey(3), x, training=False)
+    )["params"]
+    packed = pack_quantconv_params(variables["params"], template=template)
+    # Only the two dense layers converted; convs keep latent kernels.
+    n_packed = sum(
+        1 for scope in packed.values()
+        if isinstance(scope, dict) and "kernel_packed" in scope
+    )
+    assert n_packed == 2
+    y_mixed = mixed_module.apply(
+        {**variables, "params": packed}, x, training=False
+    )
+    np.testing.assert_array_equal(np.asarray(y_float), np.asarray(y_mixed))

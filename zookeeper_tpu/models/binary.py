@@ -13,7 +13,7 @@ carry too much information to binarize).
 """
 
 from functools import partial
-from typing import Any, Sequence, Tuple, Union
+from typing import Any, Optional, Sequence, Tuple, Union
 
 import flax.linen as nn
 import jax
@@ -42,6 +42,9 @@ class _BinaryNetModule(nn.Module):
     dtype: Any
     binary_compute: str = "mxu"
     packed_weights: bool = False
+    #: None = follow binary_compute / packed_weights (see BinaryAlexNet).
+    dense_binary_compute: Optional[str] = None
+    dense_packed_weights: Optional[bool] = None
     pallas_interpret: bool = False
 
     @nn.compact
@@ -62,12 +65,22 @@ class _BinaryNetModule(nn.Module):
                 x = nn.max_pool(x, (2, 2), strides=(2, 2))
             x = _bn(training, self.dtype)(x)
         x = x.reshape((x.shape[0], -1))
+        dense_bc = (
+            self.binary_compute
+            if self.dense_binary_compute is None
+            else self.dense_binary_compute
+        )
+        dense_packed = (
+            self.packed_weights
+            if self.dense_packed_weights is None
+            else self.dense_packed_weights
+        )
         for u in self.dense_units:
             x = QuantDense(
                 u, input_quantizer="ste_sign", kernel_quantizer="ste_sign",
                 use_bias=False, dtype=self.dtype,
-                binary_compute=self.binary_compute,
-                packed_weights=self.packed_weights,
+                binary_compute=dense_bc,
+                packed_weights=dense_packed,
                 pallas_interpret=self.pallas_interpret,
             )(x)
             x = _bn(training, self.dtype)(x)
@@ -87,6 +100,10 @@ class BinaryNet(Model):
     #: Inference-only: params are the bit-packed kernels (32x smaller);
     #: fill from a float checkpoint with ops.packed.pack_quantconv_params.
     packed_weights: bool = Field(False)
+    #: Dense-stage overrides; unset = follow the conv-stage settings
+    #: (see BinaryAlexNet).
+    dense_binary_compute: str = Field(allow_missing=True)
+    dense_packed_weights: bool = Field(allow_missing=True)
     #: Run Pallas kernels interpreted (CPU tests).
     pallas_interpret: bool = Field(False)
 
@@ -98,6 +115,8 @@ class BinaryNet(Model):
             dtype=self.dtype(),
             binary_compute=self.binary_compute,
             packed_weights=self.packed_weights,
+            dense_binary_compute=getattr(self, "dense_binary_compute", None),
+            dense_packed_weights=getattr(self, "dense_packed_weights", None),
             pallas_interpret=self.pallas_interpret,
         )
 
@@ -110,6 +129,12 @@ class _BinaryAlexNetModule(nn.Module):
     inflation: int = 1
     binary_compute: str = "mxu"
     packed_weights: bool = False
+    #: None = follow binary_compute / packed_weights. The dense layers
+    #: hold ~80% of the params AND run at M = batch (HBM-bound at small
+    #: batch), so dense-only packing is the deployment sweet spot
+    #: (BASELINE.md round-4 measurement).
+    dense_binary_compute: Optional[str] = None
+    dense_packed_weights: Optional[bool] = None
     pallas_interpret: bool = False
 
     @nn.compact
@@ -133,14 +158,24 @@ class _BinaryAlexNetModule(nn.Module):
                 x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
             x = _bn(training, self.dtype)(x)
         x = x.reshape((x.shape[0], -1))
+        dense_bc = (
+            self.binary_compute
+            if self.dense_binary_compute is None
+            else self.dense_binary_compute
+        )
+        dense_packed = (
+            self.packed_weights
+            if self.dense_packed_weights is None
+            else self.dense_packed_weights
+        )
         for u in (4096, 4096):
             # The binary dense layers dominate BinaryAlexNet's parameter
             # count — the packed deployment's biggest 32x win.
             x = QuantDense(
                 u, input_quantizer="ste_sign", kernel_quantizer="ste_sign",
                 use_bias=False, dtype=d,
-                binary_compute=self.binary_compute,
-                packed_weights=self.packed_weights,
+                binary_compute=dense_bc,
+                packed_weights=dense_packed,
                 pallas_interpret=self.pallas_interpret,
             )(x)
             x = _bn(training, self.dtype)(x)
@@ -155,14 +190,27 @@ class BinaryAlexNet(Model):
     inflation: int = Field(1)
     binary_compute: str = Field("mxu")
     packed_weights: bool = Field(False)
+    #: Dense-stage overrides ("" / -1 sentinel unsupported in str/bool
+    #: Fields, so these are separate optional component fields):
+    #: allow_missing = follow the conv-stage settings. Dense-only packing
+    #: ("xnor" + True here, mxu convs) is the measured deployment sweet
+    #: spot (BASELINE.md).
+    dense_binary_compute: str = Field(allow_missing=True)
+    dense_packed_weights: bool = Field(allow_missing=True)
     pallas_interpret: bool = Field(False)
 
     def build(self, input_shape, num_classes: int) -> nn.Module:
+        # allow_missing fields raise AttributeError on read; getattr's
+        # default maps that to "follow the conv-stage settings".
+        dense_bc = getattr(self, "dense_binary_compute", None)
+        dense_packed = getattr(self, "dense_packed_weights", None)
         return _BinaryAlexNetModule(
             num_classes=num_classes, dtype=self.dtype(),
             inflation=self.inflation,
             binary_compute=self.binary_compute,
             packed_weights=self.packed_weights,
+            dense_binary_compute=dense_bc,
+            dense_packed_weights=dense_packed,
             pallas_interpret=self.pallas_interpret,
         )
 
@@ -620,6 +668,9 @@ class _XnorNetModule(nn.Module):
     dtype: Any
     binary_compute: str = "mxu"
     packed_weights: bool = False
+    #: None = follow binary_compute / packed_weights (see BinaryAlexNet).
+    dense_binary_compute: Optional[str] = None
+    dense_packed_weights: Optional[bool] = None
     pallas_interpret: bool = False
 
     @nn.compact
@@ -652,13 +703,23 @@ class _XnorNetModule(nn.Module):
         x = _bn(training, d)(x)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
         x = x.reshape((x.shape[0], -1))
+        dense_bc = (
+            self.binary_compute
+            if self.dense_binary_compute is None
+            else self.dense_binary_compute
+        )
+        dense_packed = (
+            self.packed_weights
+            if self.dense_packed_weights is None
+            else self.dense_packed_weights
+        )
         for u in (4096, 4096):
             x = QuantDense(
                 u, input_quantizer="ste_sign",
                 kernel_quantizer="magnitude_aware_sign",
                 use_bias=False, dtype=d,
-                binary_compute=self.binary_compute,
-                packed_weights=self.packed_weights,
+                binary_compute=dense_bc,
+                packed_weights=dense_packed,
                 pallas_interpret=self.pallas_interpret,
             )(x)
             x = _bn(training, d)(x)
@@ -672,6 +733,10 @@ class XNORNet(Model):
 
     binary_compute: str = Field("mxu")
     packed_weights: bool = Field(False)
+    #: Dense-stage overrides; unset = follow the conv-stage settings
+    #: (see BinaryAlexNet).
+    dense_binary_compute: str = Field(allow_missing=True)
+    dense_packed_weights: bool = Field(allow_missing=True)
     pallas_interpret: bool = Field(False)
 
     def build(self, input_shape, num_classes: int) -> nn.Module:
@@ -679,6 +744,8 @@ class XNORNet(Model):
             num_classes=num_classes, dtype=self.dtype(),
             binary_compute=self.binary_compute,
             packed_weights=self.packed_weights,
+            dense_binary_compute=getattr(self, "dense_binary_compute", None),
+            dense_packed_weights=getattr(self, "dense_packed_weights", None),
             pallas_interpret=self.pallas_interpret,
         )
 
