@@ -1,0 +1,88 @@
+"""Batch-1 (or any batch) inference-latency benchmark as a CLI task.
+
+Measures a model's forward latency with the tunnel-safe on-device
+scan-chain methodology (``zookeeper_tpu.training.benchmark``), optionally
+loading an exported checkpoint — so deployment-mode comparisons (bf16 vs
+int8 vs packed, BASELINE.md's tables) are one command each::
+
+    # Fresh-init QuickNet, bf16, batch-1:
+    python examples/latency_bench.py LatencyBench model=QuickNet \\
+        model.compute_dtype=bfloat16
+
+    # Packed deployment from a converted checkpoint:
+    python examples/latency_bench.py LatencyBench model=QuickNet \\
+        model.binary_compute=xnor model.packed_weights=True \\
+        checkpoint=/tmp/packed_model
+
+Prints one JSON line: {"model", "batch_size", "ms_per_inference",
+"params_mib"}.
+"""
+
+import json
+from typing import Optional
+
+from zookeeper_tpu import ComponentField, Field, cli, task
+from zookeeper_tpu.models import Model
+from zookeeper_tpu.training import Experiment
+
+
+@task
+class LatencyBench(Experiment):
+    """Measure forward latency of a model (optionally from a checkpoint)."""
+
+    model: Model = ComponentField()
+    #: Optional model-only checkpoint (save_model / ConvertPacked output);
+    #: fresh-initialized params otherwise.
+    checkpoint: Optional[str] = Field(None)
+    batch_size: int = Field(1)
+    height: int = Field(224)
+    width: int = Field(224)
+    channels: int = Field(3)
+    num_classes: int = Field(1000)
+    chain_length: int = Field(50)
+    rounds: int = Field(4)
+
+    def run(self) -> dict:
+        import jax
+
+        from zookeeper_tpu.training.benchmark import (
+            measure_inference_latency,
+        )
+
+        input_shape = (self.height, self.width, self.channels)
+        module = self.model.build(input_shape, self.num_classes)
+        if self.checkpoint:
+            from zookeeper_tpu.training.checkpoint import (
+                load_exported_model,
+            )
+
+            params, model_state = load_exported_model(
+                self.checkpoint, self.model, module, input_shape
+            )
+        else:
+            params, model_state = self.model.initialize(module, input_shape)
+        variables = {"params": params, **model_state}
+        seconds = measure_inference_latency(
+            module,
+            variables,
+            input_shape,
+            batch_size=self.batch_size,
+            dtype=self.model.dtype(),
+            length=self.chain_length,
+            rounds=self.rounds,
+        )
+        params_bytes = sum(
+            p.size * p.dtype.itemsize for p in jax.tree.leaves(params)
+        )
+        result = {
+            "model": type(self.model).__name__,
+            "batch_size": self.batch_size,
+            "ms_per_inference": round(seconds * 1e3, 4),
+            "params_mib": round(params_bytes / 2**20, 2),
+        }
+        print(json.dumps(result))
+        return result
+
+
+if __name__ == "__main__":
+    cli()

@@ -1,0 +1,33 @@
+"""Latency-measurement utility (training.benchmark)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from zookeeper_tpu.training.benchmark import scan_chain_latency
+
+
+def test_scan_chain_latency_heavy_apply_measurable_and_ordered():
+    """A work-heavy apply (20 chained 256x256 matmuls, ~ms per call on
+    CPU — far above dispatch/timer jitter) must measure strictly positive
+    and slower than a single-matmul apply."""
+    x = jnp.asarray(
+        np.random.default_rng(0).normal(size=(256, 256)), jnp.float32
+    )
+
+    def heavy(v):
+        for _ in range(20):
+            v = v @ x
+        return v
+
+    t_heavy = scan_chain_latency(heavy, x, length=8, rounds=3)
+    t_light = scan_chain_latency(lambda v: v @ x, x, length=8, rounds=3)
+    assert t_heavy > 1e-6  # genuinely measured, not the noise floor
+    assert t_heavy > t_light
+
+
+def test_scan_chain_latency_never_negative_or_zero():
+    """Noise-dominated measurements floor at a tiny positive value (the
+    'unmeasurably fast, raise length' signal), never negative/zero."""
+    x = jnp.ones((4,))
+    t = scan_chain_latency(lambda v: v + 1.0, x, length=2, rounds=1)
+    assert t > 0.0

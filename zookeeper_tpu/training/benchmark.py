@@ -1,0 +1,89 @@
+"""On-device latency measurement utilities.
+
+The trustworthy way to time TPU inference through a remote tunnel
+(BASELINE.md methodology, battle-tested in rounds 2-4): per-dispatch
+Python-loop timing is invalid there (``block_until_ready`` returns early
+and per-call dispatch jitter swamps small kernels), so chains of
+data-dependent applies run INSIDE one compiled ``lax.scan`` — one
+dispatch per chain — and the marginal time over two chain lengths
+cancels the fixed dispatch + sync overhead. ``device_get`` is the
+completion barrier.
+"""
+
+import time
+from typing import Any, Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def scan_chain_latency(
+    apply_fn: Callable[[Any], Any],
+    x: Any,
+    *,
+    length: int = 50,
+    rounds: int = 4,
+) -> float:
+    """Marginal seconds per ``apply_fn(x)`` call.
+
+    ``apply_fn`` must be a pure function of its input returning an array
+    (e.g. ``lambda x: module.apply(variables, x, training=False)``). The
+    chain feeds a data-dependent scalar of each output back into the
+    next input, so XLA can neither hoist the apply out of the loop nor
+    dead-code-eliminate it; timing is min-over-``rounds`` per chain
+    length (min over additive non-negative noise is sound), marginal
+    over lengths ``length`` and ``2 * length``.
+    """
+
+    def chain(k: int):
+        @jax.jit
+        def run(xx):
+            def body(carry, _):
+                y = apply_fn(carry)
+                s = (jnp.sum(y) * 1e-12).astype(xx.dtype)
+                return xx + s, jnp.ravel(y)[0]
+
+            _, ys = jax.lax.scan(body, xx, None, length=k)
+            return ys[-1]
+
+        return run
+
+    run_n, run_2n = chain(length), chain(2 * length)
+    # Compile + warm both lengths before timing.
+    float(jax.device_get(run_n(x)))
+    float(jax.device_get(run_2n(x)))
+    best_n = best_2n = np.inf
+    for _ in range(rounds):
+        t0 = time.perf_counter()
+        float(jax.device_get(run_n(x)))
+        best_n = min(best_n, time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        float(jax.device_get(run_2n(x)))
+        best_2n = min(best_2n, time.perf_counter() - t0)
+    # Same floor as bench.py: a non-positive marginal means the apply is
+    # below measurement noise at this chain length — the ~0 result says
+    # "unmeasurably fast here, raise `length`", never a negative time.
+    return max(best_2n - best_n, 1e-9) / length
+
+
+def measure_inference_latency(
+    module: Any,
+    variables: Any,
+    input_shape: Tuple[int, ...],
+    *,
+    batch_size: int = 1,
+    dtype: Any = jnp.float32,
+    length: int = 50,
+    rounds: int = 4,
+    seed: int = 0,
+) -> float:
+    """Seconds per forward pass of ``module.apply`` at ``batch_size``."""
+    rng = np.random.default_rng(seed)
+    x = jnp.asarray(rng.normal(size=(batch_size, *input_shape)), dtype)
+    return scan_chain_latency(
+        lambda xx: module.apply(variables, xx, training=False),
+        x,
+        length=length,
+        rounds=rounds,
+    )
