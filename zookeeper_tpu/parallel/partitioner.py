@@ -16,7 +16,7 @@ derived by XLA from the shardings.
   sharded over all data-like axes.
 """
 
-from typing import Any, Callable, List, Optional, Sequence, Tuple
+from typing import Any, Callable, List, Optional, Sequence
 
 import jax
 import numpy as np
