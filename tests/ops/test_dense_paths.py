@@ -305,3 +305,29 @@ def test_binaryalexnet_dense_only_packed_deployment():
         {**variables, "params": packed}, x, training=False
     )
     np.testing.assert_array_equal(np.asarray(y_float), np.asarray(y_mixed))
+
+
+def test_model_summary_counts_packed_dense_weights():
+    """models.summary accounts packed DENSE kernels as 1-bit deployment
+    weights (32 true weights per stored int32 lane), same as convs."""
+    import flax.linen as nn
+
+    from zookeeper_tpu.models import model_summary
+
+    class Net(nn.Module):
+        @nn.compact
+        def __call__(self, x, training=False):
+            x = x.reshape((x.shape[0], -1))
+            return QuantDense(
+                8, input_quantizer="ste_sign", kernel_quantizer="ste_sign",
+                use_bias=False, binary_compute="xnor", packed_weights=True,
+                pallas_interpret=True,
+            )(x)
+
+    s = model_summary(Net(), (4, 8, 2))  # K = 64 -> 2 packed words
+    packed_rows = [r for r in s.rows if r.packed]
+    assert len(packed_rows) == 1
+    row = packed_rows[0]
+    # True weight count restored from the packed lanes: 64 * 8.
+    assert row.weight_count == 64 * 8
+    assert row.deploy_bits == 1
