@@ -133,21 +133,24 @@ class ConvertPacked(Experiment):
 
         s_f = model_summary(module_f, input_shape)
         s_p = model_summary(module_p, input_shape)
-        conv_f = sum(
-            r.train_bytes for r in s_f.rows if r.binary and "Conv" in r.path
-        )
-        conv_p = sum(
+        # Symmetric accounting over the BINARY kernels (conv + dense):
+        # numerator = their float train bytes; denominator = the same
+        # logical kernels in the deployment model — packed rows (binary-
+        # flagged), still-unpacked binary kernels (mixed deployments,
+        # the never-packed stem), and the per-channel scales.
+        binary_f = sum(r.train_bytes for r in s_f.rows if r.binary)
+        binary_p = sum(
             r.train_bytes
             for r in s_p.rows
-            if "kernel_packed" in r.path or "kernel_scale" in r.path
+            if r.binary or "kernel_scale" in r.path
         )
         print(
             f"converted {self.checkpoint} -> {self.output}\n"
             f"  whole model: {s_f.train_bytes / 2**20:.2f} MiB -> "
-            f"{sum(r.train_bytes for r in s_p.rows) / 2**20:.2f} MiB\n"
-            f"  binary conv kernels: {conv_f / 2**10:.1f} KiB -> "
-            f"{conv_p / 2**10:.1f} KiB "
-            f"({conv_f / max(conv_p, 1):.1f}x)\n"
+            f"{s_p.train_bytes / 2**20:.2f} MiB\n"
+            f"  binary kernels (conv + dense): {binary_f / 2**10:.1f} KiB -> "
+            f"{binary_p / 2**10:.1f} KiB "
+            f"({binary_f / max(binary_p, 1):.1f}x)\n"
             f"  verified max |forward diff| = {max_diff}"
         )
         return self.output
