@@ -94,3 +94,18 @@ def test_cifar_binarynet_task():
         "track_flip_ratio=True",
     )
     assert "epoch 1/1" in out
+
+
+def test_latency_bench_task():
+    out = run_example(
+        "latency_bench.py", "LatencyBench",
+        "model=Mlp", "model.hidden_units=(16,)",
+        "height=8", "width=8", "channels=1", "num_classes=4",
+        "chain_length=4", "rounds=2", "batch_size=2",
+    )
+    import json
+
+    result = json.loads(out.strip().splitlines()[-1])
+    assert result["model"] == "Mlp"
+    assert result["ms_per_inference"] >= 0.0
+    assert result["params_mib"] >= 0.0
