@@ -181,6 +181,30 @@ def bn_batch(n=32, seed=0):
     return {"input": jnp.asarray(x), "target": jnp.asarray(labels)}
 
 
+def assert_bn_training_parity(state1, state2, m1, m2):
+    """Shared parity gates for the BN-under-sharding tests (tolerance
+    calibration documented in test_bn_dp_parity_params_and_batch_stats)."""
+    np.testing.assert_allclose(float(m1["loss"]), float(m2["loss"]), rtol=1e-5)
+    for (p1, a), (_, b) in zip(
+        jax.tree_util.tree_flatten_with_path(
+            state1.model_state["batch_stats"]
+        )[0],
+        jax.tree_util.tree_flatten_with_path(
+            state2.model_state["batch_stats"]
+        )[0],
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=0, atol=2e-3,
+            err_msg=f"batch_stats diverged at {p1}",
+        )
+    for a, b in zip(
+        jax.tree.leaves(state1.params), jax.tree.leaves(state2.params)
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=0, atol=0.04
+        )
+
+
 def test_bn_dp_parity_params_and_batch_stats():
     """SYNCED-BN semantics, pinned: under pjit the BN mean/var reductions
     run over the GLOBAL (cross-device) batch because XLA derives the
@@ -205,30 +229,15 @@ def test_bn_dp_parity_params_and_batch_stats():
         state1, m1 = step1(state1, batch)
         state2, m2 = step2(state2, sharded)
 
-    np.testing.assert_allclose(float(m1["loss"]), float(m2["loss"]), rtol=1e-5)
-    stats1 = state1.model_state["batch_stats"]
-    stats2 = state2.model_state["batch_stats"]
-    for (p1, a), (p2, b) in zip(
-        jax.tree_util.tree_flatten_with_path(stats1)[0],
-        jax.tree_util.tree_flatten_with_path(stats2)[0],
-    ):
-        # Tolerance calibration: synced-BN parity is exact up to the
-        # cross-device reduction's fp reassociation (~1e-4 abs). LOCAL
-        # per-replica BN (4-example shards vs the 32-example global
-        # batch) would diverge at the ~1e-1 level — three orders of
-        # magnitude above this gate, so the test pins the semantics.
-        np.testing.assert_allclose(
-            np.asarray(a), np.asarray(b), rtol=0, atol=2e-3,
-            err_msg=f"batch_stats diverged at {p1}",
-        )
-    # Params: Adam divides by sqrt(v), so for near-zero gradients the
-    # per-step update is +-lr with the SIGN decided at fp-noise level —
-    # reassociation differences legitimately amplify to ~lr (1e-2) per
-    # step. Gate at 3 steps x lr; a true BN-semantics bug diverges O(1).
-    for a, b in zip(
-        jax.tree.leaves(state1.params), jax.tree.leaves(state2.params)
-    ):
-        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=0, atol=0.04)
+    # Tolerance calibration: synced-BN parity is exact up to the
+    # cross-device reduction's fp reassociation (~1e-4 abs). LOCAL
+    # per-replica BN (4-example shards vs the 32-example global batch)
+    # would diverge at the ~1e-1 level — three orders of magnitude above
+    # the gate, so the test pins the semantics. Params gate: Adam divides
+    # by sqrt(v), so near-zero gradients update +-lr with the SIGN
+    # decided at fp-noise level — gate at 3 steps x lr; a true
+    # BN-semantics bug diverges O(1).
+    assert_bn_training_parity(state1, state2, m1, m2)
 
 
 # -- Tensor parallelism for the conv zoo ------------------------------------
@@ -641,20 +650,4 @@ def test_fsdp_bn_custom_vjp_parity():
         state1, m1 = step1(state1, batch)
         state2, m2 = step2(state2, sharded)
 
-    np.testing.assert_allclose(float(m1["loss"]), float(m2["loss"]), rtol=1e-5)
-    for (p1, a), (_, b) in zip(
-        jax.tree_util.tree_flatten_with_path(
-            state1.model_state["batch_stats"]
-        )[0],
-        jax.tree_util.tree_flatten_with_path(
-            state2.model_state["batch_stats"]
-        )[0],
-    ):
-        np.testing.assert_allclose(
-            np.asarray(a), np.asarray(b), rtol=0, atol=2e-3,
-            err_msg=f"batch_stats diverged at {p1}",
-        )
-    for a, b in zip(
-        jax.tree.leaves(state1.params), jax.tree.leaves(state2.params)
-    ):
-        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=0, atol=0.04)
+    assert_bn_training_parity(state1, state2, m1, m2)
