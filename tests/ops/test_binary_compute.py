@@ -64,6 +64,18 @@ def test_int8_matmul_matches_float():
     )
 
 
+def test_int8_matmul_preserves_zeros():
+    # Same "exact on {-1, 0, +1}" contract as int8_conv: a literal 0
+    # operand contributes 0, not sign(0)-mapped garbage.
+    a = np.array(random_signs((8, 32), seed=9))
+    b = np.array(random_signs((32, 4), seed=10))
+    a[:, ::3] = 0.0
+    b[::5, :] = 0.0
+    np.testing.assert_array_equal(
+        np.asarray(int8_matmul(jnp.asarray(a), jnp.asarray(b))), a @ b
+    )
+
+
 def test_int8_conv_matches_float_conv():
     x = random_signs((2, 8, 8, 16), seed=7)
     k = random_signs((3, 3, 16, 8), seed=8)

@@ -1,0 +1,109 @@
+"""QuantLocallyConnected1D/2D: unshared-weight convs (larq surface
+parity, VERDICT round-2 missing #4). Oracle: per-position patch-matmul —
+``conv_general_dilated_patches`` + einsum is an independent compute path
+from ``conv_general_dilated_local``.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from zookeeper_tpu.ops import (
+    QuantLocallyConnected1D,
+    QuantLocallyConnected2D,
+)
+
+
+def _patch_oracle(x, kernel, bias, kernel_size, strides, padding):
+    """Reference: extract patches, per-position matmul, add bias."""
+    rank = len(kernel_size)
+    dims = ("NHWC", "HWIO", "NHWC") if rank == 2 else ("NWC", "WIO", "NWC")
+    patches = jax.lax.conv_general_dilated_patches(
+        x, kernel_size, strides, padding, dimension_numbers=dims
+    )
+    eq = "nhwk,hwko->nhwo" if rank == 2 else "nwk,wko->nwo"
+    out = jnp.einsum(eq, patches, kernel)
+    return out + bias if bias is not None else out
+
+
+@pytest.mark.parametrize("padding,strides", [
+    ("VALID", (1, 1)),
+    ("SAME", (2, 2)),
+    (((1, 0), (0, 2)), (1, 2)),
+])
+def test_local2d_matches_patch_oracle(padding, strides):
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(2, 7, 6, 3)), jnp.float32)
+    layer = QuantLocallyConnected2D(
+        features=5, kernel_size=(3, 3), strides=strides, padding=padding
+    )
+    variables = layer.init(jax.random.PRNGKey(1), x)
+    y = layer.apply(variables, x)
+    params = variables["params"]
+    ref = _patch_oracle(
+        x, params["kernel_fp"], params["bias"], (3, 3), strides, padding
+    )
+    assert y.shape == ref.shape
+    np.testing.assert_allclose(np.asarray(y), np.asarray(ref), atol=1e-5)
+    # The kernel is genuinely per-position: (out_h, out_w, kh*kw*ci, co).
+    assert params["kernel_fp"].shape[:2] == y.shape[1:3]
+    assert params["bias"].shape == y.shape[1:]
+
+
+def test_local1d_matches_patch_oracle():
+    rng = np.random.default_rng(2)
+    x = jnp.asarray(rng.normal(size=(3, 9, 4)), jnp.float32)
+    layer = QuantLocallyConnected1D(
+        features=6, kernel_size=(3,), strides=(2,), padding="SAME"
+    )
+    variables = layer.init(jax.random.PRNGKey(3), x)
+    y = layer.apply(variables, x)
+    params = variables["params"]
+    ref = _patch_oracle(
+        x, params["kernel_fp"], params["bias"], (3,), (2,), "SAME"
+    )
+    np.testing.assert_allclose(np.asarray(y), np.asarray(ref), atol=1e-5)
+
+
+def test_local2d_quantized_forward_and_grad():
+    """ste_sign input+kernel: forward equals the oracle on binarized
+    operands; gradients flow to the latent kernel (STE), i.e. the layer
+    trains like the other Quant* layers."""
+    rng = np.random.default_rng(4)
+    x = jnp.asarray(rng.normal(size=(2, 5, 5, 2)), jnp.float32)
+    layer = QuantLocallyConnected2D(
+        features=3, kernel_size=(3, 3), padding="VALID",
+        input_quantizer="ste_sign", kernel_quantizer="ste_sign",
+        use_bias=False,
+    )
+    variables = layer.init(jax.random.PRNGKey(5), x)
+    y = layer.apply(variables, x)
+    k = variables["params"]["kernel"]
+    ref = _patch_oracle(
+        jnp.sign(x), jnp.sign(k), None, (3, 3), (1, 1), "VALID"
+    )
+    np.testing.assert_allclose(np.asarray(y), np.asarray(ref), atol=1e-5)
+
+    def loss(params):
+        return (layer.apply({"params": params}, x) ** 2).sum()
+
+    grads = jax.grad(loss)(variables["params"])
+    assert float(jnp.abs(grads["kernel"]).sum()) > 0.0
+
+
+def test_local_rejects_binary_compute_modes():
+    x = jnp.ones((1, 5, 5, 2))
+    for mode in ("int8", "xnor", "xnor_popcount"):
+        layer = QuantLocallyConnected2D(
+            features=3, input_quantizer="ste_sign",
+            kernel_quantizer="ste_sign", binary_compute=mode,
+        )
+        with pytest.raises(ValueError, match="only 'mxu'"):
+            layer.init(jax.random.PRNGKey(0), x)
+
+
+def test_local_rank_mismatch_is_loud():
+    layer = QuantLocallyConnected1D(features=2)
+    with pytest.raises(ValueError, match="rank-3"):
+        layer.init(jax.random.PRNGKey(0), jnp.ones((1, 5, 5, 2)))

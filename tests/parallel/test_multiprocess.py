@@ -100,6 +100,9 @@ def test_two_process_cluster_pipeline_and_sharded_checkpoint(tmp_path):
         # reduce-scatter produced a finite loss.
         assert r["fsdp_param_sharded"]
         assert np.isfinite(r["fsdp_loss"])
+        # dp×tp leg: TP rules sharded every dense kernel on 'model'
+        # while the 'data' axis spanned the process boundary.
+        assert r["tp_kernel_sharded"]
     # The collective produced the SAME global means on both hosts — the
     # global batch was assembled correctly from per-host slices.
     np.testing.assert_allclose(results[0]["means"], results[1]["means"], rtol=1e-6)
@@ -109,4 +112,10 @@ def test_two_process_cluster_pipeline_and_sharded_checkpoint(tmp_path):
     for r in results:
         np.testing.assert_allclose(
             r["fsdp_loss"], r["fsdp_ref_loss"], rtol=1e-5
+        )
+        # The dp×tp step matches the same oracle (TP partial-sum
+        # reassociation allows a little more float noise than FSDP's
+        # bitwise-equivalent all-gather layout).
+        np.testing.assert_allclose(
+            r["tp_loss"], r["fsdp_ref_loss"], rtol=1e-4
         )

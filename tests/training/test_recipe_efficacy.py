@@ -1,0 +1,264 @@
+"""Recipe EFFICACY A/B tests (VERDICT round-2 #1): the advanced recipes
+exist to buy accuracy, so each one must demonstrably beat (or at least
+not lose to) its baseline on REAL offline data — not merely execute.
+
+All runs are seed-deterministic (dataset permutation, init, and the
+(epoch, index)-keyed pipeline are all derived from fixed seeds), so the
+pinned margins are reproducible, with headroom for minor numeric drift.
+Measured deltas are recorded in BASELINE.md ("Recipe efficacy" section).
+
+Regimes are chosen where each recipe's mechanism has something to do:
+- KD: noisy-label training (the clean-label teacher regularizes away the
+  corrupted hard labels — with plentiful clean labels KD has nothing to
+  transfer and measures as a wash; that null result is in BASELINE.md).
+- Bop vs Adam-latent: the flagship binary question, plain digits.
+- EMA: a deliberately high learning rate so raw binary-net weights are
+  still oscillating when training stops.
+- Label smoothing: plain recipe, must not hurt.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from zookeeper_tpu.core import configure
+from zookeeper_tpu.training import DistillationExperiment, TrainingExperiment
+
+pytest.importorskip("sklearn")
+
+
+def _digits_conf(extra=None):
+    return {
+        "loader.dataset": "SklearnDigits",
+        "loader.preprocessing": "ImageClassificationPreprocessing",
+        "loader.preprocessing.height": 8,
+        "loader.preprocessing.width": 8,
+        "loader.preprocessing.channels": 1,
+        "loader.host_index": 0,
+        "loader.host_count": 1,
+        "batch_size": 32,
+        "verbose": False,
+        **(extra or {}),
+    }
+
+
+def _tail_mean(history, k=3):
+    accs = [v["accuracy"] for v in history["validation"]]
+    return float(np.mean(accs[-k:]))
+
+
+@pytest.mark.slow
+def test_kd_beats_no_kd_under_label_noise(tmp_path):
+    """A clean-label teacher lifts a student trained on 40%-corrupted
+    hard labels: KD val accuracy (last-3 mean) beats the same student
+    without KD by a pinned margin.
+
+    Measured (calibration run, this box): alone 0.924, KD(alpha=0.3,
+    T=2) 0.951 — a +2.6pt lift; margin pinned at 1pt."""
+    teacher_path = str(tmp_path / "teacher")
+    teacher = TrainingExperiment()
+    configure(
+        teacher,
+        _digits_conf({
+            "model": "SimpleCnn",
+            "model.features": (16, 32),
+            "model.dense_units": (64,),
+            "epochs": 6,
+            "export_model_to": teacher_path,
+        }),
+        name="teacher",
+    )
+    t_hist = teacher.run()
+    assert t_hist["validation"][-1]["accuracy"] >= 0.95
+
+    student = {
+        "loader.dataset.label_noise_fraction": 0.4,
+        "model": "Mlp",
+        "model.hidden_units": (32,),
+        "epochs": 14,
+    }
+    alone = TrainingExperiment()
+    configure(alone, _digits_conf(dict(student)), name="alone")
+    alone_hist = alone.run()
+
+    kd = DistillationExperiment()
+    configure(
+        kd,
+        _digits_conf({
+            **student,
+            "teacher": "SimpleCnn",
+            "teacher.features": (16, 32),
+            "teacher.dense_units": (64,),
+            "teacher_checkpoint": teacher_path,
+            "alpha": 0.3,
+            "temperature": 2.0,
+        }),
+        name="kd",
+    )
+    kd_hist = kd.run()
+
+    alone_acc, kd_acc = _tail_mean(alone_hist), _tail_mean(kd_hist)
+    assert alone_acc >= 0.88, f"noisy-label baseline collapsed: {alone_acc}"
+    assert kd_acc >= alone_acc + 0.01, (
+        f"KD did not beat the no-KD student: kd={kd_acc:.4f} "
+        f"alone={alone_acc:.4f}"
+    )
+
+
+@pytest.mark.slow
+def test_bop_matches_adam_latent_recipe():
+    """Bop (the binary-native optimizer) trains BinaryNet to within a few
+    points of the Adam-on-latent-weights recipe on real digits.
+
+    Measured (calibration): Adam best 0.984, Bop best 0.997 — Bop
+    actually WINS here; pinned as within-3pts + an absolute floor."""
+    base = {
+        "model": "BinaryNet",
+        "model.features": (32, 32),
+        "model.dense_units": (64,),
+        "epochs": 8,
+        "batch_size": 64,
+    }
+    adam = TrainingExperiment()
+    configure(
+        adam,
+        _digits_conf({**base, "optimizer.schedule.base_lr": 5e-3}),
+        name="adam",
+    )
+    adam_hist = adam.run()
+    bop = TrainingExperiment()
+    configure(bop, _digits_conf({**base, "optimizer": "Bop"}), name="bop")
+    bop_hist = bop.run()
+
+    adam_best = max(v["accuracy"] for v in adam_hist["validation"])
+    bop_best = max(v["accuracy"] for v in bop_hist["validation"])
+    assert bop_best >= 0.93, f"Bop absolute floor: {bop_best:.4f}"
+    assert bop_best >= adam_best - 0.03, (
+        f"Bop lost to Adam-latent by more than 3pts: bop={bop_best:.4f} "
+        f"adam={adam_best:.4f}"
+    )
+
+
+@pytest.mark.slow
+def test_ema_eval_beats_raw_eval_late_in_run():
+    """With a high LR the raw binary-net weights are still oscillating at
+    the end of training; the EMA weights (what ships) must evaluate
+    better than the raw ones on the SAME final state.
+
+    Measured (calibration): raw 0.944, EMA 0.984 — +4pts; margin pinned
+    at 1pt (plus an EMA-loss <= raw-loss check)."""
+    from zookeeper_tpu.training.experiment import run_weighted_eval
+    from zookeeper_tpu.training.step import make_eval_step
+
+    exp = TrainingExperiment()
+    configure(
+        exp,
+        _digits_conf({
+            "model": "BinaryNet",
+            "model.features": (32, 32),
+            "model.dense_units": (64,),
+            "epochs": 8,
+            "batch_size": 64,
+            "optimizer.schedule.base_lr": 1e-2,
+            "ema_decay": 0.95,
+        }),
+        name="ema_exp",
+    )
+    exp.run()
+    state = exp.final_state
+    raw = run_weighted_eval(
+        exp.loader, "validation", jax.jit(make_eval_step(use_ema=False)),
+        state, None, epoch=0,
+    )
+    ema = run_weighted_eval(
+        exp.loader, "validation", jax.jit(make_eval_step(use_ema=True)),
+        state, None, epoch=0,
+    )
+    assert ema["accuracy"] >= 0.95, f"EMA floor: {ema['accuracy']:.4f}"
+    assert ema["accuracy"] >= raw["accuracy"] + 0.01, (
+        f"EMA eval did not beat raw eval: ema={ema['accuracy']:.4f} "
+        f"raw={raw['accuracy']:.4f}"
+    )
+    assert ema["loss"] <= raw["loss"], (
+        f"EMA loss worse than raw: {ema['loss']:.4f} vs {raw['loss']:.4f}"
+    )
+
+
+@pytest.mark.slow
+def test_label_smoothing_does_not_hurt():
+    """Label smoothing 0.1 (the ImageNet-recipe default) must not cost
+    accuracy on the fp baseline.
+
+    Measured (calibration): plain 0.969, smoothed 0.972 final (best
+    0.969 vs 0.975) — pinned as within-1pt, i.e. 'not hurting'."""
+    base = {
+        "model": "SimpleCnn",
+        "model.features": (16, 32),
+        "model.dense_units": (64,),
+        "epochs": 5,
+        "batch_size": 64,
+    }
+    plain = TrainingExperiment()
+    configure(plain, _digits_conf(dict(base)), name="plain")
+    plain_hist = plain.run()
+    smooth = TrainingExperiment()
+    configure(
+        smooth, _digits_conf({**base, "label_smoothing": 0.1}), name="smooth"
+    )
+    smooth_hist = smooth.run()
+
+    p_final = plain_hist["validation"][-1]["accuracy"]
+    s_final = smooth_hist["validation"][-1]["accuracy"]
+    assert s_final >= 0.94, f"smoothed floor: {s_final:.4f}"
+    assert s_final >= p_final - 0.01, (
+        f"label smoothing hurt: smooth={s_final:.4f} plain={p_final:.4f}"
+    )
+
+
+def test_digits_label_noise_is_deterministic_and_scoped():
+    """The noise knob: deterministic in seed, train-only, ~the requested
+    fraction actually corrupted, validation untouched."""
+    from zookeeper_tpu.data import SklearnDigits
+
+    clean = SklearnDigits()
+    configure(clean, {"seed": 3}, name="clean")
+    noisy = SklearnDigits()
+    configure(noisy, {"seed": 3, "label_noise_fraction": 0.4}, name="noisy")
+    noisy2 = SklearnDigits()
+    configure(noisy2, {"seed": 3, "label_noise_fraction": 0.4}, name="noisy2")
+
+    def labels(src):
+        return np.asarray([src[i]["label"] for i in range(len(src))])
+
+    lc, ln = labels(clean.train()), labels(noisy.train())
+    frac = float(np.mean(lc != ln))
+    assert 0.35 <= frac <= 0.45, frac  # every corrupted label is wrong
+    np.testing.assert_array_equal(ln, labels(noisy2.train()))
+    np.testing.assert_array_equal(
+        labels(clean.validation()), labels(noisy.validation())
+    )
+    # Images are untouched in both splits.
+    np.testing.assert_array_equal(
+        np.asarray(clean.train()[0]["image"]),
+        np.asarray(noisy.train()[0]["image"]),
+    )
+
+
+def test_digits_train_fraction_scopes_train_only():
+    from zookeeper_tpu.data import SklearnDigits
+
+    full = SklearnDigits()
+    configure(full, {"seed": 3}, name="full")
+    frac = SklearnDigits()
+    configure(frac, {"seed": 3, "train_fraction": 0.1}, name="frac")
+    assert len(frac.train()) == int(round(len(full.train()) * 0.1))
+    assert len(frac.validation()) == len(full.validation())
+    # The kept slice is a PREFIX of the full (seed-shuffled) train split.
+    np.testing.assert_array_equal(
+        np.asarray(frac.train()[0]["image"]),
+        np.asarray(full.train()[0]["image"]),
+    )
+    with pytest.raises(ValueError, match="train_fraction"):
+        bad = SklearnDigits()
+        configure(bad, {"train_fraction": 0.0}, name="bad")
+        bad.train()

@@ -27,17 +27,14 @@ from zookeeper_tpu.core import (
     task,
 )
 
-# Single-sourced from pyproject.toml: installed-package metadata first,
-# else (source checkout on sys.path, no dist-info) the adjacent
-# pyproject.toml itself. The last-resort sentinel is a deliberate
-# non-version so a stale hard-coded number can never masquerade as real.
+# Single-sourced from pyproject.toml. The ADJACENT pyproject.toml wins
+# when it names this package: the running code is this source checkout,
+# so a stale pip-installed dist-info elsewhere on the machine must not
+# report its older version for it. Installed-package metadata is the
+# fallback (normal installed use: no source tree adjacent). The
+# last-resort sentinel is a deliberate non-version so a stale hard-coded
+# number can never masquerade as real.
 def _resolve_version() -> str:
-    from importlib.metadata import PackageNotFoundError, version
-
-    try:
-        return version("zookeeper-tpu")
-    except PackageNotFoundError:
-        pass
     try:
         import os
         import tomllib
@@ -46,8 +43,16 @@ def _resolve_version() -> str:
             os.path.dirname(os.path.abspath(__file__)), "..", "pyproject.toml"
         )
         with open(pyproject, "rb") as f:
-            return tomllib.load(f)["project"]["version"]
+            project = tomllib.load(f)["project"]
+        if project["name"] == "zookeeper-tpu":
+            return project["version"]
     except (OSError, KeyError, ImportError, ValueError):
+        pass
+    try:
+        from importlib.metadata import PackageNotFoundError, version
+
+        return version("zookeeper-tpu")
+    except Exception:
         return "0.0.0+unknown"
 
 

@@ -253,6 +253,16 @@ class SklearnDigits(Dataset):
     """
 
     validation_fraction: float = Field(0.2)
+    #: Keep only this leading fraction of the TRAIN split (validation is
+    #: untouched) — the few-label regime for semi-supervised / KD
+    #: experiments, where a teacher trained on the full split transfers
+    #: to a label-starved student.
+    train_fraction: float = Field(1.0)
+    #: Uniformly re-label this fraction of TRAIN examples (validation is
+    #: untouched; deterministic in ``seed``) — the noisy-label regime for
+    #: robustness / distillation experiments (a teacher trained on clean
+    #: labels regularizes a student whose hard labels are corrupted).
+    label_noise_fraction: float = Field(0.0)
     num_classes: int = Field(10)
     seed: int = Field(0)
 
@@ -278,8 +288,38 @@ class SklearnDigits(Dataset):
         order = np.random.default_rng(self.seed).permutation(len(labels))
         images, labels = images[order], labels[order]
         n_val = int(len(labels) * self.validation_fraction)
+        if not 0.0 < self.train_fraction <= 1.0:
+            raise ValueError(
+                f"train_fraction={self.train_fraction} outside (0, 1]."
+            )
+        n_train = int(round((len(labels) - n_val) * self.train_fraction))
+        if n_train < 1:
+            raise ValueError(
+                f"train_fraction={self.train_fraction} keeps zero of the "
+                f"{len(labels) - n_val} train examples."
+            )
+        train_labels = labels[n_val : n_val + n_train]
+        if not 0.0 <= self.label_noise_fraction <= 1.0:
+            raise ValueError(
+                f"label_noise_fraction={self.label_noise_fraction} "
+                "outside [0, 1]."
+            )
+        if self.label_noise_fraction > 0.0:
+            rng = np.random.default_rng(self.seed + 1)
+            train_labels = train_labels.copy()
+            n_noise = int(round(len(train_labels) * self.label_noise_fraction))
+            idx = rng.choice(len(train_labels), size=n_noise, replace=False)
+            # Uniform over the OTHER classes: every corrupted label is
+            # genuinely wrong, not occasionally re-drawn as itself.
+            shift = rng.integers(1, self.num_classes, size=n_noise)
+            train_labels[idx] = (
+                train_labels[idx] + shift.astype(np.int32)
+            ) % self.num_classes
         cache = (
-            {"image": images[n_val:], "label": labels[n_val:]},
+            {
+                "image": images[n_val : n_val + n_train],
+                "label": train_labels,
+            },
             {"image": images[:n_val], "label": labels[:n_val]},
         )
         object.__setattr__(self, "_split_cache", cache)
@@ -372,6 +412,19 @@ class _TFDSSource(DataSource):
         return {k: np.asarray(v) for k, v in ex.items()}
 
 
+def _resolve_tfds_split(ds, split: str) -> str:
+    """Map the framework's logical split names ("train"/"validation"/
+    "test") onto the dataset's configured TFDS split names — shared by
+    TFDSDataset and MultiTFDSDataset so the mapping cannot drift."""
+    actual = {"train": ds.train_split}.get(split, split)
+    if split in ("validation", "test"):
+        try:
+            actual = ds.validation_split
+        except AttributeError:
+            pass
+    return actual
+
+
 @component
 class TFDSDataset(Dataset):
     """A TFDS-backed dataset (reference: ``TFDSDataset`` with fields
@@ -406,13 +459,9 @@ class TFDSDataset(Dataset):
     def num_examples(self, split: str) -> int:
         tfds = _require_tfds()
         builder = tfds.builder(self.name, data_dir=self.data_dir)
-        actual = {"train": self.train_split}.get(split, split)
-        if split in ("validation", "test"):
-            try:
-                actual = self.validation_split
-            except AttributeError:
-                pass
-        return builder.info.splits[actual].num_examples
+        return builder.info.splits[
+            _resolve_tfds_split(self, split)
+        ].num_examples
 
     def infer_num_classes(self) -> int:
         tfds = _require_tfds()
@@ -434,20 +483,57 @@ class MultiTFDSDataset(Dataset):
     data_dir: Optional[str] = Field(None)
     num_classes: int = Field(-1)
 
-    def _load_all(self, split: str) -> DataSource:
+    def load(self, split: str, decoders=None) -> DataSource:
+        """Load ``split`` of every named dataset and concatenate. Surface
+        parity with :meth:`TFDSDataset.load`: ``decoders`` passes through
+        to every underlying ``tfds.data_source`` call."""
         return ConcatSource(
-            [_TFDSSource(name, split, self.data_dir) for name in self.names]
+            [
+                _TFDSSource(name, split, self.data_dir, decoders)
+                for name in self.names
+            ]
         )
 
+    # Kept as an alias: round-2 external callers used the private name.
+    _load_all = load
+
     def train(self) -> DataSource:
-        return self._load_all(self.train_split)
+        return self.load(self.train_split)
 
     def validation(self) -> Optional[DataSource]:
         try:
             split = self.validation_split
         except AttributeError:
             return None
-        return self._load_all(split)
+        return self.load(split)
+
+    def num_examples(self, split: str) -> int:
+        """Total example count across all named datasets for ``split``
+        (parity with :meth:`TFDSDataset.num_examples`, summed)."""
+        tfds = _require_tfds()
+        actual = _resolve_tfds_split(self, split)
+        return sum(
+            tfds.builder(name, data_dir=self.data_dir)
+            .info.splits[actual]
+            .num_examples
+            for name in self.names
+        )
+
+    def infer_num_classes(self) -> int:
+        """Max class count over the merged datasets' label metadata. The
+        merged stream's label space is the union; datasets lacking label
+        metadata fall back to the scan-based default."""
+        tfds = _require_tfds()
+        counts = []
+        for name in self.names:
+            info = tfds.builder(name, data_dir=self.data_dir).info
+            label = info.features.get("label") if info.features else None
+            if label is None or not hasattr(label, "num_classes"):
+                return super().infer_num_classes()
+            counts.append(int(label.num_classes))
+        if not counts:
+            return super().infer_num_classes()
+        return max(counts)
 
 
 @component

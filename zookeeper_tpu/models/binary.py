@@ -21,12 +21,13 @@ import jax.numpy as jnp
 
 from zookeeper_tpu.core import Field, component
 from zookeeper_tpu.models.base import Model
-from zookeeper_tpu.ops.layers import QuantConv, QuantDense
+from zookeeper_tpu.ops.layers import BatchNorm, QuantConv, QuantDense
 from zookeeper_tpu.ops.quantizers import dorefa, ste_sign
 
 
 def _bn(training: bool, dtype=jnp.float32):
-    return nn.BatchNorm(
+    # ops.layers.BatchNorm == nn.BatchNorm + batch-dim sharding pin.
+    return BatchNorm(
         use_running_average=not training, momentum=0.9, epsilon=1e-5,
         dtype=dtype,
     )

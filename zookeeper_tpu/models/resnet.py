@@ -12,6 +12,7 @@ import jax.numpy as jnp
 
 from zookeeper_tpu.core import Field, component
 from zookeeper_tpu.models.base import Model
+from zookeeper_tpu.ops.layers import BatchNorm
 
 
 class _Bottleneck(nn.Module):
@@ -22,7 +23,7 @@ class _Bottleneck(nn.Module):
     @nn.compact
     def __call__(self, x, training: bool = False):
         d = self.dtype
-        bn = lambda: nn.BatchNorm(  # noqa: E731
+        bn = lambda: BatchNorm(  # noqa: E731
             use_running_average=not training, momentum=0.9, epsilon=1e-5,
             dtype=d,
         )
@@ -57,7 +58,7 @@ class _ResNetModule(nn.Module):
         d = self.dtype
         x = nn.Conv(self.width, (7, 7), strides=(2, 2), padding="SAME",
                     use_bias=False, dtype=d)(x.astype(d))
-        x = nn.BatchNorm(use_running_average=not training, momentum=0.9,
+        x = BatchNorm(use_running_average=not training, momentum=0.9,
                          epsilon=1e-5, dtype=d)(x)
         x = nn.relu(x)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")

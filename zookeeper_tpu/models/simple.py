@@ -13,6 +13,7 @@ import jax.numpy as jnp
 
 from zookeeper_tpu.core import Field, component
 from zookeeper_tpu.models.base import Model
+from zookeeper_tpu.ops.layers import BatchNorm
 
 
 class _CnnModule(nn.Module):
@@ -28,7 +29,7 @@ class _CnnModule(nn.Module):
         for i, f in enumerate(self.features):
             x = nn.Conv(f, (3, 3), padding="SAME", dtype=self.dtype)(x)
             if self.use_batch_norm:
-                x = nn.BatchNorm(use_running_average=not training)(x)
+                x = BatchNorm(use_running_average=not training)(x)
             x = nn.relu(x)
             if i % 2 == 1:
                 x = nn.max_pool(x, (2, 2), strides=(2, 2))

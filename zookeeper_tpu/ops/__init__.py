@@ -26,6 +26,9 @@ from zookeeper_tpu.ops.layers import (
     QuantConv3D,
     QuantConvND,
     QuantConvTranspose,
+    QuantLocallyConnected1D,
+    QuantLocallyConnected2D,
+    QuantLocallyConnectedND,
     QuantDense,
     QuantDepthwiseConv,
     QuantSeparableConv,
@@ -77,6 +80,9 @@ __all__ = [
     "QuantConv3D",
     "QuantConvND",
     "QuantConvTranspose",
+    "QuantLocallyConnected1D",
+    "QuantLocallyConnected2D",
+    "QuantLocallyConnectedND",
     "QuantDense",
     "QuantDepthwiseConv",
     "QuantSeparableConv",

@@ -830,9 +830,10 @@ int8_dense.defvjp(_int8_dense_fwd, _int8_dense_bwd)
 
 def int8_matmul(a_sign: Array, b_sign: Array) -> Array:
     """Binary GEMM on the MXU: +-1 as int8, int32 accumulation (2x bf16
-    MXU peak; exact)."""
-    a8 = jnp.sign(a_sign).astype(jnp.int8)
-    b8 = jnp.sign(b_sign).astype(jnp.int8)
+    MXU peak; exact on {-1, 0, +1} operands — round, not sign, so a
+    literal 0 stays 0, matching :func:`int8_conv`'s contract)."""
+    a8 = jnp.round(a_sign).astype(jnp.int8)
+    b8 = jnp.round(b_sign).astype(jnp.int8)
     return jax.lax.dot_general(
         a8,
         b8,

@@ -15,6 +15,7 @@ running the float path (a user benchmarking "int8" must never actually be
 measuring bf16).
 """
 
+from math import prod
 from typing import Any, Callable, Sequence, Tuple, Union
 
 import flax.linen as nn
@@ -22,6 +23,7 @@ import jax
 import jax.numpy as jnp
 
 from zookeeper_tpu.ops.quantizers import get_quantizer
+from zookeeper_tpu.parallel.sharding import constrain_batch_sharded
 
 Quantizer = Union[str, Callable, None]
 
@@ -94,6 +96,26 @@ def _tag_quant_act(x: jax.Array) -> jax.Array:
     from jax.ad_checkpoint import checkpoint_name
 
     return checkpoint_name(x, QUANT_ACT_CHECKPOINT_NAME)
+
+
+class BatchNorm(nn.BatchNorm):
+    """``nn.BatchNorm`` that pins its input/output batch-dim sharding to
+    the ambient activation scope (see
+    :mod:`zookeeper_tpu.parallel.sharding`; exact no-op outside a mesh
+    partitioner's step). BN's backward accumulates dx from the
+    x-hat/mean/var terms, and on dp×tp meshes GSPMD was observed choosing
+    a batch-over-all-axes layout for that accumulation, then hitting its
+    "involuntary full rematerialization" replicate-and-reshard path;
+    bracketing the op pins the batch dimension to the data axes on both
+    the forward activations and (via the constraint's transpose) the
+    cotangents. Deliberately named ``BatchNorm`` so flax auto-naming
+    keeps the ``BatchNorm_*`` param paths checkpoints and TP rules use.
+    """
+
+    @nn.compact
+    def __call__(self, x, *args, **kwargs):
+        x = constrain_batch_sharded(x)
+        return constrain_batch_sharded(super().__call__(x, *args, **kwargs))
 
 
 def _int8_kernel_is_unscaled(kernel_quantizer: Quantizer) -> bool:
@@ -219,6 +241,9 @@ class QuantDense(nn.Module):
             xnor_dense,
         )
 
+        # See QuantConv: pin the batch dim to the data axes under a
+        # partitioner's activation scope (no-op otherwise).
+        x = constrain_batch_sharded(x)
         in_q = get_quantizer(self.input_quantizer)
         k_q = get_quantizer(self.kernel_quantizer)
         # Dense has no padding concept; "VALID" satisfies the shared
@@ -281,7 +306,7 @@ class QuantDense(nn.Module):
         if self.use_bias:
             bias = self.param("bias", self.bias_init, (self.features,), jnp.float32)
             y = y + bias.astype(self.dtype)
-        return y
+        return constrain_batch_sharded(y)
 
 
 class QuantConv(nn.Module):
@@ -342,6 +367,12 @@ class QuantConv(nn.Module):
             xnor_conv,
         )
 
+        # Under a partitioner's activation scope: pin the batch dim to the
+        # data axes (both here and on the cotangent — the constraint
+        # transposes), keeping GSPMD from spreading batch over the model
+        # axis in the backward (the involuntary-remat trigger). No-op
+        # otherwise.
+        x = constrain_batch_sharded(x)
         in_q = get_quantizer(self.input_quantizer)
         k_q = get_quantizer(self.kernel_quantizer)
         _check_binary_compute(
@@ -443,7 +474,7 @@ class QuantConv(nn.Module):
         if self.use_bias:
             bias = self.param("bias", self.bias_init, (self.features,), jnp.float32)
             y = y + bias.astype(self.dtype)
-        return y
+        return constrain_batch_sharded(y)
 
 
 class QuantConvND(nn.Module):
@@ -480,6 +511,9 @@ class QuantConvND(nn.Module):
     def __call__(self, x: jax.Array) -> jax.Array:
         from zookeeper_tpu.ops.binary_compute import int8_conv
 
+        # See QuantConv: batch-dim activation pin under a partitioner's
+        # scope (no-op otherwise).
+        x = constrain_batch_sharded(x)
         rank = len(self.kernel_size)
         if self._SPATIAL_RANK is not None and rank != self._SPATIAL_RANK:
             raise ValueError(
@@ -569,7 +603,7 @@ class QuantConvND(nn.Module):
                 "bias", self.bias_init, (self.features,), jnp.float32
             )
             y = y + bias.astype(self.dtype)
-        return y
+        return constrain_batch_sharded(y)
 
 
 class QuantConv1D(QuantConvND):
@@ -587,6 +621,131 @@ class QuantConv3D(QuantConvND):
     _SPATIAL_RANK = 3
 
 
+def _local_out_dim(size: int, k: int, stride: int, pad) -> int:
+    """Output spatial extent of one dimension for the locally-connected
+    conv (needed at PARAM time: the unshared kernel is indexed by output
+    position)."""
+    if isinstance(pad, str):
+        if pad.upper() == "SAME":
+            return -(-size // stride)
+        if pad.upper() == "VALID":
+            return -(-(size - k + 1) // stride)
+        raise ValueError(f"Unknown padding {pad!r}.")
+    lo, hi = pad
+    return (size + lo + hi - k) // stride + 1
+
+
+class QuantLocallyConnectedND(nn.Module):
+    """Channels-last N-D LOCALLY CONNECTED layer with optional input/
+    kernel quantization — the larq ``QuantLocallyConnected1D``/
+    ``QuantLocallyConnected2D`` capability (SURVEY.md §2.4 quantized-layer
+    surface; spatial rank from ``kernel_size``). A conv whose kernel is
+    NOT shared across positions: every output position owns a private
+    ``(prod(kernel_size) * in_ch, features)`` weight block, stored as one
+    ``(*out_spatial, prod(kernel_size) * in_ch, features)`` param and
+    applied with ``jax.lax.conv_general_dilated_local`` — per-position
+    batched matmuls that XLA tiles onto the MXU directly.
+
+    MXU path only, by design: the binary compute modes are rejected
+    loudly. The packed kernels amortize one weight-unpack across every
+    spatial position (M large, shared K-slab); unshared weights make
+    that a per-position unpack — strictly worse than the plain MXU — and
+    the int8 path's scale handling is per-output-channel, not
+    per-position. (Same argument as the depthwise rejection.) The bias,
+    when used, is per-position AND per-channel (Keras LocallyConnected
+    semantics).
+    """
+
+    features: int
+    kernel_size: Tuple[int, ...] = (3, 3)
+    strides: Tuple[int, ...] = None
+    padding: Union[str, Sequence[Tuple[int, int]]] = "VALID"
+    input_quantizer: Quantizer = None
+    kernel_quantizer: Quantizer = None
+    kernel_clip: bool = True
+    use_bias: bool = True
+    dtype: Any = jnp.float32
+    binary_compute: str = "mxu"
+    kernel_init: Callable = nn.initializers.glorot_normal()
+    bias_init: Callable = nn.initializers.zeros_init()
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        rank = len(self.kernel_size)
+        if x.ndim != rank + 2:
+            raise ValueError(
+                f"{type(self).__name__} with kernel_size="
+                f"{tuple(self.kernel_size)} expects a rank-{rank + 2} "
+                f"channels-last input, got shape {x.shape}."
+            )
+        if self.binary_compute != "mxu":
+            raise ValueError(
+                f"{type(self).__name__}: binary_compute="
+                f"{self.binary_compute!r} is not supported — unshared "
+                "weights defeat the packed kernels' one-unpack-many-"
+                "positions amortization and the int8 path's per-channel "
+                "scale contract; only 'mxu' runs (no silent fallback)."
+            )
+        x = constrain_batch_sharded(x)
+        # No _check_binary_compute here: the mxu-only gate above already
+        # rejected every mode that function validates.
+        in_q = get_quantizer(self.input_quantizer)
+        k_q = get_quantizer(self.kernel_quantizer)
+        strides = tuple(self.strides or (1,) * rank)
+        pads = (
+            [self.padding] * rank
+            if isinstance(self.padding, str)
+            else list(self.padding)
+        )
+        ci = x.shape[-1]
+        out_spatial = tuple(
+            _local_out_dim(x.shape[1 + i], self.kernel_size[i], strides[i],
+                           pads[i])
+            for i in range(rank)
+        )
+        kernel = self.param(
+            _kernel_param_name(self.kernel_quantizer),
+            self.kernel_init,
+            (*out_spatial, int(prod(self.kernel_size)) * ci,
+             self.features),
+            jnp.float32,
+        )
+        if in_q is not None:
+            x = _tag_quant_act(in_q(x))
+        kernel = _apply_clip(kernel, self.kernel_clip)
+        if k_q is not None:
+            kernel = k_q(kernel)
+        from zookeeper_tpu.ops.binary_compute import conv_dim_numbers
+
+        y = jax.lax.conv_general_dilated_local(
+            x.astype(self.dtype),
+            kernel.astype(self.dtype),
+            window_strides=strides,
+            padding=self.padding,
+            filter_shape=tuple(self.kernel_size),
+            dimension_numbers=conv_dim_numbers(rank),
+        )
+        if self.use_bias:
+            bias = self.param(
+                "bias", self.bias_init, (*out_spatial, self.features),
+                jnp.float32,
+            )
+            y = y + bias.astype(self.dtype)
+        return constrain_batch_sharded(y)
+
+
+class QuantLocallyConnected1D(QuantLocallyConnectedND):
+    """1-D locally connected layer over [batch, width, channels] (larq
+    ``QuantLocallyConnected1D``)."""
+
+    kernel_size: Tuple[int, ...] = (3,)
+
+
+class QuantLocallyConnected2D(QuantLocallyConnectedND):
+    """2-D locally connected layer over NHWC (larq
+    ``QuantLocallyConnected2D``)."""
+
+
 class QuantConvTranspose(nn.Module):
     """Channels-last N-D TRANSPOSED conv with optional input/kernel
     quantization — the larq ``QuantConv2DTranspose``/``QuantConv3DTranspose``
@@ -596,6 +755,16 @@ class QuantConvTranspose(nn.Module):
     fractionally-strided conv contracts exactly like a conv, so the int8
     MXU path stays bit-exact on quantized operands. Packed modes are
     2-D-forward-conv-specific and raise loudly.
+
+    Kernel-layout convention: this layer uses JAX's native
+    ``lax.conv_transpose`` semantics with ``transpose_kernel=False`` —
+    the kernel is allocated ``(*spatial, in_features, out_features)`` and
+    is NOT spatially flipped / IO-swapped the way Keras/larq
+    ``Conv2DTranspose`` (gradient-of-conv) kernels are. The layer is
+    internally consistent (the int8 path and its VJP share the
+    convention, pinned by test), but a reference ``Conv2DTranspose``
+    checkpoint is not weight-portable verbatim: flip the spatial axes and
+    swap the last two kernel dims when importing such weights.
     """
 
     features: int
@@ -637,6 +806,9 @@ class QuantConvTranspose(nn.Module):
                 f"{self.binary_compute!r} unsupported (packed kernels "
                 "cover the 2-D forward conv only); use 'mxu' or 'int8'."
             )
+        # See QuantConv: batch-dim activation pin under a partitioner's
+        # scope (no-op otherwise).
+        x = constrain_batch_sharded(x)
         in_q = get_quantizer(self.input_quantizer)
         k_q = get_quantizer(self.kernel_quantizer)
         _check_binary_compute(
@@ -673,7 +845,7 @@ class QuantConvTranspose(nn.Module):
                 "bias", self.bias_init, (self.features,), jnp.float32
             )
             y = y + bias.astype(self.dtype)
-        return y
+        return constrain_batch_sharded(y)
 
 
 class QuantSeparableConvND(nn.Module):

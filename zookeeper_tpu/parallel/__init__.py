@@ -26,8 +26,14 @@ from zookeeper_tpu.parallel.distributed import (
     DistributedRuntime,
     initialize_distributed,
 )
+from zookeeper_tpu.parallel.sharding import (
+    activation_sharding_scope,
+    constrain_batch_sharded,
+)
 
 __all__ = [
+    "activation_sharding_scope",
+    "constrain_batch_sharded",
     "DataParallelPartitioner",
     "DistributedRuntime",
     "FsdpPartitioner",

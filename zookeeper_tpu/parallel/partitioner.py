@@ -204,12 +204,37 @@ class MeshPartitioner(Partitioner):
             sharding,
         )
 
+    def _with_activation_scope(self, fn: Callable) -> Callable:
+        """Wrap ``fn`` so it traces inside this mesh's activation-sharding
+        scope: layer code (Quant* layers) pins batch-dim activation
+        shardings to the data axes via
+        :func:`zookeeper_tpu.parallel.sharding.constrain_batch_sharded`,
+        which keeps GSPMD from spreading the batch over non-data axes in
+        the backward (the dp×tp involuntary-rematerialization trigger —
+        see that module's docstring)."""
+        import functools
+
+        from zookeeper_tpu.parallel.sharding import activation_sharding_scope
+
+        mesh, data_axes = self.mesh, tuple(self.data_axes)
+        # Non-data mesh axes carry tensor-parallel channel shardings.
+        model_axes = tuple(
+            a for a in self.mesh_axes if a not in set(data_axes)
+        )
+
+        @functools.wraps(fn)
+        def scoped(*args, **kwargs):
+            with activation_sharding_scope(mesh, data_axes, model_axes):
+                return fn(*args, **kwargs)
+
+        return scoped
+
     def compile_step(self, step_fn, state, *, donate_state: bool = True):
         state_sh = self.state_sharding(state)
         batch_sh = self.batch_sharding()
         metrics_sh = NamedSharding(self.mesh, PartitionSpec())
         return jax.jit(
-            step_fn,
+            self._with_activation_scope(step_fn),
             in_shardings=(state_sh, batch_sh),
             out_shardings=(state_sh, metrics_sh),
             donate_argnums=(0,) if donate_state else (),
@@ -219,7 +244,7 @@ class MeshPartitioner(Partitioner):
         state_sh = self.state_sharding(state)
         batch_sh = self.batch_sharding()
         return jax.jit(
-            eval_fn,
+            self._with_activation_scope(eval_fn),
             in_shardings=(state_sh, batch_sh),
             out_shardings=NamedSharding(self.mesh, PartitionSpec()),
         )
@@ -248,6 +273,11 @@ class FsdpPartitioner(MeshPartitioner):
     #: Parameters below this many ELEMENTS replicate (biases, BN):
     #: sharding tiny tensors costs more collective latency than it saves.
     min_weight_size: int = Field(2**15)
+    #: Regexes over params-relative paths forced to replicate regardless
+    #: of size — the escape hatch for large grouped/depthwise conv
+    #: kernels, whose FSDP-sharded weight gradients hit a GSPMD
+    #: full-rematerialization reshard (see rules.auto_fsdp_rules).
+    replicate_patterns: Sequence[str] = Field(())
 
     def state_sharding(self, state: Any) -> Any:
         # An explicit with_rules (even an empty list = replicate all)
@@ -264,5 +294,6 @@ class FsdpPartitioner(MeshPartitioner):
             axis_size=self.mesh.shape[axis],
             fsdp_axis=axis,
             min_weight_size=self.min_weight_size,
+            replicate_patterns=tuple(self.replicate_patterns),
         )
         return self._sharding_from_rules(state, rules)

@@ -53,33 +53,54 @@ def auto_fsdp_rules(
     axis_size: int,
     fsdp_axis: str = "fsdp",
     min_weight_size: int = 2**15,
+    replicate_patterns: Sequence[str] = (),
 ) -> List[PartitionRule]:
     """Generate ZeRO-3-style weight-sharding rules from a params tree.
 
-    Each parameter with at least ``min_weight_size`` elements shards its
-    largest ``axis_size``-divisible dimension over ``fsdp_axis`` (ties
-    prefer the trailing dim — output features, matching the TP layout
-    convention); everything smaller (biases, BN) replicates. Rules are
-    suffix-anchored on the params-relative path, so optimizer moments and
-    EMA copies co-shard with their parameter automatically.
+    Each parameter with at least ``min_weight_size`` elements AND rank
+    >= 2 shards its largest ``axis_size``-divisible dimension over
+    ``fsdp_axis`` (ties prefer the trailing dim — output features,
+    matching the TP layout convention); everything else (biases, BN
+    scale/shift — 1-D per-channel vectors) replicates REGARDLESS of
+    ``min_weight_size``: the memory saved is negligible, and sharding a
+    per-channel vector makes its weight-gradient reduction want a
+    channel-sharded activation cotangent, which GSPMD can only reach
+    from the batch-sharded layout by full rematerialization (the
+    "[SPMD] Involuntary full rematerialization" warning observed on
+    BatchNorm backward under FSDP). Rules are suffix-anchored on the
+    params-relative path, so optimizer moments and EMA copies co-shard
+    with their parameter automatically.
 
     This is the standard JAX FSDP recipe (scaling-book style): with the
     batch sharded over the SAME mesh axis, XLA all-gathers each layer's
     weights on use (fwd + bwd) and reduce-scatters its gradients —
     per-device param/optimizer memory drops ~axis_size-fold for the
     sharded weights, paid for with weight all-gather traffic over ICI.
+
+    ``replicate_patterns``: regexes over params-relative paths forced to
+    replicate regardless of size; matched with ``re.search``, so anchor
+    them (``"^Conv_1/"``) — a bare ``"Conv_1/"`` also matches inside
+    ``"QuantConv_1/kernel"``. The known case that needs it: a LARGE
+    grouped/depthwise conv kernel — its weight gradient lowers to a
+    ``batch_group_count`` conv whose GSPMD partitioning demands a
+    channel-sharded cotangent, reachable from the batch-sharded layout
+    only by full rematerialization (same pathology class the TP rules
+    dodge by replicating ``QuantDepthwiseConv``). Grouped kernels below
+    ``min_weight_size`` (typical stems) replicate naturally.
     """
     from math import prod
 
     from flax import traverse_util
 
+    replicate_res = [re.compile(p) for p in replicate_patterns]
     flat = traverse_util.flatten_dict(params, sep="/")
     rules: List[PartitionRule] = []
     for path, leaf in flat.items():
         shape = tuple(getattr(leaf, "shape", ()))
         size = prod(shape) if shape else 0
         spec = PartitionSpec()
-        if size >= min_weight_size:
+        forced = any(r.search(path) for r in replicate_res)
+        if not forced and size >= min_weight_size and len(shape) >= 2:
             best = None
             for i, d in enumerate(shape):
                 if d % axis_size == 0 and (best is None or d >= shape[best]):

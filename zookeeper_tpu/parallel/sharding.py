@@ -1,0 +1,82 @@
+"""Activation-sharding constraints: the framework lever GSPMD needs.
+
+Partition RULES govern parameters; activation layouts are otherwise
+compiler-chosen, and on dp×tp meshes GSPMD sometimes picks a layout it
+then cannot reshard efficiently — the observed failure is the BatchNorm
+backward's gradient accumulation getting its batch dimension spread over
+ALL mesh axes and triggering an "[SPMD] Involuntary full
+rematerialization" (replicate-then-repartition) warning. The standard
+fix (How to Scale Your Model recipe: annotate, don't hand-schedule) is
+``jax.lax.with_sharding_constraint`` pinning activations to the
+canonical dp×tp layout.
+
+This module provides the ambient plumbing so model/layer code can request
+that pin WITHOUT knowing about meshes: the partitioner opens an
+:func:`activation_sharding_scope` around step tracing, and the Quant*
+layers / the sharded ``BatchNorm`` (plus anything else that calls
+:func:`constrain_batch_sharded`) pin activations to
+
+    ``P(data_axes, None, ..., None, model_axes)``
+
+— batch on the data axes, trailing (channel) dimension on the
+tensor-parallel axes (matching TP rules that shard kernels on the output
+-feature dim and co-shard BN params), everything else replicated. The
+spec is fully CLOSED deliberately: an open/UNCONSTRAINED dim is
+refinable during propagation, and the propagator was observed refining a
+"batch on data" pin into batch-over-all-axes — recreating the exact
+resharding the pin exists to prevent. Outside a scope — single-device
+jit, eager debugging, tests — the helper is an exact no-op.
+"""
+
+from contextlib import contextmanager
+from contextvars import ContextVar
+from typing import Optional, Sequence, Tuple
+
+_SCOPE: ContextVar[
+    Optional[Tuple[object, Tuple[str, ...], Tuple[str, ...]]]
+] = ContextVar("zk_activation_sharding_scope", default=None)
+
+
+@contextmanager
+def activation_sharding_scope(
+    mesh, data_axes: Sequence[str], model_axes: Sequence[str] = ()
+):
+    """Make ``(mesh, data_axes, model_axes)`` ambient for
+    :func:`constrain_batch_sharded`.
+
+    Opened by the mesh partitioners around step tracing (the scope must be
+    active while JAX traces the step function, which is when the layer
+    code actually runs). Re-entrant; the innermost scope wins.
+    """
+    token = _SCOPE.set((mesh, tuple(data_axes), tuple(model_axes)))
+    try:
+        yield
+    finally:
+        _SCOPE.reset(token)
+
+
+def current_activation_scope():
+    """The active ``(mesh, data_axes, model_axes)`` or None."""
+    return _SCOPE.get()
+
+
+def constrain_batch_sharded(x):
+    """Pin ``x`` to the ambient canonical activation layout: dim 0
+    (batch) on the data axes, the last dim (channels) on the model axes
+    (replicated when the scope has none, e.g. pure DP / FSDP), middle
+    dims replicated. Applies to the cotangent too (the constraint
+    transposes). No-op when no scope is active or ``x`` has fewer than
+    two dims (a 1-D tensor is a per-channel vector, not a batched
+    activation — pinning its only dim to the data axes would be a
+    nonsensical layout).
+    """
+    scope = _SCOPE.get()
+    if scope is None or getattr(x, "ndim", 0) < 2:
+        return x
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    mesh, data_axes, model_axes = scope
+    chan = model_axes if model_axes else None
+    spec = PartitionSpec(data_axes, *([None] * (x.ndim - 2)), chan)
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
