@@ -240,30 +240,53 @@ def xnor_matmul(
 # -- Packed-weight MXU Pallas GEMM (weights packed, MXU contraction) --------
 
 
-def _pw_kernel(a_ref, b_ref, out_ref, *, out_dtype):
-    """One (m, n, k) grid step: unpack a packed-weight K-slab to +-1 int8
-    in VMEM, contract on the MXU, accumulate into the output block.
+def _pw_kernel(a_ref, b_ref, out_ref, w_scratch, *, out_dtype,
+               always_decode=False):
+    """One (n, m, k) grid step: contract an A block against a +-1 int8
+    weight slab held in VMEM scratch, accumulating into the output block.
 
     The HBM win: ``b_ref`` blocks arrive packed (32x fewer bytes than the
     int8 weights they encode); only the VMEM-resident tile is ever
-    unpacked."""
+    unpacked. The SCRATCH win (the round-2 "per-M-block unpack repeats"
+    structural loss): the unpack runs only on the FIRST m iteration of
+    each (n, k) — ``w_scratch`` holds every unpacked K-slab of the
+    current n column, and the remaining m blocks reuse it straight from
+    VMEM. Large-M GEMMs amortize the bit-decode across M/block_m blocks
+    instead of paying it every time (measured: the decode dominated at
+    M = spatial-positions shapes, BASELINE.md round 2)."""
+    m = pl.program_id(1)
     k = pl.program_id(2)
-    a = a_ref[:]  # [bm, bk] int8 (+-1 or 0 from spatial padding)
-    bw = b_ref[:].astype(jnp.uint32)  # [bkw, bn] packed words
-    shifts = jnp.arange(32, dtype=jnp.uint32)
-    bits = (bw[:, None, :] >> shifts[None, :, None]) & jnp.uint32(1)
-    # [bkw, 32, bn] -> [bk, bn]; row r = word r//32, bit r%32 (pack order).
-    # Pure arithmetic +-1 decode (b+b-1): Mosaic has no vector integer
-    # multiply, and i1 select masks hit relayout limits at this shape.
-    bi = bits.astype(jnp.int32)
-    b = (bi + bi - 1).reshape(-1, bw.shape[-1]).astype(jnp.int8)
+    # ``always_decode`` (static): the fallback for K so large that one n
+    # column's unpacked slabs exceed the scratch budget — decode every
+    # step into the single scratch slot (slot index 0, since the scratch
+    # then has one slot) instead of caching per k.
+    slot = 0 if always_decode else k
 
+    def _decode():
+        bw = b_ref[:].astype(jnp.uint32)  # [bkw, bn] packed words
+        shifts = jnp.arange(32, dtype=jnp.uint32)
+        bits = (bw[:, None, :] >> shifts[None, :, None]) & jnp.uint32(1)
+        # [bkw, 32, bn] -> [bk, bn]; row r = word r//32, bit r%32 (pack
+        # order). Pure arithmetic +-1 decode (b+b-1): Mosaic has no
+        # vector integer multiply, and i1 select masks hit relayout
+        # limits at this shape.
+        bi = bits.astype(jnp.int32)
+        w_scratch[slot] = (
+            (bi + bi - 1).reshape(-1, bw.shape[-1]).astype(jnp.int8)
+        )
+
+    if always_decode:
+        _decode()
+    else:
+        pl.when(m == 0)(_decode)
+
+    a = a_ref[:]  # [bm, bk] int8 (+-1 or 0 from spatial padding)
     # Precision pinned: int8 contraction is exact at any precision, and
     # a global jax_default_matmul_precision="highest" would otherwise tag
     # this dot with an fp32 contract Mosaic cannot honor for int8.
     acc = jax.lax.dot_general(
         a,
-        b,
+        w_scratch[slot],
         (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.int32,
         precision=jax.lax.Precision.DEFAULT,
@@ -284,8 +307,8 @@ def packed_weight_matmul(
     a: Array,
     b_packed: Array,
     *,
-    block_m: int = 128,
-    block_n: int = 128,
+    block_m: int = 512,
+    block_n: int = 512,
     block_kw: int = _MXU_WORDS,
     interpret: bool = False,
 ) -> Array:
@@ -294,6 +317,16 @@ def packed_weight_matmul(
     ``a`` may contain zeros (conv zero-padding) — only the WEIGHTS are
     packed, so the result is bit-exact with the float GEMM against the
     unpacked +-1 weights. Returns int32 [M, N].
+
+    Default blocks are 512x512 (capped to the problem below): measured on
+    v5e, big blocks cut the grid-step count and amortize the weight
+    decode (with the m==0 scratch reuse) — 391 -> ~110 us at the
+    M=3136/K=4608/N=512 QuickNet section shape, 8.4 -> 5.2 us at M=784,
+    batch-1 unchanged-to-better (BASELINE.md round 5). The unpacked-slab
+    scratch costs K_pad x block_n bytes of VMEM; the call auto-lowers
+    ``block_n`` to stay inside a ~4 MB budget and, for K so large that
+    even block_n=128 exceeds it, falls back to decoding every step
+    (the pre-scratch behavior) instead of failing Mosaic allocation.
     """
     m, k = a.shape
     kw, n = b_packed.shape
@@ -312,6 +345,16 @@ def packed_weight_matmul(
     block_m = min(block_m, _round_up(m, 32))
     block_n = min(block_n, _round_up(n, 128))
     block_kw = min(block_kw, kw)
+    # Scratch VMEM budget (~4 MB): one n column's unpacked slabs are
+    # K_pad x block_n int8. Lower block_n first; if even 128 lanes
+    # exceed the budget (K in the tens of thousands), keep a single-slot
+    # scratch and decode every grid step (always_decode fallback).
+    scratch_budget = 4 * 1024 * 1024
+    while block_n > 128 and _round_up(kw, block_kw) * 32 * block_n > scratch_budget:
+        block_n //= 2
+    always_decode = (
+        _round_up(kw, block_kw) * 32 * block_n > scratch_budget
+    )
     mp = _round_up(m, block_m)
     np_ = _round_up(n, block_n)
     kwp = _round_up(kw, block_kw)
@@ -320,25 +363,41 @@ def packed_weight_matmul(
     a_pad = jnp.pad(a8, ((0, mp - m), (0, kwp * 32 - k)))
     b_pad = jnp.pad(b_packed, ((0, kwp - kw), (0, np_ - n)))
 
+    # Grid order (n, m, k): k innermost so each output block accumulates
+    # consecutively; m middle so the per-(n, k) weight unpack (done on
+    # m == 0 into scratch) is reused by every later m block of the same
+    # n column.
     out = pl.pallas_call(
-        partial(_pw_kernel, out_dtype=jnp.int32),
+        partial(
+            _pw_kernel, out_dtype=jnp.int32, always_decode=always_decode
+        ),
         out_shape=jax.ShapeDtypeStruct((mp, np_), jnp.int32),
-        grid=(mp // block_m, np_ // block_n, kwp // block_kw),
+        grid=(np_ // block_n, mp // block_m, kwp // block_kw),
         in_specs=[
             pl.BlockSpec(
                 (block_m, block_kw * 32),
-                lambda i, j, k: (i, k),
+                lambda j, i, k: (i, k),
                 memory_space=pltpu.VMEM,
             ),
             pl.BlockSpec(
                 (block_kw, block_n),
-                lambda i, j, k: (k, j),
+                lambda j, i, k: (k, j),
                 memory_space=pltpu.VMEM,
             ),
         ],
         out_specs=pl.BlockSpec(
-            (block_m, block_n), lambda i, j, k: (i, j), memory_space=pltpu.VMEM
+            (block_m, block_n), lambda j, i, k: (i, j), memory_space=pltpu.VMEM
         ),
+        scratch_shapes=[
+            pltpu.VMEM(
+                (
+                    1 if always_decode else kwp // block_kw,
+                    block_kw * 32,
+                    block_n,
+                ),
+                jnp.int8,
+            )
+        ],
         interpret=interpret,
     )(a_pad, b_pad)
     return out[:m, :n]
