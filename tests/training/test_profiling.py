@@ -1,0 +1,169 @@
+"""Trace analysis (training/profiling.py): per-op device-time
+attribution. Device planes exist only in real accelerator traces, so the
+parsing contract is tested against a synthetically built xplane proto —
+the same schema the profiler writes (verified against real TPU dumps;
+the BASELINE.md round-5 attributions use exactly this reader).
+
+The load-bearing design point pinned here: attribution comes from XLA's
+per-op stats (hlo_category / flops / bytes_accessed), NEVER from op-name
+substrings — ``%convert_reduce_fusion`` (a BN reduction) contains
+"conv", and real convolutions lower to plain ``%fusion.N`` names, so
+name bucketing misattributes in both directions.
+"""
+
+import os
+
+import pytest
+
+tsl_xplane = pytest.importorskip("tensorflow.tsl.profiler.protobuf.xplane_pb2")
+
+from zookeeper_tpu.training.profiling import (  # noqa: E402
+    device_op_stats,
+    format_breakdown,
+    op_time_breakdown,
+)
+
+# (name, category, duration_ms per event, events, flops_each, bytes_each)
+_OPS = (
+    # A real conv fusion: compute-bound (ideal compute >> ideal memory).
+    ("%fusion.7 = bf16[128,28,28,256] fusion(...)", "convolution fusion",
+     3.0, 2, 5.0e9, 1.0e6),
+    # The name trap: contains "conv", IS a bandwidth-bound BN reduction.
+    ("%convert_reduce_fusion.1 = (f32[64], f32[64]) fusion(...)",
+     "loop fusion", 2.0, 1, 1.0e6, 500.0e6),
+    # Layout traffic with no flops/bytes stats: unattributed in roofline.
+    ("%copy.3 = bf16[8,8] copy(...)", "copy-done", 1.0, 1, 0, 0),
+)
+
+
+def _add_device_plane(space, plane_name):
+    plane = space.planes.add()
+    plane.name = plane_name
+    # Stat metadata ids shared by plane + event stats.
+    stat_ids = {}
+    for i, key in enumerate(
+        ("hlo_category", "flops", "bytes_accessed",
+         "peak_teraflops_per_second", "peak_hbm_bw_gigabytes_per_second"),
+        start=1,
+    ):
+        plane.stat_metadata[i].id = i
+        plane.stat_metadata[i].name = key
+        stat_ids[key] = i
+    for key, value in (
+        ("peak_teraflops_per_second", 200.0),
+        ("peak_hbm_bw_gigabytes_per_second", 800.0),
+    ):
+        s = plane.stats.add()
+        s.metadata_id = stat_ids[key]
+        s.double_value = value
+    line = plane.lines.add()
+    line.name = "XLA Ops"
+    for op_id, (name, category, dur_ms, n_events, flops, nbytes) in enumerate(
+        _OPS, start=1
+    ):
+        meta = plane.event_metadata[op_id]
+        meta.id = op_id
+        meta.name = name
+        s = meta.stats.add()
+        s.metadata_id = stat_ids["hlo_category"]
+        s.str_value = category
+        if flops:
+            s = meta.stats.add()
+            s.metadata_id = stat_ids["flops"]
+            s.double_value = flops
+        if nbytes:
+            s = meta.stats.add()
+            s.metadata_id = stat_ids["bytes_accessed"]
+            s.double_value = nbytes
+        for _ in range(n_events):
+            ev = line.events.add()
+            ev.metadata_id = op_id
+            ev.duration_ps = int(dur_ms * 1e9)
+    # A decoy line that must be ignored.
+    plane.lines.add().name = "Steps"
+
+
+def _write_fake_trace(tmp_path, n_device_planes=1):
+    space = tsl_xplane.XSpace()
+    for i in range(n_device_planes):
+        _add_device_plane(space, f"/device:TPU:{i}")
+    # A host plane that must be ignored.
+    space.planes.add().name = "/host:CPU"
+    nested = tmp_path / "plugins" / "profile" / "run1"
+    os.makedirs(nested)
+    (nested / "host0.xplane.pb").write_bytes(space.SerializeToString())
+    return str(tmp_path)
+
+
+def test_device_op_stats(tmp_path):
+    data = device_op_stats(_write_fake_trace(tmp_path))
+    assert data["peak_flops_per_sec"] == pytest.approx(200e12)
+    assert data["peak_bytes_per_sec"] == pytest.approx(800e9)
+    by_name = {op["name"]: op for op in data["ops"]}
+    conv = by_name[_OPS[0][0]]
+    assert conv["category"] == "convolution fusion"
+    assert conv["seconds"] == pytest.approx(6e-3)  # 2 events x 3 ms
+    assert conv["count"] == 2
+    assert conv["flops"] == pytest.approx(1.0e10)  # per-event x count
+
+
+def test_breakdown_categories_and_roofline(tmp_path):
+    trace_dir = _write_fake_trace(tmp_path)
+    b = op_time_breakdown(trace_dir, steps=2)
+    assert b["total_ms_per_step"] == pytest.approx(4.5)  # 9 ms / 2
+    cats = b["by_category"]
+    assert cats["convolution fusion"]["ms_per_step"] == pytest.approx(3.0)
+    # The "conv"-substring BN reduction lands in ITS category, not conv.
+    assert cats["loop fusion"]["share"] == pytest.approx(2 / 9)
+
+    roof = b["roofline"]
+    # conv fusion: 5e9/200e12 = 25 us compute vs 1e6/800e9 ~ 1.3 us mem
+    # -> compute-bound; BN reduce: 5 ns compute vs 625 us mem ->
+    # bandwidth-bound; copy: no stats -> unattributed.
+    assert roof["compute_bound_ms_per_step"] == pytest.approx(3.0)
+    assert roof["bandwidth_bound_ms_per_step"] == pytest.approx(1.0)
+    assert roof["unattributed_ms_per_step"] == pytest.approx(0.5)
+    assert roof["compute_bound_share"] == pytest.approx(6 / 9)
+
+    text = format_breakdown(b)
+    assert "4.50 ms/step" in text
+    assert "convolution fusion" in text
+    assert "compute-bound ops 3.00 ms (67%)" in text
+
+
+def test_peak_overrides_change_classification(tmp_path):
+    trace_dir = _write_fake_trace(tmp_path)
+    # With an absurdly slow compute peak EVERY attributed op (incl. the
+    # BN reduction) flips compute-bound — classification must follow the
+    # OVERRIDDEN peaks, not the plane's.
+    b = op_time_breakdown(
+        trace_dir, steps=2, peak_flops_per_sec=1e9,
+        peak_bytes_per_sec=800e9,
+    )
+    assert b["roofline"]["compute_bound_ms_per_step"] == pytest.approx(4.0)
+    b2 = op_time_breakdown(
+        trace_dir, steps=2, peak_flops_per_sec=1e20,
+        peak_bytes_per_sec=1.0,
+    )
+    assert b2["roofline"]["bandwidth_bound_ms_per_step"] == pytest.approx(
+        4.0
+    )
+
+
+def test_single_plane_semantics(tmp_path):
+    """Multi-chip dumps (one plane per local device, SPMD-identical
+    programs) must report PER-DEVICE numbers, not a sum over planes —
+    and the substring filter selects a specific plane."""
+    trace_dir = _write_fake_trace(tmp_path, n_device_planes=4)
+    b = op_time_breakdown(trace_dir, steps=2)
+    assert b["total_ms_per_step"] == pytest.approx(4.5)  # not 4x
+    times = device_op_stats(trace_dir, device_substring="TPU:3")
+    assert sum(op["seconds"] for op in times["ops"]) == pytest.approx(9e-3)
+
+
+def test_device_filter_and_errors(tmp_path):
+    trace_dir = _write_fake_trace(tmp_path)
+    with pytest.raises(ValueError, match="XLA Ops"):
+        device_op_stats(trace_dir, device_substring="TPU:7")
+    with pytest.raises(FileNotFoundError, match="xplane"):
+        device_op_stats(str(tmp_path / "empty"))

@@ -48,10 +48,18 @@ from zookeeper_tpu.training.schedule import (
     StepDecay,
     WarmupCosine,
 )
+from zookeeper_tpu.training.profiling import (
+    device_op_stats,
+    format_breakdown,
+    op_time_breakdown,
+)
 from zookeeper_tpu.training.state import TrainState
 from zookeeper_tpu.training.step import make_eval_step, make_train_step
 
 __all__ = [
+    "device_op_stats",
+    "format_breakdown",
+    "op_time_breakdown",
     "Adam",
     "AdamW",
     "BINARY_KERNEL_PATTERN",

@@ -160,6 +160,33 @@ class TrainingExperiment(Experiment):
         if self.verbose:
             print(msg, flush=True)
 
+    def _log_profile_breakdown(self, steps: int) -> None:
+        """Best-effort per-op attribution of the captured trace (the
+        BASELINE.md bottleneck-naming analysis, in the loop). Quiet on
+        failure: CPU traces carry no device planes, and the xplane proto
+        lives in the optional tensorflow dependency."""
+        if not self.verbose:
+            return
+        try:
+            from zookeeper_tpu.training.profiling import (
+                format_breakdown,
+                op_time_breakdown,
+            )
+
+            self._log(
+                format_breakdown(
+                    op_time_breakdown(
+                        self.profile_dir, steps=max(1, steps)
+                    )
+                )
+            )
+        except Exception as e:  # pragma: no cover - env-dependent
+            import logging
+
+            logging.getLogger(__name__).debug(
+                "trace breakdown unavailable: %s", e
+            )
+
     def build_state(self) -> TrainState:
         """Build module + optimizer and initialize the TrainState."""
         input_shape = self.loader.preprocessing.input_shape
@@ -308,6 +335,11 @@ class TrainingExperiment(Experiment):
                         jax.block_until_ready(metrics["loss"])
                         jax.profiler.stop_trace()
                         profiling = False
+                        # Steps min(4,..)..min(14,..) run INSIDE the
+                        # trace window, inclusive on both ends.
+                        self._log_profile_breakdown(
+                            min(14, spe - 1) - min(4, spe - 1) + 1
+                        )
                     if self.log_every and (step_idx + 1) % self.log_every == 0:
                         m = {k: float(v) for k, v in metrics.items()}
                         self._log(

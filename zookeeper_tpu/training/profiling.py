@@ -1,0 +1,250 @@
+"""Trace analysis: per-op device-time attribution from profiler dumps.
+
+``Experiment.profile_dir`` (and ``jax.profiler.start_trace`` directly)
+captures an xplane protobuf per host. TensorBoard can render it, but a
+training loop usually wants one number per QUESTION — "where does the
+step time go, and is it compute or bandwidth?" — without a UI: that is
+how BASELINE.md names the north-star and ResNet-50 bottlenecks. This
+module makes the analysis a framework capability instead of a notebook
+ritual.
+
+Two attributions, both from the profiler's own per-op stats (never from
+op-name substrings — on TPU every op lowers to a ``%fusion.N``-style
+name, and e.g. ``%convert_reduce_fusion`` contains "conv" while being a
+BN reduction, so name bucketing mis-attributes badly; the unit tests
+pin the counterexample):
+
+- **by hlo_category** (``"convolution fusion"``, ``"loop fusion"``,
+  ``"copy-done"``, ...): XLA's own classification of the executed op.
+- **roofline**: each op's ideal compute time (``flops`` / peak FLOP/s)
+  vs ideal memory time (``bytes_accessed`` / peak HBM GB/s, the
+  plane-reported peaks by default) classifies it compute- or
+  bandwidth-bound; the step then splits into time spent in each class.
+
+The xplane proto ships inside tensorflow (``tensorflow.tsl``), an
+optional dependency here — import errors surface only on call.
+"""
+
+import glob
+import os
+from collections import defaultdict
+from typing import Dict, List, Optional
+
+__all__ = [
+    "device_op_stats",
+    "op_time_breakdown",
+    "format_breakdown",
+]
+
+
+def _find_xplane_files(trace_dir: str) -> List[str]:
+    """xplane.pb files under a ``start_trace``/``profile_dir`` directory
+    (the profiler nests them as plugins/profile/<run>/<host>.xplane.pb),
+    sorted oldest-to-NEWEST BY MTIME — callers take the last entry, so a
+    reused profile dir resolves to the most recent capture regardless of
+    how run-directory names sort. A direct file path passes through.
+
+    Multi-host caveat: with a SHARED profile dir every host's dump lands
+    in the same run directory; the newest file is whichever host wrote
+    last, not necessarily this one — pass that host's file path directly
+    for per-host analysis.
+    """
+    if os.path.isfile(trace_dir):
+        return [trace_dir]
+    hits = sorted(
+        glob.glob(
+            os.path.join(trace_dir, "**", "*.xplane.pb"), recursive=True
+        ),
+        key=os.path.getmtime,
+    )
+    if not hits:
+        raise FileNotFoundError(
+            f"No .xplane.pb under {trace_dir!r} — was the trace stopped "
+            "(jax.profiler.stop_trace / the profiled epoch finished)?"
+        )
+    return hits
+
+
+def _stat_value(stat):
+    return (
+        stat.str_value
+        or stat.ref_value
+        or stat.int64_value
+        or stat.uint64_value
+        or stat.double_value
+    )
+
+
+def device_op_stats(
+    trace_dir: str, device_substring: str = ""
+) -> dict:
+    """Per-op aggregates + device peaks from the newest xplane dump.
+
+    Returns ``{"ops": [{"name", "category", "seconds", "count",
+    "flops", "bytes"}...], "peak_flops_per_sec", "peak_bytes_per_sec"}``
+    from the "XLA Ops" line of ONE device plane — the first matching
+    one. Under SPMD every device runs the same program, so one plane IS
+    the per-device attribution; summing planes would multiply every
+    number by the local device count. ``device_substring`` selects a
+    specific plane (e.g. ``"TPU:3"``).
+    """
+    from tensorflow.tsl.profiler.protobuf import xplane_pb2  # noqa: PLC0415
+
+    path = _find_xplane_files(trace_dir)[-1]
+    space = xplane_pb2.XSpace()
+    with open(path, "rb") as f:
+        space.ParseFromString(f.read())
+    per_op: Dict[str, dict] = {}
+    peak_flops: Optional[float] = None
+    peak_bw: Optional[float] = None
+    for plane in space.planes:
+        if per_op:
+            break  # One device plane only (see docstring).
+        if not plane.name.startswith("/device:"):
+            continue
+        if device_substring and device_substring not in plane.name:
+            continue
+        names = {k: v.name for k, v in plane.stat_metadata.items()}
+        for s in plane.stats:
+            key = names.get(s.metadata_id)
+            if key == "peak_teraflops_per_second":
+                peak_flops = float(_stat_value(s)) * 1e12
+            elif key == "peak_hbm_bw_gigabytes_per_second":
+                peak_bw = float(_stat_value(s)) * 1e9
+
+        def meta_stats(meta):
+            return {
+                names.get(s.metadata_id): _stat_value(s)
+                for s in meta.stats
+            }
+
+        for line in plane.lines:
+            if line.name != "XLA Ops":
+                continue
+            for event in line.events:
+                meta = plane.event_metadata[event.metadata_id]
+                row = per_op.get(meta.name)
+                if row is None:
+                    ms = meta_stats(meta)
+                    row = per_op[meta.name] = {
+                        "name": meta.name,
+                        "category": str(ms.get("hlo_category") or ""),
+                        "seconds": 0.0,
+                        "count": 0,
+                        "_flops_each": float(ms.get("flops") or 0),
+                        "_bytes_each": float(ms.get("bytes_accessed") or 0),
+                    }
+                row["seconds"] += event.duration_ps / 1e12
+                row["count"] += 1
+    if not per_op:
+        raise ValueError(
+            f"Trace {path!r} has no device 'XLA Ops' events"
+            + (f" matching {device_substring!r}" if device_substring else "")
+            + " — profile a run that executes compiled steps on device."
+        )
+    ops = []
+    for row in per_op.values():
+        row["flops"] = row.pop("_flops_each") * row["count"]
+        row["bytes"] = row.pop("_bytes_each") * row["count"]
+        ops.append(row)
+    return {
+        "ops": ops,
+        "peak_flops_per_sec": peak_flops,
+        "peak_bytes_per_sec": peak_bw,
+    }
+
+
+def op_time_breakdown(
+    trace_dir: str,
+    *,
+    steps: int = 1,
+    device_substring: str = "",
+    top_k: int = 10,
+    peak_flops_per_sec: Optional[float] = None,
+    peak_bytes_per_sec: Optional[float] = None,
+) -> dict:
+    """The BASELINE.md-style attribution: per-category ms/step, a
+    roofline compute/bandwidth split, and the top ops.
+
+    ``steps``: how many train steps the trace covers (divides totals
+    into per-step numbers). Peak overrides default to the device
+    plane's self-reported peaks (pass the machine's MEASURED peaks for
+    stricter numbers). Ops with no flops/bytes stats are skipped by the
+    roofline split (reported as ``unattributed_ms_per_step``).
+    """
+    data = device_op_stats(trace_dir, device_substring)
+    peak_f = peak_flops_per_sec or data["peak_flops_per_sec"]
+    peak_b = peak_bytes_per_sec or data["peak_bytes_per_sec"]
+    total = sum(op["seconds"] for op in data["ops"])
+    steps = max(1, steps)
+
+    by_cat: Dict[str, float] = defaultdict(float)
+    roof = {"compute_bound": 0.0, "bandwidth_bound": 0.0, "unattributed": 0.0}
+    ideal_c = ideal_m = 0.0
+    for op in data["ops"]:
+        by_cat[op["category"] or "(uncategorized)"] += op["seconds"]
+        if not peak_f or not peak_b or (not op["flops"] and not op["bytes"]):
+            roof["unattributed"] += op["seconds"]
+            continue
+        t_c = op["flops"] / peak_f
+        t_m = op["bytes"] / peak_b
+        ideal_c += t_c
+        ideal_m += t_m
+        key = "compute_bound" if t_c >= t_m else "bandwidth_bound"
+        roof[key] += op["seconds"]
+    top = sorted(data["ops"], key=lambda op: -op["seconds"])[:top_k]
+    return {
+        "total_ms_per_step": total / steps * 1e3,
+        "by_category": {
+            c: {
+                "ms_per_step": d / steps * 1e3,
+                "share": d / total if total else 0.0,
+            }
+            for c, d in sorted(by_cat.items(), key=lambda kv: -kv[1])
+        },
+        "roofline": {
+            "compute_bound_ms_per_step": roof["compute_bound"] / steps * 1e3,
+            "bandwidth_bound_ms_per_step": (
+                roof["bandwidth_bound"] / steps * 1e3
+            ),
+            "unattributed_ms_per_step": roof["unattributed"] / steps * 1e3,
+            "compute_bound_share": (
+                roof["compute_bound"] / total if total else 0.0
+            ),
+            "bandwidth_bound_share": (
+                roof["bandwidth_bound"] / total if total else 0.0
+            ),
+            "ideal_compute_ms_per_step": ideal_c / steps * 1e3,
+            "ideal_memory_ms_per_step": ideal_m / steps * 1e3,
+        },
+        "top_ops": [
+            (op["seconds"] / steps * 1e3, op["category"], op["name"])
+            for op in top
+        ],
+    }
+
+
+def format_breakdown(breakdown: dict, name_width: int = 70) -> str:
+    """Human-readable rendering of :func:`op_time_breakdown`."""
+    lines = [
+        f"device op time: {breakdown['total_ms_per_step']:.2f} ms/step"
+    ]
+    for category, row in breakdown["by_category"].items():
+        if row["ms_per_step"] < 0.005:
+            continue
+        lines.append(
+            f"  {category:28s} {row['ms_per_step']:8.2f} ms/step "
+            f"{row['share'] * 100:5.1f}%"
+        )
+    roof = breakdown["roofline"]
+    lines.append(
+        "roofline: "
+        f"compute-bound ops {roof['compute_bound_ms_per_step']:.2f} ms "
+        f"({roof['compute_bound_share'] * 100:.0f}%), "
+        f"bandwidth-bound ops {roof['bandwidth_bound_ms_per_step']:.2f} ms "
+        f"({roof['bandwidth_bound_share'] * 100:.0f}%)"
+    )
+    lines.append("top ops (ms/step):")
+    for ms, category, op_name in breakdown["top_ops"]:
+        lines.append(f"  {ms:8.3f}  [{category}] {op_name[:name_width]}")
+    return "\n".join(lines)
