@@ -9,6 +9,10 @@ subclasses for subclass-by-name configuration (``model=QuickNet``).
 """
 
 from zookeeper_tpu.models.base import Model
+from zookeeper_tpu.models.keras_import import (
+    import_keras_weights,
+    keras_transpose_kernel,
+)
 from zookeeper_tpu.models.simple import Mlp, SimpleCnn
 from zookeeper_tpu.models.binary import (
     BinaryAlexNet,
@@ -32,6 +36,8 @@ from zookeeper_tpu.models.resnet import ResNet50, ResNet101, ResNet152
 from zookeeper_tpu.models.summary import ModelSummary, model_summary
 
 __all__ = [
+    "import_keras_weights",
+    "keras_transpose_kernel",
     "ModelSummary",
     "model_summary",
     "BinaryAlexNet",

@@ -1,0 +1,184 @@
+"""Keras/larq checkpoint migration: reference weights into this framework.
+
+The reference ecosystem (zookeeper + larq + larq_zoo) stores trained
+models as Keras checkpoints. A user switching to this framework brings
+those weights along with :func:`import_keras_weights`, which maps a
+built ``tf.keras`` model's variables onto a flax params/batch-stats
+template by ALIGNED ORDER with strict shape checks.
+
+Why order-based: Keras layer names ("conv2d_7") and flax scope names
+("QuantConv_3") share nothing, but both frameworks enumerate layers in
+construction order (flax params preserve call order), and both store
+conv kernels HWIO and dense kernels [in, out] — so the i-th
+weight-bearing Keras layer corresponds to the i-th weight slot of the
+flax tree when the architectures match. Every assignment shape-checks,
+and leftover slots on either side are loud errors, so a mismatched
+architecture cannot import silently.
+
+The one layout exception is ``Conv2DTranspose``: Keras stores its
+kernel ``(kh, kw, out, in)`` with gradient-of-conv semantics, while
+:class:`~zookeeper_tpu.ops.layers.QuantConvTranspose` uses JAX's native
+``(kh, kw, in, out)`` un-flipped convention — :func:`keras_transpose_kernel`
+converts (flip spatial axes, swap the trailing dims), and the import
+applies it automatically for Keras layers of that class.
+
+tensorflow is an optional dependency: these functions only TAKE a keras
+model object, they never import tensorflow themselves.
+"""
+
+from collections.abc import Mapping
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+__all__ = ["import_keras_weights", "keras_transpose_kernel"]
+
+_KERNEL_KEYS = ("kernel", "kernel_fp")
+
+
+def keras_transpose_kernel(kernel: np.ndarray) -> np.ndarray:
+    """Convert a Keras ``Conv2DTranspose``/``Conv1DTranspose`` kernel
+    ``(*spatial, out, in)`` (gradient-of-conv semantics) to this
+    framework's ``(*spatial, in, out)`` un-flipped convention."""
+    kernel = np.asarray(kernel)
+    spatial = tuple(range(kernel.ndim - 2))
+    flipped = np.flip(kernel, axis=spatial)
+    return np.swapaxes(flipped, -1, -2)
+
+
+def _flax_slots(
+    params: Dict[str, Any], batch_stats: Optional[Dict[str, Any]]
+) -> List[dict]:
+    """Ordered weight slots from a flax params tree (call order — flax
+    preserves scope-creation order): kernel slots (conv/dense, with
+    optional bias) and BN slots (scale/bias + running stats)."""
+    slots: List[dict] = []
+
+    def visit(node, stats_node, path):
+        if not isinstance(node, Mapping):
+            return
+        kernel_key = next((k for k in _KERNEL_KEYS if k in node), None)
+        is_bn = "scale" in node and "bias" in node and kernel_key is None
+        if kernel_key is not None:
+            slots.append({
+                "kind": "kernel",
+                "path": path,
+                "node": node,
+                "kernel_key": kernel_key,
+            })
+            return
+        if is_bn:
+            slots.append({
+                "kind": "bn",
+                "path": path,
+                "node": node,
+                "stats": stats_node if isinstance(stats_node, Mapping) else None,
+            })
+            return
+        for key, child in node.items():
+            visit(
+                child,
+                (stats_node or {}).get(key) if stats_node else None,
+                f"{path}/{key}" if path else key,
+            )
+
+    visit(params, batch_stats, "")
+    return slots
+
+
+def import_keras_weights(
+    keras_model,
+    params: Dict[str, Any],
+    model_state: Optional[Dict[str, Any]] = None,
+) -> Tuple[Dict[str, Any], Dict[str, Any]]:
+    """Map a built Keras model's weights onto flax ``(params,
+    model_state)`` templates (e.g. from ``Model.initialize``); returns
+    NEW trees, templates untouched. Raises with both sides named on any
+    count or shape mismatch.
+    """
+    import jax.numpy as jnp
+
+    def clone(tree):
+        # Mapping, not dict: FrozenDict trees (older flax) traverse too;
+        # the clone is a plain mutable dict either way.
+        return {
+            k: clone(v) if isinstance(v, Mapping) else v
+            for k, v in tree.items()
+        }
+
+    new_params = clone(params)
+    new_state = clone(model_state or {})
+    slots = _flax_slots(new_params, new_state.get("batch_stats"))
+
+    def assign(node, key, value, what):
+        template = node[key]
+        value = np.asarray(value)
+        if tuple(template.shape) != tuple(value.shape):
+            raise ValueError(
+                f"{what}: keras weight shape {tuple(value.shape)} does "
+                f"not match template {tuple(template.shape)}."
+            )
+        node[key] = jnp.asarray(value, template.dtype)
+
+    slot_iter = iter(slots)
+    for layer in keras_model.layers:
+        weights = layer.get_weights()
+        if not weights:
+            continue
+        cls = type(layer).__name__
+        try:
+            slot = next(slot_iter)
+        except StopIteration:
+            raise ValueError(
+                f"Keras layer {layer.name!r} ({cls}) has no remaining "
+                "flax weight slot — architectures differ."
+            ) from None
+        what = f"keras {layer.name!r} ({cls}) -> flax {slot['path']!r}"
+        if cls == "BatchNormalization":
+            if slot["kind"] != "bn" or len(weights) != 4:
+                raise ValueError(
+                    f"{what}: expected a BatchNorm slot and 4 weights "
+                    f"(gamma, beta, moving_mean, moving_var; scale and "
+                    f"center enabled), got slot kind {slot['kind']!r} "
+                    f"and {len(weights)} weights."
+                )
+            gamma, beta, mean, var = weights
+            assign(slot["node"], "scale", gamma, what)
+            assign(slot["node"], "bias", beta, what)
+            if slot["stats"] is None:
+                raise ValueError(
+                    f"{what}: template has no batch_stats for this "
+                    "BatchNorm (pass model_state)."
+                )
+            assign(slot["stats"], "mean", mean, what)
+            assign(slot["stats"], "var", var, what)
+            continue
+        if slot["kind"] != "kernel" or len(weights) not in (1, 2):
+            raise ValueError(
+                f"{what}: expected a kernel slot and 1-2 weights "
+                f"(kernel[, bias]), got slot kind {slot['kind']!r} and "
+                f"{len(weights)} weights."
+            )
+        kernel = weights[0]
+        if "Transpose" in cls:
+            kernel = keras_transpose_kernel(kernel)
+        assign(slot["node"], slot["kernel_key"], kernel, what)
+        if len(weights) == 2:
+            if "bias" not in slot["node"]:
+                raise ValueError(
+                    f"{what}: keras layer has a bias but the flax layer "
+                    "does not (use_bias mismatch)."
+                )
+            assign(slot["node"], "bias", weights[1], what)
+        elif "bias" in slot["node"]:
+            raise ValueError(
+                f"{what}: flax layer has a bias but the keras layer "
+                "does not (use_bias mismatch)."
+            )
+    leftover = [s["path"] for s in slot_iter]
+    if leftover:
+        raise ValueError(
+            f"Keras model exhausted but flax slots remain: {leftover} — "
+            "architectures differ."
+        )
+    return new_params, new_state

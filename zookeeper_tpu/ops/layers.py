@@ -763,8 +763,10 @@ class QuantConvTranspose(nn.Module):
     ``Conv2DTranspose`` (gradient-of-conv) kernels are. The layer is
     internally consistent (the int8 path and its VJP share the
     convention, pinned by test), but a reference ``Conv2DTranspose``
-    checkpoint is not weight-portable verbatim: flip the spatial axes and
-    swap the last two kernel dims when importing such weights.
+    checkpoint is not weight-portable verbatim:
+    :func:`zookeeper_tpu.models.keras_transpose_kernel` converts (flip
+    the spatial axes, swap the trailing dims) — applied automatically by
+    ``models.import_keras_weights``, parity pinned by test.
     """
 
     features: int

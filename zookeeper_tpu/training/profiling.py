@@ -237,13 +237,21 @@ def format_breakdown(breakdown: dict, name_width: int = 70) -> str:
             f"{row['share'] * 100:5.1f}%"
         )
     roof = breakdown["roofline"]
-    lines.append(
+    line = (
         "roofline: "
         f"compute-bound ops {roof['compute_bound_ms_per_step']:.2f} ms "
         f"({roof['compute_bound_share'] * 100:.0f}%), "
         f"bandwidth-bound ops {roof['bandwidth_bound_ms_per_step']:.2f} ms "
         f"({roof['bandwidth_bound_share'] * 100:.0f}%)"
     )
+    if roof["unattributed_ms_per_step"] > 0.005:
+        # Without it, a trace missing peak/flops/bytes stats would print
+        # 0 ms everywhere and read as "no time" instead of "no roofline".
+        line += (
+            f", unattributed {roof['unattributed_ms_per_step']:.2f} ms "
+            "(ops without flops/bytes stats or peaks)"
+        )
+    lines.append(line)
     lines.append("top ops (ms/step):")
     for ms, category, op_name in breakdown["top_ops"]:
         lines.append(f"  {ms:8.3f}  [{category}] {op_name[:name_width]}")
