@@ -24,6 +24,7 @@ compiled step, so they track the real model, not a hand count.
 
 import json
 import os
+import sys
 import time
 
 # Fallback bf16 peak when on-chip measurement is unavailable: measured on
@@ -216,6 +217,19 @@ def main():
     # minutes at ImageNet shapes).
     compiled_step = jit_step.lower(state, batch).compile()
 
+    # Model FLOPs from XLA's cost analysis of the compiled train step
+    # (includes fwd + bwd + optimizer as actually executed). NOTE: for an
+    # SPMD executable this is already the PER-DEVICE partitioned module's
+    # FLOPs — do not divide by n_chips again. Computed before timing: it
+    # also sets the plausibility floor for the measured step time.
+    try:
+        analysis = compiled_step.cost_analysis()
+        if isinstance(analysis, list):  # older jax returns [dict]
+            analysis = analysis[0]
+        cost = float(analysis["flops"])
+    except Exception:
+        cost = None
+
     def run_chain(n):
         """n chained steps ended by a scalar host readback (device_get is
         the only reliable completion barrier through the remote-TPU
@@ -231,23 +245,40 @@ def main():
 
     # The tunnel adds ~100ms fixed sync latency per readback; the shared
     # two-chain-length marginal (time_marginal docstring) cancels it.
-    # More rounds = better minima vs tunnel jitter.
-    step_time = max(time_marginal(run_chain, 5, 25, rounds=8), 1e-9)
+    # More rounds = better minima vs tunnel jitter. Jitter varies by
+    # SESSION (BASELINE.md round 5 observed inverted marginals on chains
+    # that were ample in earlier rounds), so an implausible marginal —
+    # non-positive, or faster than 4x the hardware roofline for this
+    # step's own FLOPs — escalates to longer chains, and if even the
+    # longest chains stay implausible the bench FAILS instead of
+    # reporting garbage throughput.
+    min_plausible = (
+        cost / (4.0 * BF16_PEAK_FALLBACK) if cost else 1e-5
+    )
+    tiers = ((5, 25, 8), (15, 75, 8), (40, 200, 10))
+    step_time = -1.0
+    for i, (n1, n2, rounds) in enumerate(tiers):
+        step_time = time_marginal(run_chain, n1, n2, rounds=rounds)
+        if step_time > min_plausible:
+            break
+        print(
+            f"marginal {step_time * 1e3:.3f} ms/step from chains "
+            f"({n1}, {n2}) is implausible (< {min_plausible * 1e3:.3f} ms"
+            " roofline floor; tunnel jitter)"
+            + ("; escalating chain lengths..." if i + 1 < len(tiers) else ""),
+            file=sys.stderr,
+            flush=True,
+        )
+    if step_time <= min_plausible:
+        raise RuntimeError(
+            f"Bench could not obtain a plausible step time (last marginal "
+            f"{step_time * 1e3:.3f} ms <= floor {min_plausible * 1e3:.3f} "
+            "ms) even at the longest chain lengths — tunnel too unstable; "
+            "rerun on a quieter host."
+        )
 
     n_chips = jax.device_count()
     images_per_sec_per_chip = batch_size / step_time / max(1, n_chips)
-
-    # Model FLOPs from XLA's cost analysis of the compiled train step
-    # (includes fwd + bwd + optimizer as actually executed). NOTE: for an
-    # SPMD executable this is already the PER-DEVICE partitioned module's
-    # FLOPs — do not divide by n_chips again.
-    try:
-        analysis = compiled_step.cost_analysis()
-        if isinstance(analysis, list):  # older jax returns [dict]
-            analysis = analysis[0]
-        cost = float(analysis["flops"])
-    except Exception:
-        cost = None
 
     extras = {
         "model": model_name,

@@ -109,3 +109,19 @@ def test_latency_bench_task():
     assert result["model"] == "Mlp"
     assert result["ms_per_inference"] >= 0.0
     assert result["params_mib"] >= 0.0
+
+
+def test_digits_real_data_task():
+    """The offline REAL-data example: genuine handwritten digits, no
+    synthetic fallback, >=85% val accuracy in two epochs through the
+    subprocess CLI."""
+    pytest.importorskip("sklearn")
+    out = run_example(
+        "digits_experiment.py", "TrainDigits",
+        "epochs=2", "model.features=(16,32)", "model.dense_units=(64,)",
+    )
+    assert "epoch 2/2" in out
+    import re
+
+    accs = re.findall(r"val_acc=([0-9.]+)", out)
+    assert accs and float(accs[-1]) >= 0.85, out[-500:]

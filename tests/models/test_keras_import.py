@@ -179,3 +179,27 @@ def test_mismatches_are_loud():
     params2, state2 = model2.initialize(module2, (8, 8, 1))
     with pytest.raises(ValueError, match="flax slots remain"):
         import_keras_weights(tiny, params2, state2)
+
+
+def test_custom_learnables_refuse_import():
+    """Models with params outside the conv/dense/BN structures (e.g.
+    ReActNet's RSign/RPReLU shifts) must refuse order-aligned import
+    loudly — silently leaving them at init values would produce wrong
+    forwards with no error."""
+    from zookeeper_tpu.models import ReActNet
+
+    model = ReActNet()
+    configure(
+        model,
+        {"features": (8, 8), "strides": (1,)},
+        name="model",
+    )
+    module = model.build((8, 8, 3), num_classes=4)
+    params, model_state = model.initialize(module, (8, 8, 3))
+    keras_model = tf.keras.Sequential([
+        tf.keras.layers.Input((8, 8, 3)),
+        tf.keras.layers.Conv2D(8, 3, padding="same"),
+    ])
+    keras_model(np.zeros((1, 8, 8, 3), np.float32))
+    with pytest.raises(ValueError, match="custom learnables"):
+        import_keras_weights(keras_model, params, model_state)

@@ -128,3 +128,29 @@ def test_quicknet_flagship_learns_real_digits():
     history = exp.run()
     best = max(v["accuracy"] for v in history["validation"])
     assert best >= 0.85, f"best val accuracy {best:.3f} < 0.85"
+
+
+@pytest.mark.slow
+def test_birealnet_family_learns_real_digits():
+    """Bi-Real-Net (magnitude_aware_sign kernels, per-conv real-valued
+    residual shortcuts — a different quantizer family and block
+    structure than QuickNet) reaches >=80% validation accuracy on real
+    digits through the resize path."""
+    exp = TrainingExperiment()
+    configure(
+        exp,
+        _digits_conf({
+            "loader.preprocessing.height": 32,
+            "loader.preprocessing.width": 32,
+            "loader.preprocessing.resize": True,
+            "model": "BiRealNet",
+            "model.blocks_per_section": (1, 1),
+            "model.section_features": (16, 32),
+            "epochs": 8,
+            "optimizer.schedule.base_lr": 3e-3,
+        }),
+        name="experiment",
+    )
+    history = exp.run()
+    best = max(v["accuracy"] for v in history["validation"])
+    assert best >= 0.80, f"best val accuracy {best:.3f} < 0.80"

@@ -51,15 +51,27 @@ def _flax_slots(
 ) -> List[dict]:
     """Ordered weight slots from a flax params tree (call order — flax
     preserves scope-creation order): kernel slots (conv/dense, with
-    optional bias) and BN slots (scale/bias + running stats)."""
+    optional bias) and BN slots (scale/bias + running stats).
+
+    Any param leaf that fits NEITHER structure (custom learnables like
+    ReActNet's RSign/RPReLU shifts, packed deployment kernels, ...) is a
+    loud error: order-aligned import is only defined for conv/dense/BN
+    architectures, and skipping unknown params would either desync the
+    alignment or silently leave them at init values.
+    """
     slots: List[dict] = []
+    unmapped: List[str] = []
 
     def visit(node, stats_node, path):
         if not isinstance(node, Mapping):
+            unmapped.append(path)
             return
         kernel_key = next((k for k in _KERNEL_KEYS if k in node), None)
         is_bn = "scale" in node and "bias" in node and kernel_key is None
         if kernel_key is not None:
+            extra = set(node) - {kernel_key, "bias"}
+            if extra:
+                unmapped.extend(f"{path}/{k}" for k in sorted(extra))
             slots.append({
                 "kind": "kernel",
                 "path": path,
@@ -68,6 +80,9 @@ def _flax_slots(
             })
             return
         if is_bn:
+            extra = set(node) - {"scale", "bias"}
+            if extra:
+                unmapped.extend(f"{path}/{k}" for k in sorted(extra))
             slots.append({
                 "kind": "bn",
                 "path": path,
@@ -83,6 +98,14 @@ def _flax_slots(
             )
 
     visit(params, batch_stats, "")
+    if unmapped:
+        raise ValueError(
+            "Params tree has leaves the order-aligned Keras import cannot "
+            f"map (not conv/dense kernels or BatchNorm scale/bias): "
+            f"{unmapped[:8]}{'...' if len(unmapped) > 8 else ''}. Models "
+            "with custom learnables (e.g. RSign/RPReLU shifts) or packed "
+            "deployment params need a hand-written mapping."
+        )
     return slots
 
 
