@@ -256,3 +256,39 @@ def format_breakdown(breakdown: dict, name_width: int = 70) -> str:
     for ms, category, op_name in breakdown["top_ops"]:
         lines.append(f"  {ms:8.3f}  [{category}] {op_name[:name_width]}")
     return "\n".join(lines)
+
+
+def _main(argv: Optional[List[str]] = None) -> None:
+    """CLI: ``python -m zookeeper_tpu.training.profiling <trace_dir>
+    [--steps N] [--device SUBSTR] [--top K]`` — analyze an existing
+    profiler dump without writing a script."""
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        description="Per-op device-time attribution of a jax.profiler "
+        "trace (hlo_category shares + roofline split)."
+    )
+    parser.add_argument("trace_dir", help="profile_dir / start_trace dir")
+    parser.add_argument(
+        "--steps", type=int, default=1,
+        help="train steps the trace covers (divides totals)",
+    )
+    parser.add_argument(
+        "--device", default="", help="device plane substring, e.g. TPU:0"
+    )
+    parser.add_argument("--top", type=int, default=10)
+    args = parser.parse_args(argv)
+    print(
+        format_breakdown(
+            op_time_breakdown(
+                args.trace_dir,
+                steps=args.steps,
+                device_substring=args.device,
+                top_k=args.top,
+            )
+        )
+    )
+
+
+if __name__ == "__main__":
+    _main()
