@@ -55,7 +55,7 @@ def time_marginal(run_chain, n1: int, n2: int, rounds: int) -> float:
     return (t2_min - t1_min) / (n2 - n1)
 
 
-def measure_bf16_peak(rounds: int = 3) -> float:
+def measure_bf16_peak(rounds: int = 8) -> float:
     """Measure this chip's achievable bf16 matmul peak (FLOP/s) with the
     BASELINE.md methodology: a 4096^3 matmul iterated in an on-device
     ``fori_loop`` with a data dependency (each iterate feeds the next, the
@@ -77,8 +77,15 @@ def measure_bf16_peak(rounds: int = 3) -> float:
 
     from functools import partial
 
-    @partial(jax.jit, static_argnums=1)
-    def chain(x, iters):
+    @partial(jax.jit, static_argnums=2)
+    def chain(x, salt, iters):
+        # ``salt`` makes every invocation a DISTINCT computation: a
+        # fast-above-physics 268 TF/s reading showed that repeating the
+        # bit-identical request can be served from a cache somewhere in
+        # the remote-execution stack. The add is one elementwise op
+        # against `iters` matmuls.
+        x = x + salt
+
         def body(_, x):
             # 1/64 epilogue scale keeps iterates O(1) (row norms grow by
             # ~sqrt(n)*sigma per matmul); fuses into the matmul.
@@ -87,19 +94,34 @@ def measure_bf16_peak(rounds: int = 3) -> float:
         return jax.lax.fori_loop(0, iters, body, x).sum()
 
     x0 = jnp.asarray(rng.normal(size=(n, n)), jnp.bfloat16)
-    n1, n2 = 20, 60
-    float(jax.device_get(chain(x0, n1)))  # Warm both compiles.
-    float(jax.device_get(chain(x0, n2)))
+    # 200 marginal matmuls ~ 150 ms of MXU work: the old (20, 60)
+    # chains left the ~30 ms marginal inside one tunnel-jitter spike,
+    # which once passed a degraded 114 TF/s through the (generation-
+    # agnostic, so necessarily wide) plausibility window and inflated
+    # that run's MFU.
+    n1, n2 = 100, 300
+    salt = iter(range(1, 10_000))
 
     def run_chain(iters):
+        s = jnp.bfloat16(next(salt) * 1e-6)
         t0 = time.perf_counter()
-        float(jax.device_get(chain(x0, iters)))
+        float(jax.device_get(chain(x0, s, iters)))
         return time.perf_counter() - t0
 
-    per_matmul = time_marginal(run_chain, n1, n2, rounds)
-    if per_matmul <= 0:
+    run_chain(n1)  # Warm both compiles.
+    run_chain(n2)
+    # Max over independent attempts: for a PEAK, noise can only make the
+    # chip look slower (nothing finishes matmuls early once identical-
+    # request caching is salted away), so the largest plausible attempt
+    # is the best estimate — observed attempt spread is ~192 / ~154
+    # TF/s when a jitter spike lands inside one attempt's marginal.
+    peak = 0.0
+    for _ in range(2):
+        per_matmul = time_marginal(run_chain, n1, n2, rounds)
+        if per_matmul > 0:
+            peak = max(peak, 2.0 * n**3 / per_matmul)
+    if peak <= 0:
         raise ValueError("peak measurement inverted (jitter > marginal)")
-    peak = 2.0 * n**3 / per_matmul
     # Plausibility window wide enough for any current/near TPU generation
     # (v2 ~45 bf16 TFLOP/s ... future ~2 PFLOP/s); outside it the number
     # is measurement failure, not hardware.
