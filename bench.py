@@ -159,9 +159,11 @@ def resolve_bench_config(env=None):
     the other acceptance configs (ResNet50 bf16 — BASELINE config #5,
     BinaryAlexNet — config #2) with the same harness.
 
-    Returns ``(model, model_name, batch_size, binary_compute)`` with the
-    model configured; ``binary_compute`` is None for fp models (no binary
-    path to select).
+    Returns ``(model, model_name, batch_size, binary_compute,
+    pack_residuals)`` with the model configured; ``binary_compute`` is
+    None for fp models (no binary path to select), and
+    ``pack_residuals`` records whether the 1-bit residual lever was
+    actually applied (requested AND supported by the model).
     """
     from zookeeper_tpu import models as zoo
     from zookeeper_tpu.core import configure
@@ -188,8 +190,18 @@ def resolve_bench_config(env=None):
         conf["binary_compute"] = binary_compute
     else:
         binary_compute = None
+    pack_residuals = (
+        _env_flag(env, "ZK_BENCH_PACK_RESIDUALS")
+        and "pack_residuals" in type(model).__component_fields__
+    )
+    if pack_residuals:
+        conf["pack_residuals"] = True
     configure(model, conf, name="model")
-    return model, model_name, batch_size, binary_compute
+    return model, model_name, batch_size, binary_compute, pack_residuals
+
+
+def _env_flag(env, name: str, default: str = "0") -> bool:
+    return env.get(name, default).strip().lower() not in ("0", "", "false")
 
 
 def main():
@@ -204,7 +216,13 @@ def main():
 
     input_shape = (224, 224, 3)
     num_classes = 1000
-    model, model_name, batch_size, binary_compute = resolve_bench_config()
+    (
+        model,
+        model_name,
+        batch_size,
+        binary_compute,
+        pack_residuals,
+    ) = resolve_bench_config()
     module = model.build(input_shape, num_classes=num_classes)
     params, model_state = model.initialize(module, input_shape)
     state = TrainState.create(
@@ -252,6 +270,17 @@ def main():
     except Exception:
         cost = None
 
+    # Resolve the MFU anchor BEFORE timing: the plausibility floor below
+    # must scale with the chip actually under test (deriving it from the
+    # v5e fallback would reject legitimate marginals on any chip >4x a
+    # v5e), and resolving it here also keeps the peak measurement's own
+    # traffic out of the timed window. With no cost analysis there is no
+    # floor and no MFU — skip the (expensive, on-chip) measurement
+    # entirely rather than burning matmul chains on a number nothing
+    # reads.
+    if cost is not None:
+        peak_flops, peak_source = resolve_peak_flops()
+
     def run_chain(n):
         """n chained steps ended by a scalar host readback (device_get is
         the only reliable completion barrier through the remote-TPU
@@ -275,7 +304,7 @@ def main():
     # longest chains stay implausible the bench FAILS instead of
     # reporting garbage throughput.
     min_plausible = (
-        cost / (4.0 * BF16_PEAK_FALLBACK) if cost else 1e-5
+        cost / (4.0 * peak_flops) if cost is not None else 1e-5
     )
     # First tier starts at 60 marginal steps (~1.3 s of work on the
     # north star): at the (5, 25) chains rounds 2-4 used, a noisy
@@ -310,11 +339,11 @@ def main():
         "model": model_name,
         "batch_size": batch_size,
         "binary_compute": binary_compute,
+        "pack_residuals": pack_residuals,
         "step_time_ms": round(step_time * 1e3, 2),
         "n_chips": n_chips,
     }
     if cost is not None:
-        peak_flops, peak_source = resolve_peak_flops()
         mfu = cost / step_time / peak_flops
         extras["per_chip_step_tflops"] = round(cost / 1e12, 2)
         vs_baseline = round(mfu, 4)

@@ -50,6 +50,12 @@ class ConvertPacked(Experiment):
     #: sums are integers — 0.0 is achievable and the default for pure
     #: sign models; allow small slack for scaled kernels).
     verify_atol: float = Field(0.0)
+    #: Fold each packed layer's eval-mode BatchNorm into the conv
+    #: epilogue at conversion (LCE-style; erases 4 fp32 vectors per conv
+    #: from the deployed tree). The affine re-association is equal to
+    #: float rounding, not bitwise — set verify_atol accordingly
+    #: (~1e-4 covers typical stacks).
+    fold_bn: bool = Field(False)
     #: Run Pallas kernels interpreted (CPU verification).
     pallas_interpret: bool = Field(True)
 
@@ -62,9 +68,9 @@ class ConvertPacked(Experiment):
         input_shape = (self.height, self.width, self.channels)
 
         module_f = self.model.build(input_shape, self.num_classes)
-        params_f, model_state = self.model.initialize(module_f, input_shape)
+        params_init, model_state = self.model.initialize(module_f, input_shape)
         params_f, model_state = load_model(
-            self.checkpoint, params_f, model_state
+            self.checkpoint, params_init, model_state
         )
 
         # Deployment twin: same architecture, packed weights. Uses the
@@ -97,6 +103,13 @@ class ConvertPacked(Experiment):
                 "pallas_interpret": self.pallas_interpret,
             }
         )
+        if self.fold_bn:
+            if not hasattr(type(self.model), "fold_bn"):
+                raise ValueError(
+                    f"{type(self.model).__name__} has no fold_bn "
+                    "deployment mode."
+                )
+            conf["fold_bn"] = True
         _configure(deploy_model, conf, name="deploy_model")
         module_p = deploy_model.build(input_shape, self.num_classes)
         abstract = jax.eval_shape(
@@ -106,11 +119,30 @@ class ConvertPacked(Experiment):
                 training=False,
             )
         )
-        packed_params = pack_quantconv_params(
-            params_f,
-            kernel_quantizer=self.kernel_quantizer,
-            template=abstract["params"],
-        )
+        if self.fold_bn:
+            # Creation-order tree: checkpoint loads (and anything that
+            # round-trips a dict through JAX pytrees, like eval_shape)
+            # sort params alphabetically, which breaks the
+            # conv->following-BN adjacency the fold pairing reads. The
+            # pre-load initialize result still has module creation order.
+            order = params_init
+            packed_params, folded_stats = pack_quantconv_params(
+                params_f,
+                kernel_quantizer=self.kernel_quantizer,
+                template=abstract["params"],
+                fold_bn=True,
+                batch_stats=model_state["batch_stats"],
+                fold_order=order,
+            )
+            deploy_state = dict(model_state)
+            deploy_state["batch_stats"] = folded_stats
+        else:
+            packed_params = pack_quantconv_params(
+                params_f,
+                kernel_quantizer=self.kernel_quantizer,
+                template=abstract["params"],
+            )
+            deploy_state = model_state
 
         # Verify on a probe batch BEFORE writing.
         rng = np.random.default_rng(0)
@@ -119,7 +151,7 @@ class ConvertPacked(Experiment):
             {"params": params_f, **model_state}, x, training=False
         )
         y_p = module_p.apply(
-            {"params": packed_params, **model_state}, x, training=False
+            {"params": packed_params, **deploy_state}, x, training=False
         )
         max_diff = float(jnp.max(jnp.abs(y_f - y_p)))
         if max_diff > self.verify_atol:
@@ -129,7 +161,7 @@ class ConvertPacked(Experiment):
                 "kernel_quantizer for this family?"
             )
 
-        save_model(self.output, packed_params, model_state)
+        save_model(self.output, packed_params, deploy_state)
 
         s_f = model_summary(module_f, input_shape)
         s_p = model_summary(module_p, input_shape)

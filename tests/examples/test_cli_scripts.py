@@ -32,23 +32,39 @@ def test_bench_config_resolution():
     with binary_compute applied only where the model has the field."""
     resolve_bench_config = _resolve_bench_config()
 
-    model, name, batch, bc = resolve_bench_config(env={})
+    model, name, batch, bc, packres = resolve_bench_config(env={})
     assert (name, batch, bc) == ("QuickNetLarge", 128, "int8")
     assert model.compute_dtype == "bfloat16"
+    assert packres is False
 
-    model, name, batch, bc = resolve_bench_config(
-        env={"ZK_BENCH_MODEL": "ResNet50", "ZK_BENCH_BATCH": "256"}
+    model, name, batch, bc, packres = resolve_bench_config(
+        env={
+            "ZK_BENCH_MODEL": "ResNet50",
+            "ZK_BENCH_BATCH": "256",
+            # Requested but unsupported by the fp model: recorded as
+            # NOT applied, so the bench output cannot claim a lever
+            # that never ran.
+            "ZK_BENCH_PACK_RESIDUALS": "1",
+        }
     )
     assert (name, batch) == ("ResNet50", 256)
     assert bc is None  # fp model: no binary path field
+    assert packres is False
 
-    model, name, batch, bc = resolve_bench_config(
+    model, name, batch, bc, packres = resolve_bench_config(
         env={
             "ZK_BENCH_MODEL": "BinaryAlexNet",
             "ZK_BENCH_BINARY_COMPUTE": "mxu",
         }
     )
     assert (name, bc) == ("BinaryAlexNet", "mxu")
+
+    # QuickNet supports the lever: requested -> applied and recorded.
+    model, name, batch, bc, packres = resolve_bench_config(
+        env={"ZK_BENCH_PACK_RESIDUALS": "1"}
+    )
+    assert packres is True
+    assert model.pack_residuals is True
 
     with pytest.raises(ValueError, match="not in the zoo"):
         resolve_bench_config(env={"ZK_BENCH_MODEL": "NoSuchNet"})

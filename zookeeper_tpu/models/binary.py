@@ -348,6 +348,18 @@ class _QuickNetModule(nn.Module):
     dtype: Any
     binary_compute: Any = "mxu"  # str | per-section tuple of str
     packed_weights: Any = False  # bool | per-section tuple of bool
+    #: 1-bit fwd->bwd residual storage on the binary convs (requires the
+    #: int8 path; see QuantConv.pack_residuals).
+    pack_residuals: bool = False
+    #: DEPLOYMENT-ONLY: skip the BatchNorm after each binary conv — its
+    #: eval-mode scale/shift is folded into the conv's kernel_scale and
+    #: a bias at convert time (ops.packed.pack_quantconv_params
+    #: fold_bn=True), erasing four fp32 vectors per conv from the
+    #: deployed params. The uncalled BN is still CONSTRUCTED so flax
+    #: auto-numbering of the remaining (stem/transition) BatchNorms
+    #: matches the trained checkpoint. Invalid for training (batch-stats
+    #: BN cannot fold).
+    fold_bn: bool = False
     pallas_interpret: bool = False
 
     def _section_opt(self, value, s: int):
@@ -357,6 +369,15 @@ class _QuickNetModule(nn.Module):
 
     @nn.compact
     def __call__(self, x, training: bool = False):
+        if self.fold_bn and training:
+            raise ValueError(
+                "fold_bn=True is a DEPLOYMENT mode: the binary-conv "
+                "BatchNorms are folded into conv params at convert time "
+                "and skipped here, so a training=True apply would run "
+                "un-normalized with batch stats silently missing. Train "
+                "with fold_bn=False and convert with "
+                "pack_quantconv_params(fold_bn=True)."
+            )
         d = self.dtype
         # Stem: fp 3x3/2 to 8ch, then grouped 3x3/2 to first section width.
         x = nn.Conv(8, (3, 3), strides=(2, 2), padding="SAME",
@@ -378,14 +399,24 @@ class _QuickNetModule(nn.Module):
                 x = nn.Conv(feat, (1, 1), use_bias=False, dtype=d)(x)
                 x = _bn(training, self.dtype)(x)
             for _ in range(n):
+                # BN folds only where the section ships packed (the
+                # converter emits the folded scale/bias into the packed
+                # param structure); unpacked sections keep their BN.
+                fold_here = self.fold_bn and bool(
+                    self._section_opt(self.packed_weights, s)
+                )
                 y = QuantConv(
                     feat, (3, 3), input_quantizer="ste_sign",
                     kernel_quantizer="ste_sign", dtype=d,
                     binary_compute=self._section_opt(self.binary_compute, s),
                     packed_weights=self._section_opt(self.packed_weights, s),
+                    pack_residuals=self.pack_residuals,
+                    use_bias=fold_here,  # Carries the folded BN shift.
                     pallas_interpret=self.pallas_interpret,
                 )(x)
-                y = _bn(training, d)(y)
+                bn = _bn(training, d)  # Constructed even when folded:
+                if not fold_here:  # keeps flax auto-numbering stable.
+                    y = bn(y)
                 x = x + y  # Residual around every binary conv.
         x = nn.relu(x)
         x = jnp.mean(x, axis=(1, 2))
@@ -404,6 +435,11 @@ class QuickNet(Model):
     section_features: Sequence[int] = Field((64, 128, 256, 512))
     binary_compute: Union[str, Sequence[str]] = Field("mxu")
     packed_weights: Union[bool, Sequence[bool]] = Field(False)
+    #: 1-bit residual storage on the binary convs (int8 path only).
+    pack_residuals: bool = Field(False)
+    #: Deployment-only: binary-conv BNs folded into the conv epilogue
+    #: (pair with ops.packed.pack_quantconv_params fold_bn=True).
+    fold_bn: bool = Field(False)
     pallas_interpret: bool = Field(False)
 
     def build(self, input_shape, num_classes: int) -> nn.Module:
@@ -427,6 +463,8 @@ class QuickNet(Model):
             dtype=self.dtype(),
             binary_compute=norm(self.binary_compute),
             packed_weights=norm(self.packed_weights),
+            pack_residuals=self.pack_residuals,
+            fold_bn=self.fold_bn,
             pallas_interpret=self.pallas_interpret,
         )
 

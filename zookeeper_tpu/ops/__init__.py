@@ -17,6 +17,7 @@ from zookeeper_tpu.ops.quantizers import (
     magnitude_aware_sign,
     ste_heaviside,
     ste_sign,
+    ste_sign_packed,
     ste_tern,
     swish_sign,
 )
@@ -41,13 +42,16 @@ from zookeeper_tpu.ops.binary_compute import (
     int8_conv_transpose,
     int8_dense,
     int8_matmul,
+    mask_mul_resid,
     pack_bits,
     pack_conv_kernel,
     pack_dense_kernel,
+    pack_resid,
     packed_conv_infer,
     packed_dense_infer,
     packed_weight_matmul,
     unpack_bits,
+    unpack_resid_pm1,
     xnor_conv,
     xnor_dense,
     xnor_matmul,
@@ -61,15 +65,18 @@ __all__ = [
     "int8_conv_transpose",
     "int8_dense",
     "int8_matmul",
+    "mask_mul_resid",
     "pack_bits",
     "pack_conv_kernel",
     "pack_dense_kernel",
     "pack_quantconv_params",
+    "pack_resid",
     "packed_conv_infer",
     "packed_dense_infer",
     "packed_weight_matmul",
     "quantized_param_view",
     "unpack_bits",
+    "unpack_resid_pm1",
     "xnor_conv",
     "xnor_dense",
     "xnor_matmul",
@@ -94,6 +101,7 @@ __all__ = [
     "magnitude_aware_sign",
     "ste_heaviside",
     "ste_sign",
+    "ste_sign_packed",
     "ste_tern",
     "swish_sign",
 ]

@@ -86,6 +86,219 @@ def _round_up(x: int, m: int) -> int:
     return (x + m - 1) // m * m
 
 
+# -- batch-packed 1-bit residual kernels (Pallas) ---------------------------
+#
+# The residual-residency levers (pack_residuals / ste_sign_packed) must
+# not COST bandwidth. Two measured dead ends on the way here
+# (BASELINE.md round 6):
+#
+# - jnp 32-way bit pack/unpack materializes [..., 32]-shaped int32
+#   intermediates — 4 bytes per BIT, 32x more traffic than the tensor
+#   being compressed (north-star step 21.0 -> 37.2 ms);
+# - Pallas kernels over a FLATTENED [rows, 4096] view forced XLA to
+#   relayout every residual in and out of the flat shape: NHWC tensors
+#   are (8, 128)-tiled on the trailing dims, so reshape(-1) is a real
+#   copy, and "data formatting" alone cost 21 ms/step (step 49.2 ms).
+#
+# These kernels therefore pack along the BATCH dimension on the NATIVE
+# 4-D layout: batch is the outermost, untiled dim, so no reshape or
+# relayout exists anywhere on the path; word [g, h, w, c] takes bit b
+# from x[32g + b, h, w, c] — 32 unrolled elementwise VPU ops per block
+# over [bh, bw, C] tiles, traffic = one read of the source + one
+# 1/32-size write (pack), or the reverse (unpack). The layout is an
+# internal storage convention (only these kernels' inverse pairs read
+# it), not the pack_bits wire format. Batch pads to a multiple of 32
+# (tiny at training batch sizes; correctness-only for small test
+# batches).
+
+#: VMEM budget per block (input side) for the residual kernels.
+_RESID_BLOCK_BYTES = 2 * 1024 * 1024
+
+
+def _resid_interpret(interpret) -> bool:
+    """Resolve the interpret flag: explicit wins (the layer's
+    ``pallas_interpret`` convention); ``None`` auto-selects interpret
+    off-TPU so the quantizer-level entry points (which have no flag to
+    thread) still run everywhere."""
+    if interpret is not None:
+        return interpret
+    import jax as _jax
+
+    return _jax.default_backend() != "tpu"
+
+
+def _to_4d_shape(shape):
+    """Normalize a residual shape to [B, H, W, C] with LAYOUT-PRESERVING
+    reshapes only: unit dims inserted before the trailing (tiled) dims,
+    or leading (untiled) dims merged. Pure shape arithmetic — pack and
+    unpack recompute it identically from the original shape."""
+    if len(shape) == 4:
+        return tuple(shape)
+    if len(shape) == 2:  # [B, K] (dense residuals)
+        return (shape[0], 1, 1, shape[1])
+    if len(shape) == 3:  # [B, W, C] (1-D conv residuals)
+        return (shape[0], 1, shape[1], shape[2])
+    if len(shape) > 4:  # [B, *spatial, C]: merge leading spatial dims
+        from math import prod
+
+        return (shape[0], prod(shape[1:-2]), shape[-2], shape[-1])
+    raise ValueError(
+        f"1-bit residual packing needs a batched tensor, got shape {shape}."
+    )
+
+
+def _divisor_at_most(n: int, cap: int) -> int:
+    for d in range(max(1, min(cap, n)), 0, -1):
+        if n % d == 0:
+            return d
+    return 1
+
+
+def _resid_blocks(h: int, w: int, c: int, itemsize: int):
+    """(bh, bw): spatial block dims dividing (h, w) with the 32-deep
+    input block inside the VMEM budget."""
+    per_row = 32 * c * itemsize
+    bw = _divisor_at_most(w, max(1, _RESID_BLOCK_BYTES // per_row))
+    bh = _divisor_at_most(h, max(1, _RESID_BLOCK_BYTES // (per_row * bw)))
+    return bh, bw
+
+
+def _pack_resid_kernel(x_ref, out_ref, *, mask_mode: bool):
+    acc = jnp.zeros(out_ref.shape, jnp.int32)
+    for b in range(32):
+        # fp32 compare: Mosaic has no bf16 vector cmpf on this target
+        # (the widen is a free vreg conversion).
+        chunk = x_ref[b].astype(jnp.float32)
+        if mask_mode:
+            bit = jnp.abs(chunk) <= 1.0  # the ste_sign pass-through mask
+        else:
+            bit = chunk >= 0  # +-1 sign bit
+        acc = acc | (bit[None].astype(jnp.int32) << b)
+    out_ref[:] = acc
+
+
+def _unpack_pm1_resid_kernel(w_ref, out_ref, *, dtype):
+    w = w_ref[0]
+    for b in range(32):
+        bit = (w >> b) & 1
+        # Arithmetic +-1 decode (b+b-1): no vector integer multiply.
+        out_ref[b] = (bit + bit - 1).astype(dtype)
+
+
+def _mask_mul_resid_kernel(g_ref, w_ref, out_ref):
+    w = w_ref[0]
+    for b in range(32):
+        bit = ((w >> b) & 1).astype(g_ref.dtype)
+        out_ref[b] = g_ref[b] * bit
+
+
+def _pad_batch(x4: Array, pad_value) -> Array:
+    b = x4.shape[0]
+    bp = _round_up(b, 32)
+    if bp == b:
+        return x4
+    return jnp.pad(
+        x4,
+        ((0, bp - b), (0, 0), (0, 0), (0, 0)),
+        constant_values=pad_value,
+    )
+
+
+def pack_resid(
+    x: Array, *, mask_mode: bool = False, interpret: bool = None
+) -> Array:
+    """Pack a tensor to 1 bit/value along the BATCH dim: the sign bit
+    (``mask_mode=False``, exact for strictly-+-1 tensors) or the STE
+    pass-through bit ``|x| <= 1`` (``mask_mode=True``). Returns
+    [ceil(B/32), H, W, C] int32 words (shape normalized per
+    :func:`_to_4d_shape`)."""
+    x4 = _pad_batch(x.reshape(_to_4d_shape(x.shape)), 1.0)
+    bp, h, w, c = x4.shape
+    bh, bw = _resid_blocks(h, w, c, jnp.dtype(x.dtype).itemsize)
+    out = pl.pallas_call(
+        partial(_pack_resid_kernel, mask_mode=mask_mode),
+        out_shape=jax.ShapeDtypeStruct((bp // 32, h, w, c), jnp.int32),
+        grid=(bp // 32, h // bh, w // bw),
+        in_specs=[
+            pl.BlockSpec(
+                (32, bh, bw, c),
+                lambda i, j, k: (i, j, k, 0),
+                memory_space=pltpu.VMEM,
+            )
+        ],
+        out_specs=pl.BlockSpec(
+            (1, bh, bw, c),
+            lambda i, j, k: (i, j, k, 0),
+            memory_space=pltpu.VMEM,
+        ),
+        interpret=_resid_interpret(interpret),
+    )(x4)
+    return out
+
+
+def unpack_resid_pm1(words: Array, shape, dtype,
+                     interpret: bool = None) -> Array:
+    """Inverse of sign-mode :func:`pack_resid`: +-1 values of ``shape``
+    in ``dtype`` (bit-exact: +-1 is representable in every float type)."""
+    b4, h, w, c = _to_4d_shape(shape)
+    bp = _round_up(b4, 32)
+    bh, bw = _resid_blocks(h, w, c, jnp.dtype(dtype).itemsize)
+    out = pl.pallas_call(
+        partial(_unpack_pm1_resid_kernel, dtype=dtype),
+        out_shape=jax.ShapeDtypeStruct((bp, h, w, c), dtype),
+        grid=(bp // 32, h // bh, w // bw),
+        in_specs=[
+            pl.BlockSpec(
+                (1, bh, bw, c),
+                lambda i, j, k: (i, j, k, 0),
+                memory_space=pltpu.VMEM,
+            )
+        ],
+        out_specs=pl.BlockSpec(
+            (32, bh, bw, c),
+            lambda i, j, k: (i, j, k, 0),
+            memory_space=pltpu.VMEM,
+        ),
+        interpret=_resid_interpret(interpret),
+    )(words)
+    return out[:b4].reshape(shape)
+
+
+def mask_mul_resid(g: Array, words: Array, interpret: bool = None) -> Array:
+    """``g * mask`` where ``mask`` is a mask-mode :func:`pack_resid` of a
+    tensor shaped like ``g`` — the fused unpack-multiply for the
+    ste_sign backward (one read of g + 1/32 of a read for the mask, vs
+    a full re-read of the fp input in the unpacked baseline)."""
+    g4 = _pad_batch(g.reshape(_to_4d_shape(g.shape)), 0.0)
+    bp, h, w, c = g4.shape
+    bh, bw = _resid_blocks(h, w, c, jnp.dtype(g.dtype).itemsize)
+    out = pl.pallas_call(
+        _mask_mul_resid_kernel,
+        out_shape=jax.ShapeDtypeStruct((bp, h, w, c), g.dtype),
+        grid=(bp // 32, h // bh, w // bw),
+        in_specs=[
+            pl.BlockSpec(
+                (32, bh, bw, c),
+                lambda i, j, k: (i, j, k, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, bh, bw, c),
+                lambda i, j, k: (i, j, k, 0),
+                memory_space=pltpu.VMEM,
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (32, bh, bw, c),
+            lambda i, j, k: (i, j, k, 0),
+            memory_space=pltpu.VMEM,
+        ),
+        interpret=_resid_interpret(interpret),
+    )(g4, words)
+    # Batch is dim 0 in both the original and the normalized shape.
+    return out[: g.shape[0]].reshape(g.shape)
+
+
 # -- XNOR-popcount VPU Pallas GEMM (both operands packed) -------------------
 
 
@@ -929,9 +1142,11 @@ def _int8_conv_forward(x_sign, k_sign, strides, padding, groups, scaled):
     return out * safe.astype(jnp.float32) if scaled else out
 
 
-@partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5))
+@partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5, 6, 7))
 def int8_conv(x_sign: Array, k_sign: Array, strides: Tuple[int, ...],
-              padding: str, groups: int = 1, scaled: bool = True) -> Array:
+              padding: str, groups: int = 1, scaled: bool = True,
+              pack_residuals: bool = False,
+              pallas_interpret: bool = None) -> Array:
     """Channels-last conv of quantized operands on the int8 MXU path —
     any spatial rank (1-D [N,W,C], 2-D NHWC, 3-D NDHWC; rank inferred
     from the kernel).
@@ -942,19 +1157,48 @@ def int8_conv(x_sign: Array, k_sign: Array, strides: Tuple[int, ...],
     conv's gradients (the op *is* that function there). ``groups``
     supports depthwise/grouped convs (QuantDepthwiseConv); pass
     ``scaled=False`` when the kernel is statically known to be pure
-    {-1, 0, +1} (skips the scale extraction)."""
+    {-1, 0, +1} (skips the scale extraction).
+
+    ``pack_residuals=True`` stores the activation residual BIT-PACKED
+    between forward and backward (1 bit/value instead of 16/32): the
+    wgrad reconstructs ``x_sign`` from the packed words, bit-exactly,
+    because the values are +-1 by contract. Requires strictly +-1 inputs
+    (a 0 would unpack as +1 and corrupt the weight gradient — the layer
+    gates this on the +-1 input quantizers). This is the activation-
+    residency lever against the bandwidth-bound backward (the residual
+    write+read traffic drops 32x; VERDICT r3 next #1).
+    ``pallas_interpret`` applies to the residual pack/unpack kernels
+    only (None = auto: interpret off-TPU)."""
     return _int8_conv_forward(x_sign, k_sign, strides, padding, groups, scaled)
 
 
-def _int8_conv_fwd(x_sign, k_sign, strides, padding, groups, scaled):
-    return (
-        _int8_conv_forward(x_sign, k_sign, strides, padding, groups, scaled),
-        (x_sign, k_sign),
-    )
+def _int8_conv_fwd(x_sign, k_sign, strides, padding, groups, scaled,
+                   pack_residuals, pallas_interpret):
+    y = _int8_conv_forward(x_sign, k_sign, strides, padding, groups, scaled)
+    if pack_residuals:
+        # Size-0 token x[:0] (shape (0, *spatial, C)): bwd must rebuild
+        # x at its original shape/dtype, and neither is recoverable from
+        # the flat packed words alone (batch comes from the cotangent).
+        res = (
+            pack_resid(x_sign, interpret=pallas_interpret),
+            x_sign[:0],
+            k_sign,
+        )
+    else:
+        res = (x_sign, k_sign)
+    return y, res
 
 
-def _int8_conv_bwd(strides, padding, groups, scaled, res, g):
-    x_sign, k_sign = res
+def _int8_conv_bwd(strides, padding, groups, scaled, pack_residuals,
+                   pallas_interpret, res, g):
+    if pack_residuals:
+        words, tok, k_sign = res
+        shape = (g.shape[0], *tok.shape[1:])
+        x_sign = unpack_resid_pm1(
+            words, shape, tok.dtype, interpret=pallas_interpret
+        )
+    else:
+        x_sign, k_sign = res
     _, vjp = jax.vjp(
         lambda x, k: _float_conv(x, k, strides, padding, groups),
         x_sign, k_sign,

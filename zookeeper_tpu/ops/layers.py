@@ -22,7 +22,7 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
-from zookeeper_tpu.ops.quantizers import get_quantizer
+from zookeeper_tpu.ops.quantizers import get_quantizer, ste_sign_packed
 from zookeeper_tpu.parallel.sharding import constrain_batch_sharded
 
 Quantizer = Union[str, Callable, None]
@@ -36,7 +36,14 @@ _SIGN_KERNEL_QUANTIZERS = frozenset(
 #: must be exact small integers ({-1, 0, +1}) because activations are
 #: cast to int8 (dorefa's fractions would truncate).
 _INT_INPUT_QUANTIZERS = frozenset(
-    {"ste_sign", "approx_sign", "swish_sign", "ste_tern", "ste_heaviside"}
+    {
+        "ste_sign",
+        "ste_sign_packed",
+        "approx_sign",
+        "swish_sign",
+        "ste_tern",
+        "ste_heaviside",
+    }
 )
 #: Kernel quantizers the int8 path runs exactly: sign-family (sign x
 #: per-channel scale — the scale is re-applied after the integer conv)
@@ -47,7 +54,9 @@ _INT_KERNEL_QUANTIZERS = _SIGN_KERNEL_QUANTIZERS | {
 }
 #: Input quantizers safe for the bit-serial popcount path: strictly +-1
 #: (a 0 would be packed as the +1 bit and silently miscounted).
-_PM1_INPUT_QUANTIZERS = frozenset({"ste_sign", "approx_sign", "swish_sign"})
+_PM1_INPUT_QUANTIZERS = frozenset(
+    {"ste_sign", "ste_sign_packed", "approx_sign", "swish_sign"}
+)
 
 BINARY_COMPUTE_MODES = ("mxu", "int8", "xnor", "xnor_popcount")
 
@@ -209,6 +218,48 @@ def _check_binary_compute(
         )
 
 
+def _check_pack_residuals(
+    mode: str, input_quantizer: Quantizer, packed_weights: bool, layer: str
+) -> None:
+    """Loud validation for ``pack_residuals=True`` (1-bit fwd->bwd
+    residual storage): correctness rests on the input quantizer emitting
+    strictly +-1 (a 0 would unpack as +1 and corrupt the weight
+    gradient), and the lever only exists where a custom VJP owns the
+    residuals (the int8 path). Callables are trusted to honor the +-1
+    contract, matching :func:`_check_binary_compute`."""
+    problems = []
+    if packed_weights:
+        problems.append(
+            "packed_weights=True is inference-only (no training residuals "
+            "to pack)"
+        )
+    if mode != "int8":
+        problems.append(
+            f"binary_compute={mode!r} does not own its backward residuals "
+            "(supported: 'int8')"
+        )
+    if input_quantizer is None:
+        problems.append(
+            "input_quantizer is None (unquantized inputs are not +-1)"
+        )
+    elif (
+        isinstance(input_quantizer, str)
+        and input_quantizer not in _PM1_INPUT_QUANTIZERS
+    ):
+        problems.append(
+            f"input_quantizer {input_quantizer!r} can emit values other "
+            "than +-1, which 1-bit packing would corrupt (requires one of "
+            f"{sorted(_PM1_INPUT_QUANTIZERS)})"
+        )
+    if problems:
+        raise ValueError(
+            f"{layer}: pack_residuals=True requested but unusable: "
+            + "; ".join(problems)
+            + ". Fix the configuration or drop pack_residuals — this "
+            "layer never falls back silently."
+        )
+
+
 class QuantDense(nn.Module):
     """Dense layer with optional input/kernel quantization.
 
@@ -354,6 +405,13 @@ class QuantConv(nn.Module):
     #: Requires a packed binary_compute mode; fill the params from a
     #: trained float checkpoint with ops.packed.pack_quantconv_params.
     packed_weights: bool = False
+    #: Store fwd->bwd residuals at 1 bit/value: the +-1 conv input packs
+    #: 32x (the wgrad unpacks it bit-exactly) and an "ste_sign" input
+    #: quantizer swaps to its packed-mask variant. The activation-
+    #: residency lever against the bandwidth-bound backward of binary
+    #: nets. Requires binary_compute="int8" and a strictly-+-1 input
+    #: quantizer; numerics are bit-identical either way.
+    pack_residuals: bool = False
     #: Run Pallas kernels in interpreter mode (CPU tests).
     pallas_interpret: bool = False
     kernel_init: Callable = nn.initializers.glorot_normal()
@@ -379,6 +437,15 @@ class QuantConv(nn.Module):
             self.binary_compute, in_q, k_q, self.input_quantizer,
             self.kernel_quantizer, self.padding, type(self).__name__,
         )
+        if self.pack_residuals:
+            _check_pack_residuals(
+                self.binary_compute, self.input_quantizer,
+                self.packed_weights, type(self).__name__,
+            )
+            if self.input_quantizer == "ste_sign":
+                # Same values and gradients; the STE mask residual packs
+                # to 1 bit alongside the conv-input residual.
+                in_q = ste_sign_packed
         if tuple(self.kernel_dilation) != (1, 1) and self.binary_compute != "mxu":
             raise ValueError(
                 f"{type(self).__name__}: kernel_dilation="
@@ -451,6 +518,10 @@ class QuantConv(nn.Module):
                 y = int8_conv(
                     x, kernel, tuple(self.strides), self.padding, groups,
                     not _int8_kernel_is_unscaled(self.kernel_quantizer),
+                    self.pack_residuals,
+                    # None = auto (interpret off-TPU); True forces the
+                    # residual kernels interpreted like the other paths.
+                    True if self.pallas_interpret else None,
                 )
                 y = y.astype(self.dtype)
             elif self.binary_compute in ("xnor", "xnor_popcount"):

@@ -27,7 +27,11 @@ def pack_quantconv_params(
     kernel_quantizer: Union[str, Callable] = "ste_sign",
     kernel_clip: bool = True,
     template: Optional[Mapping[str, Any]] = None,
-) -> dict:
+    fold_bn: bool = False,
+    batch_stats: Optional[Mapping[str, Any]] = None,
+    bn_eps: float = 1e-5,
+    fold_order: Optional[Mapping[str, Any]] = None,
+) -> Any:
     """Convert a float params tree to the packed-weights structure.
 
     Every 4-D ``kernel`` under a module scope named ``QuantConv_*`` and
@@ -53,7 +57,34 @@ def pack_quantconv_params(
     ``kernel_quantizer`` must match what the model trained with (each zoo
     family uses one kernel quantizer throughout: QuickNet/BinaryNet
     ``ste_sign``, Bi-Real-Net ``magnitude_aware_sign``).
+
+    ``fold_bn=True`` (requires ``batch_stats``) additionally folds each
+    packed layer's FOLLOWING BatchNorm — identified by insertion order at
+    the same tree level, the flax creation order — into the conv
+    epilogue: eval-mode BN is the affine ``a*y + b`` with
+    ``a = scale/sqrt(var + eps)`` and ``b = bias - a*mean``, so
+    ``kernel_scale *= a`` and ``b`` lands in the layer's ``bias`` param
+    (LCE folds the same way at conversion; the training path deliberately
+    does not — XLA fuses the scale+shift — so this is purely a deployed-
+    footprint win: four fp32 vectors per conv erased). Returns
+    ``(params, remaining_batch_stats)`` instead of just params — the
+    folded BNs' running stats are dropped; stem/transition BNs keep
+    theirs. Deploy into a model built with ``fold_bn=True`` (which skips
+    those BN calls while preserving flax auto-numbering). ``bn_eps`` must
+    match the trained BN epsilon (the zoo's ``_bn`` uses 1e-5).
+
+    ``fold_order``: a same-structure tree whose KEY ORDER is the module
+    creation order (e.g. ``jax.eval_shape`` of the trained module's
+    init). Checkpoint round trips sort params alphabetically, which
+    destroys the layer-follows-layer adjacency the fold pairing reads —
+    pass this whenever ``params`` came from storage rather than a fresh
+    init. Defaults to ``params``' own order.
     """
+    if fold_bn and batch_stats is None:
+        raise ValueError(
+            "fold_bn=True requires the trained batch_stats (the eval-mode "
+            "mean/var being folded)."
+        )
     k_q = get_quantizer(kernel_quantizer)
     if k_q is None:
         raise ValueError("pack_quantconv_params requires a kernel quantizer.")
@@ -104,6 +135,10 @@ def pack_quantconv_params(
         return node
 
     out = convert(params, 0, template)
+    if fold_bn:
+        if fold_order is not None:
+            out = _reorder_like(out, fold_order)
+        out, remaining_stats = _fold_bn_pass(out, batch_stats, bn_eps)
     if template is not None:
         expected = sum(
             1
@@ -119,7 +154,107 @@ def pack_quantconv_params(
                 "subtree, or a template built with a different "
                 "architecture config)."
             )
+    return (out, remaining_stats) if fold_bn else out
+
+
+_PACKED_SCOPE = re.compile(r"^Quant(Conv|Dense)_\d+$")
+_BN_SCOPE = re.compile(r"^BatchNorm_\d+$")
+
+
+def _reorder_like(tree: Mapping[str, Any], order: Mapping[str, Any]) -> dict:
+    """Recursively reorder ``tree``'s keys to match ``order``'s key order
+    (keys absent from ``order`` — e.g. kernel_packed/kernel_scale the
+    packing just created under a conv scope — keep their position at the
+    end of each level; scope-level order is what the fold pairing needs)."""
+    ordered = [k for k in order if k in tree]
+    ordered += [k for k in tree if k not in order]
+    out = {}
+    for k in ordered:
+        child = tree[k]
+        sub_order = order.get(k) if isinstance(order, Mapping) else None
+        if isinstance(child, Mapping) and isinstance(sub_order, Mapping):
+            out[k] = _reorder_like(child, sub_order)
+        else:
+            out[k] = child
     return out
+
+
+def _fold_bn_pass(
+    packed: Mapping[str, Any], batch_stats: Mapping[str, Any], eps: float
+):
+    """Fold each packed layer's following BatchNorm (same-level insertion
+    order — flax creation order, which is execution order in the zoo's
+    compact modules) into ``kernel_scale``/``bias``; drop the folded BN
+    from params AND batch_stats. Raises when a packed-scope layer has no
+    following BN (a silent partial fold would desync the params from the
+    fold-mode module, which skips the BN for EVERY binary layer)."""
+
+    def walk(node: Mapping[str, Any], stats_node: Mapping[str, Any]):
+        keys = list(node)
+        out, stats_out, skip = {}, {}, set()
+        for i, key in enumerate(keys):
+            if key in skip:
+                continue
+            child = node[key]
+            if (
+                isinstance(child, Mapping)
+                and _PACKED_SCOPE.match(key)
+                and "kernel_packed" in child
+            ):
+                nxt = keys[i + 1] if i + 1 < len(keys) else None
+                if nxt is None or not _BN_SCOPE.match(nxt):
+                    raise ValueError(
+                        f"fold_bn: packed layer {key!r} is not followed "
+                        f"by a BatchNorm (next scope: {nxt!r}) — cannot "
+                        "fold. Fold conversion supports models whose "
+                        "every packed layer feeds a BatchNorm (the zoo's "
+                        "binary families)."
+                    )
+                bn = node[nxt]
+                bstats = (stats_node or {}).get(nxt)
+                if bstats is None:
+                    raise ValueError(
+                        f"fold_bn: no batch_stats for {nxt!r} — pass the "
+                        "trained model_state's batch_stats subtree."
+                    )
+                var = jnp.asarray(bstats["var"], jnp.float32)
+                mean = jnp.asarray(bstats["mean"], jnp.float32)
+                scale = jnp.asarray(bn.get("scale", 1.0), jnp.float32)
+                shift = jnp.asarray(bn.get("bias", 0.0), jnp.float32)
+                a = scale / jnp.sqrt(var + eps)
+                b = shift - mean * a
+                folded = dict(child)
+                folded["kernel_scale"] = (
+                    jnp.asarray(child["kernel_scale"], jnp.float32) * a
+                )
+                prior = jnp.asarray(child.get("bias", 0.0), jnp.float32)
+                folded["bias"] = a * prior + b
+                out[key] = folded
+                skip.add(nxt)  # BN params erased from the deployed tree.
+                if isinstance(stats_node, Mapping) and key in stats_node:
+                    stats_out[key] = stats_node[key]
+            elif isinstance(child, Mapping):
+                sub_stats = (
+                    (stats_node or {}).get(key)
+                    if isinstance(stats_node, Mapping)
+                    else None
+                )
+                out[key], folded_stats = walk(child, sub_stats or {})
+                if isinstance(stats_node, Mapping) and key in stats_node:
+                    stats_out[key] = folded_stats
+            else:
+                out[key] = child
+                if isinstance(stats_node, Mapping) and key in stats_node:
+                    stats_out[key] = stats_node[key]
+        # Stats-only scopes with no params twin (e.g. a BN with
+        # use_scale=use_bias=False) pass through unless folded away.
+        if isinstance(stats_node, Mapping):
+            for key, sval in stats_node.items():
+                if key not in stats_out and key not in skip and key not in node:
+                    stats_out[key] = sval
+        return out, stats_out
+
+    return walk(packed, batch_stats)
 
 
 def quantized_param_view(
