@@ -224,10 +224,15 @@ def main() -> None:
     tp.with_rules(conv_model_tp_rules())
     tp.setup()
     tstate = tp.shard_state(fresh_qstate())
-    tp_kernel_sharded = all(
-        not sub["kernel"].sharding.is_fully_replicated
+    # Explicit match list: all() over an empty generator would certify
+    # sharding vacuously if the scope names ever stopped matching.
+    tp_kernels = [
+        sub["kernel"]
         for name, sub in tstate.params.items()
         if name.startswith("QuantConv")
+    ]
+    tp_kernel_sharded = bool(tp_kernels) and all(
+        not k.sharding.is_fully_replicated for k in tp_kernels
     )
     tstep = tp.compile_step(make_train_step(), tstate)
     qlocal = {
@@ -251,6 +256,49 @@ def main() -> None:
     )
     tp_ref_loss = float(jax.device_get(tref_metrics["loss"]))
 
+    # CROSS-PROCESS TP (VERDICT r3 next #3): same flagship composition,
+    # but the MODEL axis now spans the process boundary (mesh rows =
+    # processes), so the TP contraction all-reduces and the co-sharded
+    # BN-stats reductions run over the inter-host link — the layout a
+    # real pod stresses. The data axis lies within each host, which
+    # means every host holds the full global batch (each of its devices
+    # addresses every data shard's model slice).
+    xtp = MeshPartitioner()
+    configure(
+        xtp,
+        {
+            # (model=num_processes, data=devices-per-process): row p =
+            # process p's devices, so 'model' crosses the boundary.
+            "mesh_shape": (num_processes, n_global // num_processes),
+            "mesh_axes": ("model", "data"),
+            "data_axes": ("data",),
+        },
+        name="xtp",
+    )
+    xtp.with_rules(conv_model_tp_rules())
+    xtp.setup()
+    xstate = xtp.shard_state(fresh_qstate())
+    # The proof the model axis crosses processes: TP-sharded kernels are
+    # not fully addressable from either host. (Non-empty match required —
+    # an empty all() would certify vacuously.)
+    xtp_kernels = [
+        sub["kernel"]
+        for name, sub in xstate.params.items()
+        if name.startswith("QuantConv")
+    ]
+    xtp_kernel_cross_process = bool(xtp_kernels) and all(
+        not k.is_fully_addressable for k in xtp_kernels
+    )
+    xstep = xtp.compile_step(make_train_step(), xstate)
+    xbatch = jax.tree.map(
+        lambda x: jax.make_array_from_process_local_data(
+            xtp.batch_sharding(), x
+        ),
+        {k: np.asarray(v) for k, v in qlocal.items()},
+    )
+    xstate, xmetrics = xstep(xstate, xbatch)
+    xtp_loss = float(jax.device_get(xmetrics["loss"]))
+
     with open(out_path, "w") as f:
         f.write(
             json.dumps(
@@ -267,6 +315,8 @@ def main() -> None:
                     "tp_kernel_sharded": tp_kernel_sharded,
                     "tp_loss": tp_loss,
                     "tp_ref_loss": tp_ref_loss,
+                    "xtp_kernel_cross_process": xtp_kernel_cross_process,
+                    "xtp_loss": xtp_loss,
                     "ok": True,
                 }
             )
