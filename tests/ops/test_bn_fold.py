@@ -14,13 +14,19 @@ from zookeeper_tpu.models import QuickNet
 from zookeeper_tpu.ops.packed import pack_quantconv_params
 
 
-def _build(**conf):
-    model = QuickNet()
+def _build(model_cls=QuickNet, base_conf=None, **conf):
+    model = model_cls()
     configure(
         model,
         {
-            "blocks_per_section": (1, 1),
-            "section_features": (32, 64),
+            **(
+                base_conf
+                if base_conf is not None
+                else {
+                    "blocks_per_section": (1, 1),
+                    "section_features": (32, 64),
+                }
+            ),
             "pallas_interpret": True,
             **conf,
         },
@@ -30,13 +36,11 @@ def _build(**conf):
     return model, module
 
 
-def _trained_like_variables():
-    """Init params/stats, then randomize BN affines and running stats so
-    the fold has something non-trivial to fold (fresh init is mean=0,
-    var=1, scale=1, bias=0 — the fold would be near-identity)."""
-    model, module = _build()
-    params, model_state = model.initialize(module, (16, 16, 3))
-    rng = np.random.default_rng(0)
+def _randomize_bns(params, model_state, rng):
+    """Randomize BN affines and running stats (recursively — some
+    families nest block scopes) so the fold has something non-trivial to
+    fold (fresh init is mean=0, var=1, scale=1, bias=0 — the fold would
+    be near-identity)."""
 
     def jitter(tree, low, high):
         return jax.tree.map(
@@ -46,20 +50,43 @@ def _trained_like_variables():
             tree,
         )
 
-    stats = dict(model_state["batch_stats"])
-    for k in stats:
-        stats[k] = {
-            "mean": jitter(stats[k]["mean"], -0.5, 0.5),
-            "var": jitter(stats[k]["var"], 0.5, 2.0),
-        }
-    params = dict(params)
-    for k in params:
-        if k.startswith("BatchNorm"):
-            params[k] = {
-                "scale": jitter(params[k]["scale"], 0.5, 1.5),
-                "bias": jitter(params[k]["bias"], -0.3, 0.3),
-            }
-    return params, stats
+    def walk_stats(node):
+        out = {}
+        for k, v in node.items():
+            if k.startswith("BatchNorm"):
+                out[k] = {
+                    "mean": jitter(v["mean"], -0.5, 0.5),
+                    "var": jitter(v["var"], 0.5, 2.0),
+                }
+            elif isinstance(v, dict):
+                out[k] = walk_stats(v)
+            else:
+                out[k] = v
+        return out
+
+    def walk_params(node):
+        out = {}
+        for k, v in node.items():
+            if k.startswith("BatchNorm"):
+                out[k] = {
+                    "scale": jitter(v["scale"], 0.5, 1.5),
+                    "bias": jitter(v["bias"], -0.3, 0.3),
+                }
+            elif isinstance(v, dict):
+                out[k] = walk_params(v)
+            else:
+                out[k] = v
+        return out
+
+    return walk_params(dict(params)), walk_stats(
+        dict(model_state["batch_stats"])
+    )
+
+
+def _trained_like_variables(model_cls=QuickNet, base_conf=None):
+    model, module = _build(model_cls, base_conf)
+    params, model_state = model.initialize(module, (16, 16, 3))
+    return _randomize_bns(params, model_state, np.random.default_rng(0))
 
 
 def test_fold_bn_matches_unfolded_eval():
@@ -139,6 +166,85 @@ def test_fold_bn_sorted_checkpoint_needs_fold_order():
         {"p": fparams, "s": fstats},
         {"p": ref_p, "s": ref_s},
     )
+
+
+@pytest.mark.parametrize(
+    "model_cls,base_conf,kernel_quantizer",
+    [
+        (
+            "BiRealNet",
+            {"blocks_per_section": (1, 1), "section_features": (32, 64)},
+            "magnitude_aware_sign",
+        ),
+        (
+            "BinaryResNetE18",
+            {"blocks_per_section": (1, 1), "section_features": (32, 64)},
+            "ste_sign",
+        ),
+    ],
+)
+def test_fold_bn_other_families_match_unfolded_eval(
+    model_cls, base_conf, kernel_quantizer
+):
+    """The fold generalizes to every conv->BN->(+shortcut) family —
+    including NESTED block scopes (the fold pass recurses) and
+    magnitude_aware_sign kernels (the per-channel MA scale multiplies
+    into the fold's `a` exactly). The shortcut BNs (after fp convs)
+    must survive unfolded."""
+    import zookeeper_tpu.models as zoo
+
+    cls = getattr(zoo, model_cls)
+    params, stats = _trained_like_variables(cls, base_conf)
+    rng = np.random.default_rng(3)
+    x = jnp.asarray(rng.normal(size=(2, 16, 16, 3)), jnp.float32)
+
+    _, packed_module = _build(
+        cls, base_conf, binary_compute="xnor", packed_weights=True
+    )
+    packed_params = pack_quantconv_params(
+        params, kernel_quantizer=kernel_quantizer
+    )
+    ref = packed_module.apply(
+        {"params": packed_params, "batch_stats": stats}, x, training=False
+    )
+
+    _, folded_module = _build(
+        cls, base_conf, binary_compute="xnor", packed_weights=True,
+        fold_bn=True,
+    )
+    fparams, fstats = pack_quantconv_params(
+        params,
+        kernel_quantizer=kernel_quantizer,
+        fold_bn=True,
+        batch_stats=stats,
+    )
+    out = folded_module.apply(
+        {"params": fparams, "batch_stats": fstats}, x, training=False
+    )
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5
+    )
+
+
+def test_fold_bn_pre_activation_family_raises():
+    """BinaryDenseNet is pre-activation (BN BEFORE the conv; outputs
+    concatenate with no following BN) — folding is structurally
+    impossible there and must fail loudly, not fold the wrong BN."""
+    from zookeeper_tpu.models import BinaryDenseNet28
+
+    model, module = _build(
+        BinaryDenseNet28,
+        {"layers_per_block": (2, 2), "reduction": (2.0,),
+         "dilation": (1, 1), "growth_rate": 32, "initial_features": 32},
+    )
+    params, model_state = model.initialize(module, (16, 16, 3))
+    params, stats = _randomize_bns(
+        params, model_state, np.random.default_rng(4)
+    )
+    with pytest.raises(
+        ValueError, match="does not normalize this conv's output"
+    ):
+        pack_quantconv_params(params, fold_bn=True, batch_stats=stats)
 
 
 def test_fold_bn_rejects_training_apply():

@@ -25,12 +25,45 @@ from zookeeper_tpu.ops.layers import BatchNorm, QuantConv, QuantDense
 from zookeeper_tpu.ops.quantizers import dorefa, ste_sign
 
 
+_FOLD_BN_TRAINING_ERROR = (
+    "fold_bn=True is a DEPLOYMENT mode: the binary-conv BatchNorms are "
+    "folded into conv params at convert time and skipped here, so a "
+    "training=True apply would run un-normalized with batch stats "
+    "silently missing. Train with fold_bn=False and convert with "
+    "pack_quantconv_params(fold_bn=True)."
+)
+
+
 def _bn(training: bool, dtype=jnp.float32):
     # ops.layers.BatchNorm == nn.BatchNorm + batch-dim sharding pin.
     return BatchNorm(
         use_running_average=not training, momentum=0.9, epsilon=1e-5,
         dtype=dtype,
     )
+
+
+def _check_fold_training(fold_bn, packed_weights, training: bool) -> None:
+    """Loud guard for the fold_bn deployment mode: raise on a training
+    apply of a build that actually folds (fold applies only where the
+    layer is PACKED, so an unpacked build with a config-inherited
+    fold_bn=True trains normally). ``packed_weights`` may be a
+    per-section tuple."""
+    packed_any = (
+        any(packed_weights)
+        if isinstance(packed_weights, (tuple, list))
+        else bool(packed_weights)
+    )
+    if fold_bn and packed_any and training:
+        raise ValueError(_FOLD_BN_TRAINING_ERROR)
+
+
+def _post_conv_bn(y, training: bool, dtype, fold_here: bool):
+    """The BN after a binary conv — or, in fold mode, its SKIP: the BN
+    module is constructed either way so flax auto-numbering matches the
+    trained checkpoint, but a folded conv's epilogue (kernel_scale/bias
+    rewritten by pack_quantconv_params) already carries the affine."""
+    bn = _bn(training, dtype)
+    return y if fold_here else bn(y)
 
 
 class _BinaryNetModule(nn.Module):
@@ -229,10 +262,19 @@ class _BiRealBlock(nn.Module):
     dtype: Any
     binary_compute: str = "mxu"
     packed_weights: bool = False
+    #: Deployment-only: the conv's following BN is folded into the conv
+    #: params at convert time and skipped here (the shortcut BN stays —
+    #: it follows an fp conv the fold pass never touches). Like
+    #: QuickNet, folding applies only where the conv is PACKED — the
+    #: converter emits folded scale/bias into the packed param structure
+    #: only, and the gate also keeps a config-inherited fold_bn=True
+    #: harmless on an unpacked (float/training) build.
+    fold_bn: bool = False
     pallas_interpret: bool = False
 
     @nn.compact
     def __call__(self, x, training: bool = False):
+        fold_here = self.fold_bn and self.packed_weights
         shortcut = x
         if self.strides > 1 or x.shape[-1] != self.features:
             # Real-valued downsample shortcut: avgpool + fp 1x1 conv + BN.
@@ -249,9 +291,10 @@ class _BiRealBlock(nn.Module):
             kernel_quantizer="magnitude_aware_sign", dtype=self.dtype,
             binary_compute=self.binary_compute,
             packed_weights=self.packed_weights,
+            use_bias=fold_here,  # Carries the folded BN shift.
             pallas_interpret=self.pallas_interpret,
         )(x)
-        y = _bn(training, self.dtype)(y)
+        y = _post_conv_bn(y, training, self.dtype, fold_here)
         return y + shortcut
 
 
@@ -264,10 +307,12 @@ class _BiRealNetModule(nn.Module):
     dtype: Any
     binary_compute: str = "mxu"
     packed_weights: bool = False
+    fold_bn: bool = False
     pallas_interpret: bool = False
 
     @nn.compact
     def __call__(self, x, training: bool = False):
+        _check_fold_training(self.fold_bn, self.packed_weights, training)
         d = self.dtype
         x = nn.Conv(64, (7, 7), strides=(2, 2), padding="SAME",
                     use_bias=False, dtype=d)(x.astype(d))
@@ -280,7 +325,8 @@ class _BiRealNetModule(nn.Module):
                 strides = 2 if (b == 0 and s > 0) else 1
                 x = _BiRealBlock(
                     feat, strides, d, self.binary_compute,
-                    self.packed_weights, self.pallas_interpret,
+                    self.packed_weights, fold_bn=self.fold_bn,
+                    pallas_interpret=self.pallas_interpret,
                 )(x, training)
         x = jnp.mean(x, axis=(1, 2))
         x = nn.Dense(self.num_classes, dtype=d)(x)
@@ -295,6 +341,9 @@ class BiRealNet(Model):
     section_features: Sequence[int] = Field((64, 128, 256, 512))
     binary_compute: str = Field("mxu")
     packed_weights: bool = Field(False)
+    #: Deployment-only: binary-conv BNs folded into the conv epilogue
+    #: (pair with ops.packed.pack_quantconv_params fold_bn=True).
+    fold_bn: bool = Field(False)
     pallas_interpret: bool = Field(False)
 
     def build(self, input_shape, num_classes: int) -> nn.Module:
@@ -305,6 +354,7 @@ class BiRealNet(Model):
             dtype=self.dtype(),
             binary_compute=self.binary_compute,
             packed_weights=self.packed_weights,
+            fold_bn=self.fold_bn,
             pallas_interpret=self.pallas_interpret,
         )
 
@@ -369,15 +419,7 @@ class _QuickNetModule(nn.Module):
 
     @nn.compact
     def __call__(self, x, training: bool = False):
-        if self.fold_bn and training:
-            raise ValueError(
-                "fold_bn=True is a DEPLOYMENT mode: the binary-conv "
-                "BatchNorms are folded into conv params at convert time "
-                "and skipped here, so a training=True apply would run "
-                "un-normalized with batch stats silently missing. Train "
-                "with fold_bn=False and convert with "
-                "pack_quantconv_params(fold_bn=True)."
-            )
+        _check_fold_training(self.fold_bn, self.packed_weights, training)
         d = self.dtype
         # Stem: fp 3x3/2 to 8ch, then grouped 3x3/2 to first section width.
         x = nn.Conv(8, (3, 3), strides=(2, 2), padding="SAME",
@@ -414,9 +456,7 @@ class _QuickNetModule(nn.Module):
                     use_bias=fold_here,  # Carries the folded BN shift.
                     pallas_interpret=self.pallas_interpret,
                 )(x)
-                bn = _bn(training, d)  # Constructed even when folded:
-                if not fold_here:  # keeps flax auto-numbering stable.
-                    y = bn(y)
+                y = _post_conv_bn(y, training, d, fold_here)
                 x = x + y  # Residual around every binary conv.
         x = nn.relu(x)
         x = jnp.mean(x, axis=(1, 2))
@@ -493,6 +533,10 @@ class _ResNetEBlock(nn.Module):
     dtype: Any
     binary_compute: str = "mxu"
     packed_weights: bool = False
+    #: Deployment-only: the conv's following BN is folded into the conv
+    #: params at convert time and skipped here (only where PACKED — see
+    #: _BiRealBlock.fold_bn).
+    fold_bn: bool = False
     pallas_interpret: bool = False
 
     @nn.compact
@@ -506,14 +550,16 @@ class _ResNetEBlock(nn.Module):
             assert self.features % shortcut.shape[-1] == 0
             reps = self.features // shortcut.shape[-1]
             shortcut = jnp.concatenate([shortcut] * reps, axis=-1)
+        fold_here = self.fold_bn and self.packed_weights
         y = QuantConv(
             self.features, (3, 3), strides=(self.strides, self.strides),
             input_quantizer="ste_sign", kernel_quantizer="ste_sign",
             dtype=self.dtype, binary_compute=self.binary_compute,
             packed_weights=self.packed_weights,
+            use_bias=fold_here,  # Carries the folded BN shift.
             pallas_interpret=self.pallas_interpret,
         )(x)
-        y = _bn(training, self.dtype)(y)
+        y = _post_conv_bn(y, training, self.dtype, fold_here)
         return y + shortcut
 
 
@@ -526,10 +572,12 @@ class _BinaryResNetEModule(nn.Module):
     dtype: Any
     binary_compute: str = "mxu"
     packed_weights: bool = False
+    fold_bn: bool = False
     pallas_interpret: bool = False
 
     @nn.compact
     def __call__(self, x, training: bool = False):
+        _check_fold_training(self.fold_bn, self.packed_weights, training)
         d = self.dtype
         x = nn.Conv(self.section_features[0], (7, 7), strides=(2, 2),
                     padding="SAME", use_bias=False, dtype=d)(x.astype(d))
@@ -542,7 +590,8 @@ class _BinaryResNetEModule(nn.Module):
                 strides = 2 if (b == 0 and s > 0) else 1
                 x = _ResNetEBlock(
                     feat, strides, d, self.binary_compute,
-                    self.packed_weights, self.pallas_interpret,
+                    self.packed_weights, fold_bn=self.fold_bn,
+                    pallas_interpret=self.pallas_interpret,
                 )(x, training)
         x = nn.relu(x)
         x = jnp.mean(x, axis=(1, 2))
@@ -563,6 +612,9 @@ class BinaryResNetE18(Model):
     section_features: Sequence[int] = Field((64, 128, 256, 512))
     binary_compute: str = Field("mxu")
     packed_weights: bool = Field(False)
+    #: Deployment-only: binary-conv BNs folded into the conv epilogue
+    #: (pair with ops.packed.pack_quantconv_params fold_bn=True).
+    fold_bn: bool = Field(False)
     pallas_interpret: bool = Field(False)
 
     def build(self, input_shape, num_classes: int) -> nn.Module:
@@ -573,6 +625,7 @@ class BinaryResNetE18(Model):
             dtype=self.dtype(),
             binary_compute=self.binary_compute,
             packed_weights=self.packed_weights,
+            fold_bn=self.fold_bn,
             pallas_interpret=self.pallas_interpret,
         )
 

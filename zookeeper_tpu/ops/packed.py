@@ -13,6 +13,7 @@ import re
 from typing import Any, Callable, Mapping, Optional, Union
 
 import jax.numpy as jnp
+import numpy as np
 
 from zookeeper_tpu.ops.binary_compute import (
     pack_conv_kernel,
@@ -216,6 +217,21 @@ def _fold_bn_pass(
                     raise ValueError(
                         f"fold_bn: no batch_stats for {nxt!r} — pass the "
                         "trained model_state's batch_stats subtree."
+                    )
+                co = int(np.shape(child["kernel_scale"])[0])
+                bn_c = int(np.shape(bstats["var"])[0])
+                if bn_c != co:
+                    # Pre-activation families (BinaryDenseNet): the next
+                    # BN in creation order normalizes the NEXT layer's
+                    # (wider, concatenated) input, not this conv's
+                    # output — folding it would be silently wrong, so
+                    # the width check fails loudly.
+                    raise ValueError(
+                        f"fold_bn: packed layer {key!r} ({co} output "
+                        f"channels) is followed by {nxt!r} over {bn_c} "
+                        "channels — that BatchNorm does not normalize "
+                        "this conv's output (pre-activation topology?). "
+                        "Cannot fold."
                     )
                 var = jnp.asarray(bstats["var"], jnp.float32)
                 mean = jnp.asarray(bstats["mean"], jnp.float32)
