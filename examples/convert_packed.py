@@ -56,6 +56,14 @@ class ConvertPacked(Experiment):
     #: float rounding, not bitwise — set verify_atol accordingly
     #: (~1e-4 covers typical stacks).
     fold_bn: bool = Field(False)
+    #: Extra config overrides applied ONLY to the deployment twin (a
+    #: dict literal on the CLI). For partial deployments where the
+    #: trained model's own config must stay float while the twin packs a
+    #: subset — e.g. the measured BinaryAlexNet sweet spot:
+    #: "deploy_overrides={'packed_weights': False, 'binary_compute':
+    #: 'mxu', 'dense_packed_weights': True, 'dense_binary_compute':
+    #: 'xnor'}" — or a per-section QuickNet tuple.
+    deploy_overrides: dict = Field({})
     #: Run Pallas kernels interpreted (CPU verification).
     pallas_interpret: bool = Field(True)
 
@@ -90,26 +98,41 @@ class ConvertPacked(Experiment):
         from zookeeper_tpu.core import configured_field_names
 
         # Clone the user's model config (widths, depths, dtype, ...) so
-        # the deployment twin is the SAME architecture, then flip the
-        # packed knobs.
-        conf = {
-            name: getattr(self.model, name)
-            for name in configured_field_names(self.model)
-        }
-        conf.update(
-            {
-                "packed_weights": True,
-                "binary_compute": "xnor",
-                "pallas_interpret": self.pallas_interpret,
-            }
+        # the deployment twin is the SAME architecture; deploy_overrides
+        # then win over EVERYTHING (incl. the task-level fold_bn), and
+        # only afterwards are the packing knobs defaulted from what the
+        # twin effectively ended up with: packed_weights defaults to
+        # True unless something set it, and a twin that IS packed gets
+        # binary_compute flipped to "xnor" unless an override pinned the
+        # mode explicitly (a trained-path 'int8'/'mxu' cloned from the
+        # user's config cannot run packed and would raise at init).
+        user_set = configured_field_names(self.model)
+        conf = {name: getattr(self.model, name) for name in user_set}
+        conf["pallas_interpret"] = self.pallas_interpret
+        conf["fold_bn"] = self.fold_bn
+        conf.update(dict(self.deploy_overrides))  # Twin-only knobs win.
+        fold_bn = bool(conf.get("fold_bn", False))
+        if fold_bn and not hasattr(type(self.model), "fold_bn"):
+            raise ValueError(
+                f"{type(self.model).__name__} has no fold_bn "
+                "deployment mode."
+            )
+        if not fold_bn:
+            del conf["fold_bn"]  # Some families lack the field entirely.
+        if "packed_weights" not in conf:
+            conf["packed_weights"] = True
+        pw = conf["packed_weights"]
+        twin_packed = (
+            any(pw) if isinstance(pw, (tuple, list)) else bool(pw)
         )
-        if self.fold_bn:
-            if not hasattr(type(self.model), "fold_bn"):
-                raise ValueError(
-                    f"{type(self.model).__name__} has no fold_bn "
-                    "deployment mode."
-                )
-            conf["fold_bn"] = True
+        bc = conf.get("binary_compute")
+        if (
+            twin_packed
+            and "binary_compute" not in self.deploy_overrides
+            and not isinstance(bc, (tuple, list))
+            and bc not in ("xnor", "xnor_popcount")
+        ):
+            conf["binary_compute"] = "xnor"
         _configure(deploy_model, conf, name="deploy_model")
         module_p = deploy_model.build(input_shape, self.num_classes)
         abstract = jax.eval_shape(
@@ -119,7 +142,7 @@ class ConvertPacked(Experiment):
                 training=False,
             )
         )
-        if self.fold_bn:
+        if fold_bn:
             # Creation-order tree: checkpoint loads (and anything that
             # round-trips a dict through JAX pytrees, like eval_shape)
             # sort params alphabetically, which breaks the

@@ -226,6 +226,63 @@ def test_fold_bn_other_families_match_unfolded_eval(
     )
 
 
+def test_fold_bn_binaryalexnet_dense_stage():
+    """BinaryAlexNet folds its DENSE stage only (dense holds ~80% of its
+    params): the dense-only packed deployment's BNs fold; the conv BNs
+    — two of which sit after a maxpool, where folding is invalid for
+    negative BN scales — survive, and conv-packed + fold raises."""
+    from zookeeper_tpu.models import BinaryAlexNet
+
+    def build(conf):
+        m = BinaryAlexNet()
+        configure(m, {"pallas_interpret": True, **conf}, name="m")
+        return m, m.build((67, 67, 3), num_classes=5)
+
+    model, float_module = build({})
+    rng_np = np.random.default_rng(5)
+    x = jnp.asarray(rng_np.normal(size=(1, 67, 67, 3)), jnp.float32)
+    variables = float_module.init(jax.random.PRNGKey(3), x, training=False)
+    params, stats = _randomize_bns(
+        variables["params"], variables, rng_np
+    )
+
+    mixed_conf = {"dense_binary_compute": "xnor", "dense_packed_weights": True}
+    _, ref_module = build(mixed_conf)
+    template = jax.eval_shape(
+        lambda: ref_module.init(jax.random.PRNGKey(3), x, training=False)
+    )["params"]
+    ref = ref_module.apply(
+        {"params": pack_quantconv_params(params, template=template),
+         "batch_stats": stats},
+        x, training=False,
+    )
+
+    _, folded_module = build({**mixed_conf, "fold_bn": True})
+    ftemplate = jax.eval_shape(
+        lambda: folded_module.init(jax.random.PRNGKey(3), x, training=False)
+    )["params"]
+    fparams, fstats = pack_quantconv_params(
+        params, template=ftemplate, fold_bn=True, batch_stats=stats
+    )
+    # Dense-stage BNs (5, 6) folded away; conv-stage BNs (0-4) survive.
+    for gone in ("BatchNorm_5", "BatchNorm_6"):
+        assert gone not in fparams and gone not in fstats
+    for kept in ("BatchNorm_0", "BatchNorm_4"):
+        assert kept in fparams and kept in fstats
+    out = folded_module.apply(
+        {"params": fparams, "batch_stats": fstats}, x, training=False
+    )
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-4
+    )
+
+    # Conv-packed + fold: loud refusal (maxpool between conv and BN).
+    _, bad = build({"packed_weights": True, "binary_compute": "xnor",
+                    "fold_bn": True})
+    with pytest.raises(ValueError, match="DENSE stage only"):
+        bad.init(jax.random.PRNGKey(0), x, training=False)
+
+
 def test_fold_bn_pre_activation_family_raises():
     """BinaryDenseNet is pre-activation (BN BEFORE the conv; outputs
     concatenate with no following BN) — folding is structurally

@@ -169,6 +169,14 @@ class _BinaryAlexNetModule(nn.Module):
     #: (BASELINE.md round-4 measurement).
     dense_binary_compute: Optional[str] = None
     dense_packed_weights: Optional[bool] = None
+    #: Deployment-only: fold the BNs after the packed DENSE layers into
+    #: their params (ops.packed fold_bn). Dense-stage only by
+    #: construction: two of the four binary convs feed a maxpool BEFORE
+    #: their BN, and a per-channel affine only commutes with max when
+    #: its scale is positive — BN's learned scale can be negative, so a
+    #: conv fold here would be silently wrong; conv-packed + fold_bn
+    #: raises instead.
+    fold_bn: bool = False
     pallas_interpret: bool = False
 
     @nn.compact
@@ -202,17 +210,29 @@ class _BinaryAlexNetModule(nn.Module):
             if self.dense_packed_weights is None
             else self.dense_packed_weights
         )
+        if self.fold_bn and self.packed_weights:
+            raise ValueError(
+                "BinaryAlexNet fold_bn supports the DENSE stage only: "
+                "two binary convs feed a maxpool before their BatchNorm, "
+                "and max only commutes with the folded affine when the "
+                "BN scale is positive — a conv fold would be silently "
+                "wrong for learned negative scales. Pack/fold the dense "
+                "stage (dense_packed_weights=True) and keep "
+                "packed_weights=False."
+            )
+        _check_fold_training(self.fold_bn, bool(dense_packed), training)
+        dense_fold = self.fold_bn and bool(dense_packed)
         for u in (4096, 4096):
             # The binary dense layers dominate BinaryAlexNet's parameter
             # count — the packed deployment's biggest 32x win.
             x = QuantDense(
                 u, input_quantizer="ste_sign", kernel_quantizer="ste_sign",
-                use_bias=False, dtype=d,
+                use_bias=dense_fold, dtype=d,
                 binary_compute=dense_bc,
                 packed_weights=dense_packed,
                 pallas_interpret=self.pallas_interpret,
             )(x)
-            x = _bn(training, self.dtype)(x)
+            x = _post_conv_bn(x, training, self.dtype, dense_fold)
         x = nn.Dense(self.num_classes, dtype=d)(x)
         return x.astype(jnp.float32)
 
@@ -231,6 +251,9 @@ class BinaryAlexNet(Model):
     #: spot (BASELINE.md).
     dense_binary_compute: str = Field(allow_missing=True)
     dense_packed_weights: bool = Field(allow_missing=True)
+    #: Deployment-only, DENSE stage only (see _BinaryAlexNetModule):
+    #: pair with ops.packed.pack_quantconv_params fold_bn=True.
+    fold_bn: bool = Field(False)
     pallas_interpret: bool = Field(False)
 
     def build(self, input_shape, num_classes: int) -> nn.Module:
@@ -245,6 +268,7 @@ class BinaryAlexNet(Model):
             packed_weights=self.packed_weights,
             dense_binary_compute=dense_bc,
             dense_packed_weights=dense_packed,
+            fold_bn=self.fold_bn,
             pallas_interpret=self.pallas_interpret,
         )
 
