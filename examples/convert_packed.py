@@ -30,6 +30,53 @@ from zookeeper_tpu.models import Model, model_summary
 from zookeeper_tpu.training import Experiment, load_model, save_model
 
 
+def resolve_deploy_conf(model, fold_bn, deploy_overrides, pallas_interpret):
+    """Resolve the deployment twin's config from the trained model's
+    explicit config + the task knobs (pure function, unit-tested).
+
+    Precedence: user's explicitly-set model fields < task knobs
+    (pallas_interpret, fold_bn) < ``deploy_overrides`` (twin-only, wins
+    over everything). THEN the packing defaults apply to whatever
+    survived — to the CONV-LEVEL pair only: ``packed_weights`` defaults
+    True unless something set it, and when it ends up truthy,
+    ``binary_compute`` flips to "xnor" unless an override pinned the
+    mode or it is a per-section tuple (a trained-path 'int8'/'mxu'
+    cloned from the user's config cannot run packed and would raise at
+    init). Stage-specific knobs like BinaryAlexNet's
+    ``dense_binary_compute`` are never second-guessed — pin them in
+    ``deploy_overrides`` (the field docstring shows the recipe).
+
+    Returns ``(conf, fold_bn_resolved)``.
+    """
+    from zookeeper_tpu.core import configured_field_names
+
+    user_set = configured_field_names(model)
+    conf = {name: getattr(model, name) for name in user_set}
+    conf["pallas_interpret"] = pallas_interpret
+    conf["fold_bn"] = fold_bn
+    conf.update(dict(deploy_overrides))  # Twin-only knobs win.
+    fold_resolved = bool(conf.get("fold_bn", False))
+    if fold_resolved and not hasattr(type(model), "fold_bn"):
+        raise ValueError(
+            f"{type(model).__name__} has no fold_bn deployment mode."
+        )
+    if not fold_resolved:
+        del conf["fold_bn"]  # Some families lack the field entirely.
+    if "packed_weights" not in conf:
+        conf["packed_weights"] = True
+    pw = conf["packed_weights"]
+    twin_packed = any(pw) if isinstance(pw, (tuple, list)) else bool(pw)
+    bc = conf.get("binary_compute")
+    if (
+        twin_packed
+        and "binary_compute" not in deploy_overrides
+        and not isinstance(bc, (tuple, list))
+        and bc not in ("xnor", "xnor_popcount")
+    ):
+        conf["binary_compute"] = "xnor"
+    return conf, fold_resolved
+
+
 @task
 class ConvertPacked(Experiment):
     """Float checkpoint -> packed deployment checkpoint."""
@@ -83,10 +130,8 @@ class ConvertPacked(Experiment):
 
         # Deployment twin: same architecture, packed weights. Uses the
         # model component's own packed knobs when it has them.
-        for field_name, value in (
-            ("packed_weights", True),
-            ("binary_compute", "xnor"),
-            ("pallas_interpret", self.pallas_interpret),
+        for field_name in (
+            "packed_weights", "binary_compute", "pallas_interpret"
         ):
             if not hasattr(type(self.model), field_name):
                 raise ValueError(
@@ -95,44 +140,11 @@ class ConvertPacked(Experiment):
                 )
         deploy_model = type(self.model)()
         from zookeeper_tpu.core import configure as _configure
-        from zookeeper_tpu.core import configured_field_names
 
-        # Clone the user's model config (widths, depths, dtype, ...) so
-        # the deployment twin is the SAME architecture; deploy_overrides
-        # then win over EVERYTHING (incl. the task-level fold_bn), and
-        # only afterwards are the packing knobs defaulted from what the
-        # twin effectively ended up with: packed_weights defaults to
-        # True unless something set it, and a twin that IS packed gets
-        # binary_compute flipped to "xnor" unless an override pinned the
-        # mode explicitly (a trained-path 'int8'/'mxu' cloned from the
-        # user's config cannot run packed and would raise at init).
-        user_set = configured_field_names(self.model)
-        conf = {name: getattr(self.model, name) for name in user_set}
-        conf["pallas_interpret"] = self.pallas_interpret
-        conf["fold_bn"] = self.fold_bn
-        conf.update(dict(self.deploy_overrides))  # Twin-only knobs win.
-        fold_bn = bool(conf.get("fold_bn", False))
-        if fold_bn and not hasattr(type(self.model), "fold_bn"):
-            raise ValueError(
-                f"{type(self.model).__name__} has no fold_bn "
-                "deployment mode."
-            )
-        if not fold_bn:
-            del conf["fold_bn"]  # Some families lack the field entirely.
-        if "packed_weights" not in conf:
-            conf["packed_weights"] = True
-        pw = conf["packed_weights"]
-        twin_packed = (
-            any(pw) if isinstance(pw, (tuple, list)) else bool(pw)
+        conf, fold_bn = resolve_deploy_conf(
+            self.model, self.fold_bn, self.deploy_overrides,
+            self.pallas_interpret,
         )
-        bc = conf.get("binary_compute")
-        if (
-            twin_packed
-            and "binary_compute" not in self.deploy_overrides
-            and not isinstance(bc, (tuple, list))
-            and bc not in ("xnor", "xnor_popcount")
-        ):
-            conf["binary_compute"] = "xnor"
         _configure(deploy_model, conf, name="deploy_model")
         module_p = deploy_model.build(input_shape, self.num_classes)
         abstract = jax.eval_shape(

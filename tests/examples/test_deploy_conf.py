@@ -1,0 +1,114 @@
+"""Deployment-twin config resolution (examples/convert_packed.py
+``resolve_deploy_conf``): precedence and packing-default rules, pure
+logic — no checkpoints or conversion runs needed."""
+
+import os
+
+import pytest
+
+from zookeeper_tpu.core import configure
+from zookeeper_tpu.models import BinaryAlexNet, Mlp, QuickNet
+
+_SCRIPT = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "examples",
+    "convert_packed.py",
+)
+
+
+def _resolve():
+    # Import under the script's CANONICAL module name so this shares one
+    # sys.modules entry (and one registered @task) with any other test
+    # that imports convert_packed — a second execution under a different
+    # name would trip the task registry's duplicate check.
+    import importlib
+    import sys
+
+    examples_dir = os.path.dirname(_SCRIPT)
+    if examples_dir not in sys.path:
+        sys.path.insert(0, examples_dir)
+    return importlib.import_module("convert_packed").resolve_deploy_conf
+
+
+def _model(cls, conf):
+    m = cls()
+    configure(m, conf, name="m")
+    return m
+
+
+def test_defaults_pack_everything():
+    resolve = _resolve()
+    conf, fold = resolve(_model(QuickNet, {}), False, {}, True)
+    assert conf["packed_weights"] is True
+    assert conf["binary_compute"] == "xnor"
+    assert fold is False and "fold_bn" not in conf
+
+
+def test_explicit_training_mode_still_flips_to_packable():
+    """A user who trained with an explicit int8/mxu path must still get
+    a runnable packed twin — the mode flips to xnor rather than
+    producing the invalid int8+packed combo."""
+    resolve = _resolve()
+    conf, _ = resolve(
+        _model(QuickNet, {"binary_compute": "int8"}), False, {}, True
+    )
+    assert conf["packed_weights"] is True
+    assert conf["binary_compute"] == "xnor"
+
+
+def test_explicit_unpacked_config_survives():
+    """packed_weights=False set on the model expresses a partial
+    deployment and must survive; with nothing packed, the trained
+    binary_compute stays."""
+    resolve = _resolve()
+    conf, _ = resolve(
+        _model(
+            BinaryAlexNet,
+            {"packed_weights": False, "binary_compute": "mxu",
+             "dense_packed_weights": True, "dense_binary_compute": "xnor"},
+        ),
+        False, {}, True,
+    )
+    assert conf["packed_weights"] is False
+    assert conf["binary_compute"] == "mxu"
+    assert conf["dense_packed_weights"] is True
+
+
+def test_deploy_overrides_win_over_everything():
+    resolve = _resolve()
+    # Overrides beat the user's model config AND the task fold_bn.
+    conf, fold = resolve(
+        _model(QuickNet, {"binary_compute": "int8"}),
+        True,
+        {"binary_compute": "int8", "fold_bn": False},
+        True,
+    )
+    assert fold is False and "fold_bn" not in conf
+    # Explicitly-overridden binary_compute is never second-guessed,
+    # even though the twin is packed (the layer raises loudly instead).
+    assert conf["binary_compute"] == "int8"
+
+    conf, fold = resolve(_model(QuickNet, {}), False, {"fold_bn": True}, True)
+    assert fold is True and conf["fold_bn"] is True
+
+
+def test_per_section_tuples_left_alone():
+    resolve = _resolve()
+    conf, _ = resolve(
+        _model(
+            QuickNet,
+            {"binary_compute": ("int8", "xnor"),
+             "packed_weights": (False, True),
+             "blocks_per_section": (1, 1),
+             "section_features": (8, 16)},
+        ),
+        False, {}, True,
+    )
+    assert conf["binary_compute"] == ("int8", "xnor")
+    assert conf["packed_weights"] == (False, True)
+
+
+def test_fold_requires_the_model_mode():
+    resolve = _resolve()
+    with pytest.raises(ValueError, match="no fold_bn deployment mode"):
+        resolve(_model(Mlp, {}), True, {}, True)
