@@ -204,11 +204,80 @@ def _env_flag(env, name: str, default: str = "0") -> bool:
     return env.get(name, default).strip().lower() not in ("0", "", "false")
 
 
+def check_device_reachable(timeout_s: float = 120.0) -> None:
+    """Fail FAST with a clear error when the accelerator is unreachable
+    (a dead remote-TPU tunnel makes the first compile hang indefinitely,
+    which reads as a silent bench stall): run one tiny jitted op with a
+    watchdog. The op runs in a daemon thread because a hung remote
+    compile cannot be interrupted from Python."""
+    import threading
+
+    done = threading.Event()
+    err = []
+
+    def probe():
+        # EVERYTHING backend-touching runs inside the watchdog thread:
+        # even jax.default_backend() blocks on backend init when the
+        # tunnel is dead.
+        try:
+            import jax
+            import jax.numpy as jnp
+
+            backend = jax.default_backend()
+            requested_cpu = str(
+                jax.config.jax_platforms
+                or os.environ.get("JAX_PLATFORMS", "")
+            ).startswith("cpu")
+            if backend == "cpu" and not requested_cpu:
+                # Accelerator registration failed and JAX silently fell
+                # back to cpu (e.g. a clobbered PYTHONPATH dropping the
+                # tunnel's site hooks) — the bench would then "run" as a
+                # multi-hour CPU stall, the exact symptom this check
+                # exists to prevent.
+                raise RuntimeError(
+                    "JAX fell back to the cpu backend without "
+                    "JAX_PLATFORMS=cpu being requested — the accelerator "
+                    "backend failed to initialize. Refusing to run the "
+                    "bench on a fallback CPU."
+                )
+            if backend != "cpu":
+                # Salted operand: a bit-identical request can be served
+                # by a cache in the remote-execution stack without
+                # touching the device (the measured peak pitfall), which
+                # would make the probe vacuous on a half-dead tunnel.
+                salt = (time.time() % 1e4) * 1e-6
+                x = jnp.full((8, 8), 1.0 + salt, jnp.float32)
+                jax.device_get(x @ x)
+        except Exception as e:  # Surface backend errors verbatim.
+            err.append(e)
+        finally:
+            done.set()
+
+    threading.Thread(target=probe, daemon=True).start()
+    if not done.wait(timeout_s):
+        print(
+            f"Accelerator unreachable: a trivial jitted op did not "
+            f"complete within {timeout_s:.0f}s (remote-TPU tunnel down?). "
+            "Refusing to start the bench — the first real compile would "
+            "hang indefinitely.",
+            file=sys.stderr,
+            flush=True,
+        )
+        # Hard exit: a normal raise still hangs at interpreter shutdown,
+        # because the backend's atexit teardown waits on the same dead
+        # tunnel the probe just diagnosed.
+        os._exit(2)
+    if err:
+        raise err[0]
+
+
 def main():
     import jax
     import jax.numpy as jnp
     import numpy as np
     import optax
+
+    check_device_reachable()
 
     from zookeeper_tpu.core import configure
     from zookeeper_tpu.parallel import DataParallelPartitioner
