@@ -37,50 +37,11 @@ def _build(model_cls=QuickNet, base_conf=None, **conf):
 
 
 def _randomize_bns(params, model_state, rng):
-    """Randomize BN affines and running stats (recursively — some
-    families nest block scopes) so the fold has something non-trivial to
-    fold (fresh init is mean=0, var=1, scale=1, bias=0 — the fold would
-    be near-identity)."""
+    # Single-sourced with verify_onchip's jitter (zookeeper_tpu.testing)
+    # so the test and the driver probe cannot drift.
+    from zookeeper_tpu.testing import randomize_bn_variables
 
-    def jitter(tree, low, high):
-        return jax.tree.map(
-            lambda x: jnp.asarray(
-                rng.uniform(low, high, np.shape(x)), jnp.float32
-            ),
-            tree,
-        )
-
-    def walk_stats(node):
-        out = {}
-        for k, v in node.items():
-            if k.startswith("BatchNorm"):
-                out[k] = {
-                    "mean": jitter(v["mean"], -0.5, 0.5),
-                    "var": jitter(v["var"], 0.5, 2.0),
-                }
-            elif isinstance(v, dict):
-                out[k] = walk_stats(v)
-            else:
-                out[k] = v
-        return out
-
-    def walk_params(node):
-        out = {}
-        for k, v in node.items():
-            if k.startswith("BatchNorm"):
-                out[k] = {
-                    "scale": jitter(v["scale"], 0.5, 1.5),
-                    "bias": jitter(v["bias"], -0.3, 0.3),
-                }
-            elif isinstance(v, dict):
-                out[k] = walk_params(v)
-            else:
-                out[k] = v
-        return out
-
-    return walk_params(dict(params)), walk_stats(
-        dict(model_state["batch_stats"])
-    )
+    return randomize_bn_variables(params, model_state["batch_stats"], rng)
 
 
 def _trained_like_variables(model_cls=QuickNet, base_conf=None):

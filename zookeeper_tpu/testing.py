@@ -1,0 +1,67 @@
+"""Shared test/verification utilities.
+
+Small helpers used by both the test suite and the driver-runnable
+verification probes (``__graft_entry__.verify_onchip``) — single-sourced
+here so the two cannot drift.
+"""
+
+from typing import Any, Mapping, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def randomize_bn_variables(
+    params: Mapping[str, Any],
+    batch_stats: Mapping[str, Any],
+    rng: np.random.Generator,
+) -> Tuple[dict, dict]:
+    """Return (params, batch_stats) copies with every BatchNorm's affine
+    and running stats randomized (recursively — some model families nest
+    block scopes).
+
+    Fresh-init BN is mean=0/var=1/scale=1/bias=0, which makes any check
+    of BN-dependent transforms (e.g. fold-at-conversion exactness) a
+    near-identity, near-vacuous comparison; jittering gives the check
+    something non-trivial to verify. Ranges keep var positive and values
+    O(1).
+    """
+
+    def jitter(tree, low, high):
+        return jax.tree.map(
+            lambda x: jnp.asarray(
+                rng.uniform(low, high, np.shape(x)), jnp.float32
+            ),
+            tree,
+        )
+
+    def walk_params(node):
+        out = {}
+        for k, v in node.items():
+            if k.startswith("BatchNorm"):
+                out[k] = {
+                    "scale": jitter(v["scale"], 0.5, 1.5),
+                    "bias": jitter(v["bias"], -0.3, 0.3),
+                }
+            elif isinstance(v, Mapping):
+                out[k] = walk_params(v)
+            else:
+                out[k] = v
+        return out
+
+    def walk_stats(node):
+        out = {}
+        for k, v in node.items():
+            if k.startswith("BatchNorm"):
+                out[k] = {
+                    "mean": jitter(v["mean"], -0.5, 0.5),
+                    "var": jitter(v["var"], 0.5, 2.0),
+                }
+            elif isinstance(v, Mapping):
+                out[k] = walk_stats(v)
+            else:
+                out[k] = v
+        return out
+
+    return walk_params(dict(params)), walk_stats(dict(batch_stats))
