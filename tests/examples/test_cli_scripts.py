@@ -77,6 +77,14 @@ def test_bench_config_resolution():
         resolve_bench_config(env={"ZK_BENCH_MODEL": "Model"})
 
 
+def test_bench_reachability_probe_cpu_noop():
+    """Under an explicitly-requested cpu backend (the test env), the
+    reachability probe is an instant no-op — it must neither run a
+    device op nor trip the silent-fallback detector."""
+    check = _bench_attr("check_device_reachable")
+    check(timeout_s=30)  # Raises/exits on failure; returning is the pass.
+
+
 def test_bench_peak_resolution():
     """The MFU anchor: env override wins; off-TPU the recorded v5e
     fallback applies (measurement needs the real MXU)."""
