@@ -167,3 +167,24 @@ def test_device_filter_and_errors(tmp_path):
         device_op_stats(trace_dir, device_substring="TPU:7")
     with pytest.raises(FileNotFoundError, match="xplane"):
         device_op_stats(str(tmp_path / "empty"))
+
+
+def test_cli_category_filter(tmp_path, capsys):
+    """The analyzer CLI's --category/--min-ms flags narrow the top-op
+    list (the relayout-copy hunting workflow) without touching the
+    per-category totals."""
+    from zookeeper_tpu.training.profiling import _main
+
+    trace_dir = _write_fake_trace(tmp_path)
+    _main([trace_dir, "--steps", "2", "--category", "copy-done"])
+    out = capsys.readouterr().out
+    assert "4.50 ms/step" in out  # totals still cover everything
+    # Top-op rows: only the copy (data formatting) survives the filter.
+    top_lines = out.split("top ops")[1]
+    assert "copy" in top_lines
+    assert "%fusion.7" not in top_lines
+
+    _main([trace_dir, "--steps", "2", "--min-ms", "10.0"])
+    out = capsys.readouterr().out
+    assert "4.50 ms/step" in out
+    assert out.split("top ops")[1].strip().count("\n") == 0  # all filtered

@@ -162,6 +162,8 @@ def op_time_breakdown(
     top_k: int = 10,
     peak_flops_per_sec: Optional[float] = None,
     peak_bytes_per_sec: Optional[float] = None,
+    top_category: str = "",
+    top_min_ms: float = 0.0,
 ) -> dict:
     """The BASELINE.md-style attribution: per-category ms/step, a
     roofline compute/bandwidth split, and the top ops.
@@ -171,6 +173,11 @@ def op_time_breakdown(
     plane's self-reported peaks (pass the machine's MEASURED peaks for
     stricter numbers). Ops with no flops/bytes stats are skipped by the
     roofline split (reported as ``unattributed_ms_per_step``).
+
+    ``top_category``/``top_min_ms`` narrow the TOP-OP list only
+    (category substring match / per-step floor), applied BEFORE ranking
+    so even individually-tiny matches surface — the relayout-copy
+    hunting workflow. Totals and the roofline always cover every op.
     """
     data = device_op_stats(trace_dir, device_substring)
     peak_f = peak_flops_per_sec or data["peak_flops_per_sec"]
@@ -192,7 +199,13 @@ def op_time_breakdown(
         ideal_m += t_m
         key = "compute_bound" if t_c >= t_m else "bandwidth_bound"
         roof[key] += op["seconds"]
-    top = sorted(data["ops"], key=lambda op: -op["seconds"])[:top_k]
+    candidates = [
+        op
+        for op in data["ops"]
+        if top_category.lower() in (op["category"] or "").lower()
+        and op["seconds"] / steps * 1e3 >= top_min_ms
+    ]
+    top = sorted(candidates, key=lambda op: -op["seconds"])[:top_k]
     return {
         "total_ms_per_step": total / steps * 1e3,
         "by_category": {
@@ -277,6 +290,19 @@ def _main(argv: Optional[List[str]] = None) -> None:
         "--device", default="", help="device plane substring, e.g. TPU:0"
     )
     parser.add_argument("--top", type=int, default=10)
+    parser.add_argument(
+        "--category",
+        default="",
+        help="only list top ops whose hlo_category contains this "
+        "substring (e.g. 'data formatting' to hunt relayout copies); "
+        "the per-category totals always cover everything",
+    )
+    parser.add_argument(
+        "--min-ms",
+        type=float,
+        default=0.0,
+        help="drop top-op rows below this many ms/step",
+    )
     args = parser.parse_args(argv)
     print(
         format_breakdown(
@@ -285,6 +311,8 @@ def _main(argv: Optional[List[str]] = None) -> None:
                 steps=args.steps,
                 device_substring=args.device,
                 top_k=args.top,
+                top_category=args.category,
+                top_min_ms=args.min_ms,
             )
         )
     )
