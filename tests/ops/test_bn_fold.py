@@ -244,6 +244,67 @@ def test_fold_bn_binaryalexnet_dense_stage():
         bad.init(jax.random.PRNGKey(0), x, training=False)
 
 
+def test_fold_bn_binarynet_dense_stage():
+    """BinaryNet mirrors the BinaryAlexNet rule: dense-stage fold only
+    (odd convs feed a maxpool before their BN); conv-packed + fold
+    raises."""
+    from zookeeper_tpu.models import BinaryNet
+
+    def build(conf):
+        m = BinaryNet()
+        configure(
+            m,
+            {
+                "features": (16, 16),
+                "dense_units": (32,),
+                "pallas_interpret": True,
+                **conf,
+            },
+            name="m",
+        )
+        return m, m.build((16, 16, 1), num_classes=5)
+
+    model, float_module = build({})
+    rng_np = np.random.default_rng(6)
+    x = jnp.asarray(rng_np.normal(size=(2, 16, 16, 1)), jnp.float32)
+    variables = float_module.init(jax.random.PRNGKey(1), x, training=False)
+    params, stats = _randomize_bns(variables["params"], variables, rng_np)
+
+    mixed_conf = {"dense_binary_compute": "xnor", "dense_packed_weights": True}
+    _, ref_module = build(mixed_conf)
+    template = jax.eval_shape(
+        lambda: ref_module.init(jax.random.PRNGKey(1), x, training=False)
+    )["params"]
+    ref = ref_module.apply(
+        {"params": pack_quantconv_params(params, template=template),
+         "batch_stats": stats},
+        x, training=False,
+    )
+
+    _, folded_module = build({**mixed_conf, "fold_bn": True})
+    ftemplate = jax.eval_shape(
+        lambda: folded_module.init(jax.random.PRNGKey(1), x, training=False)
+    )["params"]
+    fparams, fstats = pack_quantconv_params(
+        params, template=ftemplate, fold_bn=True, batch_stats=stats
+    )
+    # Conv-stage BNs (0, 1) survive; the dense BN (2) folds away.
+    assert "BatchNorm_0" in fparams and "BatchNorm_1" in fparams
+    assert "BatchNorm_2" not in fparams and "BatchNorm_2" not in fstats
+    assert "bias" in fparams["QuantDense_0"]
+    out = folded_module.apply(
+        {"params": fparams, "batch_stats": fstats}, x, training=False
+    )
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-4
+    )
+
+    _, bad = build({"packed_weights": True, "binary_compute": "xnor",
+                    "fold_bn": True})
+    with pytest.raises(ValueError, match="DENSE stage only"):
+        bad.init(jax.random.PRNGKey(0), x, training=False)
+
+
 def test_fold_bn_pre_activation_family_raises():
     """BinaryDenseNet is pre-activation (BN BEFORE the conv; outputs
     concatenate with no following BN) — folding is structurally

@@ -57,6 +57,32 @@ def _check_fold_training(fold_bn, packed_weights, training: bool) -> None:
         raise ValueError(_FOLD_BN_TRAINING_ERROR)
 
 
+def _dense_stage_fold(
+    fold_bn: bool,
+    conv_packed: bool,
+    dense_packed,
+    training: bool,
+    family: str,
+    pooled_convs: str,
+) -> bool:
+    """Resolve the dense-stage fold flag for the VGG-style families
+    (BinaryNet, BinaryAlexNet): their convs feed a maxpool BEFORE the
+    BatchNorm, and max only commutes with the folded per-channel affine
+    when the BN scale is positive — a conv fold would be silently wrong
+    for learned negative scales, so conv-packed + fold raises. Returns
+    whether the dense stage folds."""
+    if fold_bn and conv_packed:
+        raise ValueError(
+            f"{family} fold_bn supports the DENSE stage only: "
+            f"{pooled_convs} feed a maxpool before their BatchNorm, and "
+            "max only commutes with the folded affine when the BN scale "
+            "is positive. Pack/fold the dense stage "
+            "(dense_packed_weights=True) and keep packed_weights=False."
+        )
+    _check_fold_training(fold_bn, bool(dense_packed), training)
+    return fold_bn and bool(dense_packed)
+
+
 def _post_conv_bn(y, training: bool, dtype, fold_here: bool):
     """The BN after a binary conv — or, in fold mode, its SKIP: the BN
     module is constructed either way so flax auto-numbering matches the
@@ -79,6 +105,10 @@ class _BinaryNetModule(nn.Module):
     #: None = follow binary_compute / packed_weights (see BinaryAlexNet).
     dense_binary_compute: Optional[str] = None
     dense_packed_weights: Optional[bool] = None
+    #: Deployment-only, DENSE stage only: odd-indexed convs feed a
+    #: maxpool before their BN (fold-invalid for negative BN scales —
+    #: see _BinaryAlexNetModule.fold_bn), so conv-packed + fold raises.
+    fold_bn: bool = False
     pallas_interpret: bool = False
 
     @nn.compact
@@ -109,15 +139,19 @@ class _BinaryNetModule(nn.Module):
             if self.dense_packed_weights is None
             else self.dense_packed_weights
         )
+        dense_fold = _dense_stage_fold(
+            self.fold_bn, bool(self.packed_weights), dense_packed,
+            training, "BinaryNet", "odd-indexed convs",
+        )
         for u in self.dense_units:
             x = QuantDense(
                 u, input_quantizer="ste_sign", kernel_quantizer="ste_sign",
-                use_bias=False, dtype=self.dtype,
+                use_bias=dense_fold, dtype=self.dtype,
                 binary_compute=dense_bc,
                 packed_weights=dense_packed,
                 pallas_interpret=self.pallas_interpret,
             )(x)
-            x = _bn(training, self.dtype)(x)
+            x = _post_conv_bn(x, training, self.dtype, dense_fold)
         x = nn.Dense(self.num_classes, dtype=self.dtype)(x)
         return x.astype(jnp.float32)
 
@@ -138,6 +172,8 @@ class BinaryNet(Model):
     #: (see BinaryAlexNet).
     dense_binary_compute: str = Field(allow_missing=True)
     dense_packed_weights: bool = Field(allow_missing=True)
+    #: Deployment-only, DENSE stage only (see _BinaryNetModule).
+    fold_bn: bool = Field(False)
     #: Run Pallas kernels interpreted (CPU tests).
     pallas_interpret: bool = Field(False)
 
@@ -151,6 +187,7 @@ class BinaryNet(Model):
             packed_weights=self.packed_weights,
             dense_binary_compute=getattr(self, "dense_binary_compute", None),
             dense_packed_weights=getattr(self, "dense_packed_weights", None),
+            fold_bn=self.fold_bn,
             pallas_interpret=self.pallas_interpret,
         )
 
@@ -210,18 +247,10 @@ class _BinaryAlexNetModule(nn.Module):
             if self.dense_packed_weights is None
             else self.dense_packed_weights
         )
-        if self.fold_bn and self.packed_weights:
-            raise ValueError(
-                "BinaryAlexNet fold_bn supports the DENSE stage only: "
-                "two binary convs feed a maxpool before their BatchNorm, "
-                "and max only commutes with the folded affine when the "
-                "BN scale is positive — a conv fold would be silently "
-                "wrong for learned negative scales. Pack/fold the dense "
-                "stage (dense_packed_weights=True) and keep "
-                "packed_weights=False."
-            )
-        _check_fold_training(self.fold_bn, bool(dense_packed), training)
-        dense_fold = self.fold_bn and bool(dense_packed)
+        dense_fold = _dense_stage_fold(
+            self.fold_bn, bool(self.packed_weights), dense_packed,
+            training, "BinaryAlexNet", "two of the four binary convs",
+        )
         for u in (4096, 4096):
             # The binary dense layers dominate BinaryAlexNet's parameter
             # count — the packed deployment's biggest 32x win.
