@@ -305,6 +305,82 @@ def test_fold_bn_binarynet_dense_stage():
         bad.init(jax.random.PRNGKey(0), x, training=False)
 
 
+def test_fold_bn_xnornet_both_stages():
+    """XNOR-Net is the one AlexNet-shaped family where BOTH stages fold:
+    every binary layer (conv and dense) is directly BN-followed — its
+    maxpools come AFTER the BN, so the pool hazard doesn't exist."""
+    from zookeeper_tpu.models import XNORNet
+
+    def build(conf):
+        m = XNORNet()
+        configure(m, {"pallas_interpret": True, **conf}, name="m")
+        return m, m.build((67, 67, 1), num_classes=5)
+
+    model, float_module = build({})
+    rng_np = np.random.default_rng(8)
+    x = jnp.asarray(rng_np.normal(size=(1, 67, 67, 1)), jnp.float32)
+    variables = float_module.init(jax.random.PRNGKey(2), x, training=False)
+    params, stats = _randomize_bns(variables["params"], variables, rng_np)
+    # Sign-mixed BN scales: the conv-fold validity argument hinges on
+    # negative scales being safe here (no pool between conv and BN), so
+    # the test must actually EXECUTE a negative folded kernel_scale.
+    for k in params:
+        if k.startswith("BatchNorm"):
+            signs = rng_np.choice([-1.0, 1.0], size=np.shape(params[k]["scale"]))
+            params[k] = dict(params[k])
+            params[k]["scale"] = params[k]["scale"] * jnp.asarray(
+                signs, jnp.float32
+            )
+
+    packed_conf = {"binary_compute": "xnor", "packed_weights": True}
+    _, ref_module = build(packed_conf)
+    packed_params = pack_quantconv_params(
+        params, kernel_quantizer="magnitude_aware_sign"
+    )
+    ref = ref_module.apply(
+        {"params": packed_params, "batch_stats": stats}, x, training=False
+    )
+
+    _, folded_module = build({**packed_conf, "fold_bn": True})
+    fparams, fstats = pack_quantconv_params(
+        params,
+        kernel_quantizer="magnitude_aware_sign",
+        fold_bn=True,
+        batch_stats=stats,
+    )
+    # Only the fp stem's BN survives; every binary layer's BN folds.
+    assert "BatchNorm_0" in fparams
+    assert all(
+        not k.startswith("BatchNorm") or k == "BatchNorm_0"
+        for k in fparams
+    ), sorted(k for k in fparams if k.startswith("BatchNorm"))
+    for scope in ("QuantConv_0", "QuantDense_0", "QuantDense_1"):
+        assert "bias" in fparams[scope]
+    out = folded_module.apply(
+        {"params": fparams, "batch_stats": fstats}, x, training=False
+    )
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-4
+    )
+
+    # Training apply of a folding build must raise (either stage packed).
+    with pytest.raises(ValueError, match="DEPLOYMENT mode"):
+        folded_module.init(jax.random.PRNGKey(0), x, training=True)
+    _, dense_only_fold = build(
+        {"packed_weights": False, "dense_packed_weights": True,
+         "dense_binary_compute": "xnor", "fold_bn": True}
+    )
+    with pytest.raises(ValueError, match="DEPLOYMENT mode"):
+        dense_only_fold.init(jax.random.PRNGKey(0), x, training=True)
+    # Mixed config (dense-only packed + fold): conv BNs survive, dense
+    # BNs fold — eval init builds the expected structure.
+    v = dense_only_fold.init(jax.random.PRNGKey(2), x, training=False)
+    assert "BatchNorm_1" in v["params"]  # conv-stage BN still applied
+    assert "bias" in v["params"]["QuantDense_0"]
+    n_bns = sum(1 for k in v["params"] if k.startswith("BatchNorm"))
+    assert n_bns == 5  # stem + 4 conv BNs; the 2 dense BNs are skipped
+
+
 def test_fold_bn_pre_activation_family_raises():
     """BinaryDenseNet is pre-activation (BN BEFORE the conv; outputs
     concatenate with no following BN) — folding is structurally

@@ -816,11 +816,28 @@ class _XnorNetModule(nn.Module):
     #: None = follow binary_compute / packed_weights (see BinaryAlexNet).
     dense_binary_compute: Optional[str] = None
     dense_packed_weights: Optional[bool] = None
+    #: Deployment-only. Unlike the VGG-style families, EVERY XNOR-Net
+    #: binary layer (conv AND dense) is directly BN-followed — the
+    #: maxpools come after the BN — so folding applies to both stages,
+    #: each gated on that stage being packed.
+    fold_bn: bool = False
     pallas_interpret: bool = False
 
     @nn.compact
     def __call__(self, x, training: bool = False):
         d = self.dtype
+        dense_packed = (
+            self.packed_weights
+            if self.dense_packed_weights is None
+            else self.dense_packed_weights
+        )
+        conv_fold = self.fold_bn and bool(self.packed_weights)
+        dense_fold = self.fold_bn and bool(dense_packed)
+        _check_fold_training(
+            self.fold_bn,
+            bool(self.packed_weights) or bool(dense_packed),
+            training,
+        )
 
         def qconv(x, feat, k, **kw):
             return QuantConv(
@@ -828,6 +845,7 @@ class _XnorNetModule(nn.Module):
                 kernel_quantizer="magnitude_aware_sign", dtype=d,
                 binary_compute=self.binary_compute,
                 packed_weights=self.packed_weights,
+                use_bias=conv_fold,  # Carries the folded BN shift.
                 pallas_interpret=self.pallas_interpret, **kw,
             )(x)
 
@@ -838,14 +856,14 @@ class _XnorNetModule(nn.Module):
         x = _bn(training, d)(x)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
         x = qconv(x, 256, 5)
-        x = _bn(training, d)(x)
+        x = _post_conv_bn(x, training, d, conv_fold)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
         x = qconv(x, 384, 3)
-        x = _bn(training, d)(x)
+        x = _post_conv_bn(x, training, d, conv_fold)
         x = qconv(x, 384, 3)
-        x = _bn(training, d)(x)
+        x = _post_conv_bn(x, training, d, conv_fold)
         x = qconv(x, 256, 3)
-        x = _bn(training, d)(x)
+        x = _post_conv_bn(x, training, d, conv_fold)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
         x = x.reshape((x.shape[0], -1))
         dense_bc = (
@@ -853,21 +871,16 @@ class _XnorNetModule(nn.Module):
             if self.dense_binary_compute is None
             else self.dense_binary_compute
         )
-        dense_packed = (
-            self.packed_weights
-            if self.dense_packed_weights is None
-            else self.dense_packed_weights
-        )
         for u in (4096, 4096):
             x = QuantDense(
                 u, input_quantizer="ste_sign",
                 kernel_quantizer="magnitude_aware_sign",
-                use_bias=False, dtype=d,
+                use_bias=dense_fold, dtype=d,
                 binary_compute=dense_bc,
                 packed_weights=dense_packed,
                 pallas_interpret=self.pallas_interpret,
             )(x)
-            x = _bn(training, d)(x)
+            x = _post_conv_bn(x, training, d, dense_fold)
         x = nn.Dense(self.num_classes, dtype=d)(x)
         return x.astype(jnp.float32)
 
@@ -882,6 +895,9 @@ class XNORNet(Model):
     #: (see BinaryAlexNet).
     dense_binary_compute: str = Field(allow_missing=True)
     dense_packed_weights: bool = Field(allow_missing=True)
+    #: Deployment-only; BOTH stages fold (every XNOR-Net binary layer is
+    #: directly BN-followed — see _XnorNetModule).
+    fold_bn: bool = Field(False)
     pallas_interpret: bool = Field(False)
 
     def build(self, input_shape, num_classes: int) -> nn.Module:
@@ -891,6 +907,7 @@ class XNORNet(Model):
             packed_weights=self.packed_weights,
             dense_binary_compute=getattr(self, "dense_binary_compute", None),
             dense_packed_weights=getattr(self, "dense_packed_weights", None),
+            fold_bn=self.fold_bn,
             pallas_interpret=self.pallas_interpret,
         )
 
