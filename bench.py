@@ -204,6 +204,33 @@ def _env_flag(env, name: str, default: str = "0") -> bool:
     return env.get(name, default).strip().lower() not in ("0", "", "false")
 
 
+def resolve_compiler_options(env=None):
+    """``ZK_BENCH_COMPILER_OPTIONS``: a JSON object of XLA compiler
+    options applied to the train-step compile (e.g.
+    ``{"xla_tpu_scoped_vmem_limit_kib": "65536"}``). This is the only
+    way to reach TPU-side flags on a remote-execution backend — the
+    local process's XLA_FLAGS parser rejects flags its own (CPU) jaxlib
+    doesn't know, while per-compile options travel with the computation.
+    Returns None when unset so the default compile path is untouched."""
+    env = os.environ if env is None else env
+    raw = env.get("ZK_BENCH_COMPILER_OPTIONS", "").strip()
+    if not raw:
+        return None
+    try:
+        opts = json.loads(raw)
+    except json.JSONDecodeError as e:
+        raise ValueError(
+            f"ZK_BENCH_COMPILER_OPTIONS is not valid JSON ({e}); expected "
+            'an object like {"xla_tpu_scoped_vmem_limit_kib": "65536"}'
+        ) from None
+    if not isinstance(opts, dict):
+        raise ValueError(
+            "ZK_BENCH_COMPILER_OPTIONS must be a JSON object of "
+            f"option-name -> value, got {type(opts).__name__}"
+        )
+    return opts
+
+
 def check_device_reachable(timeout_s: float = 120.0) -> None:
     """Fail FAST with a clear error when the accelerator is unreachable
     (a dead remote-TPU tunnel makes the first compile hang indefinitely,
@@ -278,6 +305,9 @@ def main():
     import optax
 
     check_device_reachable()
+    # Resolve early: a malformed ZK_BENCH_COMPILER_OPTIONS must fail
+    # before the (minutes-long) model build + lower, not at compile.
+    compiler_options = resolve_compiler_options()
 
     from zookeeper_tpu.core import configure
     from zookeeper_tpu.parallel import DataParallelPartitioner
@@ -324,7 +354,11 @@ def main():
     # AOT-compile ONCE: the same executable serves the timed runs and the
     # FLOPs cost analysis (a second trace/compile of this graph costs
     # minutes at ImageNet shapes).
-    compiled_step = jit_step.lower(state, batch).compile()
+    lowered = jit_step.lower(state, batch)
+    if compiler_options is None:
+        compiled_step = lowered.compile()
+    else:
+        compiled_step = lowered.compile(compiler_options=compiler_options)
 
     # Model FLOPs from XLA's cost analysis of the compiled train step
     # (includes fwd + bwd + optimizer as actually executed). NOTE: for an
@@ -412,6 +446,8 @@ def main():
         "step_time_ms": round(step_time * 1e3, 2),
         "n_chips": n_chips,
     }
+    if compiler_options is not None:
+        extras["compiler_options"] = compiler_options
     if cost is not None:
         mfu = cost / step_time / peak_flops
         extras["per_chip_step_tflops"] = round(cost / 1e12, 2)

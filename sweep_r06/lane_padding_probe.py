@@ -1,0 +1,94 @@
+"""Measure the TPU lane-padding tax on C=64 activations.
+
+Hypothesis (from the round-6 north-star trace): the dominant
+bandwidth-bound ops all stream section-1 activations shaped
+``bf16[128,56,56,64]``, whose minor (lane) dimension 64 is padded to
+128 by the (8/16,128) HBM tiling — i.e. every touch of those tensors
+moves ~2x their logical bytes. If true, it is the structural floor
+under the north star's MFU (the architecture fixes C=64; every
+minor-dim choice for NHWC section-1 tensors pads: C=64 -> 2x,
+W=56 -> 128/56).
+
+Probe: a BN-backward-shaped reduction (sum over N,H,W to f32[C]) over
+the SAME logical element count with trailing dims 64/128/256/512 and a
+2-D merged-view control. Bandwidth-bound by construction (one read,
+tiny output). Timed with the bench marginal-chain methodology (fixed
+tunnel latency cancels); reports achieved GB/s of LOGICAL bytes — if
+the C=64 row lands near half the C=128 row, the padding tax is real.
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+import bench  # noqa: E402
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from functools import partial
+
+    bench.check_device_reachable()
+
+    rng = np.random.default_rng(0)
+    n_elts = 128 * 56 * 56 * 64  # The section-1 activation, logically.
+    shapes = [
+        (128, 56, 56, 64),    # the real layout: minor dim 64 (padded?)
+        (128, 56, 28, 128),   # same bytes, lane-exact minor dim
+        (128, 56, 14, 256),
+        (128, 56, 7, 512),
+        (128, 56 * 56 * 64),  # 2-D merged control (minor 200704 = 1568*128)
+    ]
+    logical_bytes = n_elts * 2
+
+    @partial(jax.jit, static_argnums=(1, 2))
+    def chain(x, iters, axes):
+        # Each iterate re-reads the full tensor (the salt add defeats
+        # CSE across iterations) and reduces it BN-backward-style to
+        # f32[C]; the carry feeds the next salt so nothing is hoisted.
+        def body(c, _):
+            y = (x + c.astype(x.dtype)).astype(jnp.float32).sum(axis=axes)
+            return y.sum() * 1e-12, None
+
+        out, _ = jax.lax.scan(body, jnp.float32(0), None, length=iters)
+        return out
+
+    print(
+        f"logical tensor: bf16 x {n_elts} elements "
+        f"({logical_bytes / 1e6:.1f} MB); reduce to f32[C]"
+    )
+    for shape in shapes:
+        x = jax.device_put(
+            jnp.asarray(
+                rng.normal(size=shape).astype(np.float32), jnp.bfloat16
+            )
+        )
+        axes = tuple(range(len(shape) - 1))
+
+        def run_chain(iters):
+            t0 = time.perf_counter()
+            float(jax.device_get(chain(x, iters, axes)))
+            return time.perf_counter() - t0
+
+        run_chain(4)  # warm compile
+        run_chain(256)
+        # Long chains: at ~60-300 us/pass, shorter (64, 256) chains sat
+        # inside single tunnel-jitter spikes (negative / above-physics
+        # marginals observed).
+        per_pass = bench.time_marginal(run_chain, 256, 1024, rounds=8)
+        gbs = logical_bytes / per_pass / 1e9
+        print(
+            f"  trailing={shape[-1]:>6}: {per_pass * 1e6:8.1f} us/pass, "
+            f"{gbs:7.1f} GB/s of logical bytes",
+            flush=True,
+        )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,97 @@
+"""Capture a per-op device-time trace of the north-star train step.
+
+Builds the exact bench.py workload (same ZK_BENCH_* env knobs), runs a
+few steps under ``jax.profiler.trace``, and prints the
+``training.profiling`` attribution (category shares + roofline + top
+ops). This is the capture side of the analysis CLI
+(``python -m zookeeper_tpu.training.profiling <dir>``); the BASELINE.md
+round-5/6 per-op tables were produced this way.
+"""
+
+import os
+import sys
+import tempfile
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+import bench  # noqa: E402  (repo-root module)
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    bench.check_device_reachable()
+
+    from zookeeper_tpu.core import configure
+    from zookeeper_tpu.parallel import DataParallelPartitioner
+    from zookeeper_tpu.training import TrainState, make_train_step
+    from zookeeper_tpu.training.profiling import (
+        format_breakdown,
+        op_time_breakdown,
+    )
+
+    input_shape = (224, 224, 3)
+    num_classes = 1000
+    (
+        model,
+        model_name,
+        batch_size,
+        binary_compute,
+        pack_residuals,
+    ) = bench.resolve_bench_config()
+    module = model.build(input_shape, num_classes=num_classes)
+    params, model_state = model.initialize(module, input_shape)
+    state = TrainState.create(
+        apply_fn=module.apply,
+        params=params,
+        model_state=model_state,
+        tx=optax.adam(1e-3),
+    )
+    partitioner = DataParallelPartitioner()
+    configure(partitioner, {}, name="partitioner")
+    partitioner.setup()
+    state = partitioner.shard_state(state)
+    jit_step = partitioner.compile_step(make_train_step(), state)
+
+    rng = np.random.default_rng(0)
+    batch = jax.device_put(
+        {
+            "input": jnp.asarray(
+                rng.normal(size=(batch_size, *input_shape)), jnp.bfloat16
+            ),
+            "target": jnp.asarray(rng.integers(0, num_classes, batch_size)),
+        },
+        partitioner.batch_sharding(),
+    )
+    compiled = jit_step.lower(state, batch).compile()
+
+    for _ in range(3):  # Warmup outside the trace.
+        state, metrics = compiled(state, batch)
+    float(jax.device_get(metrics["loss"]))
+
+    steps = int(os.environ.get("ZK_PROFILE_STEPS", "10"))
+    trace_dir = tempfile.mkdtemp(prefix="zk_trace_northstar_")
+    with jax.profiler.trace(trace_dir):
+        for _ in range(steps):
+            state, metrics = compiled(state, batch)
+        float(jax.device_get(metrics["loss"]))
+
+    print(
+        f"model={model_name} batch={batch_size} "
+        f"binary_compute={binary_compute} pack_residuals={pack_residuals} "
+        f"steps={steps} trace_dir={trace_dir}"
+    )
+    print(
+        format_breakdown(
+            op_time_breakdown(trace_dir, steps=steps, top_k=15)
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

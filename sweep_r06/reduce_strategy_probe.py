@@ -1,0 +1,126 @@
+"""Follow-up to lane_padding_probe: the C<=256 BN-style reduces are
+emitter-bound at ~185 GB/s (23% of HBM peak), NOT bandwidth-bound —
+trailing=512 hits 771 GB/s with the same logical bytes. Can the same
+reductions reach peak when phrased differently?
+
+Variants, each reducing bf16[128,56,56,64]-class tensors to f32[C]:
+
+- ``reduce``      — jnp.sum baseline (what the model's backward does)
+- ``dot_ones``    — dot_general contracting N,H,W against a ones
+                    tensor (MXU-eligible phrasing of the same sum)
+- ``dot_pair``    — sum(dy * xhat) per channel as a C-batched
+                    dot_general (the OTHER BN-backward statistic)
+- ``reduce_pair`` — jnp.sum(dy * xhat) baseline for dot_pair
+
+If dot_ones lands near 771 GB/s on the C=64/128 shapes, the BN
+backward's stat reductions have ~4x headroom via a pure-JAX rephrase
+(no Pallas needed) — the first real software lever found since the
+1-bit residency negative.
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+import bench  # noqa: E402
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from functools import partial
+
+    bench.check_device_reachable()
+
+    rng = np.random.default_rng(0)
+    shapes = [
+        (128, 56, 56, 64),
+        (128, 28, 28, 128),  # section-2 activations
+        (128, 56, 7, 512),   # the shape XLA's own reduce handles at peak
+    ]
+
+    def make_chain(kind):
+        @partial(jax.jit, static_argnums=(2,))
+        def chain(x, y, iters):
+            def body(c, _):
+                xs = x + c.astype(x.dtype)
+                if kind == "reduce":
+                    r = xs.astype(jnp.float32).sum(axis=(0, 1, 2))
+                elif kind == "dot_ones":
+                    ones = jnp.ones(xs.shape[:3], jnp.bfloat16)
+                    r = jax.lax.dot_general(
+                        ones, xs,
+                        (((0, 1, 2), (0, 1, 2)), ((), ())),
+                        preferred_element_type=jnp.float32,
+                    )
+                elif kind == "reduce_pair":
+                    r = (
+                        (xs * y).astype(jnp.float32).sum(axis=(0, 1, 2))
+                    )
+                elif kind == "dot_pair":
+                    # C-batched length-NHW dot: batch dim 3 on both.
+                    r = jax.lax.dot_general(
+                        jnp.moveaxis(xs, 3, 0).reshape(xs.shape[3], -1),
+                        jnp.moveaxis(y, 3, 0).reshape(y.shape[3], -1),
+                        (((1,), (1,)), ((0,), (0,))),
+                        preferred_element_type=jnp.float32,
+                    )
+                return r.sum() * 1e-12, None
+
+            out, _ = jax.lax.scan(body, jnp.float32(0), None, length=iters)
+            return out
+
+        return chain
+
+    for shape in shapes:
+        n_elts = int(np.prod(shape))
+        x = jax.device_put(
+            jnp.asarray(
+                rng.normal(size=shape).astype(np.float32), jnp.bfloat16
+            )
+        )
+        y = jax.device_put(
+            jnp.asarray(
+                rng.normal(size=shape).astype(np.float32), jnp.bfloat16
+            )
+        )
+        print(f"shape {shape} ({n_elts * 2 / 1e6:.1f} MB logical):")
+        for kind, reads in (
+            ("reduce", 1),
+            ("dot_ones", 1),
+            ("reduce_pair", 2),
+            ("dot_pair", 2),
+        ):
+            chain = make_chain(kind)
+
+            def run_chain(iters):
+                t0 = time.perf_counter()
+                float(jax.device_get(chain(x, y, iters)))
+                return time.perf_counter() - t0
+
+            try:
+                run_chain(4)
+                run_chain(256)
+                # Long chains: at ~60-300 us/pass the (64, 256) chains
+                # of the first draft sat inside single tunnel-jitter
+                # spikes and produced negative/above-physics marginals.
+                per_pass = bench.time_marginal(
+                    run_chain, 256, 1024, rounds=8
+                )
+                gbs = reads * n_elts * 2 / per_pass / 1e9
+                print(
+                    f"  {kind:12s}: {per_pass * 1e6:8.1f} us/pass, "
+                    f"{gbs:7.1f} GB/s of logical bytes read",
+                    flush=True,
+                )
+            except Exception as e:
+                print(f"  {kind:12s}: FAILED ({type(e).__name__}: {e})")
+
+
+if __name__ == "__main__":
+    main()

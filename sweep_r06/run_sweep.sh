@@ -1,0 +1,43 @@
+#!/bin/bash
+# Round-6 margin sweep (VERDICT r3 next #7), deferred by the tunnel
+# outage; see README.md in this directory for the artifact contract.
+# Peak is measured once in the b128 baseline run and pinned for every
+# variant so within-session numbers compare on the same anchor.
+set -u
+cd "$(dirname "$0")/.."
+OUT=sweep_r06
+
+echo "[sweep] b128 baseline (measures this session's peak)..."
+python bench.py > $OUT/sweep_b128.json 2> $OUT/sweep_b128.err || {
+  echo "[sweep] baseline FAILED"; exit 1; }
+PEAK=$(python -c "import json; d=json.load(open('$OUT/sweep_b128.json')); print(d['bf16_peak_tflops']*1e12)")
+echo "[sweep] pinned peak: $PEAK FLOP/s"
+
+for B in 160 192 224; do
+  echo "[sweep] batch $B..."
+  ZK_BENCH_BATCH=$B ZK_BENCH_PEAK_FLOPS=$PEAK \
+    python bench.py > $OUT/sweep_b$B.json 2> $OUT/sweep_b$B.err \
+    || echo "[sweep] b$B FAILED"
+done
+
+# TPU-side flags must travel as per-compile compiler options
+# (ZK_BENCH_COMPILER_OPTIONS): the local CPU jaxlib's XLA_FLAGS parser
+# fatals on flags it doesn't know, and the TPU compile happens on the
+# far side of the axon tunnel anyway.
+echo "[sweep] b128, latency-hiding scheduler off..."
+ZK_BENCH_PEAK_FLOPS=$PEAK \
+  ZK_BENCH_COMPILER_OPTIONS='{"xla_tpu_enable_latency_hiding_scheduler": "False"}' \
+  python bench.py > $OUT/sweep_nolhs.json 2> $OUT/sweep_nolhs.err \
+  || echo "[sweep] nolhs FAILED"
+
+echo "[sweep] b128, 64 MiB scoped VMEM..."
+ZK_BENCH_PEAK_FLOPS=$PEAK \
+  ZK_BENCH_COMPILER_OPTIONS='{"xla_tpu_scoped_vmem_limit_kib": "65536"}' \
+  python bench.py > $OUT/sweep_vmem64.json 2> $OUT/sweep_vmem64.err \
+  || echo "[sweep] vmem64 FAILED"
+
+echo "[sweep] baseline per-op trace..."
+python $OUT/profile_northstar.py > $OUT/profile_pack0.log \
+  2> $OUT/profile_pack0.err || echo "[sweep] profile FAILED"
+
+echo "[sweep] done"

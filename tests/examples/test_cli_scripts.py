@@ -96,3 +96,37 @@ def test_bench_peak_resolution():
     peak, source = resolve_peak_flops(env={})
     # Tests force JAX_PLATFORMS=cpu, so the TPU measurement is skipped.
     assert (peak, source) == (184e12, "fallback_v5e")
+
+
+def test_bench_compiler_options_resolution():
+    """ZK_BENCH_COMPILER_OPTIONS: unset -> None (default compile path);
+    a JSON object passes through; non-object JSON is rejected loudly."""
+    resolve = _bench_attr("resolve_compiler_options")
+
+    assert resolve(env={}) is None
+    assert resolve(env={"ZK_BENCH_COMPILER_OPTIONS": "  "}) is None
+
+    opts = resolve(
+        env={
+            "ZK_BENCH_COMPILER_OPTIONS": (
+                '{"xla_tpu_scoped_vmem_limit_kib": "65536"}'
+            )
+        }
+    )
+    assert opts == {"xla_tpu_scoped_vmem_limit_kib": "65536"}
+
+    with pytest.raises(ValueError, match="JSON object"):
+        resolve(env={"ZK_BENCH_COMPILER_OPTIONS": '["not", "a", "dict"]'})
+
+    # Flag-syntax (non-JSON) input fails loudly, NAMING the env var —
+    # not with a bare JSONDecodeError.
+    with pytest.raises(
+        ValueError, match="ZK_BENCH_COMPILER_OPTIONS is not valid JSON"
+    ):
+        resolve(
+            env={
+                "ZK_BENCH_COMPILER_OPTIONS": (
+                    "xla_tpu_scoped_vmem_limit_kib=65536"
+                )
+            }
+        )

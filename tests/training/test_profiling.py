@@ -125,10 +125,22 @@ def test_breakdown_categories_and_roofline(tmp_path):
     assert roof["unattributed_ms_per_step"] == pytest.approx(0.5)
     assert roof["compute_bound_share"] == pytest.approx(6 / 9)
 
+    # Per-op achieved-bandwidth columns: (ms, category, name, bytes/s,
+    # fraction of HBM peak); ops without bytes stats carry None.
+    top = {row[2]: row for row in b["top_ops"]}
+    bn = top[_OPS[1][0]]
+    assert bn[3] == pytest.approx(500e6 / 2e-3)  # 250 GB/s achieved
+    assert bn[4] == pytest.approx(250e9 / 800e9)  # 31% of peak
+    copy = top[_OPS[2][0]]
+    assert copy[3] is None and copy[4] is None
+
     text = format_breakdown(b)
     assert "4.50 ms/step" in text
     assert "convolution fusion" in text
     assert "compute-bound ops 3.00 ms (67%)" in text
+    assert "250 GB/s   31%" in text
+    # The no-overlap roofline lower bounds (sums of per-op ideals).
+    assert "roofline lower bounds" in text
 
 
 def test_peak_overrides_change_classification(tmp_path):

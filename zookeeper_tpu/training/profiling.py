@@ -231,7 +231,26 @@ def op_time_breakdown(
             "ideal_memory_ms_per_step": ideal_m / steps * 1e3,
         },
         "top_ops": [
-            (op["seconds"] / steps * 1e3, op["category"], op["name"])
+            (
+                op["seconds"] / steps * 1e3,
+                op["category"],
+                op["name"],
+                # Achieved streaming rate and its share of the HBM
+                # peak — the "is this op already at the roofline?"
+                # column (None without bytes stats / a peak). An op can
+                # legitimately sit near 100% BW *and* high TF/s at
+                # once: conv fusions overlap MXU work with the stream.
+                (
+                    op["bytes"] / op["seconds"]
+                    if op["seconds"] and op["bytes"]
+                    else None
+                ),
+                (
+                    op["bytes"] / op["seconds"] / peak_b
+                    if op["seconds"] and op["bytes"] and peak_b
+                    else None
+                ),
+            )
             for op in top
         ],
     }
@@ -265,9 +284,31 @@ def format_breakdown(breakdown: dict, name_width: int = 70) -> str:
             "(ops without flops/bytes stats or peaks)"
         )
     lines.append(line)
-    lines.append("top ops (ms/step):")
-    for ms, category, op_name in breakdown["top_ops"]:
-        lines.append(f"  {ms:8.3f}  [{category}] {op_name[:name_width]}")
+    if roof["ideal_compute_ms_per_step"] or roof["ideal_memory_ms_per_step"]:
+        # Guarded like the unattributed note above: on a trace with no
+        # peak/flops/bytes stats both ideals are 0 and printing them
+        # would read as "zero lower bound", not "no roofline data".
+        lines.append(
+            "roofline lower bounds (sum over ops at device peaks): "
+            f"compute {roof['ideal_compute_ms_per_step']:.2f} ms, "
+            f"memory {roof['ideal_memory_ms_per_step']:.2f} ms — a "
+            "measured step near or below the memory bound is already "
+            "overlapping MXU work with the HBM stream"
+        )
+    lines.append("top ops (ms/step, achieved GB/s, % of HBM peak):")
+    for row in breakdown["top_ops"]:
+        ms, category, op_name = row[0], row[1], row[2]
+        # Older callers may hold 3-tuples from before the bandwidth
+        # columns; render those without the rate.
+        bps, frac = (row[3], row[4]) if len(row) >= 5 else (None, None)
+        rate = (
+            f"{bps / 1e9:6.0f} GB/s {frac * 100:4.0f}%"
+            if bps is not None and frac is not None
+            else " " * 17
+        )
+        lines.append(
+            f"  {ms:8.3f} {rate} [{category}] {op_name[:name_width]}"
+        )
     return "\n".join(lines)
 
 
