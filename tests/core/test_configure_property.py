@@ -1,0 +1,286 @@
+"""Property-based randomized tests of the configure() precedence
+contract (SURVEY.md §3.2).
+
+The hand-written suites pin known precedence subtleties; this module
+generates RANDOM component trees (seeded, reproducible) with colliding
+field names across depths, random suffix-scoped confs, and random
+pre-bound ComponentField overrides, then checks every resolved field
+against an INDEPENDENT oracle that re-implements the documented
+precedence:
+
+    conf longest-suffix match
+      > value set at construction (pre-bound ComponentField overrides)
+      > nearest ancestor's *set* same-named field
+      > own Field default
+      > nearest ancestor's same-named field default
+
+plus the unused-key error contract: randomized typo'd keys (outside
+the field pool) and a DETERMINISTIC true-shadowing construction (a
+scoped key out-matched by a longer key at every node it could apply
+to), both of which must raise ConfigurationError naming the key.
+"""
+
+import itertools
+import random
+
+import pytest
+
+from zookeeper_tpu.core import (
+    ComponentField,
+    ConfigurationError,
+    Field,
+    component,
+    configure,
+)
+from zookeeper_tpu.core.component import configured_field_names
+
+# Small pools on purpose: collisions across depths are the interesting
+# cases (same field name declared at several levels, scoped keys that
+# shadow each other).
+FIELD_POOL = ("alpha", "beta", "gamma")
+CHILD_SLOTS = ("first", "second")
+NO_DEFAULT = object()
+
+_class_counter = itertools.count()
+
+
+class SpecNode:
+    """Oracle-side tree description, independent of the component API."""
+
+    def __init__(self):
+        self.fields = {}  # name -> default int | NO_DEFAULT
+        self.overrides = {}  # slot -> {field name -> int} (pre-bound)
+        self.children = {}  # slot -> SpecNode
+
+
+def gen_spec(rng: random.Random, depth: int = 0) -> SpecNode:
+    node = SpecNode()
+    for f in FIELD_POOL:
+        if depth == 0:
+            # Root declares every pool field WITH a default so every
+            # generated tree resolves (ancestor-default backstop).
+            node.fields[f] = rng.randrange(1000)
+        else:
+            r = rng.random()
+            if r < 0.45:
+                node.fields[f] = rng.randrange(1000)
+            elif r < 0.70:
+                node.fields[f] = NO_DEFAULT
+            # else: the field is absent on this node entirely.
+    if depth < 3:
+        for slot in CHILD_SLOTS:
+            if rng.random() < 0.65:
+                child = gen_spec(rng, depth + 1)
+                node.children[slot] = child
+                node.overrides[slot] = {
+                    f: rng.randrange(1000, 2000)
+                    for f in child.fields
+                    if rng.random() < 0.2
+                }
+    return node
+
+
+def build_component_class(spec: SpecNode) -> type:
+    attrs, ann = {}, {}
+    for f, default in spec.fields.items():
+        attrs[f] = Field() if default is NO_DEFAULT else Field(default)
+        ann[f] = int
+    for slot, child in spec.children.items():
+        child_cls = build_component_class(child)
+        attrs[slot] = ComponentField(child_cls, **spec.overrides[slot])
+        ann[slot] = child_cls
+    attrs["__annotations__"] = ann
+    return component(
+        type(f"PropNode{next(_class_counter)}", (), attrs)
+    )
+
+
+def walk(spec: SpecNode, path=()):
+    yield path, spec
+    for slot, child in spec.children.items():
+        yield from walk(child, path + (slot,))
+
+
+def gen_conf(rng: random.Random, spec: SpecNode) -> dict:
+    """Random conf keys, each a VALID suffix scoping of some (node,
+    field) pair. Because gen_spec gives the root every pool field, the
+    bare and full-path keys generated here are always consumable —
+    true SHADOWING cannot occur randomly and is covered by the
+    deterministic test below; the random unused-key cases come from
+    the out-of-pool typo key."""
+    conf = {}
+    pairs = [
+        (path, f) for path, node in walk(spec) for f in node.fields
+    ]
+    for path, f in rng.sample(pairs, k=min(len(pairs), rng.randrange(1, 7))):
+        start = rng.randrange(len(path) + 1)
+        key = ".".join(list(path[start:]) + [f])
+        conf[key] = rng.randrange(2000, 3000)
+    if rng.random() < 0.25:
+        # A key no node can consume (field outside the pool): the
+        # typo'd-override case, must raise.
+        conf["delta"] = 1
+    return conf
+
+
+def oracle(spec: SpecNode, conf: dict):
+    """Expected per-node field values + the set of conf keys consumed."""
+    used = set()
+    results = {}  # path -> {field -> value}
+    set_values = {}  # path -> {field -> value} (conf- or construction-set)
+    nodes = dict(walk(spec))
+
+    def conf_match(path, name):
+        for start in range(len(path) + 1):
+            key = ".".join(list(path[start:]) + [name])
+            if key in conf:
+                return key
+        return None
+
+    for path, node in nodes.items():
+        sv = set_values[path] = {}
+        parent_overrides = {}
+        if path:
+            parent_overrides = nodes[path[:-1]].overrides.get(path[-1], {})
+        for f in node.fields:
+            key = conf_match(path, f)
+            if key is not None:
+                used.add(key)
+                sv[f] = conf[key]
+            elif f in parent_overrides:
+                sv[f] = parent_overrides[f]
+
+    for path, node in nodes.items():
+        res = results[path] = {}
+        for f, default in node.fields.items():
+            if f in set_values[path]:
+                res[f] = set_values[path][f]
+                continue
+            for i in range(len(path) - 1, -1, -1):  # nearest ancestor set
+                anc = path[:i]
+                if f in set_values[anc]:
+                    res[f] = set_values[anc][f]
+                    break
+            else:
+                if default is not NO_DEFAULT:  # own default
+                    res[f] = default
+                else:  # nearest ancestor WITH a default
+                    for i in range(len(path) - 1, -1, -1):
+                        anc_default = nodes[path[:i]].fields.get(
+                            f, NO_DEFAULT
+                        )
+                        if anc_default is not NO_DEFAULT:
+                            res[f] = anc_default
+                            break
+                    else:
+                        raise AssertionError(
+                            "generator invariant broken: no resolvable "
+                            f"value for {path}.{f}"
+                        )
+    return results, used, set_values
+
+
+def get_node(root_instance, path):
+    node = root_instance
+    for slot in path:
+        node = getattr(node, slot)
+    return node
+
+
+@pytest.mark.parametrize("seed", range(40))
+def test_random_tree_matches_precedence_oracle(seed):
+    rng = random.Random(seed)
+    spec = gen_spec(rng)
+    conf = gen_conf(rng, spec)
+    expected, used, set_values = oracle(spec, conf)
+
+    cls = build_component_class(spec)
+    root = cls()
+    if set(conf) - used:
+        # Every conf key the oracle says no node consumes (shadowed by
+        # longer matches at every applicable node) must be reported.
+        with pytest.raises(ConfigurationError, match="did not match"):
+            configure(root, conf, name="root")
+        return
+    configure(root, conf, name="root")
+    for path, node_spec in walk(spec):
+        inst = get_node(root, path)
+        for f in node_spec.fields:
+            assert getattr(inst, f) == expected[path][f], (
+                f"seed={seed} path={'.'.join(path) or '<root>'} field={f} "
+                f"conf={conf}"
+            )
+        # configured_field_names reports exactly the explicitly-set
+        # fields (conf matches + pre-bound overrides) — not inherited
+        # or defaulted ones, and not default-instantiated child slots
+        # (those live in the lazy-default cache, not the values dict).
+        assert configured_field_names(inst) == set(set_values[path]), (
+            f"seed={seed} path={'.'.join(path) or '<root>'}"
+        )
+
+
+def _hand_built_spec():
+    """root{beta=1} -> first{alpha=2, beta=NO_DEFAULT}
+    -> first.second{alpha=3} — built without gen_spec so the root does
+    NOT declare alpha (gen_spec's root-declares-everything invariant is
+    exactly what makes true shadowing impossible in the random cases).
+    """
+    grand = SpecNode()
+    grand.fields["alpha"] = 3
+    child = SpecNode()
+    child.fields["alpha"] = 2
+    child.fields["beta"] = NO_DEFAULT
+    child.children["second"] = grand
+    child.overrides["second"] = {}
+    root = SpecNode()
+    root.fields["beta"] = 1
+    root.children["first"] = child
+    root.overrides["first"] = {}
+    return root
+
+
+def test_truly_shadowed_scoped_key_raises():
+    """TRUE shadowing, not a typo: "second.alpha" names a real field of
+    a real node — but its only matching node (first.second) finds its
+    longer full-path key first, so the short key is consumed nowhere.
+    configure must raise naming it; the oracle must predict exactly
+    that key."""
+    spec = _hand_built_spec()
+    conf = {
+        "first.alpha": 10,
+        "first.second.alpha": 11,
+        "second.alpha": 12,  # shadowed by first.second.alpha
+    }
+    _, used, _ = oracle(spec, conf)
+    assert set(conf) - used == {"second.alpha"}
+    with pytest.raises(ConfigurationError, match="second.alpha"):
+        configure(build_component_class(spec)(), conf, name="root")
+
+
+def test_oracle_matches_hand_computed_tree():
+    """Known-answer test: the oracle (and the implementation) against
+    values computed BY HAND for a fixed tree+conf — the guard against
+    an oracle that drifted into mirroring the implementation's bugs."""
+    spec = _hand_built_spec()
+    conf = {"first.alpha": 10, "beta": 20}
+    expected_by_hand = {
+        (): {"beta": 20},  # bare key matches the root directly
+        ("first",): {
+            "alpha": 10,  # its scoped key
+            "beta": 20,  # bare key matches here too (suffix "")
+        },
+        ("first", "second"): {
+            # No key matches this path; nearest ancestor SET alpha=10
+            # beats the own default 3 (explicit beats implicit).
+            "alpha": 10,
+        },
+    }
+    results, used, _ = oracle(spec, conf)
+    assert used == set(conf)
+    assert results == expected_by_hand
+
+    root = build_component_class(spec)()
+    configure(root, conf, name="root")
+    for path, fields in expected_by_hand.items():
+        for f, v in fields.items():
+            assert getattr(get_node(root, path), f) == v, (path, f)
