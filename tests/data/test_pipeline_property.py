@@ -1,0 +1,147 @@
+"""Property-based randomized tests of the multi-host batch pipeline's
+determinism contract (SURVEY.md §7 "input pipeline at pod scale",
+`data/pipeline.py` module docstring):
+
+given (seed, epoch, global example count), every host computes the SAME
+global permutation and reads ONLY its own contiguous slice of each
+global batch. The hand-written tests pin single configs; this module
+sweeps randomized (n, batch_size, host_count, seed, epoch,
+drop_remainder) and checks, against an independently-computed expected
+permutation:
+
+- cross-host exactness: host h's batch b is exactly
+  ``order[b*G + h*B : ...]`` (no duplicates, no gaps, no overlap);
+- batch-count arithmetic for drop/keep-remainder (and that multi-host
+  FORCES dropping);
+- bitwise run-to-run and cross-"process" reproducibility (each host's
+  iterator is built independently, as real processes would);
+- epoch keying: different epochs permute differently (n > 2).
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from zookeeper_tpu.data.pipeline import batch_iterator
+from zookeeper_tpu.data.source import ArraySource
+
+
+def expected_order(seed, epoch, n):
+    return np.random.default_rng(
+        np.random.SeedSequence([seed, epoch])
+    ).permutation(n)
+
+
+@pytest.mark.parametrize("case_seed", range(30))
+def test_multihost_batches_match_permutation_slices(case_seed):
+    rng = random.Random(case_seed)
+    n = rng.randrange(1, 65)
+    batch_size = rng.randrange(1, 9)
+    host_count = rng.choice((1, 1, 2, 3, 4))
+    seed = rng.randrange(10_000)
+    epoch = rng.randrange(5)
+    drop_remainder = rng.random() < 0.5
+    shuffle = rng.random() < 0.8
+
+    source = ArraySource({"x": np.arange(n, dtype=np.int64)})
+    per_host = []
+    for h in range(host_count):
+        batches = list(
+            batch_iterator(
+                source,
+                None,
+                batch_size,
+                training=True,
+                shuffle=shuffle,
+                seed=seed,
+                epoch=epoch,
+                drop_remainder=drop_remainder,
+                host_index=h,
+                host_count=host_count,
+            )
+        )
+        per_host.append(batches)
+
+    order = (
+        expected_order(seed, epoch, n) if shuffle else np.arange(n)
+    )
+    g = batch_size * host_count
+    # Multi-host FORCES drop_remainder (desync safety).
+    effective_drop = drop_remainder or host_count > 1
+    expected_batches = n // g if effective_drop else -(-n // g)
+
+    # Every counted batch has a non-empty slice on every host: dropping
+    # is forced multi-host, and single-host keep-remainder's final
+    # partial batch still starts below n.
+    for h, batches in enumerate(per_host):
+        assert len(batches) == expected_batches, (
+            f"case={case_seed} host={h}"
+        )
+        for b, batch in enumerate(batches):
+            start = b * g + h * batch_size
+            stop = min(start + batch_size, n, (b + 1) * g)
+            np.testing.assert_array_equal(
+                batch["x"], order[start:stop], err_msg=f"case={case_seed} "
+                f"host={h} batch={b}"
+            )
+
+    # Within every global batch: the hosts' slices are disjoint and
+    # (when dropping) cover the full global batch exactly.
+    for b in range(expected_batches):
+        seen = np.concatenate(
+            [
+                per_host[h][b]["x"]
+                for h in range(host_count)
+                if b < len(per_host[h])
+            ]
+        )
+        assert len(np.unique(seen)) == len(seen)
+        if effective_drop:
+            np.testing.assert_array_equal(
+                np.sort(seen), np.sort(order[b * g : (b + 1) * g])
+            )
+
+    # Bitwise reproducibility: an independently-built iterator (a fresh
+    # "process") yields identical batches.
+    for h in (0, host_count - 1):
+        rerun = list(
+            batch_iterator(
+                source,
+                None,
+                batch_size,
+                training=True,
+                shuffle=shuffle,
+                seed=seed,
+                epoch=epoch,
+                drop_remainder=drop_remainder,
+                host_index=h,
+                host_count=host_count,
+            )
+        )
+        assert len(rerun) == len(per_host[h])
+        for a, c in zip(rerun, per_host[h]):
+            np.testing.assert_array_equal(a["x"], c["x"])
+
+    # Epoch keying of the PIPELINE itself: the next epoch's batches,
+    # concatenated, must differ from this epoch's (almost surely for
+    # n > 2; skip degenerate sizes and batchless cases).
+    if shuffle and n > 2 and per_host[0]:
+        next_epoch = list(
+            batch_iterator(
+                source,
+                None,
+                batch_size,
+                training=True,
+                shuffle=shuffle,
+                seed=seed,
+                epoch=epoch + 1,
+                drop_remainder=drop_remainder,
+                host_index=0,
+                host_count=host_count,
+            )
+        )
+        flat = np.concatenate([b["x"] for b in per_host[0]])
+        flat_next = np.concatenate([b["x"] for b in next_epoch])
+        if len(flat) > 2:
+            assert not np.array_equal(flat, flat_next), f"case={case_seed}"
