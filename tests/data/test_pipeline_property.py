@@ -123,6 +123,29 @@ def test_multihost_batches_match_permutation_slices(case_seed):
         for a, c in zip(rerun, per_host[h]):
             np.testing.assert_array_equal(a["x"], c["x"])
 
+    # Mid-epoch resume: start_batch=k yields exactly the [k:] suffix of
+    # the full epoch, bitwise (the exact-resume contract).
+    if per_host[0]:
+        k = rng.randrange(len(per_host[0]) + 1)
+        suffix = list(
+            batch_iterator(
+                source,
+                None,
+                batch_size,
+                training=True,
+                shuffle=shuffle,
+                seed=seed,
+                epoch=epoch,
+                drop_remainder=drop_remainder,
+                host_index=0,
+                host_count=host_count,
+                start_batch=k,
+            )
+        )
+        assert len(suffix) == len(per_host[0]) - k
+        for a, c in zip(suffix, per_host[0][k:]):
+            np.testing.assert_array_equal(a["x"], c["x"])
+
     # Epoch keying of the PIPELINE itself: the next epoch's batches,
     # concatenated, must differ from this epoch's (almost surely for
     # n > 2; skip degenerate sizes and batchless cases).

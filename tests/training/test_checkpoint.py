@@ -195,3 +195,94 @@ def test_keep_best_rank_saves_only_on_validated_epochs(tmp_path):
     # Saves at the end of epochs 2 and 4 only (2 steps/epoch -> 4, 8).
     assert steps == [4, 8]
     exp.checkpointer.close()
+
+
+def test_step_granular_save_and_exact_midepoch_resume(tmp_path):
+    """save_every_steps checkpoints INSIDE the epoch, and resuming from
+    a mid-epoch step replays exactly the remaining batches of that
+    epoch: the resumed run's final params are bit-identical to an
+    uninterrupted run's (the whole-pipeline determinism contract)."""
+    import jax
+
+    # Uninterrupted reference: 2 epochs x 4 steps.
+    ref = make_experiment(tmp_path / "ref", {"epochs": 2})
+    ref.run()
+    ref_params = jax.device_get(ref.final_state.params)
+    ref_step = int(jax.device_get(ref.final_state.step))
+    ref.checkpointer.close()
+
+    # Interrupted run: step saves only (epoch saves pushed out of
+    # reach), so after the "crash" the LATEST checkpoint is the
+    # mid-epoch step 3 of 4.
+    conf = {
+        "checkpointer.save_every_steps": 3,
+        "checkpointer.save_every_epochs": 99,
+    }
+    exp = make_experiment(tmp_path, {"epochs": 1, **conf})
+    exp.run()
+    assert exp.checkpointer.latest_step() == 3
+    exp.checkpointer.close()
+
+    exp2 = make_experiment(tmp_path, {"epochs": 2, **conf})
+    history = exp2.run()
+    assert int(jax.device_get(exp2.final_state.step)) == ref_step == 8
+    # Epoch 0 resumed mid-way (1 remaining step) + full epoch 1.
+    assert len(history["train"]) == 2
+    got = jax.device_get(exp2.final_state.params)
+    ref_leaves = jax.tree.leaves(ref_params)
+    got_leaves = jax.tree.leaves(got)
+    assert len(ref_leaves) == len(got_leaves)
+    for a, b in zip(ref_leaves, got_leaves):
+        np.testing.assert_array_equal(a, b)
+    # The resumed run's own step saves continued on the global-step
+    # grid (6; step 3 already existed, epoch boundaries excluded).
+    assert sorted(exp2.checkpointer._manager().all_steps()) == [3, 6]
+    exp2.checkpointer.close()
+
+
+def test_save_every_steps_rejects_best_ranking(tmp_path):
+    """Mid-epoch saves carry no fresh rankable metrics: combining
+    save_every_steps with keep_best_metric must fail loudly at run
+    start, not pin a metric-less save later."""
+    exp = make_experiment(
+        tmp_path,
+        {
+            "checkpointer.save_every_steps": 2,
+            "checkpointer.keep_best_metric": "accuracy",
+        },
+    )
+    with pytest.raises(ValueError, match="save_every_steps"):
+        exp.run()
+
+
+def test_step_saves_cover_epoch_boundaries_when_epoch_path_idle(tmp_path):
+    """A step-cadence save landing on an epoch boundary must still
+    happen when the save_every_epochs path won't fire that epoch — the
+    'loss bounded to N steps' promise has no epoch-shaped holes."""
+    exp = make_experiment(
+        tmp_path,
+        {
+            "epochs": 2,
+            "checkpointer.save_every_steps": 4,
+            "checkpointer.save_every_epochs": 99,
+        },
+    )
+    exp.run()  # spe=4: steps 4 and 8 are both boundaries.
+    assert sorted(exp.checkpointer._manager().all_steps()) == [4, 8]
+    exp.checkpointer.close()
+
+
+def test_step_save_defers_to_epoch_save_on_shared_step(tmp_path):
+    """When both cadences land on one step, exactly one save happens
+    (the epoch path's); a double save of one step would collide."""
+    exp = make_experiment(
+        tmp_path,
+        {
+            "epochs": 2,
+            "checkpointer.save_every_steps": 4,
+            "checkpointer.save_every_epochs": 1,
+        },
+    )
+    exp.run()
+    assert sorted(exp.checkpointer._manager().all_steps()) == [4, 8]
+    exp.checkpointer.close()

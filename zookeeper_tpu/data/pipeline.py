@@ -61,12 +61,19 @@ def batch_iterator(
     host_index: int = 0,
     host_count: int = 1,
     num_workers: int = 0,
+    start_batch: int = 0,
 ) -> Iterator[Batch]:
     """Yield batches of stacked numpy arrays from ``source``.
 
     ``batch_size`` is the *per-host* batch size; with ``host_count > 1`` each
     global batch of ``batch_size * host_count`` examples is split
     contiguously and this host materializes slice ``host_index``.
+
+    ``start_batch`` skips the first k global batches WITHOUT fetching
+    them — the epoch's permutation is (seed, epoch)-fixed, so batch k
+    onward is identical to an uninterrupted epoch's. This is the exact
+    mid-epoch-resume hook (a step-granular checkpoint restores at
+    ``step % steps_per_epoch == k``).
     """
     n = len(source)
     if n == 0:
@@ -116,7 +123,7 @@ def batch_iterator(
         from zookeeper_tpu import native
 
         spec, img, lbl = native_spec
-        for b in range(num_batches):
+        for b in range(start_batch, num_batches):
             start = b * global_batch + host_index * batch_size
             stop = min(start + batch_size, n, (b + 1) * global_batch)
             if stop <= start:
@@ -141,7 +148,7 @@ def batch_iterator(
 
     pool = ThreadPoolExecutor(num_workers) if num_workers > 0 else None
     try:
-        for b in range(num_batches):
+        for b in range(start_batch, num_batches):
             start = b * global_batch + host_index * batch_size
             stop = min(start + batch_size, n, (b + 1) * global_batch)
             indices = range(start, stop)
@@ -303,11 +310,14 @@ class DataLoader:
         epoch: int = 0,
         sharding: Optional[Any] = None,
         training: Optional[bool] = None,
+        start_batch: int = 0,
     ) -> Iterator[Any]:
         """``training=None`` infers train-mode behavior (shuffle, augment,
         drop-remainder) from the split name; pass ``training=False`` to
         iterate the train split in eval mode (e.g. scoring a checkpoint
-        on training data: deterministic order, no augmentation)."""
+        on training data: deterministic order, no augmentation).
+        ``start_batch`` resumes the (deterministic) epoch mid-way — see
+        :func:`batch_iterator`."""
         if training is None:
             training = split == "train"
         source = self._source(split)
@@ -326,6 +336,7 @@ class DataLoader:
             host_index=hi,
             host_count=hc,
             num_workers=self.num_workers,
+            start_batch=start_batch,
         )
         if self.prefetch > 0:
             return prefetch_to_device(it, size=self.prefetch, sharding=sharding)

@@ -39,6 +39,15 @@ class Checkpointer:
     directory: Optional[str] = Field(None)
     max_to_keep: int = Field(3)
     save_every_epochs: int = Field(1)
+    #: Also save every N train STEPS (0 = off). For workloads whose
+    #: epochs take hours (ImageNet-scale), epoch-boundary saves alone
+    #: leave a crash losing up to an epoch of work; step saves bound the
+    #: loss to N steps, and resume is EXACT mid-epoch (the pipeline's
+    #: (seed, epoch)-fixed permutation replays from ``step %
+    #: steps_per_epoch`` — `DataLoader.batches(start_batch=...)`).
+    #: Incompatible with ``keep_best_metric`` (mid-epoch saves carry no
+    #: fresh rankable metrics; the experiment rejects the combination).
+    save_every_steps: int = Field(0)
     #: Resume from the latest checkpoint in ``directory`` when present.
     restore: bool = Field(True)
     #: Block on save (tests); async otherwise.

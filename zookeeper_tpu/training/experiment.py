@@ -251,6 +251,17 @@ class TrainingExperiment(Experiment):
                 f"early_stop_mode={self.early_stop_mode!r} unknown; "
                 "choose auto/min/max."
             )
+        if (
+            self.checkpointer.save_every_steps > 0
+            and self.checkpointer.keep_best_metric is not None
+        ):
+            # Pure config: fail before device setup / compilation.
+            raise ValueError(
+                "checkpointer.save_every_steps is incompatible with "
+                "keep_best_metric: mid-epoch saves carry no fresh "
+                "rankable metrics (best-ranking pins every save to a "
+                "metric). Use one or the other."
+            )
         if self.validate_every < 1:
             # Fail fast rather than guess: 0 commonly means "disable" in
             # every-N conventions, but validate=False is the explicit
@@ -298,11 +309,19 @@ class TrainingExperiment(Experiment):
         batch_sharding = partitioner.batch_sharding()
 
         spe = self._steps_per_epoch()
-        start_epoch = int(jax.device_get(state.step)) // max(1, spe)
-        if start_epoch > 0:
+        start_step = int(jax.device_get(state.step))
+        start_epoch = start_step // max(1, spe)
+        # Steps already trained within the resumed epoch (nonzero only
+        # for step-granular checkpoints): the epoch's permutation is
+        # (seed, epoch)-fixed, so skipping the first k batches resumes
+        # EXACTLY where the crashed run left off.
+        resume_step = start_step % max(1, spe)
+        if start_step > 0:
             self._log(
-                f"resumed from checkpoint at step "
-                f"{int(jax.device_get(state.step))} (epoch {start_epoch})"
+                f"resumed from checkpoint at step {start_step} "
+                f"(epoch {start_epoch}"
+                + (f", step {resume_step} within it" if resume_step else "")
+                + ")"
             )
         history: Dict[str, List[Dict[str, float]]] = {"train": [], "validation": []}
         # One presence probe, not one per epoch: dataset.validation()
@@ -321,25 +340,57 @@ class TrainingExperiment(Experiment):
             for epoch in range(start_epoch, self.epochs):
                 t0 = time.perf_counter()
                 accum: List[Any] = []
+                # Mid-epoch resume: skip the already-trained prefix of
+                # the FIRST epoch only; step_idx stays epoch-absolute so
+                # logging/writer steps and the spe cutoff are unchanged.
+                start_b = resume_step if epoch == start_epoch else 0
                 profiling = self.profile_dir is not None and epoch == start_epoch
+                # Trace window, anchored at the first step this run
+                # actually executes (warmup steps excluded).
+                p_start = min(start_b + 4, spe - 1)
+                p_stop = min(start_b + 14, spe - 1)
                 for step_idx, batch in enumerate(
-                    self.loader.batches("train", epoch=epoch, sharding=batch_sharding)
+                    self.loader.batches(
+                        "train",
+                        epoch=epoch,
+                        sharding=batch_sharding,
+                        start_batch=start_b,
+                    ),
+                    start=start_b,
                 ):
                     if step_idx >= spe:
                         break
-                    if profiling and step_idx == min(4, spe - 1):
+                    if profiling and step_idx == p_start:
                         jax.profiler.start_trace(self.profile_dir)
                     state, metrics = train_step(state, batch)
                     accum.append(metrics)
-                    if profiling and step_idx == min(14, spe - 1):
+                    if profiling and step_idx == p_stop:
                         jax.block_until_ready(metrics["loss"])
                         jax.profiler.stop_trace()
                         profiling = False
-                        # Steps min(4,..)..min(14,..) run INSIDE the
-                        # trace window, inclusive on both ends.
-                        self._log_profile_breakdown(
-                            min(14, spe - 1) - min(4, spe - 1) + 1
+                        # Steps p_start..p_stop run INSIDE the trace
+                        # window, inclusive on both ends.
+                        self._log_profile_breakdown(p_stop - p_start + 1)
+                    if (
+                        self.checkpointer.enabled
+                        and self.checkpointer.save_every_steps > 0
+                        and (epoch * spe + step_idx + 1)
+                        % self.checkpointer.save_every_steps
+                        == 0
+                        and (
+                            step_idx + 1 < spe
+                            or (epoch + 1)
+                            % self.checkpointer.save_every_epochs
+                            != 0
                         )
+                    ):
+                        # An epoch-boundary step defers to the
+                        # save_every_epochs path below ONLY when that
+                        # path will actually fire this epoch (a double
+                        # save of one step would collide in orbax);
+                        # otherwise the step cadence must still hold —
+                        # that's the "loss bounded to N steps" promise.
+                        self.checkpointer.save(state)
                     if self.log_every and (step_idx + 1) % self.log_every == 0:
                         m = {k: float(v) for k, v in metrics.items()}
                         self._log(
