@@ -216,7 +216,7 @@ def test_step_granular_save_and_exact_midepoch_resume(tmp_path):
     # mid-epoch step 3 of 4.
     conf = {
         "checkpointer.save_every_steps": 3,
-        "checkpointer.save_every_epochs": 99,
+        "checkpointer.save_every_epochs": 0,
     }
     exp = make_experiment(tmp_path, {"epochs": 1, **conf})
     exp.run()
@@ -264,7 +264,7 @@ def test_step_saves_cover_epoch_boundaries_when_epoch_path_idle(tmp_path):
         {
             "epochs": 2,
             "checkpointer.save_every_steps": 4,
-            "checkpointer.save_every_epochs": 99,
+            "checkpointer.save_every_epochs": 0,
         },
     )
     exp.run()  # spe=4: steps 4 and 8 are both boundaries.

@@ -38,6 +38,9 @@ class Checkpointer:
 
     directory: Optional[str] = Field(None)
     max_to_keep: int = Field(3)
+    #: Save at every Nth epoch boundary; 0 disables epoch-boundary
+    #: saves entirely (step-cadence-only checkpointing via
+    #: ``save_every_steps``).
     save_every_epochs: int = Field(1)
     #: Also save every N train STEPS (0 = off). For workloads whose
     #: epochs take hours (ImageNet-scale), epoch-boundary saves alone

@@ -252,6 +252,14 @@ class TrainingExperiment(Experiment):
                 "choose auto/min/max."
             )
         if (
+            self.checkpointer.save_every_epochs < 0
+            or self.checkpointer.save_every_steps < 0
+        ):
+            raise ValueError(
+                "checkpointer.save_every_epochs/save_every_steps must be "
+                ">= 0 (0 disables that cadence)."
+            )
+        if (
             self.checkpointer.save_every_steps > 0
             and self.checkpointer.keep_best_metric is not None
         ):
@@ -379,6 +387,7 @@ class TrainingExperiment(Experiment):
                         == 0
                         and (
                             step_idx + 1 < spe
+                            or self.checkpointer.save_every_epochs == 0
                             or (epoch + 1)
                             % self.checkpointer.save_every_epochs
                             != 0
@@ -467,6 +476,7 @@ class TrainingExperiment(Experiment):
 
                 if (
                     self.checkpointer.enabled
+                    and self.checkpointer.save_every_epochs > 0
                     and (epoch + 1) % self.checkpointer.save_every_epochs == 0
                 ):
                     if (
