@@ -286,3 +286,40 @@ def test_step_save_defers_to_epoch_save_on_shared_step(tmp_path):
     exp.run()
     assert sorted(exp.checkpointer._manager().all_steps()) == [4, 8]
     exp.checkpointer.close()
+
+
+def test_midepoch_resume_bit_exact_under_dp_sharding(tmp_path):
+    """The sharded interaction: restore_state() of a step-granular
+    checkpoint onto a DataParallel mesh + the pipeline's start_batch
+    skip must still be bit-identical to an uninterrupted DP run (the
+    single-device variant above doesn't cover sharded restore)."""
+    import jax
+
+    if jax.device_count() < 2:
+        pytest.skip("needs the multi-device CPU mesh")
+    dp = {"partitioner": "DataParallelPartitioner", "batch_size": 32}
+
+    ref = make_experiment(tmp_path / "ref", {"epochs": 2, **dp})
+    ref.run()
+    ref_params = jax.device_get(ref.final_state.params)
+    ref.checkpointer.close()
+
+    conf = {
+        "checkpointer.save_every_steps": 3,
+        "checkpointer.save_every_epochs": 0,
+        **dp,
+    }
+    exp = make_experiment(tmp_path, {"epochs": 1, **conf})
+    exp.run()
+    assert exp.checkpointer.latest_step() == 3  # mid-epoch (spe=4)
+    exp.checkpointer.close()
+
+    exp2 = make_experiment(tmp_path, {"epochs": 2, **conf})
+    exp2.run()
+    assert int(jax.device_get(exp2.final_state.step)) == 8
+    for a, b in zip(
+        jax.tree.leaves(ref_params),
+        jax.tree.leaves(jax.device_get(exp2.final_state.params)),
+    ):
+        np.testing.assert_array_equal(a, b)
+    exp2.checkpointer.close()
