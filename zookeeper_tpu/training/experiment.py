@@ -230,6 +230,26 @@ class TrainingExperiment(Experiment):
         DistillationExperiment adds a teacher term)."""
         return make_train_step(**self._train_step_kwargs())
 
+    def _step_save_due(self, epoch: int, step_idx: int, spe: int) -> bool:
+        """Whether the step-cadence checkpoint fires after this step.
+
+        An epoch-boundary step defers to the save_every_epochs path
+        ONLY when that path will actually fire this epoch (a double
+        save of one step would collide in orbax); otherwise the step
+        cadence must still hold — that's the "loss bounded to N steps"
+        promise (0 = cadence disabled, both knobs).
+        """
+        ck = self.checkpointer
+        if not (ck.enabled and ck.save_every_steps > 0):
+            return False
+        if (epoch * spe + step_idx + 1) % ck.save_every_steps != 0:
+            return False
+        epoch_save_fires = (
+            ck.save_every_epochs > 0
+            and (epoch + 1) % ck.save_every_epochs == 0
+        )
+        return step_idx + 1 < spe or not epoch_save_fires
+
     def run(self) -> Dict[str, List[Dict[str, float]]]:
         import jax
         import jax.numpy as jnp
@@ -379,26 +399,7 @@ class TrainingExperiment(Experiment):
                         # Steps p_start..p_stop run INSIDE the trace
                         # window, inclusive on both ends.
                         self._log_profile_breakdown(p_stop - p_start + 1)
-                    if (
-                        self.checkpointer.enabled
-                        and self.checkpointer.save_every_steps > 0
-                        and (epoch * spe + step_idx + 1)
-                        % self.checkpointer.save_every_steps
-                        == 0
-                        and (
-                            step_idx + 1 < spe
-                            or self.checkpointer.save_every_epochs == 0
-                            or (epoch + 1)
-                            % self.checkpointer.save_every_epochs
-                            != 0
-                        )
-                    ):
-                        # An epoch-boundary step defers to the
-                        # save_every_epochs path below ONLY when that
-                        # path will actually fire this epoch (a double
-                        # save of one step would collide in orbax);
-                        # otherwise the step cadence must still hold —
-                        # that's the "loss bounded to N steps" promise.
+                    if self._step_save_due(epoch, step_idx, spe):
                         self.checkpointer.save(state)
                     if self.log_every and (step_idx + 1) % self.log_every == 0:
                         m = {k: float(v) for k, v in metrics.items()}
