@@ -88,3 +88,51 @@ def test_find_subclass_by_name():
 )
 def test_parse_value(raw, expected):
     assert utils.parse_value(raw) == expected
+
+
+def test_parse_value_round_trips_random_literals():
+    """Property: any python literal survives repr -> parse_value (the
+    CLI's key=value grammar is exactly ast.literal_eval + string
+    fallback), across randomized nesting."""
+    import random
+
+    from zookeeper_tpu.core.utils import parse_value
+
+    rng = random.Random(7)
+
+    def gen_literal(depth=0):
+        kinds = ["int", "float", "str", "bool", "none"]
+        if depth < 2:
+            kinds += ["tuple", "list", "dict"]
+        kind = rng.choice(kinds)
+        if kind == "int":
+            return rng.randrange(-(10**9), 10**9)
+        if kind == "float":
+            # round() keeps repr exact; NaN/inf are not literals.
+            return round(rng.uniform(-1e6, 1e6), 6)
+        if kind == "str":
+            return "".join(
+                rng.choice("abz_ 0-.'\"\\") for _ in range(rng.randrange(8))
+            )
+        if kind == "bool":
+            return rng.random() < 0.5
+        if kind == "none":
+            return None
+        if kind == "tuple":
+            return tuple(
+                gen_literal(depth + 1) for _ in range(rng.randrange(4))
+            )
+        if kind == "list":
+            return [gen_literal(depth + 1) for _ in range(rng.randrange(4))]
+        return {
+            f"k{i}": gen_literal(depth + 1) for i in range(rng.randrange(3))
+        }
+
+    for _ in range(300):
+        value = gen_literal()
+        assert parse_value(repr(value)) == value, repr(value)
+
+    # The string fallback: bare words (not valid literals) come back
+    # verbatim, which is what makes `dataset=Mnist` work unquoted.
+    for word in ("Mnist", "quicknet_large", "path/to/dir", "3x3", "a=b"):
+        assert parse_value(word) == word
