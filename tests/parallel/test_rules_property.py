@@ -1,0 +1,126 @@
+"""Property-based tests for the FSDP rule generator + rule matcher
+(`parallel/rules.py`) over randomized param trees.
+
+The hand-written suites pin the rules on real models; this module
+generates random nested trees with collision-PRONE names (suffix
+shadowing: "Dense_0/kernel" vs "Head_0/Dense_0/kernel"; same-segment
+prefixes: "Dense_0" inside "QuantDense_0") and checks every leaf's
+assigned PartitionSpec against an independent oracle of the documented
+contract:
+
+- shard iff size >= min_weight_size AND rank >= 2 AND some dim is
+  axis_size-divisible AND not force-replicated;
+- the sharded dim is the largest divisible one, ties to the trailing;
+- deep paths are never captured by a strict-suffix rule of a shallower
+  param, and optimizer-moment trees (same paths under an extra prefix)
+  co-shard with their parameter.
+"""
+
+import random
+from math import prod
+
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec
+
+from zookeeper_tpu.parallel.rules import (
+    auto_fsdp_rules,
+    match_partition_rules,
+)
+
+MODULES = ("Dense_0", "QuantDense_0", "Head_0", "Conv_1", "BatchNorm_0")
+LEAVES = ("kernel", "bias", "kernel_scale", "scale")
+
+
+def gen_tree(rng: random.Random, depth=0):
+    tree = {}
+    for leaf in rng.sample(LEAVES, rng.randrange(1, len(LEAVES) + 1)):
+        rank = rng.randrange(0, 5)
+        shape = tuple(
+            rng.choice((1, 2, 3, 8, 16, 64, 96, 128))
+            for _ in range(rank)
+        )
+        tree[leaf] = np.zeros(shape, np.float32)
+    if depth < 2:
+        for mod in rng.sample(MODULES, rng.randrange(0, 3)):
+            tree[mod] = gen_tree(rng, depth + 1)
+    return tree
+
+
+def flatten(tree):
+    from flax import traverse_util
+
+    return traverse_util.flatten_dict(tree, sep="/").items()
+
+
+def expected_spec(shape, axis_size, min_size, rank_floor=2):
+    size = prod(shape) if shape else 0
+    if size < min_size or len(shape) < rank_floor:
+        return PartitionSpec()
+    best = None
+    for i, d in enumerate(shape):
+        if d % axis_size == 0 and (best is None or d >= shape[best]):
+            best = i
+    if best is None:
+        return PartitionSpec()
+    return PartitionSpec(
+        *["fsdp" if i == best else None for i in range(len(shape))]
+    )
+
+
+@pytest.mark.parametrize("seed", range(25))
+def test_auto_fsdp_rules_match_oracle_on_random_trees(seed):
+    rng = random.Random(seed)
+    tree = gen_tree(rng)
+    # Deliberate shadowing structure on every tree: a top-level param
+    # whose path is a strict suffix of a deeper one, and a same-segment
+    # prefix trap.
+    tree.setdefault("Dense_0", {})["kernel"] = np.zeros(
+        (64, 128), np.float32
+    )
+    tree.setdefault("Head_0", {}).setdefault("Dense_0", {})["kernel"] = (
+        np.zeros((96, 2), np.float32)
+    )
+    tree.setdefault("QuantDense_0", {})["kernel"] = np.zeros(
+        (3, 3), np.float32
+    )
+
+    axis_size = rng.choice((2, 4, 8))
+    min_size = rng.choice((1, 64, 2**15))
+    rules = auto_fsdp_rules(tree, axis_size, min_weight_size=min_size)
+    specs = match_partition_rules(rules, tree)
+
+    flat_specs = dict(flatten(specs))
+    for path, leaf in flatten(tree):
+        want = expected_spec(leaf.shape, axis_size, min_size)
+        assert flat_specs[path] == want, (
+            f"seed={seed} path={path} shape={leaf.shape} "
+            f"axis={axis_size} min={min_size}"
+        )
+        # Any sharded dim must actually be divisible.
+        for dim, name in zip(leaf.shape, flat_specs[path]):
+            if name is not None:
+                assert dim % axis_size == 0
+
+    # Optimizer-moment co-sharding: the same paths under extra prefixes
+    # (how Adam's mu/nu and EMA copies appear in full state paths) get
+    # identical specs from the SAME rules.
+    moments = {"opt": {"mu": tree}}
+    mspecs = dict(flatten(match_partition_rules(rules, moments)))
+    for path, leaf in flatten(tree):
+        assert mspecs[f"opt/mu/{path}"] == flat_specs[path], (
+            f"seed={seed} path={path}"
+        )
+
+
+def test_replicate_patterns_force_replication():
+    tree = {
+        "Stem_0": {"kernel": np.zeros((128, 128), np.float32)},
+        "Body_0": {"kernel": np.zeros((128, 128), np.float32)},
+    }
+    rules = auto_fsdp_rules(
+        tree, 2, min_weight_size=1, replicate_patterns=(r"^Stem_0/",)
+    )
+    specs = dict(flatten(match_partition_rules(rules, tree)))
+    assert specs["Stem_0/kernel"] == PartitionSpec()
+    assert specs["Body_0/kernel"] == PartitionSpec(None, "fsdp")
