@@ -1,0 +1,122 @@
+"""Property-based tests for the STE quantizer family over random
+inputs (the hand-written suite pins exact values at chosen points; this
+sweeps randomized tensors away from the surrogate boundaries and checks
+transform consistency, which point tests can't).
+
+Properties per quantizer:
+- forward lands exactly on the documented level set;
+- the custom_vjp gradient matches an independent numpy oracle of the
+  published surrogate (indicator-family quantizers; inputs sampled away
+  from the clip boundaries where the <=/< convention is pinned by the
+  point tests instead);
+- grad-under-jit == grad == grad-under-vmap (custom_vjp must be
+  transform-transparent — the property that actually matters when the
+  quantizer sits inside a pjit'd train step).
+"""
+
+import random
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from zookeeper_tpu.ops import (
+    approx_sign,
+    dorefa,
+    ste_heaviside,
+    ste_sign,
+    ste_tern,
+)
+
+
+def rand_x(rng, shape, margin=0.05):
+    """Uniform in [-2, 2], nudged away from the surrogate boundaries
+    (|x| = 1 for the sign family, {0, 1} for dorefa, threshold for
+    tern) so the oracle never straddles a <=/< convention."""
+    x = rng.uniform(-2.0, 2.0, size=shape)
+    for b in (-1.0, 0.0, 1.0):
+        near = np.abs(x - b) < margin
+        x = np.where(near, x + 2 * margin, x)
+    return x.astype(np.float32)
+
+
+CASES = [
+    (
+        "ste_sign",
+        lambda x: ste_sign(x),
+        lambda x: np.where(x >= 0, 1.0, -1.0),
+        lambda x: (np.abs(x) <= 1.0).astype(np.float32),
+    ),
+    (
+        "approx_sign",
+        lambda x: approx_sign(x),
+        lambda x: np.where(x >= 0, 1.0, -1.0),
+        lambda x: np.where(np.abs(x) <= 1.0, 2.0 - 2.0 * np.abs(x), 0.0),
+    ),
+    (
+        "ste_heaviside",
+        lambda x: ste_heaviside(x),
+        lambda x: (x > 0).astype(np.float32),
+        lambda x: (np.abs(x) <= 1.0).astype(np.float32),
+    ),
+    (
+        "ste_tern",
+        lambda x: ste_tern(x, 0.3, False),
+        lambda x: np.where(x > 0.3, 1.0, np.where(x < -0.3, -1.0, 0.0)),
+        lambda x: (np.abs(x) <= 1.0).astype(np.float32),
+    ),
+    (
+        "dorefa2",
+        lambda x: dorefa(x, 2),
+        # Half-UP like the implementation (floor(x*n + 0.5) — NOT
+        # np.round, whose half-to-even convention differs at the level
+        # midpoints), same float32 arithmetic on both sides.
+        lambda x: np.floor(
+            np.clip(x, 0.0, 1.0).astype(np.float32) * np.float32(3.0)
+            + np.float32(0.5)
+        )
+        / np.float32(3.0),
+        lambda x: ((x >= 0.0) & (x <= 1.0)).astype(np.float32),
+    ),
+]
+
+
+@pytest.mark.parametrize("seed", range(8))
+@pytest.mark.parametrize("name,fn,fwd_oracle,grad_oracle", CASES)
+def test_quantizer_forward_and_grad_match_oracle(
+    seed, name, fn, fwd_oracle, grad_oracle
+):
+    rng = np.random.default_rng(seed)
+    shape = random.Random(seed).choice(((7,), (3, 5), (2, 3, 4)))
+    x = rand_x(rng, shape)
+    if name == "ste_tern":
+        # Keep clear of this case's +-0.3 thresholds too.
+        x = np.where(np.abs(np.abs(x) - 0.3) < 0.05, x + 0.1, x)
+
+    xj = jnp.asarray(x)
+    np.testing.assert_allclose(
+        np.asarray(fn(xj)), fwd_oracle(x), atol=1e-6, err_msg=name
+    )
+
+    # Cotangent-weighted VJP against the oracle: grad of sum(fn * w)
+    # must be w * surrogate'(x) elementwise (checks the vjp actually
+    # scales the incoming cotangent, not just the mask).
+    w = rng.uniform(-1.0, 1.0, size=shape).astype(np.float32)
+    wj = jnp.asarray(w)
+    g = jax.grad(lambda v: (fn(v) * wj).sum())(xj)
+    np.testing.assert_allclose(
+        np.asarray(g), w * grad_oracle(x), atol=1e-5, err_msg=name
+    )
+
+    # Transform transparency: identical under jit and vmap (leading
+    # axis) — the composition a pjit'd train step relies on.
+    g_jit = jax.jit(jax.grad(lambda v: (fn(v) * wj).sum()))(xj)
+    np.testing.assert_allclose(np.asarray(g_jit), np.asarray(g), err_msg=name)
+    if len(shape) > 1:
+        g_vmap = jax.vmap(
+            jax.grad(lambda v, ww: (fn(v) * ww).sum()), in_axes=(0, 0)
+        )(xj, wj)
+        np.testing.assert_allclose(
+            np.asarray(g_vmap), np.asarray(g), atol=1e-6, err_msg=name
+        )
