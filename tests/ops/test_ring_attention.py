@@ -1,0 +1,101 @@
+"""Ring attention vs full attention on the virtual CPU mesh: exact
+sequence-parallel attention (values AND gradients) — the working proof
+that the mesh API's "SP could be added without redesign" claim holds.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from zookeeper_tpu.ops import attention_reference, ring_attention
+
+
+def _mesh(n):
+    if jax.device_count() < n:
+        pytest.skip(f"needs {n} devices")
+    return Mesh(np.array(jax.devices()[:n]), ("sp",))
+
+
+def _qkv(seed, b=2, s=32, h=2, d=8, dtype=jnp.float32):
+    rng = np.random.default_rng(seed)
+    mk = lambda: jnp.asarray(
+        rng.normal(size=(b, s, h, d)).astype(np.float32), dtype
+    )
+    return mk(), mk(), mk()
+
+
+@pytest.mark.parametrize("n", [1, 2, 8])
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_matches_full_attention(n, causal):
+    mesh = _mesh(n)
+    q, k, v = _qkv(seed=n * 10 + causal)
+    ref = attention_reference(q, k, v, causal=causal)
+    out = ring_attention(q, k, v, mesh=mesh, seq_axis="sp", causal=causal)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5
+    )
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_gradients_match_full_attention(causal):
+    mesh = _mesh(8)
+    q, k, v = _qkv(seed=42 + causal)
+    w = jnp.asarray(
+        np.random.default_rng(3).normal(size=q.shape).astype(np.float32)
+    )
+
+    def loss_ref(q, k, v):
+        return (attention_reference(q, k, v, causal=causal) * w).sum()
+
+    def loss_ring(q, k, v):
+        return (
+            ring_attention(
+                q, k, v, mesh=mesh, seq_axis="sp", causal=causal
+            )
+            * w
+        ).sum()
+
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    g_ring = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(g_ring, g_ref):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b_), atol=5e-5, rtol=5e-5
+        )
+
+
+def test_ring_bf16_close_to_fp32_reference():
+    mesh = _mesh(8)
+    q, k, v = _qkv(seed=7, dtype=jnp.bfloat16)
+    ref = attention_reference(
+        q.astype(jnp.float32), k.astype(jnp.float32), v.astype(jnp.float32)
+    )
+    out = ring_attention(q, k, v, mesh=mesh, seq_axis="sp")
+    assert out.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref), atol=3e-2
+    )
+
+
+def test_ring_rejects_indivisible_sequence():
+    mesh = _mesh(8)
+    q, k, v = _qkv(seed=0, s=30)
+    with pytest.raises(ValueError, match="does not divide"):
+        ring_attention(q, k, v, mesh=mesh, seq_axis="sp")
+
+
+def test_ring_composes_under_jit():
+    mesh = _mesh(8)
+    q, k, v = _qkv(seed=11)
+    f = jax.jit(
+        lambda q, k, v: ring_attention(
+            q, k, v, mesh=mesh, seq_axis="sp", causal=True
+        )
+    )
+    np.testing.assert_allclose(
+        np.asarray(f(q, k, v)),
+        np.asarray(attention_reference(q, k, v, causal=True)),
+        atol=2e-5,
+        rtol=2e-5,
+    )

@@ -57,9 +57,17 @@ from zookeeper_tpu.ops.binary_compute import (
     xnor_matmul,
     xnor_matmul_packed,
 )
+from zookeeper_tpu.ops.attention import (
+    attention_reference,
+    ring_attention,
+    ring_attention_local,
+)
 from zookeeper_tpu.ops.packed import pack_quantconv_params, quantized_param_view
 
 __all__ = [
+    "attention_reference",
+    "ring_attention",
+    "ring_attention_local",
     "conv_dim_numbers",
     "int8_conv",
     "int8_conv_transpose",
