@@ -99,3 +99,27 @@ def test_ring_composes_under_jit():
         atol=2e-5,
         rtol=2e-5,
     )
+
+
+def test_ring_composes_with_data_parallel_mesh():
+    """The realistic pod layout: batch over 'data' x sequence over 'sp'
+    on a (2, 4) mesh — each data-shard runs an independent ring."""
+    if jax.device_count() < 8:
+        pytest.skip("needs 8 devices")
+    mesh = Mesh(
+        np.array(jax.devices()[:8]).reshape(2, 4), ("data", "sp")
+    )
+    q, k, v = _qkv(seed=5, b=4, s=16)
+    ref = attention_reference(q, k, v, causal=True)
+    out = ring_attention(
+        q, k, v, mesh=mesh, seq_axis="sp", batch_axis="data", causal=True
+    )
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5
+    )
+
+    with pytest.raises(ValueError, match="Batch"):
+        ring_attention(
+            _qkv(seed=5, b=3, s=16)[0], k[:3], v[:3],
+            mesh=mesh, seq_axis="sp", batch_axis="data",
+        )

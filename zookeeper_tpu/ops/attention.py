@@ -146,19 +146,17 @@ def ring_attention_local(
         v_blk = lax.ppermute(v_blk, axis_name, perm)
         return (k_blk, v_blk, t + 1, m, l, acc), None
 
-    def as_varying(x):
-        # Under shard_map's varying-manual-axes tracking (jax >= 0.7),
-        # a constant initial carry must be marked device-varying to
-        # match the loop outputs (which depend on the local q shard);
-        # older versions have no such tracking and need nothing.
-        try:
-            return lax.pcast(x, (axis_name,), to="varying")
-        except (AttributeError, TypeError):  # pragma: no cover - shim
-            return x
-
-    m0 = as_varying(jnp.full((b, h, sq), _MASK_VALUE, jnp.float32))
-    l0 = as_varying(jnp.zeros((b, h, sq), jnp.float32))
-    acc0 = as_varying(jnp.zeros((b, h, sq, d), jnp.float32))
+    # Initial carries DERIVED from qf (zero-cost arithmetic): under
+    # shard_map's varying-manual-axes tracking, a scan's carry must
+    # enter with the same device-varyingness its outputs have. The
+    # outputs inherit qf's (varying over the ring axis AND any batch
+    # axis of a dp x sp mesh); deriving the zeros from qf gives the
+    # init identical provenance on every mesh shape, with no
+    # version-specific pcast/pvary API.
+    zeros_like_q = qf * jnp.float32(0.0)  # [b,h,sq,d]
+    m0 = zeros_like_q[..., 0] + jnp.float32(_MASK_VALUE)
+    l0 = zeros_like_q[..., 0]
+    acc0 = zeros_like_q
     (_, _, _, m, l, acc), _ = lax.scan(
         step, (k, v, jnp.int32(0), m0, l0, acc0), None, length=n
     )
@@ -173,6 +171,7 @@ def ring_attention(
     *,
     mesh,
     seq_axis: str,
+    batch_axis: Optional[str] = None,
     causal: bool = False,
     scale: Optional[float] = None,
 ) -> jax.Array:
@@ -181,6 +180,9 @@ def ring_attention(
 
     ``q/k/v`` are GLOBAL ``[batch, seq, heads, head_dim]`` arrays (or
     already-sharded global views); seq must divide by the axis size.
+    ``batch_axis`` additionally shards the batch dim (the realistic
+    dp x sp pod layout — attention is batch-elementwise, so each
+    data-shard runs its own independent ring over ``seq_axis``).
     """
     from jax.sharding import PartitionSpec as P
 
@@ -194,7 +196,12 @@ def ring_attention(
             f"Sequence length {q.shape[1]} does not divide the "
             f"'{seq_axis}' axis size {mesh.shape[seq_axis]}."
         )
-    spec = P(None, seq_axis, None, None)
+    if batch_axis is not None and q.shape[0] % mesh.shape[batch_axis] != 0:
+        raise ValueError(
+            f"Batch {q.shape[0]} does not divide the "
+            f"'{batch_axis}' axis size {mesh.shape[batch_axis]}."
+        )
+    spec = P(batch_axis, seq_axis, None, None)
     fn = shard_map(
         partial(
             ring_attention_local,
