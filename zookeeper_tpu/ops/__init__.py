@@ -58,6 +58,8 @@ from zookeeper_tpu.ops.binary_compute import (
     xnor_matmul_packed,
 )
 from zookeeper_tpu.ops.attention import (
+    all_to_all_attention,
+    all_to_all_attention_local,
     attention_reference,
     ring_attention,
     ring_attention_local,
@@ -65,6 +67,8 @@ from zookeeper_tpu.ops.attention import (
 from zookeeper_tpu.ops.packed import pack_quantconv_params, quantized_param_view
 
 __all__ = [
+    "all_to_all_attention",
+    "all_to_all_attention_local",
     "attention_reference",
     "ring_attention",
     "ring_attention_local",

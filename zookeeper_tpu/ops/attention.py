@@ -164,6 +164,66 @@ def ring_attention_local(
     return out.transpose(0, 2, 1, 3).astype(q.dtype)
 
 
+def all_to_all_attention_local(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis_name: str,
+    causal: bool = False,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Ulysses-style sequence parallelism (the brief's OTHER named SP
+    flavor): instead of streaming K/V around a ring, one
+    ``lax.all_to_all`` re-shards from sequence-sharded
+    ``[b, s/n, h, d]`` to HEAD-sharded ``[b, s, h/n, d]``, runs plain
+    dense attention locally (each device owns whole heads, so causal
+    masking needs no global-position bookkeeping), and a second
+    all_to_all re-shards back. Four all_to_all collectives per call
+    (q, k, v in; out back) vs the ring's 2n ppermutes (K and V per
+    step) — cheaper at moderate sequence lengths; the ring wins when
+    even one head's full-sequence scores would not fit. Requires
+    ``heads % axis_size == 0``.
+    """
+    n = lax.psum(1, axis_name)
+    if q.shape[2] % n != 0:
+        raise ValueError(
+            f"heads={q.shape[2]} is not divisible by the '{axis_name}' "
+            f"axis size {n}, which all-to-all (Ulysses) attention needs "
+            "to give every device whole heads."
+        )
+    a2a = partial(lax.all_to_all, axis_name=axis_name, tiled=True)
+    out = attention_reference(
+        a2a(q, split_axis=2, concat_axis=1),
+        a2a(k, split_axis=2, concat_axis=1),
+        a2a(v, split_axis=2, concat_axis=1),
+        causal=causal,
+        scale=scale,
+    )
+    return a2a(out, split_axis=1, concat_axis=2)
+
+
+def all_to_all_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    mesh,
+    seq_axis: str,
+    batch_axis: Optional[str] = None,
+    causal: bool = False,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """One-call Ulysses attention — same contract as
+    :func:`ring_attention` (global arrays, sequence sharded over
+    ``seq_axis``, optional ``batch_axis``), different comm pattern."""
+    return _sharded_attention_call(
+        all_to_all_attention_local, q, k, v,
+        mesh=mesh, seq_axis=seq_axis, batch_axis=batch_axis,
+        causal=causal, scale=scale,
+    )
+
+
 def ring_attention(
     q: jax.Array,
     k: jax.Array,
@@ -184,6 +244,16 @@ def ring_attention(
     dp x sp pod layout — attention is batch-elementwise, so each
     data-shard runs its own independent ring over ``seq_axis``).
     """
+    return _sharded_attention_call(
+        ring_attention_local, q, k, v,
+        mesh=mesh, seq_axis=seq_axis, batch_axis=batch_axis,
+        causal=causal, scale=scale,
+    )
+
+
+def _sharded_attention_call(
+    local_fn, q, k, v, *, mesh, seq_axis, batch_axis, causal, scale
+):
     from jax.sharding import PartitionSpec as P
 
     try:  # jax >= 0.4.35 moved shard_map out of experimental.
@@ -204,7 +274,7 @@ def ring_attention(
     spec = P(batch_axis, seq_axis, None, None)
     fn = shard_map(
         partial(
-            ring_attention_local,
+            local_fn,
             axis_name=seq_axis,
             causal=causal,
             scale=scale,
