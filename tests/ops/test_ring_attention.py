@@ -194,3 +194,68 @@ def test_all_to_all_composes_with_data_parallel_mesh():
     np.testing.assert_allclose(
         np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5
     )
+
+
+from zookeeper_tpu.ops import flash_attention  # noqa: E402
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize(
+    "shape", [(2, 32, 2, 8), (1, 40, 1, 16), (2, 128, 2, 8)]
+)
+def test_flash_attention_matches_dense(shape, causal):
+    """The Pallas flash forward (interpret mode on CPU) vs the dense
+    oracle — including a sequence length (40) that exercises the
+    internal padding/masking path."""
+    b, s, h, d = shape
+    rng = np.random.default_rng(s + causal)
+    mk = lambda: jnp.asarray(
+        rng.normal(size=(b, s, h, d)).astype(np.float32)
+    )
+    q, k, v = mk(), mk(), mk()
+    out = flash_attention(
+        q, k, v, causal=causal, block_q=16, block_k=16, interpret=True
+    )
+    ref = attention_reference(q, k, v, causal=causal)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5
+    )
+
+
+def test_flash_attention_bf16():
+    rng = np.random.default_rng(3)
+    mk = lambda: jnp.asarray(
+        rng.normal(size=(1, 32, 2, 8)).astype(np.float32), jnp.bfloat16
+    )
+    q, k, v = mk(), mk(), mk()
+    out = flash_attention(q, k, v, block_q=16, block_k=16, interpret=True)
+    assert out.dtype == jnp.bfloat16
+    ref = attention_reference(
+        q.astype(jnp.float32), k.astype(jnp.float32), v.astype(jnp.float32)
+    )
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref), atol=3e-2
+    )
+
+
+def test_flash_attention_unequal_blocks_and_awkward_seq():
+    """Unequal block_q/block_k with a sequence dividing neither: the
+    lcm padding must keep every query row written and every key
+    attended (regression: max-based padding dropped rows/keys)."""
+    rng = np.random.default_rng(13)
+    b, s, h, d = 1, 20, 1, 8
+    mk = lambda: jnp.asarray(
+        rng.normal(size=(b, s, h, d)).astype(np.float32)
+    )
+    q, k, v = mk(), mk(), mk()
+    for bq, bk in ((16, 8), (8, 16), (16, 12)):
+        for causal in (False, True):
+            out = flash_attention(
+                q, k, v, causal=causal, block_q=bq, block_k=bk,
+                interpret=True,
+            )
+            ref = attention_reference(q, k, v, causal=causal)
+            np.testing.assert_allclose(
+                np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5,
+                err_msg=f"bq={bq} bk={bk} causal={causal}",
+            )

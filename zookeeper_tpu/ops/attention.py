@@ -284,3 +284,166 @@ def _sharded_attention_call(
         out_specs=spec,
     )
     return fn(q, k, v)
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Single-device flash attention FORWARD as a Pallas TPU kernel:
+    exact attention with O(block) VMEM residency — only one
+    (block_q, d) query tile and one (block_k, d) key/value tile live
+    on-chip per grid step, so sequence length is HBM-bound, not
+    VMEM-bound, and the [s, s] score matrix never exists. Honest
+    framing from the round-6 on-chip measurements (BASELINE.md): XLA's
+    own fusion is GOOD — the dense path also ran s=16k on a v5e and
+    long-chain timing puts this kernel at parity with it (2.33 vs
+    2.38 ms, b1 s4096 h8 d64 bf16 causal), so the kernel buys the
+    residency GUARANTEE, not speed. Same online-softmax recurrence as
+    the ring — blocked over K inside the kernel instead of over
+    devices — so the tiers compose: flash within a chip, ring/Ulysses
+    across chips.
+
+    Layout: grid (batch*heads, q blocks, k blocks), the k dimension
+    innermost (TPU grids iterate sequentially); the online-softmax
+    carries (running max / sum / accumulator) live in VMEM scratch
+    that persists across the k steps of one q block, initialized at
+    k==0 and flushed to the output tile at the last k step. Causal
+    skipping is a ``pl.when`` predicate (fully-masked k blocks do no
+    compute, though their DMA still streams — see the index_map note).
+
+    FORWARD-ONLY: no custom_vjp is defined, so differentiating through
+    it raises; it serves inference / eval / frozen-teacher scoring
+    (the training paths in this repo are CNNs). Shapes
+    ``[batch, seq, heads, head_dim]``; seq is padded internally to a
+    common multiple of both block sizes (padded KEYS are masked out,
+    padded query rows are dropped), accumulation in fp32, output in
+    the input dtype. ``interpret=None`` auto-selects interpret mode
+    off-TPU (the repo's Pallas convention).
+    """
+    import math
+
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    b, s, h, d = q.shape
+    block_q = min(block_q, max(8, s))
+    block_k = min(block_k, max(8, s))
+    # Pad to a COMMON multiple: with unequal (possibly clamped) block
+    # sizes, rounding to max(bq, bk) alone leaves nq/nk floor-division
+    # dropping real rows/keys.
+    common = math.lcm(block_q, block_k)
+    s_pad = -(-s // common) * common
+    # f32 operands need HIGHEST for exact multiplies (default is bf16
+    # passes on the MXU); bf16 operands are exact at DEFAULT already —
+    # and Mosaic rejects an fp32 contract precision on bf16 vectors.
+    dot_precision = (
+        jax.lax.Precision.HIGHEST
+        if q.dtype == jnp.float32
+        else jax.lax.Precision.DEFAULT
+    )
+
+    def to_bh(x):
+        x = jnp.pad(x, ((0, 0), (0, s_pad - s), (0, 0), (0, 0)))
+        return x.transpose(0, 2, 1, 3).reshape(b * h, s_pad, d)
+
+    qb, kb, vb = to_bh(q), to_bh(k), to_bh(v)
+    nq, nk = s_pad // block_q, s_pad // block_k
+
+    def kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref):
+        iq = pl.program_id(1)
+        kb_idx = pl.program_id(2)
+
+        @pl.when(kb_idx == 0)
+        def _init():
+            m_ref[...] = jnp.full_like(m_ref, _MASK_VALUE)
+            l_ref[...] = jnp.zeros_like(l_ref)
+            acc_ref[...] = jnp.zeros_like(acc_ref)
+
+        # Causal skip: a k block strictly above this q block's last row
+        # is fully masked — no compute (the measured causal win).
+        live = (
+            kb_idx * block_k <= iq * block_q + block_q - 1
+            if causal
+            else True
+        )
+
+        @pl.when(live)
+        def _block():
+            sc = jax.lax.dot_general(
+                q_ref[0],
+                k_ref[0],
+                (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+                precision=dot_precision,
+            ) * jnp.float32(scale)
+            ki = kb_idx * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            valid = ki < s  # Padded keys never contribute.
+            if causal:
+                qi = iq * block_q + jax.lax.broadcasted_iota(
+                    jnp.int32, (block_q, block_k), 0
+                )
+                valid = valid & (ki <= qi)
+            sc = jnp.where(valid, sc, _MASK_VALUE)
+            m = m_ref[...]
+            m_new = jnp.maximum(m, sc.max(axis=-1, keepdims=True))
+            p = jnp.exp(sc - m_new)
+            corr = jnp.exp(m - m_new)
+            m_ref[...] = m_new
+            l_ref[...] = l_ref[...] * corr + p.sum(axis=-1, keepdims=True)
+            # p at v's dtype: f32 inputs stay exact; bf16 inputs round
+            # p to bf16 (the standard flash trade, inside the bf16
+            # tolerance class) and keep the native MXU path.
+            acc_ref[...] = acc_ref[...] * corr + jax.lax.dot_general(
+                p.astype(v_ref.dtype),
+                v_ref[0],
+                (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+                precision=dot_precision,
+            )
+
+        @pl.when(kb_idx == nk - 1)
+        def _finalize():
+            # Padded query rows attended block 0's valid keys, so l > 0
+            # everywhere (rows are sliced off by the wrapper anyway).
+            o_ref[0] = (acc_ref[...] / l_ref[...]).astype(o_ref.dtype)
+
+    # NOTE: causal fully-masked k blocks still stream from HBM (the
+    # pl.when skips only their compute). A clamped kv index_map that
+    # re-fetches the last live block (no-op DMA) was tried and measured
+    # no better at s=4096 and only ~12% at s=16k (the dynamic index
+    # costs Mosaic pipelining about what the skipped DMAs save); the
+    # simple map stays.
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((b * h, s_pad, d), q.dtype),
+        grid=(b * h, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j, kk: (i, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, j, kk: (i, kk, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, j, kk: (i, kk, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda i, j, kk: (i, j, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qb, kb, vb)
+    return (
+        out.reshape(b, h, s_pad, d).transpose(0, 2, 1, 3)[:, :s]
+    )
