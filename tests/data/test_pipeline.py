@@ -475,3 +475,79 @@ def test_native_fast_path_hits_memmap_store(tmp_path, monkeypatch):
     for a, b in zip(fast, ram):
         np.testing.assert_array_equal(a["input"], b["input"])
         np.testing.assert_array_equal(a["target"], b["target"])
+
+
+def test_start_batch_out_of_range_fails_loudly():
+    """A miscomputed resume point must raise, not silently train zero
+    steps: negative start_batch, start_batch at the epoch end, and
+    start_batch beyond it are all rejected (a legitimate epoch-boundary
+    resume rolls into the next epoch at step 0). Validation happens at
+    first iteration (batch_iterator is a generator)."""
+    src = make_source(32)  # 4 batches of 8
+    kw = dict(training=True, shuffle=True, seed=0)
+
+    # Valid interior resume points still work.
+    assert len(list(batch_iterator(src, None, 8, **kw, start_batch=3))) == 1
+
+    for bad in (-1, 4, 5):
+        with pytest.raises(ValueError, match="start_batch"):
+            list(batch_iterator(src, None, 8, **kw, start_batch=bad))
+
+    # Through the DataLoader surface too (the path Experiment uses).
+    loader = DataLoader()
+    configure(
+        loader,
+        {
+            "dataset": "SyntheticMnist",
+            "dataset.num_train_examples": 32,
+            "preprocessing": "PassThroughPreprocessing",
+            "batch_size": 8,
+        },
+        name="loader",
+    )
+    with pytest.raises(ValueError, match="start_batch"):
+        list(loader.batches("train", epoch=0, start_batch=-2))
+
+
+def test_start_batch_validated_even_on_empty_source():
+    """The validation must not be bypassed by the empty-source early
+    exit: a zero-example source with a stale resume point fails loudly
+    instead of silently yielding nothing forever."""
+    empty = ArraySource(
+        {
+            "image": np.zeros((0, 4, 4, 1), np.float32),
+            "label": np.zeros((0,), np.int32),
+        }
+    )
+    # start_batch=0 on an empty source is a legitimate empty iteration.
+    assert list(batch_iterator(empty, None, 8, training=True)) == []
+    for bad in (-1, 3):
+        with pytest.raises(ValueError, match="start_batch"):
+            list(
+                batch_iterator(
+                    empty, None, 8, training=True, start_batch=bad
+                )
+            )
+
+
+def test_train_split_smaller_than_global_batch_fails_loudly():
+    """A train split that cannot fill one global batch (remainder
+    dropped) would otherwise 'train' zero steps per epoch forever; eval
+    iteration of the same source stays permissive (callers handle
+    produced-no-batches explicitly)."""
+    src = make_source(6)  # 6 examples < batch 8
+    with pytest.raises(ValueError, match="zero batches"):
+        list(batch_iterator(src, None, 8, training=True))
+    # Eval mode without remainder dropping still yields the partial batch.
+    got = list(
+        batch_iterator(
+            src, None, 8, training=False, shuffle=False,
+            drop_remainder=False,
+        )
+    )
+    assert len(got) == 1 and got[0]["image"].shape[0] == 6
+    # Eval mode WITH remainder dropping: empty, silently (callers own it).
+    assert (
+        list(batch_iterator(src, None, 8, training=False, shuffle=False))
+        == []
+    )

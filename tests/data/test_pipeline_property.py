@@ -124,9 +124,12 @@ def test_multihost_batches_match_permutation_slices(case_seed):
             np.testing.assert_array_equal(a["x"], c["x"])
 
     # Mid-epoch resume: start_batch=k yields exactly the [k:] suffix of
-    # the full epoch, bitwise (the exact-resume contract).
+    # the full epoch, bitwise (the exact-resume contract). k is a valid
+    # resume point, i.e. strictly inside the epoch (start_batch ==
+    # num_batches is rejected — an epoch-boundary resume rolls into the
+    # next epoch at step 0).
     if per_host[0]:
-        k = rng.randrange(len(per_host[0]) + 1)
+        k = rng.randrange(len(per_host[0]))
         suffix = list(
             batch_iterator(
                 source,

@@ -130,3 +130,81 @@ def test_bench_compiler_options_resolution():
                 )
             }
         )
+
+
+def test_bench_peak_aggregation():
+    """Agreement-gated median over independent peak attempts — the
+    aggregator that replaced max-over-attempts after three fast-side
+    failures (268 / 270 / 237.9 TF/s "measured" on a 197 TF/s v5e).
+    Pinned off-chip with the observed failure shapes."""
+    agg = _bench_attr("aggregate_peak_attempts")
+
+    # Clean session: all attempts agree; median of the cluster.
+    assert agg([190e12, 192e12, 189e12, 191e12]) == pytest.approx(
+        190.5e12
+    )
+
+    # Cache-hit spike (the BENCH_r04 pathology): one above-physics fast
+    # outlier must be EXCLUDED, not returned as the max.
+    clean = agg([237.9e12, 191e12, 190e12, 192e12])
+    assert clean == pytest.approx(191e12)
+
+    # Jitter spike (slow-side outlier, the round-2 ~154 TF/s shape):
+    # excluded the same way.
+    assert agg([154e12, 190e12, 192e12, 191e12]) == pytest.approx(191e12)
+
+    # Both failure shapes in one session.
+    assert agg([154e12, 238e12, 190e12, 192e12]) == pytest.approx(191e12)
+
+    # No two attempts agree: refuse to anchor rather than guess.
+    with pytest.raises(ValueError, match="agree"):
+        agg([100e12, 150e12, 238e12])
+
+    # Fewer than two positive attempts: refuse.
+    with pytest.raises(ValueError, match=">=2"):
+        agg([190e12])
+    with pytest.raises(ValueError, match=">=2"):
+        agg([-1.0, 190e12])
+
+    # Equal-size disjoint clusters (bimodal session): REFUSE — anchoring
+    # on the slow cluster inflates MFU (the round-2 114 TF/s lesson),
+    # the fast one risks the cache pathology. Neither is trustworthy.
+    with pytest.raises(ValueError, match="ambiguous"):
+        agg([150e12, 151e12, 237e12, 238e12])
+    with pytest.raises(ValueError, match="ambiguous"):
+        agg([154e12, 156e12, 190e12, 192e12])
+
+    # But a mild outlier that merely OVERLAPS the clean cluster's band
+    # (within tol of its max, not its min) is the same cluster shifted,
+    # not a second mode — it must not veto three agreeing attempts.
+    assert agg([190e12, 191e12, 192e12, 199.6e12]) == pytest.approx(
+        191e12
+    )
+
+
+def test_bench_peak_datasheet_clamp():
+    """Generation-specific clamp: a measured peak above ~1.05x the
+    datasheet number for the detected device_kind is a measurement
+    failure; unknown generations must pass (stale table vs future
+    chip)."""
+    sheet = _bench_attr("datasheet_bf16_peak")
+    check = _bench_attr("check_peak_against_datasheet")
+
+    assert sheet("TPU v5 lite") == pytest.approx(197e12)
+    assert sheet("TPU v5e") == pytest.approx(197e12)
+    assert sheet("TPU v5p") == pytest.approx(459e12)  # "v5 lite" must not
+    assert sheet("TPU v4") == pytest.approx(275e12)
+    assert sheet("TPU v6 lite") == pytest.approx(918e12)
+    assert sheet("some future chip") is None
+    assert sheet(None) is None
+
+    # The exact BENCH_r04 defect: 237.9 TF/s on a v5e must raise.
+    with pytest.raises(ValueError, match="datasheet"):
+        check(237.9e12, "TPU v5 lite")
+    # In-band measurements pass, including slightly above datasheet
+    # (within headroom) and legitimately degraded ones.
+    check(192.5e12, "TPU v5 lite")
+    check(200e12, "TPU v5 lite")
+    check(154e12, "TPU v5 lite")
+    # Unknown generation: no clamp.
+    check(2e15, "TPU v9 hyperlite")

@@ -259,3 +259,52 @@ def test_flash_attention_unequal_blocks_and_awkward_seq():
                 np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5,
                 err_msg=f"bq={bq} bk={bk} causal={causal}",
             )
+
+
+def test_sharded_attention_rejects_mismatched_qkv_shapes():
+    """Cross-attention shapes must fail loudly at the boundary: with
+    causal=True and per-shard sk > sq a non-first ring block can be
+    fully masked while the running max still sits at the mask value,
+    making p = exp(0) = 1 for masked entries — silently corrupt l/acc,
+    wrong output, no error. Self-attention is the supported contract."""
+    mesh = _mesh(8)
+    q, k, v = _qkv(seed=3, s=16)
+    q_short = q[:, :8]
+    for fn in (ring_attention, all_to_all_attention):
+        with pytest.raises(ValueError, match="identical shape"):
+            fn(q_short, k, v, mesh=mesh, seq_axis="sp", causal=True)
+        # Head/dim mismatches are the same class of boundary error.
+        with pytest.raises(ValueError, match="identical shape"):
+            fn(q[..., : q.shape[-1] // 2], k, v, mesh=mesh, seq_axis="sp")
+
+
+def test_local_kernels_reject_mismatched_qkv_shapes():
+    """The guard lives INSIDE the local programs too — they are public
+    API for users' own shard_maps, and the corruption is in the local
+    online-softmax math."""
+    from zookeeper_tpu.ops import (
+        all_to_all_attention_local,
+        ring_attention_local,
+    )
+
+    mesh = _mesh(8)
+    q, k, v = _qkv(seed=3, s=16)
+
+    def call(fn):
+        from functools import partial as _p
+
+        from jax.sharding import PartitionSpec as P
+
+        from jax import shard_map
+
+        sm = shard_map(
+            _p(fn, axis_name="sp", causal=True),
+            mesh=mesh,
+            in_specs=(P(None, "sp"), P(None, "sp"), P(None, "sp")),
+            out_specs=P(None, "sp"),
+        )
+        return sm(q[:, :8], k, v)
+
+    for fn in (ring_attention_local, all_to_all_attention_local):
+        with pytest.raises(ValueError, match="identical"):
+            call(fn)

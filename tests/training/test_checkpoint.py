@@ -323,3 +323,48 @@ def test_midepoch_resume_bit_exact_under_dp_sharding(tmp_path):
     ):
         np.testing.assert_array_equal(a, b)
     exp2.checkpointer.close()
+
+
+def test_midepoch_resume_tags_partial_epoch(tmp_path):
+    """The resumed epoch's train aggregates cover only the replayed
+    suffix of the epoch — its metrics_file record is tagged
+    partial_epoch and it is excluded from early-stop scoring when no
+    validation split exists (a partial epoch's train metrics are not
+    comparable to full epochs'). Full epochs carry no tag."""
+    import json as _json
+
+    conf = {
+        "checkpointer.save_every_steps": 3,
+        "checkpointer.save_every_epochs": 0,
+        # No validation split: the early-stop/scoring path under test
+        # is the one that would otherwise score partial train metrics.
+        "loader.dataset.num_validation_examples": 0,
+        "validate": False,
+    }
+    exp = make_experiment(tmp_path, {"epochs": 1, **conf})
+    exp.run()
+    assert exp.checkpointer.latest_step() == 3  # mid-epoch (spe=4)
+    exp.checkpointer.close()
+
+    metrics_file = tmp_path / "metrics.jsonl"
+    exp2 = make_experiment(
+        tmp_path,
+        {
+            "epochs": 2,
+            "metrics_file": str(metrics_file),
+            # Early stop on train loss: the partial epoch must not be
+            # scored (it would compare a 1-step mean vs 4-step means).
+            "early_stop_metric": "loss",
+            "early_stop_patience": 1,
+            **conf,
+        },
+    )
+    exp2.run()
+    exp2.checkpointer.close()
+    records = [
+        _json.loads(line)
+        for line in metrics_file.read_text().splitlines()
+    ]
+    assert [r["epoch"] for r in records] == [0, 1]
+    assert records[0].get("partial_epoch") is True
+    assert "partial_epoch" not in records[1]

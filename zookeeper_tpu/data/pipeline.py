@@ -76,8 +76,6 @@ def batch_iterator(
     ``step % steps_per_epoch == k``).
     """
     n = len(source)
-    if n == 0:
-        return
     global_batch = batch_size * host_count
     # Multi-host pods MUST drop the final partial global batch: a batch
     # present on some hosts but not others would desync the lockstep jitted
@@ -85,14 +83,42 @@ def batch_iterator(
     # pod-wide hang), and shape-changing partial batches would recompile.
     if host_count > 1:
         drop_remainder = True
+
+    num_batches = n // global_batch if drop_remainder else -(-n // global_batch)
+    if training and n > 0 and num_batches == 0:
+        # A train split smaller than one global batch (with remainder
+        # dropping) yields ZERO batches: the run would "train" zero
+        # steps every epoch forever with no error — same silent
+        # pathology as a bad resume point. Eval splits stay permissive:
+        # their callers handle produced-no-batches explicitly (e.g.
+        # validation metrics simply absent that epoch).
+        raise ValueError(
+            f"Train split has {n} examples but the global batch is "
+            f"{global_batch} (batch_size={batch_size} x "
+            f"host_count={host_count}) with drop_remainder: every epoch "
+            "would yield zero batches."
+        )
+    if start_batch < 0 or (start_batch > 0 and start_batch >= num_batches):
+        # A miscomputed resume point must fail loudly: a negative value
+        # silently shifts range() semantics, and start_batch at/beyond
+        # the epoch end silently yields an EMPTY epoch (a run that
+        # "trains" zero steps per epoch forever). A legitimate epoch-
+        # boundary resume rolls into the NEXT epoch at step 0, so
+        # start_batch == num_batches is never correct. Validated BEFORE
+        # the empty-source exit so a zero-example source with a stale
+        # resume point still fails instead of yielding nothing forever.
+        raise ValueError(
+            f"start_batch={start_batch} outside [0, {num_batches}) "
+            f"(the epoch has {num_batches} batches)"
+        )
+    if n == 0:
+        return
     if shuffle:
         order = np.random.default_rng(
             np.random.SeedSequence([seed, epoch])
         ).permutation(n)
     else:
         order = np.arange(n)
-
-    num_batches = n // global_batch if drop_remainder else -(-n // global_batch)
 
     # Native fast path: when preprocessing reduces to gather+affine over a
     # uint8 feature store, assemble whole batches in one fused C++ call

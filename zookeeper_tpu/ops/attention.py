@@ -84,6 +84,22 @@ def attention_reference(
     ).astype(q.dtype)
 
 
+def _check_self_attention_shapes(q, k, v):
+    """Identical q/k/v shapes are the supported contract for the SP
+    kernels. Checked INSIDE the local programs (not just the shard_map
+    wrappers — the locals are public API for users' own shard_maps):
+    with causal=True and per-shard sk > sq, a non-first ring block can
+    be fully masked while the running max still sits at the mask value,
+    making p = exp(0) = 1 for masked entries and silently corrupting
+    the l/acc accumulators — wrong output, no error."""
+    if k.shape != q.shape or v.shape != q.shape:
+        raise ValueError(
+            "Sequence-parallel attention requires q, k, v of identical "
+            f"(per-shard) shape (self-attention); got q={q.shape}, "
+            f"k={k.shape}, v={v.shape}."
+        )
+
+
 def ring_attention_local(
     q: jax.Array,
     k: jax.Array,
@@ -97,6 +113,7 @@ def ring_attention_local(
     ``q/k/v`` already sequence-sharded: ``[batch, seq/n, heads, hd]``
     local shards, mesh axis ``axis_name`` of size n).
     """
+    _check_self_attention_shapes(q, k, v)
     if scale is None:
         scale = q.shape[-1] ** -0.5
     n = lax.psum(1, axis_name)
@@ -185,6 +202,7 @@ def all_to_all_attention_local(
     even one head's full-sequence scores would not fit. Requires
     ``heads % axis_size == 0``.
     """
+    _check_self_attention_shapes(q, k, v)
     n = lax.psum(1, axis_name)
     if q.shape[2] % n != 0:
         raise ValueError(
@@ -261,6 +279,19 @@ def _sharded_attention_call(
     except ImportError:  # pragma: no cover - version shim
         from jax.experimental.shard_map import shard_map
 
+    if k.shape != q.shape or v.shape != q.shape:
+        # Mismatched k/v sequence lengths would not error downstream:
+        # with causal=True and per-shard sk > sq, a non-first ring block
+        # can be FULLY masked while the running max still sits at the
+        # mask value, making p = exp(0) = 1 for masked entries and
+        # silently corrupting the l/acc accumulators — wrong output, no
+        # error. Self-attention (identical shapes) is the supported
+        # contract; fail loudly at the boundary.
+        raise ValueError(
+            "Sequence-parallel attention requires q, k, v of identical "
+            f"shape (self-attention); got q={q.shape}, k={k.shape}, "
+            f"v={v.shape}."
+        )
     if q.shape[1] % mesh.shape[seq_axis] != 0:
         raise ValueError(
             f"Sequence length {q.shape[1]} does not divide the "

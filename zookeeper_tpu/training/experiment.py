@@ -425,6 +425,10 @@ class TrainingExperiment(Experiment):
                 dt = time.perf_counter() - t0
                 examples = len(accum) * self.loader.batch_size
                 epoch_metrics["examples_per_sec"] = examples / dt if dt > 0 else 0.0
+                # A mid-epoch resume trains only steps start_b..spe-1 of
+                # its first epoch: its train aggregates describe a PARTIAL
+                # epoch and must not be compared against full ones.
+                partial_epoch = epoch == start_epoch and start_b > 0
                 history["train"].append(epoch_metrics)
                 line = (
                     f"epoch {epoch + 1}/{self.epochs} "
@@ -432,6 +436,8 @@ class TrainingExperiment(Experiment):
                     f"acc={epoch_metrics.get('accuracy', float('nan')):.4f} "
                     f"({epoch_metrics['examples_per_sec']:.0f} ex/s)"
                 )
+                if partial_epoch:
+                    line += f" [partial: resumed at step {start_b}]"
 
                 # vmetrics is non-None only when validation RAN this
                 # epoch (and produced batches): val_* records/scalars,
@@ -454,6 +460,8 @@ class TrainingExperiment(Experiment):
 
                 if self.metrics_file:
                     record = {"epoch": epoch, **epoch_metrics}
+                    if partial_epoch:
+                        record["partial_epoch"] = True
                     if vmetrics is not None:
                         record.update(
                             {f"val_{k}": v for k, v in vmetrics.items()}
@@ -472,8 +480,15 @@ class TrainingExperiment(Experiment):
                 # The epoch's scored metrics: fresh validation when it
                 # ran; train metrics only when the run HAS no validation
                 # (never mixed — train and val values are not on one
-                # scale). None = nothing scoreable this epoch.
+                # scale). None = nothing scoreable this epoch. A partial
+                # epoch's train aggregates are not comparable to full
+                # epochs' (fewer, later-in-permutation steps), so they
+                # are excluded from best-ranking and early stopping;
+                # validation metrics always cover the full split and
+                # stay scoreable.
                 scored = vmetrics if has_val_split else epoch_metrics
+                if partial_epoch and not has_val_split:
+                    scored = None
 
                 if (
                     self.checkpointer.enabled
