@@ -57,6 +57,33 @@ TPU_DATASHEET_BF16_TFLOPS = {
 # produces 1.2-1.4x errors, far outside this band).
 DATASHEET_HEADROOM = 1.05
 
+# Recorded v5e int8 MXU peak: measured on this machine with PRE-CAST
+# int8 operands (the round-2 177 TOP/s carried an in-loop bf16 cast that
+# halved it) — 4096^3 int8 dot_general chain, elementwise int32->int8
+# squeeze between iterates, marginal timing: 369-373 TOP/s, ~94% of the
+# 394 TOP/s datasheet (2x the bf16 197).
+INT8_PEAK_FALLBACK = 369e12
+
+# Per-generation int8-over-bf16 MXU rate: v5e/v5p/v6 double int8;
+# v2/v3/v4 run int8 at the bf16 rate (no native int8 MXU doubling).
+# Used both as the measurement ceiling (x DATASHEET_HEADROOM) and to
+# scale the datasheet fallback — assuming 2x on a v4 would record a
+# ~2x-understated MFU under an authoritative-sounding tag. Unknown
+# generations use the 2x upper bound for the CLAMP only (permissive),
+# never for a fallback value.
+TPU_INT8_FACTOR = {
+    "v2": 1.0,
+    "v3": 1.0,
+    "v4": 1.0,
+    "v5 lite": 2.0,
+    "v5litepod": 2.0,
+    "v5e": 2.0,
+    "v5p": 2.0,
+    "v6 lite": 2.0,
+    "v6e": 2.0,
+}
+INT8_FACTOR_UPPER_BOUND = 2.0
+
 
 # The v5e table keys: the generation whose RECORDED on-chip measurement
 # (BF16_PEAK_FALLBACK) exists, distinguished by key rather than by
@@ -269,46 +296,144 @@ def measure_bf16_peak(rounds: int = 4, n_attempts: int = 4) -> float:
     return peak
 
 
-def resolve_peak_flops(env=None):
-    """The MFU anchor's bf16 peak, in priority order: ``ZK_BENCH_PEAK_FLOPS``
-    env override > on-chip measurement (TPU only — the marginal-chain
-    methodology needs real hardware; CPU would take minutes; one retry,
-    since each attempt pulls fresh OS entropy) > a datasheet-derived
-    fallback for the detected generation (0.93x datasheet — the measured
-    achievable fraction on v5e) > the recorded v5e measurement. Returns
-    ``(peak_flops, source_tag)`` so the bench output can say which anchor
-    it used."""
+def measure_int8_peak(rounds: int = 4, n_attempts: int = 4) -> float:
+    """Measure this chip's achievable int8 MXU peak (OP/s), same
+    protocol as :func:`measure_bf16_peak` (fori_loop chain, marginal
+    timing, agreement-gated attempts, datasheet clamp) with int8
+    operands kept PRE-CAST: the only in-loop non-matmul work is an
+    elementwise int32->int8 squeeze (4096^2 elements against 2*4096^3
+    MACs), so the 2x MXU rate is actually observable — round 2's
+    177 TOP/s reading carried an in-loop bf16 cast that halved it."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    n = 4096
+    rng = np.random.default_rng()  # OS entropy: run-unique requests
+    a = jnp.asarray(rng.integers(-127, 128, size=(n, n)), jnp.int8)
+
+    from functools import partial
+
+    @partial(jax.jit, static_argnums=2)
+    def chain(x, salt, iters):
+        x = x + salt  # distinct request per call (cache-replay guard)
+
+        def body(_, x):
+            y = jax.lax.dot_general(
+                x, a, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.int32,
+            )
+            # Values wrap; only the data dependency matters. >>7 keeps
+            # magnitudes spread (each dot sums 4096 +-127^2 terms).
+            return (y >> 7).astype(jnp.int8)
+
+        return jax.lax.fori_loop(0, iters, body, x).astype(jnp.int32).sum()
+
+    x0 = jnp.asarray(rng.integers(-127, 128, size=(n, n)), jnp.int8)
+    n1, n2 = 100, 300
+    salt = iter(range(1, 10_000))
+
+    def run_chain(iters):
+        # int8 can hold only 256 salt values; % 251 - 125 keeps every
+        # in-run request distinct for far more calls than a measurement
+        # makes (~34). Repeating a bit-identical request is exactly the
+        # cache-replay pathology the salts exist to kill.
+        s = jnp.int8(next(salt) % 251 - 125)
+        t0 = time.perf_counter()
+        int(jax.device_get(chain(x0, s, iters)))
+        return time.perf_counter() - t0
+
+    run_chain(n1)  # Warm both compiles.
+    run_chain(n2)
+    attempts = []
+    for _ in range(n_attempts):
+        per_matmul = time_marginal(run_chain, n1, n2, rounds)
+        if per_matmul > 0:
+            attempts.append(2.0 * n**3 / per_matmul)
+    peak = aggregate_peak_attempts(attempts)
+    if not 1e13 <= peak <= 4e15:
+        raise ValueError(f"implausible measured int8 peak {peak:.3g} OP/s")
+    match = _datasheet_match(jax.devices()[0].device_kind)
+    if match is not None:
+        factor = TPU_INT8_FACTOR.get(match[0], INT8_FACTOR_UPPER_BOUND)
+        ceiling = DATASHEET_HEADROOM * factor * match[1]
+        if peak > ceiling:
+            raise ValueError(
+                f"measured int8 peak {peak / 1e12:.1f} TOP/s exceeds "
+                f"{factor:.0f}x the bf16 datasheet "
+                f"({match[1] / 1e12:.0f} TF/s) — measurement failure, "
+                "not hardware"
+            )
+    return peak
+
+
+def _resolve_measured_anchor(
+    env, env_var, measure, fallback_v5e, datasheet_scale, unit
+):
+    """Shared anchor-resolution harness (both anchors MUST stay
+    mechanically identical — a divergence in one produced the round-4
+    defect): ``env_var`` override > on-chip measurement with one retry
+    (each attempt pulls fresh OS entropy) > for a KNOWN non-v5e
+    generation, ``datasheet_scale(bf16_sheet_flops, table_key)`` (v5e's
+    0.93x-of-datasheet achievable fraction is the transfer prior) > the
+    recorded v5e measurement. Returns ``(peak_flops, source_tag)``."""
     import jax
 
     env = os.environ if env is None else env
-    override = env.get("ZK_BENCH_PEAK_FLOPS")
+    override = env.get(env_var)
     if override:
         return float(override), "env"
     if jax.default_backend() == "tpu":
         last_err = None
         for _ in range(2):
             try:
-                return measure_bf16_peak(), "measured"
+                return measure(), "measured"
             except Exception as e:
                 last_err = e
         match = _datasheet_match(jax.devices()[0].device_kind)
-        # v5e's 0.93x-of-datasheet achievable fraction transfers as the
-        # best available prior for an unmeasurable chip of a KNOWN other
-        # generation; for v5e itself the recorded number IS 0.93x of its
-        # datasheet. Matched by table KEY, not by datasheet value.
+        # Matched by table KEY, not by datasheet value (float identity
+        # would drift if an entry were corrected).
         if match is not None and match[0] not in _V5E_KEYS:
-            anchor = (0.93 * match[1], "fallback_datasheet")
+            anchor = (datasheet_scale(match[1], match[0]), "fallback_datasheet")
         else:
-            anchor = (BF16_PEAK_FALLBACK, "fallback_v5e")
+            anchor = (fallback_v5e, "fallback_v5e")
         print(
             f"on-chip peak measurement failed twice ({last_err}); "
             f"using the {anchor[1]} anchor "
-            f"({anchor[0] / 1e12:.1f} TF/s)",
+            f"({anchor[0] / 1e12:.1f} {unit})",
             file=sys.stderr,
             flush=True,
         )
         return anchor
-    return BF16_PEAK_FALLBACK, "fallback_v5e"
+    return fallback_v5e, "fallback_v5e"
+
+
+def resolve_peak_flops(env=None):
+    """The MFU anchor's bf16 peak — see ``_resolve_measured_anchor``
+    for the priority order (``ZK_BENCH_PEAK_FLOPS`` is the override)."""
+    return _resolve_measured_anchor(
+        env,
+        "ZK_BENCH_PEAK_FLOPS",
+        measure_bf16_peak,
+        BF16_PEAK_FALLBACK,
+        lambda sheet, key: 0.93 * sheet,
+        "TF/s",
+    )
+
+
+def resolve_int8_peak(env=None):
+    """The int8-MXU anchor — same harness as :func:`resolve_peak_flops`
+    (``ZK_BENCH_INT8_PEAK_FLOPS`` overrides); the datasheet fallback
+    scales by the generation's measured int8-over-bf16 factor (1x on
+    v2-v4, which have no int8 MXU doubling)."""
+    return _resolve_measured_anchor(
+        env,
+        "ZK_BENCH_INT8_PEAK_FLOPS",
+        measure_int8_peak,
+        INT8_PEAK_FALLBACK,
+        lambda sheet, key: 0.93 * TPU_INT8_FACTOR.get(key, 1.0) * sheet,
+        "TOP/s",
+    )
 
 
 def resolve_bench_config(env=None):
@@ -544,6 +669,13 @@ def main():
     # reads.
     if cost is not None:
         peak_flops, peak_source = resolve_peak_flops()
+        # Second anchor when the binary convs run on the int8 MXU path:
+        # the bf16-anchored MFU is conservative by convention (the int8
+        # ceiling is ~2x higher), so the dual-anchor output states the
+        # step's position against BOTH rooflines.
+        int8_peak = int8_source = None
+        if binary_compute == "int8":
+            int8_peak, int8_source = resolve_int8_peak()
 
     def run_chain(n):
         """n chained steps ended by a scalar host readback (device_get is
@@ -617,6 +749,12 @@ def main():
         extras["mfu_vs_measured_bf16_peak"] = vs_baseline
         extras["bf16_peak_tflops"] = round(peak_flops / 1e12, 1)
         extras["bf16_peak_source"] = peak_source
+        if int8_peak is not None:
+            extras["mfu_vs_measured_int8_peak"] = round(
+                cost / step_time / int8_peak, 4
+            )
+            extras["int8_peak_tops"] = round(int8_peak / 1e12, 1)
+            extras["int8_peak_source"] = int8_source
     else:
         vs_baseline = -1.0  # cost analysis unavailable; MFU unknown
 

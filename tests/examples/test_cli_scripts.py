@@ -208,3 +208,18 @@ def test_bench_peak_datasheet_clamp():
     check(154e12, "TPU v5 lite")
     # Unknown generation: no clamp.
     check(2e15, "TPU v9 hyperlite")
+
+
+def test_bench_int8_peak_resolution():
+    """The second MFU anchor (int8 MXU): env override wins; off-TPU the
+    recorded v5e measurement applies."""
+    resolve = _bench_attr("resolve_int8_peak")
+
+    peak, source = resolve(env={"ZK_BENCH_INT8_PEAK_FLOPS": "3.9e14"})
+    assert (peak, source) == (3.9e14, "env")
+
+    peak, source = resolve(env={})
+    # Tests force JAX_PLATFORMS=cpu, so the TPU measurement is skipped.
+    assert (peak, source) == (369e12, "fallback_v5e")
+    # The recorded fallback sits below the physical 2x-bf16 ceiling.
+    assert peak < 2.0 * 197e12

@@ -95,8 +95,8 @@ def _check_self_attention_shapes(q, k, v):
     if k.shape != q.shape or v.shape != q.shape:
         raise ValueError(
             "Sequence-parallel attention requires q, k, v of identical "
-            f"(per-shard) shape (self-attention); got q={q.shape}, "
-            f"k={k.shape}, v={v.shape}."
+            f"shape (self-attention); got q={q.shape}, k={k.shape}, "
+            f"v={v.shape}."
         )
 
 
@@ -279,19 +279,10 @@ def _sharded_attention_call(
     except ImportError:  # pragma: no cover - version shim
         from jax.experimental.shard_map import shard_map
 
-    if k.shape != q.shape or v.shape != q.shape:
-        # Mismatched k/v sequence lengths would not error downstream:
-        # with causal=True and per-shard sk > sq, a non-first ring block
-        # can be FULLY masked while the running max still sits at the
-        # mask value, making p = exp(0) = 1 for masked entries and
-        # silently corrupting the l/acc accumulators — wrong output, no
-        # error. Self-attention (identical shapes) is the supported
-        # contract; fail loudly at the boundary.
-        raise ValueError(
-            "Sequence-parallel attention requires q, k, v of identical "
-            f"shape (self-attention); got q={q.shape}, k={k.shape}, "
-            f"v={v.shape}."
-        )
+    # Checked on GLOBAL shapes too, so the error fires at the call
+    # boundary rather than inside the shard_map trace (the local
+    # kernels re-check their per-shard views for direct callers).
+    _check_self_attention_shapes(q, k, v)
     if q.shape[1] % mesh.shape[seq_axis] != 0:
         raise ValueError(
             f"Sequence length {q.shape[1]} does not divide the "
