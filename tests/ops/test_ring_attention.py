@@ -308,3 +308,102 @@ def test_local_kernels_reject_mismatched_qkv_shapes():
     for fn in (ring_attention_local, all_to_all_attention_local):
         with pytest.raises(ValueError, match="identical"):
             call(fn)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("shape", [(2, 32, 2, 8), (1, 40, 1, 16)])
+def test_flash_attention_gradients_match_dense(shape, causal):
+    """The recompute-based flash backward (custom_vjp, two Pallas
+    kernels) vs the dense oracle's gradients — including a sequence
+    length (40) that exercises the padding path, where padded q rows
+    must contribute nothing and padded keys must receive no gradient."""
+    b, s, h, d = shape
+    rng = np.random.default_rng(s * 2 + causal)
+    mk = lambda: jnp.asarray(rng.normal(size=(b, s, h, d)).astype(np.float32))
+    q, k, v = mk(), mk(), mk()
+    w = jnp.asarray(rng.normal(size=(b, s, h, d)).astype(np.float32))
+
+    def loss_flash(q, k, v):
+        return (
+            flash_attention(
+                q, k, v, causal=causal, block_q=16, block_k=16,
+                interpret=True,
+            )
+            * w
+        ).sum()
+
+    def loss_ref(q, k, v):
+        return (attention_reference(q, k, v, causal=causal) * w).sum()
+
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(g_flash, g_ref):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b_), atol=5e-5, rtol=5e-5
+        )
+
+
+def test_flash_attention_gradients_bf16():
+    """bf16 operands keep the native MXU path in the backward too; the
+    gradients stay within the bf16 tolerance class of the fp32 oracle."""
+    rng = np.random.default_rng(11)
+    mk = lambda: jnp.asarray(
+        rng.normal(size=(1, 32, 2, 8)).astype(np.float32), jnp.bfloat16
+    )
+    q, k, v = mk(), mk(), mk()
+    w = jnp.asarray(rng.normal(size=(1, 32, 2, 8)).astype(np.float32))
+
+    g_flash = jax.grad(
+        lambda q, k, v: (
+            flash_attention(
+                q, k, v, causal=True, block_q=16, block_k=16,
+                interpret=True,
+            ).astype(jnp.float32)
+            * w
+        ).sum(),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    g_ref = jax.grad(
+        lambda q, k, v: (
+            attention_reference(
+                q.astype(jnp.float32),
+                k.astype(jnp.float32),
+                v.astype(jnp.float32),
+                causal=True,
+            )
+            * w
+        ).sum(),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    for a, b_ in zip(g_flash, g_ref):
+        assert a.dtype == jnp.bfloat16
+        np.testing.assert_allclose(
+            np.asarray(a, np.float32), np.asarray(b_), atol=5e-2, rtol=5e-2
+        )
+
+
+def test_flash_attention_grad_composes_under_jit_and_value():
+    """custom_vjp composes with jit and value_and_grad (the training
+    path shape)."""
+    rng = np.random.default_rng(5)
+    mk = lambda: jnp.asarray(rng.normal(size=(2, 32, 2, 8)).astype(np.float32))
+    q, k, v = mk(), mk(), mk()
+
+    @jax.jit
+    def step(q, k, v):
+        return jax.value_and_grad(
+            lambda q: flash_attention(
+                q, k, v, causal=True, block_q=16, block_k=16,
+                interpret=True,
+            ).sum()
+        )(q)
+
+    val, g = step(q, k, v)
+    ref_val = attention_reference(q, k, v, causal=True).sum()
+    np.testing.assert_allclose(float(val), float(ref_val), rtol=1e-5)
+    ref_g = jax.grad(
+        lambda q: attention_reference(q, k, v, causal=True).sum()
+    )(q)
+    np.testing.assert_allclose(
+        np.asarray(g), np.asarray(ref_g), atol=5e-5, rtol=5e-5
+    )

@@ -319,10 +319,10 @@ def flash_attention(
     block_k: int = 128,
     interpret: Optional[bool] = None,
 ) -> jax.Array:
-    """Single-device flash attention FORWARD as a Pallas TPU kernel:
-    exact attention with O(block) VMEM residency — only one
-    (block_q, d) query tile and one (block_k, d) key/value tile live
-    on-chip per grid step, so sequence length is HBM-bound, not
+    """Single-device flash attention as a Pallas TPU kernel — forward
+    AND backward: exact attention with O(block) VMEM residency — only
+    one (block_q, d) query tile and one (block_k, d) key/value tile
+    live on-chip per grid step, so sequence length is HBM-bound, not
     VMEM-bound, and the [s, s] score matrix never exists. Honest
     framing from the round-6 on-chip measurements (BASELINE.md): XLA's
     own fusion is GOOD — the dense path also ran s=16k on a v5e and
@@ -331,7 +331,78 @@ def flash_attention(
     residency GUARANTEE, not speed. Same online-softmax recurrence as
     the ring — blocked over K inside the kernel instead of over
     devices — so the tiers compose: flash within a chip, ring/Ulysses
-    across chips.
+    across chips, for training as well as inference.
+
+    The backward is the standard recompute scheme (`custom_vjp`): the
+    forward saves only O and the per-row log-sum-exp; two blocked
+    kernels recompute P = exp(S - lse) tile-by-tile — one accumulates
+    dQ over k blocks, the other dK/dV over q blocks — so the backward
+    holds the same O(block) residency guarantee as the forward
+    (see ``_flash_backward``).
+
+    Shapes ``[batch, seq, heads, head_dim]``; seq is padded internally
+    to a common multiple of both block sizes (padded KEYS are masked
+    out, padded query rows are dropped), accumulation in fp32, output
+    in the input dtype. ``interpret=None`` auto-selects interpret mode
+    off-TPU (the repo's Pallas convention).
+    """
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    return _flash_attention(
+        q, k, v, bool(causal), float(scale), int(block_q), int(block_k),
+        bool(interpret),
+    )
+
+
+def _flash_dims(s, block_q, block_k):
+    """Shared padding arithmetic for the forward and backward kernels:
+    clamped block sizes and the padded length (a COMMON multiple of
+    both block sizes — with unequal clamped blocks, rounding to
+    max(bq, bk) alone leaves nq/nk floor-division dropping real
+    rows/keys)."""
+    import math
+
+    block_q = min(block_q, max(8, s))
+    block_k = min(block_k, max(8, s))
+    common = math.lcm(block_q, block_k)
+    s_pad = -(-s // common) * common
+    return block_q, block_k, s_pad
+
+
+def _flash_precision(dtype):
+    """f32 operands need HIGHEST for exact multiplies (default is bf16
+    passes on the MXU); bf16 operands are exact at DEFAULT already —
+    and Mosaic rejects an fp32 contract precision on bf16 vectors."""
+    return (
+        jax.lax.Precision.HIGHEST
+        if dtype == jnp.float32
+        else jax.lax.Precision.DEFAULT
+    )
+
+
+def _to_bh(x, s_pad):
+    """[b, s, h, d] -> [b*h, s_pad, d] (zero-padded sequence)."""
+    b, s, h, d = x.shape
+    x = jnp.pad(x, ((0, 0), (0, s_pad - s), (0, 0), (0, 0)))
+    return x.transpose(0, 2, 1, 3).reshape(b * h, s_pad, d)
+
+
+def _from_bh(x, b, s, h, d):
+    """Inverse of ``_to_bh`` (drops the padded rows)."""
+    s_pad = x.shape[1]
+    return x.reshape(b, h, s_pad, d).transpose(0, 2, 1, 3)[:, :s]
+
+
+def _flash_forward(
+    q, k, v, causal, scale, block_q, block_k, interpret, want_lse=False
+):
+    """The forward kernel; returns ``out [b,s,h,d]``, or
+    ``(out, lse [bh,s_pad,1])`` when ``want_lse`` — lse (the per-row
+    log-sum-exp, m + log l) is the one residual the recompute backward
+    needs beyond the primals, and pure-inference calls skip its HBM
+    stream entirely (the flag is trace-time static).
 
     Layout: grid (batch*heads, q blocks, k blocks), the k dimension
     innermost (TPU grids iterate sequentially); the online-softmax
@@ -340,50 +411,21 @@ def flash_attention(
     k==0 and flushed to the output tile at the last k step. Causal
     skipping is a ``pl.when`` predicate (fully-masked k blocks do no
     compute, though their DMA still streams — see the index_map note).
-
-    FORWARD-ONLY: no custom_vjp is defined, so differentiating through
-    it raises; it serves inference / eval / frozen-teacher scoring
-    (the training paths in this repo are CNNs). Shapes
-    ``[batch, seq, heads, head_dim]``; seq is padded internally to a
-    common multiple of both block sizes (padded KEYS are masked out,
-    padded query rows are dropped), accumulation in fp32, output in
-    the input dtype. ``interpret=None`` auto-selects interpret mode
-    off-TPU (the repo's Pallas convention).
     """
-    import math
-
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    if scale is None:
-        scale = q.shape[-1] ** -0.5
-    if interpret is None:
-        interpret = jax.default_backend() != "tpu"
     b, s, h, d = q.shape
-    block_q = min(block_q, max(8, s))
-    block_k = min(block_k, max(8, s))
-    # Pad to a COMMON multiple: with unequal (possibly clamped) block
-    # sizes, rounding to max(bq, bk) alone leaves nq/nk floor-division
-    # dropping real rows/keys.
-    common = math.lcm(block_q, block_k)
-    s_pad = -(-s // common) * common
-    # f32 operands need HIGHEST for exact multiplies (default is bf16
-    # passes on the MXU); bf16 operands are exact at DEFAULT already —
-    # and Mosaic rejects an fp32 contract precision on bf16 vectors.
-    dot_precision = (
-        jax.lax.Precision.HIGHEST
-        if q.dtype == jnp.float32
-        else jax.lax.Precision.DEFAULT
-    )
-
-    def to_bh(x):
-        x = jnp.pad(x, ((0, 0), (0, s_pad - s), (0, 0), (0, 0)))
-        return x.transpose(0, 2, 1, 3).reshape(b * h, s_pad, d)
-
-    qb, kb, vb = to_bh(q), to_bh(k), to_bh(v)
+    block_q, block_k, s_pad = _flash_dims(s, block_q, block_k)
+    dot_precision = _flash_precision(q.dtype)
+    qb, kb, vb = (_to_bh(x, s_pad) for x in (q, k, v))
     nq, nk = s_pad // block_q, s_pad // block_k
 
-    def kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref):
+    def kernel(q_ref, k_ref, v_ref, o_ref, *rest):
+        if want_lse:
+            lse_ref, m_ref, l_ref, acc_ref = rest
+        else:
+            m_ref, l_ref, acc_ref = rest
         iq = pl.program_id(1)
         kb_idx = pl.program_id(2)
 
@@ -442,6 +484,8 @@ def flash_attention(
             # Padded query rows attended block 0's valid keys, so l > 0
             # everywhere (rows are sliced off by the wrapper anyway).
             o_ref[0] = (acc_ref[...] / l_ref[...]).astype(o_ref.dtype)
+            if want_lse:
+                lse_ref[0] = m_ref[...] + jnp.log(l_ref[...])
 
     # NOTE: causal fully-masked k blocks still stream from HBM (the
     # pl.when skips only their compute). A clamped kv index_map that
@@ -449,16 +493,25 @@ def flash_attention(
     # no better at s=4096 and only ~12% at s=16k (the dynamic index
     # costs Mosaic pipelining about what the skipped DMAs save); the
     # simple map stays.
-    out = pl.pallas_call(
+    out_shape = [jax.ShapeDtypeStruct((b * h, s_pad, d), q.dtype)]
+    out_specs = [pl.BlockSpec((1, block_q, d), lambda i, j, kk: (i, j, 0))]
+    if want_lse:
+        out_shape.append(
+            jax.ShapeDtypeStruct((b * h, s_pad, 1), jnp.float32)
+        )
+        out_specs.append(
+            pl.BlockSpec((1, block_q, 1), lambda i, j, kk: (i, j, 0))
+        )
+    res = pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct((b * h, s_pad, d), q.dtype),
+        out_shape=out_shape,
         grid=(b * h, nq, nk),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda i, j, kk: (i, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda i, j, kk: (i, kk, 0)),
             pl.BlockSpec((1, block_k, d), lambda i, j, kk: (i, kk, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda i, j, kk: (i, j, 0)),
+        out_specs=out_specs,
         scratch_shapes=[
             pltpu.VMEM((block_q, 1), jnp.float32),
             pltpu.VMEM((block_q, 1), jnp.float32),
@@ -466,6 +519,233 @@ def flash_attention(
         ],
         interpret=interpret,
     )(qb, kb, vb)
-    return (
-        out.reshape(b, h, s_pad, d).transpose(0, 2, 1, 3)[:, :s]
+    if want_lse:
+        out, lse = res
+        return _from_bh(out, b, s, h, d), lse
+    return _from_bh(res[0], b, s, h, d)
+
+
+def _flash_backward(
+    q, k, v, out, lse, do, causal, scale, block_q, block_k, interpret
+):
+    """Recompute-based flash backward: with S = scale*QK^T (masked),
+    P = exp(S - lse), D_i = sum_d(dO ∘ O)_i, the gradients are
+
+        dV = P^T dO
+        dS = P ∘ (dO V^T - D)
+        dQ = scale * dS K        dK = scale * dS^T Q
+
+    Two kernels share the recompute recurrence so each keeps the
+    forward's O(block) VMEM residency: the dQ kernel walks k blocks
+    innermost accumulating one (block_q, d) dQ tile in scratch; the
+    dK/dV kernel walks q blocks innermost accumulating one (block_k, d)
+    tile of each. D is precomputed outside (one fused elementwise
+    reduce over d — XLA work, no kernel needed). Padded q rows carry
+    dO = 0 so they contribute nothing; padded keys are masked to P = 0
+    and their dK/dV rows are sliced off by the wrapper.
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, s, h, d = q.shape
+    block_q, block_k, s_pad = _flash_dims(s, block_q, block_k)
+    dot_precision = _flash_precision(q.dtype)
+    qb, kb, vb = (_to_bh(x, s_pad) for x in (q, k, v))
+    dob = _to_bh(do.astype(q.dtype), s_pad)
+    # D = rowsum(dO ∘ O): fp32, [bh, s_pad, 1]. Reduce over d FIRST in
+    # the original layout (one fused elementwise+reduce), then pad/
+    # transpose only the d=1 result — not two full [bh, s_pad, d] fp32
+    # intermediates. Padded rows are 0.
+    Db = _to_bh(
+        jnp.sum(
+            do.astype(jnp.float32) * out.astype(jnp.float32),
+            axis=-1,
+            keepdims=True,
+        ),
+        s_pad,
     )
+    nq, nk = s_pad // block_q, s_pad // block_k
+
+    def recompute_p(q_blk, k_blk, lse_blk, iq, ikb):
+        """The shared tile recompute: P = exp(S - lse), masked."""
+        sc = jax.lax.dot_general(
+            q_blk,
+            k_blk,
+            (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=dot_precision,
+        ) * jnp.float32(scale)
+        ki = ikb * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1
+        )
+        valid = ki < s
+        if causal:
+            qi = iq * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            valid = valid & (ki <= qi)
+        p = jnp.exp(jnp.where(valid, sc, _MASK_VALUE) - lse_blk)
+        # exp(_MASK - lse) underflows to 0 for any realistic lse, but a
+        # hard zero is exact for the padded/causal-masked entries.
+        return jnp.where(valid, p, 0.0)
+
+    def dq_kernel(
+        q_ref, k_ref, v_ref, do_ref, lse_ref, d_ref, dq_ref, dq_acc
+    ):
+        iq = pl.program_id(1)
+        ikb = pl.program_id(2)
+
+        @pl.when(ikb == 0)
+        def _init():
+            dq_acc[...] = jnp.zeros_like(dq_acc)
+
+        live = (
+            ikb * block_k <= iq * block_q + block_q - 1 if causal else True
+        )
+
+        @pl.when(live)
+        def _block():
+            p = recompute_p(q_ref[0], k_ref[0], lse_ref[0], iq, ikb)
+            dp = jax.lax.dot_general(
+                do_ref[0],
+                v_ref[0],
+                (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+                precision=dot_precision,
+            )
+            ds = p * (dp - d_ref[0])
+            dq_acc[...] += jax.lax.dot_general(
+                ds.astype(k_ref.dtype),
+                k_ref[0],
+                (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+                precision=dot_precision,
+            )
+
+        @pl.when(ikb == nk - 1)
+        def _finalize():
+            dq_ref[0] = (dq_acc[...] * jnp.float32(scale)).astype(
+                dq_ref.dtype
+            )
+
+    def dkv_kernel(
+        k_ref, v_ref, q_ref, do_ref, lse_ref, d_ref, dk_ref, dv_ref,
+        dk_acc, dv_acc,
+    ):
+        ikb = pl.program_id(1)
+        iq = pl.program_id(2)
+
+        @pl.when(iq == 0)
+        def _init():
+            dk_acc[...] = jnp.zeros_like(dk_acc)
+            dv_acc[...] = jnp.zeros_like(dv_acc)
+
+        live = (
+            ikb * block_k <= iq * block_q + block_q - 1 if causal else True
+        )
+
+        @pl.when(live)
+        def _block():
+            p = recompute_p(q_ref[0], k_ref[0], lse_ref[0], iq, ikb)
+            # P^T dO and dS^T Q as contracting-dim-0 dots (no explicit
+            # transpose — Mosaic keeps both operands in natural layout).
+            dv_acc[...] += jax.lax.dot_general(
+                p.astype(do_ref.dtype),
+                do_ref[0],
+                (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+                precision=dot_precision,
+            )
+            dp = jax.lax.dot_general(
+                do_ref[0],
+                v_ref[0],
+                (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+                precision=dot_precision,
+            )
+            ds = p * (dp - d_ref[0])
+            dk_acc[...] += jax.lax.dot_general(
+                ds.astype(q_ref.dtype),
+                q_ref[0],
+                (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+                precision=dot_precision,
+            )
+
+        @pl.when(iq == nq - 1)
+        def _finalize():
+            dk_ref[0] = (dk_acc[...] * jnp.float32(scale)).astype(
+                dk_ref.dtype
+            )
+            dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
+
+    q_spec = pl.BlockSpec((1, block_q, d), lambda i, j, kk: (i, j, 0))
+    k_spec_inner = pl.BlockSpec((1, block_k, d), lambda i, j, kk: (i, kk, 0))
+    lse_spec = pl.BlockSpec((1, block_q, 1), lambda i, j, kk: (i, j, 0))
+    dq = pl.pallas_call(
+        dq_kernel,
+        out_shape=jax.ShapeDtypeStruct((b * h, s_pad, d), q.dtype),
+        grid=(b * h, nq, nk),
+        in_specs=[q_spec, k_spec_inner, k_spec_inner, q_spec, lse_spec,
+                  lse_spec],
+        out_specs=q_spec,
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(qb, kb, vb, dob, lse, Db)
+
+    # dK/dV: k blocks outer, q blocks inner (the accumulator must
+    # persist across the innermost dimension).
+    k_spec_outer = pl.BlockSpec((1, block_k, d), lambda i, j, kk: (i, j, 0))
+    q_spec_inner = pl.BlockSpec((1, block_q, d), lambda i, j, kk: (i, kk, 0))
+    lse_spec_inner = pl.BlockSpec(
+        (1, block_q, 1), lambda i, j, kk: (i, kk, 0)
+    )
+    dk, dv = pl.pallas_call(
+        dkv_kernel,
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, s_pad, d), k.dtype),
+            jax.ShapeDtypeStruct((b * h, s_pad, d), v.dtype),
+        ],
+        grid=(b * h, nk, nq),
+        in_specs=[k_spec_outer, k_spec_outer, q_spec_inner, q_spec_inner,
+                  lse_spec_inner, lse_spec_inner],
+        out_specs=[k_spec_outer, k_spec_outer],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(kb, vb, qb, dob, lse, Db)
+
+    return (
+        _from_bh(dq, b, s, h, d),
+        _from_bh(dk, b, s, h, d),
+        _from_bh(dv, b, s, h, d),
+    )
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_attention(q, k, v, causal, scale, block_q, block_k, interpret):
+    # Primal (pure-inference) path: no lse output at all.
+    return _flash_forward(
+        q, k, v, causal, scale, block_q, block_k, interpret
+    )
+
+
+def _flash_attention_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+    out, lse = _flash_forward(
+        q, k, v, causal, scale, block_q, block_k, interpret, want_lse=True
+    )
+    return out, (q, k, v, out, lse)
+
+
+def _flash_attention_bwd(
+    causal, scale, block_q, block_k, interpret, residuals, do
+):
+    q, k, v, out, lse = residuals
+    return _flash_backward(
+        q, k, v, out, lse, do, causal, scale, block_q, block_k, interpret
+    )
+
+
+_flash_attention.defvjp(_flash_attention_fwd, _flash_attention_bwd)
