@@ -270,7 +270,8 @@ def ring_attention(
 
 
 def _sharded_attention_call(
-    local_fn, q, k, v, *, mesh, seq_axis, batch_axis, causal, scale
+    local_fn, q, k, v, *, mesh, seq_axis, batch_axis, causal, scale,
+    check_vma=True,
 ):
     from jax.sharding import PartitionSpec as P
 
@@ -294,17 +295,28 @@ def _sharded_attention_call(
             f"'{batch_axis}' axis size {mesh.shape[batch_axis]}."
         )
     spec = P(batch_axis, seq_axis, None, None)
-    fn = shard_map(
-        partial(
-            local_fn,
-            axis_name=seq_axis,
-            causal=causal,
-            scale=scale,
-        ),
-        mesh=mesh,
-        in_specs=(spec, spec, spec),
-        out_specs=spec,
+    local = partial(
+        local_fn,
+        axis_name=seq_axis,
+        causal=causal,
+        scale=scale,
     )
+    sm_kwargs = dict(
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
+    )
+    if check_vma:
+        fn = shard_map(local, **sm_kwargs)
+    else:
+        # The checker kwarg was renamed check_rep -> check_vma across
+        # jax versions; try newest-first, degrade to no kwarg (ancient
+        # versions have no checker to disable).
+        try:
+            fn = shard_map(local, **sm_kwargs, check_vma=False)
+        except TypeError:  # pragma: no cover - older jax
+            try:
+                fn = shard_map(local, **sm_kwargs, check_rep=False)
+            except TypeError:
+                fn = shard_map(local, **sm_kwargs)
     return fn(q, k, v)
 
 
@@ -493,11 +505,22 @@ def _flash_forward(
     # no better at s=4096 and only ~12% at s=16k (the dynamic index
     # costs Mosaic pipelining about what the skipped DMAs save); the
     # simple map stays.
-    out_shape = [jax.ShapeDtypeStruct((b * h, s_pad, d), q.dtype)]
+    # Inside a shard_map trace (the ring_flash composition) the output
+    # avals must declare how they vary over the manual mesh axes;
+    # outside one, typeof(...).vma is empty and the kwarg is a no-op.
+    # Older jax has neither typeof().vma nor the kwarg — omit it there
+    # (such versions predate the vma checker entirely).
+    try:
+        aval_kw = {"vma": jax.typeof(qb).vma}
+    except AttributeError:  # pragma: no cover - older jax
+        aval_kw = {}
+    out_shape = [
+        jax.ShapeDtypeStruct((b * h, s_pad, d), q.dtype, **aval_kw)
+    ]
     out_specs = [pl.BlockSpec((1, block_q, d), lambda i, j, kk: (i, j, 0))]
     if want_lse:
         out_shape.append(
-            jax.ShapeDtypeStruct((b * h, s_pad, 1), jnp.float32)
+            jax.ShapeDtypeStruct((b * h, s_pad, 1), jnp.float32, **aval_kw)
         )
         out_specs.append(
             pl.BlockSpec((1, block_q, 1), lambda i, j, kk: (i, j, 0))
@@ -526,7 +549,8 @@ def _flash_forward(
 
 
 def _flash_backward(
-    q, k, v, out, lse, do, causal, scale, block_q, block_k, interpret
+    q, k, v, out, lse, do, causal, scale, block_q, block_k, interpret,
+    dlse=None,
 ):
     """Recompute-based flash backward: with S = scale*QK^T (masked),
     P = exp(S - lse), D_i = sum_d(dO ∘ O)_i, the gradients are
@@ -534,6 +558,11 @@ def _flash_backward(
         dV = P^T dO
         dS = P ∘ (dO V^T - D)
         dQ = scale * dS K        dK = scale * dS^T Q
+
+    When the caller also consumes the lse output (the ring_flash merge
+    does), its cotangent folds in analytically: d lse_i/d S_ij = P_ij
+    (the normalized row), so dS = P ∘ (dO V^T - (D - dlse)) — i.e. the
+    same kernels run with D' = D - dlse, zero kernel changes.
 
     Two kernels share the recompute recurrence so each keeps the
     forward's O(block) VMEM residency: the dQ kernel walks k blocks
@@ -564,6 +593,8 @@ def _flash_backward(
         ),
         s_pad,
     )
+    if dlse is not None:
+        Db = Db - dlse.astype(jnp.float32)
     nq, nk = s_pad // block_q, s_pad // block_k
 
     def recompute_p(q_blk, k_blk, lse_blk, iq, ikb):
@@ -749,3 +780,171 @@ def _flash_attention_bwd(
 
 
 _flash_attention.defvjp(_flash_attention_fwd, _flash_attention_bwd)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_attention_lse(q, k, v, causal, scale, block_q, block_k, interpret):
+    """Flash forward returning ``(out, lse)`` with a VJP that accepts
+    BOTH cotangents — the entry point for callers that consume lse (the
+    ring_flash block merge)."""
+    return _flash_forward(
+        q, k, v, causal, scale, block_q, block_k, interpret, want_lse=True
+    )
+
+
+def _flash_attention_lse_fwd(
+    q, k, v, causal, scale, block_q, block_k, interpret
+):
+    out, lse = _flash_forward(
+        q, k, v, causal, scale, block_q, block_k, interpret, want_lse=True
+    )
+    return (out, lse), (q, k, v, out, lse)
+
+
+def _flash_attention_lse_bwd(
+    causal, scale, block_q, block_k, interpret, residuals, cts
+):
+    do, dlse = cts
+    q, k, v, out, lse = residuals
+    return _flash_backward(
+        q, k, v, out, lse, do, causal, scale, block_q, block_k, interpret,
+        dlse=dlse,
+    )
+
+
+_flash_attention_lse.defvjp(_flash_attention_lse_fwd, _flash_attention_lse_bwd)
+
+
+def ring_flash_attention_local(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis_name: str,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """The composed tier — flash WITHIN the chip, ring ACROSS chips:
+    the per-device ring program whose block compute is the Pallas flash
+    kernel instead of a dense einsum, so per-device VMEM residency is
+    O(block) in BOTH the local and the streamed dimension while the
+    sequence is sharded over ``axis_name``. Exact full attention; fully
+    differentiable (the flash kernels carry their ``custom_vjp``, the
+    merge is plain jnp, and ``ppermute``'s backward is the inverse
+    rotation).
+
+    Each ring step computes ``(o_t, lse_t)`` for the held K/V block via
+    the flash forward (which emits the per-row log-sum-exp) and folds it
+    into the running output with the standard two-block softmax merge::
+
+        lse' = logaddexp(lse, lse_t)
+        o'   = o * exp(lse - lse') + o_t * exp(lse_t - lse')
+
+    With equal shards the causal structure is block-triangular per ring
+    step: the t=0 block is the diagonal (causal flash on local
+    indices), a source shard strictly before this device's is fully
+    live (non-causal flash), and one strictly after is fully masked
+    (skipped — contributes ``lse_t = -inf``). ``lax.switch`` selects
+    among the three statically-shaped branches at run time.
+    """
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    _check_self_attention_shapes(q, k, v)
+    n = lax.psum(1, axis_name)
+    my = lax.axis_index(axis_name)
+    b, sq, h, d = q.shape
+    scale = float(scale)
+
+    def flash_block(k_blk, v_blk, blk_causal):
+        o_t, lse_t = _flash_attention_lse(
+            q, k_blk, v_blk, blk_causal, scale, block_q, block_k,
+            interpret,
+        )
+        # lse [b*h, s_pad, 1] -> [b, sq, h, 1]: _from_bh with d=1.
+        return o_t.astype(jnp.float32), _from_bh(lse_t, b, sq, h, 1)
+
+    def merge(o, lse, o_t, lse_t):
+        lse_new = jnp.logaddexp(lse, lse_t)
+        return (
+            o * jnp.exp(lse - lse_new) + o_t * jnp.exp(lse_t - lse_new),
+            lse_new,
+        )
+
+    def step(carry, _):
+        k_blk, v_blk, t, o, lse = carry
+        if causal:
+            src = (my + t) % n
+
+            def diag(_):
+                return flash_block(k_blk, v_blk, True)
+
+            def past(_):
+                return flash_block(k_blk, v_blk, False)
+
+            def future(_):
+                return (
+                    jnp.zeros((b, sq, h, d), jnp.float32),
+                    jnp.full((b, sq, h, 1), _MASK_VALUE, jnp.float32),
+                )
+
+            idx = jnp.where(src == my, 0, jnp.where(src < my, 1, 2))
+            o_t, lse_t = lax.switch(idx, [diag, past, future], None)
+        else:
+            o_t, lse_t = flash_block(k_blk, v_blk, False)
+        o, lse = merge(o, lse, o_t, lse_t)
+        perm = [(i, (i - 1) % n) for i in range(n)]
+        k_blk = lax.ppermute(k_blk, axis_name, perm)
+        v_blk = lax.ppermute(v_blk, axis_name, perm)
+        return (k_blk, v_blk, t + 1, o, lse), None
+
+    # Carries derived from q for identical device-varying provenance on
+    # every mesh shape (see ring_attention_local's init note).
+    zeros = q.astype(jnp.float32) * jnp.float32(0.0)
+    o0 = zeros
+    lse0 = zeros[..., :1] + jnp.float32(_MASK_VALUE)
+    (_, _, _, o, _), _ = lax.scan(
+        step, (k, v, jnp.int32(0), o0, lse0), None, length=n
+    )
+    return o.astype(q.dtype)
+
+
+def ring_flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    mesh,
+    seq_axis: str,
+    batch_axis: Optional[str] = None,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """One-call composed-tier attention — same contract as
+    :func:`ring_attention` (global arrays, sequence sharded over
+    ``seq_axis``, optional ``batch_axis``), with the Pallas flash
+    kernel as each device's block compute: O(block) VMEM within the
+    chip, O(S/n) HBM per chip across the ring."""
+    local = partial(
+        ring_flash_attention_local,
+        block_q=block_q,
+        block_k=block_k,
+        interpret=interpret,
+    )
+    # check_vma off: Pallas' interpret-mode lowering builds internal
+    # dynamic_slices whose index operands carry no varying-manual-axes
+    # annotation, which the shard_map vma checker rejects (jax's own
+    # error suggests exactly this workaround). Correctness is pinned
+    # the stronger way — value/grad parity vs the dense oracle.
+    return _sharded_attention_call(
+        local, q, k, v,
+        mesh=mesh, seq_axis=seq_axis, batch_axis=batch_axis,
+        causal=causal, scale=scale, check_vma=False,
+    )
