@@ -45,6 +45,31 @@ def test_multihost_batches_match_permutation_slices(case_seed):
     shuffle = rng.random() < 0.8
 
     source = ArraySource({"x": np.arange(n, dtype=np.int64)})
+
+    # A train split smaller than one global batch (with effective
+    # remainder-dropping) is rejected loudly — the zero-step-epoch
+    # guard. Sampled configs landing there pin the REJECTION contract
+    # instead of the slicing one.
+    g = batch_size * host_count
+    effective_drop = drop_remainder or host_count > 1
+    if effective_drop and n < g:
+        with pytest.raises(ValueError, match="zero batches"):
+            list(
+                batch_iterator(
+                    source,
+                    None,
+                    batch_size,
+                    training=True,
+                    shuffle=shuffle,
+                    seed=seed,
+                    epoch=epoch,
+                    drop_remainder=drop_remainder,
+                    host_index=0,
+                    host_count=host_count,
+                )
+            )
+        return
+
     per_host = []
     for h in range(host_count):
         batches = list(
@@ -66,9 +91,7 @@ def test_multihost_batches_match_permutation_slices(case_seed):
     order = (
         expected_order(seed, epoch, n) if shuffle else np.arange(n)
     )
-    g = batch_size * host_count
     # Multi-host FORCES drop_remainder (desync safety).
-    effective_drop = drop_remainder or host_count > 1
     expected_batches = n // g if effective_drop else -(-n // g)
 
     # Every counted batch has a non-empty slice on every host: dropping

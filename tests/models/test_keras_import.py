@@ -63,6 +63,7 @@ def _randomize(keras_model, seed=0):
             )
 
 
+@pytest.mark.slow
 def test_simple_cnn_forward_parity():
     input_shape, features, dense_units, n = (8, 8, 1), (4, 8), (16,), 10
     keras_model = _keras_simple_cnn(input_shape, features, dense_units, n)
@@ -181,6 +182,7 @@ def test_mismatches_are_loud():
         import_keras_weights(tiny, params2, state2)
 
 
+@pytest.mark.slow
 def test_custom_learnables_refuse_import():
     """Models with params outside the conv/dense/BN structures (e.g.
     ReActNet's RSign/RPReLU shifts) must refuse order-aligned import

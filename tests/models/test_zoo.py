@@ -27,6 +27,7 @@ def build_and_forward(model_cls, conf, input_shape, num_classes=10, batch=2):
     return logits, params, model_state, module, x
 
 
+@pytest.mark.slow
 def test_binary_net_cifar_shape():
     logits, params, *_ = build_and_forward(
         BinaryNet,
@@ -37,11 +38,13 @@ def test_binary_net_cifar_shape():
     assert np.all(np.isfinite(np.asarray(logits)))
 
 
+@pytest.mark.slow
 def test_binary_alexnet_imagenet_shape():
     logits, *_ = build_and_forward(BinaryAlexNet, {}, (224, 224, 3), 1000)
     assert logits.shape == (2, 1000)
 
 
+@pytest.mark.slow
 def test_birealnet_shape_and_param_count():
     logits, params, *_ = build_and_forward(BiRealNet, {}, (224, 224, 3), 1000)
     assert logits.shape == (2, 1000)
@@ -66,6 +69,7 @@ def test_quicknet_large_deeper_than_quicknet():
     assert nblocks(QuickNetLarge) > nblocks(QuickNet)
 
 
+@pytest.mark.slow
 def test_resnet50_shape_and_params():
     logits, params, *_ = build_and_forward(ResNet50, {}, (224, 224, 3), 1000)
     assert logits.shape == (2, 1000)
@@ -143,6 +147,7 @@ def test_binary_resnet_e18_shape_and_params():
         ("BinaryDenseNet45", (6, 12, 14, 8)),
     ],
 )
+@pytest.mark.slow
 def test_binary_densenet_variants(cls_name, layers):
     import zookeeper_tpu.models as zoo
 
@@ -159,6 +164,7 @@ def test_binary_densenet_variants(cls_name, layers):
     assert np.all(np.isfinite(np.asarray(logits)))
 
 
+@pytest.mark.slow
 def test_binary_densenet_dilated_keeps_resolution():
     """Dilated variant: blocks 3/4 trade downsampling for dilation — two
     transition maxpools are skipped, so the final stage runs at 16x the
@@ -172,6 +178,7 @@ def test_binary_densenet_dilated_keeps_resolution():
     assert l37.shape == l37d.shape == (2, 10)
 
 
+@pytest.mark.slow
 def test_xnornet_shape_and_params():
     from zookeeper_tpu.models import XNORNet
 
@@ -182,6 +189,7 @@ def test_xnornet_shape_and_params():
     assert 45e6 < n_params < 75e6
 
 
+@pytest.mark.slow
 def test_dorefanet_shape_and_activation_bits():
     from zookeeper_tpu.models import DoReFaNet
 
@@ -247,6 +255,7 @@ def test_new_zoo_subclass_by_name_lookup():
 @pytest.mark.parametrize(
     "cls_name", ["BinaryResNetE18", "RealToBinaryNet", "BinaryDenseNet28"]
 )
+@pytest.mark.slow
 def test_new_models_train_one_step(cls_name):
     import optax
 
@@ -344,6 +353,7 @@ def test_rprelu_shifted_prelu():
     np.testing.assert_allclose(np.asarray(y), [[2.0, -0.5]])
 
 
+@pytest.mark.slow
 def test_reactnet_shape_params_and_doubling():
     from zookeeper_tpu.models import ReActNet
 
@@ -354,6 +364,7 @@ def test_reactnet_shape_params_and_doubling():
     assert 20e6 < n_params < 40e6
 
 
+@pytest.mark.slow
 def test_reactnet_trains_one_step_and_binary_paths():
     import optax
 
@@ -415,6 +426,7 @@ def test_reactnet_trains_one_step_and_binary_paths():
     )
 
 
+@pytest.mark.slow
 def test_meliusnet_shape_params_and_improvement_semantics():
     from zookeeper_tpu.models import MeliusNet22
     from zookeeper_tpu.models.binary import (
@@ -448,6 +460,7 @@ def test_meliusnet_shape_params_and_improvement_semantics():
     assert 4e6 < n_params < 12e6
 
 
+@pytest.mark.slow
 def test_meliusnet_trains_one_step():
     import optax
 

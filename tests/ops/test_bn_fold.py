@@ -187,6 +187,7 @@ def test_fold_bn_other_families_match_unfolded_eval(
     )
 
 
+@pytest.mark.slow
 def test_fold_bn_binaryalexnet_dense_stage():
     """BinaryAlexNet folds its DENSE stage only (dense holds ~80% of its
     params): the dense-only packed deployment's BNs fold; the conv BNs
@@ -305,6 +306,7 @@ def test_fold_bn_binarynet_dense_stage():
         bad.init(jax.random.PRNGKey(0), x, training=False)
 
 
+@pytest.mark.slow
 def test_fold_bn_xnornet_both_stages():
     """XNOR-Net is the one AlexNet-shaped family where BOTH stages fold:
     every binary layer (conv and dense) is directly BN-followed — its

@@ -32,6 +32,7 @@ def _patch_oracle(x, kernel, bias, kernel_size, strides, padding):
     ("SAME", (2, 2)),
     (((1, 0), (0, 2)), (1, 2)),
 ])
+@pytest.mark.slow
 def test_local2d_matches_patch_oracle(padding, strides):
     rng = np.random.default_rng(0)
     x = jnp.asarray(rng.normal(size=(2, 7, 6, 3)), jnp.float32)

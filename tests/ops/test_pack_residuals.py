@@ -42,6 +42,7 @@ def random_signs(shape, seed=0, dtype=jnp.float32):
     ],
 )
 @pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+@pytest.mark.slow
 def test_pack_resid_pm1_roundtrip(shape, dtype):
     x = random_signs(shape, seed=2, dtype=dtype)
     words = pack_resid(x)
@@ -188,6 +189,7 @@ def _quantconv_loss_and_grads(pack_residuals, dtype=jnp.bfloat16):
     return l, grads, gx
 
 
+@pytest.mark.slow
 def test_quantconv_pack_residuals_end_to_end_exact():
     l0, g0, gx0 = _quantconv_loss_and_grads(False)
     l1, g1, gx1 = _quantconv_loss_and_grads(True)

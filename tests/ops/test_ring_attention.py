@@ -12,7 +12,9 @@ from jax.sharding import Mesh
 from zookeeper_tpu.ops import (
     all_to_all_attention,
     attention_reference,
+    flash_attention,
     ring_attention,
+    ring_flash_attention,
 )
 
 
@@ -42,6 +44,7 @@ def test_ring_matches_full_attention(n, causal):
     )
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("causal", [False, True])
 def test_ring_gradients_match_full_attention(causal):
     mesh = _mesh(8)
@@ -146,6 +149,7 @@ def test_all_to_all_matches_full_attention(n, causal):
     )
 
 
+@pytest.mark.slow
 def test_all_to_all_gradients_match_full_attention():
     mesh = _mesh(8)
     q, k, v = _qkv(seed=9, h=8)
@@ -194,9 +198,6 @@ def test_all_to_all_composes_with_data_parallel_mesh():
     np.testing.assert_allclose(
         np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5
     )
-
-
-from zookeeper_tpu.ops import flash_attention  # noqa: E402
 
 
 @pytest.mark.parametrize("causal", [False, True])
@@ -409,9 +410,6 @@ def test_flash_attention_grad_composes_under_jit_and_value():
     )
 
 
-from zookeeper_tpu.ops import ring_flash_attention  # noqa: E402
-
-
 @pytest.mark.parametrize("n", [1, 2, 8])
 @pytest.mark.parametrize("causal", [False, True])
 def test_ring_flash_matches_full_attention(n, causal):
@@ -430,6 +428,7 @@ def test_ring_flash_matches_full_attention(n, causal):
     )
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("n", [1, 2, 8])
 @pytest.mark.parametrize("causal", [False, True])
 def test_ring_flash_gradients_match_full_attention(n, causal):

@@ -328,6 +328,7 @@ def test_quantconv_packed_weights_params_are_32x_smaller():
     assert packed_bytes * 28 < float_bytes  # ~32x (scale overhead aside)
 
 
+@pytest.mark.slow
 def test_quicknet_large_inference_through_pallas_bit_exact():
     """The flagship criterion: QuickNet-Large (full depth, reduced input
     resolution for CPU runtime) runs inference through the Pallas packed

@@ -197,6 +197,7 @@ def test_bop_rejects_dead_base_fields():
         opt.build(total_steps=10)
 
 
+@pytest.mark.slow
 def test_flip_ratio_raises_when_pattern_matches_nothing():
     from zookeeper_tpu.training import Adam, make_train_step
 
@@ -312,6 +313,7 @@ def test_gradient_accumulation_semantics():
     np.testing.assert_allclose(np.asarray(p2), np.asarray(expected), rtol=1e-6)
 
 
+@pytest.mark.slow
 def test_bop_with_accumulation_flips_on_boundary():
     from zookeeper_tpu.training import make_train_step
 
@@ -344,6 +346,7 @@ def test_bop_with_accumulation_flips_on_boundary():
     assert moved  # Boundary step applies the accumulated update.
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("cls_name", ["Lamb", "Lars"])
 def test_large_batch_optimizers_step(cls_name):
     import zookeeper_tpu.training as tr
@@ -408,6 +411,7 @@ def test_accumulated_schedule_equals_reference_trajectory():
     np.testing.assert_allclose(np.asarray(p), np.asarray(p_ref), rtol=1e-6)
 
 
+@pytest.mark.slow
 def test_bop_accumulation_fp_side_single_wrapped():
     """The unscoped accumulate_steps key scope-inherits onto
     fp_optimizer; Bop must still apply accumulation ONCE — fp params
@@ -475,6 +479,7 @@ def test_scale_by_bop_state_structure_stable_under_scheduling():
     assert jax.tree.structure(s_const) == jax.tree.structure(s_sched)
 
 
+@pytest.mark.slow
 def test_bop_component_gamma_schedule_runs():
     """gamma_schedule configured by subclass name drives the binary side;
     the step still trains end-to-end."""

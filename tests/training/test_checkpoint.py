@@ -288,6 +288,7 @@ def test_step_save_defers_to_epoch_save_on_shared_step(tmp_path):
     exp.checkpointer.close()
 
 
+@pytest.mark.slow
 def test_midepoch_resume_bit_exact_under_dp_sharding(tmp_path):
     """The sharded interaction: restore_state() of a step-granular
     checkpoint onto a DataParallel mesh + the pipeline's start_batch

@@ -101,6 +101,7 @@ def test_weight_decay_applies_to_all_optimizers():
         assert float(new["w"][0]) < 1.0, cls.__name__
 
 
+@pytest.mark.slow
 def test_remat_policies_match_no_remat_exactly():
     """Remat changes WHEN activations exist, never WHAT is computed:
     loss, metrics, and updated params must match the no-remat step

@@ -511,9 +511,14 @@ def _flash_forward(
     # Older jax has neither typeof().vma nor the kwarg — omit it there
     # (such versions predate the vma checker entirely).
     try:
-        aval_kw = {"vma": jax.typeof(qb).vma}
+        vma = jax.typeof(qb).vma
     except AttributeError:  # pragma: no cover - older jax
-        aval_kw = {}
+        vma = None
+    # Attach the kwarg only when the set is non-empty: every jax new
+    # enough to run a pallas_call under manual axes supports it, while
+    # plain single-device calls (vma empty/absent) stay compatible with
+    # versions whose ShapeDtypeStruct lacks the parameter.
+    aval_kw = {"vma": vma} if vma else {}
     out_shape = [
         jax.ShapeDtypeStruct((b * h, s_pad, d), q.dtype, **aval_kw)
     ]
