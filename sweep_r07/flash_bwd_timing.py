@@ -1,0 +1,65 @@
+"""On-chip timing: flash fwd+bwd vs XLA's fused dense path, long-chain
+marginal protocol (BASELINE.md methodology). The forward measured at
+parity with dense (round-6); this asks the same honest question of the
+recompute backward."""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from zookeeper_tpu.ops import attention_reference, flash_attention
+
+
+def time_marginal(run, n1, n2, rounds=4):
+    t1 = min(run(n1) for _ in range(rounds))
+    t2 = min(run(n2) for _ in range(rounds))
+    return (t2 - t1) / (n2 - n1)
+
+
+def bench(s, causal=True, b=1, h=8, d=64, dtype=jnp.bfloat16):
+    rng = np.random.default_rng(0)
+    mk = lambda: jnp.asarray(
+        rng.normal(size=(b, s, h, d)).astype(np.float32), dtype
+    )
+    q, k, v = mk(), mk(), mk()
+
+    def make_chain(fn):
+        @jax.jit
+        def val_grad(q):
+            return jax.value_and_grad(
+                lambda q: fn(q).astype(jnp.float32).sum()
+            )(q)
+
+        def run(n):
+            x = q
+            t0 = time.perf_counter()
+            for _ in range(n):
+                _, g = val_grad(x)
+                # Data dependency: next iterate consumes the gradient.
+                x = x + 0 * g.astype(x.dtype)
+            float(jax.device_get(g.astype(jnp.float32).sum()))
+            return time.perf_counter() - t0
+
+        run(2)  # warm compile
+        return run
+
+    flash_run = make_chain(
+        lambda q: flash_attention(q, k, v, causal=causal, interpret=False)
+    )
+    dense_run = make_chain(
+        lambda q: attention_reference(q, k, v, causal=causal)
+    )
+    mf = time_marginal(flash_run, 10, 40) * 1e3
+    md = time_marginal(dense_run, 10, 40) * 1e3
+    print(
+        f"s={s} causal={causal} {np.dtype(dtype).name}: "
+        f"flash fwd+bwd {mf:.2f} ms/step, dense fwd+bwd {md:.2f} ms/step "
+        f"(ratio {mf / md:.2f}x)"
+    )
+
+
+if __name__ == "__main__":
+    for s in (2048, 4096, 8192):
+        bench(s)

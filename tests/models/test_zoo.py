@@ -255,7 +255,6 @@ def test_new_zoo_subclass_by_name_lookup():
 @pytest.mark.parametrize(
     "cls_name", ["BinaryResNetE18", "RealToBinaryNet", "BinaryDenseNet28"]
 )
-@pytest.mark.slow
 def test_new_models_train_one_step(cls_name):
     import optax
 
@@ -364,7 +363,6 @@ def test_reactnet_shape_params_and_doubling():
     assert 20e6 < n_params < 40e6
 
 
-@pytest.mark.slow
 def test_reactnet_trains_one_step_and_binary_paths():
     import optax
 
@@ -460,7 +458,6 @@ def test_meliusnet_shape_params_and_improvement_semantics():
     assert 4e6 < n_params < 12e6
 
 
-@pytest.mark.slow
 def test_meliusnet_trains_one_step():
     import optax
 

@@ -517,3 +517,52 @@ def test_ring_flash_bf16():
     np.testing.assert_allclose(
         np.asarray(out, np.float32), np.asarray(ref), atol=3e-2
     )
+
+
+def test_flash_auto_block_policy_aligned_and_bounded_waste():
+    """The auto block defaults: largest aligned candidate with padding
+    waste under 1/8 of the sequence; the short-sequence clamp stays
+    16-aligned (a raw-s clamp would hand Mosaic a tile-unaligned block
+    for awkward lengths like 999)."""
+    from zookeeper_tpu.ops.attention import (
+        _default_flash_blocks,
+        _flash_dims,
+    )
+
+    for s, want_auto in [
+        (2048, 1024), (4096, 1024), (8192, 1024),  # powers of two: max
+        (999, 1024),   # single padded tile (clamped to 1008 below)
+        (1100, 128),   # big blocks would pad to 2048 (+86%): fall back
+        (1280, 256),   # exact multiple of 256, not of 512/1024
+        (100, 128),
+    ]:
+        bq, bk = _default_flash_blocks(s, None, None)
+        assert (bq, bk) == (want_auto, want_auto), s
+        cq, ck, s_pad = _flash_dims(s, bq, bk)
+        assert cq % 8 == 0 and ck % 8 == 0, s
+        assert s_pad >= s and (s_pad - s) <= max(s // 8, 16), s
+    # Explicit sizes pass through untouched (modulo the short-seq clamp).
+    assert _default_flash_blocks(4096, 256, 512) == (256, 512)
+
+
+@pytest.mark.parametrize("s", [999, 1100])
+def test_flash_attention_awkward_lengths_exact(s):
+    """Values and gradients stay exact at tile-awkward sequence lengths
+    under the auto block policy (padding + masking path)."""
+    rng = np.random.default_rng(s)
+    mk = lambda: jnp.asarray(rng.normal(size=(1, s, 2, 8)).astype(np.float32))
+    q, k, v = mk(), mk(), mk()
+    out = flash_attention(q, k, v, causal=True, interpret=True)
+    ref = attention_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5
+    )
+    g = jax.grad(
+        lambda q: flash_attention(q, k, v, causal=True, interpret=True).sum()
+    )(q)
+    gr = jax.grad(
+        lambda q: attention_reference(q, k, v, causal=True).sum()
+    )(q)
+    np.testing.assert_allclose(
+        np.asarray(g), np.asarray(gr), atol=5e-5, rtol=5e-5
+    )
