@@ -566,3 +566,51 @@ def test_flash_attention_awkward_lengths_exact(s):
     np.testing.assert_allclose(
         np.asarray(g), np.asarray(gr), atol=5e-5, rtol=5e-5
     )
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_all_to_all_flash_local_matches_dense(causal):
+    """Ulysses with the flash kernel as its local compute (the
+    long-context variant): exact values AND gradients vs the dense
+    oracle."""
+    mesh = _mesh(8)
+    q, k, v = _qkv(seed=21 + causal, s=32, h=8)
+    ref = attention_reference(q, k, v, causal=causal)
+    out = all_to_all_attention(
+        q, k, v, mesh=mesh, seq_axis="sp", causal=causal,
+        local_attention="flash",
+    )
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5
+    )
+
+    w = jnp.asarray(
+        np.random.default_rng(7).normal(size=q.shape).astype(np.float32)
+    )
+    g_ref = jax.grad(
+        lambda q, k, v: (attention_reference(q, k, v, causal=causal) * w).sum(),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    g_f = jax.grad(
+        lambda q, k, v: (
+            all_to_all_attention(
+                q, k, v, mesh=mesh, seq_axis="sp", causal=causal,
+                local_attention="flash",
+            )
+            * w
+        ).sum(),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    for a, b_ in zip(g_f, g_ref):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b_), atol=5e-5, rtol=5e-5
+        )
+
+
+def test_all_to_all_rejects_unknown_local_attention():
+    mesh = _mesh(1)
+    q, k, v = _qkv(seed=0, h=8)
+    with pytest.raises(ValueError, match="local_attention"):
+        all_to_all_attention(
+            q, k, v, mesh=mesh, seq_axis="sp", local_attention="sparse"
+        )

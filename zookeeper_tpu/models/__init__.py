@@ -33,6 +33,10 @@ from zookeeper_tpu.models.binary import (
     XNORNet,
 )
 from zookeeper_tpu.models.resnet import ResNet50, ResNet101, ResNet152
+from zookeeper_tpu.models.transformer import (
+    TransformerLM,
+    TransformerLMModule,
+)
 from zookeeper_tpu.models.summary import ModelSummary, model_summary
 
 __all__ = [
@@ -51,6 +55,8 @@ __all__ = [
     "DoReFaNet",
     "MeliusNet22",
     "Mlp",
+    "TransformerLM",
+    "TransformerLMModule",
     "Model",
     "QuickNet",
     "QuickNetLarge",

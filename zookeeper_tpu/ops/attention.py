@@ -189,19 +189,31 @@ def all_to_all_attention_local(
     axis_name: str,
     causal: bool = False,
     scale: Optional[float] = None,
+    local_attention: str = "dense",
 ) -> jax.Array:
     """Ulysses-style sequence parallelism (the brief's OTHER named SP
     flavor): instead of streaming K/V around a ring, one
     ``lax.all_to_all`` re-shards from sequence-sharded
-    ``[b, s/n, h, d]`` to HEAD-sharded ``[b, s, h/n, d]``, runs plain
-    dense attention locally (each device owns whole heads, so causal
-    masking needs no global-position bookkeeping), and a second
-    all_to_all re-shards back. Four all_to_all collectives per call
-    (q, k, v in; out back) vs the ring's 2n ppermutes (K and V per
-    step) — cheaper at moderate sequence lengths; the ring wins when
-    even one head's full-sequence scores would not fit. Requires
-    ``heads % axis_size == 0``.
+    ``[b, s/n, h, d]`` to HEAD-sharded ``[b, s, h/n, d]``, runs the
+    local attention (each device owns whole heads, so causal masking
+    needs no global-position bookkeeping), and a second all_to_all
+    re-shards back. Four all_to_all collectives per call (q, k, v in;
+    out back) vs the ring's 2n ppermutes (K and V per step) — cheaper
+    at moderate sequence lengths. Requires ``heads % axis_size == 0``.
+
+    ``local_attention`` picks the per-device compute: ``"dense"``
+    materializes the full ``[s, s]`` scores per held head (fine at
+    moderate s, the exact-oracle default), ``"flash"`` runs the Pallas
+    flash kernel instead — O(block) VMEM at any length, which is what
+    makes the Ulysses flavor long-context-capable (at s=16k the dense
+    local scores alone are 8 GB and OOM; flash trains that length —
+    sweep_r07/flash_bwd_timing.py).
     """
+    if local_attention not in ("dense", "flash"):
+        raise ValueError(
+            f"local_attention={local_attention!r}: expected 'dense' or "
+            "'flash'."
+        )
     _check_self_attention_shapes(q, k, v)
     n = lax.psum(1, axis_name)
     if q.shape[2] % n != 0:
@@ -211,7 +223,10 @@ def all_to_all_attention_local(
             "to give every device whole heads."
         )
     a2a = partial(lax.all_to_all, axis_name=axis_name, tiled=True)
-    out = attention_reference(
+    local_fn = (
+        flash_attention if local_attention == "flash" else attention_reference
+    )
+    out = local_fn(
         a2a(q, split_axis=2, concat_axis=1),
         a2a(k, split_axis=2, concat_axis=1),
         a2a(v, split_axis=2, concat_axis=1),
@@ -231,14 +246,24 @@ def all_to_all_attention(
     batch_axis: Optional[str] = None,
     causal: bool = False,
     scale: Optional[float] = None,
+    local_attention: str = "dense",
 ) -> jax.Array:
     """One-call Ulysses attention — same contract as
     :func:`ring_attention` (global arrays, sequence sharded over
-    ``seq_axis``, optional ``batch_axis``), different comm pattern."""
+    ``seq_axis``, optional ``batch_axis``), different comm pattern.
+    ``local_attention="flash"`` swaps the per-device dense compute for
+    the Pallas flash kernel (long-context Ulysses; see
+    :func:`all_to_all_attention_local`)."""
+    local = partial(
+        all_to_all_attention_local, local_attention=local_attention
+    )
     return _sharded_attention_call(
-        all_to_all_attention_local, q, k, v,
+        local, q, k, v,
         mesh=mesh, seq_axis=seq_axis, batch_axis=batch_axis,
         causal=causal, scale=scale,
+        # Pallas interpret-mode lowering is not vma-annotated (same
+        # workaround as ring_flash).
+        check_vma=local_attention != "flash",
     )
 
 
