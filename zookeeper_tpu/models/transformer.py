@@ -38,6 +38,27 @@ from zookeeper_tpu.models.base import Model
 from zookeeper_tpu.ops import attention_reference, flash_attention
 
 
+def _resolve_attention(attention):
+    """``"flash"`` / ``"dense"`` / any ``callable(q, k, v, *, causal)``
+    — the callable form is how sequence parallelism plugs in (e.g.
+    ``partial(ring_flash_attention, mesh=mesh, seq_axis="sp",
+    batch_axis="data")`` shards the attention over a mesh while the
+    rest of the model runs an ordinary pjit program). Checked at the
+    MODULE level too (it is public API): a typo'd tier must not
+    silently fall back to dense — at s=16k that materializes the
+    [s, s] scores and OOMs."""
+    if callable(attention):
+        return attention
+    if attention == "flash":
+        return flash_attention
+    if attention == "dense":
+        return attention_reference
+    raise ValueError(
+        f"attention={attention!r}: expected 'flash', 'dense', or an "
+        "attention callable."
+    )
+
+
 class RMSNorm(nn.Module):
     """Root-mean-square layernorm (no mean subtraction, no bias): the
     cheaper norm that long-context transformer stacks standardized on;
@@ -59,7 +80,7 @@ class RMSNorm(nn.Module):
 class _Block(nn.Module):
     num_heads: int
     mlp_ratio: int
-    attention: str
+    attention: Any
     dtype: Any
 
     @nn.compact
@@ -71,17 +92,7 @@ class _Block(nn.Module):
         qkv = nn.Dense(3 * d, use_bias=False, dtype=self.dtype, name="qkv")(h)
         q, k, v = jnp.split(qkv, 3, axis=-1)
         to_heads = lambda t: t.reshape(b, s, self.num_heads, head_dim)
-        if self.attention not in ("flash", "dense"):
-            # Checked HERE too (the module is public API): a typo'd tier
-            # must not silently fall back to dense — at s=16k that
-            # materializes the [s, s] scores and OOMs.
-            raise ValueError(
-                f"attention={self.attention!r}: expected 'flash' or "
-                "'dense'."
-            )
-        attn = flash_attention if self.attention == "flash" else (
-            attention_reference
-        )
+        attn = _resolve_attention(self.attention)
         o = attn(to_heads(q), to_heads(k), to_heads(v), causal=True)
         o = nn.Dense(
             d, use_bias=False, dtype=self.dtype, name="proj"
@@ -103,7 +114,7 @@ class TransformerLMModule(nn.Module):
     d_model: int
     num_heads: int
     mlp_ratio: int
-    attention: str
+    attention: Any  # "flash" | "dense" | callable(q, k, v, *, causal)
     max_seq_len: int
     dtype: Any
 
@@ -172,11 +183,10 @@ class TransformerLM(Model):
                 f"TransformerLM input_shape must be (seq_len,), got "
                 f"{tuple(input_shape)}."
             )
-        if self.attention not in ("flash", "dense"):
-            raise ValueError(
-                f"attention={self.attention!r}: expected 'flash' or "
-                "'dense'."
-            )
+        # One source of truth for valid tiers (the Field is a string;
+        # callables plug in at the MODULE level — see
+        # ``_resolve_attention``).
+        _resolve_attention(self.attention)
         if self.d_model % self.num_heads != 0:
             raise ValueError(
                 f"d_model={self.d_model} not divisible by "
