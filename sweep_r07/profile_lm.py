@@ -1,0 +1,82 @@
+"""Per-op device-time attribution of the TransformerLM train step on
+the real chip — the profiling subsystem working beyond CNNs, and the
+LM step's roofline position (is the flash-attention LM compute- or
+bandwidth-bound at long context?)."""
+
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from zookeeper_tpu.core import configure
+    from zookeeper_tpu.models import TransformerLM
+    from zookeeper_tpu.parallel import DataParallelPartitioner
+    from zookeeper_tpu.training import TrainState, make_train_step
+    from zookeeper_tpu.training.profiling import (
+        format_breakdown,
+        op_time_breakdown,
+    )
+
+    seq, vocab, batch_size = 8192, 1024, 4
+    model = TransformerLM()
+    configure(
+        model,
+        {
+            "num_layers": 4, "d_model": 512, "num_heads": 8,
+            "max_seq_len": seq, "compute_dtype": "bfloat16",
+        },
+        name="model",
+    )
+    module = model.build((seq,), num_classes=vocab)
+    params, mstate = model.initialize(module, (seq,))
+    ts = TrainState.create(
+        apply_fn=module.apply, params=params, model_state=mstate,
+        tx=optax.adam(1e-3),
+    )
+    part = DataParallelPartitioner()
+    configure(part, {}, name="p")
+    part.setup()
+    ts = part.shard_state(ts)
+    step = part.compile_step(make_train_step(), ts)
+
+    rng = np.random.default_rng(0)
+    batch = jax.device_put(
+        {
+            "input": jnp.asarray(
+                rng.integers(0, vocab, (batch_size, seq)), jnp.int32
+            ),
+            "target": jnp.asarray(
+                rng.integers(0, vocab, (batch_size, seq)), jnp.int32
+            ),
+        },
+        part.batch_sharding(),
+    )
+    for _ in range(3):
+        ts, metrics = step(ts, batch)
+    float(jax.device_get(metrics["loss"]))
+
+    steps = 10
+    trace_dir = tempfile.mkdtemp(prefix="zk_trace_lm_")
+    with jax.profiler.trace(trace_dir):
+        for _ in range(steps):
+            ts, metrics = step(ts, batch)
+        float(jax.device_get(metrics["loss"]))
+    print(
+        f"model=TransformerLM 4L d512 h8 s{seq} b{batch_size} bf16 flash"
+    )
+    print(format_breakdown(op_time_breakdown(trace_dir, steps=steps)))
+
+
+if __name__ == "__main__":
+    main()

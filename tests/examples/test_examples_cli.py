@@ -125,3 +125,25 @@ def test_digits_real_data_task():
 
     accs = re.findall(r"val_acc=([0-9.]+)", out)
     assert accs and float(accs[-1]) >= 0.85, out[-500:]
+
+
+def test_lm_long_context_example():
+    """The long-context LM demo drives the TransformerLM family end to
+    end (build -> DP partitioner -> flash-attention train steps) and
+    reports falling loss + a throughput line."""
+    # 25 steps -> loss lines at steps 10, 20, 24: enough to OBSERVE the
+    # fall, not just parse a line.
+    out = run_example(
+        "lm_long_context.py",
+        "--steps", "25", "--seq", "64", "--vocab", "53", "--layers", "2",
+        "--d-model", "64", "--heads", "2", "--batch", "4",
+    )
+    assert "TransformerLM: 2L d64 h2 s64" in out
+    assert "tokens/s" in out
+    losses = [
+        float(line.split("loss=")[1].split()[0])
+        for line in out.splitlines()
+        if "loss=" in line
+    ]
+    assert len(losses) >= 2, out
+    assert losses[-1] < losses[0], losses
