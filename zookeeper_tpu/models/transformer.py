@@ -36,6 +36,7 @@ import jax.numpy as jnp
 from zookeeper_tpu.core import Field, component
 from zookeeper_tpu.models.base import Model
 from zookeeper_tpu.ops import attention_reference, flash_attention
+from zookeeper_tpu.parallel.sharding import constrain_batch_sharded
 
 
 def _resolve_attention(attention):
@@ -105,7 +106,20 @@ class _Block(nn.Module):
         )(h)
         h = nn.gelu(h)
         h = nn.Dense(d, use_bias=False, dtype=self.dtype, name="down")(h)
-        return x + h
+        # Pin the residual stream to the canonical layout (batch on the
+        # data axes) at every block boundary: without the pin, GSPMD
+        # was observed picking an FSDP-axis-spread layout for the
+        # attention intermediates it then could not reshard — the same
+        # involuntary-full-remat pathology the CNN Quant layers pin
+        # against (parallel/sharding.py). No-op outside a mesh scope,
+        # and SKIPPED when attention is a mesh-composed callable: the
+        # SP op owns the sequence-sharded layout there, and the scope's
+        # canonical spec (which reads every non-data axis as a CHANNEL
+        # axis) would pin d_model over the sequence axis and fight it.
+        out = x + h
+        if not callable(self.attention):
+            out = constrain_batch_sharded(out)
+        return out
 
 
 class TransformerLMModule(nn.Module):
@@ -142,6 +156,8 @@ class TransformerLMModule(nn.Module):
             (self.max_seq_len, self.d_model),
         )
         x = (embed[tokens] + pos[None, :s]).astype(self.dtype)
+        if not callable(self.attention):  # see _Block's pin rationale
+            x = constrain_batch_sharded(x)
         for i in range(self.num_layers):
             x = _Block(
                 num_heads=self.num_heads,
