@@ -290,6 +290,8 @@ def test_fsdp_lm_shards_exact_and_compiles_clean(capfd):
     step matches single-device."""
     from zookeeper_tpu.parallel import FsdpPartitioner
 
+    from zookeeper_tpu.models.transformer import TransformerLMModule
+
     if jax.device_count() < 8:
         pytest.skip("needs 8 devices")
     _, module, params, state = make_model()
@@ -297,7 +299,36 @@ def test_fsdp_lm_shards_exact_and_compiles_clean(capfd):
     # Low threshold so the tiny test model's kernels DO shard.
     configure(part, {"min_weight_size": 1024}, name="p")
     part.setup()
-    capfd.readouterr()  # Drop setup noise.
+
+    # POSITIVE CONTROL first (the dryrun canary lesson: prove the
+    # detector fires before trusting its silence): the UNPINNED module
+    # under the same FSDP layout must emit the warning, otherwise the
+    # absence assertion below is vacuous (e.g. a logging backend
+    # swallowing C++ stderr).
+    unpinned = TransformerLMModule(
+        vocab_size=61, num_layers=2, d_model=64, num_heads=2,
+        mlp_ratio=4, attention="flash", max_seq_len=64,
+        dtype=jnp.float32, pin_activations=False,
+    )
+    mk = lambda m: TrainState.create(
+        apply_fn=m.apply,
+        params=jax.tree.map(jnp.copy, params),
+        model_state=state,
+        tx=optax.adam(1e-3),
+    )
+    capfd.readouterr()
+    ts_u = part.shard_state(mk(unpinned))
+    part.compile_step(make_train_step(), ts_u)(
+        ts_u, jax.device_put(lm_batch(), part.batch_sharding())
+    )
+    canary_err = capfd.readouterr().err
+    assert "Involuntary full rematerialization" in canary_err, (
+        "canary: the unpinned module compiled without the warning "
+        "reaching stderr — the detector is blind, the clean assertion "
+        "below would prove nothing"
+    )
+
+    capfd.readouterr()  # Drop canary noise.
     ts2, _ = _sharded_parity_run(module, params, state, lm_batch(), part)
     err = capfd.readouterr().err
     assert "Involuntary full rematerialization" not in err
@@ -305,3 +336,26 @@ def test_fsdp_lm_shards_exact_and_compiles_clean(capfd):
         not leaf.sharding.is_fully_replicated
         for leaf in jax.tree.leaves(ts2.params)
     )
+
+
+def test_auto_pin_rule():
+    """Auto pin: strings and the bare within-chip callables pin;
+    unknown callables (assumed mesh-composed SP) do not; explicit bool
+    overrides either way."""
+    from functools import partial
+
+    from zookeeper_tpu.models.transformer import _auto_pin_activations
+    from zookeeper_tpu.ops import (
+        attention_reference,
+        flash_attention,
+        ring_flash_attention,
+    )
+
+    assert _auto_pin_activations("flash", None)
+    assert _auto_pin_activations("dense", None)
+    assert _auto_pin_activations(flash_attention, None)
+    assert _auto_pin_activations(attention_reference, None)
+    assert not _auto_pin_activations(partial(ring_flash_attention), None)
+    assert not _auto_pin_activations(lambda q, k, v, causal: q, None)
+    assert _auto_pin_activations(partial(ring_flash_attention), True)
+    assert not _auto_pin_activations("flash", False)

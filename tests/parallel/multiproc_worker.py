@@ -345,6 +345,26 @@ def main() -> None:
             ),
         )
 
+    # The COMPOSED tier across the same process seam: flash kernels as
+    # each device's ring-step block compute (interpret mode on the CPU
+    # cluster), lse-merged — the full long-context recipe with its
+    # collectives riding the inter-host link.
+    from zookeeper_tpu.ops import ring_flash_attention
+
+    rfout = ring_flash_attention(
+        gq, gk, gv, mesh=sp_mesh, seq_axis="sp", causal=True,
+        block_q=4, block_k=4,
+    )
+    ring_flash_cross_process = not rfout.is_fully_addressable
+    ring_flash_maxdiff = 0.0
+    for shard in rfout.addressable_shards:
+        ring_flash_maxdiff = max(
+            ring_flash_maxdiff,
+            float(
+                np.abs(np.asarray(shard.data) - aref[shard.index]).max()
+            ),
+        )
+
     with open(out_path, "w") as f:
         f.write(
             json.dumps(
@@ -365,6 +385,8 @@ def main() -> None:
                     "xtp_loss": xtp_loss,
                     "ring_cross_process": ring_cross_process,
                     "ring_maxdiff": ring_maxdiff,
+                    "ring_flash_cross_process": ring_flash_cross_process,
+                    "ring_flash_maxdiff": ring_flash_maxdiff,
                     "ok": True,
                 }
             )

@@ -167,3 +167,14 @@ def test_ring_attention_across_processes(cluster_results):
     for r in cluster_results:
         assert r["ring_cross_process"]
         assert r["ring_maxdiff"] < 5e-5, r["ring_maxdiff"]
+
+
+@pytest.mark.slow
+def test_ring_flash_attention_across_processes(cluster_results):
+    """The COMPOSED tier over the process seam: flash kernels as each
+    device's ring-step block compute, the merge's collectives riding
+    the inter-host link — every host's addressable output shards match
+    the dense oracle."""
+    for r in cluster_results:
+        assert r["ring_flash_cross_process"]
+        assert r["ring_flash_maxdiff"] < 5e-5, r["ring_flash_maxdiff"]

@@ -83,6 +83,7 @@ class _Block(nn.Module):
     mlp_ratio: int
     attention: Any
     dtype: Any
+    pin_activations: bool = True
 
     @nn.compact
     def __call__(self, x, training: bool):
@@ -111,15 +112,32 @@ class _Block(nn.Module):
         # was observed picking an FSDP-axis-spread layout for the
         # attention intermediates it then could not reshard — the same
         # involuntary-full-remat pathology the CNN Quant layers pin
-        # against (parallel/sharding.py). No-op outside a mesh scope,
-        # and SKIPPED when attention is a mesh-composed callable: the
-        # SP op owns the sequence-sharded layout there, and the scope's
-        # canonical spec (which reads every non-data axis as a CHANNEL
-        # axis) would pin d_model over the sequence axis and fight it.
+        # against (parallel/sharding.py). No-op outside a mesh scope;
+        # see ``_auto_pin_activations`` for when the pin is skipped.
         out = x + h
-        if not callable(self.attention):
+        if self.pin_activations:
             out = constrain_batch_sharded(out)
         return out
+
+
+def _auto_pin_activations(attention, pin_activations):
+    """Whether the residual-stream pins apply. ``None`` (the default)
+    auto-selects: pinned for the within-chip tiers (incl. the bare
+    ``flash_attention``/``attention_reference`` callables — they are
+    functionally identical to their string forms and need the same
+    FSDP protection), skipped for any OTHER callable, which is assumed
+    mesh-composed sequence parallelism: the SP op owns the
+    sequence-sharded layout, and the ambient scope's canonical spec
+    (which reads every non-data axis as a CHANNEL axis) would pin
+    d_model over the sequence axis and fight it. Pass an explicit bool
+    to override either way (e.g. ``True`` for a custom within-chip
+    kernel under FSDP)."""
+    if pin_activations is not None:
+        return pin_activations
+    return (
+        not callable(attention)
+        or attention in (flash_attention, attention_reference)
+    )
 
 
 class TransformerLMModule(nn.Module):
@@ -131,6 +149,8 @@ class TransformerLMModule(nn.Module):
     attention: Any  # "flash" | "dense" | callable(q, k, v, *, causal)
     max_seq_len: int
     dtype: Any
+    #: None = auto (see ``_auto_pin_activations``); bool overrides.
+    pin_activations: Any = None
 
     @nn.compact
     def __call__(self, tokens, training: bool = False):
@@ -155,8 +175,9 @@ class TransformerLMModule(nn.Module):
             nn.initializers.normal(0.02),
             (self.max_seq_len, self.d_model),
         )
+        pin = _auto_pin_activations(self.attention, self.pin_activations)
         x = (embed[tokens] + pos[None, :s]).astype(self.dtype)
-        if not callable(self.attention):  # see _Block's pin rationale
+        if pin:
             x = constrain_batch_sharded(x)
         for i in range(self.num_layers):
             x = _Block(
@@ -164,6 +185,7 @@ class TransformerLMModule(nn.Module):
                 mlp_ratio=self.mlp_ratio,
                 attention=self.attention,
                 dtype=self.dtype,
+                pin_activations=pin,
                 name=f"block{i}",
             )(x, training)
         x = RMSNorm(dtype=self.dtype)(x)
