@@ -76,6 +76,7 @@ def test_flash_and_dense_attention_agree():
     )
 
 
+@pytest.mark.slow
 def test_lm_learns_next_token():
     """The existing train step works unchanged for LM batches (the CE
     and accuracy broadcast over positions): loss on a periodic corpus
