@@ -360,3 +360,16 @@ def test_auto_pin_rule():
     assert not _auto_pin_activations(lambda q, k, v, causal: q, None)
     assert _auto_pin_activations(partial(ring_flash_attention), True)
     assert not _auto_pin_activations("flash", False)
+
+
+def test_model_summary_works_for_token_models():
+    """model_summary's dummy input must be an INT for rank-1
+    (token-sequence) shapes — a float dummy is an invalid embedding
+    index (previously a TypeError)."""
+    from zookeeper_tpu.models import model_summary
+
+    _, module, *_ = make_model()
+    s = model_summary(module, (32,), compute_flops=True)
+    text = str(s)
+    assert "embed" in text and "block0" in text
+    assert s.total_params > 0

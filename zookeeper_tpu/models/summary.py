@@ -125,18 +125,25 @@ def model_summary(
     input_shape: Sequence[int],
     *,
     compute_flops: bool = False,
+    input_dtype: Any = None,
 ) -> ModelSummary:
     """Summarize a flax module's parameters and (optionally) FLOPs.
 
     ``compute_flops=True`` traces+lowers the forward apply and asks XLA's
     cost analysis for the FLOP count (compilation-free where supported;
     falls back to ``None`` silently since it is diagnostic output).
+
+    ``input_dtype`` defaults to float32 for image-shaped inputs and
+    int32 for rank-1 (token-sequence) shapes — a float dummy is an
+    invalid embedding index for language models.
     """
     import jax
     import jax.numpy as jnp
     from flax import traverse_util
 
-    x = jnp.zeros((1, *input_shape), jnp.float32)
+    if input_dtype is None:
+        input_dtype = jnp.int32 if len(input_shape) == 1 else jnp.float32
+    x = jnp.zeros((1, *input_shape), input_dtype)
     variables = jax.eval_shape(
         lambda: module.init(jax.random.key(0), x, training=False)
     )
