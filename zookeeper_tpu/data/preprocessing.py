@@ -12,7 +12,7 @@ step instead (TPU time is cheaper than host time at pod scale).
 
 from __future__ import annotations
 
-from typing import Tuple
+from typing import Optional, Tuple
 
 import numpy as np
 
@@ -55,16 +55,34 @@ class Preprocessing:
 
 @component
 class PassThroughPreprocessing(Preprocessing):
-    """Forwards ``example[input_key]`` / ``example[target_key]`` unchanged."""
+    """Forwards ``example[input_key]`` / ``example[target_key]`` unchanged.
+
+    ``example_shape`` declares the per-example input shape for pipelines
+    that need it (``Experiment.build_state`` sizes the model from it —
+    e.g. ``(seq_len,)`` for a token pipeline feeding ``TransformerLM``);
+    leave unset for pipelines that never ask.
+    """
 
     input_key: str = Field("image")
     target_key: str = Field("label")
+    example_shape: Optional[Tuple[int, ...]] = Field(None)
 
     def input(self, example: Example, training: bool) -> np.ndarray:
         return example[self.input_key]
 
     def output(self, example: Example, training: bool) -> np.ndarray:
         return example[self.target_key]
+
+    @property
+    def input_shape(self) -> Tuple[int, ...]:
+        if self.example_shape is None:
+            raise ValueError(
+                "PassThroughPreprocessing.input_shape was asked for but "
+                "example_shape is not configured — set e.g. "
+                "preprocessing.example_shape=(seq_len,) so the "
+                "experiment can size the model."
+            )
+        return tuple(self.example_shape)
 
 
 @component
