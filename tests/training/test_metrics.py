@@ -153,3 +153,31 @@ def test_experiment_writes_metrics(tmp_path):
     assert (4, "train/loss") in scalars
     assert (4, "train_epoch/loss") in scalars
     assert (8, "val/accuracy") in scalars
+
+
+def test_top_k_accuracy_rank_general():
+    """top_k is rank-general like loss/accuracy: [b, s, V] logits with
+    [b, s] labels (per-position LM scoring) — labels[:, None] used to
+    break rank-3 broadcasting."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from zookeeper_tpu.training.step import top_k_accuracy
+
+    rng = np.random.default_rng(0)
+    logits = jnp.asarray(rng.normal(size=(2, 8, 11)).astype(np.float32))
+    labels = jnp.asarray(rng.integers(0, 11, (2, 8)))
+    v = float(top_k_accuracy(logits, labels, 5))
+    assert 0.0 <= v <= 1.0
+    # Oracle: per-position membership of the label in the top-5 set.
+    top5 = np.argsort(-np.asarray(logits), axis=-1)[..., :5]
+    want = float(
+        (top5 == np.asarray(labels)[..., None]).any(-1).mean()
+    )
+    assert abs(v - want) < 1e-6
+    # Rank-2 (image classification) path unchanged.
+    l2 = jnp.asarray(rng.normal(size=(16, 11)).astype(np.float32))
+    y2 = jnp.asarray(rng.integers(0, 11, (16,)))
+    t2 = np.argsort(-np.asarray(l2), axis=-1)[:, :5]
+    want2 = float((t2 == np.asarray(y2)[:, None]).any(-1).mean())
+    assert abs(float(top_k_accuracy(l2, y2, 5)) - want2) < 1e-6

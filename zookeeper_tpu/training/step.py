@@ -58,9 +58,12 @@ def accuracy(logits: jax.Array, labels: jax.Array) -> jax.Array:
 
 def top_k_accuracy(logits: jax.Array, labels: jax.Array, k: int) -> jax.Array:
     """Fraction of examples whose true label is in the top-k logits (the
-    ImageNet top-5 companion metric)."""
+    ImageNet top-5 companion metric). Rank-general like the other
+    metrics: ``[..., num_classes]`` logits against ``[...]`` integer
+    labels, so per-position LM scoring works too (``labels[:, None]``
+    broke rank-3 broadcasting)."""
     _, top = jax.lax.top_k(logits.astype(jnp.float32), k)
-    return (top == labels[:, None]).any(axis=-1).mean()
+    return (top == labels[..., None]).any(axis=-1).mean()
 
 
 def kd_divergence(
