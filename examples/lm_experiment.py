@@ -1,0 +1,45 @@
+"""Config-system-native language-model training: the TransformerLM
+family driven exactly like every other example — task CLI, components
+by name, scoped field inheritance.
+
+``seq_len`` is declared ONCE at the task level and inherited by BOTH
+the dataset (window length) and the preprocessing (``input_shape``) —
+the reference's signature config-reuse mechanism doing real work::
+
+    # Zero-config smoke (synthetic periodic corpus, memorizable):
+    python examples/lm_experiment.py TrainLM epochs=3
+
+    # Long context on a real chip, everything from the CLI:
+    python examples/lm_experiment.py TrainLM seq_len=8192 \\
+        model.d_model=512 model.num_heads=8 batch_size=4 \\
+        model.compute_dtype=bfloat16 loader.dataset.vocab_size=1024
+
+    # Dense-attention oracle run, or any other field:
+    python examples/lm_experiment.py TrainLM model.attention=dense
+"""
+
+from zookeeper_tpu import ComponentField, Field, PartialComponent, cli, task
+from zookeeper_tpu.data import DataLoader, SyntheticTokens, TokenPreprocessing
+from zookeeper_tpu.models import Model, TransformerLM
+from zookeeper_tpu.parallel import DataParallelPartitioner, Partitioner
+from zookeeper_tpu.training import TrainingExperiment
+
+
+@task
+class TrainLM(TrainingExperiment):
+    loader: DataLoader = ComponentField(
+        DataLoader,
+        dataset=SyntheticTokens,
+        preprocessing=PartialComponent(TokenPreprocessing),
+    )
+    model: Model = ComponentField(TransformerLM)
+    partitioner: Partitioner = ComponentField(DataParallelPartitioner)
+    #: Inherited by loader.dataset.seq_len AND loader.preprocessing.seq_len
+    #: (scoped field inheritance) — and caps the model's positional table.
+    seq_len: int = Field(64)
+    batch_size: int = Field(32)
+    epochs: int = Field(3)
+
+
+if __name__ == "__main__":
+    cli()

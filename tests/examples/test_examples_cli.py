@@ -147,3 +147,26 @@ def test_lm_long_context_example():
     ]
     assert len(losses) >= 2, out
     assert losses[-1] < losses[0], losses
+
+
+def test_lm_task_cli():
+    """The config-system-native LM flow: TrainLM from the task CLI with
+    scoped seq_len inheritance wiring dataset windows, preprocessing
+    input_shape, and (via the -1 default) the model's positional table
+    from ONE knob."""
+    out = run_example(
+        "lm_experiment.py", "TrainLM",
+        "epochs=3", "seq_len=32", "batch_size=16",
+        "loader.dataset.num_train_examples=128",
+        "loader.dataset.vocab_size=31",
+        "model.num_layers=2", "model.d_model=64", "model.num_heads=2",
+    )
+    assert "TrainLM" in out
+    accs = [
+        float(line.split("val_acc=")[1].split()[0])
+        for line in out.splitlines()
+        if "val_acc=" in line
+    ]
+    assert len(accs) == 3
+    assert accs[-1] > accs[0], accs
+    assert accs[-1] > 0.5, accs  # memorizable corpus, chance ~1/31

@@ -437,3 +437,64 @@ def test_passthrough_input_shape_requires_example_shape():
     pre2 = PassThroughPreprocessing()
     configure(pre2, {"example_shape": (32,)}, name="pre2")
     assert pre2.input_shape == (32,)
+
+
+def test_synthetic_tokens_and_token_preprocessing_components():
+    """The CLI-constructible token pipeline: SyntheticTokens windows one
+    deterministic periodic corpus (num_classes inferred from vocab);
+    TokenPreprocessing derives input_shape from its seq_len field (the
+    scoped-inheritance hook the TrainLM task relies on)."""
+    from zookeeper_tpu.data import SyntheticTokens, TokenPreprocessing
+
+    ds = SyntheticTokens()
+    configure(
+        ds,
+        {"seq_len": 16, "vocab_size": 23, "num_train_examples": 64},
+        name="ds",
+    )
+    src = ds.train()
+    ex = src[0]
+    assert ex["tokens"].shape == (16,) and ex["next"].shape == (16,)
+    # Next-token alignment: next[i] is the stream successor of tokens[i].
+    np.testing.assert_array_equal(ex["tokens"][1:], ex["next"][:-1])
+    assert ds.infer_num_classes() == 23
+    assert int(ex["tokens"].max()) < 23
+    # Determinism: a rebuilt source yields identical windows.
+    np.testing.assert_array_equal(ds.train()[0]["tokens"], ex["tokens"])
+    # A validation split exists (same periodic corpus BY DESIGN — this
+    # dataset is a memorization task; val_acc measures fit, not
+    # generalization).
+    assert ds.validation() is not None
+
+    pre = TokenPreprocessing()
+    configure(pre, {"seq_len": 16}, name="pre")
+    assert pre.input_shape == (16,)
+    out = pre(ex, training=True)
+    np.testing.assert_array_equal(out["input"], ex["tokens"])
+    np.testing.assert_array_equal(out["target"], ex["next"])
+
+
+def test_max_seq_len_sentinel_and_typos():
+    """-1 auto-sizes the positional table to the built sequence; 0 or
+    other negatives are config typos and raise."""
+    m = TransformerLM()
+    configure(m, {"num_layers": 1, "d_model": 32, "num_heads": 2}, name="m")
+    assert m.max_seq_len == -1
+    mod = m.build((48,), num_classes=11)
+    assert mod.max_seq_len == 48
+
+    for bad in (0, -2):
+        m2 = TransformerLM()
+        configure(m2, {"max_seq_len": bad}, name="m2")
+        with pytest.raises(ValueError, match="max_seq_len"):
+            m2.build((32,), num_classes=11)
+
+
+def test_token_preprocessing_example_shape_precedence():
+    """The inherited example_shape knob stays live: when explicitly set
+    it overrides the seq_len-derived shape."""
+    from zookeeper_tpu.data import TokenPreprocessing
+
+    pre = TokenPreprocessing()
+    configure(pre, {"seq_len": 16, "example_shape": (128,)}, name="pre")
+    assert pre.input_shape == (128,)

@@ -35,11 +35,13 @@ from zookeeper_tpu.data.dataset import (
     SyntheticImageNet,
     SyntheticImageClassification,
     SyntheticMnist,
+    SyntheticTokens,
     TFDSDataset,
 )
 from zookeeper_tpu.data.preprocessing import (
     ImageClassificationPreprocessing,
     PassThroughPreprocessing,
+    TokenPreprocessing,
     Preprocessing,
 )
 from zookeeper_tpu.data.pipeline import (
@@ -63,6 +65,7 @@ __all__ = [
     "MemmapWriter",
     "MultiTFDSDataset",
     "PassThroughPreprocessing",
+    "TokenPreprocessing",
     "Preprocessing",
     "SklearnDigits",
     "SliceSource",
@@ -70,6 +73,7 @@ __all__ = [
     "SyntheticImageNet",
     "SyntheticImageClassification",
     "SyntheticMnist",
+    "SyntheticTokens",
     "TFDSDataset",
     "WrappedSource",
     "batch_iterator",

@@ -174,6 +174,57 @@ def _synthetic_image_classification(
 
 
 @component
+class SyntheticTokens(Dataset):
+    """Always-available synthetic next-token corpus for language-model
+    pipelines: windows over one deterministic periodic token stream
+    (period ``pattern_period``), yielding ``{"tokens", "next"}``
+    examples — memorizable, so "loss falls / accuracy rises" tests and
+    demos work with zero external data. Pair with
+    ``TokenPreprocessing`` (shares ``seq_len`` by scoped inheritance)
+    and ``TransformerLM``."""
+
+    num_train_examples: int = Field(1024)
+    num_validation_examples: int = Field(128)
+    seq_len: int = Field(64)
+    vocab_size: int = Field(256)
+    pattern_period: int = Field(17)
+    seed: int = Field(0)
+
+    def _windows(self, n: int, seed: int) -> Dict[str, np.ndarray]:
+        # The stream is (seed)-fixed; per-split seeds vary the windows.
+        base = np.random.default_rng(self.seed).integers(
+            0, self.vocab_size, self.pattern_period
+        )
+        stream = np.tile(
+            base, -(-(4 * self.seq_len) // self.pattern_period) + 1
+        )
+        rng = np.random.default_rng(seed)
+        starts = rng.integers(0, len(stream) - self.seq_len - 1, n)
+        toks = np.stack(
+            [stream[s : s + self.seq_len] for s in starts]
+        ).astype(np.int32)
+        nxt = np.stack(
+            [stream[s + 1 : s + self.seq_len + 1] for s in starts]
+        ).astype(np.int32)
+        return {"tokens": toks, "next": nxt}
+
+    def train(self) -> DataSource:
+        return ArraySource(
+            self._windows(self.num_train_examples, self.seed + 1)
+        )
+
+    def validation(self) -> Optional[DataSource]:
+        if self.num_validation_examples <= 0:
+            return None
+        return ArraySource(
+            self._windows(self.num_validation_examples, self.seed + 2)
+        )
+
+    def infer_num_classes(self) -> int:
+        return self.vocab_size
+
+
+@component
 class SyntheticImageClassification(Dataset):
     """Always-available synthetic image-classification dataset.
 

@@ -86,6 +86,28 @@ class PassThroughPreprocessing(Preprocessing):
 
 
 @component
+class TokenPreprocessing(PassThroughPreprocessing):
+    """Token-pipeline passthrough: forwards ``tokens``/``next`` and
+    derives ``input_shape`` from ``seq_len`` — declared as a FIELD so
+    scoped inheritance wires it from the experiment/dataset (set
+    ``seq_len`` once at task level; ``SyntheticTokens`` and this
+    component both inherit it)."""
+
+    input_key: str = Field("tokens")
+    target_key: str = Field("next")
+    seq_len: int = Field(64)
+
+    @property
+    def input_shape(self) -> Tuple[int, ...]:
+        # The inherited example_shape keeps the parent contract (takes
+        # precedence when explicitly set) rather than becoming a dead,
+        # silently-ignored knob.
+        if self.example_shape is not None:
+            return tuple(self.example_shape)
+        return (self.seq_len,)
+
+
+@component
 class ImageClassificationPreprocessing(Preprocessing):
     """Standard image-classification preprocessing: scale uint8 pixels to
     [-1, 1] (or [0, 1]), optional train-time augmentation (random crop after

@@ -211,9 +211,13 @@ class TransformerLM(Model):
     #: "flash" (Pallas kernels, long-context default) or "dense" (the
     #: oracle path).
     attention: str = Field("flash")
-    #: Positional-table capacity; build() raises if the configured
-    #: sequence exceeds it.
-    max_seq_len: int = Field(4096)
+    #: Positional-table capacity. -1 (the default) sizes it to the
+    #: sequence length ``build()`` receives — the common case, and it
+    #: keeps one ``seq_len`` knob sufficient in CLI tasks. Set
+    #: explicitly to train short now and run longer contexts later
+    #: without a table reshape; build() raises if the configured
+    #: sequence exceeds an explicit capacity.
+    max_seq_len: int = Field(-1)
 
     def build(self, input_shape: Sequence[int], num_classes: int) -> nn.Module:
         if len(input_shape) != 1:
@@ -231,9 +235,20 @@ class TransformerLM(Model):
                 f"num_heads={self.num_heads}."
             )
         (seq_len,) = input_shape
-        if seq_len > self.max_seq_len:
+        if self.max_seq_len == -1:
+            max_seq_len = seq_len
+        elif self.max_seq_len > 0:
+            max_seq_len = self.max_seq_len
+        else:
+            # 0 or other negatives are config typos, not the sentinel —
+            # silently auto-sizing them would hide the mistake.
             raise ValueError(
-                f"seq_len {seq_len} exceeds max_seq_len {self.max_seq_len}."
+                f"max_seq_len={self.max_seq_len}: expected a positive "
+                "capacity or -1 (size to the built sequence)."
+            )
+        if seq_len > max_seq_len:
+            raise ValueError(
+                f"seq_len {seq_len} exceeds max_seq_len {max_seq_len}."
             )
         return TransformerLMModule(
             vocab_size=num_classes,
@@ -242,7 +257,7 @@ class TransformerLM(Model):
             num_heads=self.num_heads,
             mlp_ratio=self.mlp_ratio,
             attention=self.attention,
-            max_seq_len=self.max_seq_len,
+            max_seq_len=max_seq_len,
             dtype=self.dtype(),
         )
 
