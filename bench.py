@@ -187,24 +187,10 @@ def aggregate_peak_attempts(attempts, rel_tol=0.05):
     return 0.5 * (cluster[mid - 1] + cluster[mid])
 
 
-def time_marginal(run_chain, n1: int, n2: int, rounds: int) -> float:
-    """Per-step marginal time via two-chain-length differencing — the one
-    timing protocol the whole bench uses (BASELINE.md methodology).
-
-    ``run_chain(n)`` runs ``n`` chained steps ended by a host readback and
-    returns wall seconds. Each chain length takes its min over ``rounds``
-    INDEPENDENTLY (min over additive non-negative noise is sound), then
-    the marginal is taken once — min over per-round *differences* would
-    be biased fast whenever a jitter spike landed on a short chain. May
-    return <= 0 under pathological jitter; callers decide how to handle.
-    """
-    t1_min = t2_min = None
-    for _ in range(rounds):
-        t1 = run_chain(n1)
-        t2 = run_chain(n2)
-        t1_min = t1 if t1_min is None else min(t1_min, t1)
-        t2_min = t2 if t2_min is None else min(t2_min, t2)
-    return (t2_min - t1_min) / (n2 - n1)
+# Canonical implementation lives in the library so bench.py and
+# measure_fused_loop_time share one copy; re-exported here because the
+# sweep scripts import it as ``bench.time_marginal``.
+from zookeeper_tpu.training.benchmark import time_marginal  # noqa: E402
 
 
 def measure_bf16_peak(rounds: int = 4, n_attempts: int = 4) -> float:
@@ -728,6 +714,62 @@ def main():
             "rerun on a quieter host."
         )
 
+    # Steady-state END-TO-END loop time through the fused multi-step
+    # engine (training.step.build_multi_step): ``unroll`` copies of the
+    # batch resident as one HBM slab, chains of back-to-back slab
+    # dispatches with deferred readback. step_time_ms stays the
+    # compute-only anchor; loop_time_ms includes per-slab Python
+    # dispatch + host bookkeeping amortized over unroll steps — the
+    # overhead the engine exists to remove, now visible in the BENCH
+    # trajectory. ZK_BENCH_UNROLL overrides (<= 1 skips).
+    unroll = int(os.environ.get("ZK_BENCH_UNROLL", "8"))
+    loop_time = None
+    if unroll > 1:
+        try:
+            from zookeeper_tpu.training import build_multi_step
+            from zookeeper_tpu.training.benchmark import (
+                measure_fused_loop_time,
+            )
+
+            slab = jax.device_put(
+                jax.tree.map(lambda x: jnp.stack([x] * unroll), batch),
+                partitioner.slab_sharding(),
+            )
+            multi_step = partitioner.compile_multi_step(
+                build_multi_step(make_train_step()),
+                state,
+                donate_state=True,
+                donate_slab=False,  # the slab is re-driven every chain
+            )
+            # The fused loop CONTAINS the full step compute, so a
+            # marginal below ~0.8x the measured step time is jitter,
+            # not speed — escalate chain lengths, then discard.
+            loop_floor = 0.8 * step_time
+            for ln1, ln2, lrounds in ((4, 12, 6), (8, 40, 8)):
+                loop_time, state = measure_fused_loop_time(
+                    multi_step, state, slab,
+                    rounds=lrounds, n1=ln1, n2=ln2,
+                )
+                if loop_time > loop_floor:
+                    break
+            if loop_time <= loop_floor:
+                print(
+                    f"fused-loop marginal {loop_time * 1e3:.3f} ms/step "
+                    f"below the {loop_floor * 1e3:.3f} ms plausibility "
+                    "floor at all chain lengths; omitting loop_time_ms",
+                    file=sys.stderr,
+                    flush=True,
+                )
+                loop_time = None
+        except Exception as e:  # never lose the primary metric
+            print(
+                f"fused-loop measurement failed ({e}); omitting "
+                "loop_time_ms",
+                file=sys.stderr,
+                flush=True,
+            )
+            loop_time = None
+
     n_chips = jax.device_count()
     images_per_sec_per_chip = batch_size / step_time / max(1, n_chips)
 
@@ -740,6 +782,12 @@ def main():
         "n_chips": n_chips,
         "device_kind": jax.devices()[0].device_kind,
     }
+    if loop_time is not None:
+        extras["unroll"] = unroll
+        extras["loop_time_ms"] = round(loop_time * 1e3, 2)
+        extras["loop_images_per_sec_per_chip"] = round(
+            batch_size / loop_time / max(1, n_chips), 1
+        )
     if compiler_options is not None:
         extras["compiler_options"] = compiler_options
     if cost is not None:

@@ -8,8 +8,10 @@ from zookeeper_tpu.data import (
     SyntheticMnist,
     ImageClassificationPreprocessing,
     PassThroughPreprocessing,
+    TokenPreprocessing,
     batch_iterator,
     prefetch_to_device,
+    slab_iterator,
 )
 
 
@@ -475,6 +477,125 @@ def test_native_fast_path_hits_memmap_store(tmp_path, monkeypatch):
     for a, b in zip(fast, ram):
         np.testing.assert_array_equal(a["input"], b["input"])
         np.testing.assert_array_equal(a["target"], b["target"])
+
+
+def test_slab_iterator_preserves_order_partial_and_cap():
+    """Slabs are consecutive batches stacked on a new leading axis:
+    order unchanged, final slab partial when the epoch length is not a
+    multiple of unroll, and max_batches truncates mid-slab."""
+    pre = PassThroughPreprocessing()
+    configure(pre, {"input_key": "image", "target_key": "label"}, name="pre")
+
+    def batches():
+        return batch_iterator(
+            make_source(32), pre, 4, training=False, shuffle=False
+        )
+
+    flat = collect_inputs(batches())
+    slabs = list(slab_iterator(batches(), 3))
+    # 8 batches at unroll 3 -> slabs of 3, 3, 2.
+    assert [s["input"].shape[0] for s in slabs] == [3, 3, 2]
+    assert slabs[0]["input"].shape == (3, 4, 4, 4, 1)
+    restacked = np.concatenate(
+        [s["input"].reshape(-1, 4, 4, 1) for s in slabs]
+    )[:, 0, 0, 0]
+    np.testing.assert_array_equal(restacked, flat)
+
+    # max_batches mid-slab: 5 batches at unroll 4 -> 4 + 1.
+    capped = list(slab_iterator(batches(), 4, max_batches=5))
+    assert [s["input"].shape[0] for s in capped] == [4, 1]
+    np.testing.assert_array_equal(
+        np.concatenate([s["input"].reshape(-1, 4, 4, 1) for s in capped])[
+            :, 0, 0, 0
+        ],
+        flat[:20],
+    )
+
+    # unroll=1 slabs are [1, batch, ...] (degenerate but well-formed).
+    ones = list(slab_iterator(batches(), 1, max_batches=2))
+    assert [s["input"].shape[:2] for s in ones] == [(1, 4), (1, 4)]
+
+    # max_batches=0 yields NOTHING (matching islice semantics on the
+    # unroll=1 loader surface), not a one-batch slab.
+    assert list(slab_iterator(batches(), 4, max_batches=0)) == []
+
+    with pytest.raises(ValueError, match="unroll"):
+        list(slab_iterator(batches(), 0))
+
+
+def test_slab_iterator_rejects_shape_changing_batches():
+    """A partial FINAL BATCH (drop_remainder=False) cannot be stacked
+    into a slab — fail loudly instead of mis-stacking, INCLUDING when
+    slab alignment puts the partial batch alone in the last slab
+    (where a per-slab check would see uniform shapes and silently
+    emit a shape-changing slab)."""
+    pre = PassThroughPreprocessing()
+    configure(pre, {"input_key": "image", "target_key": "label"}, name="pre")
+
+    def batches(n):
+        return batch_iterator(
+            make_source(n), pre, 8, training=False, shuffle=False,
+            drop_remainder=False,
+        )
+
+    # 30 examples: batches 8,8,8,6 — partial shares slab 1 of 4.
+    with pytest.raises(ValueError, match="slab"):
+        list(slab_iterator(batches(30), 4))
+    # 36 examples: batches 8,8,8,8,4 — partial is ALONE in slab 2.
+    with pytest.raises(ValueError, match="slab"):
+        list(slab_iterator(batches(36), 4))
+
+
+def test_dataloader_unroll_yields_device_slabs():
+    """DataLoader.batches(unroll=k) stages [k, batch, ...] device slabs
+    equal to the same call's consecutive single batches stacked."""
+    import jax
+
+    conf = {
+        "dataset": "SyntheticMnist",
+        "dataset.num_train_examples": 64,
+        "preprocessing": "ImageClassificationPreprocessing",
+        "preprocessing.height": 28,
+        "preprocessing.width": 28,
+        "preprocessing.channels": 1,
+        "batch_size": 16,
+        "host_index": 0,
+        "host_count": 1,
+    }
+    loader = DataLoader()
+    configure(loader, conf, name="loader")
+    singles = list(loader.batches("train", epoch=0))
+    loader2 = DataLoader()
+    configure(loader2, conf, name="loader2")
+    slabs = list(loader2.batches("train", epoch=0, unroll=2))
+    assert len(singles) == 4 and len(slabs) == 2
+    assert isinstance(slabs[0]["input"], jax.Array)
+    assert slabs[0]["input"].shape == (2, 16, 28, 28, 1)
+    for i, slab in enumerate(slabs):
+        for j in range(2):
+            np.testing.assert_array_equal(
+                np.asarray(slab["input"][j]),
+                np.asarray(singles[2 * i + j]["input"]),
+            )
+            np.testing.assert_array_equal(
+                np.asarray(slab["target"][j]),
+                np.asarray(singles[2 * i + j]["target"]),
+            )
+
+    # max_batches caps the eager (unroll=1) surface too.
+    loader3 = DataLoader()
+    configure(loader3, conf, name="loader3")
+    assert len(list(loader3.batches("train", epoch=0, max_batches=3))) == 3
+
+
+def test_preprocessing_input_dtype_hints():
+    """The data layer's dtype hint for dummy-input consumers
+    (models.summary): tokens are int32, pixels float32, passthrough
+    unknown."""
+    assert TokenPreprocessing().input_dtype == "int32"
+    img = ImageClassificationPreprocessing()
+    assert img.input_dtype == "float32"
+    assert PassThroughPreprocessing().input_dtype is None
 
 
 def test_start_batch_out_of_range_fails_loudly():

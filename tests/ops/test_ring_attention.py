@@ -545,6 +545,46 @@ def test_flash_auto_block_policy_aligned_and_bounded_waste():
     assert _default_flash_blocks(4096, 256, 512) == (256, 512)
 
 
+def test_flash_auto_block_policy_vmem_head_dim_aware():
+    """The auto policy folds head_dim + a VMEM budget into candidate
+    filtering (ADVICE round-5): the backward holds three (bq, bk) fp32
+    intermediates plus (block, d) tiles, so at head dims well above 64
+    a 1024 block exceeds VMEM and must demote to the largest block
+    that fits — never selecting an uncompilable default."""
+    from zookeeper_tpu.ops.attention import (
+        _FLASH_VMEM_BUDGET,
+        _default_flash_blocks,
+        _flash_bwd_vmem_estimate,
+    )
+
+    # The measured sweep winner (block 1024 at d=64 bf16) stays in.
+    assert _default_flash_blocks(
+        8192, None, None, head_dim=64, itemsize=2
+    ) == (1024, 1024)
+    # Blocks shrink monotonically with head_dim and every non-floor
+    # choice fits the budget.
+    prev = 2048
+    for d in (64, 256, 1024, 4096):
+        bq, bk = _default_flash_blocks(
+            8192, None, None, head_dim=d, itemsize=4
+        )
+        assert bq == bk and bq <= prev, d
+        prev = bq
+        assert (
+            bq == 128
+            or _flash_bwd_vmem_estimate(bq, bk, d, 4) <= _FLASH_VMEM_BUDGET
+        ), d
+    # A giant head dim actually demotes below 1024...
+    assert _default_flash_blocks(8192, None, None, head_dim=4096)[0] < 1024
+    # ...but explicit sizes always bypass both filters.
+    assert _default_flash_blocks(8192, 1024, 1024, head_dim=4096) == (
+        1024,
+        1024,
+    )
+    # head_dim=None keeps the padding-only policy (pinned above).
+    assert _default_flash_blocks(8192, None, None) == (1024, 1024)
+
+
 @pytest.mark.parametrize("s", [999, 1100])
 def test_flash_attention_awkward_lengths_exact(s):
     """Values and gradients stay exact at tile-awkward sequence lengths

@@ -1,0 +1,347 @@
+"""Fused multi-step engine (training.step.build_multi_step + the
+unroll>1 experiment loop): bit-exactness vs the eager per-step path,
+mid-slab resume, the partial-final-slab edge, and the deferred-readback
+logging contract."""
+
+import json
+
+import numpy as np
+import pytest
+
+from zookeeper_tpu.core import configure
+from zookeeper_tpu.training import TrainingExperiment
+
+
+def make_experiment(extra_conf=None):
+    exp = TrainingExperiment()
+    conf = {
+        "loader.dataset": "SyntheticMnist",
+        "loader.dataset.num_train_examples": 256,
+        "loader.dataset.num_validation_examples": 64,
+        "loader.preprocessing": "ImageClassificationPreprocessing",
+        "loader.preprocessing.height": 28,
+        "loader.preprocessing.width": 28,
+        "loader.preprocessing.channels": 1,
+        "loader.host_index": 0,
+        "loader.host_count": 1,
+        "model": "Mlp",
+        "model.hidden_units": (32,),
+        "batch_size": 32,
+        "epochs": 2,
+        "verbose": False,
+        **(extra_conf or {}),
+    }
+    configure(exp, conf, name="experiment")
+    return exp
+
+
+def assert_states_equal(a, b):
+    import jax
+
+    for xa, xb in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        np.testing.assert_array_equal(np.asarray(xa), np.asarray(xb))
+
+
+def test_build_multi_step_matches_sequential_steps():
+    """The scan-fused multi-step is the SAME computation as N eager
+    steps: params, opt state, step counter, and per-step metrics all
+    bit-equal."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from zookeeper_tpu.models import Mlp
+    from zookeeper_tpu.training import (
+        TrainState,
+        build_multi_step,
+        make_train_step,
+    )
+
+    m = Mlp()
+    configure(m, {"hidden_units": (8,)}, name="m")
+    module = m.build((4, 4, 1), num_classes=3)
+    params, model_state = m.initialize(module, (4, 4, 1))
+
+    def fresh_state():
+        return TrainState.create(
+            apply_fn=module.apply,
+            params=params,
+            model_state=model_state,
+            tx=optax.adam(1e-3),
+        )
+
+    rng = np.random.default_rng(0)
+    batches = [
+        {
+            "input": jnp.asarray(
+                rng.normal(size=(8, 4, 4, 1)), jnp.float32
+            ),
+            "target": jnp.asarray(rng.integers(0, 3, 8)),
+        }
+        for _ in range(5)
+    ]
+    step = jax.jit(make_train_step())
+    s_eager = fresh_state()
+    eager_metrics = []
+    for b in batches:
+        s_eager, mtr = step(s_eager, b)
+        eager_metrics.append(mtr)
+
+    slab = {
+        k: jnp.stack([b[k] for b in batches]) for k in batches[0]
+    }
+    multi = jax.jit(build_multi_step(make_train_step()))
+    s_fused, stacked = multi(fresh_state(), slab)
+
+    assert int(s_fused.step) == 5
+    assert_states_equal(s_eager.params, s_fused.params)
+    assert_states_equal(s_eager.opt_state, s_fused.opt_state)
+    for i, mtr in enumerate(eager_metrics):
+        for k, v in mtr.items():
+            np.testing.assert_array_equal(
+                np.asarray(v), np.asarray(stacked[k][i]), err_msg=f"{k}@{i}"
+            )
+
+
+@pytest.mark.parametrize("unroll", [4, 3])
+def test_unroll_bit_exact_with_eager_loop(unroll):
+    """unroll>1 must be bit-exact with unroll=1 over full training:
+    per-epoch train metrics, validation, and the final state (params +
+    opt state). unroll=3 over 8 steps/epoch also exercises the
+    partial-final-slab edge (slabs of 3, 3, 2)."""
+    ref = make_experiment()
+    h_ref = ref.run()
+    fused = make_experiment({"unroll": unroll})
+    h_fused = fused.run()
+
+    for split in ("train", "validation"):
+        assert len(h_ref[split]) == len(h_fused[split])
+        for e_ref, e_fused in zip(h_ref[split], h_fused[split]):
+            for k, v in e_ref.items():
+                if k == "examples_per_sec":
+                    continue
+                assert v == e_fused[k], (split, k)
+    assert_states_equal(ref.final_state.params, fused.final_state.params)
+    assert_states_equal(
+        ref.final_state.opt_state, fused.final_state.opt_state
+    )
+    assert int(np.asarray(fused.final_state.step)) == int(
+        np.asarray(ref.final_state.step)
+    )
+
+
+def test_unroll_mid_slab_resume_bit_exact(tmp_path):
+    """A step-granular checkpoint at a step that is NOT a multiple of
+    unroll resumes mid-slab: the fused run picks up at start_batch=5
+    (slabs of 3 over the remaining 3 steps of epoch 0, then full
+    epochs) and lands bit-identical to an uninterrupted eager run."""
+    ckpt = {
+        "checkpointer.directory": str(tmp_path / "ckpt"),
+        "checkpointer.save_every_steps": 5,
+        "checkpointer.save_every_epochs": 0,
+        "checkpointer.synchronous": True,
+    }
+    # Phase 1: eager, first epoch only; leaves a checkpoint at step 5
+    # (8 steps/epoch — step 5 is mid-slab for any unroll > 1).
+    first = make_experiment({"epochs": 1, **ckpt})
+    first.run()
+    first.checkpointer.close()
+
+    # Phase 2: resume FUSED (unroll=4 -> first slab covers steps 5-7,
+    # a partial slab of 3) and finish both epochs.
+    resumed = make_experiment({"epochs": 2, "unroll": 4, **ckpt})
+    h_resumed = resumed.run()
+    resumed.checkpointer.close()
+
+    # Reference: uninterrupted eager run, no checkpointing.
+    ref = make_experiment()
+    h_ref = ref.run()
+
+    assert_states_equal(ref.final_state.params, resumed.final_state.params)
+    assert_states_equal(
+        ref.final_state.opt_state, resumed.final_state.opt_state
+    )
+    # Epoch 1 (the fully-post-resume epoch) aggregates match exactly;
+    # epoch 0's are partial by design (resumed at step 5).
+    for k, v in h_ref["train"][1].items():
+        if k == "examples_per_sec":
+            continue
+        assert v == h_resumed["train"][1][k], k
+
+
+def test_unroll_step_cadence_checkpoints_quantize_to_slab_end(tmp_path):
+    """Step-cadence saves in fused mode fire at the end of the slab
+    containing the due step (state mid-scan is not addressable), so
+    saved step ids are slab multiples — and each is a valid exact
+    resume point."""
+    exp = make_experiment(
+        {
+            "epochs": 1,
+            "unroll": 4,
+            "checkpointer.directory": str(tmp_path / "ckpt"),
+            "checkpointer.save_every_steps": 3,
+            "checkpointer.save_every_epochs": 0,
+            "checkpointer.synchronous": True,
+        }
+    )
+    exp.run()
+    # 8 steps, slabs [0-4), [4-8); due steps 3 and 6 -> saves at 4, 8.
+    assert sorted(exp.checkpointer._manager().all_steps()) == [4, 8]
+    exp.checkpointer.close()
+
+
+def test_deferred_readback_logs_same_metrics_as_eager(tmp_path):
+    """CI smoke for the fused loop: Experiment.run() over a few slabs
+    on CPU, asserting the deferred-readback path emits EXACTLY the
+    per-step scalars the eager path logs (same steps, same values), so
+    the fused loop cannot silently rot. log_every=2 with unroll=3
+    exercises readback boundaries that straddle slab boundaries."""
+    logs = {}
+    for name, unroll in (("eager", 1), ("fused", 3)):
+        path = str(tmp_path / f"{name}.jsonl")
+        exp = make_experiment(
+            {
+                "epochs": 1,
+                "unroll": unroll,
+                "log_every": 2,
+                "writer.jsonl.path": path,
+            }
+        )
+        exp.run()
+        with open(path) as f:
+            logs[name] = [json.loads(line) for line in f]
+    # Drop the epoch-aggregate record (train_epoch/ + val/ tags); the
+    # per-step train/ records must agree row for row.
+    step_rows = {
+        name: [r for r in rows if any(k.startswith("train/") for k in r)]
+        for name, rows in logs.items()
+    }
+    assert step_rows["eager"], "eager path logged no per-step scalars"
+    assert step_rows["eager"] == step_rows["fused"]
+
+
+def test_unroll_respects_steps_per_epoch_cap():
+    """A steps_per_epoch cap that falls mid-slab truncates the final
+    slab instead of over-training (5 steps at unroll=4 -> slabs of
+    4 + 1)."""
+    ref = make_experiment({"epochs": 1, "steps_per_epoch": 5})
+    h_ref = ref.run()
+    fused = make_experiment(
+        {"epochs": 1, "steps_per_epoch": 5, "unroll": 4}
+    )
+    h_fused = fused.run()
+    assert int(np.asarray(fused.final_state.step)) == 5
+    for k, v in h_ref["train"][0].items():
+        if k == "examples_per_sec":
+            continue
+        assert v == h_fused["train"][0][k], k
+    assert_states_equal(ref.final_state.params, fused.final_state.params)
+
+
+def test_unroll_data_parallel_mesh():
+    import jax
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs multi-device (conftest forces 8 CPU devices)")
+    exp = make_experiment(
+        {
+            "partitioner": "DataParallelPartitioner",
+            "epochs": 1,
+            "unroll": 4,
+        }
+    )
+    history = exp.run()
+    assert history["validation"][-1]["accuracy"] > 0.2
+    # The slab sharding replicates the scan axis and shards batch on
+    # the data axes.
+    sh = exp.partitioner.slab_sharding()
+    assert sh.spec[0] is None and sh.spec[1] == ("data",)
+
+
+def test_unroll_invalid_rejected():
+    exp = make_experiment({"unroll": 0})
+    with pytest.raises(ValueError, match="unroll"):
+        exp.run()
+
+
+def test_unroll_conv_forward_exact_backward_within_ulp_drift():
+    """The documented conv caveat (build_multi_step docstring): the
+    FORWARD is bit-identical under scan (step-0 loss/metrics agree
+    exactly — the batch slicing and RNG are right), while conv wgrad
+    reductions may differ at the fp32 ULP level between the scanned
+    and flat programs (XLA reduction ordering), Adam-amplified over
+    steps. Pin both halves: exact forward, bounded drift."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from zookeeper_tpu.core import configure as _cfg
+    from zookeeper_tpu.models import SimpleCnn
+    from zookeeper_tpu.training import (
+        TrainState,
+        build_multi_step,
+        make_train_step,
+    )
+
+    m = SimpleCnn()
+    _cfg(m, {}, name="m")
+    module = m.build((28, 28, 1), num_classes=10)
+    params, model_state = m.initialize(module, (28, 28, 1))
+
+    def fresh():
+        return TrainState.create(
+            apply_fn=module.apply,
+            params=params,
+            model_state=model_state,
+            tx=optax.adam(1e-3),
+        )
+
+    rng = np.random.default_rng(0)
+    batches = [
+        {
+            "input": jnp.asarray(
+                rng.normal(size=(8, 28, 28, 1)), jnp.float32
+            ),
+            "target": jnp.asarray(rng.integers(0, 10, 8)),
+        }
+        for _ in range(4)
+    ]
+    step = jax.jit(make_train_step())
+    s_eager = fresh()
+    eager_losses = []
+    for b in batches:
+        s_eager, mtr = step(s_eager, b)
+        eager_losses.append(float(mtr["loss"]))
+    slab = {k: jnp.stack([b[k] for b in batches]) for k in batches[0]}
+    s_fused, stacked = jax.jit(build_multi_step(make_train_step()))(
+        fresh(), slab
+    )
+    # Forward bit-exact: the first step sees identical params + batch.
+    assert float(stacked["loss"][0]) == eager_losses[0]
+    # Later steps track within the documented Adam-amplified ULP drift.
+    np.testing.assert_allclose(
+        np.asarray(stacked["loss"]), eager_losses, rtol=1e-4
+    )
+    for a, b in zip(
+        jax.tree.leaves(s_eager.params), jax.tree.leaves(s_fused.params)
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=2e-2
+        )
+
+
+def test_unroll_with_ema_and_flip_free_extras_bit_exact():
+    """Optional step extras (EMA, label smoothing) ride the scan
+    unchanged."""
+    import jax
+
+    conf = {"epochs": 1, "ema_decay": 0.9, "label_smoothing": 0.1}
+    ref = make_experiment(conf)
+    ref.run()
+    fused = make_experiment({**conf, "unroll": 4})
+    fused.run()
+    for a, b in zip(
+        jax.tree.leaves(ref.final_state.ema_params),
+        jax.tree.leaves(fused.final_state.ema_params),
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

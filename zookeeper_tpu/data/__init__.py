@@ -48,6 +48,7 @@ from zookeeper_tpu.data.pipeline import (
     DataLoader,
     batch_iterator,
     prefetch_to_device,
+    slab_iterator,
 )
 
 __all__ = [
@@ -78,6 +79,7 @@ __all__ = [
     "WrappedSource",
     "batch_iterator",
     "prefetch_to_device",
+    "slab_iterator",
     "wrap_source",
     "write_store",
 ]

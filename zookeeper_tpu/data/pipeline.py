@@ -191,6 +191,73 @@ def batch_iterator(
             pool.shutdown(wait=False)
 
 
+def slab_iterator(
+    iterator: Iterator[Batch],
+    unroll: int,
+    *,
+    max_batches: Optional[int] = None,
+) -> Iterator[Batch]:
+    """Group ``unroll`` consecutive batches into one ``[unroll, batch,
+    ...]`` *slab* (the ``lax.scan`` multi-step's input unit — see
+    ``training.step.build_multi_step``).
+
+    Order-preserving by construction: slab ``i`` is exactly batches
+    ``[i * unroll, (i + 1) * unroll)`` of the underlying iterator, so
+    the determinism contract (seed/epoch-fixed permutation, exact
+    ``start_batch`` resume) is untouched — slab boundaries never change
+    which example lands in which step. A resume point that is not a
+    multiple of ``unroll`` simply starts slabbing from that batch
+    ("lands mid-slab" relative to an uninterrupted run's boundaries).
+
+    The FINAL slab may be partial (fewer than ``unroll`` batches) when
+    the epoch length is not a multiple of ``unroll``; consumers scan
+    over the leading dim, so a partial slab just compiles a second,
+    shorter program. Batches within a slab must share shapes (train
+    pipelines drop the remainder batch, so this holds by construction;
+    a shape-changing partial FINAL BATCH cannot be slabbed and raises).
+
+    ``max_batches`` caps how many batches are consumed in total (the
+    ``steps_per_epoch`` cutoff, applied BEFORE stacking so a cap that
+    falls mid-slab yields a final partial slab instead of silently
+    training past the cap).
+    """
+    if unroll < 1:
+        raise ValueError(f"unroll={unroll} must be >= 1.")
+
+    def stack(buf):
+        return {k: np.stack([b[k] for b in buf]) for k in buf[0]}
+
+    if max_batches is not None and max_batches <= 0:
+        return
+    buf: list = []
+    consumed = 0
+    first_sig = None
+    for batch in iterator:
+        # Shape signature checked against the FIRST batch of the whole
+        # iteration, not just within one slab: a partial final batch
+        # that lands alone in the last slab must still fail loudly
+        # (it would otherwise compile a third executable — and under a
+        # mesh, fail batch-axis sharding — far from this boundary).
+        sig = tuple(sorted((k, v.shape) for k, v in batch.items()))
+        if first_sig is None:
+            first_sig = sig
+        elif sig != first_sig:
+            raise ValueError(
+                "slab_iterator got batches of differing shapes (a "
+                "partial final batch?): slabs require drop_remainder "
+                "batching."
+            )
+        buf.append(batch)
+        consumed += 1
+        if len(buf) == unroll:
+            yield stack(buf)
+            buf = []
+        if max_batches is not None and consumed >= max_batches:
+            break
+    if buf:
+        yield stack(buf)
+
+
 _END = object()
 
 
@@ -337,13 +404,27 @@ class DataLoader:
         sharding: Optional[Any] = None,
         training: Optional[bool] = None,
         start_batch: int = 0,
+        unroll: int = 1,
+        max_batches: Optional[int] = None,
     ) -> Iterator[Any]:
         """``training=None`` infers train-mode behavior (shuffle, augment,
         drop-remainder) from the split name; pass ``training=False`` to
         iterate the train split in eval mode (e.g. scoring a checkpoint
         on training data: deterministic order, no augmentation).
         ``start_batch`` resumes the (deterministic) epoch mid-way — see
-        :func:`batch_iterator`."""
+        :func:`batch_iterator`.
+
+        ``unroll > 1`` yields device-resident SLABS of ``unroll``
+        stacked consecutive batches (``[unroll, batch, ...]``) instead
+        of single batches — the input unit of the fused multi-step loop
+        (:func:`slab_iterator` documents the order/resume contract;
+        ``sharding`` should then be the partitioner's
+        ``slab_sharding()``). Slabs are assembled on host and staged by
+        the SAME double-buffered background thread as single batches,
+        so one ``device_put`` moves ``unroll`` batches. ``max_batches``
+        caps total batches consumed (the ``steps_per_epoch`` cutoff —
+        with slabs, apply it here so a cap that falls mid-slab
+        truncates the final slab instead of over-training)."""
         if training is None:
             training = split == "train"
         source = self._source(split)
@@ -364,6 +445,12 @@ class DataLoader:
             num_workers=self.num_workers,
             start_batch=start_batch,
         )
+        if unroll > 1:
+            it = slab_iterator(it, unroll, max_batches=max_batches)
+        elif max_batches is not None:
+            import itertools
+
+            it = itertools.islice(it, max_batches)
         if self.prefetch > 0:
             return prefetch_to_device(it, size=self.prefetch, sharding=sharding)
         return it

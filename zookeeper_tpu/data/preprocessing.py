@@ -39,6 +39,18 @@ class Preprocessing:
     def input_shape(self) -> Tuple[int, ...]:
         raise NotImplementedError
 
+    @property
+    def input_dtype(self) -> Optional[str]:
+        """Numpy dtype name of the model input this preprocessing
+        produces, or None when unknown (passthrough of an arbitrary
+        source). Consumers that must trace the model WITHOUT a real
+        example (``models.summary.model_summary`` dummy inputs) key
+        their dtype off this instead of guessing from input rank —
+        a float dummy is an invalid embedding index for token models,
+        and an int dummy is the wrong dtype for a rank-1 float-feature
+        MLP."""
+        return None
+
     def __call__(self, example: Example, training: bool) -> Example:
         return {
             "input": np.asarray(self.input(example, training)),
@@ -98,6 +110,11 @@ class TokenPreprocessing(PassThroughPreprocessing):
     seq_len: int = Field(64)
 
     @property
+    def input_dtype(self) -> str:
+        # Token ids: embedding lookups need an integer dummy.
+        return "int32"
+
+    @property
     def input_shape(self) -> Tuple[int, ...]:
         # The inherited example_shape keeps the parent contract (takes
         # precedence when explicitly set) rather than becoming a dead,
@@ -146,6 +163,11 @@ class ImageClassificationPreprocessing(Preprocessing):
     @property
     def input_shape(self) -> Tuple[int, ...]:
         return (self.height, self.width, self.channels)
+
+    @property
+    def input_dtype(self) -> str:
+        # Pixels scale to float regardless of augmentation settings.
+        return "float32"
 
     def _random_resized_crop(
         self, image: np.ndarray, rng: np.random.Generator

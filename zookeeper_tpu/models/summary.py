@@ -133,9 +133,17 @@ def model_summary(
     cost analysis for the FLOP count (compilation-free where supported;
     falls back to ``None`` silently since it is diagnostic output).
 
-    ``input_dtype`` defaults to float32 for image-shaped inputs and
-    int32 for rank-1 (token-sequence) shapes — a float dummy is an
-    invalid embedding index for language models.
+    ``input_dtype``: the dummy input's dtype. Callers that know the
+    pipeline should pass it explicitly — the data layer's
+    ``Preprocessing.input_dtype`` hint is the canonical source
+    (``TokenPreprocessing`` -> int32, image preprocessing -> float32;
+    the experiment's ``print_model_summary`` threads it through).
+    When None, a RANK heuristic fills in: float32 for multi-dim
+    (image-shaped) inputs, int32 for rank-1 shapes — rank-1 is
+    overwhelmingly a token sequence here, and a float dummy is an
+    invalid embedding index for language models. The heuristic is
+    wrong for a rank-1 float-feature model (an MLP over flat
+    features): pass ``input_dtype="float32"`` there.
     """
     import jax
     import jax.numpy as jnp

@@ -376,9 +376,11 @@ def flash_attention(
     inference.
 
     ``block_q``/``block_k`` default to the largest aligned candidate
-    (up to 1024) whose padding waste stays small — see
-    ``_default_flash_blocks``; pass explicit sizes to trade VMEM for
-    grid granularity, e.g. on head dims much larger than 64.
+    (up to 1024) whose padding waste stays small AND whose backward
+    working set fits the VMEM budget at this ``head_dim`` — see
+    ``_default_flash_blocks``; the auto policy therefore never selects
+    a block size whose backward fails Mosaic compilation on large head
+    dims. Pass explicit sizes to override (they bypass both filters).
 
     The backward is the standard recompute scheme (`custom_vjp`): the
     forward saves only O and the per-row log-sum-exp; two blocked
@@ -397,30 +399,72 @@ def flash_attention(
         scale = q.shape[-1] ** -0.5
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-    block_q, block_k = _default_flash_blocks(q.shape[1], block_q, block_k)
+    block_q, block_k = _default_flash_blocks(
+        q.shape[1], block_q, block_k,
+        head_dim=q.shape[-1], itemsize=q.dtype.itemsize,
+    )
     return _flash_attention(
         q, k, v, bool(causal), float(scale), int(block_q), int(block_k),
         bool(interpret),
     )
 
 
-def _default_flash_blocks(s, block_q, block_k):
+#: VMEM the auto flash-block policy budgets for one backward grid step
+#: (bytes). The backward kernels are the binding residency: three
+#: (block_q, block_k) fp32 intermediates (scores, P, dS) plus the
+#: double-buffered (block, head_dim) input tiles and fp32 accumulators.
+#: 64 MiB keeps the measured sweep winner (block 1024 at head_dim 64,
+#: ~16 MiB) comfortably in and demotes only extreme head dims on
+#: v5e-class parts (128 MiB physical VMEM/core; older generations are
+#: ~16 MiB — pass explicit blocks or a smaller budget there).
+_FLASH_VMEM_BUDGET = 64 * 1024 * 1024
+
+
+def _flash_bwd_vmem_estimate(block_q, block_k, head_dim, itemsize):
+    """Rough bytes one backward grid step keeps resident in VMEM: the
+    three fp32 (bq, bk) intermediates + six (block, d) input tiles at
+    the operand dtype, double-buffered by the Mosaic pipeline, + two
+    fp32 (block, d) accumulators."""
+    blk = max(block_q, block_k)
+    intermediates = 3 * block_q * block_k * 4
+    tiles = 2 * 6 * blk * head_dim * itemsize
+    accumulators = 2 * blk * head_dim * 4
+    return intermediates + tiles + accumulators
+
+
+def _default_flash_blocks(s, block_q, block_k, head_dim=None, itemsize=4):
     """Auto block size: the LARGEST aligned candidate whose padding
-    waste stays under 1/8 of the sequence. Large blocks amortize the
-    sequential grid iteration (the sweep winner at every measured
-    power-of-two length — sweep_r07/flash_bwd_timing.py: 22.7 -> 5.26
-    ms/step at s=8192 going 128 -> 1024), but a big block on an awkward
-    length would round the padded sequence up to the block multiple
-    (s=1100 at block 1024 pads to 2048 — 86% wasted rows), so awkward
-    lengths fall back toward 128. Sequences at or below a block are a
-    single tile (clamped 16-aligned by ``_flash_dims``)."""
+    waste stays under 1/8 of the sequence AND whose backward working
+    set fits the VMEM budget. Large blocks amortize the sequential
+    grid iteration (the sweep winner at every measured power-of-two
+    length — sweep_r07/flash_bwd_timing.py: 22.7 -> 5.26 ms/step at
+    s=8192 going 128 -> 1024), but a big block on an awkward length
+    would round the padded sequence up to the block multiple (s=1100
+    at block 1024 pads to 2048 — 86% wasted rows), so awkward lengths
+    fall back toward 128; and at head dims well above 64 the backward's
+    (block, d) tiles grow until a 1024 block exceeds VMEM — a loud
+    Mosaic compile failure if selected, so ``head_dim``-aware candidates
+    demote to the largest block that fits (``_flash_bwd_vmem_estimate``
+    against ``_FLASH_VMEM_BUDGET``). ``head_dim=None`` skips the VMEM
+    filter (padding-only policy, the pre-head_dim behavior); explicit
+    ``block_q``/``block_k`` always pass through untouched. Sequences at
+    or below a block are a single tile (clamped 16-aligned by
+    ``_flash_dims``)."""
     if block_q is None or block_k is None:
         auto = 128
         for blk in (1024, 512, 256, 128):
             pad = -(-s // blk) * blk - s
-            if pad * 8 <= s:
-                auto = blk
-                break
+            if pad * 8 > s:
+                continue
+            if (
+                head_dim is not None
+                and blk > 128
+                and _flash_bwd_vmem_estimate(blk, blk, head_dim, itemsize)
+                > _FLASH_VMEM_BUDGET
+            ):
+                continue
+            auto = blk
+            break
         if block_q is None:
             block_q = auto
         if block_k is None:
@@ -931,7 +975,9 @@ def ring_flash_attention_local(
     scale = float(scale)
     # Auto blocks scale with the PER-SHARD length (each flash call sees
     # one K/V shard).
-    block_q, block_k = _default_flash_blocks(sq, block_q, block_k)
+    block_q, block_k = _default_flash_blocks(
+        sq, block_q, block_k, head_dim=d, itemsize=q.dtype.itemsize,
+    )
 
     def flash_block(k_blk, v_blk, blk_causal):
         o_t, lse_t = _flash_attention_lse(

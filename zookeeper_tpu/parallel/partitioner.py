@@ -42,6 +42,13 @@ class Partitioner:
         device placement)."""
         return None
 
+    def slab_sharding(self) -> Optional[NamedSharding]:
+        """Sharding for ``[unroll, batch, ...]`` SLABS (the fused
+        multi-step loop's input unit): the leading unroll axis is the
+        scan dimension and stays unsharded; the batch axis (now axis 1)
+        carries the data-parallel sharding. None = default placement."""
+        return None
+
     def shard_state(self, state: Any) -> Any:
         """Place the freshly-initialized state onto devices."""
         return state
@@ -56,6 +63,23 @@ class Partitioner:
         """Compile ``(state, batch) -> (state, metrics)``."""
         raise NotImplementedError
 
+    def compile_multi_step(
+        self,
+        multi_step_fn: Callable,
+        state: Any,
+        *,
+        donate_state: bool = True,
+        donate_slab: bool = False,
+    ) -> Callable:
+        """Compile a fused ``(state, slab) -> (state, stacked_metrics)``
+        multi-step (``training.step.build_multi_step`` output).
+        ``donate_slab`` stays off by default: donation is input->OUTPUT
+        aliasing, and no output shares the slab's ``[unroll, batch,
+        ...]`` shape, so donating it buys nothing and XLA warns on
+        every compile. The slab's HBM frees normally when the loop
+        drops its reference after the dispatch."""
+        raise NotImplementedError
+
     def compile_eval(self, eval_fn: Callable, state: Any) -> Callable:
         """Compile ``(state, batch) -> metrics``."""
         raise NotImplementedError
@@ -67,6 +91,21 @@ class SingleDevicePartitioner(Partitioner):
 
     def compile_step(self, step_fn, state, *, donate_state: bool = True):
         return jax.jit(step_fn, donate_argnums=(0,) if donate_state else ())
+
+    def compile_multi_step(
+        self,
+        multi_step_fn,
+        state,
+        *,
+        donate_state: bool = True,
+        donate_slab: bool = False,
+    ):
+        donate = tuple(
+            i
+            for i, d in enumerate((donate_state, donate_slab))
+            if d
+        )
+        return jax.jit(multi_step_fn, donate_argnums=donate)
 
     def compile_eval(self, eval_fn, state):
         return jax.jit(eval_fn)
@@ -177,6 +216,15 @@ class MeshPartitioner(Partitioner):
     def batch_sharding(self) -> NamedSharding:
         return NamedSharding(self.mesh, PartitionSpec(tuple(self.data_axes)))
 
+    def slab_sharding(self) -> NamedSharding:
+        # Leading unroll (scan) axis replicated, batch axis sharded over
+        # the data axes — each device holds its batch slice of EVERY
+        # step in the slab, so the scanned per-step batch carries
+        # exactly the batch_sharding() layout.
+        return NamedSharding(
+            self.mesh, PartitionSpec(None, tuple(self.data_axes))
+        )
+
     def state_sharding(self, state: Any) -> Any:
         """Per-leaf shardings for the whole TrainState.
 
@@ -238,6 +286,29 @@ class MeshPartitioner(Partitioner):
             in_shardings=(state_sh, batch_sh),
             out_shardings=(state_sh, metrics_sh),
             donate_argnums=(0,) if donate_state else (),
+        )
+
+    def compile_multi_step(
+        self,
+        multi_step_fn,
+        state,
+        *,
+        donate_state: bool = True,
+        donate_slab: bool = False,
+    ):
+        state_sh = self.state_sharding(state)
+        slab_sh = self.slab_sharding()
+        # Stacked [unroll] per-step metrics replicate like the single
+        # step's scalars (PartitionSpec() is rank-agnostic).
+        metrics_sh = NamedSharding(self.mesh, PartitionSpec())
+        donate = tuple(
+            i for i, d in enumerate((donate_state, donate_slab)) if d
+        )
+        return jax.jit(
+            self._with_activation_scope(multi_step_fn),
+            in_shardings=(state_sh, slab_sh),
+            out_shardings=(state_sh, metrics_sh),
+            donate_argnums=donate,
         )
 
     def compile_eval(self, eval_fn, state):

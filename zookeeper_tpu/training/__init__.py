@@ -52,14 +52,20 @@ from zookeeper_tpu.training.profiling import (
     device_op_stats,
     format_breakdown,
     op_time_breakdown,
+    slab_annotation,
 )
 from zookeeper_tpu.training.state import TrainState
-from zookeeper_tpu.training.step import make_eval_step, make_train_step
+from zookeeper_tpu.training.step import (
+    build_multi_step,
+    make_eval_step,
+    make_train_step,
+)
 
 __all__ = [
     "device_op_stats",
     "format_breakdown",
     "op_time_breakdown",
+    "slab_annotation",
     "Adam",
     "AdamW",
     "BINARY_KERNEL_PATTERN",
@@ -90,6 +96,7 @@ __all__ = [
     "TrainState",
     "TrainingExperiment",
     "WarmupCosine",
+    "build_multi_step",
     "make_eval_step",
     "make_train_step",
 ]

@@ -79,6 +79,82 @@ def scan_chain_latency(
     return max(marginal, 1e-9)
 
 
+def time_marginal(run_chain, n1: int, n2: int, rounds: int) -> float:
+    """Per-step marginal time via two-chain-length differencing — the
+    one timing protocol the whole bench uses (BASELINE.md methodology;
+    lives here so bench.py and the library share ONE copy).
+
+    ``run_chain(n)`` runs ``n`` chained steps ended by a host readback
+    and returns wall seconds. Each chain length takes its min over
+    ``rounds`` INDEPENDENTLY (min over additive non-negative noise is
+    sound), then the marginal is taken once — min over per-round
+    *differences* would be biased fast whenever a jitter spike landed
+    on a short chain. May return <= 0 under pathological jitter;
+    callers decide how to handle.
+    """
+    t1_min = t2_min = None
+    for _ in range(rounds):
+        t1 = run_chain(n1)
+        t2 = run_chain(n2)
+        t1_min = t1 if t1_min is None else min(t1_min, t1)
+        t2_min = t2 if t2_min is None else min(t2_min, t2)
+    return (t2_min - t1_min) / (n2 - n1)
+
+
+def measure_fused_loop_time(
+    multi_step: Callable[[Any, Any], Tuple[Any, Any]],
+    state: Any,
+    slab: Any,
+    *,
+    rounds: int = 4,
+    n1: int = 8,
+    n2: int = 24,
+) -> Tuple[float, Any]:
+    """Steady-state wall seconds PER STEP of the fused multi-step loop
+    — the END-TO-END number (Python dispatch + host bookkeeping +
+    compute), where the bench's ``step_time_ms`` is the HBM-resident
+    compute-only anchor. The gap between them is exactly the per-step
+    overhead the multi-step engine amortizes.
+
+    ``multi_step`` is a compiled ``(state, slab) -> (state,
+    stacked_metrics)`` (``build_multi_step`` through
+    ``Partitioner.compile_multi_step(..., donate_slab=False)`` — the
+    slab is re-driven every call, so it must NOT be donated; the state
+    should be). Chains of ``n`` back-to-back slab dispatches end in one
+    scalar ``device_get`` (the only reliable completion barrier through
+    a remote-TPU tunnel), timed with the repo's standard protocol:
+    min-over-``rounds`` per chain length independently, marginal over
+    the two lengths so the fixed dispatch + sync overhead of the chain
+    ENDS cancels while the per-slab dispatch cost — the thing being
+    measured — stays in. May return a non-positive time under
+    pathological jitter; callers decide whether to escalate chain
+    lengths (pass larger ``n1``/``n2``) or discard.
+
+    Returns ``(seconds_per_step, final_state)`` — the state is
+    threaded through every timed step (donation consumed the input),
+    so callers can keep using it.
+    """
+    unroll = int(
+        next(iter(slab.values())).shape[0]
+        if isinstance(slab, dict)
+        else jax.tree.leaves(slab)[0].shape[0]
+    )
+    holder = {"state": state}
+
+    def run_chain(n: int) -> float:
+        st = holder["state"]
+        t0 = time.perf_counter()
+        for _ in range(n):
+            st, metrics = multi_step(st, slab)
+        holder["state"] = st
+        float(jax.device_get(metrics["loss"][-1]))
+        return time.perf_counter() - t0
+
+    run_chain(1)  # Warm the compile before timing.
+    per_slab = time_marginal(run_chain, n1, n2, rounds)
+    return per_slab / unroll, holder["state"]
+
+
 def measure_inference_latency(
     module: Any,
     variables: Any,

@@ -90,6 +90,22 @@ class TrainingExperiment(Experiment):
     epochs: int = Field(1)
     batch_size: int = Field(32)
     seed: int = Field(0)
+    #: Fused multi-step execution: batches are stacked into device-
+    #: resident SLABS of ``unroll`` consecutive batches and the train
+    #: step runs ``unroll`` times inside ONE ``lax.scan`` program
+    #: (``training.step.build_multi_step``), so per-step Python
+    #: dispatch, host bookkeeping, and the forced device->host metrics
+    #: sync are paid once per slab instead of once per step. Metrics
+    #: stay on device as ``[unroll]``-stacked arrays (deferred
+    #: readback: the host reads them only at ``log_every`` boundaries
+    #: and at epoch end, one ``device_get`` each). Same steps, same
+    #: RNG folding, same example order as the eager loop — bit-exact
+    #: for the dense stack, conv backwards within XLA reduction-order
+    #: ULPs (see ``build_multi_step``); 1 = today's eager loop. Costs
+    #: ``unroll x batch`` of input HBM per slab (x2 while the prefetch
+    #: double-buffer holds the next slab) and quantizes step-cadence
+    #: checkpoints and ``log_every`` readbacks to slab boundaries.
+    unroll: int = Field(1)
     #: Cap on steps per epoch (smoke tests / benchmarking); -1 = full epoch.
     steps_per_epoch: int = Field(-1)
     validate: bool = Field(True)
@@ -250,6 +266,108 @@ class TrainingExperiment(Experiment):
         )
         return step_idx + 1 < spe or not epoch_save_fires
 
+    def _log_step_scalars(self, epoch, step_idx, spe, row):
+        """Per-step progress line + ``train/`` writer scalars — ONE
+        formatting path shared by the eager and fused loops so the two
+        modes can never log divergent output."""
+        self._log(
+            f"  step {step_idx + 1}/{spe} "
+            f"loss={row['loss']:.4f} acc={row['accuracy']:.4f}"
+        )
+        self.writer.write_scalars(
+            epoch * spe + step_idx + 1,
+            {f"train/{k}": v for k, v in row.items()},
+        )
+
+    def _run_fused_epoch(
+        self, multi_step, state, accum, epoch, spe, start_b,
+        profiling, p_start, p_stop,
+    ):
+        """One epoch of the fused multi-step engine (``unroll > 1``).
+
+        Drives device-resident slabs of ``unroll`` stacked batches
+        through the compiled ``lax.scan`` multi-step with DEFERRED
+        metrics readback: each dispatch appends the slab's
+        ``[k]``-stacked per-step metrics to ``accum`` still on device,
+        and the host only reads back (one ``device_get`` per occasion)
+        at ``log_every`` step boundaries — so with logging off, the
+        loop dispatches slab N+1 without ever blocking on slab N's
+        results, and host time disappears under device time.
+
+        Semantics match the eager loop step-for-step: the slab
+        iterator preserves example order and ``start_batch`` resume
+        (a resume point mid-slab just becomes the first slab's first
+        step), the step counter advances inside the scan, and
+        ``log_every`` scalars carry the SAME per-step values the eager
+        path logs. Two quantizations are inherent: step-cadence
+        checkpoints fire at the end of the slab containing the due
+        step (the saved state is a valid, exactly-resumable state a
+        few steps later), and the profiler trace window widens to
+        whole slabs. Returns ``(state, steps_trained)``.
+        """
+        import jax
+
+        from zookeeper_tpu.training.profiling import slab_annotation
+
+        step_idx = start_b
+        tracing = False
+        trace_first = start_b
+        for slab_idx, slab in enumerate(
+            self.loader.batches(
+                "train",
+                epoch=epoch,
+                sharding=self.partitioner.slab_sharding(),
+                start_batch=start_b,
+                unroll=self.unroll,
+                max_batches=spe - start_b,
+            )
+        ):
+            k = int(next(iter(slab.values())).shape[0])
+            # Trace from the first SLAB BOUNDARY at/after p_start so
+            # the scan compile + warmup slabs stay OUT of the window
+            # (the eager path's warmup-exclusion contract); a
+            # single-slab epoch has no later boundary, so its one
+            # dispatch is traced, compile included — the only capture
+            # possible there.
+            if profiling and not tracing and (
+                step_idx >= p_start or step_idx + k >= spe
+            ):
+                jax.profiler.start_trace(self.profile_dir)
+                tracing, trace_first = True, step_idx
+            with slab_annotation(slab_idx, num_steps=k):
+                state, metrics = multi_step(state, slab)
+            accum.append(metrics)
+            if tracing and step_idx + k > p_stop:
+                jax.block_until_ready(metrics["loss"])
+                jax.profiler.stop_trace()
+                profiling = tracing = False
+                self._log_profile_breakdown(step_idx + k - trace_first)
+            if any(
+                self._step_save_due(epoch, s, spe)
+                for s in range(step_idx, step_idx + k)
+            ):
+                self.checkpointer.save(state)
+            if self.log_every:
+                bounds = [
+                    s
+                    for s in range(step_idx, step_idx + k)
+                    if (s + 1) % self.log_every == 0
+                ]
+                if bounds:
+                    # ONE readback for the whole slab; per-step values
+                    # are identical to what the eager loop would log.
+                    hm = jax.device_get(metrics)
+                    for s in bounds:
+                        self._log_step_scalars(
+                            epoch, s, spe,
+                            {
+                                kk: float(v[s - step_idx])
+                                for kk, v in hm.items()
+                            },
+                        )
+            step_idx += k
+        return state, step_idx - start_b
+
     def run(self) -> Dict[str, List[Dict[str, float]]]:
         import jax
         import jax.numpy as jnp
@@ -265,6 +383,11 @@ class TrainingExperiment(Experiment):
             # Pure config: fail before device setup / checkpoint restore.
             raise ValueError(
                 f"remat={self.remat!r} unknown; choose none/dots/full/quant."
+            )
+        if self.unroll < 1:
+            raise ValueError(
+                f"unroll={self.unroll} must be >= 1 (1 = eager per-step "
+                "loop; N fuses N steps per dispatch)."
             )
         if self.early_stop_mode not in ("auto", "min", "max"):
             raise ValueError(
@@ -317,6 +440,10 @@ class TrainingExperiment(Experiment):
                     model_summary(
                         self.model.build(input_shape, self.num_classes),
                         input_shape,
+                        # The pipeline knows the real input dtype (token
+                        # ids vs pixels); None falls back to summary's
+                        # documented rank heuristic.
+                        input_dtype=self.loader.preprocessing.input_dtype,
                     )
                 )
             )
@@ -325,7 +452,18 @@ class TrainingExperiment(Experiment):
         partitioner.setup()
         state = partitioner.shard_state(self.build_state())
         state = self.checkpointer.restore_state(state)
-        train_step = partitioner.compile_step(self._train_step_fn(), state)
+        if self.unroll > 1:
+            from zookeeper_tpu.training.step import build_multi_step
+
+            multi_step = partitioner.compile_multi_step(
+                build_multi_step(self._train_step_fn()), state
+            )
+            train_step = None
+        else:
+            multi_step = None
+            train_step = partitioner.compile_step(
+                self._train_step_fn(), state
+            )
         eval_step = partitioner.compile_eval(
             make_eval_step(
                 smoothed_softmax_cross_entropy(self.label_smoothing),
@@ -377,53 +515,67 @@ class TrainingExperiment(Experiment):
                 # actually executes (warmup steps excluded).
                 p_start = min(start_b + 4, spe - 1)
                 p_stop = min(start_b + 14, spe - 1)
-                for step_idx, batch in enumerate(
-                    self.loader.batches(
-                        "train",
-                        epoch=epoch,
-                        sharding=batch_sharding,
-                        start_batch=start_b,
-                    ),
-                    start=start_b,
-                ):
-                    if step_idx >= spe:
-                        break
-                    if profiling and step_idx == p_start:
-                        jax.profiler.start_trace(self.profile_dir)
-                    state, metrics = train_step(state, batch)
-                    accum.append(metrics)
-                    if profiling and step_idx == p_stop:
-                        jax.block_until_ready(metrics["loss"])
-                        jax.profiler.stop_trace()
-                        profiling = False
-                        # Steps p_start..p_stop run INSIDE the trace
-                        # window, inclusive on both ends.
-                        self._log_profile_breakdown(p_stop - p_start + 1)
-                    if self._step_save_due(epoch, step_idx, spe):
-                        self.checkpointer.save(state)
-                    if self.log_every and (step_idx + 1) % self.log_every == 0:
-                        m = {k: float(v) for k, v in metrics.items()}
-                        self._log(
-                            f"  step {step_idx + 1}/{spe} "
-                            f"loss={m['loss']:.4f} acc={m['accuracy']:.4f}"
-                        )
-                        # Per-step scalars ride the host pull that log_every
-                        # already paid for — finer than epoch granularity at
-                        # zero extra device syncs.
-                        self.writer.write_scalars(
-                            epoch * spe + step_idx + 1,
-                            {f"train/{k}": v for k, v in m.items()},
-                        )
+                if multi_step is not None:
+                    state, steps_trained = self._run_fused_epoch(
+                        multi_step, state, accum, epoch, spe, start_b,
+                        profiling, p_start, p_stop,
+                    )
+                else:
+                    for step_idx, batch in enumerate(
+                        self.loader.batches(
+                            "train",
+                            epoch=epoch,
+                            sharding=batch_sharding,
+                            start_batch=start_b,
+                        ),
+                        start=start_b,
+                    ):
+                        if step_idx >= spe:
+                            break
+                        if profiling and step_idx == p_start:
+                            jax.profiler.start_trace(self.profile_dir)
+                        state, metrics = train_step(state, batch)
+                        accum.append(metrics)
+                        if profiling and step_idx == p_stop:
+                            jax.block_until_ready(metrics["loss"])
+                            jax.profiler.stop_trace()
+                            profiling = False
+                            # Steps p_start..p_stop run INSIDE the trace
+                            # window, inclusive on both ends.
+                            self._log_profile_breakdown(p_stop - p_start + 1)
+                        if self._step_save_due(epoch, step_idx, spe):
+                            self.checkpointer.save(state)
+                        if self.log_every and (step_idx + 1) % self.log_every == 0:
+                            # Per-step scalars ride the host pull that log_every
+                            # already paid for — finer than epoch granularity at
+                            # zero extra device syncs.
+                            self._log_step_scalars(
+                                epoch, step_idx, spe,
+                                {k: float(v) for k, v in metrics.items()},
+                            )
+                    steps_trained = len(accum)
                 # One host sync per epoch: pull all accumulated device scalars
                 # in a single device_get (each separate transfer pays the full
                 # host<->device round trip, ~100ms on remote-tunnel TPUs).
+                # Fused slabs land as [k]-stacked per-step arrays; eager
+                # steps as scalars — atleast_1d + concatenate makes the
+                # epoch mean a plain per-step mean in both modes.
                 host_accum = jax.device_get(accum)
                 epoch_metrics = {
-                    k: float(np.mean([m[k] for m in host_accum]))
+                    k: float(
+                        np.mean(
+                            np.concatenate(
+                                [
+                                    np.atleast_1d(np.asarray(m[k]))
+                                    for m in host_accum
+                                ]
+                            )
+                        )
+                    )
                     for k in (host_accum[0] if host_accum else {})
                 }
                 dt = time.perf_counter() - t0
-                examples = len(accum) * self.loader.batch_size
+                examples = steps_trained * self.loader.batch_size
                 epoch_metrics["examples_per_sec"] = examples / dt if dt > 0 else 0.0
                 # A mid-epoch resume trains only steps start_b..spe-1 of
                 # its first epoch: its train aggregates describe a PARTIAL

@@ -34,7 +34,33 @@ __all__ = [
     "device_op_stats",
     "op_time_breakdown",
     "format_breakdown",
+    "slab_annotation",
 ]
+
+
+def slab_annotation(slab_index: int, num_steps: int = 1):
+    """Trace annotation marking ONE fused-slab dispatch (the
+    ``lax.scan`` multi-step of ``training.step.build_multi_step``).
+
+    Wrap the host-side dispatch of each slab::
+
+        with slab_annotation(i, num_steps=k):
+            state, metrics = multi_step(state, slab)
+
+    In the trace viewer the host thread then shows a ``slab i (k
+    steps)`` span per dispatch; because the fused loop never blocks on
+    results, consecutive spans are back-to-back slivers while the
+    device planes stay saturated — the dispatch/compute OVERLAP the
+    multi-step engine exists to create is directly visible (an eager
+    loop instead shows one host span per step with the device idling
+    between them). Near-zero cost when no trace is active
+    (``jax.profiler.TraceAnnotation`` is a no-op outside a capture).
+    """
+    import jax
+
+    return jax.profiler.TraceAnnotation(
+        f"slab {slab_index} ({num_steps} steps)"
+    )
 
 
 def _find_xplane_files(trace_dir: str) -> List[str]:
