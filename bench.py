@@ -596,6 +596,14 @@ def main():
     ) = resolve_bench_config()
     module = model.build(input_shape, num_classes=num_classes)
     params, model_state = model.initialize(module, input_shape)
+    # Snapshot the weights for the serving anchor NOW: the donated train
+    # step below consumes its input state's buffers, and on some
+    # device_put/sharding combinations those can alias these arrays —
+    # binding deleted arrays later would silently drop the serve_*
+    # metrics (the except guard would eat the error).
+    serve_weights = None
+    if _env_flag(os.environ, "ZK_BENCH_SERVE"):
+        serve_weights = jax.device_get((params, model_state))
     state = TrainState.create(
         apply_fn=module.apply,
         params=params,
@@ -773,6 +781,67 @@ def main():
     n_chips = jax.device_count()
     images_per_sec_per_chip = batch_size / step_time / max(1, n_chips)
 
+    # Serving-side anchors (env-gated: the serving engine compiles its
+    # own forward, minutes at ImageNet shapes): steady-state latency and
+    # throughput of the REAL inference path — zookeeper_tpu.serving's
+    # bucketed, pre-compiled, padded engine dispatch, host input
+    # staging included (requests arrive on host). serve_qps_per_chip
+    # uses the shared two-chain-length marginal (time_marginal) like
+    # every other anchor; the p50/p99 percentiles come from repeated
+    # SHORT chains (per-dispatch = chain/length), which amortize the
+    # fixed tunnel sync the same way while preserving dispatch-to-
+    # dispatch spread. ZK_BENCH_SERVE_BUCKET overrides the bucket (32
+    # default — the batcher's steady-state micro-batch).
+    serve_metrics = None
+    if serve_weights is not None:
+        try:
+            from zookeeper_tpu.serving import InferenceEngine
+            from zookeeper_tpu.training.benchmark import (
+                measure_serving_latency,
+            )
+
+            serve_bucket = int(os.environ.get("ZK_BENCH_SERVE_BUCKET", "32"))
+            engine = InferenceEngine()
+            configure(
+                engine,
+                {"batch_buckets": (serve_bucket,)},
+                name="serve_engine",
+            )
+            engine.bind(
+                module.apply,
+                serve_weights[0],
+                serve_weights[1],
+                input_shape,
+                dtype=jnp.bfloat16,
+                partitioner=partitioner,
+            )
+            engine.warmup()  # compile outside the timed window
+            xs = np.asarray(
+                rng.normal(size=(serve_bucket, *input_shape)),
+                np.dtype(jnp.bfloat16),
+            )
+            mean_s, p50_s, p99_s = measure_serving_latency(engine, xs)
+            if mean_s <= 0:
+                raise RuntimeError(
+                    f"non-positive serve marginal {mean_s:.6f}s "
+                    "(tunnel jitter)"
+                )
+            serve_metrics = {
+                "serve_bucket": serve_bucket,
+                "serve_p50_ms": round(p50_s * 1e3, 3),
+                "serve_p99_ms": round(p99_s * 1e3, 3),
+                "serve_qps_per_chip": round(
+                    serve_bucket / mean_s / max(1, n_chips), 1
+                ),
+            }
+        except Exception as e:  # never lose the primary metric
+            print(
+                f"serving measurement failed ({e}); omitting serve_*",
+                file=sys.stderr,
+                flush=True,
+            )
+            serve_metrics = None
+
     extras = {
         "model": model_name,
         "batch_size": batch_size,
@@ -788,6 +857,8 @@ def main():
         extras["loop_images_per_sec_per_chip"] = round(
             batch_size / loop_time / max(1, n_chips), 1
         )
+    if serve_metrics is not None:
+        extras.update(serve_metrics)
     if compiler_options is not None:
         extras["compiler_options"] = compiler_options
     if cost is not None:

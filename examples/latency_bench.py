@@ -1,9 +1,10 @@
-"""Batch-1 (or any batch) inference-latency benchmark as a CLI task.
+"""Inference-latency benchmark as a CLI task — ON the serving engine.
 
-Measures a model's forward latency with the tunnel-safe on-device
-scan-chain methodology (``zookeeper_tpu.training.benchmark``), optionally
-loading an exported checkpoint — so deployment-mode comparisons (bf16 vs
-int8 vs packed, BASELINE.md's tables) are one command each::
+Measures the steady-state per-dispatch latency of the REAL serving path
+(``zookeeper_tpu.serving.InferenceEngine``: bucketed, pre-compiled,
+padded forward — not a bespoke timing loop), optionally loading a
+deployment artifact, so deployment-mode comparisons (bf16 vs int8 vs
+packed, EMA vs raw weights, BASELINE.md's tables) are one command each::
 
     # Fresh-init QuickNet, bf16, batch-1:
     python examples/latency_bench.py LatencyBench model=QuickNet \\
@@ -14,8 +15,15 @@ int8 vs packed, BASELINE.md's tables) are one command each::
         model.binary_compute=xnor model.packed_weights=True \\
         checkpoint=/tmp/packed_model
 
+Timing uses the repo's shared two-chain-length marginal protocol
+(``training.benchmark.time_marginal``): chains of back-to-back engine
+dispatches ended by one host readback, so the fixed dispatch + sync
+overhead of the chain END cancels while the per-dispatch cost — engine
+Python + padding + compiled forward — stays in. That is the number a
+request actually pays once the MicroBatcher hands the engine a bucket.
+
 Prints one JSON line: {"model", "batch_size", "ms_per_inference",
-"params_mib"}.
+"params_mib", "compiles"}.
 """
 
 import json
@@ -28,57 +36,89 @@ from zookeeper_tpu.training import Experiment
 
 @task
 class LatencyBench(Experiment):
-    """Measure forward latency of a model (optionally from a checkpoint)."""
+    """Measure serving-engine forward latency of a model (optionally
+    from a checkpoint)."""
 
     model: Model = ComponentField()
-    #: Optional model-only checkpoint (save_model / ConvertPacked output);
-    #: fresh-initialized params otherwise.
+    #: Optional deployment artifact: save_model / ConvertPacked output,
+    #: or a full Checkpointer directory; fresh-initialized otherwise.
     checkpoint: Optional[str] = Field(None)
+    #: EMA-vs-raw selection when the checkpoint carries both.
+    weights: str = Field("auto")
     batch_size: int = Field(1)
     height: int = Field(224)
     width: int = Field(224)
     channels: int = Field(3)
     num_classes: int = Field(1000)
-    chain_length: int = Field(50)
+    #: Long-chain length for the marginal (the short chain is a third).
+    chain_length: int = Field(48)
     rounds: int = Field(4)
 
     def run(self) -> dict:
         import jax
+        import numpy as np
 
-        from zookeeper_tpu.training.benchmark import (
-            measure_inference_latency,
-        )
+        from zookeeper_tpu.serving import InferenceEngine
+        from zookeeper_tpu.training.benchmark import measure_serving_latency
 
         input_shape = (self.height, self.width, self.channels)
         module = self.model.build(input_shape, self.num_classes)
         if self.checkpoint:
-            from zookeeper_tpu.training.checkpoint import (
-                load_exported_model,
-            )
+            from zookeeper_tpu.training.checkpoint import load_inference_model
 
-            params, model_state = load_exported_model(
-                self.checkpoint, self.model, module, input_shape
+            abstract = jax.eval_shape(
+                lambda: self.model.initialize(module, input_shape)
+            )
+            params, model_state = load_inference_model(
+                self.checkpoint,
+                weights=self.weights,
+                params_like=abstract[0],
+                model_state_like=abstract[1],
             )
         else:
             params, model_state = self.model.initialize(module, input_shape)
-        variables = {"params": params, **model_state}
-        seconds = measure_inference_latency(
-            module,
-            variables,
-            input_shape,
-            batch_size=self.batch_size,
-            dtype=self.model.dtype(),
-            length=self.chain_length,
-            rounds=self.rounds,
+
+        engine = InferenceEngine()
+        from zookeeper_tpu.core import configure
+
+        configure(
+            engine, {"batch_buckets": (self.batch_size,)}, name="engine"
         )
+        engine.bind(
+            module.apply,
+            params,
+            model_state,
+            input_shape,
+            dtype=self.model.dtype(),
+        )
+        engine.warmup()  # compile outside the timed window
+
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(self.batch_size, *input_shape)).astype(
+            self.model.dtype()
+        )
+
+        n2 = max(2, self.chain_length)
+        n1 = max(1, n2 // 3)
+        mean_s, p50_s, p99_s = measure_serving_latency(
+            engine, x, n1=n1, n2=n2, rounds=self.rounds,
+            percentile_samples=max(4, self.rounds * 2),
+        )
+        # Pathological jitter can invert the marginal; clamp like
+        # scan_chain_latency does rather than report a negative time.
+        seconds = max(mean_s, 1e-9)
         params_bytes = sum(
-            p.size * p.dtype.itemsize for p in jax.tree.leaves(params)
+            p.size * np.dtype(p.dtype).itemsize
+            for p in jax.tree.leaves(params)
         )
         result = {
             "model": type(self.model).__name__,
             "batch_size": self.batch_size,
             "ms_per_inference": round(seconds * 1e3, 4),
+            "p50_ms": round(p50_s * 1e3, 4),
+            "p99_ms": round(p99_s * 1e3, 4),
             "params_mib": round(params_bytes / 2**20, 2),
+            "compiles": engine.compile_count,
         }
         print(json.dumps(result))
         return result

@@ -25,3 +25,14 @@ os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "3")
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+
+def pytest_configure(config):
+    # The serving-subsystem marker (select with `-m serving`). Serving
+    # unit tests are CPU-safe and thread-free in tier 1; the threaded
+    # batcher paths (async coalescing, QPS soak) additionally carry
+    # `slow` and stay out of the tier-1 run.
+    config.addinivalue_line(
+        "markers",
+        "serving: dynamic-batching inference subsystem tests",
+    )

@@ -170,3 +170,42 @@ def test_lm_task_cli():
     assert len(accs) == 3
     assert accs[-1] > accs[0], accs
     assert accs[-1] > 0.5, accs  # memorizable corpus, chance ~1/31
+
+
+def test_serve_classifier_end_to_end(tmp_path):
+    """The full inference half of the north star from the CLI: train +
+    export the digits model, then serve the validation split through the
+    dynamic-batching engine — batched serving must score what training
+    shipped, with zero recompiles after warmup."""
+    pytest.importorskip("sklearn")
+    export = str(tmp_path / "digits_model")
+    out = run_example(
+        "digits_experiment.py", "TrainDigits",
+        "epochs=2", "model.features=(16,32)", "model.dense_units=(64,)",
+        f"export_model_to='{export}'",
+    )
+    assert "epoch 2/2" in out
+    import json
+    import re
+
+    accs = re.findall(r"val_acc=([0-9.]+)", out)
+    assert accs, out[-500:]
+    trained_acc = float(accs[-1])
+
+    out = run_example(
+        "serve_classifier.py", "ServeDigits",
+        f"checkpoint='{export}'",
+        "model.features=(16,32)", "model.dense_units=(64,)",
+        "engine.batch_buckets=(1,8,32)",
+    )
+    result = json.loads(out.strip().splitlines()[-1])
+    assert result["recompiles_after_warmup"] == 0
+    assert result["compiles"] == 3
+    # Serving the exported weights through the batcher reproduces the
+    # trained model's quality (row-exact batching; the small tolerance
+    # covers the training-side eval dropping the remainder batch while
+    # serving scores every example).
+    assert result["accuracy"] >= 0.85, result
+    assert abs(result["accuracy"] - trained_acc) < 0.05, (result, trained_acc)
+    assert result["examples"] == 359  # full validation split coverage
+    assert result["latency_p50_ms"] > 0.0

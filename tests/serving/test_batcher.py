@@ -1,0 +1,264 @@
+"""MicroBatcher correctness: the acceptance pin — results for N
+concurrent heterogeneous requests are BIT-identical to unbatched
+single-request forwards, padding sliced away, including the
+oversized-split and partial-bucket paths, in deterministic synchronous
+mode (no threads, no clocks). Async/threaded behavior is exercised in
+the slow-marked tests at the bottom."""
+
+import numpy as np
+import pytest
+
+from zookeeper_tpu.core import configure
+from zookeeper_tpu.serving import InferenceEngine, MicroBatcher, ServingMetrics
+
+pytestmark = pytest.mark.serving
+
+FEATURES = 6
+CLASSES = 4
+
+
+@pytest.fixture(scope="module")
+def engine():
+    from zookeeper_tpu.models.simple import Mlp
+
+    model = Mlp()
+    configure(model, {"hidden_units": (16,)}, name="model")
+    module = model.build((FEATURES,), CLASSES)
+    params, model_state = model.initialize(module, (FEATURES,))
+    eng = InferenceEngine()
+    configure(eng, {"batch_buckets": (1, 4, 8)}, name="engine")
+    eng.bind(module.apply, params, model_state, (FEATURES,))
+    eng.warmup()
+    return eng
+
+
+def make_batcher(engine, metrics=False, **conf):
+    m = None
+    if metrics:
+        m = ServingMetrics()
+        configure(m, {}, name="metrics")
+    b = MicroBatcher()
+    configure(b, dict(conf), name="batcher")
+    b.bind(engine, metrics=m)
+    return b, m
+
+
+def reference(engine, x):
+    """Unbatched single-request forward (chunked only when the request
+    itself exceeds the largest bucket)."""
+    step = engine.max_batch
+    return np.concatenate(
+        [
+            np.asarray(engine.infer(x[i : i + step]))
+            for i in range(0, x.shape[0], step)
+        ]
+    )
+
+
+def test_concurrent_heterogeneous_requests_bit_identical(engine):
+    """The headline acceptance test: heterogeneous sizes, including an
+    OVERSIZED request (> max bucket, split over dispatches) and a final
+    PARTIAL bucket, all bit-identical to single-request forwards."""
+    rng = np.random.default_rng(0)
+    sizes = [3, 1, 11, 4, 2, 7, 1, 5]  # 11 > max_batch=8: oversized
+    requests = [
+        rng.normal(size=(n, FEATURES)).astype(np.float32) for n in sizes
+    ]
+    batcher, metrics = make_batcher(engine, metrics=True)
+    before = engine.compile_count
+    handles = [batcher.submit(x) for x in requests]
+    batcher.flush()
+    for x, handle in zip(requests, handles):
+        got = handle.result()
+        assert got.shape == (x.shape[0], CLASSES)
+        assert np.array_equal(got, reference(engine, x))
+    assert engine.compile_count == before  # warmed buckets: no compiles
+    totals = metrics.totals
+    assert totals["requests"] == len(sizes)
+    assert totals["rows"] == sum(sizes)
+    # 34 rows coalesce into ceil(34/8)=5 dispatches (FIFO row packing).
+    assert totals["dispatches"] == 5
+
+
+def test_partial_bucket_path(engine):
+    """A queue draining below the largest bucket pads into the smallest
+    covering bucket — and the result is still exact."""
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(3, FEATURES)).astype(np.float32)
+    batcher, metrics = make_batcher(engine, metrics=True)
+    handle = batcher.submit(x)
+    batcher.flush()
+    assert np.array_equal(handle.result(), reference(engine, x))
+    snap = metrics.snapshot()
+    # 3 real rows rode the 4-bucket: fill 0.75, waste 0.25.
+    assert snap["bucket_fill_mean"] == pytest.approx(0.75)
+    assert snap["padding_waste_mean"] == pytest.approx(0.25)
+
+
+def test_oversized_request_split_exact(engine):
+    """A single request far above the largest bucket splits across
+    dispatches and reassembles in row order."""
+    rng = np.random.default_rng(2)
+    x = rng.normal(size=(29, FEATURES)).astype(np.float32)  # 8+8+8+5
+    batcher, metrics = make_batcher(engine, metrics=True)
+    handle = batcher.submit(x)
+    batcher.flush()
+    got = handle.result()
+    assert got.shape == (29, CLASSES)
+    assert np.array_equal(got, reference(engine, x))
+    assert metrics.totals["dispatches"] == 4
+
+
+def test_result_triggers_flush_synchronously(engine):
+    """Deterministic sync mode needs no explicit flush: result() IS the
+    trigger (thread- and clock-free)."""
+    rng = np.random.default_rng(3)
+    a = rng.normal(size=(2, FEATURES)).astype(np.float32)
+    b = rng.normal(size=(5, FEATURES)).astype(np.float32)
+    batcher, _ = make_batcher(engine)
+    ha, hb = batcher.submit(a), batcher.submit(b)
+    assert not ha.done and not hb.done
+    got_a = ha.result()  # flushes the whole queue
+    assert hb.done
+    assert np.array_equal(got_a, reference(engine, a))
+    assert np.array_equal(hb.result(), reference(engine, b))
+
+
+def test_fifo_coalescing_fills_largest_bucket(engine):
+    """Pending rows >= the largest bucket coalesce into FULL largest-
+    bucket dispatches (the throughput contract)."""
+    rng = np.random.default_rng(4)
+    requests = [
+        rng.normal(size=(4, FEATURES)).astype(np.float32) for _ in range(4)
+    ]
+    batcher, metrics = make_batcher(engine, metrics=True)
+    handles = [batcher.submit(x) for x in requests]
+    batcher.flush()
+    for x, h in zip(requests, handles):
+        assert np.array_equal(h.result(), reference(engine, x))
+    snap = metrics.snapshot()
+    assert metrics.totals["dispatches"] == 2  # 16 rows = 2 full 8-buckets
+    assert snap["bucket_fill_mean"] == pytest.approx(1.0)
+    assert snap["padding_waste_mean"] == pytest.approx(0.0)
+
+
+def test_queue_full_backpressure_drains_inline(engine):
+    """Sync-mode backpressure: a submit that would exceed max_queue_rows
+    drains the backlog inline instead of growing it — the queue never
+    holds more than max_queue_rows + one request."""
+    rng = np.random.default_rng(5)
+    batcher, _ = make_batcher(engine, max_queue_rows=8)
+    handles = []
+    max_seen = 0
+    for _ in range(10):
+        x = rng.normal(size=(3, FEATURES)).astype(np.float32)
+        handles.append((x, batcher.submit(x)))
+        max_seen = max(max_seen, batcher.queue_rows)
+    assert max_seen <= 8 + 3
+    # Earlier requests were already served by the inline drains.
+    assert sum(1 for _, h in handles if h.done) >= 7
+    batcher.flush()
+    for x, h in handles:
+        assert np.array_equal(h.result(), reference(engine, x))
+
+
+def test_bad_request_shapes_rejected(engine):
+    batcher, _ = make_batcher(engine)
+    with pytest.raises(ValueError, match="at least one row"):
+        batcher.submit(np.zeros((0, FEATURES), np.float32))
+    with pytest.raises(RuntimeError, match="not bound"):
+        MicroBatcher().submit(np.zeros((1, FEATURES), np.float32))
+
+
+def test_failed_dispatch_propagates_to_requests(engine):
+    """An engine failure surfaces through every affected handle instead
+    of hanging it."""
+    batcher, _ = make_batcher(engine)
+    bad = np.zeros((2, FEATURES + 1), np.float32)  # wrong feature width
+    handle = batcher.submit(bad)
+    with pytest.raises(Exception):
+        batcher.flush()
+    with pytest.raises(Exception):
+        handle.result()
+
+
+def test_bind_validates_config(engine):
+    b = MicroBatcher()
+    configure(b, {"max_queue_rows": 0}, name="batcher")
+    with pytest.raises(ValueError, match="max_queue_rows"):
+        b.bind(engine)
+    b2 = MicroBatcher()
+    configure(b2, {"max_delay_ms": -1.0}, name="batcher2")
+    with pytest.raises(ValueError, match="max_delay_ms"):
+        b2.bind(engine)
+
+
+# -- threaded paths (excluded from tier-1: markers below) ----------------
+
+
+@pytest.mark.slow
+def test_async_mode_serves_and_matches(engine):
+    """Async worker: results match sync references; close() is clean."""
+    rng = np.random.default_rng(6)
+    batcher, _ = make_batcher(
+        engine, synchronous=False, max_delay_ms=5.0
+    )
+    try:
+        requests = [
+            rng.normal(
+                size=(int(rng.integers(1, 10)), FEATURES)
+            ).astype(np.float32)
+            for _ in range(16)
+        ]
+        handles = [batcher.submit(x) for x in requests]
+        for x, h in zip(requests, handles):
+            assert np.array_equal(
+                h.result(timeout=30), reference(engine, x)
+            )
+    finally:
+        batcher.close()
+
+
+@pytest.mark.slow
+def test_qps_soak_async(engine):
+    """QPS soak: sustained concurrent submitters against the async
+    worker — every result exact, queue bounded by backpressure."""
+    import threading
+
+    rng = np.random.default_rng(7)
+    metrics = ServingMetrics()
+    configure(metrics, {}, name="metrics")
+    batcher = MicroBatcher()
+    configure(
+        batcher,
+        {"synchronous": False, "max_delay_ms": 1.0, "max_queue_rows": 64},
+        name="batcher",
+    )
+    batcher.bind(engine, metrics=metrics)
+    failures = []
+
+    def client(seed):
+        r = np.random.default_rng(seed)
+        for _ in range(25):
+            x = r.normal(
+                size=(int(r.integers(1, 12)), FEATURES)
+            ).astype(np.float32)
+            got = batcher.submit(x).result(timeout=60)
+            if not np.array_equal(got, reference(engine, x)):
+                failures.append(seed)
+
+    threads = [
+        threading.Thread(target=client, args=(s,)) for s in range(4)
+    ]
+    try:
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+    finally:
+        batcher.close()
+    assert not failures
+    totals = metrics.totals
+    assert totals["requests"] == 100
+    snap = metrics.snapshot()
+    assert snap["latency_p99_ms"] >= snap["latency_p50_ms"] >= 0.0

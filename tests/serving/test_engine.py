@@ -1,0 +1,240 @@
+"""InferenceEngine: bucket selection, padding exactness, compile-cache
+discipline, partitioner integration (all CPU, thread-free)."""
+
+import numpy as np
+import pytest
+
+from zookeeper_tpu.core import configure
+from zookeeper_tpu.serving import InferenceEngine
+
+pytestmark = pytest.mark.serving
+
+
+def make_engine(buckets=(1, 4, 8), hidden=(16,), features=6, classes=4,
+                partitioner=None, seed=0):
+    from zookeeper_tpu.models.simple import Mlp
+
+    model = Mlp()
+    configure(model, {"hidden_units": tuple(hidden)}, name="model")
+    module = model.build((features,), classes)
+    params, model_state = model.initialize(module, (features,), seed=seed)
+    engine = InferenceEngine()
+    configure(engine, {"batch_buckets": tuple(buckets)}, name="engine")
+    engine.bind(
+        module.apply, params, model_state, (features,),
+        partitioner=partitioner,
+    )
+    return engine, module, {"params": params, **model_state}
+
+
+def test_bucket_selection_and_oversize_error():
+    engine, _, _ = make_engine()
+    assert engine.bucket_for(1) == 1
+    assert engine.bucket_for(2) == 4
+    assert engine.bucket_for(4) == 4
+    assert engine.bucket_for(5) == 8
+    assert engine.max_batch == 8
+    with pytest.raises(ValueError, match="exceeds the largest bucket"):
+        engine.bucket_for(9)
+    with pytest.raises(ValueError, match="not servable"):
+        engine.bucket_for(0)
+
+
+def test_invalid_bucket_configs_rejected():
+    from zookeeper_tpu.models.simple import Mlp
+
+    model = Mlp()
+    configure(model, {"hidden_units": (4,)}, name="model")
+    module = model.build((3,), 2)
+    params, model_state = model.initialize(module, (3,))
+    for bad in ((), (0, 4), (8, 4), (4, 4)):
+        engine = InferenceEngine()
+        configure(engine, {"batch_buckets": bad}, name="engine")
+        with pytest.raises(ValueError, match="batch_buckets"):
+            engine.bind(module.apply, params, model_state, (3,))
+
+
+def test_unbound_engine_raises():
+    engine = InferenceEngine()
+    configure(engine, {}, name="engine")
+    with pytest.raises(RuntimeError, match="not bound"):
+        engine.warmup()
+    with pytest.raises(RuntimeError, match="not bound"):
+        engine.infer(np.zeros((1, 4), np.float32))
+
+
+def test_warmup_precompiles_every_bucket_and_serving_never_recompiles():
+    """The acceptance contract: warmup() compiles exactly one program
+    per configured bucket, and serving any warmed bucket afterwards
+    moves the compile counter by ZERO."""
+    engine, _, _ = make_engine(buckets=(1, 4, 8))
+    assert engine.compile_count == 0
+    assert engine.warmup() == 3
+    assert engine.compile_count == 3
+    rng = np.random.default_rng(0)
+    for n in (1, 2, 3, 4, 5, 8):  # every bucket, exact and padded fills
+        out = engine.infer(rng.normal(size=(n, 6)).astype(np.float32))
+        assert np.asarray(out).shape == (n, 4)
+    assert engine.compile_count == 3  # zero recompiles after warmup
+    # warmup again: cache hits, still zero new compiles.
+    engine.warmup()
+    assert engine.compile_count == 3
+
+
+def test_padding_is_sliced_and_rows_exact_vs_unpadded_apply():
+    """Padded rows must never leak into real rows: engine output for n
+    rows equals the raw unpadded module.apply on those rows."""
+    engine, module, variables = make_engine(buckets=(4, 8))
+    engine.warmup()
+    rng = np.random.default_rng(1)
+    for n in (1, 3, 4, 6):
+        x = rng.normal(size=(n, 6)).astype(np.float32)
+        got = np.asarray(engine.infer(x))
+        want = np.asarray(module.apply(variables, x, training=False))
+        np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)
+
+
+def test_same_rows_identical_across_buckets():
+    """The row-independence invariant the MicroBatcher's correctness
+    rests on: a row's result is bit-identical whichever bucket it rides
+    in."""
+    engine, _, _ = make_engine(buckets=(2, 8))
+    engine.warmup()
+    rng = np.random.default_rng(2)
+    x = rng.normal(size=(2, 6)).astype(np.float32)
+    small = np.asarray(engine.infer(x))  # bucket 2, no padding
+    padded = np.asarray(engine.infer(np.concatenate([x, x, x])))  # bucket 8
+    assert np.array_equal(small, padded[:2])
+    assert np.array_equal(small, padded[2:4])
+
+
+def test_input_dtype_cast():
+    engine, _, _ = make_engine(buckets=(4,))
+    out = engine.infer(np.ones((2, 6), np.float64))  # cast, not an error
+    assert np.asarray(out).shape == (2, 4)
+    assert engine.compile_count == 1
+
+
+def test_mesh_partitioner_serving_matches_single_device():
+    """Partitioner integration: the forward under a data-parallel mesh
+    (8 virtual CPU devices) produces the same results as single-device
+    serving, and the compile cache keys on the mesh."""
+    from zookeeper_tpu.parallel import DataParallelPartitioner
+
+    part = DataParallelPartitioner()
+    configure(part, {}, name="partitioner")
+    engine_dp, module, variables = make_engine(
+        buckets=(8,), partitioner=part
+    )
+    engine_dp.warmup()
+    rng = np.random.default_rng(3)
+    x = rng.normal(size=(5, 6)).astype(np.float32)
+    got = np.asarray(engine_dp.infer(x))
+    want = np.asarray(module.apply(variables, x, training=False))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+    assert engine_dp.compile_count == 1
+    key_meshes = {k[3] for k in engine_dp._cache}
+    assert key_meshes == {part.mesh}
+
+
+def test_seq_buckets_causal_lm():
+    """Sequence bucketing for token models: right-padded causal
+    attention must reproduce the exact-length forward on the real
+    positions, and each (batch, seq) bucket pair is one compile."""
+    from zookeeper_tpu.models.transformer import TransformerLM
+
+    model = TransformerLM()
+    configure(
+        model,
+        {
+            "num_layers": 1,
+            "d_model": 16,
+            "num_heads": 2,
+            "attention": "dense",
+            "max_seq_len": 16,
+        },
+        name="model",
+    )
+    vocab = 11
+    module = model.build((16,), vocab)
+    params, model_state = model.initialize(module, (16,))
+    engine = InferenceEngine()
+    configure(
+        engine,
+        {"batch_buckets": (2, 4), "seq_buckets": (8, 16)},
+        name="engine",
+    )
+    engine.bind(
+        module.apply, params, model_state, (16,), dtype=np.int32
+    )
+    assert engine.warmup() == 4  # 2 batch x 2 seq buckets
+    assert engine.compile_count == 4
+    rng = np.random.default_rng(4)
+    variables = {"params": params, **model_state}
+    for n, seq in ((1, 5), (2, 8), (3, 11), (4, 16)):
+        tokens = rng.integers(0, vocab, size=(n, seq)).astype(np.int32)
+        got = np.asarray(engine.infer(tokens))
+        assert got.shape == (n, seq, vocab)
+        want = np.asarray(module.apply(variables, tokens, training=False))
+        np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+    assert engine.compile_count == 4  # warmed: zero recompiles
+    with pytest.raises(ValueError, match="seq bucket"):
+        engine.infer(rng.integers(0, vocab, size=(1, 17)).astype(np.int32))
+
+
+def test_mesh_partitioner_sub_mesh_buckets_replicate():
+    """Buckets smaller than the data-axis product (the 1-row bucket on
+    an 8-way mesh) cannot shard; they must fall back to a replicated
+    compile and still produce exact results."""
+    from zookeeper_tpu.parallel import DataParallelPartitioner
+
+    part = DataParallelPartitioner()
+    configure(part, {}, name="partitioner")
+    engine, module, variables = make_engine(
+        buckets=(1, 4, 8), partitioner=part
+    )
+    assert engine.warmup() == 3  # 1 and 4 replicate, 8 shards
+    rng = np.random.default_rng(5)
+    for n in (1, 3, 8):
+        x = rng.normal(size=(n, 6)).astype(np.float32)
+        got = np.asarray(engine.infer(x))
+        want = np.asarray(module.apply(variables, x, training=False))
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+    assert engine.compile_count == 3
+
+
+def test_seq_bucket_not_confused_by_pooled_output_width():
+    """A pooled [batch, classes] head whose class count EQUALS the seq
+    bucket must not get its classes sliced off as sequence padding (the
+    output-axis detection is by abstract trace, not dimension-size
+    coincidence)."""
+    import flax.linen as nn
+
+    class PooledHead(nn.Module):
+        classes: int
+
+        @nn.compact
+        def __call__(self, x, training: bool = False):
+            x = x.mean(axis=1)  # pool the sequence away
+            return nn.Dense(self.classes)(x)
+
+    seq_bucket = 8
+    module = PooledHead(classes=seq_bucket)  # the collision on purpose
+    import jax
+
+    variables = module.init(
+        jax.random.PRNGKey(0), np.zeros((1, seq_bucket, 3), np.float32)
+    )
+    params = variables["params"]
+    engine = InferenceEngine()
+    configure(
+        engine,
+        {"batch_buckets": (4,), "seq_buckets": (seq_bucket,)},
+        name="engine",
+    )
+    engine.bind(module.apply, params, {}, (seq_bucket, 3))
+    engine.warmup()
+    x = np.random.default_rng(0).normal(size=(2, 5, 3)).astype(np.float32)
+    out = np.asarray(engine.infer(x))
+    # All classes survive: nothing was mistaken for sequence padding.
+    assert out.shape == (2, seq_bucket)

@@ -1,0 +1,163 @@
+"""ServingConfig: config-tree wiring, checkpoint consumption (EMA vs
+raw), metrics emission — the in-process end-to-end of the serve task."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from zookeeper_tpu.core import configure
+from zookeeper_tpu.serving import ServingConfig
+
+pytestmark = pytest.mark.serving
+
+
+def make_service(extra=None):
+    svc = ServingConfig()
+    conf = {
+        "model": "Mlp",
+        "model.hidden_units": (8,),
+        "height": 4,
+        "width": 4,
+        "channels": 1,
+        "num_classes": 3,
+        "engine.batch_buckets": (1, 4),
+        "requests": 10,
+        "max_request": 6,
+        "verbose": False,
+        **(extra or {}),
+    }
+    configure(svc, conf, name="serve")
+    return svc
+
+
+def train_and_export(tmp_path, ema=True):
+    from zookeeper_tpu.training import TrainingExperiment
+
+    exp = TrainingExperiment()
+    conf = {
+        "loader.dataset": "SyntheticMnist",
+        "loader.dataset.num_train_examples": 64,
+        "loader.dataset.num_validation_examples": 16,
+        "loader.preprocessing": "ImageClassificationPreprocessing",
+        "loader.preprocessing.height": 8,
+        "loader.preprocessing.width": 8,
+        "loader.preprocessing.channels": 1,
+        "loader.host_index": 0,
+        "loader.host_count": 1,
+        "model": "Mlp",
+        "model.hidden_units": (8,),
+        "batch_size": 32,
+        "epochs": 1,
+        "verbose": False,
+        "validate": False,
+        "export_model_to": str(tmp_path / "export"),
+        "checkpointer.directory": str(tmp_path / "ckpt"),
+        "checkpointer.synchronous": True,
+    }
+    if ema:
+        conf["ema_decay"] = 0.9
+    configure(exp, conf, name="experiment")
+    exp.run()
+    return exp
+
+
+def test_service_runs_and_reports_zero_recompiles():
+    svc = make_service()
+    result = svc.run()
+    assert result["recompiles_after_warmup"] == 0
+    assert result["compiles"] == 2  # one per bucket
+    assert result["requests"] == 10
+    assert result["latency_p50_ms"] >= 0.0
+    assert 0.0 < result["bucket_fill_mean"] <= 1.0
+    assert result["dispatches"] >= 1
+
+
+def test_service_rejects_bad_config():
+    with pytest.raises(ValueError, match="weights"):
+        make_service({"weights": "fastest"}).build_service()
+    with pytest.raises(ValueError, match="max_request"):
+        make_service({"max_request": 0}).build_service()
+
+
+def test_service_metrics_flow_through_writer(tmp_path):
+    path = str(tmp_path / "serve_metrics.jsonl")
+    svc = make_service({"writer.jsonl.path": path})
+    svc.run()
+    with open(path) as f:
+        records = [json.loads(line) for line in f]
+    assert records
+    keys = set(records[-1])
+    assert "serve/latency_p50_ms" in keys
+    assert "serve/padding_waste_mean" in keys
+    assert "serve/qps" in keys
+
+
+def test_serving_consumes_ema_vs_raw_weights(tmp_path):
+    """The ship-weights contract end-to-end: serving a full training
+    checkpoint with weights=ema scores the EMA shadow (= what the
+    model-only export ships), weights=raw the raw params — and the two
+    genuinely differ."""
+    import jax
+
+    exp = train_and_export(tmp_path, ema=True)
+    state = exp.final_state
+    module = exp.model.build((8, 8, 1), 10)
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(4, 8, 8, 1)).astype(np.float32)
+
+    def serve(checkpoint, weights):
+        svc = ServingConfig()
+        configure(
+            svc,
+            {
+                "model": "Mlp",
+                "model.hidden_units": (8,),
+                "height": 8,
+                "width": 8,
+                "channels": 1,
+                "num_classes": 10,
+                "engine.batch_buckets": (4,),
+                "checkpoint": checkpoint,
+                "weights": weights,
+                "verbose": False,
+            },
+            name="serve",
+        )
+        svc.build_service()
+        return np.asarray(svc.engine.infer(x))
+
+    got_ema = serve(str(tmp_path / "ckpt"), "ema")
+    got_raw = serve(str(tmp_path / "ckpt"), "raw")
+    got_export = serve(str(tmp_path / "export"), "auto")
+
+    ema_vars = {
+        "params": jax.device_get(state.ema_params),
+        **jax.device_get(state.model_state),
+    }
+    raw_vars = {
+        "params": jax.device_get(state.params),
+        **jax.device_get(state.model_state),
+    }
+    want_ema = np.asarray(module.apply(ema_vars, x, training=False))
+    want_raw = np.asarray(module.apply(raw_vars, x, training=False))
+    np.testing.assert_allclose(got_ema, want_ema, rtol=1e-6, atol=1e-6)
+    np.testing.assert_allclose(got_raw, want_raw, rtol=1e-6, atol=1e-6)
+    np.testing.assert_allclose(got_export, want_ema, rtol=1e-6, atol=1e-6)
+    assert not np.allclose(got_ema, got_raw)
+
+
+def test_serving_ema_requested_without_ema_errors(tmp_path):
+    train_and_export(tmp_path, ema=False)
+    svc = make_service(
+        {
+            "height": 8,
+            "width": 8,
+            "num_classes": 10,
+            "checkpoint": str(tmp_path / "ckpt"),
+            "weights": "ema",
+        }
+    )
+    with pytest.raises(ValueError, match="no ema_params"):
+        svc.build_service()

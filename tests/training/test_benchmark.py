@@ -31,3 +31,30 @@ def test_scan_chain_latency_never_negative_or_zero():
     x = jnp.ones((4,))
     t = scan_chain_latency(lambda v: v + 1.0, x, length=2, rounds=1)
     assert t > 0.0
+
+
+def test_measure_serving_latency_on_engine():
+    """The bench's serve_* anchor path (ZK_BENCH_SERVE): measures a
+    warmed InferenceEngine with the shared chain protocols — finite
+    mean, ordered percentiles, zero compiles inside the timed window."""
+    from zookeeper_tpu.core import configure
+    from zookeeper_tpu.models.simple import Mlp
+    from zookeeper_tpu.serving import InferenceEngine
+    from zookeeper_tpu.training.benchmark import measure_serving_latency
+
+    model = Mlp()
+    configure(model, {"hidden_units": (16,)}, name="model")
+    module = model.build((6,), 4)
+    params, model_state = model.initialize(module, (6,))
+    engine = InferenceEngine()
+    configure(engine, {"batch_buckets": (4,)}, name="engine")
+    engine.bind(module.apply, params, model_state, (6,))
+    engine.warmup()
+    x = np.random.default_rng(0).normal(size=(4, 6)).astype(np.float32)
+    before = engine.compile_count
+    mean_s, p50_s, p99_s = measure_serving_latency(
+        engine, x, n1=2, n2=6, rounds=2, percentile_samples=6, chain_len=2
+    )
+    assert engine.compile_count == before  # warmed: no timed compiles
+    assert np.isfinite(mean_s)
+    assert 0.0 <= p50_s <= p99_s

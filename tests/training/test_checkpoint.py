@@ -369,3 +369,246 @@ def test_midepoch_resume_tags_partial_epoch(tmp_path):
     assert [r["epoch"] for r in records] == [0, 1]
     assert records[0].get("partial_epoch") is True
     assert "partial_epoch" not in records[1]
+
+
+# -- model-only round trips: the serving load path --------------------------
+# save_model / load_model / load_exported_model are what the serving
+# engine and EvalExperiment consume; their contract (exact values, dtype
+# preservation, loud structure mismatch) is pinned here BEFORE the engine
+# builds on it.
+
+
+def _tiny_model(hidden=(16,), features=6, classes=4, seed=0):
+    from zookeeper_tpu.core import configure as _configure
+    from zookeeper_tpu.models.simple import Mlp
+
+    model = Mlp()
+    _configure(model, {"hidden_units": tuple(hidden)}, name="model")
+    module = model.build((features,), classes)
+    params, model_state = model.initialize(module, (features,), seed=seed)
+    return model, module, params, model_state
+
+
+def test_save_load_model_roundtrip_exact_and_dtypes(tmp_path):
+    """params + model_state round-trip bit-exactly, preserving dtypes —
+    including a non-float32 leaf (the bf16 deployment case)."""
+    import jax
+    import jax.numpy as jnp
+
+    from zookeeper_tpu.training.checkpoint import load_model, save_model
+
+    _, _, params, model_state = _tiny_model()
+    # Mixed dtypes: cast one kernel to bfloat16 before saving.
+    params = dict(params)
+    first = sorted(params)[0]
+    params[first] = jax.tree.map(
+        lambda a: a.astype(jnp.bfloat16), params[first]
+    )
+    model_state = {"aux": {"counter": jnp.asarray(3, jnp.int32)}}
+    path = str(tmp_path / "model")
+    save_model(path, params, model_state)
+
+    abstract = jax.eval_shape(lambda: (params, model_state))
+    got_params, got_state = load_model(path, abstract[0], abstract[1])
+    for want, got in zip(
+        jax.tree.leaves(params), jax.tree.leaves(got_params)
+    ):
+        assert want.dtype == got.dtype
+        assert np.array_equal(jax.device_get(want), jax.device_get(got))
+    assert got_state["aux"]["counter"].dtype == jnp.int32
+    assert int(got_state["aux"]["counter"]) == 3
+
+
+def test_save_model_overwrite_is_allowed(tmp_path):
+    import jax
+
+    from zookeeper_tpu.training.checkpoint import load_model, save_model
+
+    _, _, params, model_state = _tiny_model()
+    path = str(tmp_path / "model")
+    save_model(path, params, model_state)
+    _, _, params2, _ = _tiny_model(seed=1)
+    save_model(path, params2, model_state)  # re-export must not crash
+    abstract = jax.eval_shape(lambda: (params2, model_state))
+    got, _ = load_model(path, abstract[0], abstract[1])
+    assert np.array_equal(
+        jax.device_get(jax.tree.leaves(params2)[0]),
+        jax.device_get(jax.tree.leaves(got)[0]),
+    )
+
+
+def test_load_exported_model_roundtrip(tmp_path):
+    """The abstract-init consumer flow (eval / teacher / serving):
+    zero-allocation target structure, exact restored values."""
+    import jax
+
+    from zookeeper_tpu.training.checkpoint import (
+        load_exported_model,
+        save_model,
+    )
+
+    model, module, params, model_state = _tiny_model()
+    path = str(tmp_path / "model")
+    save_model(path, params, model_state)
+    got_params, got_state = load_exported_model(path, model, module, (6,))
+    for want, got in zip(
+        jax.tree.leaves(params), jax.tree.leaves(got_params)
+    ):
+        assert want.dtype == got.dtype
+        assert np.array_equal(jax.device_get(want), jax.device_get(got))
+
+
+def test_load_model_structure_mismatch_is_clear(tmp_path):
+    """Restoring into a differently-shaped model must raise the
+    actionable structure-mismatch error, not a raw orbax traceback."""
+    import jax
+
+    from zookeeper_tpu.training.checkpoint import (
+        load_exported_model,
+        save_model,
+    )
+
+    model, module, params, model_state = _tiny_model(hidden=(16,))
+    path = str(tmp_path / "model")
+    save_model(path, params, model_state)
+    other_model, other_module, _, _ = _tiny_model(hidden=(16, 16))
+    with pytest.raises(ValueError, match="does not match the target model"):
+        load_exported_model(path, other_model, other_module, (6,))
+
+
+def test_select_inference_weights_policy():
+    from zookeeper_tpu.training.checkpoint import select_inference_weights
+
+    raw, ema = {"w": 1}, {"w": 2}
+    assert select_inference_weights(raw, ema, "raw") is raw
+    assert select_inference_weights(raw, ema, "ema") is ema
+    assert select_inference_weights(raw, ema, "auto") is ema
+    assert select_inference_weights(raw, None, "auto") is raw
+    assert select_inference_weights(raw, None, "raw") is raw
+    with pytest.raises(ValueError, match="no ema_params"):
+        select_inference_weights(raw, None, "ema")
+    with pytest.raises(ValueError, match="unknown"):
+        select_inference_weights(raw, ema, "fastest")
+
+
+def test_load_inference_model_export_and_manager_dir(tmp_path):
+    """ONE loader serves both deployment artifacts: a save_model export
+    and a full Checkpointer directory (latest step), with EMA-vs-raw
+    selection and structure validation."""
+    import jax
+
+    from zookeeper_tpu.training.checkpoint import load_inference_model
+
+    exp = make_experiment(
+        tmp_path,
+        {
+            "epochs": 1,
+            "ema_decay": 0.9,
+            "validate": False,
+            "loader.dataset.num_validation_examples": 0,
+            "export_model_to": str(tmp_path / "export"),
+        },
+    )
+    exp.run()
+    state = exp.final_state
+    raw = jax.device_get(state.params)
+    ema = jax.device_get(state.ema_params)
+
+    def same(a, b):
+        return all(
+            np.array_equal(x, y)
+            for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b))
+        )
+
+    # Model-only export ships the EMA (the "ship weights" artifact).
+    p_exp, _ = load_inference_model(str(tmp_path / "export"))
+    assert same(p_exp, ema)
+    # Full manager dir: explicit raw / ema / auto selection.
+    ckpt = str(tmp_path / "ckpt")
+    p_raw, _ = load_inference_model(ckpt, weights="raw")
+    p_ema, _ = load_inference_model(ckpt, weights="ema")
+    p_auto, ms = load_inference_model(ckpt, weights="auto")
+    assert same(p_raw, raw) and same(p_ema, ema) and same(p_auto, ema)
+    # Structure validation against a *_like tree.
+    with pytest.raises(ValueError, match="does not match the target model"):
+        load_inference_model(
+            ckpt, params_like={"not": {"this": np.zeros(1)}}
+        )
+    # Clear error on a path with no checkpoint at all.
+    with pytest.raises(ValueError, match="No restorable checkpoint"):
+        load_inference_model(str(tmp_path / "nowhere"))
+
+
+def test_eval_experiment_scores_selected_weights(tmp_path):
+    """The EvalExperiment fix: it can now score the EMA (or raw) weights
+    straight from a full training checkpoint directory, matching the
+    export-based score exactly."""
+    from zookeeper_tpu.core import configure as _configure
+    from zookeeper_tpu.training import EvalExperiment
+
+    exp = make_experiment(
+        tmp_path,
+        {
+            "epochs": 1,
+            "ema_decay": 0.9,
+            "export_model_to": str(tmp_path / "export"),
+        },
+    )
+    exp.run()
+
+    def evaluate(checkpoint, weights):
+        ev = EvalExperiment()
+        _configure(
+            ev,
+            {
+                "loader.dataset": "SyntheticMnist",
+                "loader.dataset.num_train_examples": 128,
+                "loader.dataset.num_validation_examples": 32,
+                "loader.preprocessing": "ImageClassificationPreprocessing",
+                "loader.preprocessing.height": 28,
+                "loader.preprocessing.width": 28,
+                "loader.preprocessing.channels": 1,
+                "loader.host_index": 0,
+                "loader.host_count": 1,
+                "model": "Mlp",
+                "model.hidden_units": (16,),
+                "batch_size": 32,
+                "verbose": False,
+                "checkpoint": checkpoint,
+                "weights": weights,
+            },
+            name="eval",
+        )
+        return ev.run()
+
+    ema_from_ckpt = evaluate(str(tmp_path / "ckpt"), "ema")
+    ema_from_export = evaluate(str(tmp_path / "export"), "auto")
+    raw_from_ckpt = evaluate(str(tmp_path / "ckpt"), "raw")
+    assert ema_from_ckpt == ema_from_export
+    assert raw_from_ckpt["loss"] != ema_from_ckpt["loss"]
+    with pytest.raises(ValueError, match="unknown"):
+        evaluate(str(tmp_path / "ckpt"), "fastest")
+
+
+def test_load_inference_model_same_structure_wrong_widths_is_clear(tmp_path):
+    """A checkpoint with the SAME tree structure but different layer
+    widths must fail the like-validation with the clear error, not
+    surface later as an opaque XLA shape error inside apply."""
+    import jax
+
+    from zookeeper_tpu.training.checkpoint import (
+        load_inference_model,
+        save_model,
+    )
+
+    model16, module16, params16, state16 = _tiny_model(hidden=(16,))
+    path = str(tmp_path / "model16")
+    save_model(path, params16, state16)
+    model32, module32, _, _ = _tiny_model(hidden=(32,))
+    abstract = jax.eval_shape(
+        lambda: model32.initialize(module32, (6,))
+    )
+    with pytest.raises(ValueError, match="leaf shape mismatch"):
+        load_inference_model(
+            path, params_like=abstract[0], model_state_like=abstract[1]
+        )

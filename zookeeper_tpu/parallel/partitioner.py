@@ -84,6 +84,34 @@ class Partitioner:
         """Compile ``(state, batch) -> metrics``."""
         raise NotImplementedError
 
+    def variables_sharding(self, variables: Any) -> Any:
+        """Sharding pytree for an inference variables dict
+        (``{"params": ..., **model_state}`` — no optimizer state). Paths
+        match the same partition rules as training state (``params/...``
+        prefixes are identical), so a model serves under the layout it
+        trained with. None = default placement."""
+        return None
+
+    def compile_forward(
+        self, forward_fn: Callable, variables: Any, *,
+        batch_rows: Optional[int] = None,
+    ) -> Callable:
+        """Compile an inference forward ``(variables, batch) -> outputs``
+        for the serving engine. DONATION-SAFE by contract: unlike the
+        train step's consumed state, the variables serve every subsequent
+        request and must never be donated; the batch is not donated
+        either (no output aliases its shape — donating would buy nothing
+        and warn on every compile, the ``donate_slab`` lesson).
+
+        ``batch_rows`` is the concrete bucket size being compiled (the
+        serving engine compiles per shape bucket, so it always knows):
+        mesh partitioners use it to fall back to a REPLICATED batch when
+        the bucket cannot split over the data axes (a 1-row request on
+        an 8-way mesh) — correct everywhere, wasteful only on the small
+        buckets; size the bucket ladder in multiples of the data-axis
+        product to serve fully sharded."""
+        raise NotImplementedError
+
 
 @component
 class SingleDevicePartitioner(Partitioner):
@@ -109,6 +137,9 @@ class SingleDevicePartitioner(Partitioner):
 
     def compile_eval(self, eval_fn, state):
         return jax.jit(eval_fn)
+
+    def compile_forward(self, forward_fn, variables, *, batch_rows=None):
+        return jax.jit(forward_fn)
 
 
 def _device_mesh(
@@ -320,6 +351,44 @@ class MeshPartitioner(Partitioner):
             out_shardings=NamedSharding(self.mesh, PartitionSpec()),
         )
 
+    def variables_sharding(self, variables: Any) -> Any:
+        # Same rule table as training state: rules are matched against
+        # full paths, and an inference dict's ``params/...`` /
+        # ``batch_stats/...`` paths are exactly the training prefixes.
+        return self._sharding_from_rules(variables, self.rules)
+
+    def compile_forward(self, forward_fn, variables, *, batch_rows=None):
+        vars_sh = self.variables_sharding(variables)
+        batch_sh = self.batch_sharding()
+        scoped = self._with_activation_scope(forward_fn)
+        if batch_rows is not None:
+            total = int(
+                np.prod([self.mesh.shape[a] for a in self.data_axes])
+            )
+            if batch_rows % total != 0:
+                # A bucket that cannot split over the data axes (e.g.
+                # the 1-row bucket on an 8-way mesh) runs REPLICATED —
+                # every device computes the whole small batch. Correct
+                # always; only the sub-mesh buckets pay the redundancy.
+                # The activation scope would re-pin batch dims to the
+                # data axes inside the trace and fight the replicated
+                # in_sharding, so it is dropped for these buckets.
+                repl = NamedSharding(self.mesh, PartitionSpec())
+                return jax.jit(
+                    forward_fn,
+                    in_shardings=(vars_sh, repl),
+                    out_shardings=repl,
+                )
+        # Outputs keep the batch-sharded layout (PartitionSpec is
+        # rank-agnostic on trailing dims): the serving readback slices
+        # per-request rows on host, so replicating (an all-gather) would
+        # be pure waste. No donation — see the base-class contract.
+        return jax.jit(
+            scoped,
+            in_shardings=(vars_sh, batch_sh),
+            out_shardings=batch_sh,
+        )
+
 
 @component
 class DataParallelPartitioner(MeshPartitioner):
@@ -350,6 +419,18 @@ class FsdpPartitioner(MeshPartitioner):
     #: full-rematerialization reshard (see rules.auto_fsdp_rules).
     replicate_patterns: Sequence[str] = Field(())
 
+    def _auto_rules(self, params: Any) -> List[PartitionRule]:
+        from zookeeper_tpu.parallel.rules import auto_fsdp_rules
+
+        axis = tuple(self.mesh_axes)[0]
+        return auto_fsdp_rules(
+            params,
+            axis_size=self.mesh.shape[axis],
+            fsdp_axis=axis,
+            min_weight_size=self.min_weight_size,
+            replicate_patterns=tuple(self.replicate_patterns),
+        )
+
     def state_sharding(self, state: Any) -> Any:
         # An explicit with_rules (even an empty list = replicate all)
         # always wins; otherwise rules derive from THIS state's params on
@@ -357,14 +438,14 @@ class FsdpPartitioner(MeshPartitioner):
         # differently-shaped states cannot silently apply stale rules.
         if getattr(self, "_rules_override", None) is not None:
             return super().state_sharding(state)
-        from zookeeper_tpu.parallel.rules import auto_fsdp_rules
+        return self._sharding_from_rules(state, self._auto_rules(state.params))
 
-        axis = tuple(self.mesh_axes)[0]
-        rules = auto_fsdp_rules(
-            state.params,
-            axis_size=self.mesh.shape[axis],
-            fsdp_axis=axis,
-            min_weight_size=self.min_weight_size,
-            replicate_patterns=tuple(self.replicate_patterns),
+    def variables_sharding(self, variables: Any) -> Any:
+        # Serving under FSDP: derive the same auto layout from the
+        # inference dict's params (suffix-anchored rules, so the
+        # ``params/`` prefix matches like training state paths).
+        if getattr(self, "_rules_override", None) is not None:
+            return super().variables_sharding(variables)
+        return self._sharding_from_rules(
+            variables, self._auto_rules(variables["params"])
         )
-        return self._sharding_from_rules(state, rules)

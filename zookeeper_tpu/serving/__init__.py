@@ -1,0 +1,38 @@
+"""Serving subsystem: dynamic-batching compiled inference.
+
+The inference half of the north star (ROADMAP: "serves heavy traffic
+from millions of users"): turn an exported or in-memory model into a
+low-latency, high-throughput service by amortizing per-request Python
+and dispatch cost the same way the fused training loop amortizes
+per-step cost — many requests ride one compiled program.
+
+- :class:`InferenceEngine` (``engine.py``): compiles one donation-safe,
+  sharded forward per SHAPE BUCKET (padded batch sizes, plus sequence
+  buckets for token models), with an explicit ``warmup()`` and a compile
+  cache keyed on (bucket, dtype, mesh) so steady-state serving never
+  recompiles.
+- :class:`MicroBatcher` (``batcher.py``): coalesces concurrent
+  ``submit()`` calls into the largest bucket that fills within
+  ``max_delay_ms``, pads the remainder, slices per-request results back
+  out; oversized requests split, a full queue applies backpressure, and
+  a deterministic synchronous mode keeps tier-1 tests thread-free.
+- :class:`ServingMetrics` (``metrics.py``): request latency percentiles,
+  queue depth, bucket-fill ratio, padding waste — emitted through the
+  training ``MetricsWriter`` family.
+- :class:`ServingConfig` (``service.py``): the ``Component`` tying model
+  + checkpoint (EMA-vs-raw weight selection) + engine + batcher +
+  metrics into one CLI-drivable task tree.
+"""
+
+from zookeeper_tpu.serving.batcher import MicroBatcher, PendingResult
+from zookeeper_tpu.serving.engine import InferenceEngine
+from zookeeper_tpu.serving.metrics import ServingMetrics
+from zookeeper_tpu.serving.service import ServingConfig
+
+__all__ = [
+    "InferenceEngine",
+    "MicroBatcher",
+    "PendingResult",
+    "ServingConfig",
+    "ServingMetrics",
+]

@@ -1,0 +1,330 @@
+"""Request coalescing: many ``submit()`` calls, one compiled dispatch.
+
+The serving counterpart of the fused training loop's slab: per-request
+dispatch pays Python + dispatch + readback once per REQUEST; the
+``MicroBatcher`` pays it once per MICRO-BATCH by concatenating queued
+requests (FIFO, row-granular) into the engine's largest bucket, padding
+only the final remainder, and slicing per-request results back out of
+the one readback.
+
+Degradation contract (all paths pinned in tests/serving/test_batcher.py):
+
+- *Oversized* requests (more rows than the largest bucket) are split
+  across consecutive dispatches and re-assembled — callers never see
+  the bucket limit.
+- *Queue-full* applies backpressure instead of buffering toward OOM:
+  synchronous mode drains the backlog inline; async mode blocks the
+  submitter until the worker catches up.
+- *Partial* micro-batches (queue drains below a bucket) pad up to the
+  smallest covering bucket — never a fresh compile.
+
+Determinism: inference is row-independent (engine docstring), so a
+request's result is bit-identical however it was coalesced or split —
+the batcher changes WHEN rows run, never WHAT they compute.
+
+Threading: ``synchronous=True`` (the default) is completely thread- and
+clock-free — requests queue until ``flush()`` (or ``result()``, which
+flushes on demand), so tier-1 CPU tests are deterministic. Async mode
+adds one worker thread that dispatches whenever the largest bucket
+fills or the oldest request has waited ``max_delay_ms``.
+"""
+
+import threading
+import time
+from typing import Any, List, Optional, Tuple
+
+import numpy as np
+
+from zookeeper_tpu.core import Field, component
+
+Array = Any
+
+
+class PendingResult:
+    """Handle for one submitted request; ``result()`` yields the
+    ``[n, ...]`` output rows in submission order."""
+
+    __slots__ = (
+        "_batcher", "_event", "_parts", "_rows", "_rows_done",
+        "_value", "_error", "_done", "_t_submit",
+    )
+
+    def __init__(self, batcher: "MicroBatcher", rows: int, event) -> None:
+        self._batcher = batcher
+        self._event = event  # None in synchronous mode
+        self._parts: List[np.ndarray] = []
+        self._rows = rows
+        self._rows_done = 0
+        self._value = None
+        self._error: Optional[BaseException] = None
+        self._done = False
+        self._t_submit = time.perf_counter()
+
+    @property
+    def done(self) -> bool:
+        return self._done
+
+    def _deliver(self, part: np.ndarray) -> None:
+        """Called by the batcher with consecutive row slices (FIFO order
+        guarantees they arrive in row order, including across the splits
+        of an oversized request)."""
+        self._parts.append(part)
+        self._rows_done += part.shape[0]
+        if self._rows_done >= self._rows:
+            self._value = (
+                self._parts[0]
+                if len(self._parts) == 1
+                else np.concatenate(self._parts)
+            )
+            self._parts = []
+            self._finish()
+
+    def _fail(self, error: BaseException) -> None:
+        self._error = error
+        self._finish()
+
+    def _finish(self) -> None:
+        self._done = True
+        latency_ms = (time.perf_counter() - self._t_submit) * 1e3
+        self._batcher._record_done(self, latency_ms)
+        if self._event is not None:
+            self._event.set()
+
+    def result(self, timeout: Optional[float] = None) -> np.ndarray:
+        if not self._done:
+            if self._event is None:
+                # Deterministic synchronous mode: asking for a result IS
+                # the flush trigger — no threads, no clocks.
+                self._batcher.flush()
+            elif not self._event.wait(timeout):
+                raise TimeoutError(
+                    f"request not served within {timeout}s (worker "
+                    "stalled, or close() was called before flush())."
+                )
+        if self._error is not None:
+            raise self._error
+        return self._value
+
+
+@component
+class MicroBatcher:
+    """Coalescing request queue in front of an
+    :class:`~zookeeper_tpu.serving.engine.InferenceEngine`."""
+
+    #: Async mode: dispatch as soon as the largest bucket fills, or when
+    #: the OLDEST pending request has waited this long — the knob trading
+    #: p99 latency against bucket fill (docs/DESIGN.md §8). Ignored in
+    #: synchronous mode (flush() is the trigger).
+    max_delay_ms: float = Field(2.0)
+    #: Backpressure threshold in ROWS. A submit that would grow the
+    #: queue past this drains the backlog (sync) or blocks (async)
+    #: rather than buffering unboundedly toward OOM.
+    max_queue_rows: int = Field(4096)
+    #: Thread- and clock-free deterministic mode (tier-1 default):
+    #: requests queue until flush()/result().
+    synchronous: bool = Field(True)
+
+    # -- wiring ----------------------------------------------------------
+
+    def bind(self, engine, metrics=None) -> "MicroBatcher":
+        if self.max_queue_rows < 1:
+            raise ValueError(
+                f"max_queue_rows={self.max_queue_rows} must be >= 1."
+            )
+        if self.max_delay_ms < 0:
+            raise ValueError(
+                f"max_delay_ms={self.max_delay_ms} must be >= 0."
+            )
+        object.__setattr__(self, "_engine", engine)
+        object.__setattr__(self, "_metrics", metrics)
+        # Queue of (request, lo, hi): row slice [lo, hi) of request still
+        # owed. Oversized/partially-taken requests stay at the head with
+        # lo advanced, so delivery is always in row order.
+        object.__setattr__(self, "_queue", [])
+        object.__setattr__(self, "_queue_rows", 0)
+        object.__setattr__(self, "_cv", threading.Condition())
+        object.__setattr__(self, "_worker", None)
+        object.__setattr__(self, "_inflight", False)
+        object.__setattr__(self, "_stop", threading.Event())
+        return self
+
+    def _require_bound(self) -> None:
+        if getattr(self, "_engine", None) is None:
+            raise RuntimeError(
+                "MicroBatcher is not bound: call batcher.bind(engine) "
+                "before submit()."
+            )
+
+    def _record_done(self, req: PendingResult, latency_ms: float) -> None:
+        if self._metrics is not None and req._error is None:
+            self._metrics.record_request(latency_ms, req._rows)
+
+    @property
+    def queue_rows(self) -> int:
+        return getattr(self, "_queue_rows", 0)
+
+    # -- submission ------------------------------------------------------
+
+    def submit(self, x: Array) -> PendingResult:
+        """Enqueue one request ``[n, *input_shape]``; returns a
+        :class:`PendingResult`. Never dispatches inline in async mode;
+        in sync mode dispatch happens at flush()/result() (or right here
+        when backpressure triggers)."""
+        self._require_bound()
+        x = np.asarray(x)
+        if x.ndim < 1 or x.shape[0] < 1:
+            raise ValueError(
+                f"request must have at least one row, got shape {x.shape}."
+            )
+        n = int(x.shape[0])
+        if self.synchronous:
+            if self._queue and self._queue_rows + n > self.max_queue_rows:
+                self.flush()  # backpressure: drain the backlog inline
+            req = PendingResult(self, n, event=None)
+            self._queue.append((req, x, 0, n))
+            object.__setattr__(self, "_queue_rows", self._queue_rows + n)
+            if self._metrics is not None:
+                self._metrics.record_queue_depth(self._queue_rows)
+            return req
+        self._ensure_worker()
+        req = PendingResult(self, n, event=threading.Event())
+        with self._cv:
+            while (
+                self._queue
+                and self._queue_rows + n > self.max_queue_rows
+                and not self._stop.is_set()
+            ):
+                self._cv.wait(0.01)  # backpressure: block the submitter
+            self._queue.append((req, x, 0, n))
+            object.__setattr__(self, "_queue_rows", self._queue_rows + n)
+            if self._metrics is not None:
+                self._metrics.record_queue_depth(self._queue_rows)
+            self._cv.notify_all()
+        return req
+
+    # -- dispatch planning ----------------------------------------------
+
+    def _take_plan(self) -> List[Tuple[PendingResult, np.ndarray]]:
+        """Pop up to ``engine.max_batch`` rows off the queue head
+        (row-granular: the last request taken may contribute only a
+        prefix, its remainder staying at the head). Caller holds the
+        lock in async mode; sync mode is single-threaded."""
+        room = self._engine.max_batch
+        plan: List[Tuple[PendingResult, np.ndarray]] = []
+        taken = 0
+        while self._queue and taken < room:
+            req, x, lo, hi = self._queue[0]
+            take = min(room - taken, hi - lo)
+            plan.append((req, x[lo : lo + take]))
+            taken += take
+            if lo + take == hi:
+                self._queue.pop(0)
+            else:
+                self._queue[0] = (req, x, lo + take, hi)
+        object.__setattr__(self, "_queue_rows", self._queue_rows - taken)
+        return plan
+
+    def _run_plan(self, plan: List[Tuple[PendingResult, np.ndarray]]) -> None:
+        """One engine dispatch + ONE host readback for the whole
+        micro-batch, then per-request slices delivered back out."""
+        import jax
+
+        rows = sum(part.shape[0] for _, part in plan)
+        if rows == 0:
+            return
+        batch = (
+            plan[0][1]
+            if len(plan) == 1
+            else np.concatenate([part for _, part in plan])
+        )
+        try:
+            out = np.asarray(jax.device_get(self._engine.infer(batch)))
+        except Exception as e:
+            for req, _ in plan:
+                req._fail(e)
+            raise
+        if self._metrics is not None:
+            self._metrics.record_dispatch(rows, self._engine.bucket_for(rows))
+        offset = 0
+        for req, part in plan:
+            k = part.shape[0]
+            req._deliver(out[offset : offset + k])
+            offset += k
+
+    # -- synchronous drain ----------------------------------------------
+
+    def flush(self) -> None:
+        """Serve every queued request. In synchronous mode this is THE
+        dispatch path (deterministic: FIFO micro-batches of at most
+        ``engine.max_batch`` rows each); in async mode it blocks until
+        the worker has drained the queue."""
+        self._require_bound()
+        if self.synchronous:
+            while self._queue:
+                self._run_plan(self._take_plan())
+            return
+        with self._cv:
+            self._cv.notify_all()
+            while (self._queue or self._inflight) and not self._stop.is_set():
+                self._cv.wait(0.01)
+
+    # -- async worker ----------------------------------------------------
+
+    def _ensure_worker(self) -> None:
+        if getattr(self, "_worker", None) is None:
+            thread = threading.Thread(
+                target=self._worker_loop, name="microbatcher", daemon=True
+            )
+            object.__setattr__(self, "_worker", thread)
+            thread.start()
+
+    def _worker_loop(self) -> None:
+        max_batch = self._engine.max_batch
+        delay_s = self.max_delay_ms / 1e3
+        while not self._stop.is_set():
+            with self._cv:
+                while not self._queue and not self._stop.is_set():
+                    self._cv.wait(0.05)
+                if self._stop.is_set():
+                    break
+                # Coalescing window: go when the largest bucket fills or
+                # the oldest request has waited max_delay_ms.
+                oldest = self._queue[0][0]._t_submit
+                while (
+                    self._queue_rows < max_batch
+                    and not self._stop.is_set()
+                ):
+                    remaining = oldest + delay_s - time.perf_counter()
+                    if remaining <= 0:
+                        break
+                    self._cv.wait(remaining)
+                plan = self._take_plan()
+                object.__setattr__(self, "_inflight", True)
+            try:
+                self._run_plan(plan)
+            except Exception:
+                pass  # requests carry the error; the worker must survive
+            finally:
+                with self._cv:
+                    object.__setattr__(self, "_inflight", False)
+                    self._cv.notify_all()
+
+    def close(self) -> None:
+        """Stop the async worker (pending requests are failed so no
+        result() blocks forever). Safe to call repeatedly / unbound."""
+        if getattr(self, "_engine", None) is None:
+            return
+        self._stop.set()
+        worker = getattr(self, "_worker", None)
+        if worker is not None:
+            with self._cv:
+                self._cv.notify_all()
+            worker.join(timeout=5)
+            object.__setattr__(self, "_worker", None)
+        err = RuntimeError("MicroBatcher closed with requests pending.")
+        for req, _, _, _ in self._queue:
+            if not req.done:
+                req._fail(err)
+        del self._queue[:]
+        object.__setattr__(self, "_queue_rows", 0)
+        self._stop.clear()

@@ -1,0 +1,278 @@
+"""Bucketed, pre-compiled inference engine.
+
+Serving traffic arrives at arbitrary batch sizes; compiling a fresh XLA
+program per size would stall requests for seconds and fill the compile
+cache with near-duplicates. The engine therefore quantizes every request
+batch to a small set of *shape buckets* (``batch_buckets``, e.g.
+``(1, 8, 32, 128)``), pads up to the bucket, runs the ONE compiled
+program for that bucket, and slices the padding back off. Token models
+additionally bucket the sequence axis (``seq_buckets``) — valid for
+causal attention, where right-padding cannot influence earlier
+positions.
+
+Compilation discipline:
+
+- Every bucket's forward is AOT-compiled (``jit(...).lower().compile()``)
+  into an explicit cache keyed on ``(batch_bucket, seq_bucket, dtype,
+  mesh)``; ``warmup()`` pre-compiles every configured bucket so the
+  first real request never pays a compile, and ``compile_count`` lets
+  tests assert that a warmed bucket triggers ZERO further compiles.
+- The forward is *donation-safe*: the weights are passed (never
+  donated — they serve every subsequent request, unlike the training
+  step's consumed state), and the padded input is not donated either
+  (no output aliases its shape, so donation would buy nothing and make
+  XLA warn on every compile — the ``donate_slab`` lesson).
+- Sharding comes from the same :class:`~zookeeper_tpu.parallel.\
+partitioner.Partitioner` family training uses
+  (``Partitioner.compile_forward``): the weights are placed once under
+  the partitioner's rules (dp replication / tp / explicit FSDP rules)
+  and the batch axis shards like a training batch, so a model trains
+  and serves under one layout.
+
+Per-row exactness: padding rows are zeros and inference is row-
+independent (BatchNorm uses running stats, attention is causal), so a
+request's rows are bit-identical whichever bucket they ride in — the
+invariant the MicroBatcher's coalescing correctness rests on (pinned in
+tests/serving/).
+"""
+
+from typing import Any, Callable, Optional, Sequence, Tuple
+
+import numpy as np
+
+from zookeeper_tpu.core import Field, component
+
+Array = Any
+
+
+@component
+class InferenceEngine:
+    """Compiled, bucketed forward passes over a bound model.
+
+    Configure the buckets as Fields; bind the runtime objects (apply_fn,
+    weights, input spec, partitioner) with :meth:`bind` — they are not
+    CLI-expressible. ``infer(x)`` serves one already-assembled batch of
+    at most ``max_batch`` rows; request coalescing/splitting lives in
+    :class:`~zookeeper_tpu.serving.batcher.MicroBatcher`.
+    """
+
+    #: Padded batch sizes, ascending. Each distinct bucket costs one
+    #: compile (at ``warmup()``) and its activation HBM; more buckets =
+    #: less padding waste per dispatch. The largest bucket is the
+    #: engine's max dispatch size (the batcher splits bigger requests).
+    batch_buckets: Sequence[int] = Field((1, 8, 32, 128))
+    #: Sequence-length buckets for token inputs (empty = no sequence
+    #: padding). Right-padding is only output-preserving under CAUSAL
+    #: attention; non-causal models must serve at exact lengths.
+    seq_buckets: Sequence[int] = Field(())
+
+    # -- runtime binding -------------------------------------------------
+
+    def bind(
+        self,
+        apply_fn: Callable[..., Array],
+        params: Any,
+        model_state: Any,
+        input_shape: Sequence[int],
+        *,
+        dtype: Any = None,
+        partitioner: Any = None,
+    ) -> "InferenceEngine":
+        """Attach the model to serve.
+
+        ``apply_fn`` follows the repo's module convention
+        (``apply(variables, x, training=False)``); ``input_shape`` is the
+        per-example shape (no batch dim); ``dtype`` the input dtype
+        (defaults to float32; token models pass int32). ``partitioner``
+        defaults to a fresh single-device one; pass the training
+        partitioner to serve under the training dp/tp layout.
+        """
+        import jax
+
+        buckets = tuple(int(b) for b in self.batch_buckets)
+        if not buckets or any(b < 1 for b in buckets) or list(buckets) != sorted(
+            set(buckets)
+        ):
+            raise ValueError(
+                f"batch_buckets={self.batch_buckets!r} must be a non-empty, "
+                "strictly-ascending tuple of positive sizes."
+            )
+        seq_buckets = tuple(int(s) for s in self.seq_buckets)
+        if seq_buckets and list(seq_buckets) != sorted(set(seq_buckets)):
+            raise ValueError(
+                f"seq_buckets={self.seq_buckets!r} must be "
+                "strictly ascending."
+            )
+        if partitioner is None:
+            from zookeeper_tpu.parallel.partitioner import (
+                SingleDevicePartitioner,
+            )
+
+            partitioner = SingleDevicePartitioner()
+        partitioner.setup()
+        variables = {"params": params, **dict(model_state or {})}
+        sharding = partitioner.variables_sharding(variables)
+        if sharding is not None:
+            variables = jax.tree.map(jax.device_put, variables, sharding)
+        else:
+            variables = jax.device_put(variables)
+        object.__setattr__(self, "_apply_fn", apply_fn)
+        object.__setattr__(self, "_variables", variables)
+        object.__setattr__(self, "_partitioner", partitioner)
+        object.__setattr__(self, "_input_shape", tuple(input_shape))
+        object.__setattr__(
+            self, "_dtype", np.dtype(dtype) if dtype is not None else np.float32
+        )
+        object.__setattr__(self, "_cache", {})
+        object.__setattr__(self, "_compile_count", 0)
+        return self
+
+    def _require_bound(self) -> None:
+        if getattr(self, "_apply_fn", None) is None:
+            raise RuntimeError(
+                "InferenceEngine is not bound: call "
+                "engine.bind(apply_fn, params, model_state, input_shape) "
+                "before warmup()/infer()."
+            )
+
+    # -- bucket arithmetic ----------------------------------------------
+
+    @property
+    def max_batch(self) -> int:
+        return max(int(b) for b in self.batch_buckets)
+
+    @property
+    def compile_count(self) -> int:
+        """Number of XLA compiles performed so far (cache misses). After
+        ``warmup()`` this is exactly ``len(batch_buckets) * max(1,
+        len(seq_buckets))`` and serving warmed buckets must not move it."""
+        return getattr(self, "_compile_count", 0)
+
+    def bucket_for(self, n: int) -> int:
+        """Smallest batch bucket holding ``n`` rows."""
+        if n < 1:
+            raise ValueError(f"batch of {n} rows is not servable.")
+        for b in self.batch_buckets:
+            if int(b) >= n:
+                return int(b)
+        raise ValueError(
+            f"batch of {n} rows exceeds the largest bucket "
+            f"{self.max_batch}; split it (MicroBatcher does this "
+            "automatically) or widen batch_buckets."
+        )
+
+    def _seq_bucket_for(self, seq: int) -> int:
+        for s in self.seq_buckets:
+            if int(s) >= seq:
+                return int(s)
+        raise ValueError(
+            f"sequence length {seq} exceeds the largest seq bucket "
+            f"{max(int(s) for s in self.seq_buckets)}; widen seq_buckets."
+        )
+
+    # -- compile cache ---------------------------------------------------
+
+    def _bucket_shape(
+        self, bucket: int, seq_bucket: Optional[int]
+    ) -> Tuple[int, ...]:
+        shape = (bucket, *self._input_shape)
+        if seq_bucket is not None:
+            shape = (bucket, seq_bucket, *self._input_shape[1:])
+        return shape
+
+    def _compiled(self, bucket: int, seq_bucket: Optional[int], dtype):
+        """The AOT-compiled forward for one shape bucket, plus whether
+        the OUTPUT carries the sequence axis (cache-keyed on bucket,
+        dtype, and the partitioner's mesh — a rebound mesh must never
+        serve another mesh's executable)."""
+        import jax
+
+        self._require_bound()
+        key = (bucket, seq_bucket, str(np.dtype(dtype)), self._partitioner.mesh)
+        cached = self._cache.get(key)
+        if cached is not None:
+            return cached
+        apply_fn = self._apply_fn
+
+        def forward(variables, x):
+            return apply_fn(variables, x, training=False)
+
+        out_tracks_seq = False
+        if seq_bucket is not None:
+            # Does output axis 1 follow the sequence axis? Decided by
+            # abstract trace at two sequence lengths — a dimension-size
+            # coincidence (e.g. a pooled [batch, classes] head whose
+            # class count equals the seq bucket) must NOT get its
+            # classes sliced off as "padding".
+            def out_shape(s):
+                return jax.eval_shape(
+                    forward,
+                    self._variables,
+                    jax.ShapeDtypeStruct(
+                        self._bucket_shape(bucket, s), np.dtype(dtype)
+                    ),
+                ).shape
+
+            a = out_shape(seq_bucket)
+            b = out_shape(max(1, seq_bucket - 1))
+            out_tracks_seq = (
+                len(a) >= 2 and len(b) >= 2 and a[1] != b[1]
+            )
+        jitted = self._partitioner.compile_forward(
+            forward, self._variables, batch_rows=bucket
+        )
+        dummy = jax.ShapeDtypeStruct(
+            self._bucket_shape(bucket, seq_bucket), np.dtype(dtype)
+        )
+        compiled = (jitted.lower(self._variables, dummy).compile(),
+                    out_tracks_seq)
+        self._cache[key] = compiled
+        object.__setattr__(self, "_compile_count", self._compile_count + 1)
+        return compiled
+
+    def warmup(self) -> int:
+        """Pre-compile every configured (batch, seq) bucket so no request
+        ever waits on XLA. Returns the number of cached executables."""
+        self._require_bound()
+        seqs = tuple(int(s) for s in self.seq_buckets) or (None,)
+        for bucket in self.batch_buckets:
+            for seq in seqs:
+                self._compiled(int(bucket), seq, self._dtype)
+        return len(self._cache)
+
+    # -- serving ---------------------------------------------------------
+
+    def infer(self, x: Array) -> Array:
+        """Forward one batch ``[n, *input_shape]`` (n <= ``max_batch``):
+        pad to the bucket, dispatch the compiled program, slice the
+        padding back off. Returns a device array ``[n, ...]`` — the
+        caller decides when to pay the host readback (the batcher does
+        one ``device_get`` per coalesced dispatch, not per request)."""
+        x = np.asarray(x)
+        self._require_bound()
+        n = x.shape[0]
+        bucket = self.bucket_for(n)
+        seq_bucket = None
+        orig_seq = None
+        if self.seq_buckets:
+            if x.ndim < 2:
+                raise ValueError(
+                    "seq_buckets configured but the input has no sequence "
+                    f"axis (shape {x.shape})."
+                )
+            orig_seq = x.shape[1]
+            seq_bucket = self._seq_bucket_for(orig_seq)
+        pad = [(0, bucket - n)] + [(0, 0)] * (x.ndim - 1)
+        if seq_bucket is not None:
+            pad[1] = (0, seq_bucket - orig_seq)
+        if any(p != (0, 0) for p in pad):
+            x = np.pad(x, pad)  # zero padding: row-independent forward
+        x = x.astype(self._dtype, copy=False)
+        compiled, out_tracks_seq = self._compiled(bucket, seq_bucket, x.dtype)
+        out = compiled(self._variables, x)[:n]
+        if out_tracks_seq and orig_seq != seq_bucket:
+            out = out[:, :orig_seq]
+        return out
+
+    def __call__(self, x: Array) -> Array:
+        return self.infer(x)

@@ -1,0 +1,119 @@
+"""Serving observability: latency percentiles, queue depth, padding cost.
+
+The serving analogue of the training metrics writers: the batcher and
+service record per-request and per-dispatch samples here (host-side
+floats only — recording never adds device syncs), and ``emit()`` flushes
+an aggregated snapshot through the existing
+:class:`~zookeeper_tpu.training.metrics.MetricsWriter` family, so one
+sink config observes both halves of the system.
+
+The tracked quantities are the levers of the serving cost model
+(docs/DESIGN.md §8):
+
+- ``latency_p50/p95/p99_ms`` — per-request submit-to-result wall time;
+  the tail is what ``max_delay_ms`` trades against throughput.
+- ``queue_depth`` — pending rows at submit time; sustained growth means
+  the engine is saturated (widen buckets or add chips).
+- ``bucket_fill`` — real rows / bucket rows per dispatch; low fill says
+  the delay window closes before traffic accumulates.
+- ``padding_waste`` — padded rows / bucket rows; the compute thrown
+  away to shape quantization (more buckets shrink it).
+"""
+
+from collections import deque
+from typing import Dict, Mapping, Optional
+
+import numpy as np
+
+from zookeeper_tpu.core import Field, component
+
+
+@component
+class ServingMetrics:
+    """Bounded-window aggregator for serving samples.
+
+    All recorders are O(1) appends into fixed-size deques (a serving
+    process runs indefinitely; unbounded sample lists would be a slow
+    leak). ``snapshot()`` reduces the current window; counters
+    (``requests``/``rows``/``dispatches``) are lifetime totals.
+    """
+
+    #: Samples retained per series (latency/fill/depth). Percentiles are
+    #: computed over this sliding window.
+    window: int = Field(4096)
+
+    def _series(self, name: str) -> deque:
+        store = getattr(self, "_store", None)
+        if store is None:
+            store = {}
+            object.__setattr__(self, "_store", store)
+            object.__setattr__(
+                self, "_totals", {"requests": 0, "rows": 0, "dispatches": 0}
+            )
+        if name not in store:
+            store[name] = deque(maxlen=max(1, int(self.window)))
+        return store[name]
+
+    # -- recorders (called by MicroBatcher / ServingConfig) --------------
+
+    def record_request(self, latency_ms: float, rows: int) -> None:
+        self._series("latency_ms").append(float(latency_ms))
+        self._totals["requests"] += 1
+        self._totals["rows"] += int(rows)
+
+    def record_queue_depth(self, rows: int) -> None:
+        self._series("queue_depth").append(float(rows))
+
+    def record_dispatch(self, real_rows: int, bucket_rows: int) -> None:
+        if bucket_rows <= 0:
+            return
+        self._series("bucket_fill").append(real_rows / bucket_rows)
+        self._series("padding_waste").append(
+            (bucket_rows - real_rows) / bucket_rows
+        )
+        self._totals["dispatches"] += 1
+
+    # -- reduction -------------------------------------------------------
+
+    @property
+    def totals(self) -> Dict[str, int]:
+        self._series("latency_ms")  # ensure initialized
+        return dict(self._totals)
+
+    def snapshot(self) -> Dict[str, float]:
+        """Aggregate the current window into a flat ``{name: float}``
+        mapping (absent series are simply omitted, so an idle service
+        emits only its counters)."""
+        self._series("latency_ms")
+        out: Dict[str, float] = {
+            k: float(v) for k, v in self._totals.items()
+        }
+        lat = self._store.get("latency_ms")
+        if lat:
+            arr = np.asarray(lat)
+            out["latency_p50_ms"] = float(np.percentile(arr, 50))
+            out["latency_p95_ms"] = float(np.percentile(arr, 95))
+            out["latency_p99_ms"] = float(np.percentile(arr, 99))
+            out["latency_mean_ms"] = float(arr.mean())
+        for name in ("queue_depth", "bucket_fill", "padding_waste"):
+            series = self._store.get(name)
+            if series:
+                out[f"{name}_mean"] = float(np.mean(series))
+        return out
+
+    def emit(
+        self, writer, step: int = 0, extra: Optional[Mapping[str, float]] = None
+    ) -> Dict[str, float]:
+        """Write the snapshot through a training-family MetricsWriter
+        under the ``serve/`` prefix; returns the snapshot."""
+        snap = self.snapshot()
+        scalars = {f"serve/{k}": float(v) for k, v in snap.items()}
+        if extra:
+            scalars.update(
+                {f"serve/{k}": float(v) for k, v in extra.items()}
+            )
+        writer.write_scalars(int(step), scalars)
+        return snap
+
+    def reset(self) -> None:
+        object.__setattr__(self, "_store", None)

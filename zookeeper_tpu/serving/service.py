@@ -1,0 +1,199 @@
+"""The serving config tree: one component from checkpoint to hot engine.
+
+``ServingConfig`` is the ``Experiment``-shaped citizen of the config
+system (same ``key=value`` CLI, same scoped-field wiring) for the
+inference half of the north star: point it at a deployment artifact —
+a ``save_model`` export or a full ``Checkpointer`` directory — pick EMA
+vs raw weights, and ``build_service()`` returns a warmed engine +
+batcher pair ready for traffic.
+
+``run()`` is the demo/bench driver (a real deployment would wrap
+``build_service()`` in its transport of choice): it feeds a
+deterministic stream of variable-size synthetic requests through the
+batcher, then prints ONE JSON line of serving metrics (latency
+percentiles, bucket fill, padding waste, qps) through the same
+``MetricsWriter`` sinks training uses — so
+``python examples/serve_classifier.py ServeDigits checkpoint=...`` is an
+end-to-end smoke of the whole subsystem.
+"""
+
+import json
+import time
+from typing import Any, Dict, Optional
+
+from zookeeper_tpu.core import ComponentField, Field, component, pretty_print
+from zookeeper_tpu.models.base import Model
+from zookeeper_tpu.parallel.partitioner import (
+    Partitioner,
+    SingleDevicePartitioner,
+)
+from zookeeper_tpu.serving.batcher import MicroBatcher
+from zookeeper_tpu.serving.engine import InferenceEngine
+from zookeeper_tpu.serving.metrics import ServingMetrics
+from zookeeper_tpu.training.experiment import Experiment
+from zookeeper_tpu.training.metrics import CompositeMetricsWriter, MetricsWriter
+
+
+@component
+class ServingConfig(Experiment):
+    """Configurable inference service over an exported model.
+
+    Subclass with ``@task`` (like the training examples do for
+    ``TrainingExperiment``) to get a ``serve``-style CLI entry point —
+    see ``examples/serve_classifier.py``.
+    """
+
+    model: Model = ComponentField()
+    partitioner: Partitioner = ComponentField(SingleDevicePartitioner)
+    engine: InferenceEngine = ComponentField(InferenceEngine)
+    batcher: MicroBatcher = ComponentField(MicroBatcher)
+    metrics: ServingMetrics = ComponentField(ServingMetrics)
+    #: Same pluggable sink family as training (``writer.jsonl.path=...``
+    #: / ``writer.tensorboard.log_dir=...``).
+    writer: MetricsWriter = ComponentField(CompositeMetricsWriter)
+
+    #: Deployment artifact: a ``save_model`` export or a full
+    #: ``Checkpointer`` directory (latest step). None = fresh-initialized
+    #: weights (compile/latency smoke without a training run).
+    checkpoint: Optional[str] = Field(None)
+    #: EMA-vs-raw weight selection (``select_inference_weights``):
+    #: "auto" ships the EMA shadow when the checkpoint carries one —
+    #: the same artifact ``ema_decay`` + ``export_model_to`` produce.
+    weights: str = Field("auto")
+
+    #: Per-example input geometry (images; token models drive the engine
+    #: programmatically with ``seq_buckets``).
+    height: int = Field(224)
+    width: int = Field(224)
+    channels: int = Field(3)
+    num_classes: int = Field(1000)
+    seed: int = Field(0)
+
+    #: Pre-compile every bucket before serving (warm path: first request
+    #: never pays XLA).
+    warmup: bool = Field(True)
+    #: Demo-driver knobs for ``run()``: how many synthetic requests, and
+    #: the largest request size in the stream.
+    requests: int = Field(64)
+    max_request: int = Field(8)
+    verbose: bool = Field(True)
+
+    @property
+    def input_shape(self):
+        return (self.height, self.width, self.channels)
+
+    def build_service(self):
+        """Load weights, bind + warm the engine, bind the batcher.
+        Returns ``(engine, batcher)`` (also kept on self)."""
+        if self.weights not in ("auto", "ema", "raw"):
+            # Pure config: fail before any checkpoint IO / compile.
+            raise ValueError(
+                f"weights={self.weights!r} unknown; choose auto/ema/raw."
+            )
+        if self.requests < 0 or self.max_request < 1:
+            raise ValueError(
+                f"requests={self.requests} must be >= 0 and "
+                f"max_request={self.max_request} >= 1."
+            )
+        module = self.model.build(self.input_shape, self.num_classes)
+        if self.checkpoint:
+            import jax
+
+            from zookeeper_tpu.training.checkpoint import load_inference_model
+
+            abstract = jax.eval_shape(
+                lambda: self.model.initialize(
+                    module, self.input_shape, seed=self.seed
+                )
+            )
+            params, model_state = load_inference_model(
+                self.checkpoint,
+                weights=self.weights,
+                params_like=abstract[0],
+                model_state_like=abstract[1],
+            )
+        else:
+            params, model_state = self.model.initialize(
+                module, self.input_shape, seed=self.seed
+            )
+        self.partitioner.setup()
+        self.engine.bind(
+            module.apply,
+            params,
+            model_state,
+            self.input_shape,
+            dtype=self.model.dtype(),
+            partitioner=self.partitioner,
+        )
+        if self.warmup:
+            self.engine.warmup()
+        self.batcher.bind(self.engine, metrics=self.metrics)
+        return self.engine, self.batcher
+
+    def finish_report(
+        self,
+        *,
+        warm_compiles: int,
+        n_requests: int,
+        dt: float,
+        writer_extra: Optional[Dict[str, float]] = None,
+        result_extra: Optional[Dict[str, Any]] = None,
+    ) -> Dict[str, Any]:
+        """The ONE reporting path (shared with serve-task subclasses so
+        the JSON contract — compiles/recompiles_after_warmup/qps/serve
+        metric keys — can never fork): emit the metrics snapshot through
+        the writer, assemble + print the result line, close the
+        batcher."""
+        qps = n_requests / dt if dt > 0 else 0.0
+        snapshot = self.metrics.emit(
+            self.writer, step=0, extra={"qps": qps, **(writer_extra or {})}
+        )
+        self.writer.flush()
+        result = {
+            **{k: round(float(v), 4) for k, v in snapshot.items()},
+            "model": type(self.model).__name__,
+            "weights": self.weights,
+            "batch_buckets": [int(b) for b in self.engine.batch_buckets],
+            "compiles": self.engine.compile_count,
+            "recompiles_after_warmup": (
+                self.engine.compile_count - warm_compiles
+            ),
+            "requests": n_requests,
+            "qps": round(qps, 1),
+            **(result_extra or {}),
+        }
+        if self.verbose:
+            print(json.dumps(result), flush=True)
+        self.batcher.close()
+        return result
+
+    def run(self) -> Dict[str, Any]:
+        """Serve a deterministic synthetic request stream and report."""
+        import numpy as np
+
+        if self.verbose:
+            print(pretty_print(self), flush=True)
+        engine, batcher = self.build_service()
+        warm_compiles = engine.compile_count
+        rng = np.random.default_rng(self.seed)
+        t0 = time.perf_counter()
+        pending = []
+        rows = 0
+        for _ in range(self.requests):
+            n = int(rng.integers(1, self.max_request + 1))
+            x = rng.normal(size=(n, *self.input_shape)).astype(
+                self.model.dtype()
+            )
+            pending.append((n, batcher.submit(x)))
+            rows += n
+        batcher.flush()
+        dt = time.perf_counter() - t0
+        for n, handle in pending:
+            out = handle.result()
+            assert out.shape[0] == n, (out.shape, n)
+        return self.finish_report(
+            warm_compiles=warm_compiles,
+            n_requests=self.requests,
+            dt=dt,
+            writer_extra={"rows_per_sec": (rows / dt) if dt > 0 else 0.0},
+        )

@@ -11,8 +11,10 @@ runs single-device, data-parallel, or model-parallel.
 
 from zookeeper_tpu.training.checkpoint import (
     Checkpointer,
+    load_inference_model,
     load_model,
     save_model,
+    select_inference_weights,
 )
 from zookeeper_tpu.training.distill import DistillationExperiment
 from zookeeper_tpu.training.experiment import (
@@ -80,8 +82,10 @@ __all__ = [
     "DistillationExperiment",
     "EvalExperiment",
     "Experiment",
+    "load_inference_model",
     "load_model",
     "save_model",
+    "select_inference_weights",
     "JsonlMetricsWriter",
     "MetricsWriter",
     "TensorBoardMetricsWriter",

@@ -155,6 +155,62 @@ def measure_fused_loop_time(
     return per_slab / unroll, holder["state"]
 
 
+def measure_serving_latency(
+    engine: Any,
+    x: Any,
+    *,
+    n1: int = 8,
+    n2: int = 24,
+    rounds: int = 6,
+    percentile_samples: int = 24,
+    chain_len: int = 4,
+) -> Tuple[float, float, float]:
+    """Steady-state latency of the SERVING path — one
+    ``InferenceEngine.infer`` dispatch (engine Python + host input
+    staging + padded compiled forward), measured with the repo's shared
+    protocols:
+
+    - the MEAN per-dispatch time comes from :func:`time_marginal` over
+      chains of back-to-back dispatches (the fixed chain-end sync
+      cancels; the per-dispatch cost stays in) — this anchors
+      ``serve_qps_per_chip``;
+    - the p50/p99 come from ``percentile_samples`` independent SHORT
+      chains of ``chain_len`` dispatches each (per-dispatch =
+      chain/len): chaining amortizes the fixed readback the same way
+      while preserving dispatch-to-dispatch spread, which a single
+      marginal would average away.
+
+    The engine must be warmed (``warmup()``) — a compile inside the
+    timed window would dominate everything. Returns
+    ``(mean_s, p50_s, p99_s)`` per dispatch; the mean may be
+    non-positive under pathological jitter (callers decide, like every
+    ``time_marginal`` consumer).
+    """
+    import jax.numpy as jnp
+
+    def run_chain(k: int) -> float:
+        t0 = time.perf_counter()
+        out = None
+        for _ in range(k):
+            out = engine.infer(x)
+        # device_get is the completion barrier (block_until_ready
+        # returns early through remote-TPU tunnels).
+        float(jax.device_get(jnp.ravel(out)[0]))
+        return time.perf_counter() - t0
+
+    run_chain(2)  # warm the dispatch path (not the compile — warmup())
+    mean_s = time_marginal(run_chain, n1, n2, rounds)
+    samples = np.asarray(
+        sorted(run_chain(chain_len) / chain_len
+               for _ in range(percentile_samples))
+    )
+    return (
+        mean_s,
+        float(np.percentile(samples, 50)),
+        float(np.percentile(samples, 99)),
+    )
+
+
 def measure_inference_latency(
     module: Any,
     variables: Any,

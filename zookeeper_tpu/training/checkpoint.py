@@ -238,11 +238,26 @@ def save_model(path: str, params: Any, model_state: Any) -> None:
         )
 
 
+def _structure_mismatch_error(path: str, err: Exception) -> ValueError:
+    """Wrap an orbax restore failure in a clear, actionable error: the
+    overwhelmingly common cause is a model/checkpoint structure mismatch
+    (different architecture fields than the exporting run), and orbax's
+    own wording buries that."""
+    return ValueError(
+        f"Checkpoint at {path!r} does not match the target model "
+        "structure: the restoring model must be built with the SAME "
+        "architecture configuration as the exporting run (layer counts, "
+        "features, packed_weights, ...). Original orbax error: "
+        f"{err}"
+    )
+
+
 def load_model(path: str, params_like: Any, model_state_like: Any):
     """Restore a ``save_model`` checkpoint. ``*_like`` provide the target
     structure/shardings (shape-dtype structs suffice; structs without
     sharding — e.g. from ``jax.eval_shape`` — restore onto the default
-    device); returns ``(params, model_state)``."""
+    device); returns ``(params, model_state)``. A checkpoint whose tree
+    does not match the target structure raises a clear ValueError."""
     import jax
     import orbax.checkpoint as ocp
 
@@ -253,7 +268,14 @@ def load_model(path: str, params_like: Any, model_state_like: Any):
     )
 
     def to_struct(leaf):
-        struct = ocp.utils.to_shape_dtype_struct(leaf)
+        # ShapeDtypeStructs pass through untouched: the installed orbax's
+        # to_shape_dtype_struct crashes on a struct whose sharding is
+        # None (exactly what jax.eval_shape produces — the abstract-init
+        # restore path).
+        if isinstance(leaf, jax.ShapeDtypeStruct):
+            struct = leaf
+        else:
+            struct = ocp.utils.to_shape_dtype_struct(leaf)
         if getattr(struct, "sharding", None) is None:
             struct = jax.ShapeDtypeStruct(
                 struct.shape, struct.dtype, sharding=default_sharding
@@ -265,7 +287,10 @@ def load_model(path: str, params_like: Any, model_state_like: Any):
         to_struct, {"params": params_like, "model_state": model_state_like}
     )
     with ocp.StandardCheckpointer() as ckptr:
-        restored = ckptr.restore(path, target)
+        try:
+            restored = ckptr.restore(path, target)
+        except (ValueError, KeyError, TypeError) as e:
+            raise _structure_mismatch_error(path, e) from e
     return restored["params"], restored["model_state"]
 
 
@@ -280,3 +305,148 @@ def load_exported_model(path: str, model: Any, module: Any, input_shape,
         lambda: model.initialize(module, input_shape, seed=seed)
     )
     return load_model(path, abstract[0], abstract[1])
+
+
+def select_inference_weights(
+    params: Any, ema_params: Optional[Any], weights: str = "auto"
+):
+    """The ONE weight-selection policy shared by serving and eval
+    consumers (ServingConfig.weights / EvalExperiment.weights):
+
+    - ``"raw"``  — the raw training parameters.
+    - ``"ema"``  — the EMA shadow (the "ship weights" that ``ema_decay``
+      maintains and ``export_model_to`` ships); error when absent.
+    - ``"auto"`` — EMA when present, else raw: the artifact the training
+      config says to ship.
+    """
+    if weights == "raw":
+        return params
+    if weights == "ema":
+        if ema_params is None:
+            raise ValueError(
+                "weights='ema' but the checkpoint carries no ema_params: "
+                "it was trained without ema_decay, or it is a model-only "
+                "export (save_model ships ONE set of weights — already "
+                "the EMA when the exporting run had ema_decay on). Use "
+                "weights='auto' or 'raw'."
+            )
+        return ema_params
+    if weights == "auto":
+        return params if ema_params is None else ema_params
+    raise ValueError(
+        f"weights={weights!r} unknown; choose auto/ema/raw."
+    )
+
+
+def _checkpoint_manager_item_dir(path: str) -> Optional[str]:
+    """When ``path`` is a ``Checkpointer`` (orbax CheckpointManager)
+    directory, the directory of its LATEST step's saved item; None when
+    ``path`` is not a manager directory (e.g. a ``save_model`` export,
+    whose own directory holds the checkpoint)."""
+    if not os.path.isdir(path):
+        return None
+    steps = [d for d in os.listdir(path) if d.isdigit()]
+    if not steps:
+        return None
+    step_dir = os.path.join(path, max(steps, key=int))
+    # CheckpointManager nests single-item saves under "default".
+    default = os.path.join(step_dir, "default")
+    return default if os.path.isdir(default) else step_dir
+
+
+def load_inference_model(
+    path: str,
+    *,
+    weights: str = "auto",
+    params_like: Any = None,
+    model_state_like: Any = None,
+):
+    """Load inference weights from EITHER deployment artifact:
+
+    - a ``save_model`` model-only export (params + model_state), or
+    - a full ``Checkpointer`` directory (latest step of a training run's
+      CheckpointManager tree — params, ema_params, model_state; the
+      optimizer state is restored and dropped),
+
+    selecting EMA vs raw via :func:`select_inference_weights`. The
+    restore is structure-free (arrays land on host, as saved), so no
+    target pytree is needed; when ``params_like`` is given the restored
+    params tree is validated against it and a structure mismatch raises
+    the same clear error as :func:`load_model`. Returns
+    ``(params, model_state)`` — callers place them on devices (the
+    serving engine's ``bind`` shards them under its partitioner).
+    """
+    import jax
+    import numpy as np
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(os.path.expanduser(path))
+    item_dir = _checkpoint_manager_item_dir(path)
+    # Target-free restore is deliberate (it is what makes ONE loader
+    # serve both artifact layouts without knowing the exporting run's
+    # optimizer tree); orbax warns "generally UNSAFE" on every such
+    # call, but the structure IS validated below against the *_like
+    # trees — silence just that warning.
+    import logging
+
+    absl_logger = logging.getLogger("absl")
+    prev_level = absl_logger.level
+    absl_logger.setLevel(logging.ERROR)
+    try:
+        with ocp.StandardCheckpointer() as ckptr:
+            try:
+                restored = ckptr.restore(item_dir or path)
+            except Exception as e:
+                raise ValueError(
+                    f"No restorable checkpoint at {path!r} (expected a "
+                    "save_model export or a Checkpointer directory). "
+                    f"Original orbax error: {e}"
+                ) from e
+    finally:
+        absl_logger.setLevel(prev_level)
+    if not isinstance(restored, dict) or "params" not in restored:
+        raise ValueError(
+            f"Checkpoint at {path!r} has no 'params' tree — not a "
+            "save_model export or Checkpointer state."
+        )
+    params = select_inference_weights(
+        restored["params"], restored.get("ema_params"), weights
+    )
+    model_state = restored.get("model_state") or {}
+
+    def check_like(got_tree, like, what):
+        """Tree structure AND leaf shapes must match the target (a
+        same-depth checkpoint with different layer widths would
+        otherwise surface later as an opaque XLA shape error inside
+        apply — the failure mode the clear error exists to prevent).
+        Dtypes stay lenient: the saved dtype is authoritative and flax
+        promotes at apply time."""
+        want_s = jax.tree.structure(like)
+        got_s = jax.tree.structure(got_tree)
+        if want_s != got_s:
+            raise _structure_mismatch_error(
+                path,
+                ValueError(f"expected {what} tree {want_s}, got {got_s}"),
+            )
+        bad = [
+            f"{np.shape(g)} where the model expects {np.shape(w)}"
+            for g, w in zip(
+                jax.tree.leaves(got_tree), jax.tree.leaves(like)
+            )
+            if tuple(np.shape(g)) != tuple(np.shape(w))
+        ]
+        if bad:
+            raise _structure_mismatch_error(
+                path,
+                ValueError(
+                    f"{what} leaf shape mismatch: "
+                    + "; ".join(bad[:4])
+                    + (" ..." if len(bad) > 4 else "")
+                ),
+            )
+
+    if params_like is not None:
+        check_like(params, params_like, "params")
+    if model_state_like is not None:
+        check_like(model_state, model_state_like, "model_state")
+    return params, model_state

@@ -145,6 +145,10 @@ class TrainingExperiment(Experiment):
     #: step maintains the average, validation evaluates it, and
     #: export_model_to ships it. Standard for long binary-net recipes:
     #: late sign flips make raw weights oscillate; the average does not.
+    #: Downstream consumers pick EMA vs raw with the shared weights
+    #: Field (``ServingConfig.weights`` / ``EvalExperiment.weights`` —
+    #: ``training.checkpoint.select_inference_weights``): "auto" serves
+    #: the EMA shadow whenever this knob produced one.
     ema_decay: float = Field(0.0)
     #: Rematerialization policy ("none"/"dots"/"full"/"quant"): trade
     #: backward recompute for activation HBM (see make_train_step —
@@ -721,8 +725,15 @@ class EvalExperiment(Experiment):
     partitioner: Partitioner = ComponentField(SingleDevicePartitioner)
     runtime: DistributedRuntime = ComponentField(DistributedRuntime)
 
-    #: Model-only checkpoint (save_model format).
+    #: Model-only checkpoint (save_model format) OR a full
+    #: ``Checkpointer`` directory (the latest step of a training run).
     checkpoint: str = Field()
+    #: Which weights to score when the checkpoint carries both: "auto"
+    #: (EMA when present — the ship artifact), "ema" (require the EMA
+    #: shadow), or "raw" (the raw training params). Shares
+    #: ``training.checkpoint.select_inference_weights`` with the serving
+    #: loader, so eval scores exactly what serving ships.
+    weights: str = Field("auto")
     split: str = Field("validation")
     batch_size: int = Field(32)
     seed: int = Field(0)
@@ -735,8 +746,14 @@ class EvalExperiment(Experiment):
         return int(self.loader.dataset.resolved_num_classes())
 
     def run(self) -> Dict[str, float]:
-        from zookeeper_tpu.training.checkpoint import load_exported_model
+        import jax
 
+        from zookeeper_tpu.training.checkpoint import load_inference_model
+
+        if self.weights not in ("auto", "ema", "raw"):
+            raise ValueError(
+                f"weights={self.weights!r} unknown; choose auto/ema/raw."
+            )
         if self.split not in ("train", "validation"):
             # The loader maps any non-"train" name to the validation
             # split; scoring "test" against validation data silently
@@ -758,8 +775,20 @@ class EvalExperiment(Experiment):
 
         input_shape = self.loader.preprocessing.input_shape
         module = self.model.build(input_shape, self.num_classes)
-        params, model_state = load_exported_model(
-            self.checkpoint, self.model, module, input_shape, seed=self.seed
+        # The unified inference loader (shared with the serving engine):
+        # model-only export OR full Checkpointer directory, EMA-vs-raw
+        # selected by the weights Field, structure validated against the
+        # freshly-built model's abstract init.
+        abstract = jax.eval_shape(
+            lambda: self.model.initialize(
+                module, input_shape, seed=self.seed
+            )
+        )
+        params, model_state = load_inference_model(
+            self.checkpoint,
+            weights=self.weights,
+            params_like=abstract[0],
+            model_state_like=abstract[1],
         )
         state = TrainState.create(
             apply_fn=module.apply,
