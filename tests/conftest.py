@@ -16,8 +16,13 @@ if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
         xla_flags + " --xla_force_host_platform_device_count=8"
     ).strip()
-# Keep single-core CI boxes responsive.
-os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "3")
+# Keep single-core CI boxes responsive — but stop at level 2 (INFO +
+# WARNING suppressed, ERROR kept): GSPMD's "Involuntary full
+# rematerialization" diagnostic is an E-level line that level 3 now
+# SWALLOWS on this XLA version (the old "the warning bypasses level-3
+# filtering" observation rotted), which silently blinded every
+# SPMD-log-cleanliness assertion and its canary.
+os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "2")
 
 # The machine's sitecustomize registers the real TPU backend
 # programmatically (overriding JAX_PLATFORMS from the environment), so the

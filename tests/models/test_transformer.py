@@ -137,10 +137,13 @@ def _sharded_parity_run(module, params, state, batch, partitioner):
     return ts2, m2
 
 
+@pytest.mark.slow
 def test_dp_sharded_step_matches_single_device():
     """The LM trains under the same DataParallelPartitioner as the CNN
     zoo — one step on the 8-device mesh is bit-comparable to the
-    single-device step."""
+    single-device step. (8-virtual-device parity tail: certification
+    tier — the fast tier keeps the single-device flash/dense parity
+    check, `test_flash_and_dense_attention_agree`.)"""
     from zookeeper_tpu.parallel import DataParallelPartitioner
 
     if jax.device_count() < 8:
@@ -174,8 +177,12 @@ def test_build_rejections():
         m4.build((32, 32, 3), num_classes=10)
 
 
+@pytest.mark.slow
 def test_sequence_parallel_lm_train_step_matches_single_device():
-    """The long-context pod recipe end to end: ring_flash_attention
+    """(8-virtual-device parity tail, certification tier — the dryrun's
+    sp-lm leg covers the composed recipe on every driver round.)
+
+    The long-context pod recipe end to end: ring_flash_attention
     (flash kernels inside a ppermute ring) plugs into the model as an
     attention CALLABLE over a dp x sp mesh, and one full train step —
     forward, backward through the composed tier, Adam update — matches
@@ -299,8 +306,6 @@ def test_fsdp_lm_shards_exact_and_compiles_clean(capfd):
     step matches single-device."""
     from zookeeper_tpu.parallel import FsdpPartitioner
 
-    from zookeeper_tpu.models.transformer import TransformerLMModule
-
     if jax.device_count() < 8:
         pytest.skip("needs 8 devices")
     _, module, params, state = make_model()
@@ -310,33 +315,23 @@ def test_fsdp_lm_shards_exact_and_compiles_clean(capfd):
     part.setup()
 
     # POSITIVE CONTROL first (the dryrun canary lesson: prove the
-    # detector fires before trusting its silence): the UNPINNED module
-    # under the same FSDP layout must emit the warning, otherwise the
-    # absence assertion below is vacuous (e.g. a logging backend
-    # swallowing C++ stderr).
-    unpinned = TransformerLMModule(
-        vocab_size=61, num_layers=2, d_model=64, num_heads=2,
-        mlp_ratio=4, attention="flash", max_seq_len=64,
-        dtype=jnp.float32, pin_activations=False,
-    )
-    mk = lambda m: TrainState.create(
-        apply_fn=m.apply,
-        params=jax.tree.map(jnp.copy, params),
-        model_state=state,
-        tx=optax.adam(1e-3),
-    )
+    # detector fires before trusting its silence). The original control
+    # — the UNPINNED module under the same FSDP layout — ROTTED: on the
+    # current XLA version it compiles without the warning, so it can no
+    # longer prove the detector sees anything. The trigger is
+    # single-sourced in testing.run_spmd_remat_trigger (shared with the
+    # dryrun canary so the two detectors stay in lockstep; model-free,
+    # so future layout fixes can't defuse it).
+    from zookeeper_tpu.testing import run_spmd_remat_trigger
+
     capfd.readouterr()
-    ts_u = part.shard_state(mk(unpinned))
-    part.compile_step(make_train_step(), ts_u)(
-        ts_u, jax.device_put(lm_batch(), part.batch_sharding())
-    )
+    run_spmd_remat_trigger(8)
     canary_err = capfd.readouterr().err
     assert "Involuntary full rematerialization" in canary_err, (
-        "canary: the unpinned module compiled without the warning "
+        "canary: the known remat trigger compiled without the warning "
         "reaching stderr — the detector is blind, the clean assertion "
         "below would prove nothing"
     )
-
     capfd.readouterr()  # Drop canary noise.
     ts2, _ = _sharded_parity_run(module, params, state, lm_batch(), part)
     err = capfd.readouterr().err

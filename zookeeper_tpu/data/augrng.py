@@ -1,0 +1,89 @@
+"""Shared counter-based augmentation RNG (the determinism contract of the
+fused native batch assembly).
+
+Both augmentation implementations — the per-example Python path in
+``data/preprocessing.py`` and the fused C++ kernel in
+``native/src/zk_native.cpp`` — draw from THIS generator, keyed by
+``(seed, example_index, epoch)``. The two paths therefore consume the
+identical random stream and produce bit-identical batches, which is what
+lets the pipeline switch between them freely (native fast path on hosts
+with a toolchain, Python everywhere else) without perturbing the
+bit-exact-resume contract or multi-host batch agreement.
+
+Design constraints (why not ``np.random.Generator``):
+
+- The stream must be reproducible from a HANDFUL of integer ops so a
+  ~40-line C++ mirror can stay provably in sync. splitmix64 is the
+  standard pick: a counter keyed by a 64-bit state, one finalizer per
+  draw, passes BigCrush-level bit-mixing for this use (crop offsets and
+  flip coins, not cryptography).
+- Every derived draw (``uniform``, ``randint``) uses ONLY IEEE-754
+  exactly-rounded double ops (+ - * /), so Python floats and C++ doubles
+  agree to the last bit on every platform. ``recipe_exp`` exists for the
+  same reason: ``math.exp``/``std::exp`` may differ in the final ulp
+  between libms, which would desync the RandomResizedCrop aspect draw —
+  a fixed-order Horner polynomial is bit-identical by construction (and
+  exact to ~1 ulp over the |u| <= 2 range real aspect configs use).
+
+The C++ twin lives in ``native/src/zk_native.cpp`` (``AugRng`` /
+``recipe_exp``); ``tests/native/test_augment.py`` pins the two together
+through whole-batch bitwise equality.
+"""
+
+from __future__ import annotations
+
+_MASK = (1 << 64) - 1
+_GOLDEN = 0x9E3779B97F4A7C15
+_MIX1 = 0xBF58476D1CE4E5B9
+_MIX2 = 0x94D049BB133111EB
+
+#: Exactly 2**-53 (a power of two, so the product below rounds once).
+_U53_INV = 1.0 / 9007199254740992.0
+
+
+def _mix(z: int) -> int:
+    """splitmix64 finalizer (64-bit wrapping arithmetic)."""
+    z &= _MASK
+    z = ((z ^ (z >> 30)) * _MIX1) & _MASK
+    z = ((z ^ (z >> 27)) * _MIX2) & _MASK
+    return (z ^ (z >> 31)) & _MASK
+
+
+class AugRng:
+    """Deterministic per-example augmentation stream for
+    ``(seed, index, epoch)`` — the Python half of the shared contract."""
+
+    def __init__(self, seed: int, index: int, epoch: int):
+        s = _mix((int(seed) + _GOLDEN) & _MASK)
+        s = _mix(((s ^ (int(index) & _MASK)) + _GOLDEN) & _MASK)
+        s = _mix(((s ^ (int(epoch) & _MASK)) + _GOLDEN) & _MASK)
+        self._state = s
+
+    def next_u64(self) -> int:
+        self._state = (self._state + _GOLDEN) & _MASK
+        return _mix(self._state)
+
+    def uniform(self, lo: float, hi: float) -> float:
+        """Double in [lo, hi): 53 mantissa bits, one rounding for the
+        scale and one for the affine — identical op order in C++."""
+        d = (self.next_u64() >> 11) * _U53_INV
+        return lo + (hi - lo) * d
+
+    def randint(self, n: int) -> int:
+        """Integer in [0, n). Plain modulo — the (identical-in-C++)
+        modulo bias is ~n/2**64, irrelevant for crop offsets."""
+        return int(self.next_u64() % n)
+
+
+def recipe_exp(u: float) -> float:
+    """exp(u) as a fixed-order 21-term Horner polynomial.
+
+    Bit-identical across Python/C++ because it is the same sequence of
+    exactly-rounded double ops; accurate to ~1 ulp for |u| <= 2 (the
+    log-aspect range of any sane RandomResizedCrop config; wider ranges
+    degrade accuracy gracefully and stay deterministic).
+    """
+    acc = 1.0
+    for k in range(21, 0, -1):
+        acc = 1.0 + acc * (u / k)
+    return acc

@@ -120,14 +120,18 @@ def batch_iterator(
     else:
         order = np.arange(n)
 
-    # Native fast path: when preprocessing reduces to gather+affine over a
-    # uint8 feature store, assemble whole batches in one fused C++ call
-    # (threads, no per-example Python) — the LCE-equivalent host kernel.
-    # Duck-typed over any source exposing whole-column ndarray access:
-    # ArraySource (``.arrays``, in-RAM) and MemmapSource (``.features``,
-    # disk-backed > RAM — the path ImageNet-scale training actually uses;
-    # the C++ gather reads straight out of the mapping, so page faults
-    # ride the kernel's threads, VERDICT round-2 #3).
+    # Native fast path: when preprocessing reduces to a fused C++ batch
+    # assembly over a uint8 feature store — plain gather+affine
+    # ("normalize" mode) or the full training augmentation recipe
+    # ("augment" mode: RandomResizedCrop/pad+crop, flip, normalize,
+    # bit-identical to the Python path via the shared counter RNG) —
+    # assemble whole batches in one call (threads, no per-example
+    # Python) — the LCE-equivalent host kernel. Duck-typed over any
+    # source exposing whole-column ndarray access: ArraySource
+    # (``.arrays``, in-RAM) and MemmapSource (``.features``, disk-backed
+    # > RAM — the path ImageNet-scale training actually uses; the C++
+    # gather reads straight out of the mapping, so page faults ride the
+    # kernel's threads, VERDICT round-2 #3).
     native_spec = None
     if preprocessing is not None and hasattr(preprocessing, "native_batch_spec"):
         spec = preprocessing.native_batch_spec(training)
@@ -136,19 +140,78 @@ def batch_iterator(
             if arrays is not None:
                 img = arrays.get(spec["image_key"])
                 lbl = arrays.get(spec["label_key"])
-                if (
+                mode = spec.get("mode", "normalize")
+                ok = (
                     img is not None
                     and lbl is not None
                     and img.dtype == np.uint8
                     and img.flags["C_CONTIGUOUS"]
-                    and tuple(img.shape[1:]) == tuple(spec["expected_shape"])
-                ):
+                )
+                if ok and mode == "normalize":
+                    # gather_normalize has a numpy fallback, so no
+                    # availability gate here.
+                    ok = tuple(img.shape[1:]) == tuple(
+                        spec["expected_shape"]
+                    )
+                elif ok:  # mode == "augment"
+                    # The augmented kernel has NO numpy fallback (the
+                    # per-example Python path below IS the bit-identical
+                    # reference), so it engages only when the library
+                    # loads and the store shape fits the recipe:
+                    # RandomResizedCrop accepts any fixed source
+                    # resolution (it resizes), pad+crop requires the
+                    # source to already be output-shaped.
+                    from zookeeper_tpu import native
+
+                    eh, ew, ec = spec["expected_shape"]
+                    ok = (
+                        native.available()
+                        and img.ndim == 4
+                        and (
+                            img.shape[3] == ec
+                            if spec["random_resized_crop"]
+                            # pad+crop: source already output-shaped,
+                            # and the kernel's reflect indexing is
+                            # valid only for pad < side (numpy's
+                            # np.pad handles pad >= side by repeated
+                            # reflection, which the kernel does not
+                            # model — fall back to Python there).
+                            else tuple(img.shape[1:]) == (eh, ew, ec)
+                            and spec["pad_pixels"] < min(eh, ew)
+                        )
+                    )
+                if ok:
                     native_spec = (spec, img, lbl)
 
     if native_spec is not None:
         from zookeeper_tpu import native
 
         spec, img, lbl = native_spec
+        if spec.get("mode", "normalize") == "normalize":
+            def assemble(idx):
+                return native.gather_normalize(
+                    img, idx, spec["scale"], spec["shift"]
+                )
+        else:
+            eh, ew, _ = spec["expected_shape"]
+
+            def assemble(idx):
+                return native.gather_augment_normalize(
+                    img,
+                    idx,
+                    out_height=eh,
+                    out_width=ew,
+                    seed=seed,
+                    epoch=epoch,
+                    random_resized_crop=spec["random_resized_crop"],
+                    crop_scale_range=spec["crop_scale_range"],
+                    log_aspect_range=spec["log_aspect_range"],
+                    pad_pixels=spec["pad_pixels"],
+                    random_flip=spec["random_flip"],
+                    post_scale=spec["post_scale"],
+                    post_shift=spec["post_shift"],
+                )
+
         for b in range(start_batch, num_batches):
             start = b * global_batch + host_index * batch_size
             stop = min(start + batch_size, n, (b + 1) * global_batch)
@@ -156,9 +219,7 @@ def batch_iterator(
                 continue
             idx = order[start:stop].astype(np.int64)
             yield {
-                "input": native.gather_normalize(
-                    img, idx, spec["scale"], spec["shift"]
-                ),
+                "input": assemble(idx),
                 "target": lbl[idx].astype(np.int32),
             }
         return
@@ -168,6 +229,7 @@ def batch_iterator(
         example = dict(source[idx])
         example.setdefault("_index", np.int64(idx))
         example.setdefault("_epoch", np.int64(epoch))
+        example.setdefault("_seed", np.int64(seed))
         if preprocessing is not None:
             example = preprocessing(example, training)
         return example

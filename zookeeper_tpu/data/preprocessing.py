@@ -12,11 +12,13 @@ step instead (TPU time is cheaper than host time at pod scale).
 
 from __future__ import annotations
 
+import math
 from typing import Optional, Tuple
 
 import numpy as np
 
 from zookeeper_tpu.core import Field, component
+from zookeeper_tpu.data.augrng import AugRng, recipe_exp
 from zookeeper_tpu.data.source import Example
 
 
@@ -130,10 +132,16 @@ class ImageClassificationPreprocessing(Preprocessing):
     [-1, 1] (or [0, 1]), optional train-time augmentation (random crop after
     padding + horizontal flip — the CIFAR/larq recipe), integer label out.
 
-    Augmentation is seeded per-example from a stable hash so the pipeline
-    stays deterministic and resumable (same example index + epoch => same
-    augmentation), which is a correctness requirement for multi-host
-    pipelines where every host must agree on the global batch.
+    Augmentation draws from the shared counter RNG
+    (``data/augrng.AugRng``) keyed by (pipeline seed, example index,
+    epoch), so the pipeline stays deterministic and resumable (same key
+    => same augmentation) — a correctness requirement for multi-host
+    pipelines where every host must agree on the global batch — AND
+    bit-identical to the fused native batch-assembly kernel
+    (``native.gather_augment_normalize``), which consumes the same
+    stream. This method is the reference implementation of that
+    contract; any recipe change here must be mirrored in
+    ``native/src/zk_native.cpp``.
     """
 
     image_key: str = Field("image")
@@ -147,10 +155,10 @@ class ImageClassificationPreprocessing(Preprocessing):
     random_flip: bool = Field(True)
     #: Inception-style RandomResizedCrop (the ImageNet training recipe):
     #: sample a crop covering ``crop_scale_range`` of the source area at
-    #: an aspect ratio in ``crop_aspect_range``, then resize to
-    #: (height, width). Replaces the CIFAR-style pad+crop when on.
-    #: Resize is nearest-neighbor (library-free numpy; documented
-    #: deviation from bilinear).
+    #: an aspect ratio in ``crop_aspect_range``, then bilinear-resize to
+    #: (height, width) (half-pixel centers, clamped edges — the standard
+    #: align_corners=False convention). Replaces the CIFAR-style
+    #: pad+crop when on.
     random_resized_crop: bool = Field(False)
     crop_scale_range: Tuple[float, float] = Field((0.08, 1.0))
     crop_aspect_range: Tuple[float, float] = Field((0.75, 4.0 / 3.0))
@@ -169,52 +177,57 @@ class ImageClassificationPreprocessing(Preprocessing):
         # Pixels scale to float regardless of augmentation settings.
         return "float32"
 
-    def _random_resized_crop(
-        self, image: np.ndarray, rng: np.random.Generator
-    ) -> np.ndarray:
+    def _validated_rrc_ranges(self):
+        """``(scale_lo, scale_hi, log_aspect_lo, log_aspect_hi)`` or a
+        fail-fast ValueError with the config values (not an
+        OverflowError from log/uniform deep inside a pipeline). The log
+        endpoints are computed HERE, once, with ``math.log`` — both the
+        Python draw loop and the native kernel receive these exact
+        doubles, so a libm log discrepancy can never desync them."""
         s_lo, s_hi = self.crop_scale_range
         a_lo, a_hi = self.crop_aspect_range
         if not (0.0 < s_lo <= s_hi <= 1.0) or not (0.0 < a_lo <= a_hi):
-            # Fail fast with the config values, not an OverflowError from
-            # np.log/rng.uniform deep inside a (possibly multi-worker)
-            # pipeline.
             raise ValueError(
                 f"Invalid RandomResizedCrop ranges: crop_scale_range="
                 f"{(s_lo, s_hi)} must satisfy 0 < lo <= hi <= 1 and "
                 f"crop_aspect_range={(a_lo, a_hi)} must satisfy "
                 "0 < lo <= hi."
             )
+        return float(s_lo), float(s_hi), math.log(a_lo), math.log(a_hi)
+
+    def _random_resized_crop(self, image: np.ndarray, rng: AugRng) -> np.ndarray:
+        lo, hi, log_lo, log_hi = self._validated_rrc_ranges()
         h, w = image.shape[:2]
         area = float(h * w)
-        lo, hi = self.crop_scale_range
-        log_lo, log_hi = np.log(self.crop_aspect_range)
         # Rejection-sample like the Inception reference (10 tries, then a
-        # deterministic center-square fallback).
+        # deterministic center-square fallback). Draw order and the
+        # exact arithmetic (recipe_exp, IEEE sqrt, round-half-even) are
+        # the shared contract with the native kernel.
         for _ in range(10):
             target_area = area * rng.uniform(lo, hi)
-            aspect = float(np.exp(rng.uniform(log_lo, log_hi)))
-            cw = int(round(np.sqrt(target_area * aspect)))
-            ch = int(round(np.sqrt(target_area / aspect)))
+            aspect = recipe_exp(rng.uniform(log_lo, log_hi))
+            cw = int(round(math.sqrt(target_area * aspect)))
+            ch = int(round(math.sqrt(target_area / aspect)))
             if 0 < cw <= w and 0 < ch <= h:
-                top = int(rng.integers(0, h - ch + 1))
-                left = int(rng.integers(0, w - cw + 1))
+                top = rng.randint(h - ch + 1)
+                left = rng.randint(w - cw + 1)
                 crop = image[top : top + ch, left : left + cw]
-                return _resize_nearest(crop, self.height, self.width)
+                return _resize_bilinear(crop, self.height, self.width)
         side = min(h, w)
         crop = _center_crop_or_pad(image, side, side)
-        return _resize_nearest(crop, self.height, self.width)
+        return _resize_bilinear(crop, self.height, self.width)
 
-    def _augment(self, image: np.ndarray, rng: np.random.Generator) -> np.ndarray:
+    def _augment(self, image: np.ndarray, rng: AugRng) -> np.ndarray:
         if self.random_resized_crop:
             image = self._random_resized_crop(image, rng)
         else:
             p = self.pad_pixels
             if p > 0:
                 padded = np.pad(image, ((p, p), (p, p), (0, 0)), mode="reflect")
-                oy = int(rng.integers(0, 2 * p + 1))
-                ox = int(rng.integers(0, 2 * p + 1))
+                oy = rng.randint(2 * p + 1)
+                ox = rng.randint(2 * p + 1)
                 image = padded[oy : oy + self.height, ox : ox + self.width]
-        if self.random_flip and rng.integers(0, 2) == 1:
+        if self.random_flip and rng.randint(2) == 1:
             image = image[:, ::-1]
         return image
 
@@ -238,13 +251,14 @@ class ImageClassificationPreprocessing(Preprocessing):
         ):
             image = _resize_nearest(image, self.height, self.width)
         if training and self.augment:
-            # Seed from (example index, epoch): deterministic/resumable AND
-            # varying per epoch — the same crop every epoch would silently
-            # shrink augmentation diversity.
-            rng = np.random.default_rng(
-                np.random.SeedSequence(
-                    [int(example.get("_index", 0)), int(example.get("_epoch", 0))]
-                )
+            # Keyed on (pipeline seed, example index, epoch):
+            # deterministic/resumable AND varying per epoch — the same
+            # crop every epoch would silently shrink augmentation
+            # diversity. The same key drives the native fused kernel.
+            rng = AugRng(
+                int(example.get("_seed", 0)),
+                int(example.get("_index", 0)),
+                int(example.get("_epoch", 0)),
             )
             image = self._augment(image, rng)
         if image.shape[:2] != (self.height, self.width):
@@ -257,10 +271,30 @@ class ImageClassificationPreprocessing(Preprocessing):
         return np.asarray(example[self.label_key], dtype=np.int32)
 
     def native_batch_spec(self, training: bool):
-        # Augmentation is per-example/stateful; only the pure
-        # normalize-and-stack mode collapses to the native fused kernel.
         if training and self.augment:
-            return None
+            # Augmented mode: the fused C++ kernel replays this class's
+            # recipe bit-identically (shared counter RNG), so the spec
+            # carries the full recipe. The pipeline falls back to this
+            # Python path when the library or store shape doesn't
+            # support it — behaviorally identical either way.
+            spec = {
+                "image_key": self.image_key,
+                "label_key": self.label_key,
+                "mode": "augment",
+                "expected_shape": self.input_shape,
+                "random_resized_crop": bool(self.random_resized_crop),
+                "pad_pixels": int(self.pad_pixels),
+                "random_flip": bool(self.random_flip),
+                "post_scale": 2.0 if self.zero_center else 1.0,
+                "post_shift": -1.0 if self.zero_center else 0.0,
+                "crop_scale_range": (0.0, 0.0),
+                "log_aspect_range": (0.0, 0.0),
+            }
+            if self.random_resized_crop:
+                s_lo, s_hi, log_lo, log_hi = self._validated_rrc_ranges()
+                spec["crop_scale_range"] = (s_lo, s_hi)
+                spec["log_aspect_range"] = (log_lo, log_hi)
+            return spec
         if self.zero_center:
             scale, shift = 2.0 / 255.0, -1.0
         else:
@@ -268,6 +302,7 @@ class ImageClassificationPreprocessing(Preprocessing):
         return {
             "image_key": self.image_key,
             "label_key": self.label_key,
+            "mode": "normalize",
             "scale": scale,
             "shift": shift,
             "expected_shape": self.input_shape,
@@ -290,6 +325,43 @@ def _center_crop_or_pad(image: np.ndarray, height: int, width: int) -> np.ndarra
             mode="constant",
         )
     return image
+
+
+def _resize_bilinear(image: np.ndarray, height: int, width: int) -> np.ndarray:
+    """Bilinear resize, half-pixel centers (align_corners=False), clamped
+    edges — pure numpy, float32 taps.
+
+    The arithmetic is the bit-identity contract with the native kernel's
+    ``bilinear_crop_resize``: source coordinates and fractional offsets
+    in float64, weights cast to float32, and the interpolation as the
+    fixed op order ``(p00*wx0 + p01*fx)*wy0 + (p10*wx0 + p11*fx)*fy``
+    (two rounded mul+add per tap pair — which is also why the native
+    build pins ``-ffp-contract=off``)."""
+    h, w = image.shape[:2]
+    img = np.ascontiguousarray(image, dtype=np.float32)
+    sy = (np.arange(height, dtype=np.float64) + 0.5) * (h / height) - 0.5
+    sx = (np.arange(width, dtype=np.float64) + 0.5) * (w / width) - 0.5
+    y0 = np.floor(sy)
+    x0 = np.floor(sx)
+    fy = (sy - y0).astype(np.float32)[:, None, None]
+    fx = (sx - x0).astype(np.float32)[None, :, None]
+    y0 = y0.astype(np.int64)
+    x0 = x0.astype(np.int64)
+    y0c = np.clip(y0, 0, h - 1)
+    y1c = np.clip(y0 + 1, 0, h - 1)
+    x0c = np.clip(x0, 0, w - 1)
+    x1c = np.clip(x0 + 1, 0, w - 1)
+    r0 = img[y0c]
+    r1 = img[y1c]
+    p00 = r0[:, x0c]
+    p01 = r0[:, x1c]
+    p10 = r1[:, x0c]
+    p11 = r1[:, x1c]
+    wy0 = np.float32(1.0) - fy
+    wx0 = np.float32(1.0) - fx
+    top = p00 * wx0 + p01 * fx
+    bot = p10 * wx0 + p11 * fx
+    return top * wy0 + bot * fy
 
 
 def _resize_nearest(image: np.ndarray, height: int, width: int) -> np.ndarray:

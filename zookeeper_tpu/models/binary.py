@@ -474,25 +474,36 @@ class _QuickNetModule(nn.Module):
     def __call__(self, x, training: bool = False):
         _check_fold_training(self.fold_bn, self.packed_weights, training)
         d = self.dtype
+        # The fp stem/transition segments pin activations to the
+        # canonical dp x tp layout like the Quant* layers do
+        # (parallel/sharding.py). Without the pins the segments are
+        # GSPMD-free territory, and at data-axis sizes > 4 the
+        # propagator was observed choosing a batch-over-all-axes layout
+        # for the grouped stem conv / blurpool that it could only leave
+        # by involuntary full rematerialization (found by the 16-device
+        # dryrun leg; value-identical either way — pins are layout-only
+        # and no-ops outside a partitioner scope).
+        from zookeeper_tpu.parallel.sharding import constrain_batch_sharded
+
         # Stem: fp 3x3/2 to 8ch, then grouped 3x3/2 to first section width.
         x = nn.Conv(8, (3, 3), strides=(2, 2), padding="SAME",
                     use_bias=False, dtype=d)(x.astype(d))
         x = _bn(training, self.dtype)(x)
-        x = nn.relu(x)
+        x = constrain_batch_sharded(nn.relu(x))
         x = nn.Conv(
             self.section_features[0], (3, 3), strides=(2, 2), padding="SAME",
             use_bias=False, feature_group_count=4, dtype=d,
         )(x)
-        x = _bn(training, self.dtype)(x)
+        x = constrain_batch_sharded(_bn(training, self.dtype)(x))
         for s, (n, feat) in enumerate(
             zip(self.blocks_per_section, self.section_features)
         ):
             if s > 0:
                 # Transition: blurpool downsample + fp 1x1 conv to widen.
-                x = nn.relu(x)
-                x = _blur_pool(x, d)
+                x = constrain_batch_sharded(nn.relu(x))
+                x = constrain_batch_sharded(_blur_pool(x, d))
                 x = nn.Conv(feat, (1, 1), use_bias=False, dtype=d)(x)
-                x = _bn(training, self.dtype)(x)
+                x = constrain_batch_sharded(_bn(training, self.dtype)(x))
             for _ in range(n):
                 # BN folds only where the section ships packed (the
                 # converter emits the folded scale/bias into the packed

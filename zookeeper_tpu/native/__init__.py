@@ -37,19 +37,33 @@ def _build_dirs():
     yield os.path.join(cache, "zookeeper_tpu")
 
 
+# -ffp-contract=off: the augmented-assembly kernel is BIT-identical
+# to the numpy reference only if mul+add stays two rounded ops (an
+# auto-contracted FMA on FMA-capable targets would flip the last
+# ulp of every bilinear tap). Module-level so the digest can cover it.
+_BUILD_FLAGS = (
+    "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread",
+    "-ffp-contract=off",
+)
+
+
 def _src_digest() -> str:
+    # The digest covers the COMPILE FLAGS as well as the source: flags
+    # like -ffp-contract are correctness-load-bearing (bit-identity
+    # contract), so a flags-only change must miss the binary cache just
+    # like a source edit.
+    h = hashlib.sha256()
+    h.update(" ".join(_BUILD_FLAGS).encode())
     with open(_SRC, "rb") as f:
-        return hashlib.sha256(f.read()).hexdigest()[:12]
+        h.update(f.read())
+    return h.hexdigest()[:12]
 
 
 def _build(lib_path: str) -> bool:
     # Unique temp per builder: concurrent processes must not interleave
     # writes into one file (os.replace then promotes only complete builds).
     tmp = f"{lib_path}.{os.getpid()}.tmp"
-    cmd = [
-        "g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread",
-        _SRC, "-o", tmp,
-    ]
+    cmd = ["g++", *_BUILD_FLAGS, _SRC, "-o", tmp]
     try:
         os.makedirs(os.path.dirname(lib_path), exist_ok=True)
         subprocess.run(
@@ -118,6 +132,19 @@ def _load() -> Optional[ctypes.CDLL]:
             ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_int64),
             ctypes.POINTER(ctypes.c_float), ctypes.c_int64, ctypes.c_int64,
             ctypes.c_float, ctypes.c_float,
+        ]
+        lib.zk_gather_augment_normalize_u8.argtypes = [
+            ctypes.POINTER(ctypes.c_uint8),   # store
+            ctypes.POINTER(ctypes.c_int64),   # indices
+            ctypes.POINTER(ctypes.c_float),   # out
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,  # batch,h,w
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,  # c,oh,ow
+            ctypes.c_int64, ctypes.c_int64,   # seed, epoch
+            ctypes.c_int32,                   # random_resized_crop
+            ctypes.c_double, ctypes.c_double,  # scale range
+            ctypes.c_double, ctypes.c_double,  # log-aspect range
+            ctypes.c_int32, ctypes.c_int32,   # pad_pixels, random_flip
+            ctypes.c_float, ctypes.c_float,   # post_scale, post_shift
         ]
         lib.zk_xnor_gemm_ref.argtypes = [
             ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
@@ -189,6 +216,80 @@ def gather_normalize(
         batch, example_size, float(scale), float(shift),
     )
     return out.reshape(batch, *example_shape)
+
+
+def gather_augment_normalize(
+    store: np.ndarray,
+    indices: np.ndarray,
+    *,
+    out_height: int,
+    out_width: int,
+    seed: int,
+    epoch: int,
+    random_resized_crop: bool,
+    crop_scale_range=(0.08, 1.0),
+    log_aspect_range=(0.0, 0.0),
+    pad_pixels: int = 0,
+    random_flip: bool = True,
+    post_scale: float = 2.0,
+    post_shift: float = -1.0,
+) -> np.ndarray:
+    """Fused AUGMENTED batch assembly over a ``[N, H, W, C]`` uint8 store:
+    per-example RandomResizedCrop (bilinear) or reflect-pad+crop, flip,
+    normalize — bit-identical to the Python reference path
+    (``ImageClassificationPreprocessing`` with ``augment=True``) through
+    the shared ``(seed, index, epoch)`` counter RNG (``data/augrng.py``).
+
+    Unlike the other entry points there is NO numpy fallback here: the
+    per-example Python preprocessing path IS the reference
+    implementation, so callers (``data/pipeline.py``) gate on
+    ``available()`` and simply keep using it when the toolchain is
+    absent. Raises RuntimeError if called without the library.
+    """
+    lib = _load()
+    if lib is None:
+        raise RuntimeError(
+            "native library unavailable — use the Python preprocessing "
+            "path (bit-identical by contract)."
+        )
+    store = np.ascontiguousarray(store)
+    if store.dtype != np.uint8 or store.ndim != 4:
+        raise ValueError(
+            "gather_augment_normalize expects a [N, H, W, C] uint8 store, "
+            f"got {store.dtype} {store.shape}."
+        )
+    if not random_resized_crop and store.shape[1:3] != (out_height, out_width):
+        raise ValueError(
+            "pad+crop recipe requires the store's spatial shape "
+            f"{store.shape[1:3]} to equal the output ({out_height}, "
+            f"{out_width}); only RandomResizedCrop resizes."
+        )
+    if not random_resized_crop and pad_pixels >= min(out_height, out_width):
+        # The kernel's single-bounce reflect indexing is valid only for
+        # pad < side; numpy's np.pad(mode="reflect") reflects repeatedly
+        # for larger pads, so the Python path must handle those.
+        raise ValueError(
+            f"pad_pixels={pad_pixels} >= min image side "
+            f"{min(out_height, out_width)} is outside the fused kernel's "
+            "reflect range — use the Python preprocessing path."
+        )
+    indices = np.ascontiguousarray(indices, dtype=np.int64)
+    batch = len(indices)
+    channels = store.shape[3]
+    out = np.empty((batch, out_height, out_width, channels), np.float32)
+    lib.zk_gather_augment_normalize_u8(
+        _ptr(store, ctypes.c_uint8),
+        _ptr(indices, ctypes.c_int64),
+        _ptr(out, ctypes.c_float),
+        batch, store.shape[1], store.shape[2], channels,
+        out_height, out_width, int(seed), int(epoch),
+        int(bool(random_resized_crop)),
+        float(crop_scale_range[0]), float(crop_scale_range[1]),
+        float(log_aspect_range[0]), float(log_aspect_range[1]),
+        int(pad_pixels), int(bool(random_flip)),
+        float(post_scale), float(post_shift),
+    )
+    return out
 
 
 def xnor_gemm(

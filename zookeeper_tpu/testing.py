@@ -12,6 +12,54 @@ import jax.numpy as jnp
 import numpy as np
 
 
+def run_spmd_remat_trigger(n_devices: int = 8) -> None:
+    """Compile-and-run a MINIMAL program known to make GSPMD log its
+    "Involuntary full rematerialization" diagnostic — the positive
+    control ("canary") for every SPMD-log-cleanliness certification
+    (``__graft_entry__.dryrun_multichip`` and the FSDP suite).
+
+    Single-sourced here because canary triggers ROT: two earlier,
+    model-based triggers (the everything-shards QuickNet FSDP layout;
+    the unpinned transformer under FSDP) stopped warning after model
+    layout fixes / XLA upgrades, silently blinding whichever detector
+    still used them. This trigger is the ``rules.auto_fsdp_rules``
+    documented pathology with NO model code in the path: a depthwise
+    conv with batch-sharded input and channel-sharded kernel, whose
+    weight gradient demands a channel-sharded cotangent that GSPMD can
+    reach from the batch-sharded layout only by full rematerialization.
+    Empirically fires at (data >= 4, model = 2) meshes, i.e.
+    ``n_devices >= 8``; if it ever stops firing, update it HERE and
+    both certification legs stay in lockstep.
+
+    NOTE: the diagnostic is an ERROR-level C++ stderr line that
+    ``TF_CPP_MIN_LOG_LEVEL=3`` suppresses (a "bypasses level-3
+    filtering" observation rotted with an XLA upgrade) — callers'
+    environments must keep the level <= 2 for the capture to see it.
+    """
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+    groups = 8
+    mesh = Mesh(
+        np.array(jax.devices()[:n_devices]).reshape(n_devices // 2, 2),
+        ("data", "model"),
+    )
+    x = jnp.ones((n_devices, 8, 8, groups), jnp.float32)
+    k = jnp.ones((3, 3, 1, groups), jnp.float32)
+    xs = NamedSharding(mesh, PartitionSpec("data"))
+    ks = NamedSharding(mesh, PartitionSpec(None, None, None, "model"))
+
+    def loss(x, k):
+        y = jax.lax.conv_general_dilated(
+            x, k, (2, 2), "SAME", feature_group_count=groups,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
+        return (y * y).sum()
+
+    jax.jit(jax.grad(loss, argnums=1), in_shardings=(xs, ks))(
+        jax.device_put(x, xs), jax.device_put(k, ks)
+    ).block_until_ready()
+
+
 def randomize_bn_variables(
     params: Mapping[str, Any],
     batch_stats: Mapping[str, Any],
