@@ -597,6 +597,124 @@ def measure_host_aug_throughput(env=None):
     return metrics
 
 
+def measure_recovery_leg(env=None):
+    """Always-on recovery leg: time from supervisor restart to the
+    first post-resume train step (``recovery_restore_ms``) — the
+    recovery-time number docs/DESIGN.md §10 budgets against, measured
+    by actually walking the kill->save->restart->restore path on a
+    tiny synthetic experiment (seconds on any backend; the checkpoint
+    machinery exercised is byte-for-byte the production path)."""
+    import shutil
+    import tempfile
+
+    from zookeeper_tpu.core import configure
+    from zookeeper_tpu.resilience import measure_recovery_restore_ms
+    from zookeeper_tpu.training import TrainingExperiment
+
+    tmp = tempfile.mkdtemp(prefix="zk_bench_recovery_")
+
+    def make_experiment():
+        exp = TrainingExperiment()
+        configure(
+            exp,
+            {
+                "loader.dataset": "SyntheticMnist",
+                "loader.dataset.num_train_examples": 128,
+                "loader.dataset.num_validation_examples": 0,
+                "loader.preprocessing": "ImageClassificationPreprocessing",
+                "loader.preprocessing.height": 28,
+                "loader.preprocessing.width": 28,
+                "loader.preprocessing.channels": 1,
+                "loader.host_index": 0,
+                "loader.host_count": 1,
+                "model": "Mlp",
+                "model.hidden_units": (32,),
+                "batch_size": 32,
+                "epochs": 1,
+                "validate": False,
+                "verbose": False,
+                "checkpointer.directory": os.path.join(tmp, "ckpt"),
+                "checkpointer.synchronous": True,
+                "checkpointer.save_every_epochs": 0,
+            },
+            name="bench_recovery",
+        )
+        return exp
+
+    try:
+        return measure_recovery_restore_ms(make_experiment, kill_at_step=2)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def measure_shed_overload(env=None):
+    """``ZK_BENCH_SHED=1`` leg: drive the async MicroBatcher into
+    deliberate overload (submits as fast as Python can issue them
+    against a bounded ``shed_above_rows`` queue) and report the shed
+    rate plus served-request latency percentiles — the load-shedding
+    posture under pressure, through the REAL serving path (engine
+    dispatch + worker thread + metrics). Knobs:
+    ``ZK_BENCH_SHED_REQUESTS`` (default 400), ``ZK_BENCH_SHED_ROWS``
+    (queue threshold, default 64)."""
+    import numpy as np
+
+    from zookeeper_tpu.core import configure
+    from zookeeper_tpu.models.simple import Mlp
+    from zookeeper_tpu.serving import (
+        InferenceEngine,
+        MicroBatcher,
+        RejectedError,
+        ServingMetrics,
+    )
+
+    env = os.environ if env is None else env
+    n_requests = int(env.get("ZK_BENCH_SHED_REQUESTS", "400"))
+    shed_rows = int(env.get("ZK_BENCH_SHED_ROWS", "64"))
+
+    model = Mlp()
+    configure(model, {"hidden_units": (64,)}, name="shed_model")
+    module = model.build((32,), 10)
+    params, model_state = model.initialize(module, (32,))
+    engine = InferenceEngine()
+    configure(engine, {"batch_buckets": (8, 32)}, name="shed_engine")
+    engine.bind(module.apply, params, model_state, (32,))
+    engine.warmup()
+    metrics = ServingMetrics()
+    configure(metrics, {}, name="shed_metrics")
+    batcher = MicroBatcher()
+    configure(
+        batcher,
+        {
+            "synchronous": False,
+            "max_delay_ms": 0.5,
+            "shed_above_rows": shed_rows,
+        },
+        name="shed_batcher",
+    )
+    batcher.bind(engine, metrics=metrics)
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(8, 32)).astype(np.float32)
+    handles, shed = [], 0
+    try:
+        for _ in range(n_requests):
+            try:
+                handles.append(batcher.submit(x))
+            except RejectedError:
+                shed += 1
+        for h in handles:
+            h.result(timeout=120)
+    finally:
+        batcher.close()
+    snap = metrics.snapshot()
+    return {
+        "shed_requests": n_requests,
+        "shed_queue_rows": shed_rows,
+        "shed_rate": round(shed / max(1, n_requests), 4),
+        "shed_p50_ms": round(snap.get("latency_p50_ms", 0.0), 3),
+        "shed_p99_ms": round(snap.get("latency_p99_ms", 0.0), 3),
+    }
+
+
 # The LM perf leg's pinned workload: the configuration behind
 # BASELINE.md's 187k tokens/s claim (TransformerLM 4L/d512/h8, flash
 # attention, s=8192, b=4, vocab 1024, bf16) — pinned so the number is
@@ -1098,6 +1216,35 @@ def main():
         )
         host_metrics = None
 
+    # Recovery leg (always-on, seconds): supervisor-restart ->
+    # first-post-resume-step latency through the real kill/save/restore
+    # path (docs/DESIGN.md §10 recovery-time budget).
+    recovery_metrics = None
+    try:
+        recovery_metrics = measure_recovery_leg()
+    except Exception as e:  # never lose the primary metric
+        print(
+            f"recovery leg failed ({e}); omitting recovery_*",
+            file=sys.stderr,
+            flush=True,
+        )
+        recovery_metrics = None
+
+    # Load-shedding leg (env-gated: spins a worker thread + a few
+    # hundred dispatches): shed rate + latency percentiles under
+    # deliberate overload through the MicroBatcher.
+    shed_metrics = None
+    if _env_flag(os.environ, "ZK_BENCH_SHED"):
+        try:
+            shed_metrics = measure_shed_overload()
+        except Exception as e:  # never lose the primary metric
+            print(
+                f"shed leg failed ({e}); omitting shed_*",
+                file=sys.stderr,
+                flush=True,
+            )
+            shed_metrics = None
+
     extras = {
         "model": model_name,
         "batch_size": batch_size,
@@ -1111,6 +1258,10 @@ def main():
         extras.update(lm_metrics)
     if host_metrics is not None:
         extras.update(host_metrics)
+    if recovery_metrics is not None:
+        extras.update(recovery_metrics)
+    if shed_metrics is not None:
+        extras.update(shed_metrics)
     if loop_time is not None:
         extras["unroll"] = unroll
         extras["loop_time_ms"] = round(loop_time * 1e3, 2)

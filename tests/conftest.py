@@ -41,3 +41,11 @@ def pytest_configure(config):
         "markers",
         "serving: dynamic-batching inference subsystem tests",
     )
+    # Deterministic fault-injection / recovery tests (select with
+    # `-m chaos` — the CI chaos step runs exactly this subset on CPU).
+    # Fast single-fault legs run in tier 1; multi-restart soaks
+    # additionally carry `slow`.
+    config.addinivalue_line(
+        "markers",
+        "chaos: deterministic fault-injection and recovery tests",
+    )

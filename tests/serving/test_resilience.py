@@ -1,0 +1,322 @@
+"""Serving resilience: deadlines, load shedding, worker-death cleanup +
+restart, and drain-or-fail close — every leg driven deterministically
+(sync-mode tests are clock-free; async tests inject the crash via
+FaultPlan and assert on completion events, not timing)."""
+
+import time
+
+import numpy as np
+import pytest
+
+from zookeeper_tpu.core import configure
+from zookeeper_tpu.resilience import FaultPlan, faults
+from zookeeper_tpu.serving import (
+    DeadlineExpiredError,
+    InferenceEngine,
+    MicroBatcher,
+    RejectedError,
+    ServingMetrics,
+    WorkerCrashedError,
+)
+
+pytestmark = [pytest.mark.serving, pytest.mark.chaos]
+
+FEATURES = 6
+CLASSES = 4
+
+
+@pytest.fixture(scope="module")
+def engine():
+    from zookeeper_tpu.models.simple import Mlp
+
+    model = Mlp()
+    configure(model, {"hidden_units": (16,)}, name="model")
+    module = model.build((FEATURES,), CLASSES)
+    params, model_state = model.initialize(module, (FEATURES,))
+    eng = InferenceEngine()
+    configure(eng, {"batch_buckets": (1, 4, 8)}, name="engine")
+    eng.bind(module.apply, params, model_state, (FEATURES,))
+    eng.warmup()
+    return eng
+
+
+def make_batcher(engine, **conf):
+    m = ServingMetrics()
+    configure(m, {}, name="metrics")
+    b = MicroBatcher()
+    configure(b, dict(conf), name="batcher")
+    b.bind(engine, metrics=m)
+    return b, m
+
+
+def req(rng, n=2):
+    return rng.normal(size=(n, FEATURES)).astype(np.float32)
+
+
+def reference(engine, x):
+    step = engine.max_batch
+    return np.concatenate(
+        [
+            np.asarray(engine.infer(x[i : i + step]))
+            for i in range(0, x.shape[0], step)
+        ]
+    )
+
+
+# -- load shedding --------------------------------------------------------
+
+
+def test_shed_rejects_over_threshold_sync(engine):
+    rng = np.random.default_rng(0)
+    batcher, metrics = make_batcher(engine, shed_above_rows=4)
+    kept = batcher.submit(req(rng, 3))
+    with pytest.raises(RejectedError, match="shed"):
+        batcher.submit(req(rng, 3))
+    # The shed submit was never enqueued; the admitted one still serves.
+    assert batcher.queue_rows == 3
+    batcher.flush()
+    assert kept.result().shape == (3, CLASSES)
+    assert metrics.totals["rejected"] == 1
+    assert metrics.totals["requests"] == 1
+
+
+def test_shed_always_admits_into_empty_queue(engine):
+    """An oversized single request must stay servable: shedding never
+    rejects into an empty queue."""
+    rng = np.random.default_rng(1)
+    batcher, metrics = make_batcher(engine, shed_above_rows=4)
+    h = batcher.submit(req(rng, 11))  # > threshold AND > max bucket
+    batcher.flush()
+    assert h.result().shape == (11, CLASSES)
+    assert metrics.totals["rejected"] == 0
+
+
+def test_shed_async_rejects_without_blocking(engine):
+    rng = np.random.default_rng(2)
+    batcher, metrics = make_batcher(
+        engine, synchronous=False, shed_above_rows=4, max_delay_ms=60000.0
+    )
+    try:
+        kept = batcher.submit(req(rng, 3))
+        t0 = time.perf_counter()
+        with pytest.raises(RejectedError):
+            batcher.submit(req(rng, 3))
+        assert time.perf_counter() - t0 < 1.0  # shed, not backpressured
+        assert metrics.totals["rejected"] == 1
+        batcher.flush()
+        assert kept.result(timeout=30).shape == (3, CLASSES)
+    finally:
+        batcher.close()
+
+
+def test_shed_validates_config(engine):
+    b = MicroBatcher()
+    configure(b, {"shed_above_rows": -1}, name="b")
+    with pytest.raises(ValueError, match="shed_above_rows"):
+        b.bind(engine)
+
+
+# -- deadlines ------------------------------------------------------------
+
+
+def test_deadline_expired_request_never_served_sync(engine):
+    """deadline_ms=0 is expiry-by-construction (clock-free determinism):
+    the request fails at dispatch planning, neighbors still serve."""
+    rng = np.random.default_rng(3)
+    batcher, metrics = make_batcher(engine)
+    doomed = batcher.submit(req(rng, 2), deadline_ms=0)
+    x_alive = req(rng, 2)
+    alive = batcher.submit(x_alive)
+    batcher.flush()
+    with pytest.raises(DeadlineExpiredError):
+        doomed.result()
+    assert np.array_equal(alive.result(), reference(engine, x_alive))
+    assert metrics.totals["deadline_expired"] == 1
+    assert metrics.totals["requests"] == 1  # only the served one counts
+
+
+def test_default_deadline_field_applies(engine):
+    rng = np.random.default_rng(4)
+    batcher, metrics = make_batcher(engine, default_deadline_ms=0.0)
+    # Field value 0 = disabled: requests serve normally.
+    h = batcher.submit(req(rng, 2))
+    batcher.flush()
+    assert h.result().shape == (2, CLASSES)
+
+    batcher2, metrics2 = make_batcher(engine, default_deadline_ms=0.001)
+    doomed = batcher2.submit(req(rng, 2))
+    time.sleep(0.002)  # let the (tiny) default deadline lapse
+    batcher2.flush()
+    with pytest.raises(DeadlineExpiredError):
+        doomed.result()
+    assert metrics2.totals["deadline_expired"] == 1
+
+
+def test_result_never_blocks_past_deadline_async(engine):
+    """The acceptance pin: a stalled worker (coalescing window held open
+    for 60s) cannot make result() wait past the request deadline."""
+    rng = np.random.default_rng(5)
+    batcher, metrics = make_batcher(
+        engine, synchronous=False, max_delay_ms=60000.0
+    )
+    try:
+        t0 = time.perf_counter()
+        h = batcher.submit(req(rng, 2), deadline_ms=50)
+        with pytest.raises(DeadlineExpiredError):
+            h.result()  # timeout=None: bounded by the deadline alone
+        assert time.perf_counter() - t0 < 10.0
+        assert metrics.totals["deadline_expired"] == 1
+    finally:
+        batcher.close()
+
+
+def test_deadline_with_explicit_timeout_uses_sooner(engine):
+    rng = np.random.default_rng(6)
+    batcher, _ = make_batcher(
+        engine, synchronous=False, max_delay_ms=60000.0
+    )
+    try:
+        h = batcher.submit(req(rng, 2), deadline_ms=50)
+        with pytest.raises(DeadlineExpiredError):
+            h.result(timeout=30)  # deadline (50ms) < timeout (30s)
+    finally:
+        batcher.close()
+
+
+def test_negative_deadline_rejected(engine):
+    batcher, _ = make_batcher(engine)
+    with pytest.raises(ValueError, match="deadline_ms"):
+        batcher.submit(np.zeros((1, FEATURES), np.float32), deadline_ms=-1)
+
+
+# -- worker death ---------------------------------------------------------
+
+
+def test_worker_crash_fails_pending_and_restarts(engine):
+    """The PendingResult-hang fix + restart leg: an injected worker
+    crash fails every queued request promptly (result(timeout=None)
+    raises instead of hanging forever), counts a restart, and the next
+    submit serves on a fresh worker."""
+    rng = np.random.default_rng(7)
+    batcher, metrics = make_batcher(
+        engine, synchronous=False, max_delay_ms=1.0
+    )
+    try:
+        with faults.injected(FaultPlan(serving_worker_crash=1)):
+            x = req(rng, 2)
+            h = batcher.submit(x)
+            # Wait on COMPLETION (event), not timing: the crash handler
+            # must have failed the request.
+            for _ in range(1000):
+                if h.done:
+                    break
+                time.sleep(0.005)
+            assert h.done
+            with pytest.raises(WorkerCrashedError):
+                h.result()  # timeout=None — hung forever before the fix
+            assert metrics.totals["worker_restarts"] == 1
+            # Fresh worker serves the retry bit-identically.
+            x2 = req(rng, 3)
+            h2 = batcher.submit(x2)
+            assert np.array_equal(
+                h2.result(timeout=30), reference(engine, x2)
+            )
+            assert metrics.totals["worker_restarts"] == 1  # no re-crash
+    finally:
+        batcher.close()
+
+
+def test_worker_crash_fails_many_queued_requests(engine):
+    """Deterministic many-queued crash: the worker is held un-started
+    (a stand-in thread object) while 5 requests queue, then the real
+    worker starts, crashes on its first iteration, and ALL 5 fail."""
+    import types
+
+    rng = np.random.default_rng(8)
+    batcher, metrics = make_batcher(
+        engine, synchronous=False, max_delay_ms=1.0
+    )
+    try:
+        with faults.injected(FaultPlan(serving_worker_crash=1)):
+            object.__setattr__(
+                batcher,
+                "_worker",
+                types.SimpleNamespace(is_alive=lambda: True),
+            )
+            handles = [batcher.submit(req(rng, 1)) for _ in range(5)]
+            assert batcher.queue_rows == 5  # nothing dispatched yet
+            object.__setattr__(batcher, "_worker", None)
+            batcher._ensure_worker()  # real worker: crashes immediately
+            for h in handles:
+                with pytest.raises(WorkerCrashedError):
+                    h.result(timeout=30)
+        assert metrics.totals["worker_restarts"] == 1
+        assert batcher.queue_rows == 0
+    finally:
+        batcher.close()
+
+
+def test_engine_error_does_not_kill_worker(engine):
+    """An engine failure is a per-request error, not a worker death:
+    the SAME worker keeps serving (no restart counted)."""
+    rng = np.random.default_rng(9)
+    batcher, metrics = make_batcher(
+        engine, synchronous=False, max_delay_ms=1.0
+    )
+    try:
+        bad = batcher.submit(np.zeros((2, FEATURES + 1), np.float32))
+        with pytest.raises(Exception):
+            bad.result(timeout=30)
+        x = req(rng, 2)
+        good = batcher.submit(x)
+        assert np.array_equal(
+            good.result(timeout=30), reference(engine, x)
+        )
+        assert metrics.totals["worker_restarts"] == 0
+    finally:
+        batcher.close()
+
+
+# -- close: drain or fail -------------------------------------------------
+
+
+def test_close_without_drain_fails_pending(engine):
+    rng = np.random.default_rng(10)
+    batcher, _ = make_batcher(engine)
+    h = batcher.submit(req(rng, 2))
+    batcher.close()
+    with pytest.raises(RuntimeError, match="closed with requests pending"):
+        h.result()
+
+
+def test_close_drain_serves_pending_sync_and_async(engine):
+    rng = np.random.default_rng(11)
+    for conf in ({}, {"synchronous": False, "max_delay_ms": 1.0}):
+        batcher, _ = make_batcher(engine, **conf)
+        x = req(rng, 3)
+        h = batcher.submit(x)
+        batcher.close(drain=True)
+        assert np.array_equal(h.result(timeout=30), reference(engine, x))
+
+
+def test_close_idempotent_and_unbound_safe(engine):
+    MicroBatcher().close()  # unbound: no-op
+    batcher, _ = make_batcher(engine)
+    batcher.close()
+    batcher.close(drain=True)
+
+
+# -- metrics surface ------------------------------------------------------
+
+
+def test_resilience_counters_in_snapshot(engine):
+    rng = np.random.default_rng(12)
+    batcher, metrics = make_batcher(engine, shed_above_rows=2)
+    batcher.submit(req(rng, 2), deadline_ms=0)
+    with pytest.raises(RejectedError):
+        batcher.submit(req(rng, 2))
+    batcher.flush()
+    snap = metrics.snapshot()
+    assert snap["rejected"] == 1.0
+    assert snap["deadline_expired"] == 1.0
+    assert snap["worker_restarts"] == 0.0

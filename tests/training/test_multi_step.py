@@ -330,6 +330,57 @@ def test_unroll_conv_forward_exact_backward_within_ulp_drift():
         )
 
 
+@pytest.mark.chaos
+def test_unroll_kill_midepoch_recovery_bit_exact(tmp_path):
+    """The resilience acceptance pin for the fused loop: an injected
+    kill (FaultPlan.kill_at_step) mid-epoch under unroll>1 exits with
+    Preempted at the next SLAB boundary after one synchronous save, and
+    run_with_recovery resumes to a final state — params, opt_state, AND
+    per-epoch metrics — bit-identical to an uninterrupted eager run."""
+    from zookeeper_tpu.resilience import (
+        FaultPlan,
+        Preempted,
+        faults,
+        run_with_recovery,
+    )
+
+    ref = make_experiment()  # uninterrupted eager reference, 2 epochs
+    h_ref = ref.run()
+
+    ckpt = {
+        "checkpointer.directory": str(tmp_path / "ckpt"),
+        "checkpointer.synchronous": True,
+        "checkpointer.save_every_epochs": 0,
+        "checkpointer.save_every_steps": 0,  # ONLY the preemption save
+    }
+    exp = make_experiment({"unroll": 3, **ckpt})
+    # Step 5 is mid-epoch (spe=8) and mid-slab for unroll=3: the kill
+    # must quantize to the slab boundary at step 6, like step-cadence
+    # checkpoints do.
+    with faults.injected(FaultPlan(kill_at_step=5)) as plan:
+        result = run_with_recovery(exp, backoff_s=0.0, sleep=lambda s: None)
+    assert result.restarts == 1
+    assert isinstance(result.causes[0], Preempted)
+    assert result.causes[0].step == 6 and result.causes[0].saved
+    assert result.restore_ms and result.restore_ms[0] > 0
+
+    assert_states_equal(ref.final_state.params, exp.final_state.params)
+    assert_states_equal(
+        ref.final_state.opt_state, exp.final_state.opt_state
+    )
+    assert int(np.asarray(exp.final_state.step)) == int(
+        np.asarray(ref.final_state.step)
+    )
+    # Epoch 1 (fully post-recovery) metrics match the reference exactly;
+    # epoch 0's aggregates are split across the kill (partial by design).
+    h_res = result.history
+    for k, v in h_ref["train"][1].items():
+        if k == "examples_per_sec":
+            continue
+        assert v == h_res["train"][1][k], k
+    exp.checkpointer.close()
+
+
 def test_unroll_with_ema_and_flip_free_extras_bit_exact():
     """Optional step extras (EMA, label smoothing) ride the scan
     unchanged."""

@@ -1,0 +1,55 @@
+"""Resilience subsystem: failure is the common case.
+
+A production-scale system (ROADMAP north star) runs on preemptible
+pools, flaky disks, and unattended numerics; this package makes every
+one of those a *recoverable, tested* event instead of a lost run:
+
+- :class:`PreemptionGuard` (``guard.py``): SIGTERM/SIGINT become a flag
+  checked at step/slab boundaries; the training loop saves once,
+  synchronously, and exits with the distinguished :class:`Preempted`
+  status.
+- :func:`run_with_recovery` (``supervisor.py``): budgeted, backoff'd
+  restarts of an experiment; resumed runs restore from the checkpointer
+  and replay the ``(seed, epoch)``-deterministic pipeline for EXACT
+  mid-epoch resume. :class:`RecoveryResult` reports restarts and
+  restore latency.
+- :class:`FaultPlan` (``faults.py``): deterministic, process-local
+  fault injection (kill at step N, corrupt a checkpoint, fail a save,
+  NaN a step, crash the serving worker) driving the chaos test suite —
+  every recovery leg is walked bit-exactly in tier-1, not just claimed.
+
+Crash-consistent restore (fallback to the newest VALID retained step)
+and retrying saves live in ``training.checkpoint.Checkpointer``;
+non-finite-loss policies in ``training.step.make_train_step``
+(``nan_policy``); serving deadlines / load-shedding / worker-restart in
+``serving.batcher.MicroBatcher``. docs/DESIGN.md §10 is the failure
+model tying them together.
+"""
+
+from zookeeper_tpu.resilience.faults import (
+    FaultPlan,
+    InjectedFault,
+    NonFiniteLossError,
+    Preempted,
+    corrupt_checkpoint_dir,
+)
+from zookeeper_tpu.resilience.guard import PreemptionGuard
+from zookeeper_tpu.resilience.supervisor import (
+    RECOVERABLE,
+    RecoveryResult,
+    measure_recovery_restore_ms,
+    run_with_recovery,
+)
+
+__all__ = [
+    "FaultPlan",
+    "InjectedFault",
+    "NonFiniteLossError",
+    "Preempted",
+    "PreemptionGuard",
+    "RECOVERABLE",
+    "RecoveryResult",
+    "corrupt_checkpoint_dir",
+    "measure_recovery_restore_ms",
+    "run_with_recovery",
+]

@@ -1,0 +1,218 @@
+"""Deterministic, process-local fault injection.
+
+Every recovery leg in this repo is *exercised*, not just claimed: a test
+installs a :class:`FaultPlan` naming exactly which fault fires where
+(``kill_at_step=N``, ``corrupt_checkpoint_step=M``, ``fail_save_io=1``,
+``nan_at_step=K``, ``serving_worker_crash=1``), runs the real system,
+and asserts the recovery contract — e.g. that post-recovery training
+state is bit-identical to an uninterrupted run's. The production code
+paths carry the (cheap, plan-gated) injection hooks themselves, so the
+code that recovers in tests is byte-for-byte the code that recovers in
+production; with no plan installed every hook is a single ``None``
+check.
+
+Determinism rules:
+
+- Faults key on *logical* coordinates (the global step counter, the
+  N-th save attempt, the N-th worker dispatch), never on wall clock —
+  two runs of the same plan fire the same faults at the same points.
+- One-shot faults (``fail_save_io``, ``serving_worker_crash``, and the
+  kill/corrupt triggers) consume themselves, so the *retry* of the
+  faulted operation succeeds and the recovery path actually completes.
+- The plan is process-local (a module global guarded for thread-safe
+  decrement): installing one affects only this process, and ``clear()``
+  (or the ``injected()`` context manager) restores a fault-free world.
+
+NaN injection is the one fault that must live *inside* the compiled
+step: ``make_train_step`` reads the active plan at trace time and scales
+the loss by a ``step == nan_at_step`` selected NaN, so the fault fires
+on-device inside a fused ``lax.scan`` slab exactly like a real numeric
+blow-up would — no host sync, no recompile of the recovery run.
+"""
+
+import contextlib
+import os
+import threading
+from dataclasses import dataclass, field
+from typing import Iterator, Optional
+
+
+class Preempted(Exception):
+    """Training exited at a safe boundary after a preemption request
+    (SIGTERM/SIGINT or an injected kill). State as of ``step`` has been
+    checkpointed when a checkpoint directory was configured; a
+    supervisor (``run_with_recovery``) resumes from it — except for
+    SIGINT-caused exits (``signum``), which the supervisor treats as
+    the OPERATOR stopping the job, not the pool preempting it."""
+
+    def __init__(self, step: int, saved: bool, signum: Optional[int] = None):
+        self.step = int(step)
+        self.saved = bool(saved)
+        #: The signal that caused the exit (None = injected/programmatic).
+        self.signum = signum
+        super().__init__(
+            f"preempted at step {step} "
+            f"({'checkpoint saved' if saved else 'no checkpoint configured'}"
+            + (f", signal {signum}" if signum is not None else "")
+            + ")"
+        )
+
+
+class NonFiniteLossError(RuntimeError):
+    """``nan_policy="halt"``: a non-finite training loss reached a host
+    readback boundary. The in-memory state may have skipped the bad
+    step(s) but the run refuses to continue; a supervisor restores from
+    the last checkpoint."""
+
+    def __init__(self, step: int, skipped: int):
+        self.step = int(step)
+        self.skipped = int(skipped)
+        super().__init__(
+            f"non-finite training loss detected by step {step} "
+            f"({skipped} step(s) skipped); halting per nan_policy='halt'"
+        )
+
+
+class InjectedFault(OSError):
+    """The error raised by plan-driven IO faults — an ``OSError``
+    subclass so production retry paths treat it exactly like the disk
+    failures it stands in for."""
+
+
+@dataclass
+class FaultPlan:
+    """One deterministic schedule of faults. All fields default to
+    "never fire"; tests set exactly the legs they walk.
+
+    - ``kill_at_step``: request preemption at the first safe boundary
+      whose global step counter is ``>= kill_at_step`` (one-shot — the
+      recovery run is not re-killed).
+    - ``corrupt_checkpoint_step``: after the save of this step lands,
+      scribble its on-disk files so restore sees a torn checkpoint.
+    - ``fail_save_io``: the next N checkpoint save attempts raise
+      :class:`InjectedFault` (``fail_save_io=1`` == "once": the retry
+      succeeds).
+    - ``nan_at_step``: the train step whose global step counter equals
+      this value computes a NaN loss (traced into the compiled step).
+    - ``serving_worker_crash``: the next N MicroBatcher worker dispatch
+      iterations crash the worker thread (exercises worker-death
+      cleanup + restart).
+    """
+
+    kill_at_step: Optional[int] = None
+    corrupt_checkpoint_step: Optional[int] = None
+    fail_save_io: int = 0
+    nan_at_step: Optional[int] = None
+    serving_worker_crash: int = 0
+
+    _lock: threading.Lock = field(
+        default_factory=threading.Lock, repr=False, compare=False
+    )
+    _killed: bool = field(default=False, repr=False, compare=False)
+    _corrupted: bool = field(default=False, repr=False, compare=False)
+
+    # -- trigger points (called by the production hooks) -----------------
+
+    def kill_due(self, step: int) -> bool:
+        """One-shot: True at the first query with ``step >=
+        kill_at_step``. Queried at safe boundaries (slab/step ends), so
+        with ``unroll > 1`` the kill lands at the end of the slab
+        containing the step — the same quantization step-cadence
+        checkpoints already have."""
+        if self.kill_at_step is None:
+            return False
+        with self._lock:
+            if not self._killed and int(step) >= self.kill_at_step:
+                self._killed = True
+                return True
+        return False
+
+    def take_save_io_failure(self) -> bool:
+        """Consume one injected save-IO failure (False when exhausted)."""
+        with self._lock:
+            if self.fail_save_io > 0:
+                self.fail_save_io -= 1
+                return True
+        return False
+
+    def take_worker_crash(self) -> bool:
+        """Consume one injected serving-worker crash."""
+        with self._lock:
+            if self.serving_worker_crash > 0:
+                self.serving_worker_crash -= 1
+                return True
+        return False
+
+    def corrupt_due(self, step: int) -> bool:
+        """One-shot: True when ``step``'s just-landed save should be
+        corrupted on disk."""
+        if self.corrupt_checkpoint_step is None:
+            return False
+        with self._lock:
+            if not self._corrupted and int(step) == self.corrupt_checkpoint_step:
+                self._corrupted = True
+                return True
+        return False
+
+
+def corrupt_checkpoint_dir(path: str) -> int:
+    """Deterministically tear a checkpoint on disk: every regular file
+    under ``path`` is truncated to half and its head overwritten with a
+    fixed garbage pattern — the torn-write/partial-flush shape a real
+    crash leaves, reproducible bit-for-bit. Returns the number of files
+    damaged (0 means ``path`` held nothing to corrupt — callers should
+    treat that as a test-setup error, not a survived fault)."""
+    damaged = 0
+    pattern = b"\xde\xad\xbe\xef" * 16
+    for root, _, files in os.walk(path):
+        for name in files:
+            fpath = os.path.join(root, name)
+            try:
+                size = os.path.getsize(fpath)
+            except OSError:
+                continue
+            with open(fpath, "r+b" if size else "wb") as f:
+                f.truncate(size // 2)
+                f.seek(0)
+                f.write(pattern[: max(1, min(len(pattern), size // 2 or 1))])
+            damaged += 1
+    return damaged
+
+
+# -- process-local activation -------------------------------------------
+
+_ACTIVE: Optional[FaultPlan] = None
+
+
+def install(plan: FaultPlan) -> FaultPlan:
+    """Make ``plan`` the process's active fault plan (replacing any
+    prior one). Returns the plan for chaining."""
+    global _ACTIVE
+    _ACTIVE = plan
+    return plan
+
+
+def clear() -> None:
+    """Deactivate fault injection (the default, fault-free world)."""
+    global _ACTIVE
+    _ACTIVE = None
+
+
+def active() -> Optional[FaultPlan]:
+    """The active plan, or None. Production hooks call this and do
+    nothing when it is None — the entire overhead of an uninjected
+    process is this one attribute read."""
+    return _ACTIVE
+
+
+@contextlib.contextmanager
+def injected(plan: FaultPlan) -> Iterator[FaultPlan]:
+    """``with injected(FaultPlan(...)) as plan:`` — scoped activation
+    that always restores the previous plan (tests can nest)."""
+    global _ACTIVE
+    prev = _ACTIVE
+    _ACTIVE = plan
+    try:
+        yield plan
+    finally:
+        _ACTIVE = prev

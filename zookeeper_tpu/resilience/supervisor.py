@@ -1,0 +1,189 @@
+"""The restart supervisor: keep training alive across recoverable exits.
+
+``run_with_recovery(experiment)`` is the production entry point for a
+preemptible pool: it runs ``experiment.run()``, and when the run exits
+with a *recoverable* status — :class:`Preempted` (SIGTERM / injected
+kill, state already checkpointed) or :class:`NonFiniteLossError`
+(``nan_policy="halt"``) — it re-runs the experiment, whose own
+``Checkpointer.restore_state`` picks up at the last valid checkpoint
+(exact mid-epoch resume: step counter + the ``(seed, epoch)``-fixed
+pipeline replay). Restarts are budgeted (``max_restarts``) with
+exponential backoff so a permanently-broken run fails instead of
+spinning, and every restart's *restore latency* (supervisor restart →
+first post-resume train step) is measured — the recovery-time number
+the failure model in docs/DESIGN.md §10 budgets against.
+
+Unrecoverable exceptions (config errors, structure mismatches, bugs)
+propagate immediately: retrying those would replay the same crash
+``max_restarts`` times and bury the real traceback.
+"""
+
+import logging
+import signal as _signal
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Tuple, Type
+
+from zookeeper_tpu.resilience.faults import NonFiniteLossError, Preempted
+
+logger = logging.getLogger(__name__)
+
+#: Exit statuses a restart can actually fix: the state to resume from is
+#: on disk and the cause is transient (preemption) or policy-halted
+#: (non-finite loss whose bad step a checkpoint restore discards).
+#: A ``Preempted`` carrying SIGINT is excluded at runtime — Ctrl-C is
+#: the operator stopping the job, and restarting it would make the run
+#: effectively uninterruptible.
+RECOVERABLE = (Preempted, NonFiniteLossError)
+
+
+@dataclass
+class RecoveryResult:
+    """What the supervisor observed across the whole supervised run."""
+
+    #: The final ``experiment.run()`` return value (training history).
+    history: Any
+    #: Restarts actually performed (0 = the first run completed).
+    restarts: int
+    #: The recoverable exceptions that triggered each restart, in order.
+    causes: List[BaseException] = field(default_factory=list)
+    #: Restore latency per RESUMED run that reached its first train
+    #: step: supervisor re-entry -> first post-resume step, ms (the
+    #: final successful attempt and any restarted attempt that trained
+    #: before being re-preempted). Shorter than ``restarts`` when a
+    #: resumed run died before its first step; empty when the
+    #: experiment doesn't report first-step timestamps.
+    restore_ms: List[float] = field(default_factory=list)
+
+
+def run_with_recovery(
+    experiment: Any,
+    *,
+    max_restarts: int = 3,
+    backoff_s: float = 1.0,
+    backoff_factor: float = 2.0,
+    max_backoff_s: float = 60.0,
+    recover_on: Tuple[Type[BaseException], ...] = RECOVERABLE,
+    sleep: Callable[[float], None] = time.sleep,
+) -> RecoveryResult:
+    """Run ``experiment.run()`` under a restart budget.
+
+    ``max_restarts`` bounds the number of RE-runs (so the experiment
+    executes at most ``max_restarts + 1`` times); backoff between
+    restarts is ``backoff_s * backoff_factor**i`` capped at
+    ``max_backoff_s`` (pass ``sleep=lambda s: None`` in tests). When the
+    budget is exhausted the last recoverable exception propagates —
+    callers distinguish "never recovered" from a hard failure by type.
+
+    The experiment must be restartable-by-rerun: its ``run()`` restores
+    from its checkpointer when a checkpoint exists (exactly what
+    ``TrainingExperiment`` does). The same experiment OBJECT is reused
+    so its configured component tree (checkpoint directory above all)
+    carries over.
+    """
+    if max_restarts < 0:
+        raise ValueError(f"max_restarts={max_restarts} must be >= 0.")
+    if backoff_s < 0 or backoff_factor < 1.0:
+        raise ValueError(
+            f"backoff_s={backoff_s} must be >= 0 and "
+            f"backoff_factor={backoff_factor} >= 1."
+        )
+    causes: List[BaseException] = []
+    restore_ms: List[float] = []
+    for attempt in range(max_restarts + 1):
+        t_start = time.perf_counter()
+        try:
+            history = experiment.run()
+        except recover_on as e:
+            if (
+                isinstance(e, Preempted)
+                and e.signum == _signal.SIGINT
+            ):
+                # Ctrl-C is the OPERATOR stopping the job: restarting
+                # would make the run effectively uninterruptible. The
+                # clean-save-and-exit already happened; just stop.
+                logger.warning(
+                    "SIGINT preemption (operator stop) — not restarting: %s",
+                    e,
+                )
+                raise
+            causes.append(e)
+            _record_restore_ms(experiment, attempt, t_start, restore_ms)
+            if attempt >= max_restarts:
+                logger.warning(
+                    "restart budget exhausted (%d restart(s)); last "
+                    "recoverable exit propagates: %s",
+                    max_restarts,
+                    e,
+                )
+                raise
+            delay = min(
+                max_backoff_s, backoff_s * (backoff_factor**attempt)
+            )
+            logger.warning(
+                "recoverable exit (%s); restart %d/%d after %.1fs backoff",
+                e,
+                attempt + 1,
+                max_restarts,
+                delay,
+            )
+            if delay > 0:
+                sleep(delay)
+            continue
+        _record_restore_ms(experiment, attempt, t_start, restore_ms)
+        return RecoveryResult(
+            history=history,
+            restarts=attempt,
+            causes=causes,
+            restore_ms=restore_ms,
+        )
+    raise AssertionError("unreachable")  # pragma: no cover
+
+
+def _record_restore_ms(
+    experiment: Any,
+    attempt: int,
+    t_start: float,
+    restore_ms: List[float],
+) -> None:
+    """Restore latency of one RESUMED attempt (restart -> first
+    post-resume step), read from the experiment's first-step timestamp
+    (``TrainingExperiment`` records one per run). Called for the final
+    successful attempt AND for restarted attempts that trained before
+    exiting recoverably again; attempt 0 is not a restart."""
+    if attempt == 0:
+        return  # no restart happened; nothing to attribute
+    t_first = getattr(experiment, "first_step_at", None)
+    if t_first is not None and t_first >= t_start:
+        restore_ms.append((t_first - t_start) * 1e3)
+
+
+def measure_recovery_restore_ms(
+    make_experiment: Callable[[], Any],
+    *,
+    kill_at_step: int = 2,
+    max_restarts: int = 1,
+) -> Dict[str, float]:
+    """Benchmark harness for the recovery path: run a (small) experiment
+    factory under an injected mid-run kill, resume it, and report the
+    measured restore latency. ``make_experiment()`` must return a fresh
+    experiment configured with a checkpoint directory; the SAME object
+    is killed and resumed (matching the in-process supervisor flow).
+    Returns ``{"recovery_restore_ms": ..., "recovery_restarts": ...}``.
+    """
+    from zookeeper_tpu.resilience import faults
+
+    exp = make_experiment()
+    with faults.injected(faults.FaultPlan(kill_at_step=kill_at_step)):
+        result = run_with_recovery(
+            exp, max_restarts=max_restarts, backoff_s=0.0, sleep=lambda s: None
+        )
+    if result.restarts < 1 or not result.restore_ms:
+        raise RuntimeError(
+            "recovery measurement never restarted (kill_at_step beyond "
+            "the run, or no checkpoint directory configured)"
+        )
+    return {
+        "recovery_restore_ms": round(result.restore_ms[-1], 2),
+        "recovery_restarts": float(result.restarts),
+    }

@@ -14,8 +14,12 @@ per-step cost — many requests ride one compiled program.
 - :class:`MicroBatcher` (``batcher.py``): coalesces concurrent
   ``submit()`` calls into the largest bucket that fills within
   ``max_delay_ms``, pads the remainder, slices per-request results back
-  out; oversized requests split, a full queue applies backpressure, and
-  a deterministic synchronous mode keeps tier-1 tests thread-free.
+  out; oversized requests split, a full queue applies backpressure (or
+  sheds with :class:`RejectedError` past ``shed_above_rows``), requests
+  carry deadlines (:class:`DeadlineExpiredError` — ``result()`` never
+  blocks past one), a dead async worker fails its requests cleanly
+  (:class:`WorkerCrashedError`) and restarts, and a deterministic
+  synchronous mode keeps tier-1 tests thread-free.
 - :class:`ServingMetrics` (``metrics.py``): request latency percentiles,
   queue depth, bucket-fill ratio, padding waste — emitted through the
   training ``MetricsWriter`` family.
@@ -24,15 +28,24 @@ per-step cost — many requests ride one compiled program.
   metrics into one CLI-drivable task tree.
 """
 
-from zookeeper_tpu.serving.batcher import MicroBatcher, PendingResult
+from zookeeper_tpu.serving.batcher import (
+    DeadlineExpiredError,
+    MicroBatcher,
+    PendingResult,
+    RejectedError,
+    WorkerCrashedError,
+)
 from zookeeper_tpu.serving.engine import InferenceEngine
 from zookeeper_tpu.serving.metrics import ServingMetrics
 from zookeeper_tpu.serving.service import ServingConfig
 
 __all__ = [
+    "DeadlineExpiredError",
     "InferenceEngine",
     "MicroBatcher",
     "PendingResult",
+    "RejectedError",
     "ServingConfig",
     "ServingMetrics",
+    "WorkerCrashedError",
 ]

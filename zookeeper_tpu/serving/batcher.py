@@ -7,7 +7,7 @@ requests (FIFO, row-granular) into the engine's largest bucket, padding
 only the final remainder, and slicing per-request results back out of
 the one readback.
 
-Degradation contract (all paths pinned in tests/serving/test_batcher.py):
+Degradation contract (all paths pinned in tests/serving/):
 
 - *Oversized* requests (more rows than the largest bucket) are split
   across consecutive dispatches and re-assembled — callers never see
@@ -15,6 +15,20 @@ Degradation contract (all paths pinned in tests/serving/test_batcher.py):
 - *Queue-full* applies backpressure instead of buffering toward OOM:
   synchronous mode drains the backlog inline; async mode blocks the
   submitter until the worker catches up.
+- *Overload shedding* (``shed_above_rows > 0``): instead of blocking
+  submitters, a submit that would push the queue past the threshold
+  raises :class:`RejectedError` immediately — the load-shed posture a
+  user-facing service wants (fail fast, let the client retry elsewhere)
+  vs the backpressure posture a batch pipeline wants.
+- *Deadlines*: a request may carry ``deadline_ms``; expired requests
+  are failed with :class:`DeadlineExpiredError` (never dispatched, and
+  ``result()`` NEVER blocks past the deadline — the serving-resilience
+  acceptance pin).
+- *Worker death*: if the async worker thread dies (bug, injected
+  crash), every queued and in-flight request is failed cleanly with
+  :class:`WorkerCrashedError` — no ``result()`` hangs — and the next
+  ``submit()`` starts a fresh worker (``ServingMetrics`` counts
+  ``worker_restarts``).
 - *Partial* micro-batches (queue drains below a bucket) pad up to the
   smallest covering bucket — never a fresh compile.
 
@@ -24,9 +38,10 @@ the batcher changes WHEN rows run, never WHAT they compute.
 
 Threading: ``synchronous=True`` (the default) is completely thread- and
 clock-free — requests queue until ``flush()`` (or ``result()``, which
-flushes on demand), so tier-1 CPU tests are deterministic. Async mode
-adds one worker thread that dispatches whenever the largest bucket
-fills or the oldest request has waited ``max_delay_ms``.
+flushes on demand), so tier-1 CPU tests are deterministic (deadline
+tests use ``deadline_ms=0``, which is expiry-by-construction, not
+timing). Async mode adds one worker thread that dispatches whenever the
+largest bucket fills or the oldest request has waited ``max_delay_ms``.
 """
 
 import threading
@@ -40,16 +55,41 @@ from zookeeper_tpu.core import Field, component
 Array = Any
 
 
+class RejectedError(RuntimeError):
+    """Load shedding: the queue is past ``shed_above_rows``; the request
+    was never enqueued. Clients should back off / retry elsewhere."""
+
+
+class DeadlineExpiredError(TimeoutError):
+    """The request's ``deadline_ms`` elapsed before its rows were
+    served; it has been failed (dropped from the queue if still
+    pending). A ``TimeoutError`` subclass so generic timeout handling
+    catches it."""
+
+
+class WorkerCrashedError(RuntimeError):
+    """The async worker thread died with this request queued or in
+    flight. The request was failed (not silently dropped); submitting
+    again runs on a freshly restarted worker."""
+
+
 class PendingResult:
     """Handle for one submitted request; ``result()`` yields the
     ``[n, ...]`` output rows in submission order."""
 
     __slots__ = (
         "_batcher", "_event", "_parts", "_rows", "_rows_done",
-        "_value", "_error", "_done", "_t_submit",
+        "_value", "_error", "_done", "_t_submit", "_deadline_at",
+        "_lock",
     )
 
-    def __init__(self, batcher: "MicroBatcher", rows: int, event) -> None:
+    def __init__(
+        self,
+        batcher: "MicroBatcher",
+        rows: int,
+        event,
+        deadline_at: Optional[float] = None,
+    ) -> None:
         self._batcher = batcher
         self._event = event  # None in synchronous mode
         self._parts: List[np.ndarray] = []
@@ -59,48 +99,102 @@ class PendingResult:
         self._error: Optional[BaseException] = None
         self._done = False
         self._t_submit = time.perf_counter()
+        self._deadline_at = deadline_at  # absolute perf_counter secs
+        # Completion can race between the worker (deliver), a crash
+        # handler (fail), and the caller's deadline expiry (fail):
+        # first transition wins, the rest are no-ops.
+        self._lock = threading.Lock()
 
     @property
     def done(self) -> bool:
         return self._done
 
+    def expired(self, now: Optional[float] = None) -> bool:
+        """Whether the deadline has passed (False when none was set)."""
+        if self._deadline_at is None:
+            return False
+        return (time.perf_counter() if now is None else now) >= self._deadline_at
+
     def _deliver(self, part: np.ndarray) -> None:
         """Called by the batcher with consecutive row slices (FIFO order
         guarantees they arrive in row order, including across the splits
-        of an oversized request)."""
-        self._parts.append(part)
-        self._rows_done += part.shape[0]
-        if self._rows_done >= self._rows:
-            self._value = (
-                self._parts[0]
-                if len(self._parts) == 1
-                else np.concatenate(self._parts)
-            )
-            self._parts = []
-            self._finish()
+        of an oversized request). A no-op once the request completed
+        (e.g. already failed on deadline expiry)."""
+        with self._lock:
+            if self._done:
+                return
+            self._parts.append(part)
+            self._rows_done += part.shape[0]
+            if self._rows_done >= self._rows:
+                self._value = (
+                    self._parts[0]
+                    if len(self._parts) == 1
+                    else np.concatenate(self._parts)
+                )
+                self._parts = []
+                self._finish()
 
-    def _fail(self, error: BaseException) -> None:
-        self._error = error
-        self._finish()
+    def _fail(self, error: BaseException) -> bool:
+        """Fail the request; returns True only for the thread that
+        actually performed the transition (completion is first-wins)."""
+        with self._lock:
+            if self._done:
+                return False
+            self._error = error
+            self._finish()
+            return True
 
     def _finish(self) -> None:
+        """Caller holds ``_lock``."""
         self._done = True
         latency_ms = (time.perf_counter() - self._t_submit) * 1e3
         self._batcher._record_done(self, latency_ms)
         if self._event is not None:
             self._event.set()
 
+    def _expire(self) -> None:
+        """Fail on deadline expiry (idempotent: concurrent expirers —
+        the worker's queue sweep and the caller's result() timeout —
+        count the metric exactly once, decided by the locked
+        transition)."""
+        if self._fail(
+            DeadlineExpiredError(
+                f"request deadline expired after "
+                f"{(time.perf_counter() - self._t_submit) * 1e3:.1f}ms "
+                "(queue wait exceeded deadline_ms)"
+            )
+        ):
+            self._batcher._record_deadline_expired()
+
     def result(self, timeout: Optional[float] = None) -> np.ndarray:
+        """Block for the rows (async mode) or flush-and-return (sync
+        mode). NEVER blocks past the request's deadline: on expiry the
+        request fails with :class:`DeadlineExpiredError` even if the
+        worker is stalled or dead."""
         if not self._done:
             if self._event is None:
                 # Deterministic synchronous mode: asking for a result IS
                 # the flush trigger — no threads, no clocks.
                 self._batcher.flush()
-            elif not self._event.wait(timeout):
-                raise TimeoutError(
-                    f"request not served within {timeout}s (worker "
-                    "stalled, or close() was called before flush())."
-                )
+                if not self._done and self.expired():
+                    self._expire()
+            else:
+                wait_s = timeout
+                if self._deadline_at is not None:
+                    remaining = self._deadline_at - time.perf_counter()
+                    wait_s = (
+                        remaining
+                        if timeout is None
+                        else min(timeout, remaining)
+                    )
+                if not self._event.wait(max(0.0, wait_s) if wait_s is not None else None):
+                    if self.expired():
+                        self._expire()
+                    else:
+                        raise TimeoutError(
+                            f"request not served within {timeout}s (worker "
+                            "stalled, or close() was called before flush())."
+                        )
         if self._error is not None:
             raise self._error
         return self._value
@@ -120,6 +214,18 @@ class MicroBatcher:
     #: queue past this drains the backlog (sync) or blocks (async)
     #: rather than buffering unboundedly toward OOM.
     max_queue_rows: int = Field(4096)
+    #: Load shedding threshold in ROWS (0 = off). When on, a submit that
+    #: would grow the queue past this raises :class:`RejectedError`
+    #: instead of blocking/buffering — overload fails fast (the
+    #: ``ServingMetrics.rejected`` counter tracks the shed rate).
+    #: Checked BEFORE backpressure; an empty queue always admits one
+    #: request (oversized requests stay servable).
+    shed_above_rows: int = Field(0)
+    #: Default per-request deadline in ms (0 = none). ``submit()``'s
+    #: ``deadline_ms`` overrides per request. Expired requests fail with
+    #: :class:`DeadlineExpiredError` — at dispatch planning (never
+    #: served late) and in ``result()`` (never blocks past it).
+    default_deadline_ms: float = Field(0.0)
     #: Thread- and clock-free deterministic mode (tier-1 default):
     #: requests queue until flush()/result().
     synchronous: bool = Field(True)
@@ -135,16 +241,24 @@ class MicroBatcher:
             raise ValueError(
                 f"max_delay_ms={self.max_delay_ms} must be >= 0."
             )
+        if self.shed_above_rows < 0 or self.default_deadline_ms < 0:
+            raise ValueError(
+                f"shed_above_rows={self.shed_above_rows} and "
+                f"default_deadline_ms={self.default_deadline_ms} must be "
+                ">= 0 (0 disables)."
+            )
         object.__setattr__(self, "_engine", engine)
         object.__setattr__(self, "_metrics", metrics)
-        # Queue of (request, lo, hi): row slice [lo, hi) of request still
-        # owed. Oversized/partially-taken requests stay at the head with
-        # lo advanced, so delivery is always in row order.
+        # Queue of (request, x, lo, hi): row slice [lo, hi) of request
+        # still owed. Oversized/partially-taken requests stay at the
+        # head with lo advanced, so delivery is always in row order.
         object.__setattr__(self, "_queue", [])
         object.__setattr__(self, "_queue_rows", 0)
         object.__setattr__(self, "_cv", threading.Condition())
         object.__setattr__(self, "_worker", None)
         object.__setattr__(self, "_inflight", False)
+        object.__setattr__(self, "_plan_inflight", None)
+        object.__setattr__(self, "_force_drain", False)
         object.__setattr__(self, "_stop", threading.Event())
         return self
 
@@ -159,17 +273,54 @@ class MicroBatcher:
         if self._metrics is not None and req._error is None:
             self._metrics.record_request(latency_ms, req._rows)
 
+    def _record_deadline_expired(self) -> None:
+        if self._metrics is not None:
+            self._metrics.record_deadline_expired()
+
     @property
     def queue_rows(self) -> int:
         return getattr(self, "_queue_rows", 0)
 
     # -- submission ------------------------------------------------------
 
-    def submit(self, x: Array) -> PendingResult:
+    def _deadline_at(self, deadline_ms: Optional[float]) -> Optional[float]:
+        if deadline_ms is None:
+            deadline_ms = self.default_deadline_ms or None
+        if deadline_ms is None:
+            return None
+        if deadline_ms < 0:
+            raise ValueError(f"deadline_ms={deadline_ms} must be >= 0.")
+        return time.perf_counter() + deadline_ms / 1e3
+
+    def _shed_check(self, n: int) -> None:
+        """Raise ``RejectedError`` when admitting ``n`` more rows would
+        pass the shed threshold (caller holds the lock in async mode)."""
+        if (
+            self.shed_above_rows > 0
+            and self._queue
+            and self._queue_rows + n > self.shed_above_rows
+        ):
+            if self._metrics is not None:
+                self._metrics.record_rejected()
+            raise RejectedError(
+                f"queue at {self._queue_rows} rows; admitting {n} more "
+                f"would exceed shed_above_rows={self.shed_above_rows} — "
+                "request shed (service overloaded, retry with backoff)."
+            )
+
+    def submit(
+        self, x: Array, *, deadline_ms: Optional[float] = None
+    ) -> PendingResult:
         """Enqueue one request ``[n, *input_shape]``; returns a
         :class:`PendingResult`. Never dispatches inline in async mode;
         in sync mode dispatch happens at flush()/result() (or right here
-        when backpressure triggers)."""
+        when backpressure triggers). ``deadline_ms`` bounds how long the
+        request may wait: ``None`` falls back to the component's
+        ``default_deadline_ms`` (whose 0 means "no deadline"), while an
+        EXPLICIT ``deadline_ms=0`` is already-expired — the
+        deterministic clock-free expiry the chaos tests use. Raises
+        :class:`RejectedError` without enqueueing when load shedding is
+        on and the queue is past the threshold."""
         self._require_bound()
         x = np.asarray(x)
         if x.ndim < 1 or x.shape[0] < 1:
@@ -177,18 +328,22 @@ class MicroBatcher:
                 f"request must have at least one row, got shape {x.shape}."
             )
         n = int(x.shape[0])
+        deadline_at = self._deadline_at(deadline_ms)
         if self.synchronous:
+            self._shed_check(n)
             if self._queue and self._queue_rows + n > self.max_queue_rows:
                 self.flush()  # backpressure: drain the backlog inline
-            req = PendingResult(self, n, event=None)
+            req = PendingResult(self, n, event=None, deadline_at=deadline_at)
             self._queue.append((req, x, 0, n))
             object.__setattr__(self, "_queue_rows", self._queue_rows + n)
             if self._metrics is not None:
                 self._metrics.record_queue_depth(self._queue_rows)
             return req
-        self._ensure_worker()
-        req = PendingResult(self, n, event=threading.Event())
+        req = PendingResult(
+            self, n, event=threading.Event(), deadline_at=deadline_at
+        )
         with self._cv:
+            self._shed_check(n)
             while (
                 self._queue
                 and self._queue_rows + n > self.max_queue_rows
@@ -199,16 +354,47 @@ class MicroBatcher:
             object.__setattr__(self, "_queue_rows", self._queue_rows + n)
             if self._metrics is not None:
                 self._metrics.record_queue_depth(self._queue_rows)
+            # Worker liveness is checked UNDER the lock, after the
+            # request is queued: _on_worker_crash also holds the lock,
+            # so either cleanup already ran (dead worker observed here,
+            # fresh one spawned) or it runs after us and fails THIS
+            # request cleanly — a request can never land in the queue
+            # with no worker and no failure (the hang this lock order
+            # exists to prevent). The fresh thread blocks on the lock
+            # until we release; no deadlock.
+            self._ensure_worker()
             self._cv.notify_all()
         return req
 
     # -- dispatch planning ----------------------------------------------
+
+    def _expire_overdue(self) -> None:
+        """Fail-and-drop queued requests whose deadline has passed —
+        they must never be dispatched late. Caller holds the lock in
+        async mode; sync mode is single-threaded."""
+        now = time.perf_counter()
+        if not any(req.expired(now) for req, _, _, _ in self._queue):
+            return
+        kept = []
+        dropped_rows = 0
+        for entry in self._queue:
+            req, _, lo, hi = entry
+            if req.expired(now):
+                dropped_rows += hi - lo
+                req._expire()
+            else:
+                kept.append(entry)
+        self._queue[:] = kept
+        object.__setattr__(
+            self, "_queue_rows", self._queue_rows - dropped_rows
+        )
 
     def _take_plan(self) -> List[Tuple[PendingResult, np.ndarray]]:
         """Pop up to ``engine.max_batch`` rows off the queue head
         (row-granular: the last request taken may contribute only a
         prefix, its remainder staying at the head). Caller holds the
         lock in async mode; sync mode is single-threaded."""
+        self._expire_overdue()
         room = self._engine.max_batch
         plan: List[Tuple[PendingResult, np.ndarray]] = []
         taken = 0
@@ -239,17 +425,24 @@ class MicroBatcher:
         )
         try:
             out = np.asarray(jax.device_get(self._engine.infer(batch)))
+            if self._metrics is not None:
+                self._metrics.record_dispatch(
+                    rows, self._engine.bucket_for(rows)
+                )
+            offset = 0
+            for req, part in plan:
+                k = part.shape[0]
+                req._deliver(out[offset : offset + k])
+                offset += k
         except Exception as e:
+            # The WHOLE dispatch path is covered, not just infer: a
+            # failure after the rows were popped from the queue
+            # (metrics, delivery) must still fail every request in the
+            # plan — an undelivered-and-unfailed handle would hang
+            # result() forever. _fail no-ops on already-delivered ones.
             for req, _ in plan:
                 req._fail(e)
             raise
-        if self._metrics is not None:
-            self._metrics.record_dispatch(rows, self._engine.bucket_for(rows))
-        offset = 0
-        for req, part in plan:
-            k = part.shape[0]
-            req._deliver(out[offset : offset + k])
-            offset += k
 
     # -- synchronous drain ----------------------------------------------
 
@@ -257,21 +450,37 @@ class MicroBatcher:
         """Serve every queued request. In synchronous mode this is THE
         dispatch path (deterministic: FIFO micro-batches of at most
         ``engine.max_batch`` rows each); in async mode it blocks until
-        the worker has drained the queue."""
+        the worker has drained the queue (returning early — with the
+        queue already failed clean — if the worker dies)."""
         self._require_bound()
         if self.synchronous:
             while self._queue:
-                self._run_plan(self._take_plan())
+                plan = self._take_plan()
+                if plan:
+                    self._run_plan(plan)
             return
         with self._cv:
+            # Force-drain: the worker skips the remaining coalescing
+            # window (flush means "serve NOW", however long max_delay_ms
+            # had left).
+            object.__setattr__(self, "_force_drain", True)
             self._cv.notify_all()
-            while (self._queue or self._inflight) and not self._stop.is_set():
-                self._cv.wait(0.01)
+            try:
+                while (
+                    self._queue or self._inflight
+                ) and not self._stop.is_set():
+                    worker = getattr(self, "_worker", None)
+                    if worker is None or not worker.is_alive():
+                        break  # worker died; crash cleanup fails the queue
+                    self._cv.wait(0.01)
+            finally:
+                object.__setattr__(self, "_force_drain", False)
 
     # -- async worker ----------------------------------------------------
 
     def _ensure_worker(self) -> None:
-        if getattr(self, "_worker", None) is None:
+        worker = getattr(self, "_worker", None)
+        if worker is None or not worker.is_alive():
             thread = threading.Thread(
                 target=self._worker_loop, name="microbatcher", daemon=True
             )
@@ -279,6 +488,17 @@ class MicroBatcher:
             thread.start()
 
     def _worker_loop(self) -> None:
+        try:
+            self._worker_body()
+        except BaseException as e:
+            # Worker death is survivable BY DESIGN: every queued and
+            # in-flight request fails cleanly (no result() ever hangs
+            # on a dead worker) and the next submit() restarts.
+            self._on_worker_crash(e)
+
+    def _worker_body(self) -> None:
+        from zookeeper_tpu.resilience import faults
+
         max_batch = self._engine.max_batch
         delay_s = self.max_delay_ms / 1e3
         while not self._stop.is_set():
@@ -287,12 +507,19 @@ class MicroBatcher:
                     self._cv.wait(0.05)
                 if self._stop.is_set():
                     break
+                plan_fault = faults.active()
+                if plan_fault is not None and plan_fault.take_worker_crash():
+                    raise WorkerCrashedError(
+                        "injected worker crash "
+                        "(FaultPlan.serving_worker_crash)"
+                    )
                 # Coalescing window: go when the largest bucket fills or
                 # the oldest request has waited max_delay_ms.
                 oldest = self._queue[0][0]._t_submit
                 while (
                     self._queue_rows < max_batch
                     and not self._stop.is_set()
+                    and not self._force_drain
                 ):
                     remaining = oldest + delay_s - time.perf_counter()
                     if remaining <= 0:
@@ -300,6 +527,7 @@ class MicroBatcher:
                     self._cv.wait(remaining)
                 plan = self._take_plan()
                 object.__setattr__(self, "_inflight", True)
+                object.__setattr__(self, "_plan_inflight", plan)
             try:
                 self._run_plan(plan)
             except Exception:
@@ -307,13 +535,46 @@ class MicroBatcher:
             finally:
                 with self._cv:
                     object.__setattr__(self, "_inflight", False)
+                    object.__setattr__(self, "_plan_inflight", None)
                     self._cv.notify_all()
 
-    def close(self) -> None:
-        """Stop the async worker (pending requests are failed so no
-        result() blocks forever). Safe to call repeatedly / unbound."""
+    def _on_worker_crash(self, error: BaseException) -> None:
+        with self._cv:
+            pending = [req for req, _, _, _ in self._queue]
+            inflight = [
+                req
+                for req, _ in (getattr(self, "_plan_inflight", None) or [])
+            ]
+            del self._queue[:]
+            object.__setattr__(self, "_queue_rows", 0)
+            object.__setattr__(self, "_inflight", False)
+            object.__setattr__(self, "_plan_inflight", None)
+            # next submit()'s _ensure_worker starts a fresh thread
+            object.__setattr__(self, "_worker", None)
+            if self._metrics is not None:
+                self._metrics.record_worker_restart()
+            wrapped = WorkerCrashedError(
+                f"MicroBatcher worker crashed ({error!r}); this request "
+                "was failed cleanly — resubmit to run on the restarted "
+                "worker."
+            )
+            wrapped.__cause__ = error
+            for req in inflight + pending:
+                req._fail(wrapped)
+            self._cv.notify_all()
+
+    def close(self, drain: bool = False) -> None:
+        """Stop the async worker. ``drain=True`` serves everything still
+        queued first (a graceful shutdown); otherwise pending requests
+        are FAILED so no ``result()`` blocks forever. Safe to call
+        repeatedly / unbound."""
         if getattr(self, "_engine", None) is None:
             return
+        if drain:
+            try:
+                self.flush()
+            except Exception:
+                pass  # per-request errors already delivered to handles
         self._stop.set()
         worker = getattr(self, "_worker", None)
         if worker is not None:
@@ -323,8 +584,7 @@ class MicroBatcher:
             object.__setattr__(self, "_worker", None)
         err = RuntimeError("MicroBatcher closed with requests pending.")
         for req, _, _, _ in self._queue:
-            if not req.done:
-                req._fail(err)
+            req._fail(err)
         del self._queue[:]
         object.__setattr__(self, "_queue_rows", 0)
         self._stop.clear()

@@ -48,7 +48,20 @@ class ServingMetrics:
             store = {}
             object.__setattr__(self, "_store", store)
             object.__setattr__(
-                self, "_totals", {"requests": 0, "rows": 0, "dispatches": 0}
+                self,
+                "_totals",
+                {
+                    "requests": 0,
+                    "rows": 0,
+                    "dispatches": 0,
+                    # Resilience counters (docs/DESIGN.md §10): shed
+                    # submits, deadline-failed requests, and worker
+                    # crash/restart cycles. Lifetime totals like the
+                    # rest; the shed RATE is rejected/(rejected+requests).
+                    "rejected": 0,
+                    "deadline_expired": 0,
+                    "worker_restarts": 0,
+                },
             )
         if name not in store:
             store[name] = deque(maxlen=max(1, int(self.window)))
@@ -63,6 +76,22 @@ class ServingMetrics:
 
     def record_queue_depth(self, rows: int) -> None:
         self._series("queue_depth").append(float(rows))
+
+    def record_rejected(self) -> None:
+        """A submit was shed (``RejectedError``) instead of enqueued."""
+        self._series("latency_ms")  # ensure initialized
+        self._totals["rejected"] += 1
+
+    def record_deadline_expired(self) -> None:
+        """A request's deadline elapsed before it was served."""
+        self._series("latency_ms")
+        self._totals["deadline_expired"] += 1
+
+    def record_worker_restart(self) -> None:
+        """The async batcher worker died and was scheduled for restart
+        (its queued/in-flight requests were failed cleanly)."""
+        self._series("latency_ms")
+        self._totals["worker_restarts"] += 1
 
     def record_dispatch(self, real_rows: int, bucket_rows: int) -> None:
         if bucket_rows <= 0:

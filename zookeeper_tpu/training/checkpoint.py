@@ -8,10 +8,14 @@ and RNG folding live in the state, and the data pipeline is
 (seed, epoch)-deterministic — SURVEY.md §7).
 """
 
+import logging
 import os
+import time
 from typing import Any, Optional
 
 from zookeeper_tpu.core import Field, component
+
+logger = logging.getLogger(__name__)
 
 
 def _state_pytree(state) -> dict:
@@ -67,6 +71,16 @@ class Checkpointer:
     keep_best_metric: Optional[str] = Field(None)
     #: "max" (accuracy-like) or "min" (loss-like).
     best_mode: str = Field("max")
+    #: Crash-resilient saves: a save that raises (disk full, transient
+    #: IO, injected fault) is retried this many times with exponential
+    #: backoff; when every attempt fails the save is LOGGED AND DROPPED
+    #: (``save()`` returns False) instead of crashing the training loop
+    #: mid-epoch — the work-loss bound simply stretches to the next
+    #: successful save. Contract/config errors (keep_best without
+    #: metrics) still raise: those are bugs, not weather.
+    save_retries: int = Field(2)
+    #: Base backoff between save retries (doubles per attempt).
+    save_retry_backoff_s: float = Field(0.25)
 
     @property
     def enabled(self) -> bool:
@@ -125,12 +139,56 @@ class Checkpointer:
                 )
             metrics = {k: float(v) for k, v in metrics.items()}
         step = int(jax.device_get(state.step)) if step is None else int(step)
-        saved = self._manager().save(
-            step,
-            args=ocp.args.StandardSave(_state_pytree(state)),
-            metrics=metrics,
-        )
-        return bool(saved)
+        from zookeeper_tpu.resilience import faults
+
+        attempts = max(0, int(self.save_retries)) + 1
+        for attempt in range(attempts):
+            try:
+                plan = faults.active()
+                if plan is not None and plan.take_save_io_failure():
+                    raise faults.InjectedFault(
+                        f"injected save IO failure at step {step}"
+                    )
+                saved = self._manager().save(
+                    step,
+                    args=ocp.args.StandardSave(_state_pytree(state)),
+                    metrics=metrics,
+                )
+            except Exception as e:
+                if attempt + 1 >= attempts:
+                    logger.warning(
+                        "checkpoint save at step %d failed after %d "
+                        "attempt(s) (%s); dropping this save — training "
+                        "continues, work-loss bound stretches to the next "
+                        "successful save",
+                        step,
+                        attempts,
+                        e,
+                    )
+                    return False
+                delay = self.save_retry_backoff_s * (2**attempt)
+                logger.warning(
+                    "checkpoint save at step %d failed (%s); retrying in "
+                    "%.2fs (%d/%d)",
+                    step,
+                    e,
+                    delay,
+                    attempt + 1,
+                    attempts - 1,
+                )
+                if delay > 0:
+                    time.sleep(delay)
+                continue
+            plan = faults.active()
+            if plan is not None and plan.corrupt_due(step):
+                # Chaos hook: tear THIS step's files once the save has
+                # fully landed (finalized), modeling post-crash disk
+                # state for the restore-fallback leg.
+                self.wait()
+                path = os.path.abspath(os.path.expanduser(self.directory))
+                faults.corrupt_checkpoint_dir(os.path.join(path, str(step)))
+            return bool(saved)
+        raise AssertionError("unreachable")  # pragma: no cover
 
     def latest_step(self) -> Optional[int]:
         if not self.enabled:
@@ -144,16 +202,88 @@ class Checkpointer:
             return None
         return self._manager().best_step()
 
+    def _step_finalized(self, step: int) -> bool:
+        """Orbax finalize check for one retained step: a save that never
+        finalized (crash mid-write) must not even be attempted. Modern
+        orbax already excludes tmp dirs from ``all_steps()``; this is
+        the belt to that suspender, and quietly passes when the
+        installed orbax has no checker."""
+        import orbax.checkpoint as ocp
+
+        path = os.path.join(
+            os.path.abspath(os.path.expanduser(self.directory)), str(step)
+        )
+        checker = getattr(ocp.utils, "is_checkpoint_finalized", None)
+        if checker is None or not os.path.isdir(path):
+            return True
+        try:
+            return bool(checker(path))
+        except Exception:
+            return True
+
     def restore_state(self, state: Any) -> Any:
-        """Restore the latest checkpoint into (a copy of) ``state``;
-        returns ``state`` unchanged when disabled or no checkpoint exists.
-        Restored arrays adopt the sharding/placement of the target state
-        leaves."""
+        """Restore the NEWEST VALID checkpoint into (a copy of)
+        ``state``; returns ``state`` unchanged when disabled or no
+        checkpoint exists. Restored arrays adopt the sharding/placement
+        of the target state leaves.
+
+        Crash consistency: a retained step that is unfinalized, torn on
+        disk, or structurally unreadable is SKIPPED with a warning and
+        the next-newest retained step restores instead — a corrupt
+        latest checkpoint costs the work since the previous save, never
+        the whole run. Only when EVERY retained step fails does restore
+        raise (silently restarting from scratch would be worse than the
+        crash): the likely cause then is a model/config mismatch, not
+        corruption, and the error says so."""
         if not self.enabled or not self.restore:
             return state
-        step = self._manager().latest_step()
-        if step is None:
+        steps = sorted(self._manager().all_steps(), reverse=True)
+        if not steps:
             return state
+        last_err: Optional[Exception] = None
+        for i, step in enumerate(steps):
+            if not self._step_finalized(step):
+                logger.warning(
+                    "checkpoint step %d is not finalized (crash "
+                    "mid-save?); falling back to an earlier step",
+                    step,
+                )
+                continue
+            try:
+                restored = self._restore_step(step, state)
+            except Exception as e:
+                last_err = e
+                logger.warning(
+                    "checkpoint step %d failed to restore (%s); falling "
+                    "back to an earlier retained step",
+                    step,
+                    e,
+                )
+                continue
+            if i > 0:
+                logger.warning(
+                    "restored step %d instead of the newest retained "
+                    "step %d: later step(s) were corrupt/unreadable — "
+                    "work since step %d will be retrained",
+                    step,
+                    steps[0],
+                    step,
+                )
+            return self._assemble_restored(state, restored)
+        raise ValueError(
+            f"None of the {len(steps)} retained checkpoint step(s) "
+            f"{steps} in {self.directory!r} could be restored. If every "
+            "step failed identically this is almost certainly a "
+            "model/checkpoint STRUCTURE mismatch (the restoring model "
+            "must be built with the exporting run's architecture "
+            "config), not disk corruption. Last error: "
+            f"{last_err}"
+        ) from last_err
+
+    def _restore_step(self, step: int, state: Any):
+        """Restore one specific step against ``state``'s structure
+        (including the EMA-toggle retry); raises on any mismatch or
+        on-disk corruption — ``restore_state`` decides the fallback."""
         import jax
         import orbax.checkpoint as ocp
 
@@ -191,6 +321,11 @@ class Checkpointer:
                 restored = do_restore(target)
             except Exception:
                 raise first_err from None
+        return restored
+
+    def _assemble_restored(self, state: Any, restored: dict) -> Any:
+        import jax
+
         ema = state.ema_params
         if ema is not None:
             # Prefer the saved buffer; else seed from restored params so

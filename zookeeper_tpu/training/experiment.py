@@ -22,6 +22,9 @@ from zookeeper_tpu.data.pipeline import DataLoader
 from zookeeper_tpu.models.base import Model
 from zookeeper_tpu.parallel.distributed import DistributedRuntime
 from zookeeper_tpu.parallel.partitioner import Partitioner, SingleDevicePartitioner
+from zookeeper_tpu.resilience import faults as _faults
+from zookeeper_tpu.resilience.faults import NonFiniteLossError, Preempted
+from zookeeper_tpu.resilience.guard import PreemptionGuard
 from zookeeper_tpu.training.checkpoint import Checkpointer
 from zookeeper_tpu.training.metrics import CompositeMetricsWriter, MetricsWriter
 from zookeeper_tpu.training.optimizer import Adam, Optimizer
@@ -86,6 +89,13 @@ class TrainingExperiment(Experiment):
     #: Pluggable metrics sink (SURVEY §5): no-op until a leg is configured,
     #: e.g. ``writer.tensorboard.log_dir=/tmp/tb writer.jsonl.path=m.jsonl``.
     writer: MetricsWriter = ComponentField(CompositeMetricsWriter)
+    #: Preemption safety (docs/DESIGN.md §10): while training runs,
+    #: SIGTERM/SIGINT set a flag checked at step/slab boundaries; the
+    #: loop then saves ONE synchronous checkpoint (exact-resume state)
+    #: and exits with the distinguished ``Preempted`` status that
+    #: ``resilience.run_with_recovery`` resumes from. ``guard.enabled=
+    #: False`` restores raw signal behavior.
+    guard: PreemptionGuard = ComponentField(PreemptionGuard)
 
     epochs: int = Field(1)
     batch_size: int = Field(32)
@@ -150,6 +160,14 @@ class TrainingExperiment(Experiment):
     #: ``training.checkpoint.select_inference_weights``): "auto" serves
     #: the EMA shadow whenever this knob produced one.
     ema_decay: float = Field(0.0)
+    #: Non-finite-loss policy (``training.step.make_train_step``):
+    #: "ignore" (default, zero-cost), "skip" (a non-finite step keeps
+    #: the pre-step params/opt/EMA state on device — no host sync —
+    #: and the epoch metrics report a summed ``skipped_steps`` count),
+    #: or "halt" (skip on device, then raise ``NonFiniteLossError`` at
+    #: the next metrics readback boundary so a supervisor restores
+    #: from checkpoint).
+    nan_policy: str = Field("ignore")
     #: Rematerialization policy ("none"/"dots"/"full"/"quant"): trade
     #: backward recompute for activation HBM (see make_train_step —
     #: "quant" saves only the tagged binarized activations; measured
@@ -243,6 +261,7 @@ class TrainingExperiment(Experiment):
             ),
             "ema_decay": self.ema_decay if self.ema_decay > 0 else None,
             "remat": self.remat,
+            "nan_policy": self.nan_policy,
         }
 
     def _train_step_fn(self):
@@ -282,6 +301,66 @@ class TrainingExperiment(Experiment):
             epoch * spe + step_idx + 1,
             {f"train/{k}": v for k, v in row.items()},
         )
+
+    def _mark_first_step(self, metrics) -> None:
+        """Timestamp the completion of THIS RUN's first train step (one
+        deliberate device sync, once per run): the supervisor reads it
+        to report restore latency (restart -> first post-resume step)."""
+        if getattr(self, "first_step_at", None) is None:
+            import jax
+
+            jax.block_until_ready(metrics["loss"])
+            self.first_step_at = time.perf_counter()
+
+    def _boundary_check(self, state, global_step: int) -> None:
+        """Preemption check at a safe boundary (a step/slab end, where
+        ``state`` is a valid exact-resume point). An active FaultPlan's
+        ``kill_at_step`` trips the same flag a real SIGTERM does, so the
+        injected and production paths are one path. On preemption: one
+        SYNCHRONOUS save of exactly this state, then the distinguished
+        ``Preempted`` exit (teardown still runs via run()'s finally)."""
+        plan = _faults.active()
+        if plan is not None and plan.kill_due(global_step):
+            self.guard.request_preemption()
+        if not self.guard.preempted:
+            return
+        saved = False
+        ck = self.checkpointer
+        if ck.enabled:
+            if ck.keep_best_metric is not None:
+                # Rank-managed retention can't accept a metric-less
+                # save; the latest ranked save is the resume point.
+                saved = ck.latest_step() is not None
+            elif ck.latest_step() == global_step:
+                saved = True  # a cadence save just landed on this step
+            else:
+                saved = bool(ck.save(state))
+            ck.wait()  # synchronous: the process may die right after
+        self._log(
+            f"preemption requested "
+            f"(signal {self.guard.received_signal or 'injected/manual'}); "
+            f"exiting at step {global_step} "
+            f"({'checkpoint saved' if saved else 'NO checkpoint'})"
+        )
+        raise Preempted(global_step, saved, self.guard.received_signal)
+
+    def _check_halt(self, host_metrics, global_step: int) -> None:
+        """``nan_policy="halt"``: raise at a readback boundary when any
+        step in the freshly-pulled host metrics was skipped for a
+        non-finite loss/grad. ``host_metrics`` is one step's scalar
+        dict, one slab's [k]-stacked dict, or a list of either."""
+        if self.nan_policy != "halt":
+            return
+        import numpy as np
+
+        rows = host_metrics if isinstance(host_metrics, list) else [host_metrics]
+        skipped = sum(
+            float(np.sum(np.asarray(m["skipped_steps"])))
+            for m in rows
+            if "skipped_steps" in m
+        )
+        if skipped > 0:
+            raise NonFiniteLossError(global_step, int(skipped))
 
     def _run_fused_epoch(
         self, multi_step, state, accum, epoch, spe, start_b,
@@ -341,6 +420,7 @@ class TrainingExperiment(Experiment):
             with slab_annotation(slab_idx, num_steps=k):
                 state, metrics = multi_step(state, slab)
             accum.append(metrics)
+            self._mark_first_step(metrics)
             if tracing and step_idx + k > p_stop:
                 jax.block_until_ready(metrics["loss"])
                 jax.profiler.stop_trace()
@@ -361,6 +441,7 @@ class TrainingExperiment(Experiment):
                     # ONE readback for the whole slab; per-step values
                     # are identical to what the eager loop would log.
                     hm = jax.device_get(metrics)
+                    self._check_halt(hm, epoch * spe + step_idx + k)
                     for s in bounds:
                         self._log_step_scalars(
                             epoch, s, spe,
@@ -370,6 +451,10 @@ class TrainingExperiment(Experiment):
                             },
                         )
             step_idx += k
+            # Slab ends are the fused loop's safe boundaries: the state
+            # here is a valid exact-resume point (same quantization as
+            # step-cadence checkpoints).
+            self._boundary_check(state, epoch * spe + step_idx)
         return state, step_idx - start_b
 
     def run(self) -> Dict[str, List[Dict[str, float]]]:
@@ -392,6 +477,12 @@ class TrainingExperiment(Experiment):
             raise ValueError(
                 f"unroll={self.unroll} must be >= 1 (1 = eager per-step "
                 "loop; N fuses N steps per dispatch)."
+            )
+        if self.nan_policy not in ("ignore", "skip", "halt"):
+            # Pure config: fail before device setup / compilation.
+            raise ValueError(
+                f"nan_policy={self.nan_policy!r} unknown; "
+                "choose ignore/skip/halt."
             )
         if self.early_stop_mode not in ("auto", "min", "max"):
             raise ValueError(
@@ -506,6 +597,11 @@ class TrainingExperiment(Experiment):
             and self.early_stop_metric is not None
             and "loss" in self.early_stop_metric
         )
+        # Per-run restore-latency probe (read by run_with_recovery).
+        self.first_step_at = None
+        # From here until teardown, SIGTERM/SIGINT mean "save and exit
+        # at the next step/slab boundary", not "die mid-write".
+        self.guard.install()
         try:
             for epoch in range(start_epoch, self.epochs):
                 t0 = time.perf_counter()
@@ -540,6 +636,7 @@ class TrainingExperiment(Experiment):
                             jax.profiler.start_trace(self.profile_dir)
                         state, metrics = train_step(state, batch)
                         accum.append(metrics)
+                        self._mark_first_step(metrics)
                         if profiling and step_idx == p_stop:
                             jax.block_until_ready(metrics["loss"])
                             jax.profiler.stop_trace()
@@ -553,10 +650,15 @@ class TrainingExperiment(Experiment):
                             # Per-step scalars ride the host pull that log_every
                             # already paid for — finer than epoch granularity at
                             # zero extra device syncs.
+                            hm = jax.device_get(metrics)
+                            self._check_halt(hm, epoch * spe + step_idx + 1)
                             self._log_step_scalars(
                                 epoch, step_idx, spe,
-                                {k: float(v) for k, v in metrics.items()},
+                                {k: float(v) for k, v in hm.items()},
                             )
+                        self._boundary_check(
+                            state, epoch * spe + step_idx + 1
+                        )
                     steps_trained = len(accum)
                 # One host sync per epoch: pull all accumulated device scalars
                 # in a single device_get (each separate transfer pays the full
@@ -565,9 +667,14 @@ class TrainingExperiment(Experiment):
                 # steps as scalars — atleast_1d + concatenate makes the
                 # epoch mean a plain per-step mean in both modes.
                 host_accum = jax.device_get(accum)
+                self._check_halt(
+                    host_accum, epoch * spe + start_b + steps_trained
+                )
                 epoch_metrics = {
+                    # skipped_steps is a COUNTER (how many steps this
+                    # epoch hit the nan_policy guard), not a mean.
                     k: float(
-                        np.mean(
+                        (np.sum if k == "skipped_steps" else np.mean)(
                             np.concatenate(
                                 [
                                     np.atleast_1d(np.asarray(m[k]))
@@ -691,9 +798,37 @@ class TrainingExperiment(Experiment):
             # complete and buffered metrics (TensorBoard events) become
             # durable even when an epoch raises mid-run. flush, not
             # close: the writer is a long-lived component and run() may
-            # be called again on the same experiment.
-            self.checkpointer.wait()
-            self.writer.flush()
+            # be called again on the same experiment. A teardown step
+            # that ITSELF raises while an exception is already in
+            # flight must not mask it (the original traceback is the
+            # one that says what actually went wrong) — it is logged
+            # and suppressed; with no exception in flight the first
+            # teardown failure propagates after every step has run.
+            import sys
+
+            self.guard.uninstall()
+            pending = sys.exc_info()[1]
+            teardown_err: Optional[BaseException] = None
+            for what, fn in (
+                ("checkpointer.wait", self.checkpointer.wait),
+                ("writer.flush", self.writer.flush),
+            ):
+                try:
+                    fn()
+                except Exception as e:
+                    if pending is not None or teardown_err is not None:
+                        import logging
+
+                        logging.getLogger(__name__).warning(
+                            "teardown %s failed (%s); suppressed so the "
+                            "original exception propagates",
+                            what,
+                            e,
+                        )
+                    else:
+                        teardown_err = e
+            if teardown_err is not None:
+                raise teardown_err
         if self.export_model_to:
             from zookeeper_tpu.training.checkpoint import save_model
 

@@ -88,6 +88,7 @@ def make_train_step(
     distill: Tuple[Callable[[jax.Array], jax.Array], float, float] = None,
     ema_decay: float = None,
     remat: str = "none",
+    nan_policy: str = "ignore",
 ) -> Callable[[TrainState, Batch], Tuple[TrainState, Metrics]]:
     """Build the pure train step. Works unjitted (debugging), under
     ``jax.jit``, or under ``pjit``/``shard_map`` — no collectives are
@@ -129,6 +130,30 @@ def make_train_step(
       is within ~1% of "none" — and "quant" lands ~25% HIGHER (the
       pinned saves constrain fusion). Policies are exactness-preserving
       (pinned by test); measure before relying on one.
+
+    ``nan_policy``: what a non-finite loss or gradient does to the step
+    (the resilience posture — one bad step inside a fused ``lax.scan``
+    slab would otherwise silently poison every subsequent step):
+
+    - ``"ignore"``: today's behavior, zero extra ops (default).
+    - ``"skip"``: when loss or global grad norm is non-finite, the
+      params / optimizer state / model_state / EMA keep their PRE-STEP
+      values via ``jnp.where`` selects — fully on device, no host sync,
+      no ``lax.cond`` dispatch stall — while the STEP COUNTER still
+      advances (the counter drives checkpoint naming and the
+      ``(seed, epoch)`` pipeline replay; freezing it would break the
+      exact-resume contract). Metrics gain a per-step ``skipped_steps``
+      0/1 flag (the experiment sums it per epoch).
+    - ``"halt"``: on-device identical to ``"skip"`` (the bad update is
+      still suppressed so the checkpointed state stays clean), but the
+      EXPERIMENT raises ``NonFiniteLossError`` at its next metrics
+      readback boundary so a supervisor restores from checkpoint —
+      detection latency is the deferred-readback cadence, by design.
+
+    Chaos hook: when an active ``FaultPlan`` sets ``nan_at_step``, the
+    loss is scaled by a ``step == N`` selected NaN at trace time —
+    poisoning loss AND grads on-device exactly like a real numeric
+    blow-up, deterministically.
     """
     flip_paths = None
     if flip_ratio_pattern is not None:
@@ -139,6 +164,17 @@ def make_train_step(
         raise ValueError(
             f"Unknown remat policy {remat!r}; choose none/dots/full/quant."
         )
+    if nan_policy not in ("ignore", "skip", "halt"):
+        raise ValueError(
+            f"Unknown nan_policy {nan_policy!r}; choose ignore/skip/halt."
+        )
+    # Deterministic chaos: the active FaultPlan's NaN step is read ONCE,
+    # at build time, and traced into the compiled step (a plan installed
+    # after compilation does not retroactively poison a cached program).
+    from zookeeper_tpu.resilience import faults as _faults
+
+    _plan = _faults.active()
+    nan_at_step = _plan.nan_at_step if _plan is not None else None
 
     def train_step(state: TrainState, batch: Batch) -> Tuple[TrainState, Metrics]:
         # Per-step RNG derived from the step counter: deterministic,
@@ -186,6 +222,14 @@ def make_train_step(
             else:
                 logits, new_model_state = out, state.model_state
             loss = loss_fn(logits, batch["target"])
+            if nan_at_step is not None:
+                # Multiplicative NaN: poisons the loss AND (through the
+                # chain rule) every gradient — the real blow-up shape.
+                loss = loss * jnp.where(
+                    state.step == nan_at_step,
+                    jnp.float32(jnp.nan),
+                    jnp.float32(1.0),
+                )
             kd = None
             if distill is not None:
                 teacher_fn, alpha, temperature = distill
@@ -197,6 +241,7 @@ def make_train_step(
         (loss, (logits, new_model_state, kd)), grads = jax.value_and_grad(
             compute_loss, has_aux=True
         )(state.params)
+        grad_norm = optax.global_norm(grads)
         new_state = state.apply_gradients(grads).replace(
             model_state=dict(new_model_state)
         )
@@ -213,11 +258,37 @@ def make_train_step(
                     new_state.params,
                 )
             )
+        if nan_policy != "ignore":
+            # Keep the PRE-step values for every stateful leaf when the
+            # step blew up; the step counter still advances (see
+            # docstring — it is the resume/replay clock, not model
+            # state). Pure where-selects: no host sync, scan-safe.
+            ok = jnp.isfinite(loss) & jnp.isfinite(grad_norm)
+
+            def keep_old(new, old):
+                return jax.tree.map(
+                    lambda n, o: jnp.where(ok, n, o), new, old
+                )
+
+            new_state = new_state.replace(
+                params=keep_old(new_state.params, state.params),
+                opt_state=keep_old(new_state.opt_state, state.opt_state),
+                model_state=keep_old(
+                    new_state.model_state, state.model_state
+                ),
+                ema_params=(
+                    keep_old(new_state.ema_params, state.ema_params)
+                    if new_state.ema_params is not None
+                    else None
+                ),
+            )
         metrics = {
             "loss": loss,
             "accuracy": accuracy(logits, batch["target"]),
-            "grad_norm": optax.global_norm(grads),
+            "grad_norm": grad_norm,
         }
+        if nan_policy != "ignore":
+            metrics["skipped_steps"] = (~ok).astype(jnp.float32)
         if kd is not None:
             metrics["kd_loss"] = kd
         if flip_paths is not None:
