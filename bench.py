@@ -730,6 +730,21 @@ LM_BENCH_CONFIG = {
 }
 
 
+def lm_bench_flash_blocks(seq, d_model=None, num_heads=None, itemsize=2):
+    """The flash auto-block sizes the LM leg's pinned config selects
+    (bf16 operands by default) — recorded in the bench JSON so a
+    flash-policy regression (a changed default demoting the measured
+    sweep winner) becomes driver-visible as a moved number, not just a
+    slower step time."""
+    from zookeeper_tpu.ops.attention import _default_flash_blocks
+
+    d_model = LM_BENCH_CONFIG["d_model"] if d_model is None else d_model
+    num_heads = LM_BENCH_CONFIG["num_heads"] if num_heads is None else num_heads
+    return _default_flash_blocks(
+        seq, None, None, head_dim=d_model // num_heads, itemsize=itemsize
+    )
+
+
 def measure_lm_throughput(peak_flops=None, env=None):
     """``ZK_BENCH_LM=1`` leg: tokens/s/chip of the full jitted LM train
     step (fwd + bwd through the flash-attention custom_vjp + Adam) at
@@ -830,6 +845,7 @@ def measure_lm_throughput(peak_flops=None, env=None):
             "lengths (tunnel jitter)"
         )
     n_chips = jax.device_count()
+    lm_block_q, lm_block_k = lm_bench_flash_blocks(seq)
     metrics = {
         "lm_tokens_per_sec_per_chip": round(
             batch_size * seq / step_time / max(1, n_chips), 1
@@ -841,9 +857,118 @@ def measure_lm_throughput(peak_flops=None, env=None):
             **LM_BENCH_CONFIG
         ),
         "lm_attention": "flash",
+        # Flash-policy + parallelism visibility: the auto-selected
+        # block sizes this run compiled with, and the sequence-parallel
+        # degree (1 on the single-chip leg; the dp x sp leg reports its
+        # own sp_* metrics).
+        "lm_flash_block_q": int(lm_block_q),
+        "lm_flash_block_k": int(lm_block_k),
+        "lm_sp_degree": 1,
     }
     if lm_cost is not None:
         metrics["lm_per_chip_step_tflops"] = round(lm_cost / 1e12, 2)
+    return metrics
+
+
+def measure_sp_ring_throughput(env=None):
+    """``ZK_BENCH_SP=1`` leg: tokens/s of one fwd+bwd ring-attention
+    step at long sequence on a sequence-parallel mesh, measured for
+    BOTH ring schedules — ``sp_tokens_per_sec_overlap`` (the
+    double-buffered prefetch default) vs ``sp_tokens_per_sec_sequential``
+    (permutes issued after the block compute) — so a scheduling
+    regression in either direction is a moved number. The op is timed
+    directly (not the full LM step): the schedules differ ONLY inside
+    the ring loop, and the surrounding transformer would dilute the
+    comparison with identical work.
+
+    Knobs: ZK_BENCH_SP_SEQ (default 8192), ZK_BENCH_SP_DEGREE (default
+    min(8, devices)), ZK_BENCH_SP_FLAVOR ("ring" = dense block compute,
+    compiles on every backend; "ring_flash" for real chips — interpret-
+    mode Pallas would dominate the timing off-TPU), ZK_BENCH_SP_BATCH,
+    ZK_BENCH_SP_HEADS."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    from zookeeper_tpu.ops import ring_attention, ring_flash_attention
+    from zookeeper_tpu.training.benchmark import time_marginal
+
+    env = os.environ if env is None else env
+    seq = int(env.get("ZK_BENCH_SP_SEQ", "8192"))
+    sp = int(env.get("ZK_BENCH_SP_DEGREE", str(min(8, jax.device_count()))))
+    flavor = env.get("ZK_BENCH_SP_FLAVOR", "ring")
+    batch = int(env.get("ZK_BENCH_SP_BATCH", "1"))
+    heads = int(env.get("ZK_BENCH_SP_HEADS", "4"))
+    head_dim = 64
+    if flavor not in ("ring", "ring_flash"):
+        raise ValueError(
+            f"ZK_BENCH_SP_FLAVOR={flavor!r}: expected ring/ring_flash."
+        )
+    if not 1 <= sp <= jax.device_count():
+        # A silently-truncated ring would report tokens/s against a
+        # misstated sp_degree; fail the leg loudly instead.
+        raise ValueError(
+            f"ZK_BENCH_SP_DEGREE={sp}: need 1 <= degree <= device "
+            f"count ({jax.device_count()})."
+        )
+    fn = ring_flash_attention if flavor == "ring_flash" else ring_attention
+    mesh = Mesh(np.array(jax.devices()[:sp]), ("sp",))
+    rng = np.random.default_rng(0)
+    q, k, v = (
+        jax.device_put(
+            jnp.asarray(
+                rng.normal(size=(batch, seq, heads, head_dim)).astype(
+                    np.float32
+                )
+                * 0.02
+            ),
+            NamedSharding(mesh, P(None, "sp")),
+        )
+        for _ in range(3)
+    )
+
+    metrics = {
+        "sp_seq_len": seq,
+        "sp_degree": sp,
+        "sp_flavor": flavor,
+        "sp_batch_size": batch,
+    }
+    for name, overlap in (("overlap", True), ("sequential", False)):
+        # fwd + bwd (the training shape): grads w.r.t. q/k/v all ride
+        # the ring, so both the forward and the inverse rotations of
+        # the schedule under test are in the timed program.
+        step = jax.jit(
+            jax.grad(
+                lambda q, k, v, _ov=overlap: fn(
+                    q, k, v, mesh=mesh, seq_axis="sp", causal=True,
+                    overlap=_ov,
+                )
+                .astype(jnp.float32)
+                .sum(),
+                argnums=(0, 1, 2),
+            )
+        )
+
+        def run_chain(n):
+            t0 = time.perf_counter()
+            g = None
+            for _ in range(n):
+                g = step(q, k, v)
+            jax.block_until_ready(g)
+            return time.perf_counter() - t0
+
+        run_chain(1)  # Warmup (compile).
+        step_time = time_marginal(run_chain, 1, 3, rounds=3)
+        if step_time <= 0:
+            raise RuntimeError(
+                f"non-positive SP marginal {step_time:.6f}s (jitter)"
+            )
+        metrics[f"sp_tokens_per_sec_{name}"] = round(
+            batch * seq / step_time, 1
+        )
+        metrics[f"sp_step_time_ms_{name}"] = round(step_time * 1e3, 2)
     return metrics
 
 
@@ -1201,6 +1326,21 @@ def main():
             )
             lm_metrics = None
 
+    # Sequence-parallel ring schedule A/B leg (env-gated: a long-
+    # sequence multi-device compile): overlapped vs sequential ring
+    # tokens/s, so ring-schedule regressions are driver-visible.
+    sp_metrics = None
+    if _env_flag(os.environ, "ZK_BENCH_SP"):
+        try:
+            sp_metrics = measure_sp_ring_throughput()
+        except Exception as e:  # never lose the primary metric
+            print(
+                f"SP ring leg failed ({e}); omitting sp_*",
+                file=sys.stderr,
+                flush=True,
+            )
+            sp_metrics = None
+
     # Host input-pipeline leg (CPU-only, seconds): the augmented batch-
     # assembly rate the driver machine-checks round over round — the
     # one stage where the framework's own code, not the tunnel, was the
@@ -1256,6 +1396,8 @@ def main():
     }
     if lm_metrics is not None:
         extras.update(lm_metrics)
+    if sp_metrics is not None:
+        extras.update(sp_metrics)
     if host_metrics is not None:
         extras.update(host_metrics)
     if recovery_metrics is not None:

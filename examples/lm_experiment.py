@@ -14,6 +14,13 @@ the reference's signature config-reuse mechanism doing real work::
         model.d_model=512 model.num_heads=8 batch_size=4 \\
         model.compute_dtype=bfloat16 loader.dataset.vocab_size=1024
 
+    # Sequence parallelism (the dp x sp ring-flash recipe) — the
+    # partitioner owns the ("data", "sp") mesh and injects the ring
+    # attention; checkpoints/EMA/metrics/unroll/resume ride unchanged:
+    python examples/lm_experiment.py TrainLM seq_len=8192 \\
+        partitioner=SequenceParallelPartitioner partitioner.sp=4 \\
+        model.d_model=512 model.num_heads=8 batch_size=4
+
     # Dense-attention oracle run, or any other field:
     python examples/lm_experiment.py TrainLM model.attention=dense
 """

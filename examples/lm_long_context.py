@@ -42,6 +42,11 @@ def main():
     ap.add_argument("--d-model", type=int, default=256)
     ap.add_argument("--heads", type=int, default=4)
     ap.add_argument("--lr", type=float, default=1e-3)
+    ap.add_argument(
+        "--sp", type=int, default=0,
+        help="sequence-parallel degree (0 = pure data parallelism); "
+        "uses SequenceParallelPartitioner's dp x sp ring-flash recipe",
+    )
     args = ap.parse_args()
 
     model = TransformerLM()
@@ -58,13 +63,30 @@ def main():
         },
         name="model",
     )
+    if args.sp > 0:
+        # dp x sp: the partitioner owns the mesh and injects the ring
+        # attention callable — same seam the TrainLM CLI recipe uses.
+        from zookeeper_tpu.parallel import SequenceParallelPartitioner
+
+        part = SequenceParallelPartitioner()
+        configure(part, {"sp": args.sp}, name="partitioner")
+        part.setup()
+        part.prepare_model(model)
+    else:
+        part = DataParallelPartitioner()
+        configure(part, {}, name="partitioner")
+        part.setup()
     module = model.build((args.seq,), num_classes=args.vocab)
     params, mstate = model.initialize(module, (args.seq,))
     n_params = sum(p.size for p in jax.tree.leaves(params))
+    mesh_desc = (
+        f"mesh={dict(part.mesh.shape)}" if part.mesh is not None
+        else f"{jax.device_count()} device(s)"
+    )
     print(
         f"TransformerLM: {args.layers}L d{args.d_model} h{args.heads} "
         f"s{args.seq} vocab{args.vocab} = {n_params / 1e6:.1f}M params "
-        f"on {jax.device_count()} device(s)"
+        f"on {mesh_desc}"
     )
 
     ts = TrainState.create(
@@ -73,9 +95,6 @@ def main():
         model_state=mstate,
         tx=optax.adam(args.lr),
     )
-    part = DataParallelPartitioner()
-    configure(part, {}, name="partitioner")
-    part.setup()
     ts = part.shard_state(ts)
     step = part.compile_step(make_train_step(), ts)
     sharding = part.batch_sharding()

@@ -223,3 +223,32 @@ def test_bench_int8_peak_resolution():
     assert (peak, source) == (369e12, "fallback_v5e")
     # The recorded fallback sits below the physical 2x-bf16 ceiling.
     assert peak < 2.0 * 197e12
+
+
+def test_lm_bench_records_flash_blocks_and_sp_degree():
+    """The LM leg's bench JSON carries the auto-selected flash block
+    sizes (so a flash-policy regression moves a driver-visible number,
+    not just the step time) — computed by the same head_dim/VMEM-aware
+    policy the compiled step uses, at the leg's bf16 operands."""
+    lm_bench_flash_blocks = _bench_attr("lm_bench_flash_blocks")
+
+    # Pinned config (d512/h8 -> head_dim 64, bf16): the measured sweep
+    # winner at every power-of-two length.
+    assert lm_bench_flash_blocks(8192) == (1024, 1024)
+    assert lm_bench_flash_blocks(2048) == (1024, 1024)
+    # Awkward lengths fall back exactly like the kernel's policy...
+    assert lm_bench_flash_blocks(1100) == (128, 128)
+    # ...and extreme head dims demote via the VMEM filter.
+    bq, bk = lm_bench_flash_blocks(8192, d_model=4096, num_heads=1,
+                                   itemsize=4)
+    assert bq == bk and bq < 1024
+
+
+def test_sp_bench_env_knobs_validate():
+    """The SP A/B leg fails fast on an invalid flavor (before any
+    multi-device compile)."""
+    import pytest
+
+    measure = _bench_attr("measure_sp_ring_throughput")
+    with pytest.raises(ValueError, match="ZK_BENCH_SP_FLAVOR"):
+        measure(env={"ZK_BENCH_SP_FLAVOR": "dense"})

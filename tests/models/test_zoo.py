@@ -53,6 +53,7 @@ def test_birealnet_shape_and_param_count():
     assert 8e6 < n_params < 20e6
 
 
+@pytest.mark.slow
 def test_quicknet_shape():
     logits, params, *_ = build_and_forward(QuickNet, {}, (224, 224, 3), 1000)
     assert logits.shape == (2, 1000)
@@ -147,7 +148,18 @@ def zoo_build():
     return get
 
 
-@pytest.mark.parametrize("name", sorted(ONE_STEP_CASES))
+@pytest.mark.parametrize(
+    "name",
+    [
+        # The heaviest builds carry slow (tiering policy, README Tests):
+        # the fast tier keeps one-step smoke of the flagship + compact
+        # members; the full run covers every zoo class.
+        pytest.param(n, marks=pytest.mark.slow)
+        if n in ("BinaryDenseNet28", "MeliusNet22")
+        else n
+        for n in sorted(ONE_STEP_CASES)
+    ],
+)
 def test_models_train_one_step(zoo_build, name):
     import optax
 
@@ -220,6 +232,7 @@ def test_reactnet_int8_path_matches_mxu(zoo_build):
     )
 
 
+@pytest.mark.slow
 def test_binary_resnet_e18_shape_and_params():
     from zookeeper_tpu.models import BinaryResNetE18
 

@@ -129,6 +129,7 @@ def test_fold_bn_sorted_checkpoint_needs_fold_order():
     )
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize(
     "model_cls,base_conf,kernel_quantizer",
     [
@@ -245,6 +246,7 @@ def test_fold_bn_binaryalexnet_dense_stage():
         bad.init(jax.random.PRNGKey(0), x, training=False)
 
 
+@pytest.mark.slow
 def test_fold_bn_binarynet_dense_stage():
     """BinaryNet mirrors the BinaryAlexNet rule: dense-stage fold only
     (odd convs feed a maxpool before their BN); conv-packed + fold

@@ -224,6 +224,7 @@ def test_binarynet_whole_model_packed_deployment_with_dense():
     np.testing.assert_array_equal(np.asarray(y_float), np.asarray(y_packed))
 
 
+@pytest.mark.slow
 def test_xnornet_packed_deployment_includes_dense(tmp_path):
     """XNORNet (magnitude-aware kernels) converts template-less and the
     packed model loads — the regression the reviewer flagged: zoo models
@@ -264,6 +265,7 @@ def test_xnornet_packed_deployment_includes_dense(tmp_path):
     )
 
 
+@pytest.mark.slow
 def test_binaryalexnet_dense_only_packed_deployment():
     """The measured deployment sweet spot: bf16 convs + packed dense
     (dense holds ~80% of BinaryAlexNet's params at M = batch). The

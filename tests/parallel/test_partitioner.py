@@ -306,6 +306,7 @@ def test_quicknet_tp_rules_shard_and_train():
     assert int(jax.device_get(new_state.step)) == 1
 
 
+@pytest.mark.slow
 def test_quicknet_tp_matches_dp_numerics():
     """TP must not change the math: one step of QuickNet on dp x tp equals
     the same step on pure DP (params compared after the update)."""
@@ -621,6 +622,7 @@ def make_binary_bn_state(seed=0):
     )
 
 
+@pytest.mark.slow
 def test_fsdp_bn_custom_vjp_parity():
     """The hard-parts composition under FSDP: synced BN + int8 custom_vjp
     binary convs/dense with ZeRO-3-sharded weights must match a

@@ -137,6 +137,7 @@ def test_mesh_partitioner_serving_matches_single_device():
     assert key_meshes == {part.mesh}
 
 
+@pytest.mark.slow
 def test_seq_buckets_causal_lm():
     """Sequence bucketing for token models: right-padded causal
     attention must reproduce the exact-length forward on the real

@@ -2,10 +2,12 @@
 
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from zookeeper_tpu.training.benchmark import scan_chain_latency
 
 
+@pytest.mark.slow
 def test_scan_chain_latency_heavy_apply_measurable_and_ordered():
     """A work-heavy apply (20 chained 256x256 matmuls, ~ms per call on
     CPU — far above dispatch/timer jitter) must measure strictly positive

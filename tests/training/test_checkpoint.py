@@ -38,6 +38,7 @@ def test_checkpointer_disabled_by_default():
     assert ckpt.restore_state("anything") == "anything"
 
 
+@pytest.mark.slow
 def test_save_and_restore_roundtrip(tmp_path):
     exp = make_experiment(tmp_path)
     exp.run()
@@ -61,6 +62,7 @@ def test_save_and_restore_roundtrip(tmp_path):
     exp2.checkpointer.close()
 
 
+@pytest.mark.slow
 def test_resume_continues_training(tmp_path):
     # Train 1 epoch, then "crash"; resume with epochs=3 trains 2 more.
     exp = make_experiment(tmp_path, {"epochs": 1})
@@ -326,6 +328,7 @@ def test_midepoch_resume_bit_exact_under_dp_sharding(tmp_path):
     exp2.checkpointer.close()
 
 
+@pytest.mark.slow
 def test_midepoch_resume_tags_partial_epoch(tmp_path):
     """The resumed epoch's train aggregates cover only the replayed
     suffix of the epoch — its metrics_file record is tagged
@@ -539,6 +542,7 @@ def test_load_inference_model_export_and_manager_dir(tmp_path):
         load_inference_model(str(tmp_path / "nowhere"))
 
 
+@pytest.mark.slow
 def test_eval_experiment_scores_selected_weights(tmp_path):
     """The EvalExperiment fix: it can now score the EMA (or raw) weights
     straight from a full training checkpoint directory, matching the

@@ -58,6 +58,7 @@ def _base_conf(extra=None):
     }
 
 
+@pytest.mark.slow
 def test_distillation_end_to_end(tmp_path):
     """Stage 1 trains+exports a teacher; stage 2 distills a student from
     it. The student's step reports kd_loss and the loop runs to the end."""

@@ -92,6 +92,7 @@ def _batches():
         yield x, y
 
 
+@pytest.mark.slow
 def test_per_step_loss_matches_keras_oracle():
     state = _flax_state()
     keras_model = _keras_model_from_flax(state.params)

@@ -130,6 +130,7 @@ def test_unroll_bit_exact_with_eager_loop(unroll):
     )
 
 
+@pytest.mark.slow
 def test_unroll_mid_slab_resume_bit_exact(tmp_path):
     """A step-granular checkpoint at a step that is NOT a multiple of
     unroll resumes mid-slab: the fused run picks up at start_batch=5
@@ -190,6 +191,7 @@ def test_unroll_step_cadence_checkpoints_quantize_to_slab_end(tmp_path):
     exp.checkpointer.close()
 
 
+@pytest.mark.slow
 def test_deferred_readback_logs_same_metrics_as_eager(tmp_path):
     """CI smoke for the fused loop: Experiment.run() over a few slabs
     on CPU, asserting the deferred-readback path emits EXACTLY the
@@ -220,6 +222,7 @@ def test_deferred_readback_logs_same_metrics_as_eager(tmp_path):
     assert step_rows["eager"] == step_rows["fused"]
 
 
+@pytest.mark.slow
 def test_unroll_respects_steps_per_epoch_cap():
     """A steps_per_epoch cap that falls mid-slab truncates the final
     slab instead of over-training (5 steps at unroll=4 -> slabs of
@@ -264,6 +267,7 @@ def test_unroll_invalid_rejected():
         exp.run()
 
 
+@pytest.mark.slow
 def test_unroll_conv_forward_exact_backward_within_ulp_drift():
     """The documented conv caveat (build_multi_step docstring): the
     FORWARD is bit-identical under scan (step-0 loss/metrics agree

@@ -36,6 +36,7 @@ from zookeeper_tpu.models.resnet import ResNet50, ResNet101, ResNet152
 from zookeeper_tpu.models.transformer import (
     TransformerLM,
     TransformerLMModule,
+    greedy_decode,
 )
 from zookeeper_tpu.models.summary import ModelSummary, model_summary
 
@@ -43,6 +44,7 @@ __all__ = [
     "import_keras_weights",
     "keras_transpose_kernel",
     "ModelSummary",
+    "greedy_decode",
     "model_summary",
     "BinaryAlexNet",
     "BinaryDenseNet28",

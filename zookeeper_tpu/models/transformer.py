@@ -195,6 +195,47 @@ class TransformerLMModule(nn.Module):
         )
 
 
+def greedy_decode(
+    module: nn.Module, variables: Any, prompt: Any, steps: int
+) -> jax.Array:
+    """Greedy argmax continuation: ``[batch, t0]`` int tokens ->
+    ``[batch, t0 + steps]``. Each step recomputes the FULL context
+    (one jitted forward per emitted token, no KV cache) — a smoke/debug
+    utility for eyeballing what a trained LM memorized and the seed of
+    a future incremental-decode serving path, not a serving path
+    itself. Deterministic by construction (argmax, no sampling).
+
+    The module's positional table bounds the total length: building
+    with ``max_seq_len`` headroom (an explicit capacity larger than
+    the training ``seq_len``) is what makes room to decode past the
+    training window.
+    """
+    if steps < 0:
+        raise ValueError(f"steps={steps} must be >= 0.")
+    tokens = jnp.asarray(prompt)
+    if tokens.ndim != 2:
+        raise ValueError(
+            f"prompt must be [batch, t0] int tokens, got {tokens.shape}."
+        )
+    cap = getattr(module, "max_seq_len", None)
+    if cap is not None and tokens.shape[1] + steps > cap:
+        raise ValueError(
+            f"prompt length {tokens.shape[1]} + steps {steps} exceeds "
+            f"the positional table capacity {cap}; build the model with "
+            "a larger max_seq_len to decode further."
+        )
+    # One executable per total length (steps distinct compiles): fine
+    # for a smoke utility; an incremental decoder would bucket lengths.
+    forward = jax.jit(
+        lambda v, t: module.apply(v, t, training=False)
+    )
+    for _ in range(int(steps)):
+        logits = forward(variables, tokens)
+        nxt = jnp.argmax(logits[:, -1, :], axis=-1).astype(tokens.dtype)
+        tokens = jnp.concatenate([tokens, nxt[:, None]], axis=1)
+    return tokens
+
+
 @component
 class TransformerLM(Model):
     """Causal LM model component (see module docstring).
@@ -219,6 +260,20 @@ class TransformerLM(Model):
     #: sequence exceeds an explicit capacity.
     max_seq_len: int = Field(-1)
 
+    def set_attention_override(self, fn) -> None:
+        """The partitioner injection seam (``Partitioner.prepare_model``):
+        a mesh-owning partitioner (``SequenceParallelPartitioner``)
+        installs its attention callable here BEFORE ``build()``, which
+        then takes precedence over the string ``attention`` Field — so
+        sequence-parallel recipes drive from the CLI without hand-wiring
+        callables into model configs. ``None`` clears the override."""
+        if fn is not None and not callable(fn):
+            raise ValueError(
+                f"attention override must be callable(q, k, v, *, "
+                f"causal) or None, got {fn!r}."
+            )
+        object.__setattr__(self, "_attention_override", fn)
+
     def build(self, input_shape: Sequence[int], num_classes: int) -> nn.Module:
         if len(input_shape) != 1:
             raise ValueError(
@@ -227,8 +282,12 @@ class TransformerLM(Model):
             )
         # One source of truth for valid tiers (the Field is a string;
         # callables plug in at the MODULE level — see
-        # ``_resolve_attention``).
-        _resolve_attention(self.attention)
+        # ``_resolve_attention``). An injected override (the
+        # partitioner seam above) wins over the Field.
+        attention = getattr(self, "_attention_override", None)
+        if attention is None:
+            _resolve_attention(self.attention)
+            attention = self.attention
         if self.d_model % self.num_heads != 0:
             raise ValueError(
                 f"d_model={self.d_model} not divisible by "
@@ -256,7 +315,7 @@ class TransformerLM(Model):
             d_model=self.d_model,
             num_heads=self.num_heads,
             mlp_ratio=self.mlp_ratio,
-            attention=self.attention,
+            attention=attention,
             max_seq_len=max_seq_len,
             dtype=self.dtype(),
         )

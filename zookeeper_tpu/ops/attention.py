@@ -100,6 +100,18 @@ def _check_self_attention_shapes(q, k, v):
         )
 
 
+def _ring_rotate(k_blk, v_blk, axis_name, n):
+    """One ring hop: device i sends its K/V block to i-1, so after t
+    hops device r holds the block that originated on (r + t) % n. The
+    final hop of a full ring returns the blocks home (and keeps the
+    scan body uniform)."""
+    perm = [(i, (i - 1) % n) for i in range(n)]
+    return (
+        lax.ppermute(k_blk, axis_name, perm),
+        lax.ppermute(v_blk, axis_name, perm),
+    )
+
+
 def ring_attention_local(
     q: jax.Array,
     k: jax.Array,
@@ -108,10 +120,25 @@ def ring_attention_local(
     axis_name: str,
     causal: bool = False,
     scale: Optional[float] = None,
+    overlap: bool = True,
 ) -> jax.Array:
     """The per-device ring program (call INSIDE shard_map/pjit with
     ``q/k/v`` already sequence-sharded: ``[batch, seq/n, heads, hd]``
     local shards, mesh axis ``axis_name`` of size n).
+
+    ``overlap`` selects the DOUBLE-BUFFERED schedule (default): each
+    scan step issues the next shard's ``ppermute``s FIRST, then runs
+    the current block's attention on the held buffers — the rotation's
+    only dependency is the held K/V, so the ICI transfer proceeds
+    concurrently with the block compute (XLA's async
+    collective-permute-start/done pair brackets the whole block
+    program) instead of starting after it. Two K/V buffers are live per
+    step (the held pair and the in-flight pair) — the double-buffer
+    cost, +O(S/n) HBM. ``overlap=False`` keeps the sequential order
+    (permute issued after the compute, the pre-overlap schedule): the
+    dataflow is IDENTICAL either way — same ops on the same operands,
+    only issue order changes — so outputs are bit-identical; the knob
+    exists for A/B timing and as the measured-regression escape hatch.
     """
     _check_self_attention_shapes(q, k, v)
     if scale is None:
@@ -126,6 +153,10 @@ def ring_attention_local(
 
     def step(carry, _):
         k_blk, v_blk, t, m, l, acc = carry
+        if overlap:
+            # Prefetch: the next shard's rotation is in flight while
+            # this block computes (see docstring).
+            k_nxt, v_nxt = _ring_rotate(k_blk, v_blk, axis_name, n)
         s = jnp.einsum(
             "bhqd,bkhd->bhqk",
             qf,
@@ -154,14 +185,9 @@ def ring_attention_local(
             v_blk.astype(jnp.float32),
             precision=lax.Precision.HIGHEST,
         )
-        # Rotate K/V one hop: device i sends to i-1, so after t steps
-        # device r holds the block that originated on (r + t) % n. The
-        # final rotation returns the blocks home (and keeps the scan
-        # body uniform).
-        perm = [(i, (i - 1) % n) for i in range(n)]
-        k_blk = lax.ppermute(k_blk, axis_name, perm)
-        v_blk = lax.ppermute(v_blk, axis_name, perm)
-        return (k_blk, v_blk, t + 1, m, l, acc), None
+        if not overlap:
+            k_nxt, v_nxt = _ring_rotate(k_blk, v_blk, axis_name, n)
+        return (k_nxt, v_nxt, t + 1, m, l, acc), None
 
     # Initial carries DERIVED from qf (zero-cost arithmetic): under
     # shard_map's varying-manual-axes tracking, a scan's carry must
@@ -277,6 +303,7 @@ def ring_attention(
     batch_axis: Optional[str] = None,
     causal: bool = False,
     scale: Optional[float] = None,
+    overlap: bool = True,
 ) -> jax.Array:
     """One-call sequence-parallel attention: shards ``q/k/v``'s
     sequence dim over ``mesh``'s ``seq_axis`` and runs the ring.
@@ -286,9 +313,13 @@ def ring_attention(
     ``batch_axis`` additionally shards the batch dim (the realistic
     dp x sp pod layout — attention is batch-elementwise, so each
     data-shard runs its own independent ring over ``seq_axis``).
+    ``overlap`` selects the double-buffered comm-overlapped ring
+    schedule (default; bit-identical values — see
+    :func:`ring_attention_local`).
     """
+    local = partial(ring_attention_local, overlap=overlap)
     return _sharded_attention_call(
-        ring_attention_local, q, k, v,
+        local, q, k, v,
         mesh=mesh, seq_axis=seq_axis, batch_axis=batch_axis,
         causal=causal, scale=scale,
     )
@@ -940,6 +971,7 @@ def ring_flash_attention_local(
     block_q: Optional[int] = None,
     block_k: Optional[int] = None,
     interpret: Optional[bool] = None,
+    overlap: bool = True,
 ) -> jax.Array:
     """The composed tier — flash WITHIN the chip, ring ACROSS chips:
     the per-device ring program whose block compute is the Pallas flash
@@ -948,7 +980,10 @@ def ring_flash_attention_local(
     sequence is sharded over ``axis_name``. Exact full attention; fully
     differentiable (the flash kernels carry their ``custom_vjp``, the
     merge is plain jnp, and ``ppermute``'s backward is the inverse
-    rotation).
+    rotation). ``overlap`` selects the double-buffered schedule — the
+    next shard's rotation is issued BEFORE the flash block compute so
+    the ICI hop hides under the kernel (bit-identical values; see
+    :func:`ring_attention_local` for the schedule contract).
 
     Each ring step computes ``(o_t, lse_t)`` for the held K/V block via
     the flash forward (which emits the per-row log-sum-exp) and folds it
@@ -996,6 +1031,10 @@ def ring_flash_attention_local(
 
     def step(carry, _):
         k_blk, v_blk, t, o, lse = carry
+        if overlap:
+            # Double-buffered schedule: the next shard is in flight on
+            # the ICI ring while the flash kernel runs on the held one.
+            k_nxt, v_nxt = _ring_rotate(k_blk, v_blk, axis_name, n)
         if causal:
             src = (my + t) % n
 
@@ -1016,10 +1055,9 @@ def ring_flash_attention_local(
         else:
             o_t, lse_t = flash_block(k_blk, v_blk, False)
         o, lse = merge(o, lse, o_t, lse_t)
-        perm = [(i, (i - 1) % n) for i in range(n)]
-        k_blk = lax.ppermute(k_blk, axis_name, perm)
-        v_blk = lax.ppermute(v_blk, axis_name, perm)
-        return (k_blk, v_blk, t + 1, o, lse), None
+        if not overlap:
+            k_nxt, v_nxt = _ring_rotate(k_blk, v_blk, axis_name, n)
+        return (k_nxt, v_nxt, t + 1, o, lse), None
 
     # Carries derived from q for identical device-varying provenance on
     # every mesh shape (see ring_attention_local's init note).
@@ -1045,17 +1083,21 @@ def ring_flash_attention(
     block_q: Optional[int] = None,
     block_k: Optional[int] = None,
     interpret: Optional[bool] = None,
+    overlap: bool = True,
 ) -> jax.Array:
     """One-call composed-tier attention — same contract as
     :func:`ring_attention` (global arrays, sequence sharded over
     ``seq_axis``, optional ``batch_axis``), with the Pallas flash
     kernel as each device's block compute: O(block) VMEM within the
-    chip, O(S/n) HBM per chip across the ring."""
+    chip, O(S/n) HBM per chip across the ring. ``overlap`` selects the
+    double-buffered comm-overlapped schedule (default; bit-identical
+    values)."""
     local = partial(
         ring_flash_attention_local,
         block_q=block_q,
         block_k=block_k,
         interpret=interpret,
+        overlap=overlap,
     )
     # check_vma off: Pallas' interpret-mode lowering builds internal
     # dynamic_slices whose index operands carry no varying-manual-axes

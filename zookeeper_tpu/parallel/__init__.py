@@ -21,7 +21,9 @@ from zookeeper_tpu.parallel.rules import (
     auto_fsdp_rules,
     conv_model_tp_rules,
     match_partition_rules,
+    transformer_tp_rules,
 )
+from zookeeper_tpu.parallel.sequence import SequenceParallelPartitioner
 from zookeeper_tpu.parallel.distributed import (
     DistributedRuntime,
     initialize_distributed,
@@ -41,8 +43,10 @@ __all__ = [
     "MeshPartitioner",
     "Partitioner",
     "PartitionRule",
+    "SequenceParallelPartitioner",
     "SingleDevicePartitioner",
     "conv_model_tp_rules",
     "initialize_distributed",
     "match_partition_rules",
+    "transformer_tp_rules",
 ]

@@ -37,6 +37,14 @@ class Partitioner:
     def mesh(self) -> Optional[Mesh]:
         return None
 
+    def prepare_model(self, model: Any) -> None:
+        """Hook called before ``model.build()`` (the experiment does it
+        in ``build_state``): a partitioner that owns part of the MODEL
+        program — e.g. ``SequenceParallelPartitioner`` injecting its
+        mesh-bound attention callable — wires it here, so recipes stay
+        config-first instead of hand-wiring callables into models.
+        Default: no-op."""
+
     def batch_sharding(self) -> Optional[NamedSharding]:
         """Sharding for host->device prefetch of batches (None = default
         device placement)."""

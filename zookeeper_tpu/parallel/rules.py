@@ -48,6 +48,27 @@ def conv_model_tp_rules(model_axis: str = "model") -> List[PartitionRule]:
     ]
 
 
+def transformer_tp_rules(model_axis: str = "model") -> List[PartitionRule]:
+    """Megatron-style tensor-parallel rules for the TransformerLM
+    family: the fused qkv and MLP up projections are COLUMN-parallel
+    (output features over ``model_axis``), the attention output and MLP
+    down projections ROW-parallel (input features over ``model_axis``)
+    — each block then needs exactly one all-reduce per projection pair
+    (Korthikanti et al., 2022; XLA inserts it from the shardings).
+    Embedding / positional tables and RMSNorm scales replicate (the
+    weight-tied LM head reads the replicated embedding). Matched
+    against full state paths, so Adam moments co-shard automatically.
+    """
+    P = PartitionSpec
+    # (^|/)-anchored segment names: re.search on '/'-joined paths would
+    # otherwise shard any layer merely ENDING in one of these names
+    # ('warmup/kernel', 'breakdown/kernel') on the wrong axis, silently.
+    return [
+        (r"(^|/)(qkv|up)/kernel$", P(None, model_axis)),
+        (r"(^|/)(proj|down)/kernel$", P(model_axis, None)),
+    ]
+
+
 def auto_fsdp_rules(
     params: Any,
     axis_size: int,

@@ -1,0 +1,228 @@
+"""Sequence parallelism as a config-native Partitioner.
+
+Before this module, the dp x sp ring-flash LM recipe — the repo's
+long-context flagship (trains s=16k where dense OOMs) — was the ONE
+capability not drivable from the ``key=value`` CLI: tests hand-wired
+``partial(ring_flash_attention, mesh=..., seq_axis="sp")`` into the
+model build. :class:`SequenceParallelPartitioner` closes that seam: it
+owns the ``("data", "sp")`` mesh (optionally ``("data", "sp",
+"model")`` with ``tp > 1``), shards batches on ``data`` and the
+SEQUENCE dimension on ``sp``, and injects the selected
+sequence-parallel attention callable into the model build through the
+``Partitioner.prepare_model`` hook — so
+
+    python examples/lm_experiment.py TrainLM \\
+        partitioner=SequenceParallelPartitioner partitioner.sp=4 ...
+
+trains end-to-end with checkpoint/EMA/metrics/unroll/resume riding
+unchanged through ``Experiment.run()``.
+
+Axis ownership (docs/DESIGN.md §11): the PARTITIONER owns the mesh and
+the batch/state shardings; the ATTENTION OP owns the sequence-sharded
+layout inside its shard_map (ring rotation or all_to_all re-shard); the
+MODEL stays mesh-ignorant — it receives an opaque attention callable
+and turns its residual-stream activation pins off
+(``models.transformer._auto_pin_activations``), because the canonical
+batch/channel pin would read ``sp`` as a channel axis and fight the
+sequence sharding. Everything between attention calls is an ordinary
+pjit program GSPMD lays out from the batch/param shardings.
+"""
+
+from typing import Any, Callable, List, Sequence
+
+from jax.sharding import NamedSharding, PartitionSpec
+
+from zookeeper_tpu.core import Field, component
+from zookeeper_tpu.parallel.partitioner import MeshPartitioner, _device_mesh
+from zookeeper_tpu.parallel.rules import PartitionRule, transformer_tp_rules
+
+#: The attention flavors the ``attention`` Field selects, mapped to
+#: their ops-layer entry points (all share the q/k/v [b, s, h, d]
+#: global-array contract of ``ops.attention``).
+SP_ATTENTION_FLAVORS = ("ring_flash", "ring", "ulysses")
+
+
+@component
+class SequenceParallelPartitioner(MeshPartitioner):
+    """dp x sp (x tp) partitioner for sequence-parallel attention models.
+
+    ``sp`` is the sequence-parallel degree (the ring/all_to_all axis);
+    ``dp`` the data-parallel degree (-1 infers it from the device
+    count); ``tp > 1`` adds a Megatron-style ``model`` axis with
+    :func:`transformer_tp_rules` as the default rule table (explicit
+    ``with_rules`` overrides). Batches shard ``[batch, seq]`` as
+    ``P("data", "sp")`` — the sequence dim is sharded ON THE HOST
+    PREFETCH, so no device ever materializes the full sequence of a
+    global batch; params and optimizer state replicate over ``data``
+    and ``sp`` (shard over ``model`` per the rules).
+
+    Contract: the model must expose ``set_attention_override`` (the
+    TransformerLM family does); the global sequence length must divide
+    ``sp`` and the global batch must divide ``dp``. Initialization
+    dummies (batch 1) fall back to a batch-replicated attention call —
+    value-identical, since attention is batch-elementwise.
+    """
+
+    #: Sequence-parallel degree; -1 = all devices not taken by dp/tp.
+    sp: int = Field(-1)
+    #: Data-parallel degree; -1 = inferred from the device count.
+    dp: int = Field(-1)
+    #: Tensor-parallel degree over a trailing "model" axis (1 = off).
+    tp: int = Field(1)
+    #: Attention flavor injected into the model: "ring_flash" (flash
+    #: kernels inside the ppermute ring — the long-context default),
+    #: "ring" (dense block compute), or "ulysses" (all_to_all head
+    #: re-shard; needs heads % sp == 0).
+    attention: str = Field("ring_flash")
+    #: Ulysses' per-device compute: "flash" (long-context) or "dense".
+    ulysses_local: str = Field("flash")
+    #: Ring schedule: True = double-buffered comm-overlapped prefetch
+    #: (bit-identical values; see ops.attention.ring_attention_local),
+    #: False = the sequential issue order (A/B timing escape hatch).
+    overlap: bool = Field(True)
+
+    data_axes: Sequence[str] = Field(("data",))
+
+    def setup(self) -> None:
+        if self._mesh is not None:
+            return
+        from zookeeper_tpu.core import configured_field_names
+
+        ignored = {"mesh_shape", "mesh_axes", "data_axes"} & set(
+            configured_field_names(self)
+        )
+        if ignored:
+            # The inherited MeshPartitioner Fields would be silently
+            # ignored (this partitioner derives its mesh from sp/dp/tp)
+            # — training on a different layout than the config states.
+            raise ValueError(
+                f"SequenceParallelPartitioner derives its mesh from "
+                f"sp/dp/tp; the configured {sorted(ignored)} would be "
+                "ignored. Set partitioner.sp / partitioner.dp / "
+                "partitioner.tp instead (or use MeshPartitioner for an "
+                "arbitrary layout)."
+            )
+        if self.attention not in SP_ATTENTION_FLAVORS:
+            raise ValueError(
+                f"partitioner.attention={self.attention!r} unknown; "
+                f"choose one of {'/'.join(SP_ATTENTION_FLAVORS)}."
+            )
+        if self.ulysses_local not in ("flash", "dense"):
+            raise ValueError(
+                f"partitioner.ulysses_local={self.ulysses_local!r} "
+                "unknown; choose flash/dense."
+            )
+        # Flavor-inapplicable knobs are the same config-says-one-thing
+        # hazard as the inherited mesh Fields above: reject rather than
+        # silently ignore.
+        explicit = set(configured_field_names(self))
+        if self.attention == "ulysses" and "overlap" in explicit:
+            raise ValueError(
+                "partitioner.overlap only applies to the ring flavors; "
+                "attention=ulysses has no ring schedule to overlap."
+            )
+        if self.attention != "ulysses" and "ulysses_local" in explicit:
+            raise ValueError(
+                f"partitioner.ulysses_local only applies to "
+                f"attention=ulysses (got attention={self.attention!r})."
+            )
+        if self.tp < 1:
+            raise ValueError(f"tp={self.tp} must be >= 1.")
+        if self.sp == 0 or self.sp < -1 or self.dp == 0 or self.dp < -1:
+            raise ValueError(
+                f"sp={self.sp} / dp={self.dp}: expected a positive "
+                "degree or -1 (infer)."
+            )
+        dp, sp = self.dp, self.sp
+        if dp == -1 and sp == -1:
+            # Wholly unspecified: everything onto the sequence axis —
+            # the long-context posture this partitioner exists for.
+            dp = 1
+        sizes = [dp, sp]
+        axes = ["data", "sp"]
+        if self.tp > 1:
+            sizes.append(self.tp)
+            axes.append("model")
+        object.__setattr__(
+            self,
+            "_mesh",
+            _device_mesh(tuple(sizes), tuple(axes), self.num_devices),
+        )
+
+    @property
+    def rules(self) -> List[PartitionRule]:
+        override = getattr(self, "_rules_override", None)
+        if override is not None:
+            return override
+        # tp shards the transformer projections Megatron-style by
+        # default; without tp everything replicates (pure dp x sp).
+        return transformer_tp_rules() if self.tp > 1 else []
+
+    def batch_sharding(self) -> NamedSharding:
+        # [batch, seq] token batches: batch over data, SEQUENCE over sp
+        # — the host prefetch already lands each device's sequence
+        # shard, so the full sequence never materializes per device.
+        return NamedSharding(self.mesh, PartitionSpec("data", "sp"))
+
+    def slab_sharding(self) -> NamedSharding:
+        # [unroll, batch, seq] slabs: scan axis replicated (the fused
+        # multi-step contract), then the batch sharding's layout.
+        return NamedSharding(self.mesh, PartitionSpec(None, "data", "sp"))
+
+    def _with_activation_scope(self, fn: Callable) -> Callable:
+        # No ambient activation scope: the SP attention op owns the
+        # sequence-sharded layout inside its shard_map, and the
+        # canonical batch/channel pin would read "sp" (a non-data axis)
+        # as a CHANNEL axis and pin d_model over the sequence axis —
+        # exactly the fight _auto_pin_activations turns the model-side
+        # pins off for. GSPMD propagates the rest from the batch/param
+        # shardings.
+        return fn
+
+    def attention_callable(self) -> Callable:
+        """The injected attention: the Field-selected flavor bound to
+        this partitioner's mesh. Resolved lazily per call so the one
+        callable serves real batches (batch sharded over ``data``) AND
+        init/summary dummies (batch 1, which cannot split over ``data``
+        — it runs batch-replicated instead, value-identical because
+        attention is batch-elementwise)."""
+        from zookeeper_tpu.ops import (
+            all_to_all_attention,
+            ring_attention,
+            ring_flash_attention,
+        )
+
+        self.setup()
+        mesh = self._mesh
+        flavor = self.attention
+        local = self.ulysses_local
+        overlap = self.overlap
+
+        def sp_attention(q, k, v, *, causal=False, scale=None):
+            batch_axis = (
+                "data" if q.shape[0] % mesh.shape["data"] == 0 else None
+            )
+            kw = dict(
+                mesh=mesh, seq_axis="sp", batch_axis=batch_axis,
+                causal=causal, scale=scale,
+            )
+            if flavor == "ring_flash":
+                return ring_flash_attention(q, k, v, overlap=overlap, **kw)
+            if flavor == "ring":
+                return ring_attention(q, k, v, overlap=overlap, **kw)
+            return all_to_all_attention(q, k, v, local_attention=local, **kw)
+
+        return sp_attention
+
+    def prepare_model(self, model: Any) -> None:
+        hook = getattr(model, "set_attention_override", None)
+        if hook is None:
+            raise ValueError(
+                f"SequenceParallelPartitioner requires a model with an "
+                f"attention-injection seam (set_attention_override); "
+                f"{type(model).__name__} has none. Sequence parallelism "
+                "shards the sequence dimension of attention — it cannot "
+                "apply to the CNN zoo; use MeshPartitioner/"
+                "FsdpPartitioner there."
+            )
+        hook(self.attention_callable())

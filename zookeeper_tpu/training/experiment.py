@@ -228,6 +228,10 @@ class TrainingExperiment(Experiment):
     def build_state(self) -> TrainState:
         """Build module + optimizer and initialize the TrainState."""
         input_shape = self.loader.preprocessing.input_shape
+        # Mesh-owning partitioners wire themselves into the model here
+        # (e.g. SequenceParallelPartitioner injecting its attention
+        # callable) — the config-first seam; a no-op for the rest.
+        self.partitioner.prepare_model(self.model)
         module = self.model.build(input_shape, self.num_classes)
         params, model_state = self.model.initialize(
             module, input_shape, seed=self.seed
@@ -875,6 +879,16 @@ class EvalExperiment(Experiment):
     verbose: bool = Field(True)
     #: Also report top-5 accuracy (ImageNet companion metric).
     track_top5: bool = Field(False)
+    #: LM headline metrics: derive ``perplexity`` (e^CE) and
+    #: ``bits_per_token`` (CE / ln 2) from the split's weighted-mean
+    #: cross-entropy. Derived AFTER aggregation — ``exp`` is convex, so
+    #: a per-batch perplexity mean would overstate the true
+    #: whole-split perplexity; the weighted CE mean is the exact
+    #: token-level mean (every position contributes one CE term and
+    #: batches are example-weighted). The existing CE/accuracy already
+    #: broadcast over positions (rank-general metrics), so this is
+    #: pure arithmetic on the aggregate — no LM-specific eval step.
+    track_lm_metrics: bool = Field(False)
 
     @Field
     def num_classes(self) -> int:
@@ -909,6 +923,9 @@ class EvalExperiment(Experiment):
         partitioner.setup()
 
         input_shape = self.loader.preprocessing.input_shape
+        # Same partitioner->model seam as training (the SP attention
+        # callable must be injected before build for dp x sp eval).
+        partitioner.prepare_model(self.model)
         module = self.model.build(input_shape, self.num_classes)
         # The unified inference loader (shared with the serving engine):
         # model-only export OR full Checkpointer directory, EMA-vs-raw
@@ -941,6 +958,12 @@ class EvalExperiment(Experiment):
         )
         if not metrics:
             raise ValueError(f"Split {self.split!r} produced no batches.")
+        if self.track_lm_metrics:
+            import math
+
+            ce = metrics["loss"]
+            metrics["perplexity"] = math.exp(ce)
+            metrics["bits_per_token"] = ce / math.log(2.0)
         if self.verbose:
             line = " ".join(f"{k}={v:.4f}" for k, v in sorted(metrics.items()))
             print(f"eval[{self.split}] {line}", flush=True)
