@@ -715,6 +715,127 @@ def measure_shed_overload(env=None):
     }
 
 
+def measure_checkpoint_stall(env=None):
+    """``ZK_BENCH_CKPT=1`` leg: the training-thread cost of a
+    checkpoint save, sync vs async, at the same cadence — the number
+    the async checkpointer exists to move (docs/DESIGN.md §12). Both
+    modes drive the REAL Checkpointer over a real jitted train step:
+
+    - ``ckpt_sync_save_stall_ms``: full blocking serialize+write on the
+      training thread (``mode="sync"``, orbax-synchronous).
+    - ``ckpt_async_save_stall_ms``: device→host snapshot + queue
+      hand-off only (``mode="async"``); the write overlaps the steps
+      that follow.
+    - ``ckpt_steps_overlapped_per_save``: train steps that completed
+      while the async write was still in flight — the work a sync save
+      would have stalled.
+
+    Knobs: ``ZK_BENCH_CKPT_HIDDEN`` (Mlp width, default 512 — ~1.2M
+    params so the serialize cost is visible), ``ZK_BENCH_CKPT_SAVES``
+    (timed saves per mode, default 5)."""
+    import shutil
+    import tempfile
+    import time
+
+    import jax
+    import numpy as np
+    import optax
+
+    from zookeeper_tpu.core import configure
+    from zookeeper_tpu.models.simple import Mlp
+    from zookeeper_tpu.training import (
+        Checkpointer,
+        TrainState,
+        make_train_step,
+    )
+
+    env = os.environ if env is None else env
+    hidden = int(env.get("ZK_BENCH_CKPT_HIDDEN", "512"))
+    saves = int(env.get("ZK_BENCH_CKPT_SAVES", "5"))
+
+    model = Mlp()
+    configure(
+        model, {"hidden_units": (hidden, hidden)}, name="ckpt_bench_model"
+    )
+    module = model.build((28, 28, 1), 10)
+    params, model_state = model.initialize(module, (28, 28, 1))
+    state0 = TrainState.create(
+        apply_fn=module.apply,
+        params=params,
+        model_state=model_state,
+        tx=optax.adam(1e-3),
+    )
+    state_mb = sum(
+        np.asarray(leaf).nbytes for leaf in jax.tree.leaves(state0.params)
+    ) / 1e6
+    rng = np.random.default_rng(0)
+    batch = {
+        "input": rng.normal(size=(32, 28, 28, 1)).astype(np.float32),
+        "target": rng.integers(0, 10, 32),
+    }
+    step = jax.jit(make_train_step())
+    tmp = tempfile.mkdtemp(prefix="zk_bench_ckpt_")
+
+    def run_mode(mode):
+        ck = Checkpointer()
+        configure(
+            ck,
+            {
+                "directory": os.path.join(tmp, mode),
+                "mode": mode,
+                # The sync leg measures the FULL blocking serialize+
+                # write (the stall the async mode removes); orbax's own
+                # background commit would hide part of it.
+                "synchronous": True,
+                "save_every_epochs": 0,
+                "max_to_keep": 2,
+            },
+            name=f"ckpt_bench_{mode}",
+        )
+        st = state0
+        stalls, overlapped = [], []
+        # saves + 1 rounds: the first save pays one-time manager
+        # creation (and, async, writer-thread start) — excluded.
+        for i in range(saves + 1):
+            for _ in range(2):
+                st, m = step(st, batch)
+            jax.block_until_ready(m["loss"])
+            t0 = time.perf_counter()
+            ck.save(st, step=int(jax.device_get(st.step)))
+            stall = (time.perf_counter() - t0) * 1e3
+            if mode == "async":
+                k = 0
+                while ck.async_in_flight and k < 10_000:
+                    st, m = step(st, batch)
+                    jax.block_until_ready(m["loss"])
+                    k += 1
+                if i > 0:
+                    overlapped.append(k)
+            ck.wait()
+            if i > 0:
+                stalls.append(stall)
+        ck.close()
+        return float(np.mean(stalls)), (
+            float(np.mean(overlapped)) if overlapped else 0.0
+        )
+
+    try:
+        step(state0, batch)  # compile outside every timed window
+        sync_ms, _ = run_mode("sync")
+        async_ms, steps_overlapped = run_mode("async")
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    return {
+        "ckpt_sync_save_stall_ms": round(sync_ms, 3),
+        "ckpt_async_save_stall_ms": round(async_ms, 3),
+        "ckpt_async_stall_frac": round(async_ms / sync_ms, 4)
+        if sync_ms > 0
+        else -1.0,
+        "ckpt_steps_overlapped_per_save": round(steps_overlapped, 1),
+        "ckpt_state_mb": round(state_mb, 2),
+    }
+
+
 # The LM perf leg's pinned workload: the configuration behind
 # BASELINE.md's 187k tokens/s claim (TransformerLM 4L/d512/h8, flash
 # attention, s=8192, b=4, vocab 1024, bf16) — pinned so the number is
@@ -1385,6 +1506,21 @@ def main():
             )
             shed_metrics = None
 
+    # Checkpoint-stall leg (env-gated: several real orbax saves):
+    # sync vs async training-thread save stall + steps overlapped per
+    # async save — the async checkpointer's acceptance number.
+    ckpt_metrics = None
+    if _env_flag(os.environ, "ZK_BENCH_CKPT"):
+        try:
+            ckpt_metrics = measure_checkpoint_stall()
+        except Exception as e:  # never lose the primary metric
+            print(
+                f"checkpoint stall leg failed ({e}); omitting ckpt_*",
+                file=sys.stderr,
+                flush=True,
+            )
+            ckpt_metrics = None
+
     extras = {
         "model": model_name,
         "batch_size": batch_size,
@@ -1404,6 +1540,8 @@ def main():
         extras.update(recovery_metrics)
     if shed_metrics is not None:
         extras.update(shed_metrics)
+    if ckpt_metrics is not None:
+        extras.update(ckpt_metrics)
     if loop_time is not None:
         extras["unroll"] = unroll
         extras["loop_time_ms"] = round(loop_time * 1e3, 2)

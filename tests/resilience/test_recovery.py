@@ -329,7 +329,13 @@ def test_save_failure_exhausted_drops_without_crashing(tmp_path, caplog):
         with faults.injected(FaultPlan(fail_save_io=10)):
             assert ckpt.save(_tiny_state(1.0, 1), step=1) is False
     assert ckpt.latest_step() is None
-    assert any("dropping this save" in r.message for r in caplog.records)
+    # The final drop is LOUD: error level, step number, and the full
+    # exception chain — a thinning save cadence must not be missable
+    # in supervisor logs.
+    dropped = [r for r in caplog.records if "DROPPED" in r.message]
+    assert dropped and dropped[0].levelno == logging.ERROR
+    assert "step 1" in dropped[0].getMessage()
+    assert dropped[0].exc_info is not None
     ckpt.close()
 
 

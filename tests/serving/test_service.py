@@ -161,3 +161,71 @@ def test_serving_ema_requested_without_ema_errors(tmp_path):
     )
     with pytest.raises(ValueError, match="no ema_params"):
         svc.build_service()
+
+
+@pytest.mark.chaos
+def test_service_watch_streams_live_checkpoints(tmp_path):
+    """watch=True on the config tree: the service binds, warms, and the
+    watcher follows the training run's checkpoint directory — a second
+    epoch's save becomes the live weights without recompiling, and the
+    metrics gauge names the live step."""
+    exp = train_and_export(tmp_path, ema=False)
+
+    svc = make_service(
+        {
+            "height": 8,
+            "width": 8,
+            "num_classes": 10,
+            "checkpoint": str(tmp_path / "ckpt"),
+            "weights": "raw",
+            "watch": True,
+            # Long interval: the test polls deterministically itself.
+            "watch_poll_s": 3600.0,
+        }
+    )
+    engine, _ = svc.build_service()
+    watcher = svc.watcher
+    try:
+        warm = engine.compile_count
+        assert watcher.poll_once() in (None, 2)  # already newest
+
+        # The training run advances one more epoch; its save appears.
+        from zookeeper_tpu.core import configure as _configure
+        from zookeeper_tpu.training import TrainingExperiment
+
+        cont = TrainingExperiment()
+        _configure(
+            cont,
+            {
+                "loader.dataset": "SyntheticMnist",
+                "loader.dataset.num_train_examples": 64,
+                "loader.dataset.num_validation_examples": 16,
+                "loader.preprocessing": "ImageClassificationPreprocessing",
+                "loader.preprocessing.height": 8,
+                "loader.preprocessing.width": 8,
+                "loader.preprocessing.channels": 1,
+                "loader.host_index": 0,
+                "loader.host_count": 1,
+                "model": "Mlp",
+                "model.hidden_units": (8,),
+                "batch_size": 32,
+                "epochs": 2,
+                "verbose": False,
+                "validate": False,
+                "checkpointer.directory": str(tmp_path / "ckpt"),
+                "checkpointer.synchronous": True,
+            },
+            name="experiment2",
+        )
+        cont.run()
+        cont.checkpointer.close()
+
+        swapped = watcher.poll_once()
+        assert swapped == 4 and watcher.current_step == 4
+        assert engine.compile_count == warm  # hot swap, zero recompiles
+        assert svc.metrics.totals["serving_weights_step"] == 4
+        assert svc.metrics.totals["weight_swaps"] >= 1
+    finally:
+        watcher.stop()
+        svc.batcher.close()
+        exp.checkpointer.close()

@@ -1,0 +1,448 @@
+"""Async tiered checkpointing (training.async_checkpoint + the
+Checkpointer's mode="async"): crash-consistent finalize under injected
+kills/finalize failures, writer-thread IO-failure isolation, queue
+policies, retention tiers, and restore-vs-GC races — every leg walked
+deterministically (docs/DESIGN.md §12)."""
+
+import logging
+import os
+import shutil
+import threading
+
+import numpy as np
+import pytest
+
+from zookeeper_tpu.core import configure
+from zookeeper_tpu.resilience import FaultPlan, faults
+from zookeeper_tpu.training import Checkpointer, TrainingExperiment
+
+pytestmark = pytest.mark.chaos
+
+
+def make_experiment(extra_conf=None):
+    exp = TrainingExperiment()
+    conf = {
+        "loader.dataset": "SyntheticMnist",
+        "loader.dataset.num_train_examples": 256,
+        "loader.dataset.num_validation_examples": 0,
+        "loader.preprocessing": "ImageClassificationPreprocessing",
+        "loader.preprocessing.height": 28,
+        "loader.preprocessing.width": 28,
+        "loader.preprocessing.channels": 1,
+        "loader.host_index": 0,
+        "loader.host_count": 1,
+        "model": "Mlp",
+        "model.hidden_units": (32,),
+        "batch_size": 32,
+        "epochs": 1,
+        "validate": False,
+        "verbose": False,
+        **(extra_conf or {}),
+    }
+    configure(exp, conf, name="experiment")
+    return exp
+
+
+def async_conf(tmp_path, **extra):
+    return {
+        "checkpointer.directory": str(tmp_path / "ckpt"),
+        "checkpointer.mode": "async",
+        "checkpointer.save_every_epochs": 0,
+        "checkpointer.save_retry_backoff_s": 0.0,
+        **extra,
+    }
+
+
+def assert_states_equal(a, b):
+    import jax
+
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    assert len(la) == len(lb)
+    for xa, xb in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(xa), np.asarray(xb))
+
+
+def _tiny_state(value: float, step: int):
+    import jax.numpy as jnp
+    import optax
+
+    from zookeeper_tpu.training import TrainState
+
+    state = TrainState.create(
+        apply_fn=lambda *a, **k: None,
+        params={"w": jnp.full((2,), value)},
+        model_state={},
+        tx=optax.sgd(0.1),
+    )
+    return state.replace(step=jnp.asarray(step))
+
+
+def make_ckpt(tmp_path, **conf):
+    ckpt = Checkpointer()
+    configure(
+        ckpt,
+        {
+            "directory": str(tmp_path / "ck"),
+            "save_retry_backoff_s": 0.0,
+            **conf,
+        },
+        name="ckpt",
+    )
+    return ckpt
+
+
+# -- the async mode is the same checkpoint, written off-thread -----------
+
+
+def test_async_saves_restore_bit_identical_to_sync(tmp_path):
+    """An async-mode save of a state restores bit-identically to a
+    sync-mode save of the same state: one write protocol, two threads."""
+    for mode, sub in (("sync", "a"), ("async", "b")):
+        ckpt = make_ckpt(tmp_path / sub, mode=mode)
+        ckpt.save(_tiny_state(3.5, 7), step=7)
+        ckpt.wait()
+        restored = ckpt.restore_state(_tiny_state(0.0, 0))
+        assert int(np.asarray(restored.step)) == 7
+        np.testing.assert_array_equal(np.asarray(restored.params["w"]), 3.5)
+        ckpt.close()
+
+
+def test_async_mode_training_run_resumes_like_sync(tmp_path):
+    """End to end: async step-cadence checkpoints from a real training
+    run restore into an exact mid-epoch resume (the same contract the
+    sync mode pins in test_checkpoint.py)."""
+    import jax
+
+    ref = make_experiment({"epochs": 2})
+    ref.run()
+
+    conf = async_conf(tmp_path, **{"checkpointer.save_every_steps": 3})
+    exp = make_experiment({"epochs": 1, **conf})
+    exp.run()
+    assert exp.checkpointer.latest_step() == 6  # spe=8: saves at 3, 6
+    exp.checkpointer.close()
+
+    exp2 = make_experiment({"epochs": 2, **conf})
+    exp2.run()
+    assert int(jax.device_get(exp2.final_state.step)) == 16
+    assert_states_equal(ref.final_state.params, exp2.final_state.params)
+    assert_states_equal(
+        ref.final_state.opt_state, exp2.final_state.opt_state
+    )
+    exp2.checkpointer.close()
+
+
+def test_invalid_mode_and_policy_rejected(tmp_path):
+    for bad in (
+        {"checkpointer.mode": "background"},
+        {"checkpointer.queue_policy": "drop"},
+        {"checkpointer.durable_every_steps": -1},
+        # supersede may drop a better-ranked queued snapshot for a
+        # worse one: incompatible with best-ranking, by construction.
+        {
+            "checkpointer.queue_policy": "supersede",
+            "checkpointer.keep_best_metric": "accuracy",
+        },
+    ):
+        exp = make_experiment({**async_conf(tmp_path), **bad})
+        with pytest.raises(ValueError):
+            exp.run()
+
+
+# -- chaos: kill mid-async-write -----------------------------------------
+
+
+def test_kill_mid_async_write_restores_previous_finalized_step(tmp_path):
+    """THE crash-consistency pin: an async write that dies mid-write
+    (before its atomic finalize) leaves only an unfinalized remnant —
+    restore lands on the PREVIOUS finalized step, bit-exactly."""
+    import jax
+
+    # Reference: the state after exactly 3 steps (the surviving save).
+    ref = make_experiment({"steps_per_epoch": 3})
+    ref.run()
+
+    conf = async_conf(tmp_path, **{"checkpointer.save_every_steps": 3})
+    exp = make_experiment(conf)
+    with faults.injected(FaultPlan(kill_during_async_write=6)):
+        exp.run()  # spe=8: step-3 save lands, step-6 write is torn
+    exp.checkpointer.close()
+
+    # The torn write is invisible to discovery (unfinalized name), and
+    # its remnant is really on disk.
+    root = str(tmp_path / "ckpt")
+    names = os.listdir(root)
+    assert any(n.startswith("6.") for n in names), names
+    assert "6" not in names
+
+    ckpt = Checkpointer()
+    configure(ckpt, {"directory": root}, name="restore_ckpt")
+    restored = ckpt.restore_state(
+        exp.build_state()
+    )  # fresh structurally-matching state
+    assert int(jax.device_get(restored.step)) == 3
+    assert_states_equal(ref.final_state.params, restored.params)
+    assert_states_equal(ref.final_state.opt_state, restored.opt_state)
+    ckpt.close()
+
+
+def test_fail_async_finalize_retries_then_succeeds(tmp_path):
+    """A finalize failure (data written, rename didn't happen) is torn
+    on disk but retried by the writer: the retry lands the step and the
+    remnant never becomes restorable."""
+    ckpt = make_ckpt(tmp_path, mode="async")
+    with faults.injected(FaultPlan(fail_async_finalize=1)):
+        ckpt.save(_tiny_state(1.0, 4), step=4)
+        ckpt.wait()
+    assert ckpt.latest_step() == 4
+    writer = ckpt._writer()
+    assert writer.stats["finalized"] == 1
+    restored = ckpt.restore_state(_tiny_state(0.0, 0))
+    assert int(np.asarray(restored.step)) == 4
+    ckpt.close()
+
+
+def test_fail_async_finalize_exhausted_drops_and_earlier_step_restores(
+    tmp_path, caplog
+):
+    """Every finalize attempt failing drops the save LOUDLY (error log
+    with the step + exception chain) and restore falls back to the
+    previous step — the training thread never hears about any of it."""
+    ckpt = make_ckpt(tmp_path, mode="async", save_retries=0)
+    ckpt.save(_tiny_state(1.0, 2), step=2)
+    ckpt.wait()
+    with caplog.at_level(logging.ERROR, "zookeeper_tpu.training.checkpoint"):
+        with faults.injected(FaultPlan(fail_async_finalize=5)):
+            ckpt.save(_tiny_state(9.0, 4), step=4)
+            ckpt.wait()
+    dropped = [r for r in caplog.records if "DROPPED" in r.message]
+    assert dropped and dropped[0].exc_info is not None  # chain logged
+    assert ckpt.latest_step() == 2
+    restored = ckpt.restore_state(_tiny_state(0.0, 0))
+    assert int(np.asarray(restored.step)) == 2
+    ckpt.close()
+
+
+def test_writer_thread_save_io_failure_never_touches_training(tmp_path):
+    """fail_save_io consumed ON THE WRITER THREAD: the training loop
+    completes every epoch with zero exceptions and a final state
+    bit-identical to a run that never checkpointed; the failed save is
+    retried/dropped entirely in the background."""
+    ref = make_experiment()
+    ref.run()
+
+    conf = async_conf(
+        tmp_path,
+        **{
+            "checkpointer.save_every_steps": 3,
+            "checkpointer.save_retries": 0,
+        },
+    )
+    exp = make_experiment(conf)
+    with faults.injected(FaultPlan(fail_save_io=1)):
+        history = exp.run()  # the step-3 write fails+drops; step 6 lands
+    assert len(history["train"]) == 1
+    assert_states_equal(ref.final_state.params, exp.final_state.params)
+    assert sorted(
+        s for s, _ in exp.checkpointer._tier_entries()
+    ) == [6]
+    exp.checkpointer.close()
+
+
+# -- queue policies -------------------------------------------------------
+
+
+def _gated_writer_ckpt(tmp_path, policy):
+    """A checkpointer whose async writes block on a test-held gate, so
+    queue-policy behavior is exercised without any timing."""
+    ckpt = make_ckpt(tmp_path, mode="async", queue_policy=policy)
+    gate = threading.Event()
+    orig = ckpt._attempt_async_write
+
+    def gated(step, tree, metrics):
+        gate.wait(timeout=30)
+        return orig(step, tree, metrics)
+
+    object.__setattr__(ckpt, "_attempt_async_write", gated)
+    return ckpt, gate
+
+
+def test_supersede_policy_replaces_queued_snapshot(tmp_path):
+    """supersede: while one write is in flight, the QUEUED snapshot is
+    replaced by a newer one — the in-flight write still lands, the
+    superseded step never does, and the newest state wins."""
+    import time
+
+    ckpt, gate = _gated_writer_ckpt(tmp_path, "supersede")
+    ckpt.save(_tiny_state(1.0, 1), step=1)  # taken by the writer, gated
+    writer = ckpt._writer()
+    for _ in range(2000):
+        if writer._writing_step is not None:
+            break
+        time.sleep(0.001)
+    assert writer._writing_step == 1
+    ckpt.save(_tiny_state(2.0, 2), step=2)  # queued
+    ckpt.save(_tiny_state(3.0, 3), step=3)  # supersedes 2
+    gate.set()
+    ckpt.wait()
+    assert sorted(s for s, _ in ckpt._tier_entries()) == [1, 3]
+    assert writer.stats["superseded"] == 1
+    restored = ckpt.restore_state(_tiny_state(0.0, 0))
+    assert int(np.asarray(restored.step)) == 3
+    ckpt.close()
+
+
+def test_wait_policy_backpressures_and_writes_every_step(tmp_path):
+    """wait (default): the depth-1 queue blocks the submitter instead
+    of dropping — every submitted step lands, in order."""
+    ckpt, gate = _gated_writer_ckpt(tmp_path, "wait")
+    done = []
+
+    def submit_all():
+        for s in (1, 2, 3):
+            ckpt.save(_tiny_state(float(s), s), step=s)
+            done.append(s)
+
+    t = threading.Thread(target=submit_all)
+    t.start()
+    gate.set()
+    t.join(timeout=30)
+    assert not t.is_alive()
+    ckpt.wait()
+    assert sorted(s for s, _ in ckpt._tier_entries()) == [1, 2, 3]
+    assert ckpt._writer().stats["superseded"] == 0
+    ckpt.close()
+
+
+def test_preemption_drains_inflight_write_and_records_wait(tmp_path):
+    """The PreemptionGuard path under async mode: the in-flight write
+    lands before the final synchronous save, SIGTERM semantics are
+    unchanged (newest state on disk), and save_wait_ms is surfaced per
+    attempt by run_with_recovery."""
+    import jax
+
+    from zookeeper_tpu.resilience import run_with_recovery
+
+    ref = make_experiment({"epochs": 2})
+    ref.run()
+
+    conf = async_conf(tmp_path, **{"checkpointer.save_every_steps": 2})
+    exp = make_experiment({"epochs": 2, **conf})
+    with faults.injected(FaultPlan(kill_at_step=5)):
+        result = run_with_recovery(exp, backoff_s=0.0, sleep=lambda s: None)
+    assert result.restarts == 1
+    assert len(result.save_wait_ms) == 1
+    assert result.save_wait_ms[0] >= 0.0
+    assert len(result.restore_ms) == 1 and result.restore_ms[0] > 0
+    assert int(jax.device_get(exp.final_state.step)) == 16
+    assert_states_equal(ref.final_state.params, exp.final_state.params)
+    assert_states_equal(
+        ref.final_state.opt_state, exp.final_state.opt_state
+    )
+    exp.checkpointer.close()
+
+
+# -- retention tiers ------------------------------------------------------
+
+
+def test_durable_tier_promotes_and_restores_after_local_loss(tmp_path):
+    """Every-N local with GC + progress-based durable promotion (first
+    save, then every >= M steps of progress — cadence alignment can
+    never starve the tier): when the whole local tier is lost, restore
+    falls back to the newest durable step; when that one is torn too,
+    to the one before it."""
+    ckpt = make_ckpt(
+        tmp_path,
+        mode="async",
+        max_to_keep=2,
+        durable_every_steps=4,
+    )
+    for s in (2, 4, 6, 8):
+        ckpt.save(_tiny_state(float(s), s), step=s)
+    ckpt.wait()
+    # Local GC kept the newest 2; durable promoted the FIRST save, then
+    # the first save >= 4 steps later (2 -> 6; 4 and 8 are closer).
+    entries = ckpt._tier_entries()
+    assert [e for e in entries if e[1] == "local"] == [
+        (8, "local"), (6, "local"),
+    ]
+    assert [e for e in entries if e[1] == "durable"] == [
+        (6, "durable"), (2, "durable"),
+    ]
+    # Lose the ENTIRE local tier (the machine died; only the durable
+    # store survived).
+    for name in os.listdir(str(tmp_path / "ck")):
+        if name.isdigit():
+            shutil.rmtree(str(tmp_path / "ck" / name))
+    restored = ckpt.restore_state(_tiny_state(0.0, 0))
+    assert int(np.asarray(restored.step)) == 6
+    np.testing.assert_array_equal(np.asarray(restored.params["w"]), 6.0)
+    # Tear durable step 6 as well: the walk lands on durable 2.
+    from zookeeper_tpu.resilience import corrupt_checkpoint_dir
+
+    assert corrupt_checkpoint_dir(str(tmp_path / "ck" / "durable" / "6")) > 0
+    restored = ckpt.restore_state(_tiny_state(0.0, 0))
+    assert int(np.asarray(restored.step)) == 2
+    ckpt.close()
+
+
+def test_durable_tier_cannot_be_starved_by_cadence_misalignment(tmp_path):
+    """The promotion rule is progress-based, NOT step-number
+    divisibility: a save cadence whose step numbers never hit the
+    durable grid (saves at 64,128,... with durable_every_steps=100)
+    still fills the archival tier."""
+    ckpt = make_ckpt(tmp_path, durable_every_steps=100)
+    for s in (64, 128, 192, 256):
+        ckpt.save(_tiny_state(float(s), s), step=s)
+    ckpt.wait()
+    durable = [s for s, t in ckpt._tier_entries() if t == "durable"]
+    # 64 (first), then 192 (>= 100 past 64); 128 and 256 are closer.
+    assert sorted(durable) == [64, 192]
+    ckpt.close()
+
+
+def test_restore_survives_retention_gc_race(tmp_path, caplog):
+    """A step directory deleted between the walk's listing and its open
+    (the retention GC racing a restore) must fall through to the
+    next-newest step, not raise."""
+    ckpt = make_ckpt(tmp_path)
+    for s in (1, 2):
+        ckpt.save(_tiny_state(float(s), s), step=s)
+    ckpt.wait()
+    # The manager has listed steps [1, 2]; delete 2 from disk UNDER it,
+    # exactly what a concurrent GC (or operator rm) does mid-walk.
+    assert sorted(ckpt._manager().all_steps()) == [1, 2]
+    shutil.rmtree(str(tmp_path / "ck" / "2"))
+    with caplog.at_level(
+        logging.WARNING, "zookeeper_tpu.training.checkpoint"
+    ):
+        restored = ckpt.restore_state(_tiny_state(0.0, 0))
+    assert int(np.asarray(restored.step)) == 1
+    assert any("falling back" in r.message for r in caplog.records)
+    ckpt.close()
+
+
+# -- save retry backoff (satellite): jittered, loud on final drop --------
+
+
+def test_save_retry_backoff_rerandomized_per_attempt(tmp_path, monkeypatch):
+    """The retry backoff draws FRESH jitter every attempt (±50% around
+    the doubling base) — a fleet must decorrelate, not stampede —
+    and the final drop logs at error level with the exception chain."""
+    delays = []
+    monkeypatch.setattr(
+        "zookeeper_tpu.training.checkpoint.time.sleep", delays.append
+    )
+    ckpt = make_ckpt(
+        tmp_path, save_retries=4, save_retry_backoff_s=1.0
+    )
+    with faults.injected(FaultPlan(fail_save_io=10)):
+        assert ckpt.save(_tiny_state(1.0, 1), step=1) is False
+    assert len(delays) == 4
+    for attempt, d in enumerate(delays):
+        base = 1.0 * 2**attempt
+        assert 0.5 * base <= d <= 1.5 * base, (attempt, d)
+    # Re-randomized: the exact deterministic doubling (the old bug) is
+    # a measure-zero draw across four attempts.
+    assert delays != [1.0, 2.0, 4.0, 8.0]
+    ckpt.close()

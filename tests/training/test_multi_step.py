@@ -385,6 +385,53 @@ def test_unroll_kill_midepoch_recovery_bit_exact(tmp_path):
     exp.checkpointer.close()
 
 
+@pytest.mark.chaos
+def test_unroll_async_ckpt_kill_recovery_bit_exact(tmp_path):
+    """The SAME contract as above under checkpointer.mode="async": the
+    step-cadence saves ride the background writer (slab-boundary
+    snapshots overlapping the next slab), the kill drains the in-flight
+    write before the final synchronous save, and the recovered run is
+    STILL bit-identical to the uninterrupted eager reference — the
+    async path changes where the write runs, never what resumes."""
+    from zookeeper_tpu.resilience import (
+        FaultPlan,
+        Preempted,
+        faults,
+        run_with_recovery,
+    )
+
+    ref = make_experiment()  # uninterrupted eager reference, 2 epochs
+    h_ref = ref.run()
+
+    ckpt = {
+        "checkpointer.directory": str(tmp_path / "ckpt"),
+        "checkpointer.mode": "async",
+        "checkpointer.save_every_epochs": 0,
+        # Step-cadence saves flow through the writer while training
+        # continues; the preemption save is still synchronous.
+        "checkpointer.save_every_steps": 3,
+    }
+    exp = make_experiment({"unroll": 3, **ckpt})
+    with faults.injected(FaultPlan(kill_at_step=5)):
+        result = run_with_recovery(exp, backoff_s=0.0, sleep=lambda s: None)
+    assert result.restarts == 1
+    assert isinstance(result.causes[0], Preempted)
+    assert result.causes[0].step == 6 and result.causes[0].saved
+    # The async addition to the preemption budget is observable.
+    assert len(result.save_wait_ms) == 1 and result.save_wait_ms[0] >= 0.0
+
+    assert_states_equal(ref.final_state.params, exp.final_state.params)
+    assert_states_equal(
+        ref.final_state.opt_state, exp.final_state.opt_state
+    )
+    h_res = result.history
+    for k, v in h_ref["train"][1].items():
+        if k == "examples_per_sec":
+            continue
+        assert v == h_res["train"][1][k], k
+    exp.checkpointer.close()
+
+
 def test_unroll_with_ema_and_flip_free_extras_bit_exact():
     """Optional step extras (EMA, label smoothing) ride the scan
     unchanged."""

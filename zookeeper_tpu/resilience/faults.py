@@ -97,6 +97,18 @@ class FaultPlan:
     - ``serving_worker_crash``: the next N MicroBatcher worker dispatch
       iterations crash the worker thread (exercises worker-death
       cleanup + restart).
+    - ``fail_async_finalize``: the next N ASYNC checkpoint writes fail
+      at the finalize boundary — the data is written but never
+      atomically renamed into place, so a torn UNFINALIZED remnant is
+      left on disk (exactly the state a crash between write and rename
+      leaves) and the write reports failure to the writer's retry loop
+      (``fail_async_finalize=1`` == "once": the retry succeeds).
+    - ``kill_during_async_write``: the async write of THIS step dies
+      mid-write (one-shot, step-keyed like ``corrupt_checkpoint_step``):
+      a torn unfinalized remnant is left on disk and the write is
+      silently abandoned — no retry, no error to the training thread —
+      modeling the process being killed while the background writer was
+      mid-save. Restore must land on the previous finalized step.
     """
 
     kill_at_step: Optional[int] = None
@@ -104,12 +116,15 @@ class FaultPlan:
     fail_save_io: int = 0
     nan_at_step: Optional[int] = None
     serving_worker_crash: int = 0
+    fail_async_finalize: int = 0
+    kill_during_async_write: Optional[int] = None
 
     _lock: threading.Lock = field(
         default_factory=threading.Lock, repr=False, compare=False
     )
     _killed: bool = field(default=False, repr=False, compare=False)
     _corrupted: bool = field(default=False, repr=False, compare=False)
+    _async_killed: bool = field(default=False, repr=False, compare=False)
 
     # -- trigger points (called by the production hooks) -----------------
 
@@ -140,6 +155,29 @@ class FaultPlan:
         with self._lock:
             if self.serving_worker_crash > 0:
                 self.serving_worker_crash -= 1
+                return True
+        return False
+
+    def take_async_finalize_failure(self) -> bool:
+        """Consume one injected async-finalize failure (False when
+        exhausted)."""
+        with self._lock:
+            if self.fail_async_finalize > 0:
+                self.fail_async_finalize -= 1
+                return True
+        return False
+
+    def async_kill_due(self, step: int) -> bool:
+        """One-shot: True when the async write of ``step`` should die
+        mid-write (torn remnant on disk, write silently abandoned)."""
+        if self.kill_during_async_write is None:
+            return False
+        with self._lock:
+            if (
+                not self._async_killed
+                and int(step) == self.kill_during_async_write
+            ):
+                self._async_killed = True
                 return True
         return False
 

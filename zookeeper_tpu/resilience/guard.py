@@ -20,7 +20,7 @@ path and the real-SIGTERM path are one code path.
 
 import signal
 import threading
-from typing import Optional, Sequence
+from typing import Any, Optional, Sequence, Tuple
 
 from zookeeper_tpu.core import Field, component
 
@@ -101,6 +101,54 @@ class PreemptionGuard:
             # request_preemption() remains fully functional.
             st["prev"].clear()
         return self
+
+    def preemption_save(
+        self, checkpointer: Any, state: Any, global_step: int
+    ) -> Tuple[bool, float]:
+        """The ONE preemption-boundary save policy, shared by every
+        loop shape and both checkpoint modes (docs/DESIGN.md §10/§12):
+
+        1. Drain the async writer — the process is about to die, so any
+           queued/in-flight background write must land first. Under
+           ``queue_policy="supersede"`` the queued-but-not-started
+           snapshot is dropped instead (the final save below writes a
+           strictly newer state); the in-flight write always completes.
+        2. ONE SYNCHRONOUS save of exactly this boundary state (skipped
+           when a cadence save already landed on this step, or when
+           best-ranking retention makes a metric-less save unrankable —
+           the latest ranked save is then the resume point).
+        3. ``wait()`` so the bytes are durable before the grace window
+           closes.
+
+        Returns ``(saved, save_wait_ms)`` — the wait is the time spent
+        in step 1, the async-mode addition to the preemption budget
+        that ``run_with_recovery`` surfaces per attempt. SIGTERM
+        semantics are therefore UNCHANGED by async mode: the process
+        still exits having synchronously saved the newest state.
+        """
+        saved = False
+        wait_ms = 0.0
+        if checkpointer.enabled:
+            # Superseding the queued snapshot is only sound when the
+            # final save below actually replaces it with newer state;
+            # under best-ranking retention the final save is SKIPPED
+            # (metric-less saves are unrankable), so a queued ranked
+            # snapshot must be written out, not dropped.
+            supersede = (
+                checkpointer.queue_policy == "supersede"
+                and checkpointer.keep_best_metric is None
+            )
+            wait_ms = checkpointer.drain_async(supersede=supersede)
+            if checkpointer.keep_best_metric is not None:
+                # Rank-managed retention can't accept a metric-less
+                # save; the latest ranked save is the resume point.
+                saved = checkpointer.latest_step() is not None
+            elif checkpointer.latest_step() == global_step:
+                saved = True  # a cadence save just landed on this step
+            else:
+                saved = bool(checkpointer.save(state, sync=True))
+            checkpointer.wait()  # synchronous: the process may die next
+        return saved, wait_ms
 
     def uninstall(self) -> "PreemptionGuard":
         """Restore the pre-install handlers (idempotent)."""

@@ -54,6 +54,14 @@ class RecoveryResult:
     #: resumed run died before its first step; empty when the
     #: experiment doesn't report first-step timestamps.
     restore_ms: List[float] = field(default_factory=list)
+    #: Per PREEMPTED attempt: ms the preemption path spent waiting on
+    #: in-flight async checkpoint writes before its final synchronous
+    #: save (``PreemptionGuard.preemption_save``; 0.0 under
+    #: ``checkpointer.mode="sync"``). The async-mode addition to the
+    #: preemption grace-window budget, surfaced alongside
+    #: ``restore_ms`` so both halves of the recovery cost are
+    #: observable. Empty when the experiment doesn't report it.
+    save_wait_ms: List[float] = field(default_factory=list)
 
 
 def run_with_recovery(
@@ -90,11 +98,13 @@ def run_with_recovery(
         )
     causes: List[BaseException] = []
     restore_ms: List[float] = []
+    save_wait_ms: List[float] = []
     for attempt in range(max_restarts + 1):
         t_start = time.perf_counter()
         try:
             history = experiment.run()
         except recover_on as e:
+            _record_save_wait_ms(experiment, e, save_wait_ms)
             if (
                 isinstance(e, Preempted)
                 and e.signum == _signal.SIGINT
@@ -136,8 +146,26 @@ def run_with_recovery(
             restarts=attempt,
             causes=causes,
             restore_ms=restore_ms,
+            save_wait_ms=save_wait_ms,
         )
     raise AssertionError("unreachable")  # pragma: no cover
+
+
+def _record_save_wait_ms(
+    experiment: Any,
+    cause: BaseException,
+    save_wait_ms: List[float],
+) -> None:
+    """Save-wait latency of one PREEMPTED attempt (time the preemption
+    path spent draining in-flight async checkpoint writes before its
+    final synchronous save), read from the experiment's per-run probe.
+    Only ``Preempted`` exits performed a preemption save; other
+    recoverable exits carry no sample."""
+    if not isinstance(cause, Preempted):
+        return
+    wait = getattr(experiment, "save_wait_ms", None)
+    if wait is not None:
+        save_wait_ms.append(float(wait))
 
 
 def _record_restore_ms(
@@ -169,7 +197,8 @@ def measure_recovery_restore_ms(
     measured restore latency. ``make_experiment()`` must return a fresh
     experiment configured with a checkpoint directory; the SAME object
     is killed and resumed (matching the in-process supervisor flow).
-    Returns ``{"recovery_restore_ms": ..., "recovery_restarts": ...}``.
+    Returns ``{"recovery_restore_ms": ..., "recovery_restarts": ...,
+    "recovery_save_wait_ms": ...}``.
     """
     from zookeeper_tpu.resilience import faults
 
@@ -186,4 +215,10 @@ def measure_recovery_restore_ms(
     return {
         "recovery_restore_ms": round(result.restore_ms[-1], 2),
         "recovery_restarts": float(result.restarts),
+        # Time the preemption path waited on in-flight async writes
+        # before its final sync save (0.0 under mode="sync") — the
+        # other half of the recovery budget.
+        "recovery_save_wait_ms": round(
+            result.save_wait_ms[-1] if result.save_wait_ms else 0.0, 2
+        ),
     }

@@ -35,11 +35,12 @@ from zookeeper_tpu.serving.batcher import (
     RejectedError,
     WorkerCrashedError,
 )
-from zookeeper_tpu.serving.engine import InferenceEngine
+from zookeeper_tpu.serving.engine import CheckpointWatcher, InferenceEngine
 from zookeeper_tpu.serving.metrics import ServingMetrics
 from zookeeper_tpu.serving.service import ServingConfig
 
 __all__ = [
+    "CheckpointWatcher",
     "DeadlineExpiredError",
     "InferenceEngine",
     "MicroBatcher",

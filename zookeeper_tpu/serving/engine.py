@@ -34,13 +34,29 @@ independent (BatchNorm uses running stats, attention is causal), so a
 request's rows are bit-identical whichever bucket they ride in — the
 invariant the MicroBatcher's coalescing correctness rests on (pinned in
 tests/serving/).
+
+Checkpoint→serving streaming: ``swap_weights`` replaces the bound
+weights IN PLACE without touching the compile cache (the executables
+take the variables as an argument — same bucket shapes ⇒ same
+programs), and ``watch_checkpoints`` polls a live training run's
+``Checkpointer`` directory for newly FINALIZED steps and hot-swaps
+them in, turning train→export→serve into train→serve-continuously
+(docs/DESIGN.md §12). The swap is one Python reference assignment and
+``infer`` reads the reference exactly once per dispatch, so every
+micro-batch is served entirely by one weight version — atomic w.r.t.
+in-flight ``MicroBatcher`` dispatches by construction.
 """
 
+import logging
+import threading
+import time
 from typing import Any, Callable, Optional, Sequence, Tuple
 
 import numpy as np
 
 from zookeeper_tpu.core import Field, component
+
+logger = logging.getLogger(__name__)
 
 Array = Any
 
@@ -110,15 +126,13 @@ class InferenceEngine:
 
             partitioner = SingleDevicePartitioner()
         partitioner.setup()
-        variables = {"params": params, **dict(model_state or {})}
-        sharding = partitioner.variables_sharding(variables)
-        if sharding is not None:
-            variables = jax.tree.map(jax.device_put, variables, sharding)
-        else:
-            variables = jax.device_put(variables)
         object.__setattr__(self, "_apply_fn", apply_fn)
-        object.__setattr__(self, "_variables", variables)
         object.__setattr__(self, "_partitioner", partitioner)
+        object.__setattr__(
+            self,
+            "_variables",
+            self._place_variables({"params": params, **dict(model_state or {})}),
+        )
         object.__setattr__(self, "_input_shape", tuple(input_shape))
         object.__setattr__(
             self, "_dtype", np.dtype(dtype) if dtype is not None else np.float32
@@ -126,6 +140,119 @@ class InferenceEngine:
         object.__setattr__(self, "_cache", {})
         object.__setattr__(self, "_compile_count", 0)
         return self
+
+    def _place_variables(self, variables: Any) -> Any:
+        """Device placement under the bound partitioner's rules — the
+        ONE placement path shared by ``bind`` and ``swap_weights`` so a
+        hot-swapped weight set lands under exactly the layout the
+        cached executables were compiled for."""
+        import jax
+
+        sharding = self._partitioner.variables_sharding(variables)
+        if sharding is not None:
+            return jax.tree.map(jax.device_put, variables, sharding)
+        return jax.device_put(variables)
+
+    def swap_weights(self, params: Any, model_state: Any = None) -> None:
+        """Atomically replace the served weights WITHOUT recompiling.
+
+        The new tree must match the bound one in structure, leaf shapes,
+        and dtypes — the cached executables were compiled against those
+        (same shapes ⇒ same programs; anything else must fail loudly
+        here, not as an XLA argument error mid-request). The swap itself
+        is one reference assignment and ``infer`` reads the reference
+        exactly once per dispatch, so every in-flight micro-batch is
+        served entirely by the version it started with.
+        """
+        import jax
+
+        self._require_bound()
+        new = {"params": params, **dict(model_state or {})}
+        cur = self._variables
+        want_s, got_s = jax.tree.structure(cur), jax.tree.structure(new)
+        if want_s != got_s:
+            raise ValueError(
+                "swap_weights: new variables tree does not match the "
+                f"bound structure (bound {want_s}, got {got_s}); the "
+                "compiled buckets serve ONE architecture."
+            )
+        bad = [
+            f"{np.shape(g)}/{np.dtype(getattr(g, 'dtype', type(g)))} where "
+            f"the engine serves {np.shape(w)}/{np.dtype(w.dtype)}"
+            for w, g in zip(jax.tree.leaves(cur), jax.tree.leaves(new))
+            if tuple(np.shape(g)) != tuple(np.shape(w))
+            or np.dtype(getattr(g, "dtype", np.float32)) != np.dtype(w.dtype)
+        ]
+        if bad:
+            raise ValueError(
+                "swap_weights: leaf shape/dtype mismatch — "
+                + "; ".join(bad[:4])
+                + (" ..." if len(bad) > 4 else "")
+                + ". The cached executables were compiled for the bound "
+                "shapes; a differently-sized checkpoint needs a fresh "
+                "bind()."
+            )
+        placed = self._place_variables(new)
+        # Atomic w.r.t. dispatches: infer() snapshots this reference
+        # once per call.
+        object.__setattr__(self, "_variables", placed)
+
+    def watch_checkpoints(
+        self,
+        directory: str,
+        *,
+        weights: str = "ema",
+        poll_interval_s: float = 2.0,
+        metrics: Any = None,
+        start: bool = True,
+        initial_step: Optional[int] = None,
+    ) -> "CheckpointWatcher":
+        """Serve a LIVE training run: poll ``directory`` (a
+        ``Checkpointer`` tree) for newly finalized steps and hot-swap
+        each one in via :meth:`swap_weights` — no recompiles, no
+        restarts, each request served entirely by one weight version.
+        ``weights`` picks EMA vs raw exactly like the cold loaders
+        ("ema" is the ship-weights default for a run with ``ema_decay``
+        on; use "auto"/"raw" otherwise). ``start=False`` returns the
+        watcher unstarted for deterministic single-step polling
+        (``poll_once``) — the tier-1 test mode. ``metrics`` is an
+        optional :class:`~zookeeper_tpu.serving.metrics.ServingMetrics`
+        recording ``weight_swaps`` / ``weight_swap_ms`` /
+        ``serving_weights_step``. ``initial_step`` marks that step as
+        already live (the caller just bound its weights — e.g.
+        ``ServingConfig.build_service``), so the watcher does not
+        redundantly reload and re-swap it at startup.
+
+        With ``start=True`` the FIRST poll runs eagerly on the calling
+        thread: a configuration bug (``weights="ema"`` against an
+        EMA-less run, a structure mismatch) raises HERE, at the call
+        site, instead of silently killing the daemon thread."""
+        import os
+
+        self._require_bound()
+        if not os.path.isdir(os.path.expanduser(directory)):
+            # Not an error — serving may legitimately start before the
+            # training run's first save creates the directory — but a
+            # TYPO'd path would otherwise poll nothing forever with
+            # healthy-looking metrics. Name it loudly, once.
+            logger.warning(
+                "watch_checkpoints: %r does not exist (yet); polling "
+                "continues — if this path is misspelled, no checkpoint "
+                "will ever stream in",
+                directory,
+            )
+        watcher = CheckpointWatcher(
+            self,
+            directory,
+            weights=weights,
+            poll_interval_s=poll_interval_s,
+            metrics=metrics,
+            initial_step=initial_step,
+        )
+        if start:
+            watcher.poll_once()  # config errors surface synchronously
+            watcher.start()
+        return watcher
 
     def _require_bound(self) -> None:
         if getattr(self, "_apply_fn", None) is None:
@@ -250,6 +377,10 @@ class InferenceEngine:
         one ``device_get`` per coalesced dispatch, not per request)."""
         x = np.asarray(x)
         self._require_bound()
+        # ONE read of the weights reference per dispatch: a concurrent
+        # swap_weights lands either entirely before or entirely after
+        # this batch (the hot-swap atomicity contract).
+        variables = self._variables
         n = x.shape[0]
         bucket = self.bucket_for(n)
         seq_bucket = None
@@ -269,10 +400,203 @@ class InferenceEngine:
             x = np.pad(x, pad)  # zero padding: row-independent forward
         x = x.astype(self._dtype, copy=False)
         compiled, out_tracks_seq = self._compiled(bucket, seq_bucket, x.dtype)
-        out = compiled(self._variables, x)[:n]
+        out = compiled(variables, x)[:n]
         if out_tracks_seq and orig_seq != seq_bucket:
             out = out[:, :orig_seq]
         return out
 
     def __call__(self, x: Array) -> Array:
         return self.infer(x)
+
+
+class CheckpointWatcher:
+    """Checkpoint→serving streaming: tail a training run's
+    ``Checkpointer`` directory and hot-swap newly FINALIZED steps into
+    a live :class:`InferenceEngine`.
+
+    Discovery goes through
+    :func:`~zookeeper_tpu.training.checkpoint.finalized_steps` — only
+    atomically-finalized steps are ever visible, so a torn async write
+    or a crash mid-save can never be served. A step that vanishes
+    between discovery and load (retention GC racing the poll — the
+    same race ``restore_state`` tolerates) is skipped with a warning
+    and the next poll simply picks up the then-newest step.
+
+    ``poll_once()`` is the deterministic unit (returns the swapped step
+    or None); ``start()`` runs it on a daemon thread every
+    ``poll_interval_s``. ``stop()`` is idempotent.
+
+    Known cost: each swap's ``load_inference_model`` is a target-free
+    restore of the FULL saved TrainState, optimizer state included
+    (~2x params for Adam-family), which is immediately dropped — the
+    installed orbax's ``StandardRestore`` rejects ``PLACEHOLDER``
+    targets (see ``Checkpointer._restore_step``), so a partial read is
+    not available; revisit when orbax grows per-leaf skipping. The IO
+    runs on the watcher thread, never a request path.
+    """
+
+    def __init__(
+        self,
+        engine: InferenceEngine,
+        directory: str,
+        *,
+        weights: str = "ema",
+        poll_interval_s: float = 2.0,
+        metrics: Any = None,
+        initial_step: Optional[int] = None,
+    ) -> None:
+        if weights not in ("auto", "ema", "raw"):
+            raise ValueError(
+                f"weights={weights!r} unknown; choose auto/ema/raw."
+            )
+        if poll_interval_s <= 0:
+            raise ValueError(
+                f"poll_interval_s={poll_interval_s} must be > 0."
+            )
+        self._engine = engine
+        self._directory = directory
+        self._weights = weights
+        self._poll_interval_s = float(poll_interval_s)
+        self._metrics = metrics
+        # initial_step = the caller already serves this step's weights
+        # (bound at load time): it is live without a swap, and only
+        # NEWER steps trigger one.
+        self._current_step: Optional[int] = (
+            int(initial_step) if initial_step is not None else None
+        )
+        if initial_step is not None and metrics is not None:
+            metrics.record_weights_step(int(initial_step))
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._swaps = 0
+        # poll_once is callable both from the daemon thread and
+        # directly (tests, manual refresh): serialize the two.
+        self._poll_lock = threading.Lock()
+
+    @property
+    def current_step(self) -> Optional[int]:
+        """The training step whose weights are live (None until the
+        first successful swap)."""
+        return self._current_step
+
+    @property
+    def swaps(self) -> int:
+        return self._swaps
+
+    @property
+    def alive(self) -> bool:
+        """Whether the daemon poller is still following the directory
+        (False after ``stop()`` OR after a fatal config error killed
+        the loop — the check an operator/health probe should use before
+        trusting ``serving_weights_step`` as 'live-following')."""
+        thread = self._thread
+        return (
+            thread is not None
+            and thread.is_alive()
+            and not self._stop.is_set()
+        )
+
+    def poll_once(self) -> Optional[int]:
+        """One poll: when a finalized step newer than ``current_step``
+        exists, load it (EMA/raw per ``weights``) and swap it in.
+        Returns the newly-live step, or None (nothing new, or the
+        newest step vanished/failed to load — retried next poll)."""
+        with self._poll_lock:
+            return self._poll_once_locked()
+
+    def _poll_once_locked(self) -> Optional[int]:
+        from zookeeper_tpu.training.checkpoint import (
+            CheckpointUnreadableError,
+            finalized_steps,
+            load_inference_model,
+        )
+
+        steps = finalized_steps(self._directory)
+        if not steps:
+            return None
+        newest = steps[-1]
+        if self._current_step is not None and newest <= self._current_step:
+            return None
+        t0 = time.perf_counter()
+        try:
+            params, model_state = load_inference_model(
+                self._directory, weights=self._weights, step=newest
+            )
+        except CheckpointUnreadableError as e:
+            # A finalized-but-torn step (post-crash disk state) or
+            # files vanishing under the read: weather, exactly like
+            # restore_state's walk — warn and retry next poll.
+            logger.warning(
+                "checkpoint watcher: step %d could not be loaded "
+                "(%s); retrying at the next poll",
+                newest,
+                e,
+            )
+            return None
+        except ValueError:
+            # A CONFIGURATION bug (weights="ema" on an EMA-less run,
+            # structure validation): silently retrying would pin
+            # serving to stale weights while hiding it. Stop loudly.
+            self._stop.set()
+            raise
+        except Exception as e:
+            logger.warning(
+                "checkpoint watcher: step %d could not be loaded (%s); "
+                "retrying at the next poll",
+                newest,
+                e,
+            )
+            return None
+        try:
+            self._engine.swap_weights(params, model_state)
+        except ValueError:
+            # Shape/structure mismatch against the compiled buckets:
+            # configuration bug, never weather. Stop loudly.
+            self._stop.set()
+            raise
+        swap_ms = (time.perf_counter() - t0) * 1e3
+        self._current_step = newest
+        self._swaps += 1
+        if self._metrics is not None:
+            self._metrics.record_weight_swap(swap_ms, newest)
+        logger.info(
+            "serving weights hot-swapped to training step %d (%.1f ms, "
+            "no recompile)",
+            newest,
+            swap_ms,
+        )
+        return newest
+
+    def start(self) -> "CheckpointWatcher":
+        if self._thread is not None and self._thread.is_alive():
+            return self
+        self._stop.clear()
+
+        def loop():
+            while not self._stop.is_set():
+                try:
+                    self.poll_once()
+                except Exception as e:
+                    logger.error(
+                        "checkpoint watcher stopped: %s", e
+                    )
+                    self._stop.set()
+                    if self._metrics is not None:
+                        # The staleness gauge must be distinguishable
+                        # from "up to date": a dead watcher counts.
+                        self._metrics.record_watcher_stopped()
+                    return
+                self._stop.wait(self._poll_interval_s)
+
+        self._thread = threading.Thread(
+            target=loop, name="zk-ckpt-watcher", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        thread = self._thread
+        if thread is not None:
+            thread.join(timeout=10)
+            self._thread = None

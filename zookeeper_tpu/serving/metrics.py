@@ -61,6 +61,17 @@ class ServingMetrics:
                     "rejected": 0,
                     "deadline_expired": 0,
                     "worker_restarts": 0,
+                    # Checkpoint→serving streaming (docs/DESIGN.md §12):
+                    # hot-swap count and WHICH training step is live —
+                    # the dashboard gauge that says how stale the served
+                    # model is relative to the training run (-1 = the
+                    # bind()-time weights, never swapped).
+                    "weight_swaps": 0,
+                    "serving_weights_step": -1,
+                    # Nonzero = the watcher daemon died on a fatal
+                    # error and serving_weights_step is FROZEN, not
+                    # live-following (alert on this, not on staleness).
+                    "watcher_stopped": 0,
                 },
             )
         if name not in store:
@@ -93,6 +104,27 @@ class ServingMetrics:
         self._series("latency_ms")
         self._totals["worker_restarts"] += 1
 
+    def record_weight_swap(self, swap_ms: float, step: int) -> None:
+        """A checkpoint hot-swap landed: ``step``'s weights are now
+        live (``CheckpointWatcher``/``swap_weights``); ``swap_ms`` is
+        load+place+swap wall time."""
+        self._series("weight_swap_ms").append(float(swap_ms))
+        self._totals["weight_swaps"] += 1
+        self._totals["serving_weights_step"] = int(step)
+
+    def record_watcher_stopped(self) -> None:
+        """The checkpoint watcher's daemon died on a fatal error:
+        ``serving_weights_step`` is frozen from here on."""
+        self._series("latency_ms")
+        self._totals["watcher_stopped"] += 1
+
+    def record_weights_step(self, step: int) -> None:
+        """Set the live-weights gauge WITHOUT counting a swap — the
+        bind-time weights of a service that loaded ``step`` at startup
+        (``CheckpointWatcher(initial_step=...)``)."""
+        self._series("latency_ms")
+        self._totals["serving_weights_step"] = int(step)
+
     def record_dispatch(self, real_rows: int, bucket_rows: int) -> None:
         if bucket_rows <= 0:
             return
@@ -124,7 +156,9 @@ class ServingMetrics:
             out["latency_p95_ms"] = float(np.percentile(arr, 95))
             out["latency_p99_ms"] = float(np.percentile(arr, 99))
             out["latency_mean_ms"] = float(arr.mean())
-        for name in ("queue_depth", "bucket_fill", "padding_waste"):
+        for name in (
+            "queue_depth", "bucket_fill", "padding_waste", "weight_swap_ms",
+        ):
             series = self._store.get(name)
             if series:
                 out[f"{name}_mean"] = float(np.mean(series))

@@ -72,6 +72,15 @@ class ServingConfig(Experiment):
     #: Pre-compile every bucket before serving (warm path: first request
     #: never pays XLA).
     warmup: bool = Field(True)
+    #: Serve a LIVE training run: when ``checkpoint`` is a Checkpointer
+    #: directory, a background watcher polls it for newly finalized
+    #: steps and hot-swaps each one into the warmed engine — no
+    #: recompiles, no restart (``InferenceEngine.watch_checkpoints``;
+    #: docs/DESIGN.md §12). The ``weights`` Field picks EMA vs raw for
+    #: the swaps exactly as it does for the initial load.
+    watch: bool = Field(False)
+    #: Watcher poll interval, seconds.
+    watch_poll_s: float = Field(2.0)
     #: Demo-driver knobs for ``run()``: how many synthetic requests, and
     #: the largest request size in the stream.
     requests: int = Field(64)
@@ -96,6 +105,17 @@ class ServingConfig(Experiment):
                 f"max_request={self.max_request} >= 1."
             )
         module = self.model.build(self.input_shape, self.num_classes)
+        # Watcher baseline, captured BEFORE the load: the load below
+        # binds at-least-this step, so any step finalizing during
+        # load/warmup stays NEWER than the baseline and the first poll
+        # swaps it in (listing after warmup could mark a step "live"
+        # that was never actually bound).
+        watch_baseline = None
+        if self.watch and self.checkpoint:
+            from zookeeper_tpu.training.checkpoint import finalized_steps
+
+            steps = finalized_steps(self.checkpoint)
+            watch_baseline = steps[-1] if steps else None
         if self.checkpoint:
             import jax
 
@@ -128,6 +148,27 @@ class ServingConfig(Experiment):
         if self.warmup:
             self.engine.warmup()
         self.batcher.bind(self.engine, metrics=self.metrics)
+        if self.watch:
+            if not self.checkpoint:
+                raise ValueError(
+                    "watch=True needs checkpoint= pointing at a live "
+                    "Checkpointer directory to stream steps from."
+                )
+            # The pre-load baseline seeds the watcher so startup does
+            # not redundantly reload the step the load above already
+            # bound; a step that finalized since is newer than the
+            # baseline and the eager first poll swaps it in.
+            object.__setattr__(
+                self,
+                "watcher",
+                self.engine.watch_checkpoints(
+                    self.checkpoint,
+                    weights=self.weights,
+                    poll_interval_s=self.watch_poll_s,
+                    metrics=self.metrics,
+                    initial_step=watch_baseline,
+                ),
+            )
         return self.engine, self.batcher
 
     def finish_report(
@@ -164,6 +205,9 @@ class ServingConfig(Experiment):
         }
         if self.verbose:
             print(json.dumps(result), flush=True)
+        watcher = getattr(self, "watcher", None)
+        if watcher is not None:
+            watcher.stop()
         self.batcher.close()
         return result
 

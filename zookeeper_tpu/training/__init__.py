@@ -9,8 +9,11 @@ optax optimizers/schedules wired as configurable components, and an
 runs single-device, data-parallel, or model-parallel.
 """
 
+from zookeeper_tpu.training.async_checkpoint import AsyncCheckpointWriter
 from zookeeper_tpu.training.checkpoint import (
     Checkpointer,
+    CheckpointUnreadableError,
+    finalized_steps,
     load_inference_model,
     load_model,
     save_model,
@@ -59,6 +62,7 @@ from zookeeper_tpu.training.profiling import (
 from zookeeper_tpu.training.state import TrainState
 from zookeeper_tpu.training.step import (
     build_multi_step,
+    host_snapshot,
     make_eval_step,
     make_train_step,
 )
@@ -70,9 +74,11 @@ __all__ = [
     "slab_annotation",
     "Adam",
     "AdamW",
+    "AsyncCheckpointWriter",
     "BINARY_KERNEL_PATTERN",
     "Bop",
     "Checkpointer",
+    "CheckpointUnreadableError",
     "Lamb",
     "Lars",
     "scale_by_bop",
@@ -82,6 +88,8 @@ __all__ = [
     "DistillationExperiment",
     "EvalExperiment",
     "Experiment",
+    "finalized_steps",
+    "host_snapshot",
     "load_inference_model",
     "load_model",
     "save_model",

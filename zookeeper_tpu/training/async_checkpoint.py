@@ -1,0 +1,217 @@
+"""Async checkpoint writer: the background half of ``Checkpointer``'s
+``mode="async"``.
+
+The synchronous save path stalls the training thread for the whole
+serialize+write; at production model sizes that stall dominates the
+recovery budget and forces save cadence against throughput (ROADMAP
+item 4). The async mode splits the save in two:
+
+1. **Snapshot** (training thread, cheap): a donation-safe device→host
+   copy of the persistable state (``training.step.host_snapshot``) taken
+   at a step/slab boundary. Once it returns, the training loop is free
+   to dispatch the next slab — the snapshot is plain host numpy and
+   survives the state's device buffers being donated.
+2. **Write** (this module's thread): the snapshot is handed to an
+   :class:`AsyncCheckpointWriter` with a BOUNDED queue of depth 1. The
+   writer performs the same crash-consistent protocol the sync path
+   uses — write into an unfinalized temp location, then atomically
+   finalize (orbax's tmp-dir → rename step) — so
+   ``Checkpointer.restore_state``'s newest-first torn-checkpoint walk
+   needs no changes to stay correct: an in-flight write that dies with
+   the process is just an unfinalized remnant the walk never even
+   lists.
+
+Queue policy (``Checkpointer.queue_policy``):
+
+- ``"wait"`` (default): when a snapshot is already queued behind the
+  in-flight write, a new ``submit`` BLOCKS the training thread until
+  the slot frees — backpressure, never unbounded host memory.
+- ``"supersede"``: the queued-but-not-started snapshot is replaced by
+  the newer one (the in-flight write always completes — a write cannot
+  be aborted mid-finalize without tearing it). Under a writer slower
+  than the save cadence this keeps the newest state flowing to disk at
+  zero training-thread stall, trading away intermediate steps.
+
+Failure policy: a write that fails (disk, injected ``fail_save_io`` /
+``fail_async_finalize``) retries on the WRITER thread with the
+checkpointer's jittered backoff and is then logged-and-dropped — the
+training thread never sees checkpoint IO weather, in either direction.
+``FaultPlan.kill_during_async_write`` models the process dying mid-write
+(torn unfinalized remnant on disk, write silently abandoned), the leg
+the chaos suite pins restore against.
+"""
+
+import logging
+import threading
+import time
+from typing import Any, Dict, Optional
+
+logger = logging.getLogger(__name__)
+
+
+class AsyncCheckpointWriter:
+    """Depth-1-queue background writer for one :class:`Checkpointer`.
+
+    State machine per snapshot: ``queued`` (the single pending slot) →
+    ``writing`` (popped by the worker; ``write-to-temp → fsync →
+    atomic finalize`` via the checkpointer's write path) → ``finalized``
+    (or ``dropped`` after exhausted retries / ``superseded`` before the
+    write began / ``killed`` by an injected mid-write death).
+    """
+
+    def __init__(self, checkpointer: Any, queue_policy: str = "wait"):
+        if queue_policy not in ("wait", "supersede"):
+            raise ValueError(
+                f"queue_policy={queue_policy!r} unknown; choose "
+                "wait/supersede."
+            )
+        self._ckpt = checkpointer
+        self._policy = queue_policy
+        self._cv = threading.Condition()
+        #: The ONE pending slot: (step, host_tree, metrics) or None.
+        self._pending: Optional[tuple] = None
+        self._writing_step: Optional[int] = None
+        self._stopping = False
+        self.stats: Dict[str, float] = {
+            "submitted": 0,
+            "finalized": 0,
+            "dropped": 0,
+            "superseded": 0,
+            "killed": 0,
+            "last_write_ms": 0.0,
+        }
+        self._thread = threading.Thread(
+            target=self._loop, name="zk-async-ckpt", daemon=True
+        )
+        self._thread.start()
+
+    # -- training-thread API ---------------------------------------------
+
+    def submit(
+        self, step: int, host_tree: Any, metrics: Optional[dict] = None
+    ) -> bool:
+        """Queue one host snapshot for writing. Returns True when the
+        snapshot was accepted (which is not a durability promise — the
+        write may still retry/drop on the writer thread; ``drain`` or
+        ``Checkpointer.wait`` observe completion)."""
+        with self._cv:
+            if self._stopping:
+                return False
+            self.stats["submitted"] += 1
+            if self._pending is not None:
+                if self._policy == "supersede":
+                    self.stats["superseded"] += 1
+                    logger.info(
+                        "async checkpoint of step %d superseded by step %d "
+                        "before its write began",
+                        self._pending[0],
+                        step,
+                    )
+                else:
+                    # Bounded queue, "wait" policy: the training thread
+                    # backpressures until the in-flight write frees the
+                    # slot (the documented stall of a writer slower than
+                    # the save cadence).
+                    while self._pending is not None and not self._stopping:
+                        if not self._thread.is_alive():
+                            return False  # writer died; never hang training
+                        self._cv.wait(0.005)
+                    if self._stopping:
+                        return False
+            self._pending = (int(step), host_tree, metrics)
+            self._cv.notify_all()
+        return True
+
+    @property
+    def in_flight(self) -> bool:
+        """Whether any snapshot is queued or being written (the bench's
+        steps-overlapped-per-save probe polls this)."""
+        return self._pending is not None or self._writing_step is not None
+
+    def drain(self, supersede: bool = False) -> float:
+        """Block until the writer is idle; returns the wall time spent
+        waiting in ms (the preemption path's ``save_wait_ms``).
+        ``supersede=True`` drops the queued-but-not-started snapshot
+        first (the caller is about to write a newer state itself — the
+        preemption final save); the in-flight write always completes.
+        """
+        t0 = time.perf_counter()
+        with self._cv:
+            if supersede and self._pending is not None:
+                self.stats["superseded"] += 1
+                self._pending = None
+                self._cv.notify_all()
+            while self._pending is not None or self._writing_step is not None:
+                if not self._thread.is_alive():
+                    break  # never hang on a dead writer
+                self._cv.wait(0.005)
+        return (time.perf_counter() - t0) * 1e3
+
+    def stop(self) -> None:
+        """Drain and stop the writer thread (idempotent). A queued
+        snapshot is still written — stop is a graceful shutdown, not a
+        drop."""
+        with self._cv:
+            self._stopping = True
+            self._cv.notify_all()
+        self._thread.join(timeout=60)
+
+    # -- writer thread ----------------------------------------------------
+
+    def _loop(self) -> None:
+        from zookeeper_tpu.resilience import faults
+
+        while True:
+            with self._cv:
+                while self._pending is None and not self._stopping:
+                    self._cv.wait(0.05)
+                if self._pending is None:
+                    break  # stopping with nothing queued
+                step, host_tree, metrics = self._pending
+                self._pending = None
+                self._writing_step = step
+                self._cv.notify_all()
+            t0 = time.perf_counter()
+            try:
+                plan = faults.active()
+                if plan is not None and plan.async_kill_due(step):
+                    # Injected process death mid-write: leave the torn,
+                    # UNFINALIZED remnant a real crash would, and abandon
+                    # the write — a dead process does not retry. Restore
+                    # must land on the previous finalized step.
+                    self._ckpt._leave_unfinalized_remnant(step)
+                    self.stats["killed"] += 1
+                    logger.warning(
+                        "async write of step %d killed mid-write "
+                        "(injected): unfinalized remnant left on disk; "
+                        "restore walks back to the previous finalized step",
+                        step,
+                    )
+                elif self._ckpt._run_with_save_retries(
+                    step,
+                    lambda: self._ckpt._attempt_async_write(
+                        step, host_tree, metrics
+                    ),
+                ):
+                    self.stats["finalized"] += 1
+                    self.stats["last_write_ms"] = (
+                        time.perf_counter() - t0
+                    ) * 1e3
+                else:
+                    self.stats["dropped"] += 1
+            except BaseException as e:
+                # Belt to the retry loop's suspenders: NOTHING the writer
+                # hits may propagate toward the training thread; a write
+                # that failed outside the retried section is a dropped
+                # save, loudly logged.
+                self.stats["dropped"] += 1
+                logger.error(
+                    "async checkpoint write of step %d failed outside the "
+                    "retry loop; dropping this save",
+                    step,
+                    exc_info=e,
+                )
+            finally:
+                with self._cv:
+                    self._writing_step = None
+                    self._cv.notify_all()

@@ -2,16 +2,40 @@
 
 The reference delegates checkpointing to user-supplied Keras callbacks
 (SURVEY.md §5 "Checkpoint / resume: absent in framework"); here it is a
-first-class component: async, sharding-aware save/restore of the
-TrainState pytree via orbax, with retention and exact-resume (step counter
-and RNG folding live in the state, and the data pipeline is
+first-class component: sharding-aware save/restore of the TrainState
+pytree via orbax, with retention and exact-resume (step counter and RNG
+folding live in the state, and the data pipeline is
 (seed, epoch)-deterministic — SURVEY.md §7).
+
+Two save modes (docs/DESIGN.md §12):
+
+- ``mode="sync"``: the save runs on the training thread — simple,
+  and the right default for tests and small states.
+- ``mode="async"``: the training thread only takes a donation-safe
+  device→host snapshot (``training.step.host_snapshot``) and hands it
+  to a background :class:`~zookeeper_tpu.training.async_checkpoint.\
+AsyncCheckpointWriter`; the serialize+write overlaps the next slab's
+  compute. Crash consistency is IDENTICAL in both modes: every write
+  lands in an unfinalized temp location and is atomically finalized
+  (orbax tmp-dir → rename), so ``restore_state``'s newest-first
+  torn-checkpoint walk covers a crash at any point of either path.
+
+Retention tiers: the primary directory keeps every ``save_every_steps``
+checkpoint under ``max_to_keep`` GC (the cheap, local, fast-resume
+tier); ``durable_every_steps`` additionally PROMOTES a save into a
+durable tier (``durable_directory``, default ``<directory>/durable``)
+whenever at least that many steps of progress have passed since the
+last promotion, with its own — typically unbounded — retention.
+``restore_state`` walks both tiers newest-first, so a wiped local tier
+still resumes from the newest durable step.
 """
 
 import logging
 import os
+import random
+import threading
 import time
-from typing import Any, Optional
+from typing import Any, List, Optional, Tuple
 
 from zookeeper_tpu.core import Field, component
 
@@ -79,8 +103,39 @@ class Checkpointer:
     #: successful save. Contract/config errors (keep_best without
     #: metrics) still raise: those are bugs, not weather.
     save_retries: int = Field(2)
-    #: Base backoff between save retries (doubles per attempt).
+    #: Base backoff between save retries (doubles per attempt, with a
+    #: fresh ±50% jitter re-drawn EVERY attempt so a fleet of workers
+    #: hitting one flaky store never retries in lockstep).
     save_retry_backoff_s: float = Field(0.25)
+    #: "sync" (save on the training thread) or "async" (device→host
+    #: snapshot on the training thread, serialize+write on a background
+    #: writer overlapping the next slab's compute — docs/DESIGN.md §12).
+    #: Crash-consistency and restore semantics are identical; the
+    #: preemption path drains the writer and still does ONE final
+    #: synchronous save, so SIGTERM semantics are unchanged.
+    mode: str = Field("sync")
+    #: Async-mode bounded-queue policy when a snapshot is already
+    #: queued behind the in-flight write: "wait" (the new snapshot
+    #: backpressures the training thread) or "supersede" (the queued,
+    #: not-yet-started snapshot is replaced by the newer one; the
+    #: in-flight write always completes).
+    queue_policy: str = Field("wait")
+    #: Durable retention tier: a saved step is additionally promoted to
+    #: ``durable_directory`` whenever at least this many steps of
+    #: training progress have passed since the last promotion (the
+    #: first save always promotes; 0 = off). Progress-based — NOT
+    #: step-number divisibility — so the tier can never be starved by a
+    #: save cadence whose step numbers happen to miss the grid (e.g.
+    #: epoch saves at step multiples of 117). The local tier stays
+    #: small and fast under ``max_to_keep`` GC; the durable tier is the
+    #: archival copy restore falls back to when the whole local tier is
+    #: lost or torn.
+    durable_every_steps: int = Field(0)
+    #: Durable-tier location; None = ``<directory>/durable``.
+    durable_directory: Optional[str] = Field(None)
+    #: Durable-tier retention (0 = keep everything — the archival
+    #: default).
+    durable_max_to_keep: int = Field(0)
 
     @property
     def enabled(self) -> bool:
@@ -117,56 +172,125 @@ class Checkpointer:
             )
         return self._mgr
 
-    def save(
-        self,
-        state: Any,
-        *,
-        step: Optional[int] = None,
-        metrics: Optional[dict] = None,
-    ) -> bool:
-        if not self.enabled:
-            return False
-        import jax
+    @property
+    def _durable_enabled(self) -> bool:
+        return self.enabled and self.durable_every_steps > 0
+
+    def _durable_path(self) -> str:
+        base = self.durable_directory or os.path.join(
+            self.directory, "durable"
+        )
+        return os.path.abspath(os.path.expanduser(base))
+
+    def _durable_manager(self):
         import orbax.checkpoint as ocp
 
-        if self.keep_best_metric is not None:
-            if not metrics or self.keep_best_metric not in metrics:
-                raise ValueError(
-                    f"keep_best_metric={self.keep_best_metric!r} but this "
-                    "save carries no such metric "
-                    f"(got {sorted(metrics or {})}). Pass metrics= to "
-                    "save(), or unset keep_best_metric."
-                )
-            metrics = {k: float(v) for k, v in metrics.items()}
-        step = int(jax.device_get(state.step)) if step is None else int(step)
-        from zookeeper_tpu.resilience import faults
+        if getattr(self, "_durable_mgr", None) is None:
+            options = ocp.CheckpointManagerOptions(
+                # 0 = archival: keep every promoted step forever.
+                max_to_keep=(
+                    self.durable_max_to_keep
+                    if self.durable_max_to_keep > 0
+                    else None
+                ),
+                enable_async_checkpointing=False,
+            )
+            path = self._durable_path()
+            os.makedirs(path, exist_ok=True)
+            object.__setattr__(
+                self,
+                "_durable_mgr",
+                ocp.CheckpointManager(path, options=options),
+            )
+        return self._durable_mgr
 
+    def _io_lock(self) -> threading.Lock:
+        """One lock around every orbax-manager call: in async mode the
+        writer thread and the training thread (preemption final save,
+        ``latest_step`` probes) share the managers; orbax makes no
+        thread-safety promise, so this component does."""
+        lock = getattr(self, "_mgr_lock", None)
+        if lock is None:
+            lock = threading.Lock()
+            object.__setattr__(self, "_mgr_lock", lock)
+        return lock
+
+    def _writer(self):
+        """The lazily-started async writer (async mode only)."""
+        from zookeeper_tpu.training.async_checkpoint import (
+            AsyncCheckpointWriter,
+        )
+
+        writer = getattr(self, "_async_writer", None)
+        if writer is None:
+            writer = AsyncCheckpointWriter(
+                self, queue_policy=self.queue_policy
+            )
+            object.__setattr__(self, "_async_writer", writer)
+        return writer
+
+    @property
+    def async_in_flight(self) -> bool:
+        """Whether an async write is queued or in flight (False in sync
+        mode) — the bench's steps-overlapped-per-save probe."""
+        writer = getattr(self, "_async_writer", None)
+        return writer is not None and writer.in_flight
+
+    def _validate_mode(self) -> None:
+        if self.mode not in ("sync", "async"):
+            raise ValueError(
+                f"mode={self.mode!r} unknown; choose sync/async."
+            )
+        if self.queue_policy not in ("wait", "supersede"):
+            raise ValueError(
+                f"queue_policy={self.queue_policy!r} unknown; choose "
+                "wait/supersede."
+            )
+        if self.durable_every_steps < 0 or self.durable_max_to_keep < 0:
+            raise ValueError(
+                "durable_every_steps/durable_max_to_keep must be >= 0 "
+                "(0 disables the durable tier / keeps everything)."
+            )
+        if self.queue_policy == "supersede" and self.keep_best_metric:
+            # "Newest wins" and "best wins" contradict: a queued RANKED
+            # snapshot (possibly the best model so far) replaced by a
+            # newer, worse-ranked one would silently lose the best
+            # checkpoint. Best-ranking requires every ranked save to be
+            # written — the wait policy.
+            raise ValueError(
+                "queue_policy='supersede' is incompatible with "
+                "keep_best_metric: superseding may drop a better-ranked "
+                "queued snapshot in favor of a worse one. Use "
+                "queue_policy='wait'."
+            )
+
+    # -- write path (shared by the sync caller and the async writer) -----
+
+    def _run_with_save_retries(self, step: int, attempt_fn) -> bool:
+        """The ONE retry loop both save modes use: exponential backoff
+        with a fresh ±50% jitter drawn EVERY attempt (a fleet retrying
+        a shared flaky store must decorrelate, not stampede in
+        lockstep), and a final drop that is LOUD — error level, step
+        number, full exception chain — because a silently-thinning save
+        cadence is exactly what a supervisor log reader must not miss.
+        """
         attempts = max(0, int(self.save_retries)) + 1
         for attempt in range(attempts):
             try:
-                plan = faults.active()
-                if plan is not None and plan.take_save_io_failure():
-                    raise faults.InjectedFault(
-                        f"injected save IO failure at step {step}"
-                    )
-                saved = self._manager().save(
-                    step,
-                    args=ocp.args.StandardSave(_state_pytree(state)),
-                    metrics=metrics,
-                )
+                return bool(attempt_fn())
             except Exception as e:
                 if attempt + 1 >= attempts:
-                    logger.warning(
-                        "checkpoint save at step %d failed after %d "
-                        "attempt(s) (%s); dropping this save — training "
-                        "continues, work-loss bound stretches to the next "
-                        "successful save",
+                    logger.error(
+                        "checkpoint save at step %d DROPPED after %d "
+                        "attempt(s); training continues, work-loss bound "
+                        "stretches to the next successful save",
                         step,
                         attempts,
-                        e,
+                        exc_info=e,
                     )
                     return False
                 delay = self.save_retry_backoff_s * (2**attempt)
+                delay *= random.uniform(0.5, 1.5)  # re-drawn per attempt
                 logger.warning(
                     "checkpoint save at step %d failed (%s); retrying in "
                     "%.2fs (%d/%d)",
@@ -178,22 +302,169 @@ class Checkpointer:
                 )
                 if delay > 0:
                     time.sleep(delay)
-                continue
-            plan = faults.active()
-            if plan is not None and plan.corrupt_due(step):
-                # Chaos hook: tear THIS step's files once the save has
-                # fully landed (finalized), modeling post-crash disk
-                # state for the restore-fallback leg.
-                self.wait()
-                path = os.path.abspath(os.path.expanduser(self.directory))
-                faults.corrupt_checkpoint_dir(os.path.join(path, str(step)))
-            return bool(saved)
         raise AssertionError("unreachable")  # pragma: no cover
 
+    def _write_state(
+        self,
+        tree: Any,
+        step: int,
+        metrics: Optional[dict],
+        block: bool = False,
+    ) -> bool:
+        """One write attempt: local-tier save, durable-tier promotion
+        when the step is due, chaos hooks in line. ``tree`` is either
+        device state (sync path) or a host snapshot (async path) —
+        orbax handles both. ``block=True`` waits out orbax's own
+        background commit (the async WRITER passes it: "finalized" must
+        mean on-disk before the writer reports success); the sync path
+        keeps orbax's ``synchronous`` Field semantics unchanged."""
+        import orbax.checkpoint as ocp
+
+        from zookeeper_tpu.resilience import faults
+
+        plan = faults.active()
+        if plan is not None and plan.take_save_io_failure():
+            raise faults.InjectedFault(
+                f"injected save IO failure at step {step}"
+            )
+        with self._io_lock():
+            mgr = self._manager()
+            if step in mgr.all_steps():
+                saved = True  # idempotent: this step already finalized
+            else:
+                saved = mgr.save(
+                    step, args=ocp.args.StandardSave(tree), metrics=metrics
+                )
+                if block:
+                    mgr.wait_until_finished()
+            if self._durable_enabled and self._durable_promotion_due(step):
+                dmgr = self._durable_manager()
+                if step not in dmgr.all_steps():
+                    # Durable promotion never carries best-ranking
+                    # metrics: the archival tier keeps by cadence.
+                    dmgr.save(step, args=ocp.args.StandardSave(tree))
+                    dmgr.wait_until_finished()
+        plan = faults.active()
+        if plan is not None and plan.corrupt_due(step):
+            # Chaos hook: tear THIS step's files once the save has
+            # fully landed (finalized), modeling post-crash disk
+            # state for the restore-fallback leg. Direct manager wait
+            # (NOT self.wait(): on the writer thread that would drain
+            # the writer's own in-flight item — a deadlock).
+            with self._io_lock():
+                self._manager().wait_until_finished()
+            path = os.path.abspath(os.path.expanduser(self.directory))
+            faults.corrupt_checkpoint_dir(os.path.join(path, str(step)))
+        return bool(saved)
+
+    def _durable_promotion_due(self, step: int) -> bool:
+        """Progress-based promotion: the first save always promotes
+        (a durable tier must never sit empty while saves land), then
+        every save at least ``durable_every_steps`` past the previous
+        promotion. The baseline is the durable manager's own newest
+        step, so the cadence survives restarts. Caller holds
+        ``_io_lock``."""
+        last = self._durable_manager().latest_step()
+        return last is None or step - int(last) >= self.durable_every_steps
+
+    def _attempt_async_write(
+        self, step: int, host_tree: Any, metrics: Optional[dict]
+    ) -> bool:
+        """One WRITER-THREAD attempt: the async-only finalize-failure
+        injection wraps the shared write path (the data lands, the
+        atomic rename doesn't — a torn unfinalized remnant is left on
+        disk exactly as a crash between write and finalize would)."""
+        from zookeeper_tpu.resilience import faults
+
+        plan = faults.active()
+        if plan is not None and plan.take_async_finalize_failure():
+            self._leave_unfinalized_remnant(step)
+            raise faults.InjectedFault(
+                f"injected async finalize failure at step {step}"
+            )
+        return self._write_state(host_tree, step, metrics, block=True)
+
+    def _leave_unfinalized_remnant(self, step: int) -> None:
+        """Model a write that died before finalize: a tmp-named step
+        directory with torn contents. The name is NOT a bare step
+        number, so orbax's ``all_steps()`` (and therefore the restore
+        walk) never lists it — the crash-consistency argument in one
+        line. fsynced so the modeled disk state is durable, like the
+        real crash's would be."""
+        nonce = int(getattr(self, "_remnant_nonce", 0)) + 1
+        object.__setattr__(self, "_remnant_nonce", nonce)
+        root = os.path.abspath(os.path.expanduser(self.directory))
+        tmp = os.path.join(
+            root, f"{step}.orbax-checkpoint-tmp-zk{nonce}", "default"
+        )
+        os.makedirs(tmp, exist_ok=True)
+        with open(os.path.join(tmp, "manifest.ocdbt"), "wb") as f:
+            f.write(b"\xde\xad\xbe\xef" * 8)  # torn mid-write
+            f.flush()
+            os.fsync(f.fileno())
+
+    def save(
+        self,
+        state: Any,
+        *,
+        step: Optional[int] = None,
+        metrics: Optional[dict] = None,
+        sync: Optional[bool] = None,
+    ) -> bool:
+        """Save ``state`` (mode-selected path; ``sync=True`` forces the
+        synchronous path regardless of mode — the preemption final
+        save). In async mode the return value means ACCEPTED by the
+        writer queue, not yet durable; ``wait()`` observes completion.
+        """
+        if not self.enabled:
+            return False
+        import jax
+
+        self._validate_mode()
+        if self.keep_best_metric is not None:
+            if not metrics or self.keep_best_metric not in metrics:
+                raise ValueError(
+                    f"keep_best_metric={self.keep_best_metric!r} but this "
+                    "save carries no such metric "
+                    f"(got {sorted(metrics or {})}). Pass metrics= to "
+                    "save(), or unset keep_best_metric."
+                )
+            metrics = {k: float(v) for k, v in metrics.items()}
+        step = int(jax.device_get(state.step)) if step is None else int(step)
+        if self.mode == "async" and not sync:
+            from zookeeper_tpu.training.step import host_snapshot
+
+            # Training-thread cost ends here: a donation-safe host copy,
+            # then hand off. Serialize+write overlap the next slab.
+            tree = host_snapshot(_state_pytree(state))
+            return self._writer().submit(step, tree, metrics)
+        return self._run_with_save_retries(
+            step,
+            lambda: self._write_state(_state_pytree(state), step, metrics),
+        )
+
+    def drain_async(self, supersede: bool = False) -> float:
+        """Wait out any queued/in-flight async write; returns ms spent
+        waiting (0.0 in sync mode — the preemption path's
+        ``save_wait_ms``). ``supersede=True`` drops the queued-but-not-
+        started snapshot (the caller is about to synchronously save a
+        NEWER state)."""
+        writer = getattr(self, "_async_writer", None)
+        if writer is None:
+            return 0.0
+        return writer.drain(supersede=supersede)
+
     def latest_step(self) -> Optional[int]:
+        """Newest step across BOTH retention tiers (an async write that
+        already finalized counts; one still in flight does not)."""
         if not self.enabled:
             return None
-        return self._manager().latest_step()
+        with self._io_lock():
+            steps = [self._manager().latest_step()]
+            if self._durable_enabled:
+                steps.append(self._durable_manager().latest_step())
+        steps = [s for s in steps if s is not None]
+        return max(steps) if steps else None
 
     def best_step(self) -> Optional[int]:
         """Best saved step per ``keep_best_metric`` (None when best
@@ -202,7 +473,7 @@ class Checkpointer:
             return None
         return self._manager().best_step()
 
-    def _step_finalized(self, step: int) -> bool:
+    def _step_finalized(self, step: int, root: Optional[str] = None) -> bool:
         """Orbax finalize check for one retained step: a save that never
         finalized (crash mid-write) must not even be attempted. Modern
         orbax already excludes tmp dirs from ``all_steps()``; this is
@@ -210,9 +481,9 @@ class Checkpointer:
         installed orbax has no checker."""
         import orbax.checkpoint as ocp
 
-        path = os.path.join(
-            os.path.abspath(os.path.expanduser(self.directory)), str(step)
-        )
+        if root is None:
+            root = os.path.abspath(os.path.expanduser(self.directory))
+        path = os.path.join(root, str(step))
         checker = getattr(ocp.utils, "is_checkpoint_finalized", None)
         if checker is None or not os.path.isdir(path):
             return True
@@ -221,6 +492,23 @@ class Checkpointer:
         except Exception:
             return True
 
+    def _tier_entries(self) -> List[Tuple[int, str]]:
+        """Every restorable ``(step, tier)`` across both retention
+        tiers, newest-first; a step present in both tiers is walked
+        local-first (same bytes, cheaper storage class in production)
+        with the durable copy still behind it as fallback."""
+        with self._io_lock():
+            entries = [
+                (int(s), "local") for s in self._manager().all_steps()
+            ]
+            if self._durable_enabled:
+                entries += [
+                    (int(s), "durable")
+                    for s in self._durable_manager().all_steps()
+                ]
+        entries.sort(key=lambda e: (e[0], e[1] == "local"), reverse=True)
+        return entries
+
     def restore_state(self, state: Any) -> Any:
         """Restore the NEWEST VALID checkpoint into (a copy of)
         ``state``; returns ``state`` unchanged when disabled or no
@@ -228,65 +516,81 @@ class Checkpointer:
         of the target state leaves.
 
         Crash consistency: a retained step that is unfinalized, torn on
-        disk, or structurally unreadable is SKIPPED with a warning and
+        disk, structurally unreadable, or DELETED since listing (the
+        retention GC racing this walk) is SKIPPED with a warning and
         the next-newest retained step restores instead — a corrupt
         latest checkpoint costs the work since the previous save, never
-        the whole run. Only when EVERY retained step fails does restore
-        raise (silently restarting from scratch would be worse than the
+        the whole run. The walk covers both retention tiers (local
+        first at equal steps, then the every-M durable promotions).
+        Only when EVERY retained step fails does restore raise
+        (silently restarting from scratch would be worse than the
         crash): the likely cause then is a model/config mismatch, not
         corruption, and the error says so."""
         if not self.enabled or not self.restore:
             return state
-        steps = sorted(self._manager().all_steps(), reverse=True)
-        if not steps:
+        entries = self._tier_entries()
+        if not entries:
             return state
         last_err: Optional[Exception] = None
-        for i, step in enumerate(steps):
-            if not self._step_finalized(step):
+        for i, (step, tier) in enumerate(entries):
+            root = (
+                self._durable_path()
+                if tier == "durable"
+                else os.path.abspath(os.path.expanduser(self.directory))
+            )
+            if not self._step_finalized(step, root):
                 logger.warning(
-                    "checkpoint step %d is not finalized (crash "
+                    "%s checkpoint step %d is not finalized (crash "
                     "mid-save?); falling back to an earlier step",
+                    tier,
                     step,
                 )
                 continue
             try:
-                restored = self._restore_step(step, state)
+                restored = self._restore_step(step, state, tier)
             except Exception as e:
                 last_err = e
                 logger.warning(
-                    "checkpoint step %d failed to restore (%s); falling "
-                    "back to an earlier retained step",
+                    "%s checkpoint step %d failed to restore (%s); "
+                    "falling back to an earlier retained step",
+                    tier,
                     step,
                     e,
                 )
                 continue
             if i > 0:
                 logger.warning(
-                    "restored step %d instead of the newest retained "
+                    "restored %s step %d instead of the newest retained "
                     "step %d: later step(s) were corrupt/unreadable — "
                     "work since step %d will be retrained",
+                    tier,
                     step,
-                    steps[0],
+                    entries[0][0],
                     step,
                 )
             return self._assemble_restored(state, restored)
         raise ValueError(
-            f"None of the {len(steps)} retained checkpoint step(s) "
-            f"{steps} in {self.directory!r} could be restored. If every "
-            "step failed identically this is almost certainly a "
-            "model/checkpoint STRUCTURE mismatch (the restoring model "
-            "must be built with the exporting run's architecture "
-            "config), not disk corruption. Last error: "
+            f"None of the {len(entries)} retained checkpoint step(s) "
+            f"{[s for s, _ in entries]} in {self.directory!r} could be "
+            "restored. If every step failed identically this is almost "
+            "certainly a model/checkpoint STRUCTURE mismatch (the "
+            "restoring model must be built with the exporting run's "
+            "architecture config), not disk corruption. Last error: "
             f"{last_err}"
         ) from last_err
 
-    def _restore_step(self, step: int, state: Any):
+    def _restore_step(self, step: int, state: Any, tier: str = "local"):
         """Restore one specific step against ``state``'s structure
         (including the EMA-toggle retry); raises on any mismatch or
         on-disk corruption — ``restore_state`` decides the fallback."""
         import jax
         import orbax.checkpoint as ocp
 
+        mgr = (
+            self._durable_manager()
+            if tier == "durable"
+            else self._manager()
+        )
         target = jax.tree.map(
             ocp.utils.to_shape_dtype_struct, _state_pytree(state)
         )
@@ -297,9 +601,7 @@ class Checkpointer:
         # structure, and on the specific ema_params structure mismatch
         # retry once with the target adjusted to the disk's shape.
         def do_restore(tgt):
-            return self._manager().restore(
-                step, args=ocp.args.StandardRestore(tgt)
-            )
+            return mgr.restore(step, args=ocp.args.StandardRestore(tgt))
 
         try:
             restored = do_restore(target)
@@ -346,14 +648,25 @@ class Checkpointer:
         )
 
     def wait(self) -> None:
-        """Block until pending async saves land (call before exit)."""
+        """Block until pending saves land — the async writer's queue
+        first (every accepted snapshot written or loudly dropped), then
+        orbax's own pending commits (call before exit)."""
+        self.drain_async()
         if self.enabled and getattr(self, "_mgr", None) is not None:
-            self._mgr.wait_until_finished()
+            with self._io_lock():
+                self._mgr.wait_until_finished()
+                if getattr(self, "_durable_mgr", None) is not None:
+                    self._durable_mgr.wait_until_finished()
 
     def close(self) -> None:
-        if getattr(self, "_mgr", None) is not None:
-            self._mgr.close()
-            object.__setattr__(self, "_mgr", None)
+        writer = getattr(self, "_async_writer", None)
+        if writer is not None:
+            writer.stop()  # graceful: a queued snapshot still lands
+            object.__setattr__(self, "_async_writer", None)
+        for attr in ("_mgr", "_durable_mgr"):
+            if getattr(self, attr, None) is not None:
+                getattr(self, attr).close()
+                object.__setattr__(self, attr, None)
 
 
 def save_model(path: str, params: Any, model_state: Any) -> None:
@@ -371,6 +684,16 @@ def save_model(path: str, params: Any, model_state: Any) -> None:
         ckptr.save(
             path, {"params": params, "model_state": model_state}, force=True
         )
+
+
+class CheckpointUnreadableError(ValueError):
+    """No restorable checkpoint bytes at the requested path/step — a
+    torn finalized step (post-crash disk), files vanishing under the
+    read (retention GC), or an empty directory. A ``ValueError``
+    subclass for back-compat, but distinguishable STRUCTURALLY from
+    configuration errors (structure mismatch, weights="ema" without
+    EMA), which stay plain ``ValueError`` — consumers like the serving
+    ``CheckpointWatcher`` retry this and stop loudly on those."""
 
 
 def _structure_mismatch_error(path: str, err: Exception) -> ValueError:
@@ -473,17 +796,60 @@ def select_inference_weights(
     )
 
 
-def _checkpoint_manager_item_dir(path: str) -> Optional[str]:
+def finalized_steps(path: str) -> List[int]:
+    """FINALIZED checkpoint steps in a ``Checkpointer`` directory,
+    ascending — the discovery primitive of checkpoint→serving streaming
+    (``InferenceEngine.watch_checkpoints``). Unfinalized writes never
+    appear: an in-flight or crashed async write lives under a tmp name
+    (not a bare step number) until its atomic finalize rename, and any
+    bare-numbered dir is additionally vetted through orbax's finalize
+    checker. Empty when ``path`` is missing or holds no steps."""
+    path = os.path.abspath(os.path.expanduser(path))
+    if not os.path.isdir(path):
+        return []
+    import orbax.checkpoint as ocp
+
+    checker = getattr(ocp.utils, "is_checkpoint_finalized", None)
+    steps = []
+    for name in os.listdir(path):
+        if not name.isdigit() or not os.path.isdir(os.path.join(path, name)):
+            continue
+        if checker is not None:
+            try:
+                if not checker(os.path.join(path, name)):
+                    continue
+            except Exception:
+                continue  # vanished mid-scan (retention GC race): skip
+        steps.append(int(name))
+    return sorted(steps)
+
+
+def _checkpoint_manager_item_dir(
+    path: str, step: Optional[int] = None
+) -> Optional[str]:
     """When ``path`` is a ``Checkpointer`` (orbax CheckpointManager)
-    directory, the directory of its LATEST step's saved item; None when
-    ``path`` is not a manager directory (e.g. a ``save_model`` export,
-    whose own directory holds the checkpoint)."""
+    directory, the directory of its LATEST (or the requested) step's
+    saved item; None when ``path`` is not a manager directory (e.g. a
+    ``save_model`` export, whose own directory holds the checkpoint)."""
     if not os.path.isdir(path):
         return None
     steps = [d for d in os.listdir(path) if d.isdigit()]
     if not steps:
         return None
-    step_dir = os.path.join(path, max(steps, key=int))
+    if step is not None:
+        if str(int(step)) not in steps:
+            # IO-shaped, not ValueError: a requested step can VANISH
+            # between discovery and load (retention GC racing a
+            # watcher poll) — callers must be able to tell that apart
+            # from a structure mismatch.
+            raise FileNotFoundError(
+                f"Checkpoint step {step} not found under {path!r} "
+                f"(available: {sorted(int(s) for s in steps)}) — "
+                "deleted by retention GC since it was listed?"
+            )
+        step_dir = os.path.join(path, str(int(step)))
+    else:
+        step_dir = os.path.join(path, max(steps, key=int))
     # CheckpointManager nests single-item saves under "default".
     default = os.path.join(step_dir, "default")
     return default if os.path.isdir(default) else step_dir
@@ -495,13 +861,15 @@ def load_inference_model(
     weights: str = "auto",
     params_like: Any = None,
     model_state_like: Any = None,
+    step: Optional[int] = None,
 ):
     """Load inference weights from EITHER deployment artifact:
 
     - a ``save_model`` model-only export (params + model_state), or
     - a full ``Checkpointer`` directory (latest step of a training run's
-      CheckpointManager tree — params, ema_params, model_state; the
-      optimizer state is restored and dropped),
+      CheckpointManager tree — or the specific ``step`` when given, the
+      hot-swap watcher's addressing mode — params, ema_params,
+      model_state; the optimizer state is restored and dropped),
 
     selecting EMA vs raw via :func:`select_inference_weights`. The
     restore is structure-free (arrays land on host, as saved), so no
@@ -516,7 +884,7 @@ def load_inference_model(
     import orbax.checkpoint as ocp
 
     path = os.path.abspath(os.path.expanduser(path))
-    item_dir = _checkpoint_manager_item_dir(path)
+    item_dir = _checkpoint_manager_item_dir(path, step=step)
     # Target-free restore is deliberate (it is what makes ONE loader
     # serve both artifact layouts without knowing the exporting run's
     # optimizer tree); orbax warns "generally UNSAFE" on every such
@@ -532,7 +900,7 @@ def load_inference_model(
             try:
                 restored = ckptr.restore(item_dir or path)
             except Exception as e:
-                raise ValueError(
+                raise CheckpointUnreadableError(
                     f"No restorable checkpoint at {path!r} (expected a "
                     "save_model export or a Checkpointer directory). "
                     f"Original orbax error: {e}"

@@ -328,18 +328,13 @@ class TrainingExperiment(Experiment):
             self.guard.request_preemption()
         if not self.guard.preempted:
             return
-        saved = False
-        ck = self.checkpointer
-        if ck.enabled:
-            if ck.keep_best_metric is not None:
-                # Rank-managed retention can't accept a metric-less
-                # save; the latest ranked save is the resume point.
-                saved = ck.latest_step() is not None
-            elif ck.latest_step() == global_step:
-                saved = True  # a cadence save just landed on this step
-            else:
-                saved = bool(ck.save(state))
-            ck.wait()  # synchronous: the process may die right after
+        # The guard owns the drain-then-sync-save policy (async mode
+        # first lands or supersedes the in-flight background write);
+        # the time spent waiting on that write is surfaced per attempt
+        # by run_with_recovery as save_wait_ms.
+        saved, self.save_wait_ms = self.guard.preemption_save(
+            self.checkpointer, state, global_step
+        )
         self._log(
             f"preemption requested "
             f"(signal {self.guard.received_signal or 'injected/manual'}); "
@@ -501,6 +496,9 @@ class TrainingExperiment(Experiment):
                 "checkpointer.save_every_epochs/save_every_steps must be "
                 ">= 0 (0 disables that cadence)."
             )
+        # Pure config (mode/queue_policy/durable tier): fail before
+        # device setup / checkpoint restore.
+        self.checkpointer._validate_mode()
         if (
             self.checkpointer.save_every_steps > 0
             and self.checkpointer.keep_best_metric is not None
@@ -603,6 +601,10 @@ class TrainingExperiment(Experiment):
         )
         # Per-run restore-latency probe (read by run_with_recovery).
         self.first_step_at = None
+        # Per-run preemption-save wait probe (ms spent draining the
+        # in-flight async checkpoint write before the final sync save;
+        # 0.0 in sync mode — also read by run_with_recovery).
+        self.save_wait_ms = None
         # From here until teardown, SIGTERM/SIGINT mean "save and exit
         # at the next step/slab boundary", not "die mid-write".
         self.guard.install()

@@ -370,6 +370,38 @@ def build_multi_step(
     return multi_step
 
 
+def host_snapshot(tree):
+    """Donation-safe device→host snapshot of a pytree: every leaf comes
+    back as an independent host ``np.ndarray``, so the snapshot stays
+    valid after the originating device buffers are donated into the
+    next step/slab dispatch (the async checkpointer's slab-boundary
+    hook — ``training.async_checkpoint``).
+
+    The device→host copies for ALL leaves are issued asynchronously
+    first (``copy_to_host_async``, best-effort — a leaf that is already
+    host-side or an older jax simply skips the hint), then materialized:
+    the transfers overlap each other and any still-running device work
+    queued BEHIND the state's producing computation, so the training
+    thread pays one drained-copy wait, not a serialized per-leaf walk.
+    """
+    import numpy as np
+
+    leaves, treedef = jax.tree.flatten(tree)
+    for leaf in leaves:
+        copy_async = getattr(leaf, "copy_to_host_async", None)
+        if copy_async is not None:
+            try:
+                copy_async()
+            except Exception:
+                pass  # placement/backend without async copies: device_get below
+    # np.asarray on a jax Array materializes the (already in-flight)
+    # host copy; 0-d leaves become 0-d ndarrays (orbax rejects bare
+    # numpy scalars, so the asarray wrapper is load-bearing).
+    return jax.tree.unflatten(
+        treedef, [np.asarray(jax.device_get(leaf)) for leaf in leaves]
+    )
+
+
 def make_eval_step(
     loss_fn: Callable[[jax.Array, jax.Array], jax.Array] = softmax_cross_entropy,
     *,
