@@ -1,0 +1,120 @@
+"""The live HTTP endpoint: /metrics scrape validity, /statusz content,
+/trace export — over a real socket, the way a scraper sees it."""
+
+import json
+import re
+import urllib.request
+
+import pytest
+
+from zookeeper_tpu.observability import trace
+from zookeeper_tpu.observability.export import ObservabilityServer
+from zookeeper_tpu.observability.registry import MetricsRegistry
+
+PROM_SAMPLE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? [^ ]+$"
+)
+
+
+@pytest.fixture
+def server_and_registry():
+    r = MetricsRegistry()
+    r.counter("zk_test_requests", help="reqs").inc(2)
+    r.gauge("zk_test_step", initial=-1)
+    h = r.histogram("zk_test_lat_ms", buckets=(1.0, 10.0))
+    h.observe(0.5)
+    server = ObservabilityServer([r], port=0).start()
+    yield server, r
+    server.stop()
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        return resp.status, resp.headers, resp.read().decode()
+
+
+def test_metrics_endpoint_serves_all_series(server_and_registry):
+    server, registry = server_and_registry
+    assert server.port not in (None, 0)  # ephemeral port got bound
+    status, headers, body = _get(f"{server.url}/metrics")
+    assert status == 200
+    assert headers["Content-Type"].startswith("text/plain")
+    samples = [
+        line
+        for line in body.splitlines()
+        if line and not line.startswith("#")
+    ]
+    assert all(PROM_SAMPLE.match(line) for line in samples), samples
+    for inst in registry.collect():
+        assert inst.name in body
+    assert "zk_test_requests 2" in body
+    assert 'zk_test_lat_ms_bucket{le="+Inf"} 1' in body
+
+
+def test_metrics_scrape_sees_live_updates(server_and_registry):
+    server, registry = server_and_registry
+    registry.counter("zk_test_requests").inc(5)
+    _, _, body = _get(f"{server.url}/metrics")
+    assert "zk_test_requests 7" in body
+
+
+def test_statusz_endpoint(server_and_registry):
+    server, _ = server_and_registry
+    server.add_status_provider("custom", lambda: {"answer": 42})
+    status, headers, body = _get(f"{server.url}/statusz")
+    assert status == 200
+    doc = json.loads(body)
+    assert doc["pid"] > 0
+    assert doc["uptime_s"] >= 0
+    assert "zk-obs-http" in doc["threads"]
+    assert doc["metrics"]["zk_test_requests"] == 2
+    assert doc["custom"] == {"answer": 42}
+
+
+def test_statusz_survives_broken_provider(server_and_registry):
+    server, _ = server_and_registry
+    server.add_status_provider(
+        "broken", lambda: (_ for _ in ()).throw(RuntimeError("nope"))
+    )
+    status, _, body = _get(f"{server.url}/statusz")
+    assert status == 200
+    assert "error" in json.loads(body)["broken"]
+
+
+def test_trace_endpoint_serves_chrome_json(server_and_registry):
+    server, _ = server_and_registry
+    trace.disable()
+    try:
+        trace.enable(64)
+        with trace.span("probe", step=1):
+            pass
+        _, _, body = _get(f"{server.url}/trace")
+        doc = json.loads(body)
+        assert any(
+            e["ph"] == "X" and e["name"] == "probe"
+            for e in doc["traceEvents"]
+        )
+    finally:
+        trace.disable()
+
+
+def test_unknown_path_404s(server_and_registry):
+    server, _ = server_and_registry
+    with pytest.raises(urllib.error.HTTPError) as err:
+        _get(f"{server.url}/nope")
+    assert err.value.code == 404
+
+
+def test_healthz(server_and_registry):
+    server, _ = server_and_registry
+    status, _, body = _get(f"{server.url}/healthz")
+    assert status == 200 and body == "ok\n"
+
+
+def test_stop_is_idempotent():
+    server = ObservabilityServer([MetricsRegistry()], port=0).start()
+    url = server.url
+    server.stop()
+    server.stop()
+    with pytest.raises(Exception):
+        _get(f"{url}/metrics")

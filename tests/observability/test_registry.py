@@ -1,0 +1,205 @@
+"""Typed metrics registry: instrument semantics, get-or-create /
+conflict rules, thread safety, and Prometheus text rendering."""
+
+import re
+import threading
+
+import pytest
+
+from zookeeper_tpu.observability.export import render_prometheus
+from zookeeper_tpu.observability.registry import (
+    Histogram,
+    MetricsRegistry,
+)
+
+
+def test_counter_monotone():
+    r = MetricsRegistry()
+    c = r.counter("reqs")
+    c.inc()
+    c.inc(4)
+    assert c.value == 5
+    with pytest.raises(ValueError):
+        c.inc(-1)
+
+
+def test_gauge_set_inc_initial():
+    r = MetricsRegistry()
+    g = r.gauge("step", initial=-1)
+    assert g.value == -1
+    g.set(7)
+    g.inc(2)
+    assert g.value == 9
+
+
+def test_histogram_buckets_cumulative():
+    r = MetricsRegistry()
+    h = r.histogram("lat", buckets=(1.0, 5.0, 10.0))
+    for v in (0.5, 1.0, 3.0, 7.0, 100.0):
+        h.observe(v)
+    # le semantics: a sample equal to a bound lands IN that bucket.
+    assert h.cumulative_counts() == [2, 3, 4]
+    assert h.count == 5
+    assert h.sum == pytest.approx(111.5)
+
+
+def test_histogram_rejects_bad_buckets():
+    r = MetricsRegistry()
+    with pytest.raises(ValueError):
+        r.histogram("bad", buckets=())
+    with pytest.raises(ValueError):
+        r.histogram("bad2", buckets=(5.0, 1.0))
+    with pytest.raises(ValueError):
+        r.histogram("bad3", buckets=(1.0, 1.0))
+    # An inf bound would render an explicit le="+Inf" bucket line next
+    # to the implicit one — a duplicate sample Prometheus rejects.
+    with pytest.raises(ValueError):
+        r.histogram("bad4", buckets=(1.0, float("inf")))
+    with pytest.raises(ValueError):
+        r.histogram("bad5", buckets=(float("nan"),))
+
+
+def test_instrument_reset_in_place():
+    r = MetricsRegistry()
+    c = r.counter("c")
+    g = r.gauge("g", initial=-1.0)
+    h = r.histogram("h", buckets=(1.0, 10.0))
+    c.inc(3)
+    g.set(42.0)
+    h.observe(5.0)
+    for inst in (c, g, h):
+        inst.reset()
+    assert c.value == 0.0
+    assert g.value == -1.0  # registration-time initial, not 0
+    assert h.count == 0 and h.sum == 0.0
+    assert h.cumulative_counts() == [0, 0]
+    # Identity preserved: the registry still hands out the same objects.
+    assert r.counter("c") is c and r.gauge("g") is g
+
+
+def test_get_or_create_shares_and_conflicts():
+    r = MetricsRegistry()
+    assert r.counter("x") is r.counter("x")
+    with pytest.raises(ValueError):
+        r.gauge("x")  # same name, different type
+    h = r.histogram("h", buckets=(1.0, 2.0))
+    assert r.histogram("h", buckets=(1.0, 2.0)) is h
+    with pytest.raises(ValueError):
+        r.histogram("h", buckets=(1.0, 3.0))  # same name, other bounds
+
+
+def test_labels_distinguish_series():
+    r = MetricsRegistry()
+    a = r.counter("req", labels={"tier": "a"})
+    b = r.counter("req", labels={"tier": "b"})
+    assert a is not b
+    a.inc()
+    assert (a.value, b.value) == (1, 0)
+
+
+def test_concurrent_counter_increments_are_exact():
+    r = MetricsRegistry()
+    c = r.counter("hits")
+    h = r.histogram("obs", buckets=(10.0, 100.0))
+    n_threads, per_thread = 8, 2000
+
+    def work():
+        for i in range(per_thread):
+            c.inc()
+            h.observe(float(i % 150))
+
+    threads = [threading.Thread(target=work) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.value == n_threads * per_thread
+    assert h.count == n_threads * per_thread
+    assert h.cumulative_counts()[-1] + (h.count - h.cumulative_counts()[-1]) == h.count
+
+
+PROM_SAMPLE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? [^ ]+$"
+)
+
+
+def test_prometheus_rendering_format():
+    r = MetricsRegistry()
+    r.counter("zk_requests", help="total requests").inc(3)
+    r.gauge("zk_step", initial=-1)
+    h = r.histogram("zk_lat_ms", buckets=(1.0, 10.0), help="latency")
+    h.observe(0.3)
+    h.observe(4.0)
+    h.observe(40.0)
+    labeled = r.counter("zk_tenant_reqs", labels={"tenant": "a b"})
+    labeled.inc()
+    text = render_prometheus([r])
+    lines = text.splitlines()
+    samples = [l for l in lines if l and not l.startswith("#")]
+    assert all(PROM_SAMPLE.match(l) for l in samples), samples
+    assert "# TYPE zk_requests counter" in lines
+    assert "# HELP zk_requests total requests" in lines
+    assert "zk_requests 3" in lines
+    assert "zk_step -1" in lines
+    assert "# TYPE zk_lat_ms histogram" in lines
+    assert 'zk_lat_ms_bucket{le="1"} 1' in lines
+    assert 'zk_lat_ms_bucket{le="10"} 2' in lines
+    assert 'zk_lat_ms_bucket{le="+Inf"} 3' in lines
+    assert "zk_lat_ms_sum 44.3" in lines
+    assert "zk_lat_ms_count 3" in lines
+    assert 'zk_tenant_reqs{tenant="a b"} 1' in lines
+
+
+def test_prometheus_groups_label_variants_under_one_header():
+    """Two label variants of one metric (a per-split gauge, or the same
+    name across two registries) must render ONE # HELP/# TYPE header
+    with contiguous samples — the exposition parser rejects a second
+    TYPE line for a name, failing the whole scrape."""
+    r = MetricsRegistry()
+    r.gauge("zk_occ", help="fill", labels={"split": "train"}).set(2)
+    r.gauge("zk_occ", help="fill", labels={"split": "validation"}).set(1)
+    r2 = MetricsRegistry()
+    r2.gauge("zk_occ", help="fill", labels={"split": "test"}).set(0)
+    text = render_prometheus([r, r2])
+    lines = text.splitlines()
+    assert lines.count("# TYPE zk_occ gauge") == 1
+    assert lines.count("# HELP zk_occ fill") == 1
+    assert 'zk_occ{split="train"} 2' in lines
+    assert 'zk_occ{split="validation"} 1' in lines
+    assert 'zk_occ{split="test"} 0' in lines
+
+
+def test_prometheus_sanitizes_names():
+    r = MetricsRegistry()
+    r.counter("serve/latency p99").inc()
+    text = render_prometheus([r])
+    assert "serve_latency_p99 1" in text
+
+
+def test_flat_dict_view():
+    r = MetricsRegistry()
+    r.counter("c").inc(2)
+    r.gauge("g").set(1.5)
+    h = r.histogram("h", buckets=(1.0,))
+    h.observe(4.0)
+    flat = r.as_flat_dict()
+    assert flat["c"] == 2
+    assert flat["g"] == 1.5
+    assert flat["h_count"] == 1
+    assert flat["h_sum"] == 4.0
+    assert flat["h_mean"] == 4.0
+
+
+def test_render_multiple_registries():
+    a, b = MetricsRegistry(), MetricsRegistry()
+    a.counter("from_a").inc()
+    b.counter("from_b").inc()
+    text = render_prometheus([a, b])
+    assert "from_a 1" in text and "from_b 1" in text
+
+
+def test_histogram_isinstance_check():
+    r = MetricsRegistry()
+    h = r.histogram("h", buckets=(1.0,))
+    assert isinstance(h, Histogram)
+    assert h.kind == "histogram"

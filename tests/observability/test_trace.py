@@ -1,0 +1,163 @@
+"""Host-side span tracing: recording semantics, the disabled-path
+zero-cost contract, ring bounding, thread attribution, and Chrome
+trace-event export validity."""
+
+import json
+import threading
+
+import pytest
+
+from zookeeper_tpu.observability import trace
+
+
+@pytest.fixture(autouse=True)
+def _clean_tracer():
+    """Every test starts and ends with tracing disabled (the module
+    global is process-wide)."""
+    trace.disable()
+    yield
+    trace.disable()
+
+
+def test_disabled_span_is_shared_noop_no_allocation():
+    # The zero-cost contract: the SAME object comes back from every
+    # disabled span() call — one flag check, no per-call allocation.
+    a = trace.span("x", step=1, slab=2)
+    b = trace.span("y")
+    assert a is b
+    with a:
+        pass  # entering/exiting the noop is safe and records nothing
+    assert not trace.enabled()
+    assert trace.get_tracer() is None
+
+
+def test_disabled_event_records_nothing():
+    trace.event("whatever", step=3, attrs={"k": 1})
+    assert trace.get_tracer() is None
+
+
+def test_span_records_interval_with_attribution():
+    tracer = trace.enable(128)
+    with trace.span("data_wait", step=7, slab=2, attrs={"rows": 32}):
+        pass
+    (rec,) = tracer.snapshot()
+    assert rec["phase"] == "X"
+    assert rec["name"] == "data_wait"
+    assert rec["step"] == 7
+    assert rec["slab"] == 2
+    assert rec["attrs"] == {"rows": 32}
+    assert rec["dur_ns"] >= 0
+    assert rec["thread_name"] == threading.current_thread().name
+    assert rec["thread_id"] == threading.get_ident()
+
+
+def test_event_records_instant():
+    tracer = trace.enable(128)
+    trace.event("fault_injected", step=5, attrs={"kind": "kill_at_step"})
+    (rec,) = tracer.snapshot()
+    assert rec["phase"] == "i"
+    assert rec["name"] == "fault_injected"
+    assert rec["step"] == 5
+
+
+def test_ring_is_bounded_and_evicts_oldest():
+    tracer = trace.enable(capacity=8)
+    for i in range(20):
+        trace.event("e", step=i)
+    records = tracer.snapshot()
+    assert len(records) == 8
+    assert [r["step"] for r in records] == list(range(12, 20))
+
+
+def test_reenable_keeps_existing_ring_first_enable_wins():
+    tracer = trace.enable(64)
+    trace.event("kept")
+    assert trace.enable(64) is tracer
+    # A nested enabler with a different capacity must NOT drop the
+    # live ring (the outer session's records and tracer reference
+    # survive); capacity changes require an explicit disable().
+    assert trace.enable(32) is tracer
+    assert len(tracer) == 1
+    trace.disable()
+    fresh = trace.enable(32)
+    assert fresh is not tracer and fresh.capacity == 32
+
+
+def test_drain_clears_snapshotted_records():
+    tracer = trace.enable(64)
+    trace.event("a")
+    trace.event("b")
+    drained = tracer.drain()
+    assert [r["name"] for r in drained] == ["a", "b"]
+    assert len(tracer) == 0
+
+
+def test_concurrent_recording_is_lossless_under_capacity():
+    tracer = trace.enable(capacity=100_000)
+    n_threads, per_thread = 8, 500
+
+    def record(tid):
+        for i in range(per_thread):
+            with trace.span("work", step=i, attrs=None):
+                pass
+            trace.event("mark", step=i)
+
+    threads = [
+        threading.Thread(target=record, args=(t,), name=f"rec-{t}")
+        for t in range(n_threads)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(tracer) == n_threads * per_thread * 2
+
+
+def test_chrome_export_is_valid_trace_event_json(tmp_path):
+    trace.enable(256)
+    with trace.span("dispatch", step=3, slab=1):
+        with trace.span("inner"):
+            pass
+    trace.event("fault_injected", attrs={"kind": "fail_save_io"})
+
+    def other():
+        with trace.span("ckpt_write", step=3):
+            pass
+
+    t = threading.Thread(target=other, name="zk-async-ckpt")
+    t.start()
+    t.join()
+
+    path = tmp_path / "trace.json"
+    n = trace.export_chrome_trace(str(path))
+    doc = json.loads(path.read_text())
+    assert isinstance(doc["traceEvents"], list)
+    assert n == len(doc["traceEvents"])
+    by_phase = {}
+    for e in doc["traceEvents"]:
+        by_phase.setdefault(e["ph"], []).append(e)
+        # The trace-event contract every viewer relies on.
+        assert isinstance(e["name"], str)
+        assert isinstance(e["pid"], int)
+        assert isinstance(e["tid"], int)
+        if e["ph"] == "X":
+            assert e["ts"] >= 0 and e["dur"] >= 0
+    # Complete spans, instants, and per-thread name metadata all present.
+    assert {e["name"] for e in by_phase["X"]} == {
+        "dispatch", "inner", "ckpt_write",
+    }
+    assert by_phase["i"][0]["args"]["kind"] == "fail_save_io"
+    thread_names = {e["args"]["name"] for e in by_phase["M"]}
+    assert "zk-async-ckpt" in thread_names
+    # step/slab attribution lands in args.
+    dispatch = next(e for e in by_phase["X"] if e["name"] == "dispatch")
+    assert dispatch["args"] == {"step": 3, "slab": 1}
+
+
+def test_span_is_exception_safe():
+    tracer = trace.enable(64)
+    with pytest.raises(ValueError):
+        with trace.span("failing"):
+            raise ValueError("boom")
+    (rec,) = tracer.snapshot()
+    assert rec["name"] == "failing"  # recorded despite the raise

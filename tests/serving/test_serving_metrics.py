@@ -1,0 +1,233 @@
+"""ServingMetrics: concurrent recording exactness, empty-reservoir
+percentile edge cases, window bounding, and the typed-registry backing
+(docs/DESIGN.md §13) — the aggregator is recorded into from the async
+batcher worker, the checkpoint watcher daemon, and submitter threads
+at once, so its counters must be exact under contention."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from zookeeper_tpu.core import configure
+from zookeeper_tpu.observability.export import render_prometheus
+from zookeeper_tpu.serving import ServingMetrics
+
+pytestmark = pytest.mark.serving
+
+
+def make_metrics(extra=None):
+    m = ServingMetrics()
+    configure(m, dict(extra or {}), name="metrics_test")
+    return m
+
+
+# -- concurrency ---------------------------------------------------------
+
+
+def test_concurrent_recording_counters_are_exact():
+    m = make_metrics()
+    n_threads, per_thread = 8, 500
+    barrier = threading.Barrier(n_threads)
+
+    def record(tid):
+        barrier.wait()  # maximize interleaving
+        for i in range(per_thread):
+            m.record_request(float(i % 37), rows=2)
+            m.record_dispatch(real_rows=3, bucket_rows=4)
+            m.record_queue_depth(i % 11)
+            if i % 5 == 0:
+                m.record_rejected()
+            if i % 7 == 0:
+                m.record_deadline_expired()
+
+    threads = [
+        threading.Thread(target=record, args=(t,))
+        for t in range(n_threads)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+    totals = m.totals
+    assert totals["requests"] == n_threads * per_thread
+    assert totals["rows"] == n_threads * per_thread * 2
+    assert totals["dispatches"] == n_threads * per_thread
+    assert totals["rejected"] == n_threads * len(range(0, per_thread, 5))
+    assert totals["deadline_expired"] == n_threads * len(
+        range(0, per_thread, 7)
+    )
+    # Histograms saw every sample too (the /metrics view can't
+    # silently undercount relative to the totals).
+    hist = m._obs()["hist"]["latency_ms"]
+    assert hist.count == n_threads * per_thread
+
+
+def test_concurrent_first_touch_initialization_shares_one_registry():
+    """The racing-threads-at-first-record path: every thread's samples
+    must land in ONE registry (a dropped half-initialized registry
+    would silently eat samples)."""
+    m = make_metrics()
+    n_threads = 16
+    barrier = threading.Barrier(n_threads)
+
+    def record():
+        barrier.wait()
+        m.record_request(1.0, rows=1)
+
+    threads = [threading.Thread(target=record) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert m.totals["requests"] == n_threads
+    assert len(m._series("latency_ms")) == n_threads
+
+
+def test_concurrent_percentile_snapshot_during_recording():
+    """snapshot() races record_* without crashing or returning
+    out-of-range percentiles (the scrape thread reads while the worker
+    records)."""
+    m = make_metrics()
+    stop = threading.Event()
+    errors = []
+
+    def record():
+        i = 0
+        while not stop.is_set():
+            m.record_request(float(i % 100), rows=1)
+            i += 1
+
+    def snapshot():
+        try:
+            while not stop.is_set():
+                snap = m.snapshot()
+                if "latency_p99_ms" in snap:
+                    assert 0.0 <= snap["latency_p50_ms"] <= 99.0
+                    assert snap["latency_p50_ms"] <= snap["latency_p99_ms"]
+        except Exception as e:  # pragma: no cover - the failure leg
+            errors.append(e)
+
+    recorder = threading.Thread(target=record)
+    reader = threading.Thread(target=snapshot)
+    recorder.start()
+    reader.start()
+    # Let them contend briefly but deterministically-bounded.
+    recorder.join(timeout=0.25)
+    stop.set()
+    recorder.join()
+    reader.join()
+    assert not errors
+
+
+# -- empty-reservoir percentile edge cases -------------------------------
+
+
+def test_snapshot_with_no_samples_has_counters_only():
+    m = make_metrics()
+    snap = m.snapshot()
+    assert snap["requests"] == 0.0
+    assert "latency_p50_ms" not in snap
+    assert "latency_p95_ms" not in snap
+    assert "latency_p99_ms" not in snap
+    assert "latency_mean_ms" not in snap
+    assert "queue_depth_mean" not in snap
+    assert "bucket_fill_mean" not in snap
+
+
+def test_snapshot_after_counter_only_recording_omits_percentiles():
+    """Counter recorders (rejected/deadline/watcher) must not conjure
+    an empty latency series into the percentile math."""
+    m = make_metrics()
+    m.record_rejected()
+    m.record_deadline_expired()
+    m.record_watcher_stopped()
+    m.record_weights_step(12)
+    snap = m.snapshot()
+    assert snap["rejected"] == 1.0
+    assert snap["serving_weights_step"] == 12.0
+    assert "latency_p50_ms" not in snap
+    assert "weight_swap_ms_mean" not in snap
+
+
+def test_single_sample_percentiles_degenerate_to_that_sample():
+    m = make_metrics()
+    m.record_request(8.25, rows=1)
+    snap = m.snapshot()
+    for key in (
+        "latency_p50_ms", "latency_p95_ms", "latency_p99_ms",
+        "latency_mean_ms",
+    ):
+        assert snap[key] == 8.25
+
+
+def test_window_bounds_percentile_reservoir():
+    m = make_metrics({"window": 8})
+    for v in range(100):
+        m.record_request(float(v), rows=1)
+    # Only the last 8 samples survive; totals still count everything.
+    assert m.totals["requests"] == 100
+    arr = np.asarray(m._series("latency_ms"))
+    assert arr.tolist() == [float(v) for v in range(92, 100)]
+    snap = m.snapshot()
+    assert snap["latency_p50_ms"] == pytest.approx(
+        float(np.percentile(arr, 50))
+    )
+
+
+def test_reset_clears_counters_windows_in_place():
+    m = make_metrics()
+    m.record_request(1.0, rows=1)
+    m.record_weight_swap(5.0, step=7)
+    old_registry = m.registry
+    m.reset()
+    assert m.totals["requests"] == 0
+    assert m.totals["serving_weights_step"] == -1  # back to initial
+    assert "latency_p50_ms" not in m.snapshot()
+    # The registry and instruments survive reset: a live
+    # ObservabilityServer that captured m.registry at startup must keep
+    # rendering this aggregator (and see post-reset samples).
+    assert m.registry is old_registry
+    m.record_request(2.0, rows=3)
+    text = render_prometheus([old_registry])
+    assert "zk_serving_requests 1" in text
+    assert "zk_serving_rows 3" in text
+
+
+# -- registry backing ----------------------------------------------------
+
+
+def test_registry_renders_every_serving_series():
+    m = make_metrics()
+    m.record_request(3.0, rows=2)
+    m.record_dispatch(3, 4)
+    m.record_queue_depth(5)
+    m.record_weight_swap(20.0, step=42)
+    text = render_prometheus([m.registry])
+    assert "zk_serving_requests 1" in text
+    assert "zk_serving_rows 2" in text
+    assert "zk_serving_dispatches 1" in text
+    assert "zk_serving_queue_depth 5" in text
+    assert "zk_serving_serving_weights_step 42" in text
+    assert "zk_serving_weight_swaps 1" in text
+    assert "zk_serving_latency_ms_count 1" in text
+    assert 'zk_serving_bucket_fill_bucket{le="+Inf"} 1' in text
+
+
+def test_two_instances_have_independent_registries():
+    a, b = make_metrics(), make_metrics()
+    a.record_request(1.0, rows=1)
+    assert a.totals["requests"] == 1
+    assert b.totals["requests"] == 0
+    assert a.registry is not b.registry
+
+
+def test_totals_key_order_is_stable():
+    # Downstream JSON consumers (finish_report lines, dashboards) see
+    # the historical key order.
+    assert list(make_metrics().totals) == [
+        "requests", "rows", "dispatches", "rejected",
+        "deadline_expired", "worker_restarts", "weight_swaps",
+        "serving_weights_step", "watcher_stopped",
+    ]

@@ -1,0 +1,275 @@
+"""Training-loop observability: host-span capture across a real run
+(the acceptance artifact — data_wait/dispatch/readback/checkpoint spans
+covering full slabs, exported as Chrome trace-event JSON), the live
+/metrics endpoint, and the profiling-window try/finally fix."""
+
+import json
+import urllib.request
+
+import pytest
+
+from zookeeper_tpu.core import configure
+from zookeeper_tpu.observability import trace
+from zookeeper_tpu.training import TrainingExperiment
+
+
+@pytest.fixture(autouse=True)
+def _clean_tracer():
+    trace.disable()
+    yield
+    trace.disable()
+
+
+def make_experiment(tmp_path, extra=None):
+    exp = TrainingExperiment()
+    conf = {
+        "loader.dataset": "SyntheticMnist",
+        "loader.dataset.num_train_examples": 256,
+        "loader.dataset.num_validation_examples": 0,
+        "loader.preprocessing": "ImageClassificationPreprocessing",
+        "loader.preprocessing.height": 28,
+        "loader.preprocessing.width": 28,
+        "loader.preprocessing.channels": 1,
+        "loader.host_index": 0,
+        "loader.host_count": 1,
+        "model": "Mlp",
+        "model.hidden_units": (32,),
+        "batch_size": 32,
+        "epochs": 1,
+        "validate": False,
+        "verbose": False,
+        "checkpointer.directory": str(tmp_path / "ckpt"),
+        "checkpointer.synchronous": True,
+        **(extra or {}),
+    }
+    configure(exp, conf, name="obs_experiment")
+    return exp
+
+
+def _spans(doc, name):
+    return [
+        e
+        for e in doc["traceEvents"]
+        if e["ph"] == "X" and e["name"] == name
+    ]
+
+
+def test_fused_run_exports_full_slab_phase_trace(tmp_path):
+    """The acceptance artifact: a fused (unroll>1) run's host trace is
+    valid Chrome trace-event JSON covering >= one full slab with
+    data_wait / dispatch / readback / checkpoint spans, each carrying
+    step/slab attribution."""
+    trace_path = tmp_path / "host_trace.json"
+    exp = make_experiment(
+        tmp_path,
+        {
+            "unroll": 2,
+            "log_every": 2,
+            "checkpointer.save_every_steps": 4,
+            "trace_export": str(trace_path),
+        },
+    )
+    exp.run()
+    doc = json.loads(trace_path.read_text())
+    # 256 examples / 32 batch = 8 steps = 4 slabs of 2.
+    dispatch = _spans(doc, "dispatch")
+    assert len(dispatch) == 4
+    assert [e["args"]["slab"] for e in dispatch] == [0, 1, 2, 3]
+    assert all("step" in e["args"] for e in dispatch)
+    data_wait = _spans(doc, "data_wait")
+    assert len(data_wait) >= 4  # one per slab pull (+ exhaustion probe)
+    assert _spans(doc, "readback")  # log_every + epoch-end readbacks
+    ckpt = _spans(doc, "checkpoint")
+    assert len(ckpt) == 2  # save_every_steps=4 over 8 steps
+    # The nested checkpointer-internal span rides the same timeline.
+    assert _spans(doc, "ckpt_sync_save")
+    # Every complete event is well-formed for the trace viewers.
+    for e in doc["traceEvents"]:
+        if e["ph"] == "X":
+            assert e["ts"] >= 0 and e["dur"] >= 0
+    # Run-scoped enablement: teardown restored the disabled state.
+    assert not trace.enabled()
+
+
+def test_eager_run_exports_phase_trace(tmp_path):
+    trace_path = tmp_path / "host_trace.json"
+    exp = make_experiment(
+        tmp_path, {"log_every": 4, "trace_export": str(trace_path)}
+    )
+    exp.run()
+    doc = json.loads(trace_path.read_text())
+    assert len(_spans(doc, "dispatch")) == 8  # one per eager step
+    assert _spans(doc, "data_wait")
+    assert _spans(doc, "readback")
+
+
+def test_trace_export_written_even_when_run_raises(tmp_path):
+    """Teardown exports the trace on the failure path too — the trace
+    of a crashed run is the one you actually want to look at."""
+    from zookeeper_tpu.resilience import faults
+
+    trace_path = tmp_path / "host_trace.json"
+    exp = make_experiment(tmp_path, {"trace_export": str(trace_path)})
+    with faults.injected(faults.FaultPlan(kill_at_step=3)):
+        with pytest.raises(faults.Preempted):
+            exp.run()
+    doc = json.loads(trace_path.read_text())
+    assert _spans(doc, "dispatch")
+    # The injected kill is a self-explaining instant on the timeline.
+    injected = [
+        e
+        for e in doc["traceEvents"]
+        if e["ph"] == "i" and e["name"] == "fault_injected"
+    ]
+    assert injected and injected[0]["args"]["kind"] == "kill_at_step"
+    assert not trace.enabled()
+
+
+def test_metrics_endpoint_live_during_run(tmp_path):
+    """metrics_port=0 brings up /metrics for the run's lifetime: a
+    scrape from inside the run (hooked off the epoch writer call) sees
+    the process-global gauges and the experiment's published epoch
+    rates; the server is gone after teardown."""
+    exp = make_experiment(tmp_path, {"epochs": 2, "metrics_port": 0})
+    spe = 8  # 256 / 32
+    scraped = {}
+    orig_write = exp.writer.write_scalars
+
+    def spy(step, values):
+        server = getattr(exp, "obs_server", None)
+        if (
+            "body" not in scraped
+            and server is not None
+            and any(k.startswith("train_epoch/") for k in values)
+            and step >= 2 * spe
+        ):
+            base = f"http://127.0.0.1:{server.port}"
+            scraped["body"] = (
+                urllib.request.urlopen(base + "/metrics").read().decode()
+            )
+            scraped["statusz"] = json.loads(
+                urllib.request.urlopen(base + "/statusz").read()
+            )
+        return orig_write(step, values)
+
+    exp.writer.write_scalars = spy
+    exp.run()
+    assert "body" in scraped, "epoch-boundary scrape never fired"
+    body = scraped["body"]
+    # Epoch-derived rates (published at the END of epoch 1, scraped at
+    # epoch 2's writer call) and the process-global prefetch gauge.
+    assert "zk_train_loss" in body
+    assert "zk_train_examples_per_sec" in body
+    assert "zk_train_epoch 1" in body
+    assert "zk_prefetch_occupancy" in body
+    status = scraped["statusz"]
+    assert status["training"]["model"] == "Mlp"
+    assert status["training"]["epochs"] == 2
+    # Teardown stopped the server and cleared the handle.
+    assert getattr(exp, "obs_server", None) is None
+
+
+def test_prefetch_thread_is_named(tmp_path):
+    """Satellite: the device-prefetch producer runs under a zk- name so
+    py-spy / host-trace attribution reads as a subsystem, not
+    Thread-N."""
+    import threading
+    import time
+
+    from zookeeper_tpu.data.pipeline import prefetch_to_device
+
+    seen = {}
+    release = threading.Event()
+
+    def slow_source():
+        for i in range(4):
+            yield {"x": i}
+            release.wait(1.0)  # keep the producer alive to be observed
+
+    it = prefetch_to_device(slow_source(), size=1)
+    first = next(it)
+    deadline = time.perf_counter() + 2.0
+    while time.perf_counter() < deadline and "name" not in seen:
+        names = [t.name for t in threading.enumerate()]
+        hits = [n for n in names if n.startswith("zk-prefetch")]
+        if hits:
+            seen["name"] = hits[0]
+        else:
+            time.sleep(0.01)
+    release.set()
+    for _ in it:
+        pass
+    assert seen.get("name") == "zk-prefetch"
+    assert first["x"] == 0
+
+
+def test_profiling_window_closed_on_mid_capture_exception(
+    tmp_path, monkeypatch
+):
+    """Satellite fix: an exception raised while the jax.profiler
+    capture window is open (here: an injected preemption between
+    p_start and p_stop) must still stop the trace in teardown —
+    previously the window leaked and poisoned the next start_trace."""
+    import jax
+
+    from zookeeper_tpu.resilience import faults
+
+    calls = {"start": 0, "stop": 0}
+    real_start = jax.profiler.start_trace
+    real_stop = jax.profiler.stop_trace
+
+    def start(*a, **k):
+        calls["start"] += 1
+        return real_start(*a, **k)
+
+    def stop(*a, **k):
+        calls["stop"] += 1
+        return real_stop(*a, **k)
+
+    monkeypatch.setattr(jax.profiler, "start_trace", start)
+    monkeypatch.setattr(jax.profiler, "stop_trace", stop)
+
+    exp = make_experiment(
+        tmp_path, {"profile_dir": str(tmp_path / "prof")}
+    )
+    # Eager window is steps p_start=4..p_stop=7 (spe=8): kill at global
+    # step 6, strictly inside the open capture.
+    with faults.injected(faults.FaultPlan(kill_at_step=6)):
+        with pytest.raises(faults.Preempted):
+            exp.run()
+    assert calls["start"] == 1
+    assert calls["stop"] == 1, (
+        "teardown must close the dangling capture window"
+    )
+    assert not getattr(exp, "_jax_trace_active", False)
+    # And the next capture starts cleanly in the same process.
+    real_start(str(tmp_path / "prof2"))
+    real_stop()
+
+
+def test_profiling_window_still_closed_on_clean_run(tmp_path, monkeypatch):
+    """The happy path stops the trace exactly once (in the loop, not
+    again in teardown)."""
+    import jax
+
+    calls = {"start": 0, "stop": 0}
+    real_start = jax.profiler.start_trace
+    real_stop = jax.profiler.stop_trace
+    monkeypatch.setattr(
+        jax.profiler,
+        "start_trace",
+        lambda *a, **k: (calls.__setitem__("start", calls["start"] + 1),
+                         real_start(*a, **k))[1],
+    )
+    monkeypatch.setattr(
+        jax.profiler,
+        "stop_trace",
+        lambda *a, **k: (calls.__setitem__("stop", calls["stop"] + 1),
+                         real_stop(*a, **k))[1],
+    )
+    exp = make_experiment(
+        tmp_path, {"profile_dir": str(tmp_path / "prof")}
+    )
+    exp.run()
+    assert calls["start"] == 1
+    assert calls["stop"] == 1

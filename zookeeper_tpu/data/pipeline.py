@@ -29,6 +29,7 @@ import numpy as np
 
 from zookeeper_tpu.core import ComponentField, Field, component
 from zookeeper_tpu.data.dataset import Dataset
+from zookeeper_tpu.observability.registry import default_registry
 from zookeeper_tpu.data.preprocessing import Preprocessing
 from zookeeper_tpu.data.source import DataSource
 
@@ -234,7 +235,11 @@ def batch_iterator(
             example = preprocessing(example, training)
         return example
 
-    pool = ThreadPoolExecutor(num_workers) if num_workers > 0 else None
+    pool = (
+        ThreadPoolExecutor(num_workers, thread_name_prefix="zk-data-worker")
+        if num_workers > 0
+        else None
+    )
     try:
         for b in range(start_batch, num_batches):
             start = b * global_batch + host_index * batch_size
@@ -328,6 +333,7 @@ def prefetch_to_device(
     *,
     size: int = 2,
     sharding: Optional[Any] = None,
+    split: Optional[str] = None,
 ) -> Iterator[Any]:
     """Asynchronously stage host batches into device memory.
 
@@ -372,22 +378,40 @@ def prefetch_to_device(
             )
         return jax.device_put(batch, sharding)
 
+    # Prefetch occupancy (docs/DESIGN.md §13): sampled after every
+    # producer put and consumer get. Pinned at the queue's max while
+    # the device is the bottleneck; sitting at 0 means the loop is
+    # DATA-BOUND and the host pipeline is the thing to fix (the same
+    # diagnosis the trace's per-slab data_wait spans give, scrapeable).
+    # Labeled by split so a train loop and a validation loop in the
+    # same process each get their own series instead of flapping one
+    # shared gauge (split cardinality is bounded by the dataset's).
+    occupancy = default_registry().gauge(
+        "zk_prefetch_occupancy",
+        help="device-prefetch queue fill (staged batches ready)",
+        labels={"split": split} if split else None,
+    )
+
     def producer():
         try:
             for batch in iterator:
                 batch = stage(batch)
                 if not put_or_stop(batch):
                     return  # Consumer gone: drop refs, free device buffers.
+                occupancy.set(q.qsize())
         except BaseException as e:  # propagate into consumer
             err.append(e)
         finally:
             put_or_stop(_END)
 
-    thread = threading.Thread(target=producer, daemon=True)
+    thread = threading.Thread(
+        target=producer, name="zk-prefetch", daemon=True
+    )
     thread.start()
     try:
         while True:
             item = q.get()
+            occupancy.set(q.qsize())
             if item is _END:
                 if err:
                     raise err[0]
@@ -396,7 +420,9 @@ def prefetch_to_device(
     finally:
         # Consumer stopped early (e.g. steps_per_epoch cap): unblock and
         # terminate the producer so threads/HBM buffers don't accumulate
-        # across epochs.
+        # across epochs. Zero the gauge — a dead loop's last fill must
+        # not scrape as a live, healthy queue.
+        occupancy.set(0)
         stop.set()
 
 
@@ -514,7 +540,9 @@ class DataLoader:
 
             it = itertools.islice(it, max_batches)
         if self.prefetch > 0:
-            return prefetch_to_device(it, size=self.prefetch, sharding=sharding)
+            return prefetch_to_device(
+                it, size=self.prefetch, sharding=sharding, split=split
+            )
         return it
 
     def steps_per_epoch(self, split: str = "train") -> int:

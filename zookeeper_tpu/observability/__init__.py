@@ -1,0 +1,55 @@
+"""Unified observability: host-side span tracing, typed metrics, live
+export.
+
+The cross-cutting layer the north star's "production under heavy
+traffic" claim requires (docs/DESIGN.md §13). Three modules, stdlib
+only, all zero-cost until opted in:
+
+- ``trace`` — ``span()``/``event()`` into a bounded ring buffer with a
+  Chrome trace-event exporter: host phases (data wait, slab dispatch,
+  metrics readback, checkpoint write, batcher coalescing, preemption
+  drain) open in Perfetto alongside the ``jax.profiler`` device trace.
+- ``registry`` — Counter/Gauge/Histogram instruments behind a typed
+  name table; ``ServingMetrics`` and the background subsystems record
+  into it.
+- ``export`` — Prometheus text exposition + a stdlib HTTP
+  ``/metrics``-``/statusz``-``/trace`` endpoint
+  (``TrainingExperiment.metrics_port`` / ``ServingConfig.metrics_port``
+  opt in).
+"""
+
+from zookeeper_tpu.observability import trace
+from zookeeper_tpu.observability.export import (
+    ObservabilityServer,
+    render_prometheus,
+)
+from zookeeper_tpu.observability.registry import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    default_registry,
+)
+from zookeeper_tpu.observability.trace import (
+    Tracer,
+    event,
+    export_chrome_trace,
+    span,
+    to_chrome_trace,
+)
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "ObservabilityServer",
+    "Tracer",
+    "default_registry",
+    "event",
+    "export_chrome_trace",
+    "render_prometheus",
+    "span",
+    "to_chrome_trace",
+    "trace",
+]

@@ -36,6 +36,16 @@ import threading
 from dataclasses import dataclass, field
 from typing import Iterator, Optional
 
+from zookeeper_tpu.observability import trace as _trace
+
+
+def _injection_event(kind: str, step: Optional[int] = None) -> None:
+    """Every fault that actually FIRES marks the host trace, so a
+    chaos-test timeline is self-explaining: the injected kill/IO-
+    failure/crash appears as an instant event exactly where the
+    recovery machinery it triggered starts its spans."""
+    _trace.event("fault_injected", step=step, attrs={"kind": kind})
+
 
 class Preempted(Exception):
     """Training exited at a safe boundary after a preemption request
@@ -139,6 +149,7 @@ class FaultPlan:
         with self._lock:
             if not self._killed and int(step) >= self.kill_at_step:
                 self._killed = True
+                _injection_event("kill_at_step", step=int(step))
                 return True
         return False
 
@@ -147,6 +158,7 @@ class FaultPlan:
         with self._lock:
             if self.fail_save_io > 0:
                 self.fail_save_io -= 1
+                _injection_event("fail_save_io")
                 return True
         return False
 
@@ -155,6 +167,7 @@ class FaultPlan:
         with self._lock:
             if self.serving_worker_crash > 0:
                 self.serving_worker_crash -= 1
+                _injection_event("serving_worker_crash")
                 return True
         return False
 
@@ -164,6 +177,7 @@ class FaultPlan:
         with self._lock:
             if self.fail_async_finalize > 0:
                 self.fail_async_finalize -= 1
+                _injection_event("fail_async_finalize")
                 return True
         return False
 
@@ -178,6 +192,7 @@ class FaultPlan:
                 and int(step) == self.kill_during_async_write
             ):
                 self._async_killed = True
+                _injection_event("kill_during_async_write", step=int(step))
                 return True
         return False
 
@@ -189,6 +204,7 @@ class FaultPlan:
         with self._lock:
             if not self._corrupted and int(step) == self.corrupt_checkpoint_step:
                 self._corrupted = True
+                _injection_event("corrupt_checkpoint_step", step=int(step))
                 return True
         return False
 

@@ -23,6 +23,7 @@ import threading
 from typing import Any, Optional, Sequence, Tuple
 
 from zookeeper_tpu.core import Field, component
+from zookeeper_tpu.observability import trace as _trace
 
 
 @component
@@ -72,6 +73,10 @@ class PreemptionGuard:
         st = self._state()
         st["signal"] = signum
         st["flag"].set()
+        # Async-signal-safe enough: one deque append, no locks taken.
+        _trace.event(
+            "preemption_requested", attrs={"signal": signum}
+        )
 
     def _signals(self) -> Sequence[int]:
         sigs = [signal.SIGTERM]
@@ -138,7 +143,8 @@ class PreemptionGuard:
                 checkpointer.queue_policy == "supersede"
                 and checkpointer.keep_best_metric is None
             )
-            wait_ms = checkpointer.drain_async(supersede=supersede)
+            with _trace.span("preemption_drain", step=global_step):
+                wait_ms = checkpointer.drain_async(supersede=supersede)
             if checkpointer.keep_best_metric is not None:
                 # Rank-managed retention can't accept a metric-less
                 # save; the latest ranked save is the resume point.
@@ -146,7 +152,8 @@ class PreemptionGuard:
             elif checkpointer.latest_step() == global_step:
                 saved = True  # a cadence save just landed on this step
             else:
-                saved = bool(checkpointer.save(state, sync=True))
+                with _trace.span("preemption_save", step=global_step):
+                    saved = bool(checkpointer.save(state, sync=True))
             checkpointer.wait()  # synchronous: the process may die next
         return saved, wait_ms
 

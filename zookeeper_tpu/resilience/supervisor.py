@@ -24,6 +24,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Tuple, Type
 
+from zookeeper_tpu.observability import trace as _trace
 from zookeeper_tpu.resilience.faults import NonFiniteLossError, Preempted
 
 logger = logging.getLogger(__name__)
@@ -137,10 +138,22 @@ def run_with_recovery(
                 max_restarts,
                 delay,
             )
+            _trace.event(
+                "supervisor_restart",
+                attrs={
+                    "attempt": attempt + 1,
+                    "cause": type(e).__name__,
+                    "backoff_s": delay,
+                },
+            )
             if delay > 0:
                 sleep(delay)
             continue
         _record_restore_ms(experiment, attempt, t_start, restore_ms)
+        if attempt > 0:
+            _trace.event(
+                "supervisor_recovered", attrs={"restarts": attempt}
+            )
         return RecoveryResult(
             history=history,
             restarts=attempt,

@@ -51,6 +51,7 @@ from typing import Any, List, Optional, Tuple
 import numpy as np
 
 from zookeeper_tpu.core import Field, component
+from zookeeper_tpu.observability import trace as _trace
 
 Array = Any
 
@@ -270,10 +271,22 @@ class MicroBatcher:
             )
 
     def _record_done(self, req: PendingResult, latency_ms: float) -> None:
+        if _trace.enabled():
+            _trace.event(
+                "request_complete",
+                attrs={
+                    "rows": req._rows,
+                    "latency_ms": round(latency_ms, 3),
+                    "error": type(req._error).__name__
+                    if req._error is not None
+                    else None,
+                },
+            )
         if self._metrics is not None and req._error is None:
             self._metrics.record_request(latency_ms, req._rows)
 
     def _record_deadline_expired(self) -> None:
+        _trace.event("request_deadline_expired")
         if self._metrics is not None:
             self._metrics.record_deadline_expired()
 
@@ -302,6 +315,11 @@ class MicroBatcher:
         ):
             if self._metrics is not None:
                 self._metrics.record_rejected()
+            if _trace.enabled():
+                _trace.event(
+                    "request_shed",
+                    attrs={"rows": n, "queue_rows": self._queue_rows},
+                )
             raise RejectedError(
                 f"queue at {self._queue_rows} rows; admitting {n} more "
                 f"would exceed shed_above_rows={self.shed_above_rows} — "
@@ -338,6 +356,11 @@ class MicroBatcher:
             object.__setattr__(self, "_queue_rows", self._queue_rows + n)
             if self._metrics is not None:
                 self._metrics.record_queue_depth(self._queue_rows)
+            if _trace.enabled():
+                _trace.event(
+                    "request_enqueue",
+                    attrs={"rows": n, "queue_rows": self._queue_rows},
+                )
             return req
         req = PendingResult(
             self, n, event=threading.Event(), deadline_at=deadline_at
@@ -354,6 +377,11 @@ class MicroBatcher:
             object.__setattr__(self, "_queue_rows", self._queue_rows + n)
             if self._metrics is not None:
                 self._metrics.record_queue_depth(self._queue_rows)
+            if _trace.enabled():
+                _trace.event(
+                    "request_enqueue",
+                    attrs={"rows": n, "queue_rows": self._queue_rows},
+                )
             # Worker liveness is checked UNDER the lock, after the
             # request is queued: _on_worker_crash also holds the lock,
             # so either cleanup already ran (dead worker observed here,
@@ -418,13 +446,25 @@ class MicroBatcher:
         rows = sum(part.shape[0] for _, part in plan)
         if rows == 0:
             return
-        batch = (
-            plan[0][1]
-            if len(plan) == 1
-            else np.concatenate([part for _, part in plan])
+        # Coalescing visibility: one span covers concat + engine
+        # dispatch + the single host readback; the per-request
+        # complete events that follow nest under it on the timeline.
+        dispatch_span = _trace.span(
+            "serve_dispatch",
+            attrs=(
+                {"rows": rows, "requests": len(plan)}
+                if _trace.enabled()
+                else None
+            ),
         )
         try:
-            out = np.asarray(jax.device_get(self._engine.infer(batch)))
+            with dispatch_span:
+                batch = (
+                    plan[0][1]
+                    if len(plan) == 1
+                    else np.concatenate([part for _, part in plan])
+                )
+                out = np.asarray(jax.device_get(self._engine.infer(batch)))
             if self._metrics is not None:
                 self._metrics.record_dispatch(
                     rows, self._engine.bucket_for(rows)
@@ -482,7 +522,7 @@ class MicroBatcher:
         worker = getattr(self, "_worker", None)
         if worker is None or not worker.is_alive():
             thread = threading.Thread(
-                target=self._worker_loop, name="microbatcher", daemon=True
+                target=self._worker_loop, name="zk-microbatcher", daemon=True
             )
             object.__setattr__(self, "_worker", thread)
             thread.start()
@@ -551,6 +591,13 @@ class MicroBatcher:
             object.__setattr__(self, "_plan_inflight", None)
             # next submit()'s _ensure_worker starts a fresh thread
             object.__setattr__(self, "_worker", None)
+            _trace.event(
+                "worker_crash",
+                attrs={
+                    "error": type(error).__name__,
+                    "failed_requests": len(inflight) + len(pending),
+                },
+            )
             if self._metrics is not None:
                 self._metrics.record_worker_restart()
             wrapped = WorkerCrashedError(

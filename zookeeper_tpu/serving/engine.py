@@ -55,6 +55,7 @@ from typing import Any, Callable, Optional, Sequence, Tuple
 import numpy as np
 
 from zookeeper_tpu.core import Field, component
+from zookeeper_tpu.observability import trace as _trace
 
 logger = logging.getLogger(__name__)
 
@@ -192,10 +193,11 @@ class InferenceEngine:
                 "shapes; a differently-sized checkpoint needs a fresh "
                 "bind()."
             )
-        placed = self._place_variables(new)
-        # Atomic w.r.t. dispatches: infer() snapshots this reference
-        # once per call.
-        object.__setattr__(self, "_variables", placed)
+        with _trace.span("weight_swap"):
+            placed = self._place_variables(new)
+            # Atomic w.r.t. dispatches: infer() snapshots this reference
+            # once per call.
+            object.__setattr__(self, "_variables", placed)
 
     def watch_checkpoints(
         self,
@@ -400,7 +402,15 @@ class InferenceEngine:
             x = np.pad(x, pad)  # zero padding: row-independent forward
         x = x.astype(self._dtype, copy=False)
         compiled, out_tracks_seq = self._compiled(bucket, seq_bucket, x.dtype)
-        out = compiled(variables, x)[:n]
+        with _trace.span(
+            "engine_infer",
+            attrs=(
+                {"rows": int(n), "bucket": bucket}
+                if _trace.enabled()
+                else None
+            ),
+        ):
+            out = compiled(variables, x)[:n]
         if out_tracks_seq and orig_seq != seq_bucket:
             out = out[:, :orig_seq]
         return out
@@ -557,6 +567,11 @@ class CheckpointWatcher:
         swap_ms = (time.perf_counter() - t0) * 1e3
         self._current_step = newest
         self._swaps += 1
+        _trace.event(
+            "ckpt_hot_swap",
+            step=newest,
+            attrs={"swap_ms": round(swap_ms, 3)},
+        )
         if self._metrics is not None:
             self._metrics.record_weight_swap(swap_ms, newest)
         logger.info(

@@ -7,6 +7,22 @@ an aggregated snapshot through the existing
 :class:`~zookeeper_tpu.training.metrics.MetricsWriter` family, so one
 sink config observes both halves of the system.
 
+Since the observability layer landed (docs/DESIGN.md §13), the
+aggregator is implemented ON TOP of the typed registry
+(``observability.registry``): every lifetime total is a
+:class:`~zookeeper_tpu.observability.registry.Counter` (or Gauge for
+``serving_weights_step``), every sampled series additionally feeds a
+fixed-bucket Histogram, and the whole instrument set renders live at
+``/metrics`` in Prometheus text via ``ServingConfig.metrics_port``.
+The PUBLIC API is bit-compatible with the pre-registry class: the
+``record_*`` recorders, ``totals``, ``snapshot()`` (exact
+``np.percentile`` over the bounded sample window — histograms are for
+scraping, not for the snapshot numbers), ``emit()`` and ``reset()``
+behave identically; recording is additionally thread-safe (registry
+instruments are locked, window appends are GIL-atomic deque ops) since
+the async batcher worker, watcher daemon, and submitter threads all
+record concurrently.
+
 The tracked quantities are the levers of the serving cost model
 (docs/DESIGN.md §8):
 
@@ -20,136 +36,228 @@ The tracked quantities are the levers of the serving cost model
   away to shape quantization (more buckets shrink it).
 """
 
+import threading
 from collections import deque
 from typing import Dict, Mapping, Optional
 
 import numpy as np
 
 from zookeeper_tpu.core import Field, component
+from zookeeper_tpu.observability.registry import (
+    DEFAULT_MS_BUCKETS,
+    DEFAULT_RATIO_BUCKETS,
+    MetricsRegistry,
+)
+
+#: Exposition name prefix: every instrument this component registers
+#: renders as ``zk_serving_<name>`` at ``/metrics``.
+_PREFIX = "zk_serving_"
+
+#: Guards first-touch creation of an instance's instrument set: two
+#: threads racing the first record_* must share ONE registry (a dropped
+#: half-initialized one would silently eat its thread's samples).
+_INIT_LOCK = threading.Lock()
+
+#: Lifetime counters, in the order ``totals`` has always reported them.
+_COUNTER_NAMES = (
+    "requests",
+    "rows",
+    "dispatches",
+    # Resilience counters (docs/DESIGN.md §10): shed submits,
+    # deadline-failed requests, and worker crash/restart cycles. The
+    # shed RATE is rejected/(rejected+requests).
+    "rejected",
+    "deadline_expired",
+    "worker_restarts",
+    # Checkpoint→serving streaming (docs/DESIGN.md §12).
+    "weight_swaps",
+    # Nonzero = the watcher daemon died on a fatal error and
+    # serving_weights_step is FROZEN, not live-following (alert on
+    # this, not on staleness).
+    "watcher_stopped",
+)
 
 
 @component
 class ServingMetrics:
     """Bounded-window aggregator for serving samples.
 
-    All recorders are O(1) appends into fixed-size deques (a serving
-    process runs indefinitely; unbounded sample lists would be a slow
-    leak). ``snapshot()`` reduces the current window; counters
-    (``requests``/``rows``/``dispatches``) are lifetime totals.
+    All recorders are O(1): a locked counter bump and/or an append into
+    a fixed-size deque plus a histogram observe (a serving process runs
+    indefinitely; unbounded sample lists would be a slow leak).
+    ``snapshot()`` reduces the current window; counters
+    (``requests``/``rows``/``dispatches``/...) are lifetime totals.
     """
 
     #: Samples retained per series (latency/fill/depth). Percentiles are
     #: computed over this sliding window.
     window: int = Field(4096)
 
+    # -- lazy state ------------------------------------------------------
+
+    def _obs(self) -> dict:
+        obs = getattr(self, "_obs_state", None)
+        if obs is None:
+            with _INIT_LOCK:
+                obs = getattr(self, "_obs_state", None)
+                if obs is not None:
+                    return obs
+                obs = self._build_obs()
+                object.__setattr__(self, "_obs_state", obs)
+        return obs
+
+    def _build_obs(self) -> dict:
+        registry = MetricsRegistry()
+        return {
+            "registry": registry,
+            "counters": {
+                name: registry.counter(
+                    _PREFIX + name, help=f"lifetime {name} total"
+                )
+                for name in _COUNTER_NAMES
+            },
+            # WHICH training step is live — the dashboard gauge that
+            # says how stale the served model is relative to the
+            # training run (-1 = the bind()-time weights, never
+            # swapped).
+            "weights_step": registry.gauge(
+                _PREFIX + "serving_weights_step",
+                help="training step whose weights are live (-1 = "
+                "bind-time weights)",
+                initial=-1,
+            ),
+            "queue_depth": registry.gauge(
+                _PREFIX + "queue_depth",
+                help="pending rows at the last submit",
+            ),
+            "hist": {
+                "latency_ms": registry.histogram(
+                    _PREFIX + "latency_ms",
+                    buckets=DEFAULT_MS_BUCKETS,
+                    help="per-request submit-to-result wall time",
+                ),
+                "bucket_fill": registry.histogram(
+                    _PREFIX + "bucket_fill",
+                    buckets=DEFAULT_RATIO_BUCKETS,
+                    help="real rows / bucket rows per dispatch",
+                ),
+                "padding_waste": registry.histogram(
+                    _PREFIX + "padding_waste",
+                    buckets=DEFAULT_RATIO_BUCKETS,
+                    help="padded rows / bucket rows per dispatch",
+                ),
+                "weight_swap_ms": registry.histogram(
+                    _PREFIX + "weight_swap_ms",
+                    buckets=DEFAULT_MS_BUCKETS,
+                    help="checkpoint hot-swap load+place+swap time",
+                ),
+            },
+            "windows": {},
+        }
+
+    @property
+    def registry(self) -> MetricsRegistry:
+        """The typed instrument registry backing this aggregator —
+        attach it to an ``ObservabilityServer`` to scrape every series
+        live (``ServingConfig.metrics_port`` does)."""
+        return self._obs()["registry"]
+
     def _series(self, name: str) -> deque:
-        store = getattr(self, "_store", None)
-        if store is None:
-            store = {}
-            object.__setattr__(self, "_store", store)
-            object.__setattr__(
-                self,
-                "_totals",
-                {
-                    "requests": 0,
-                    "rows": 0,
-                    "dispatches": 0,
-                    # Resilience counters (docs/DESIGN.md §10): shed
-                    # submits, deadline-failed requests, and worker
-                    # crash/restart cycles. Lifetime totals like the
-                    # rest; the shed RATE is rejected/(rejected+requests).
-                    "rejected": 0,
-                    "deadline_expired": 0,
-                    "worker_restarts": 0,
-                    # Checkpoint→serving streaming (docs/DESIGN.md §12):
-                    # hot-swap count and WHICH training step is live —
-                    # the dashboard gauge that says how stale the served
-                    # model is relative to the training run (-1 = the
-                    # bind()-time weights, never swapped).
-                    "weight_swaps": 0,
-                    "serving_weights_step": -1,
-                    # Nonzero = the watcher daemon died on a fatal
-                    # error and serving_weights_step is FROZEN, not
-                    # live-following (alert on this, not on staleness).
-                    "watcher_stopped": 0,
-                },
+        windows = self._obs()["windows"]
+        series = windows.get(name)
+        if series is None:
+            # setdefault: two threads racing the first sample of a
+            # series must share ONE deque, not drop one of them.
+            series = windows.setdefault(
+                name, deque(maxlen=max(1, int(self.window)))
             )
-        if name not in store:
-            store[name] = deque(maxlen=max(1, int(self.window)))
-        return store[name]
+        return series
+
+    def _observe(self, name: str, value: float) -> None:
+        """One sample: window append (exact percentile source) + fixed-
+        bucket histogram observe (live scrape source)."""
+        self._series(name).append(float(value))
+        hist = self._obs()["hist"].get(name)
+        if hist is not None:
+            hist.observe(value)
 
     # -- recorders (called by MicroBatcher / ServingConfig) --------------
 
     def record_request(self, latency_ms: float, rows: int) -> None:
-        self._series("latency_ms").append(float(latency_ms))
-        self._totals["requests"] += 1
-        self._totals["rows"] += int(rows)
+        obs = self._obs()
+        self._observe("latency_ms", latency_ms)
+        obs["counters"]["requests"].inc()
+        obs["counters"]["rows"].inc(int(rows))
 
     def record_queue_depth(self, rows: int) -> None:
         self._series("queue_depth").append(float(rows))
+        self._obs()["queue_depth"].set(rows)
 
     def record_rejected(self) -> None:
         """A submit was shed (``RejectedError``) instead of enqueued."""
-        self._series("latency_ms")  # ensure initialized
-        self._totals["rejected"] += 1
+        self._obs()["counters"]["rejected"].inc()
 
     def record_deadline_expired(self) -> None:
         """A request's deadline elapsed before it was served."""
-        self._series("latency_ms")
-        self._totals["deadline_expired"] += 1
+        self._obs()["counters"]["deadline_expired"].inc()
 
     def record_worker_restart(self) -> None:
         """The async batcher worker died and was scheduled for restart
         (its queued/in-flight requests were failed cleanly)."""
-        self._series("latency_ms")
-        self._totals["worker_restarts"] += 1
+        self._obs()["counters"]["worker_restarts"].inc()
 
     def record_weight_swap(self, swap_ms: float, step: int) -> None:
         """A checkpoint hot-swap landed: ``step``'s weights are now
         live (``CheckpointWatcher``/``swap_weights``); ``swap_ms`` is
         load+place+swap wall time."""
-        self._series("weight_swap_ms").append(float(swap_ms))
-        self._totals["weight_swaps"] += 1
-        self._totals["serving_weights_step"] = int(step)
+        obs = self._obs()
+        self._observe("weight_swap_ms", swap_ms)
+        obs["counters"]["weight_swaps"].inc()
+        obs["weights_step"].set(int(step))
 
     def record_watcher_stopped(self) -> None:
         """The checkpoint watcher's daemon died on a fatal error:
         ``serving_weights_step`` is frozen from here on."""
-        self._series("latency_ms")
-        self._totals["watcher_stopped"] += 1
+        self._obs()["counters"]["watcher_stopped"].inc()
 
     def record_weights_step(self, step: int) -> None:
         """Set the live-weights gauge WITHOUT counting a swap — the
         bind-time weights of a service that loaded ``step`` at startup
         (``CheckpointWatcher(initial_step=...)``)."""
-        self._series("latency_ms")
-        self._totals["serving_weights_step"] = int(step)
+        self._obs()["weights_step"].set(int(step))
 
     def record_dispatch(self, real_rows: int, bucket_rows: int) -> None:
         if bucket_rows <= 0:
             return
-        self._series("bucket_fill").append(real_rows / bucket_rows)
-        self._series("padding_waste").append(
-            (bucket_rows - real_rows) / bucket_rows
+        self._observe("bucket_fill", real_rows / bucket_rows)
+        self._observe(
+            "padding_waste", (bucket_rows - real_rows) / bucket_rows
         )
-        self._totals["dispatches"] += 1
+        self._obs()["counters"]["dispatches"].inc()
 
     # -- reduction -------------------------------------------------------
 
     @property
     def totals(self) -> Dict[str, int]:
-        self._series("latency_ms")  # ensure initialized
-        return dict(self._totals)
+        obs = self._obs()
+        out: Dict[str, int] = {}
+        for name in _COUNTER_NAMES:
+            out[name] = int(obs["counters"][name].value)
+            if name == "weight_swaps":
+                # Historical key order: the gauge sits between the swap
+                # counter and watcher_stopped.
+                out["serving_weights_step"] = int(obs["weights_step"].value)
+        return out
 
     def snapshot(self) -> Dict[str, float]:
         """Aggregate the current window into a flat ``{name: float}``
         mapping (absent series are simply omitted, so an idle service
         emits only its counters)."""
-        self._series("latency_ms")
+        windows = self._obs()["windows"]
         out: Dict[str, float] = {
-            k: float(v) for k, v in self._totals.items()
+            k: float(v) for k, v in self.totals.items()
         }
-        lat = self._store.get("latency_ms")
+        lat = windows.get("latency_ms")
         if lat:
             arr = np.asarray(lat)
             out["latency_p50_ms"] = float(np.percentile(arr, 50))
@@ -159,7 +267,7 @@ class ServingMetrics:
         for name in (
             "queue_depth", "bucket_fill", "padding_waste", "weight_swap_ms",
         ):
-            series = self._store.get(name)
+            series = windows.get(name)
             if series:
                 out[f"{name}_mean"] = float(np.mean(series))
         return out
@@ -179,4 +287,19 @@ class ServingMetrics:
         return snap
 
     def reset(self) -> None:
-        object.__setattr__(self, "_store", None)
+        """Zero every series IN PLACE. The registry and instrument
+        objects survive (an ``ObservabilityServer`` that captured
+        ``self.registry`` at startup keeps rendering this aggregator —
+        a scraper just sees an ordinary counter reset); dropping
+        ``_obs_state`` instead would silently disconnect ``/metrics``
+        from all future samples."""
+        obs = getattr(self, "_obs_state", None)
+        if obs is None:
+            return
+        for counter in obs["counters"].values():
+            counter.reset()
+        obs["weights_step"].reset()
+        obs["queue_depth"].reset()
+        for hist in obs["hist"].values():
+            hist.reset()
+        obs["windows"].clear()

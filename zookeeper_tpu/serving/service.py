@@ -86,6 +86,13 @@ class ServingConfig(Experiment):
     requests: int = Field(64)
     max_request: int = Field(8)
     verbose: bool = Field(True)
+    #: Live observability endpoint (docs/DESIGN.md §13): port for a
+    #: stdlib HTTP server exposing every ``ServingMetrics`` series at
+    #: ``/metrics`` (Prometheus text exposition) plus ``/statusz``
+    #: (engine compile counts, batcher queue, live-weights step) and
+    #: ``/trace``. -1 = off (default); 0 = ephemeral port (readable via
+    #: ``self.obs_server.port`` — the CI scrape smoke uses this).
+    metrics_port: int = Field(-1)
 
     @property
     def input_shape(self):
@@ -169,7 +176,54 @@ class ServingConfig(Experiment):
                     initial_step=watch_baseline,
                 ),
             )
+        if self.metrics_port >= 0:
+            try:
+                self._start_obs_server()
+            except BaseException:
+                # The service half-exists (watcher daemon polling,
+                # batcher bound) and run()'s cleanup paths only cover
+                # what build_service RETURNED — a bind failure (busy
+                # port) must not leak live threads into a caller that
+                # catches the error.
+                self._teardown_service(suppress=True)
+                raise
         return self.engine, self.batcher
+
+    def _obs_status(self):
+        """``/statusz`` section: the serving-process vitals an operator
+        (or health probe) checks before trusting the metrics."""
+        watcher = getattr(self, "watcher", None)
+        return {
+            "model": type(self.model).__name__,
+            "weights": self.weights,
+            "batch_buckets": [int(b) for b in self.engine.batch_buckets],
+            "compiles": self.engine.compile_count,
+            "queue_rows": self.batcher.queue_rows,
+            "watcher_alive": (
+                watcher.alive if watcher is not None else None
+            ),
+            "serving_weights_step": self.metrics.totals[
+                "serving_weights_step"
+            ],
+        }
+
+    def _start_obs_server(self):
+        from zookeeper_tpu.observability import ObservabilityServer
+        from zookeeper_tpu.observability.registry import default_registry
+
+        server = ObservabilityServer(
+            [default_registry(), self.metrics.registry],
+            port=self.metrics_port,
+            status_providers={"serving": self._obs_status},
+        )
+        server.start()
+        object.__setattr__(self, "obs_server", server)
+        if self.verbose:
+            print(
+                f"observability endpoint: {server.url}/metrics",
+                flush=True,
+            )
+        return server
 
     def finish_report(
         self,
@@ -205,11 +259,37 @@ class ServingConfig(Experiment):
         }
         if self.verbose:
             print(json.dumps(result), flush=True)
-        watcher = getattr(self, "watcher", None)
-        if watcher is not None:
-            watcher.stop()
-        self.batcher.close()
+        self._teardown_service()
         return result
+
+    def _teardown_obs_server(self):
+        """Idempotent endpoint teardown — the server holds an OS port
+        (unlike the daemon threads), so EVERY exit path must release it
+        or a same-port rebuild in this process dies with EADDRINUSE."""
+        server = getattr(self, "obs_server", None)
+        if server is not None:
+            object.__setattr__(self, "obs_server", None)
+            server.stop()
+
+    def _teardown_service(self, *, suppress: bool = False) -> None:
+        """The ONE teardown sequence (watcher daemon, /metrics port,
+        batcher worker) shared by every exit path. Each step runs even
+        when an earlier one raises; the first failure is re-raised at
+        the end unless ``suppress`` (error paths, where a cleanup
+        failure must not mask the original exception)."""
+        first: Optional[BaseException] = None
+        watcher = getattr(self, "watcher", None)
+        steps = [self._teardown_obs_server, self.batcher.close]
+        if watcher is not None:
+            steps.insert(0, watcher.stop)
+        for step in steps:
+            try:
+                step()
+            except BaseException as e:
+                if first is None:
+                    first = e
+        if first is not None and not suppress:
+            raise first
 
     def run(self) -> Dict[str, Any]:
         """Serve a deterministic synthetic request stream and report."""
@@ -218,23 +298,29 @@ class ServingConfig(Experiment):
         if self.verbose:
             print(pretty_print(self), flush=True)
         engine, batcher = self.build_service()
-        warm_compiles = engine.compile_count
-        rng = np.random.default_rng(self.seed)
-        t0 = time.perf_counter()
-        pending = []
-        rows = 0
-        for _ in range(self.requests):
-            n = int(rng.integers(1, self.max_request + 1))
-            x = rng.normal(size=(n, *self.input_shape)).astype(
-                self.model.dtype()
-            )
-            pending.append((n, batcher.submit(x)))
-            rows += n
-        batcher.flush()
-        dt = time.perf_counter() - t0
-        for n, handle in pending:
-            out = handle.result()
-            assert out.shape[0] == n, (out.shape, n)
+        try:
+            warm_compiles = engine.compile_count
+            rng = np.random.default_rng(self.seed)
+            t0 = time.perf_counter()
+            pending = []
+            rows = 0
+            for _ in range(self.requests):
+                n = int(rng.integers(1, self.max_request + 1))
+                x = rng.normal(size=(n, *self.input_shape)).astype(
+                    self.model.dtype()
+                )
+                pending.append((n, batcher.submit(x)))
+                rows += n
+            batcher.flush()
+            dt = time.perf_counter() - t0
+            for n, handle in pending:
+                out = handle.result()
+                assert out.shape[0] == n, (out.shape, n)
+        except BaseException:
+            # finish_report (the happy-path teardown) won't run: release
+            # the endpoint's port and the watcher/worker threads here.
+            self._teardown_service(suppress=True)
+            raise
         return self.finish_report(
             warm_compiles=warm_compiles,
             n_requests=self.requests,

@@ -46,6 +46,9 @@ import threading
 import time
 from typing import Any, Dict, Optional
 
+from zookeeper_tpu.observability import trace as _trace
+from zookeeper_tpu.observability.registry import default_registry
+
 logger = logging.getLogger(__name__)
 
 
@@ -80,10 +83,24 @@ class AsyncCheckpointWriter:
             "killed": 0,
             "last_write_ms": 0.0,
         }
+        # Process-global gauge (one writer per process in practice):
+        # queued (0/1, the depth-1 slot) + in-flight write (0/1) — the
+        # "is the writer keeping up with the save cadence" scrape.
+        self._queue_gauge = default_registry().gauge(
+            "zk_ckpt_queue_depth",
+            help="async checkpoint snapshots queued + being written",
+        )
         self._thread = threading.Thread(
             target=self._loop, name="zk-async-ckpt", daemon=True
         )
         self._thread.start()
+
+    def _update_queue_gauge(self) -> None:
+        """Caller holds ``_cv``."""
+        self._queue_gauge.set(
+            (1 if self._pending is not None else 0)
+            + (1 if self._writing_step is not None else 0)
+        )
 
     # -- training-thread API ---------------------------------------------
 
@@ -101,6 +118,9 @@ class AsyncCheckpointWriter:
             if self._pending is not None:
                 if self._policy == "supersede":
                     self.stats["superseded"] += 1
+                    _trace.event(
+                        "ckpt_superseded", step=self._pending[0]
+                    )
                     logger.info(
                         "async checkpoint of step %d superseded by step %d "
                         "before its write began",
@@ -119,6 +139,8 @@ class AsyncCheckpointWriter:
                     if self._stopping:
                         return False
             self._pending = (int(step), host_tree, metrics)
+            self._update_queue_gauge()
+            _trace.event("ckpt_queued", step=step)
             self._cv.notify_all()
         return True
 
@@ -139,7 +161,9 @@ class AsyncCheckpointWriter:
         with self._cv:
             if supersede and self._pending is not None:
                 self.stats["superseded"] += 1
+                _trace.event("ckpt_superseded", step=self._pending[0])
                 self._pending = None
+                self._update_queue_gauge()
                 self._cv.notify_all()
             while self._pending is not None or self._writing_step is not None:
                 if not self._thread.is_alive():
@@ -170,6 +194,7 @@ class AsyncCheckpointWriter:
                 step, host_tree, metrics = self._pending
                 self._pending = None
                 self._writing_step = step
+                self._update_queue_gauge()
                 self._cv.notify_all()
             t0 = time.perf_counter()
             try:
@@ -181,30 +206,37 @@ class AsyncCheckpointWriter:
                     # must land on the previous finalized step.
                     self._ckpt._leave_unfinalized_remnant(step)
                     self.stats["killed"] += 1
+                    _trace.event("ckpt_killed", step=step)
                     logger.warning(
                         "async write of step %d killed mid-write "
                         "(injected): unfinalized remnant left on disk; "
                         "restore walks back to the previous finalized step",
                         step,
                     )
-                elif self._ckpt._run_with_save_retries(
-                    step,
-                    lambda: self._ckpt._attempt_async_write(
-                        step, host_tree, metrics
-                    ),
-                ):
-                    self.stats["finalized"] += 1
-                    self.stats["last_write_ms"] = (
-                        time.perf_counter() - t0
-                    ) * 1e3
                 else:
-                    self.stats["dropped"] += 1
+                    with _trace.span("ckpt_write", step=step):
+                        finalized = self._ckpt._run_with_save_retries(
+                            step,
+                            lambda: self._ckpt._attempt_async_write(
+                                step, host_tree, metrics
+                            ),
+                        )
+                    if finalized:
+                        self.stats["finalized"] += 1
+                        self.stats["last_write_ms"] = (
+                            time.perf_counter() - t0
+                        ) * 1e3
+                        _trace.event("ckpt_finalized", step=step)
+                    else:
+                        self.stats["dropped"] += 1
+                        _trace.event("ckpt_dropped", step=step)
             except BaseException as e:
                 # Belt to the retry loop's suspenders: NOTHING the writer
                 # hits may propagate toward the training thread; a write
                 # that failed outside the retried section is a dropped
                 # save, loudly logged.
                 self.stats["dropped"] += 1
+                _trace.event("ckpt_dropped", step=step)
                 logger.error(
                     "async checkpoint write of step %d failed outside the "
                     "retry loop; dropping this save",
@@ -214,4 +246,5 @@ class AsyncCheckpointWriter:
             finally:
                 with self._cv:
                     self._writing_step = None
+                    self._update_queue_gauge()
                     self._cv.notify_all()

@@ -38,6 +38,7 @@ import time
 from typing import Any, List, Optional, Tuple
 
 from zookeeper_tpu.core import Field, component
+from zookeeper_tpu.observability import trace as _trace
 
 logger = logging.getLogger(__name__)
 
@@ -436,12 +437,16 @@ class Checkpointer:
 
             # Training-thread cost ends here: a donation-safe host copy,
             # then hand off. Serialize+write overlap the next slab.
-            tree = host_snapshot(_state_pytree(state))
+            with _trace.span("ckpt_snapshot", step=step):
+                tree = host_snapshot(_state_pytree(state))
             return self._writer().submit(step, tree, metrics)
-        return self._run_with_save_retries(
-            step,
-            lambda: self._write_state(_state_pytree(state), step, metrics),
-        )
+        with _trace.span("ckpt_sync_save", step=step):
+            return self._run_with_save_retries(
+                step,
+                lambda: self._write_state(
+                    _state_pytree(state), step, metrics
+                ),
+            )
 
     def drain_async(self, supersede: bool = False) -> float:
         """Wait out any queued/in-flight async write; returns ms spent
@@ -539,6 +544,11 @@ class Checkpointer:
                 else os.path.abspath(os.path.expanduser(self.directory))
             )
             if not self._step_finalized(step, root):
+                _trace.event(
+                    "restore_skip",
+                    step=step,
+                    attrs={"tier": tier, "reason": "unfinalized"},
+                )
                 logger.warning(
                     "%s checkpoint step %d is not finalized (crash "
                     "mid-save?); falling back to an earlier step",
@@ -547,9 +557,15 @@ class Checkpointer:
                 )
                 continue
             try:
-                restored = self._restore_step(step, state, tier)
+                with _trace.span("restore_step", step=step):
+                    restored = self._restore_step(step, state, tier)
             except Exception as e:
                 last_err = e
+                _trace.event(
+                    "restore_skip",
+                    step=step,
+                    attrs={"tier": tier, "reason": "unreadable"},
+                )
                 logger.warning(
                     "%s checkpoint step %d failed to restore (%s); "
                     "falling back to an earlier retained step",
@@ -568,6 +584,9 @@ class Checkpointer:
                     entries[0][0],
                     step,
                 )
+            _trace.event(
+                "restore_done", step=step, attrs={"tier": tier}
+            )
             return self._assemble_restored(state, restored)
         raise ValueError(
             f"None of the {len(entries)} retained checkpoint step(s) "

@@ -20,6 +20,8 @@ from typing import Any, Dict, List, Optional
 from zookeeper_tpu.core import ComponentField, Field, component, pretty_print
 from zookeeper_tpu.data.pipeline import DataLoader
 from zookeeper_tpu.models.base import Model
+from zookeeper_tpu.observability import trace as _obs_trace
+from zookeeper_tpu.observability.registry import MetricsRegistry
 from zookeeper_tpu.parallel.distributed import DistributedRuntime
 from zookeeper_tpu.parallel.partitioner import Partitioner, SingleDevicePartitioner
 from zookeeper_tpu.resilience import faults as _faults
@@ -42,6 +44,22 @@ class Experiment:
 
     def run(self) -> Any:
         raise NotImplementedError("Experiment subclasses must implement run().")
+
+
+def _data_wait_iter(iterable, name="data_wait"):
+    """Wrap a batch/slab iterator so each ``next()`` is a ``data_wait``
+    host span: the time the training thread spent BLOCKED on the input
+    pipeline (prefetch queue empty = data-bound loop; near-zero spans =
+    compute-bound). One flag check + a generator hop per slab when
+    tracing is off."""
+    it = iter(iterable)
+    while True:
+        with _obs_trace.span(name):
+            try:
+                item = next(it)
+            except StopIteration:
+                return
+        yield item
 
 
 def run_weighted_eval(loader, split, eval_step, state, sharding, epoch=0):
@@ -134,6 +152,19 @@ class TrainingExperiment(Experiment):
     metrics_file: Optional[str] = Field(None)
     #: Capture a jax.profiler trace of a few steady-state steps when set.
     profile_dir: Optional[str] = Field(None)
+    #: Host-side span tracing (docs/DESIGN.md §13): when set, the run
+    #: records data_wait/dispatch/readback/checkpoint spans (plus every
+    #: background subsystem's spans/events) and writes Chrome
+    #: trace-event JSON here at teardown — open it in Perfetto next to
+    #: the ``profile_dir`` device trace. None = tracing stays disabled
+    #: (zero-cost: one flag check per would-be span).
+    trace_export: Optional[str] = Field(None)
+    #: Live observability endpoint: port for a stdlib HTTP server
+    #: serving ``/metrics`` (Prometheus text), ``/statusz`` (JSON
+    #: status) and ``/trace`` while the run is alive. -1 = off
+    #: (default); 0 = bind an ephemeral port (logged, and readable via
+    #: ``self.obs_server.port``).
+    metrics_port: int = Field(-1)
     #: Report the per-step sign-flip fraction of binary kernels
     #: (larq FlipRatio capability) in the train metrics.
     track_flip_ratio: bool = Field(False)
@@ -224,6 +255,124 @@ class TrainingExperiment(Experiment):
             logging.getLogger(__name__).debug(
                 "trace breakdown unavailable: %s", e
             )
+
+    # -- observability (docs/DESIGN.md §13) ------------------------------
+
+    @property
+    def obs_registry(self) -> MetricsRegistry:
+        """This experiment's typed instrument registry (derived rates
+        published per epoch); rendered at ``/metrics`` when
+        ``metrics_port`` is set."""
+        reg = getattr(self, "_obs_registry", None)
+        if reg is None:
+            reg = MetricsRegistry()
+            self._obs_registry = reg
+        return reg
+
+    def _publish_epoch_observability(
+        self, epoch, steps_trained, epoch_metrics, vmetrics
+    ) -> None:
+        """Mirror the epoch's derived rates into typed instruments so a
+        live scrape sees them without waiting for the writer sinks.
+        Rides the epoch boundary — zero cost on the step path. Never
+        raises: a pathological metric NAME (one colliding with a
+        differently-typed instrument) loses its mirror with a log line,
+        not the training run — observability is strictly an observer
+        here."""
+        import logging
+
+        reg = self.obs_registry
+        try:
+            # _total suffix keeps the counter clear of the zk_train_<k>
+            # gauge namespace (an epoch metric literally named
+            # "steps_total" would still collide; the except covers it).
+            reg.counter(
+                "zk_train_steps_total",
+                help="train steps completed this run",
+            ).inc(steps_trained)
+            reg.gauge("zk_train_epoch", help="last completed epoch").set(
+                epoch + 1
+            )
+            for k, v in epoch_metrics.items():
+                reg.gauge(f"zk_train_{k}").set(v)
+            for k, v in (vmetrics or {}).items():
+                reg.gauge(f"zk_val_{k}").set(v)
+        except Exception as e:
+            logging.getLogger(__name__).warning(
+                "epoch observability mirror skipped: %s", e
+            )
+
+    def _obs_status(self) -> Dict[str, Any]:
+        """The ``/statusz`` section for this run."""
+        return {
+            "model": type(self.model).__name__,
+            "epochs": int(self.epochs),
+            "batch_size": int(self.batch_size),
+            "unroll": int(self.unroll),
+        }
+
+    def _setup_observability(self) -> None:
+        if self.trace_export:
+            # Remember whether WE turned tracing on: an externally-
+            # enabled tracer (nested runs, tests) must survive teardown.
+            self._trace_enabled_here = not _obs_trace.enabled()
+            _obs_trace.enable()
+        if self.metrics_port >= 0:
+            from zookeeper_tpu.observability import ObservabilityServer
+            from zookeeper_tpu.observability.registry import default_registry
+
+            server = ObservabilityServer(
+                [default_registry(), self.obs_registry],
+                port=self.metrics_port,
+                status_providers={"training": self._obs_status},
+            )
+            server.start()
+            self.obs_server = server
+            self._log(f"observability endpoint: {server.url}/metrics")
+
+    def _finish_host_trace(self) -> None:
+        """Teardown: write the Chrome trace-event JSON and restore the
+        pre-run tracing state."""
+        if self.trace_export and _obs_trace.enabled():
+            n = _obs_trace.export_chrome_trace(self.trace_export)
+            self._log(
+                f"host trace: {n} events -> {self.trace_export} "
+                "(open in Perfetto)"
+            )
+            if getattr(self, "_trace_enabled_here", False):
+                _obs_trace.disable()
+
+    def _stop_obs_server(self) -> None:
+        server = getattr(self, "obs_server", None)
+        if server is not None:
+            self.obs_server = None
+            server.stop()
+
+    # -- jax profiler window (device trace) ------------------------------
+
+    def _start_jax_trace(self) -> None:
+        import jax
+
+        jax.profiler.start_trace(self.profile_dir)
+        self._jax_trace_active = True
+
+    def _stop_jax_trace(self) -> None:
+        import jax
+
+        # Clear the flag BEFORE stopping: a stop that raises must not
+        # be retried by the teardown abort (stop_trace on a stopped
+        # profiler raises).
+        self._jax_trace_active = False
+        jax.profiler.stop_trace()
+
+    def _abort_jax_trace(self) -> None:
+        """Teardown half of the profiling-window contract: an exception
+        raised mid-capture (preemption, NaN halt, a crash) must not
+        leave ``jax.profiler.start_trace`` open — a dangling capture
+        poisons the next run's ``start_trace`` and holds the trace
+        buffers. No-op when no window is open."""
+        if getattr(self, "_jax_trace_active", False):
+            self._stop_jax_trace()
 
     def build_state(self) -> TrainState:
         """Build module + optimizer and initialize the TrainState."""
@@ -395,13 +544,15 @@ class TrainingExperiment(Experiment):
         tracing = False
         trace_first = start_b
         for slab_idx, slab in enumerate(
-            self.loader.batches(
-                "train",
-                epoch=epoch,
-                sharding=self.partitioner.slab_sharding(),
-                start_batch=start_b,
-                unroll=self.unroll,
-                max_batches=spe - start_b,
+            _data_wait_iter(
+                self.loader.batches(
+                    "train",
+                    epoch=epoch,
+                    sharding=self.partitioner.slab_sharding(),
+                    start_batch=start_b,
+                    unroll=self.unroll,
+                    max_batches=spe - start_b,
+                )
             )
         ):
             k = int(next(iter(slab.values())).shape[0])
@@ -414,22 +565,28 @@ class TrainingExperiment(Experiment):
             if profiling and not tracing and (
                 step_idx >= p_start or step_idx + k >= spe
             ):
-                jax.profiler.start_trace(self.profile_dir)
+                self._start_jax_trace()
                 tracing, trace_first = True, step_idx
-            with slab_annotation(slab_idx, num_steps=k):
+            with slab_annotation(slab_idx, num_steps=k), _obs_trace.span(
+                "dispatch", step=epoch * spe + step_idx, slab=slab_idx
+            ):
                 state, metrics = multi_step(state, slab)
             accum.append(metrics)
             self._mark_first_step(metrics)
             if tracing and step_idx + k > p_stop:
                 jax.block_until_ready(metrics["loss"])
-                jax.profiler.stop_trace()
+                self._stop_jax_trace()
                 profiling = tracing = False
                 self._log_profile_breakdown(step_idx + k - trace_first)
             if any(
                 self._step_save_due(epoch, s, spe)
                 for s in range(step_idx, step_idx + k)
             ):
-                self.checkpointer.save(state)
+                with _obs_trace.span(
+                    "checkpoint", step=epoch * spe + step_idx + k,
+                    slab=slab_idx,
+                ):
+                    self.checkpointer.save(state)
             if self.log_every:
                 bounds = [
                     s
@@ -439,7 +596,11 @@ class TrainingExperiment(Experiment):
                 if bounds:
                     # ONE readback for the whole slab; per-step values
                     # are identical to what the eager loop would log.
-                    hm = jax.device_get(metrics)
+                    with _obs_trace.span(
+                        "readback", step=epoch * spe + step_idx + k,
+                        slab=slab_idx,
+                    ):
+                        hm = jax.device_get(metrics)
                     self._check_halt(hm, epoch * spe + step_idx + k)
                     for s in bounds:
                         self._log_step_scalars(
@@ -544,71 +705,77 @@ class TrainingExperiment(Experiment):
                     )
                 )
             )
-        self.runtime.initialize()  # Multi-host bootstrap; no-op single host.
-        partitioner = self.partitioner
-        partitioner.setup()
-        state = partitioner.shard_state(self.build_state())
-        state = self.checkpointer.restore_state(state)
-        if self.unroll > 1:
-            from zookeeper_tpu.training.step import build_multi_step
-
-            multi_step = partitioner.compile_multi_step(
-                build_multi_step(self._train_step_fn()), state
-            )
-            train_step = None
-        else:
-            multi_step = None
-            train_step = partitioner.compile_step(
-                self._train_step_fn(), state
-            )
-        eval_step = partitioner.compile_eval(
-            make_eval_step(
-                smoothed_softmax_cross_entropy(self.label_smoothing),
-                use_ema=self.ema_decay > 0,
-                top5=self.track_top5,
-            ),
-            state,
-        )
-        batch_sharding = partitioner.batch_sharding()
-
-        spe = self._steps_per_epoch()
-        start_step = int(jax.device_get(state.step))
-        start_epoch = start_step // max(1, spe)
-        # Steps already trained within the resumed epoch (nonzero only
-        # for step-granular checkpoints): the epoch's permutation is
-        # (seed, epoch)-fixed, so skipping the first k batches resumes
-        # EXACTLY where the crashed run left off.
-        resume_step = start_step % max(1, spe)
-        if start_step > 0:
-            self._log(
-                f"resumed from checkpoint at step {start_step} "
-                f"(epoch {start_epoch}"
-                + (f", step {resume_step} within it" if resume_step else "")
-                + ")"
-            )
-        history: Dict[str, List[Dict[str, float]]] = {"train": [], "validation": []}
-        # One presence probe, not one per epoch: dataset.validation()
-        # may construct a real source (e.g. a TFDS reader).
-        has_val_split = self.validate and (
-            self.loader.dataset.validation() is not None
-        )
-        es_best: Optional[float] = None
-        es_stale = 0
-        es_minimize = self.early_stop_mode == "min" or (
-            self.early_stop_mode == "auto"
-            and self.early_stop_metric is not None
-            and "loss" in self.early_stop_metric
-        )
-        # Per-run restore-latency probe (read by run_with_recovery).
-        self.first_step_at = None
-        # Per-run preemption-save wait probe (ms spent draining the
-        # in-flight async checkpoint write before the final sync save;
-        # 0.0 in sync mode — also read by run_with_recovery).
-        self.save_wait_ms = None
-        # From here until teardown, SIGTERM/SIGINT mean "save and exit
-        # at the next step/slab boundary", not "die mid-write".
-        self.guard.install()
         try:
+            # Opt-in observability (trace ring + /metrics endpoint) comes
+            # up BEFORE device setup so compile/restore phases are
+            # scrapeable — inside the protected region so a half-failed
+            # setup (tracer enabled, then the HTTP bind raises
+            # EADDRINUSE) is still torn down by the finally below.
+            self._setup_observability()
+            self.runtime.initialize()  # Multi-host bootstrap; no-op single host.
+            partitioner = self.partitioner
+            partitioner.setup()
+            state = partitioner.shard_state(self.build_state())
+            state = self.checkpointer.restore_state(state)
+            if self.unroll > 1:
+                from zookeeper_tpu.training.step import build_multi_step
+
+                multi_step = partitioner.compile_multi_step(
+                    build_multi_step(self._train_step_fn()), state
+                )
+                train_step = None
+            else:
+                multi_step = None
+                train_step = partitioner.compile_step(
+                    self._train_step_fn(), state
+                )
+            eval_step = partitioner.compile_eval(
+                make_eval_step(
+                    smoothed_softmax_cross_entropy(self.label_smoothing),
+                    use_ema=self.ema_decay > 0,
+                    top5=self.track_top5,
+                ),
+                state,
+            )
+            batch_sharding = partitioner.batch_sharding()
+
+            spe = self._steps_per_epoch()
+            start_step = int(jax.device_get(state.step))
+            start_epoch = start_step // max(1, spe)
+            # Steps already trained within the resumed epoch (nonzero only
+            # for step-granular checkpoints): the epoch's permutation is
+            # (seed, epoch)-fixed, so skipping the first k batches resumes
+            # EXACTLY where the crashed run left off.
+            resume_step = start_step % max(1, spe)
+            if start_step > 0:
+                self._log(
+                    f"resumed from checkpoint at step {start_step} "
+                    f"(epoch {start_epoch}"
+                    + (f", step {resume_step} within it" if resume_step else "")
+                    + ")"
+                )
+            history: Dict[str, List[Dict[str, float]]] = {"train": [], "validation": []}
+            # One presence probe, not one per epoch: dataset.validation()
+            # may construct a real source (e.g. a TFDS reader).
+            has_val_split = self.validate and (
+                self.loader.dataset.validation() is not None
+            )
+            es_best: Optional[float] = None
+            es_stale = 0
+            es_minimize = self.early_stop_mode == "min" or (
+                self.early_stop_mode == "auto"
+                and self.early_stop_metric is not None
+                and "loss" in self.early_stop_metric
+            )
+            # Per-run restore-latency probe (read by run_with_recovery).
+            self.first_step_at = None
+            # Per-run preemption-save wait probe (ms spent draining the
+            # in-flight async checkpoint write before the final sync save;
+            # 0.0 in sync mode — also read by run_with_recovery).
+            self.save_wait_ms = None
+            # From here until teardown, SIGTERM/SIGINT mean "save and exit
+            # at the next step/slab boundary", not "die mid-write".
+            self.guard.install()
             for epoch in range(start_epoch, self.epochs):
                 t0 = time.perf_counter()
                 accum: List[Any] = []
@@ -628,35 +795,47 @@ class TrainingExperiment(Experiment):
                     )
                 else:
                     for step_idx, batch in enumerate(
-                        self.loader.batches(
-                            "train",
-                            epoch=epoch,
-                            sharding=batch_sharding,
-                            start_batch=start_b,
+                        _data_wait_iter(
+                            self.loader.batches(
+                                "train",
+                                epoch=epoch,
+                                sharding=batch_sharding,
+                                start_batch=start_b,
+                            )
                         ),
                         start=start_b,
                     ):
                         if step_idx >= spe:
                             break
                         if profiling and step_idx == p_start:
-                            jax.profiler.start_trace(self.profile_dir)
-                        state, metrics = train_step(state, batch)
+                            self._start_jax_trace()
+                        with _obs_trace.span(
+                            "dispatch", step=epoch * spe + step_idx
+                        ):
+                            state, metrics = train_step(state, batch)
                         accum.append(metrics)
                         self._mark_first_step(metrics)
                         if profiling and step_idx == p_stop:
                             jax.block_until_ready(metrics["loss"])
-                            jax.profiler.stop_trace()
+                            self._stop_jax_trace()
                             profiling = False
                             # Steps p_start..p_stop run INSIDE the trace
                             # window, inclusive on both ends.
                             self._log_profile_breakdown(p_stop - p_start + 1)
                         if self._step_save_due(epoch, step_idx, spe):
-                            self.checkpointer.save(state)
+                            with _obs_trace.span(
+                                "checkpoint",
+                                step=epoch * spe + step_idx + 1,
+                            ):
+                                self.checkpointer.save(state)
                         if self.log_every and (step_idx + 1) % self.log_every == 0:
                             # Per-step scalars ride the host pull that log_every
                             # already paid for — finer than epoch granularity at
                             # zero extra device syncs.
-                            hm = jax.device_get(metrics)
+                            with _obs_trace.span(
+                                "readback", step=epoch * spe + step_idx + 1
+                            ):
+                                hm = jax.device_get(metrics)
                             self._check_halt(hm, epoch * spe + step_idx + 1)
                             self._log_step_scalars(
                                 epoch, step_idx, spe,
@@ -672,7 +851,10 @@ class TrainingExperiment(Experiment):
                 # Fused slabs land as [k]-stacked per-step arrays; eager
                 # steps as scalars — atleast_1d + concatenate makes the
                 # epoch mean a plain per-step mean in both modes.
-                host_accum = jax.device_get(accum)
+                with _obs_trace.span(
+                    "readback", step=epoch * spe + start_b + steps_trained
+                ):
+                    host_accum = jax.device_get(accum)
                 self._check_halt(
                     host_accum, epoch * spe + start_b + steps_trained
                 )
@@ -745,6 +927,9 @@ class TrainingExperiment(Experiment):
                 if vmetrics is not None:
                     scalars.update({f"val/{k}": v for k, v in vmetrics.items()})
                 self.writer.write_scalars((epoch + 1) * spe, scalars)
+                self._publish_epoch_observability(
+                    epoch, steps_trained, epoch_metrics, vmetrics
+                )
 
                 # The epoch's scored metrics: fresh validation when it
                 # ran; train metrics only when the run HAS no validation
@@ -772,7 +957,10 @@ class TrainingExperiment(Experiment):
                         # rank-saves happen on validated epochs only.
                         pass
                     else:
-                        self.checkpointer.save(state, metrics=scored)
+                        with _obs_trace.span(
+                            "checkpoint", step=(epoch + 1) * spe
+                        ):
+                            self.checkpointer.save(state, metrics=scored)
 
                 if self.early_stop_metric is not None and scored is not None:
                     if self.early_stop_metric not in scored:
@@ -816,8 +1004,15 @@ class TrainingExperiment(Experiment):
             pending = sys.exc_info()[1]
             teardown_err: Optional[BaseException] = None
             for what, fn in (
+                # First: close any open jax.profiler capture window — an
+                # exception mid-capture must not leave start_trace open
+                # (the next run's start_trace would fail and the trace
+                # buffers leak).
+                ("profiler.stop_trace", self._abort_jax_trace),
                 ("checkpointer.wait", self.checkpointer.wait),
                 ("writer.flush", self.writer.flush),
+                ("trace.export", self._finish_host_trace),
+                ("obs_server.stop", self._stop_obs_server),
             ):
                 try:
                     fn()
