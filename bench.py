@@ -73,165 +73,30 @@ def bench_metadata(device_kind=None):
     return meta
 
 
-# Fallback bf16 peak when on-chip measurement is unavailable: measured on
-# this machine's v5e chip (BASELINE.md round-2 re-measurement: on-device
-# fori_loop, full-sum dependency, 4096^3 bf16 matmul -> 184 TFLOP/s, 93%
-# of the v5e datasheet 197). Round 1's 79 TFLOP/s was a dispatch-bound
-# under-measurement.
-BF16_PEAK_FALLBACK = 184e12
+# The peak-anchor machinery (datasheet tables, the measured-peak
+# agreement gate, the datasheet clamp) moved to
+# ``zookeeper_tpu.observability.peaks`` so the LIVE MFU gauges
+# (``zk_train_mfu``/``zk_serve_mfu``, docs/DESIGN.md §14) and this
+# bench divide by the same anchors; re-exported here unchanged (sweep
+# scripts and tests import them as ``bench.*``).
+from zookeeper_tpu.observability.peaks import (  # noqa: E402,F401
+    ACHIEVABLE_FRACTION,
+    BF16_PEAK_FALLBACK,
+    DATASHEET_HEADROOM,
+    INT8_FACTOR_UPPER_BOUND,
+    INT8_PEAK_FALLBACK,
+    TPU_DATASHEET_BF16_TFLOPS,
+    TPU_INT8_FACTOR,
+    V5E_KEYS as _V5E_KEYS,
+    aggregate_peak_attempts,
+    check_peak_against_datasheet,
+    datasheet_bf16_peak,
+    datasheet_match as _datasheet_match,
+)
 
-# Public datasheet bf16 peaks (TFLOP/s per chip) keyed by substrings of
-# jax's ``device_kind`` string. A MEASURED peak above ~1.05x the matching
-# datasheet number is physically impossible and therefore a measurement
-# failure (remote-execution caching is the proven mechanism: rounds 2-4
-# recorded 268 / 270 / 237.9 TF/s on a 197 TF/s v5e), never hardware.
-# Longest-substring match so "v5 lite" wins over a bare "v5".
-TPU_DATASHEET_BF16_TFLOPS = {
-    "v2": 46.0,
-    "v3": 123.0,
-    "v4": 275.0,
-    "v5 lite": 197.0,
-    "v5litepod": 197.0,
-    "v5e": 197.0,
-    "v5p": 459.0,
-    "v6 lite": 918.0,
-    "v6e": 918.0,
-}
-
-# Headroom above the datasheet number before a measurement is rejected:
-# covers clock/rounding slop in the datasheet itself, not caching (which
-# produces 1.2-1.4x errors, far outside this band).
-DATASHEET_HEADROOM = 1.05
-
-# Recorded v5e int8 MXU peak: measured on this machine with PRE-CAST
-# int8 operands (the round-2 177 TOP/s carried an in-loop bf16 cast that
-# halved it) — 4096^3 int8 dot_general chain, elementwise int32->int8
-# squeeze between iterates, marginal timing: 369-373 TOP/s, ~94% of the
-# 394 TOP/s datasheet (2x the bf16 197).
-INT8_PEAK_FALLBACK = 369e12
-
-# Per-generation int8-over-bf16 MXU rate: v5e/v5p/v6 double int8;
-# v2/v3/v4 run int8 at the bf16 rate (no native int8 MXU doubling).
-# Used both as the measurement ceiling (x DATASHEET_HEADROOM) and to
-# scale the datasheet fallback — assuming 2x on a v4 would record a
-# ~2x-understated MFU under an authoritative-sounding tag. Unknown
-# generations use the 2x upper bound for the CLAMP only (permissive),
-# never for a fallback value.
-TPU_INT8_FACTOR = {
-    "v2": 1.0,
-    "v3": 1.0,
-    "v4": 1.0,
-    "v5 lite": 2.0,
-    "v5litepod": 2.0,
-    "v5e": 2.0,
-    "v5p": 2.0,
-    "v6 lite": 2.0,
-    "v6e": 2.0,
-}
-INT8_FACTOR_UPPER_BOUND = 2.0
-
-
-# The v5e table keys: the generation whose RECORDED on-chip measurement
-# (BF16_PEAK_FALLBACK) exists, distinguished by key rather than by
-# comparing datasheet numbers (float identity would silently drift if a
-# table entry were corrected or two generations shared a number).
-_V5E_KEYS = frozenset({"v5 lite", "v5litepod", "v5e"})
-
-
-def _datasheet_match(device_kind):
-    """``(table_key, peak_flops)`` for the longest table key contained in
-    ``device_kind``, or None when the generation is unrecognized."""
-    kind = (device_kind or "").lower()
-    best = None
-    for key, tflops in TPU_DATASHEET_BF16_TFLOPS.items():
-        if key in kind and (best is None or len(key) > len(best[0])):
-            best = (key, tflops * 1e12)
-    return best
-
-
-def datasheet_bf16_peak(device_kind):
-    """Datasheet bf16 peak (FLOP/s) for a jax ``device_kind`` string, or
-    None when the generation is unrecognized (future hardware must not be
-    clamped to a stale table)."""
-    match = _datasheet_match(device_kind)
-    return None if match is None else match[1]
-
-
-def check_peak_against_datasheet(peak, device_kind):
-    """Raise when a measured peak exceeds the datasheet band for this
-    device generation — above-physics readings are measurement failures
-    (the remote-execution-cache pathology), and recording one as
-    "measured" corrupts the MFU time series (BENCH_r04: 237.9 TF/s on a
-    197 TF/s v5e read as an MFU collapse). Unknown generations pass: a
-    stale table must not reject a future chip."""
-    sheet = datasheet_bf16_peak(device_kind)
-    if sheet is not None and peak > DATASHEET_HEADROOM * sheet:
-        raise ValueError(
-            f"measured peak {peak / 1e12:.1f} TF/s exceeds the "
-            f"{device_kind!r} datasheet {sheet / 1e12:.0f} TF/s by more "
-            f"than {DATASHEET_HEADROOM:.2f}x — measurement failure "
-            "(cached request?), not hardware"
-        )
-
-
-def aggregate_peak_attempts(attempts, rel_tol=0.05):
-    """Agreement-gated aggregation of independent peak attempts: the
-    estimate is the median of the largest cluster of attempts that agree
-    within ``rel_tol`` (max/min <= 1+rel_tol over the cluster), requiring
-    at least two members. Raises when no two attempts agree.
-
-    This replaces max-over-attempts, whose design assumption — "noise can
-    only make the chip look slower" — was empirically falsified three
-    times (268, 270, 237.9 TF/s fast-side errors on a 197 TF/s part):
-    max is precisely the aggregator that amplifies any residual fast-side
-    failure mode. When two DISJOINT clusters tie for largest (a bimodal
-    session — e.g. two jitter-degraded and two genuine attempts), neither
-    is trustworthy and the function refuses rather than guess: anchoring
-    on the slow cluster would INFLATE MFU (the round-2 114 TF/s lesson),
-    anchoring on the fast one risks the cache pathology.
-    """
-    vals = sorted(a for a in attempts if a > 0)
-    if len(vals) < 2:
-        raise ValueError(
-            f"need >=2 positive attempts to agree, got {len(vals)} "
-            f"from {list(attempts)}"
-        )
-    best = None
-    ambiguous = False  # a DISJOINT equal-size cluster exists
-    for i in range(len(vals)):
-        j = i
-        while j + 1 < len(vals) and vals[j + 1] <= vals[i] * (1 + rel_tol):
-            j += 1
-        size = j - i + 1
-        if size >= 2:
-            if best is None or size > best[0]:
-                best, ambiguous = (size, i, j), False
-            elif size == best[0] and i > best[2]:
-                # Only windows sharing NO attempts with the best are a
-                # second mode; an equal-size window that overlaps it
-                # (e.g. a mild fast outlier within tol of the cluster's
-                # max but not its min) is the same cluster shifted and
-                # must not veto the measurement.
-                ambiguous = True
-    if best is None:
-        raise ValueError(
-            "no two peak attempts agree within "
-            f"{rel_tol:.0%}: {[round(v / 1e12, 1) for v in vals]} TF/s — "
-            "session too noisy to anchor MFU"
-        )
-    if ambiguous:
-        raise ValueError(
-            "ambiguous peak attempts (two disjoint equal-size clusters): "
-            f"{[round(v / 1e12, 1) for v in vals]} TF/s — bimodal "
-            "session, refusing to pick a cluster"
-        )
-    _, i, j = best
-    cluster = vals[i : j + 1]
-    mid = len(cluster) // 2
-    if len(cluster) % 2:
-        return cluster[mid]
-    return 0.5 * (cluster[mid - 1] + cluster[mid])
-
+# The shared cost-analysis wrapper (ONE call site family across
+# summary/ledger/engine/bench — tolerant of None/[dict]/missing keys).
+from zookeeper_tpu.observability.ledger import cost_flops  # noqa: E402
 
 # Canonical implementation lives in the library so bench.py and
 # measure_fused_loop_time share one copy; re-exported here because the
@@ -448,7 +313,7 @@ def resolve_peak_flops(env=None):
         "ZK_BENCH_PEAK_FLOPS",
         measure_bf16_peak,
         BF16_PEAK_FALLBACK,
-        lambda sheet, key: 0.93 * sheet,
+        lambda sheet, key: ACHIEVABLE_FRACTION * sheet,
         "TF/s",
     )
 
@@ -463,7 +328,9 @@ def resolve_int8_peak(env=None):
         "ZK_BENCH_INT8_PEAK_FLOPS",
         measure_int8_peak,
         INT8_PEAK_FALLBACK,
-        lambda sheet, key: 0.93 * TPU_INT8_FACTOR.get(key, 1.0) * sheet,
+        lambda sheet, key: (
+            ACHIEVABLE_FRACTION * TPU_INT8_FACTOR.get(key, 1.0) * sheet
+        ),
         "TOP/s",
     )
 
@@ -967,6 +834,38 @@ def measure_trace_overhead(env=None):
             best = min(best, time.perf_counter() - t0)
         return best / iters * 1e6
 
+    def call_cost_us(fn, iters: int = 20000, reps: int = 5) -> float:
+        """Min-over-reps per-call cost of ``fn()`` — the same
+        component-measurement protocol as span_cost_us."""
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                fn()
+            best = min(best, time.perf_counter() - t0)
+        return best / iters * 1e6
+
+    # Ledger-era per-step observability costs (docs/DESIGN.md §14):
+    # the step-time watchdog's observe() and a gauge set() ride EVERY
+    # step/dispatch; both are measured as components and included in
+    # the gated budget. The zk-device-probe HBM poll is interval-
+    # driven (default 10s), never per-step — its one-poll cost rides
+    # along informationally.
+    from zookeeper_tpu.observability.device import DeviceProbe
+    from zookeeper_tpu.observability.registry import MetricsRegistry
+    from zookeeper_tpu.observability.watchdog import StepTimeWatchdog
+
+    obs_reg = MetricsRegistry()
+    probe_dog = StepTimeWatchdog("obs_bench_probe", registry=obs_reg)
+    watchdog_us = call_cost_us(lambda: probe_dog.observe(1e-3))
+    probe_gauge = obs_reg.gauge("obs_bench_probe_gauge")
+    gauge_us = call_cost_us(lambda: probe_gauge.set(1.0))
+    probe = DeviceProbe(registry=obs_reg)
+    t0 = time.perf_counter()
+    for _ in range(20):
+        probe.poll_once()
+    hbm_poll_us = (time.perf_counter() - t0) / 20 * 1e6
+
     prior_tracer = trace.get_tracer()
     state, m = step(state, batch)  # compile outside every timed window
     jax.block_until_ready(m["loss"])
@@ -995,15 +894,21 @@ def measure_trace_overhead(env=None):
         trace.install(prior_tracer)
     # The fused loop records two spans per step (data_wait +
     # dispatch); readback/checkpoint spans amortize over a slab or an
-    # epoch and only lower the real per-step count below this.
+    # epoch and only lower the real per-step count below this. The
+    # ledger era adds one watchdog observe (the inter-dispatch stream)
+    # and one gauge set (EWMA mirror) per step; the sync-point MFU
+    # gauges amortize over log_every and only lower the real count.
     spans_per_step = 2
     step_floor_ms = min(untraced_best, traced_best) / steps * 1e3
     overhead_frac = (
-        (enabled_us - noop_us) * spans_per_step / 1e3 / step_floor_ms
-    )
+        (enabled_us - noop_us) * spans_per_step + watchdog_us + gauge_us
+    ) / 1e3 / step_floor_ms
     return {
         "obs_span_cost_us": round(enabled_us, 4),
         "obs_span_noop_cost_us": round(noop_us, 4),
+        "obs_watchdog_cost_us": round(watchdog_us, 4),
+        "obs_gauge_cost_us": round(gauge_us, 4),
+        "obs_hbm_poll_us": round(hbm_poll_us, 3),
         "obs_spans_per_step": spans_per_step,
         "obs_step_time_ms_untraced": round(
             untraced_best / steps * 1e3, 4
@@ -1114,13 +1019,7 @@ def measure_lm_throughput(peak_flops=None, env=None):
     )
     lowered = jit_step.lower(state, lm_batch)
     compiled = lowered.compile()
-    try:
-        analysis = compiled.cost_analysis()
-        if isinstance(analysis, list):
-            analysis = analysis[0]
-        lm_cost = float(analysis["flops"])
-    except Exception:
-        lm_cost = None
+    lm_cost = cost_flops(compiled)  # shared wrapper; None when absent
 
     def run_chain(k):
         nonlocal state
@@ -1342,12 +1241,37 @@ def check_device_reachable(timeout_s: float = 120.0) -> None:
         raise err[0]
 
 
-def main():
+def parse_args(argv=None):
+    """Bench CLI: ``--compare PREV.json`` gates this run against a
+    previous BENCH/MULTICHIP artifact via ``tools.bench_diff`` (exit 3
+    on regression); everything else stays env-var-driven (ZK_BENCH_*)
+    so the driver contract is unchanged."""
+    import argparse
+
+    parser = argparse.ArgumentParser(description="north-star bench")
+    parser.add_argument(
+        "--compare",
+        default=None,
+        metavar="PREV_JSON",
+        help="previous bench JSON (raw line or driver wrapper) to diff "
+        "against; regressions beyond per-metric tolerance exit 3",
+    )
+    parser.add_argument(
+        "--compare-out",
+        default=None,
+        metavar="DIFF_JSON",
+        help="write the full diff JSON here (CI artifact)",
+    )
+    return parser.parse_args(argv)
+
+
+def main(argv=None):
     import jax
     import jax.numpy as jnp
     import numpy as np
     import optax
 
+    args = parse_args(argv)
     check_device_reachable()
     # Resolve early: a malformed ZK_BENCH_COMPILER_OPTIONS must fail
     # before the (minutes-long) model build + lower, not at compile.
@@ -1416,14 +1340,10 @@ def main():
     # (includes fwd + bwd + optimizer as actually executed). NOTE: for an
     # SPMD executable this is already the PER-DEVICE partitioned module's
     # FLOPs — do not divide by n_chips again. Computed before timing: it
-    # also sets the plausibility floor for the measured step time.
-    try:
-        analysis = compiled_step.cost_analysis()
-        if isinstance(analysis, list):  # older jax returns [dict]
-            analysis = analysis[0]
-        cost = float(analysis["flops"])
-    except Exception:
-        cost = None
+    # also sets the plausibility floor for the measured step time. Goes
+    # through the shared cost-analysis wrapper (None/[dict]/missing-key
+    # tolerant) the ledger and summary use.
+    cost = cost_flops(compiled_step)
 
     # Resolve the MFU anchor BEFORE timing: the plausibility floor below
     # must scale with the chip actually under test (deriving it from the
@@ -1778,17 +1698,34 @@ def main():
         "ResNet50": "resnet50",
         "BinaryAlexNet": "binary_alexnet",
     }.get(model_name, model_name.lower())
-    print(
-        json.dumps(
-            {
-                "metric": f"{metric_model}_train_images_per_sec_per_chip",
-                "value": round(images_per_sec_per_chip, 1),
-                "unit": "images/sec/chip",
-                "vs_baseline": vs_baseline,
-                **extras,
-            }
+    result = {
+        "metric": f"{metric_model}_train_images_per_sec_per_chip",
+        "value": round(images_per_sec_per_chip, 1),
+        "unit": "images/sec/chip",
+        "vs_baseline": vs_baseline,
+        **extras,
+    }
+    print(json.dumps(result))
+
+    if args.compare:
+        # Regression gate (tools/bench_diff.py): diff this run against
+        # the previous artifact AFTER the result line printed — the
+        # measurement must never be lost to a failed gate.
+        sys.path.insert(0, os.path.join(os.path.dirname(__file__), "tools"))
+        import bench_diff
+
+        previous = bench_diff.load_bench_json(args.compare)
+        diff = bench_diff.compare(result, previous)
+        print(
+            f"--compare vs {args.compare}:\n{diff.report()}",
+            file=sys.stderr,
+            flush=True,
         )
-    )
+        if args.compare_out:
+            with open(args.compare_out, "w") as f:
+                json.dump(diff.as_dict(), f, indent=1)
+        if not diff.ok:
+            raise SystemExit(3)
 
 
 if __name__ == "__main__":

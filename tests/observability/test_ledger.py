@@ -1,0 +1,305 @@
+"""Program ledger: the shared cost_analysis wrapper's backend
+tolerance, ledger recording/eviction, the LedgeredExecutable compile
+seam, and the MFU gauge math pinned against hand-computed fixtures
+(docs/DESIGN.md §14)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from zookeeper_tpu.observability.ledger import (
+    LedgeredExecutable,
+    ProgramLedger,
+    cost_analysis_dict,
+    cost_bytes,
+    cost_flops,
+    default_ledger,
+    memory_analysis_dict,
+    mfu,
+)
+from zookeeper_tpu.observability.registry import MetricsRegistry
+
+
+# -- the shared cost_analysis wrapper ------------------------------------
+
+
+class _Prog:
+    """Stand-in for a jax Lowered/Compiled with a controllable
+    cost_analysis payload."""
+
+    def __init__(self, payload):
+        self._payload = payload
+
+    def cost_analysis(self):
+        if isinstance(self._payload, Exception):
+            raise self._payload
+        return self._payload
+
+
+@pytest.mark.parametrize(
+    "payload",
+    [
+        None,  # CPU backend on some jax versions
+        [],  # empty legacy list
+        "not a dict",  # junk payload
+        RuntimeError("unsupported backend"),  # cost_analysis raises
+    ],
+)
+def test_cost_analysis_dict_tolerates_backend_quirks(payload):
+    assert cost_analysis_dict(_Prog(payload)) == {}
+    assert cost_flops(_Prog(payload)) is None
+    assert cost_bytes(_Prog(payload)) is None
+
+
+def test_cost_analysis_dict_unwraps_legacy_list_convention():
+    prog = _Prog([{"flops": 12.0, "bytes accessed": 34.0}])
+    assert cost_flops(prog) == 12.0
+    assert cost_bytes(prog) == 34.0
+
+
+def test_cost_scalars_reject_nan_negative_and_non_numeric():
+    assert cost_flops(_Prog({"flops": float("nan")})) is None
+    assert cost_flops(_Prog({"flops": -1.0})) is None
+    assert cost_flops(_Prog({"flops": "garbage"})) is None
+    assert cost_flops(_Prog({})) is None
+    assert cost_flops(_Prog({"flops": 7})) == 7.0
+
+
+def test_memory_analysis_dict_tolerates_missing_backend_support():
+    class NoMem:
+        def memory_analysis(self):
+            raise NotImplementedError
+
+    assert memory_analysis_dict(NoMem()) == {}
+
+    class Mem:
+        def memory_analysis(self):
+            class A:
+                argument_size_in_bytes = 128
+                output_size_in_bytes = 64
+                temp_size_in_bytes = 32
+
+            return A()
+
+    out = memory_analysis_dict(Mem())
+    assert out["argument_size_in_bytes"] == 128.0
+    assert out["temp_size_in_bytes"] == 32.0
+
+
+def test_summary_and_ledger_share_one_wrapper():
+    """The dedup contract: models.summary takes its FLOPs straight off
+    the ledger record (record() runs the ONE shared cost_analysis pass
+    per program) — no second divergent call site, no re-run."""
+    import inspect
+
+    from zookeeper_tpu.models import summary as summary_mod
+
+    src = inspect.getsource(summary_mod)
+    assert ").flops" in src  # record(...).flops — the shared pass
+    assert ".cost_analysis()" not in src
+    assert "cost_flops" not in src
+
+
+# -- ProgramLedger -------------------------------------------------------
+
+
+def test_ledger_records_and_renders_status():
+    reg = MetricsRegistry()
+    ledger = ProgramLedger(registry=reg)
+    rec = ledger.record(
+        "train_step",
+        "TestPartitioner/mesh=1",
+        lowered=_Prog({"flops": 1e9, "bytes accessed": 2e6}),
+        lower_ms=1.5,
+        compile_ms=20.0,
+        attrs={"partitioner": "TestPartitioner"},
+    )
+    assert rec.flops == 1e9
+    assert rec.bytes_accessed == 2e6
+    assert rec.ordinal == 1
+    assert ledger.latest("train_step") is rec
+    assert ledger.latest("serve_forward") is None
+    status = ledger.as_status()
+    assert status["count"] == 1
+    assert status["total_compile_ms"] == 20.0
+    assert status["programs"][0]["kind"] == "train_step"
+    assert reg.counter(
+        "zk_compiles_total", labels={"kind": "train_step"}
+    ).value == 1
+    assert reg.counter(
+        "zk_compile_ms_total", labels={"kind": "train_step"}
+    ).value == 20.0
+
+
+def test_ledger_survives_unavailable_cost_analysis():
+    """The satellite contract: programs whose cost analysis is
+    unavailable still get a row (identity + compile time), with None
+    FLOPs rather than a crash."""
+    ledger = ProgramLedger(registry=MetricsRegistry())
+    rec = ledger.record(
+        "serve_forward",
+        "b4/float32",
+        lowered=_Prog(RuntimeError("no cost analysis")),
+        compiled=None,
+        compile_ms=3.0,
+    )
+    assert rec.flops is None
+    assert rec.bytes_accessed is None
+    assert rec.memory == {}
+    row = ledger.as_status()["programs"][0]
+    assert "flops" not in row
+    assert row["compile_ms"] == 3.0
+
+
+def test_ledger_bounds_records_and_keeps_newest():
+    ledger = ProgramLedger(max_records=4, registry=MetricsRegistry())
+    for i in range(10):
+        ledger.record("train_step", f"key{i}")
+    entries = ledger.entries()
+    assert len(entries) == 4
+    assert [e.key for e in entries] == ["key6", "key7", "key8", "key9"]
+    # Ordinals keep counting across eviction (process-lifetime order).
+    assert entries[-1].ordinal == 10
+
+
+def test_default_ledger_is_process_global():
+    assert default_ledger() is default_ledger()
+
+
+# -- MFU math (hand-computed fixture) ------------------------------------
+
+
+def test_mfu_pinned_against_hand_computed_fixture():
+    """18.4 TFLOP program at 0.25 s/step on a 184 TF/s peak is exactly
+    40% MFU — the gauge math must reproduce the hand computation."""
+    assert mfu(18.4e12, 0.25, 184e12) == pytest.approx(0.4)
+    # bench.py's offline convention: mfu = flops / time / peak. A
+    # half-speed step halves MFU.
+    assert mfu(18.4e12, 0.5, 184e12) == pytest.approx(0.2)
+
+
+@pytest.mark.parametrize(
+    "flops,seconds,peak",
+    [
+        (None, 0.1, 184e12),  # cost analysis unavailable
+        (1e12, 0.0, 184e12),  # zero time (no sync yet)
+        (1e12, -0.1, 184e12),  # clock skew
+        (1e12, 0.1, None),  # no peak anchor
+        (0.0, 0.1, 184e12),  # empty program
+        ("x", 0.1, 184e12),  # junk
+        (float("nan"), 0.1, 184e12),
+    ],
+)
+def test_mfu_returns_none_on_any_degenerate_input(flops, seconds, peak):
+    assert mfu(flops, seconds, peak) is None
+
+
+# -- LedgeredExecutable --------------------------------------------------
+
+
+def _jitted_add():
+    return jax.jit(lambda a, b: a + b)
+
+
+def test_ledgered_executable_records_on_first_call_only():
+    ledger = ProgramLedger(registry=MetricsRegistry())
+    fn = LedgeredExecutable(
+        _jitted_add(), kind="train_step", key="test/mesh=1", ledger=ledger
+    )
+    a = jnp.ones((4, 4))
+    out = fn(a, a)
+    np.testing.assert_allclose(np.asarray(out), 2.0)
+    assert len(ledger.entries()) == 1
+    rec = ledger.entries()[0]
+    assert rec.kind == "train_step"
+    assert rec.key.startswith("test/mesh=1/args")
+    assert rec.compile_ms is not None and rec.compile_ms >= 0
+    assert rec.dispatches == 1
+    # Steady state: same signature dispatches the compiled program, no
+    # new ledger rows.
+    for _ in range(3):
+        fn(a, a)
+    assert len(ledger.entries()) == 1
+    assert ledger.entries()[0].dispatches == 4
+    assert fn.ledger_entry is rec
+
+
+def test_ledgered_executable_matches_plain_jit_output():
+    fn = LedgeredExecutable(
+        jax.jit(lambda x: jnp.sin(x) * 2),
+        kind="eval_step",
+        key="k",
+        ledger=ProgramLedger(registry=MetricsRegistry()),
+    )
+    x = jnp.linspace(0, 1, 17)
+    np.testing.assert_array_equal(
+        np.asarray(fn(x)), np.asarray(jax.jit(lambda x: jnp.sin(x) * 2)(x))
+    )
+
+
+def test_ledgered_executable_falls_back_on_shape_change():
+    """A partial final batch (new shapes) must dispatch through the
+    wrapped jit — same numbers as the uninstrumented seam — without
+    growing the ledger."""
+    ledger = ProgramLedger(registry=MetricsRegistry())
+    fn = LedgeredExecutable(
+        _jitted_add(), kind="eval_step", key="k", ledger=ledger
+    )
+    fn(jnp.ones((8,)), jnp.ones((8,)))
+    out = fn(jnp.ones((3,)), jnp.ones((3,)))  # odd final batch
+    assert out.shape == (3,)
+    np.testing.assert_allclose(np.asarray(out), 2.0)
+    assert len(ledger.entries()) == 1
+    # And the original shape still dispatches the compiled program.
+    assert fn(jnp.ones((8,)), jnp.ones((8,))).shape == (8,)
+
+
+def test_ledgered_executable_real_error_still_raises():
+    """An error that is NOT a shape change (same signature) must not be
+    swallowed by the fallback path."""
+
+    def bad(a, b):
+        return jnp.reshape(a, (5,)) + b  # invalid for (4,) inputs
+
+    fn = LedgeredExecutable(
+        jax.jit(bad), kind="eval_step", key="k",
+        ledger=ProgramLedger(registry=MetricsRegistry()),
+    )
+    with pytest.raises(TypeError):
+        fn(jnp.ones((4,)), jnp.ones((4,)))
+
+
+def test_ledgered_executable_delegates_lower_and_attrs():
+    jitted = _jitted_add()
+    fn = LedgeredExecutable(
+        jitted, kind="train_step", key="k",
+        ledger=ProgramLedger(registry=MetricsRegistry()),
+    )
+    lowered = fn.lower(jnp.ones((2,)), jnp.ones((2,)))
+    assert hasattr(lowered, "compile")
+
+
+def test_partitioner_seams_return_ledgered_executables():
+    """The tentpole wiring: SingleDevicePartitioner's compile seams
+    hand back ledger-instrumented callables whose records land in the
+    process-global ledger with the partitioner identity key."""
+    from zookeeper_tpu.parallel import SingleDevicePartitioner
+
+    before = len(default_ledger().entries())
+    part = SingleDevicePartitioner()
+    part.setup()
+    step = part.compile_step(
+        lambda state, batch: (state, {"loss": jnp.mean(batch)}),
+        {"w": jnp.ones(())},
+        donate_state=False,
+    )
+    assert isinstance(step, LedgeredExecutable)
+    state, metrics = step({"w": jnp.ones(())}, jnp.ones((4,)))
+    rec = default_ledger().entries()[-1]
+    assert len(default_ledger().entries()) == before + 1
+    assert rec.kind == "train_step"
+    assert "SingleDevicePartitioner" in rec.key
+    # On the CPU backend cost analysis exists: the row carries FLOPs
+    # the MFU gauge can divide.
+    assert rec.flops is None or rec.flops >= 0

@@ -1,0 +1,161 @@
+"""Step-time anomaly watchdog: fires on an injected straggler, stays
+silent on steady cadence, and follows the docs/DESIGN.md §14
+false-positive policy (warmup, min_ratio floor, min_excess_s floor,
+bounded-burst EWMA absorption)."""
+
+import threading
+
+import pytest
+
+from zookeeper_tpu.observability import trace
+from zookeeper_tpu.observability.registry import MetricsRegistry
+from zookeeper_tpu.observability.watchdog import StepTimeWatchdog
+
+
+@pytest.fixture(autouse=True)
+def _clean_tracer():
+    trace.disable()
+    yield
+    trace.disable()
+
+
+def _dog(**kw):
+    kw.setdefault("registry", MetricsRegistry())
+    return StepTimeWatchdog("test_stream", **kw)
+
+
+def test_silent_on_steady_cadence():
+    """A realistic steady stream (small jitter around 100ms) must never
+    fire — the acceptance contract's false-positive half."""
+    reg = MetricsRegistry()
+    dog = StepTimeWatchdog("steady", registry=reg)
+    jitter = [1.0, -0.7, 0.3, -0.2, 0.9, -0.5, 0.1, -0.9]
+    for i in range(200):
+        flagged = dog.observe(0.100 + jitter[i % len(jitter)] * 1e-3, step=i)
+        assert not flagged
+    assert dog.anomalies == 0
+    assert reg.counter(
+        "zk_step_time_anomalies_total", labels={"stream": "steady"}
+    ).value == 0
+    assert dog.ewma_seconds == pytest.approx(0.100, rel=0.02)
+
+
+def test_fires_on_injected_straggler_and_traces_it():
+    """The acceptance contract's true-positive half: one injected 3x
+    straggler in a steady stream is flagged, counted, and emits a
+    step_time_anomaly trace event with attribution."""
+    tracer = trace.enable()
+    reg = MetricsRegistry()
+    dog = StepTimeWatchdog("train_step", registry=reg)
+    jitter = [0.4, -0.3, 0.2, -0.5, 0.1]
+    for i in range(50):
+        assert not dog.observe(0.100 + jitter[i % 5] * 1e-3, step=i)
+    assert dog.observe(0.300, step=50)  # the straggler
+    assert dog.anomalies == 1
+    assert reg.counter(
+        "zk_step_time_anomalies_total", labels={"stream": "train_step"}
+    ).value == 1
+    records = tracer.drain()
+    events = [r for r in records if r.get("name") == "step_time_anomaly"]
+    assert len(events) == 1
+    attrs = events[0]["attrs"]
+    assert attrs["stream"] == "train_step"
+    assert attrs["observed_ms"] == pytest.approx(300.0)
+    assert attrs["baseline_ms"] == pytest.approx(100.0, rel=0.05)
+    assert events[0]["step"] == 50
+
+
+def test_warmup_suppresses_early_observations():
+    dog = _dog(warmup=5)
+    # A wild first few samples (compile, first-touch) never fire.
+    for v in (5.0, 0.1, 0.1, 0.1, 0.1):
+        assert not dog.observe(v)
+
+
+def test_min_ratio_floor_on_near_zero_spread():
+    """A microsecond-perfect cadence collapses MAD to ~0; without the
+    ratio floor ANY jitter would be 'threshold sigmas'. A +20% blip
+    must stay silent, a 2x one may fire."""
+    dog = _dog(threshold=6.0, min_ratio=1.5)
+    for _ in range(64):
+        dog.observe(0.010)
+    assert not dog.observe(0.012)  # +20% — under the ratio floor
+    assert dog.observe(0.020)  # 2x — a real straggler
+
+
+def test_min_excess_floor_guards_fast_streams():
+    """With min_excess_s=5ms (the training default), a 2x blip on a
+    1ms-step CPU stream is sub-floor noise; on a 100ms stream the same
+    ratio fires."""
+    fast = _dog(min_excess_s=0.005)
+    for _ in range(64):
+        fast.observe(0.001)
+    assert not fast.observe(0.003)  # 3x, but only +2ms — under floor
+    slow = _dog(min_excess_s=0.005)
+    for _ in range(64):
+        slow.observe(0.100)
+    assert slow.observe(0.300)
+
+
+def test_persistent_regression_becomes_new_baseline():
+    """Bounded-burst policy: a step-function regression fires while it
+    is news, then the EWMA absorbs it and the alerts stop."""
+    dog = _dog(alpha=0.2, min_excess_s=0.0)
+    for _ in range(64):
+        dog.observe(0.050)
+    flags = [dog.observe(0.200) for _ in range(60)]
+    assert flags[0] is True
+    burst = sum(flags)
+    assert 1 <= burst <= 30  # news for ~1/alpha observations, not forever
+    assert not flags[-1]
+    assert dog.ewma_seconds == pytest.approx(0.200, rel=0.05)
+
+
+def test_ewma_gauge_mirrors_baseline():
+    reg = MetricsRegistry()
+    dog = StepTimeWatchdog("g", registry=reg)
+    dog.observe(0.080)
+    assert reg.gauge(
+        "zk_step_time_ewma_ms", labels={"stream": "g"}
+    ).value == pytest.approx(80.0)
+
+
+def test_negative_durations_ignored():
+    dog = _dog()
+    assert not dog.observe(-1.0)
+    assert dog.ewma_seconds is None
+
+
+def test_constructor_validation():
+    with pytest.raises(ValueError):
+        _dog(alpha=0.0)
+    with pytest.raises(ValueError):
+        _dog(alpha=1.5)
+    with pytest.raises(ValueError):
+        _dog(window=2)
+    with pytest.raises(ValueError):
+        _dog(warmup=0)
+    with pytest.raises(ValueError):
+        _dog(min_ratio=0.5)
+
+
+def test_thread_safe_under_concurrent_observers():
+    """The serving dispatcher's worker thread and test assertions may
+    race; N threads x M observes must count exactly and never raise."""
+    dog = _dog(window=32)
+    errors = []
+
+    def feed():
+        try:
+            for _ in range(500):
+                dog.observe(0.010)
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    threads = [threading.Thread(target=feed) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+    assert dog.anomalies == 0

@@ -1,0 +1,249 @@
+"""Bench regression gate: direction-aware classification, tolerance
+gating, driver-wrapper loading, schema-mismatch downgrade, and the CLI
+exit-code contract."""
+
+import json
+
+import pytest
+
+from tools import bench_diff
+
+
+# -- classification ------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "name,expected",
+    [
+        ("value", "higher"),
+        ("vs_baseline", "higher"),
+        ("lm_tokens_per_sec_per_chip", "higher"),
+        ("host_aug_images_per_sec_per_core", "higher"),
+        ("serve_qps_per_chip", "higher"),
+        ("mfu_vs_measured_peak", "higher"),
+        ("ckpt_steps_overlapped_per_save", "higher"),
+        ("serve_p50_ms", "lower"),
+        ("serve_p99_ms", "lower"),
+        ("recovery_restore_ms", "lower"),
+        ("ckpt_async_save_stall_ms", "lower"),
+        ("shed_rate", "lower"),
+    ],
+)
+def test_classify_metric_directions(name, expected):
+    assert bench_diff.classify_metric(name) == expected
+
+
+@pytest.mark.parametrize(
+    "name",
+    [
+        "model", "metric", "unit", "n_chips", "batch_size", "unroll",
+        "device_kind", "git_sha", "jax_version", "bench_schema_version",
+        "peak_flops_source", "binary_compute", "obs_trace_overhead_frac",
+        # Peak anchors and FLOP counts are measurement CONTEXT: a
+        # re-measured peak (the BENCH_r04 237.9 pathology being fixed)
+        # explains the gated numbers and must not gate itself.
+        "measured_bf16_peak_tflops", "measured_int8_peak_tops",
+        "model_step_tflops",
+    ],
+)
+def test_identity_and_context_keys_never_gate(name):
+    assert bench_diff.classify_metric(name) is None
+
+
+# -- compare -------------------------------------------------------------
+
+
+def _line(**kw):
+    base = {
+        "metric": "quicknet_train_images_per_sec_per_chip",
+        "value": 1000.0,
+        "unit": "images/sec/chip",
+        "bench_schema_version": 1,
+    }
+    base.update(kw)
+    return base
+
+
+def test_no_gate_within_tolerance():
+    diff = bench_diff.compare(_line(value=950.0), _line(value=1000.0))
+    assert diff.ok
+    assert not diff.regressions and not diff.improvements
+
+
+def test_throughput_drop_beyond_tolerance_is_a_regression():
+    diff = bench_diff.compare(_line(value=850.0), _line(value=1000.0))
+    assert not diff.ok
+    (row,) = diff.regressions
+    assert row["name"] == "value"
+    assert row["delta"] == pytest.approx(-0.15)
+    assert "REGRESSION" in diff.report()
+
+
+def test_latency_directions_invert():
+    cur = _line(serve_p50_ms=12.0)
+    prev = _line(serve_p50_ms=10.0)
+    diff = bench_diff.compare(cur, prev)
+    assert [r["name"] for r in diff.regressions] == ["serve_p50_ms"]
+    # A latency DROP is an improvement, not a regression.
+    diff2 = bench_diff.compare(prev, _line(serve_p50_ms=14.0))
+    assert diff2.ok
+    assert [r["name"] for r in diff2.improvements] == ["serve_p50_ms"]
+
+
+def test_per_metric_tolerance_overrides_default():
+    # serve_p99_ms carries a 30% override: +25% is weather, not a gate.
+    diff = bench_diff.compare(
+        _line(serve_p99_ms=12.5), _line(serve_p99_ms=10.0)
+    )
+    assert diff.ok
+    diff2 = bench_diff.compare(
+        _line(serve_p99_ms=14.0), _line(serve_p99_ms=10.0)
+    )
+    assert not diff2.ok
+
+
+def test_added_removed_and_drift_never_gate():
+    cur = _line(new_leg_tokens_per_sec=5.0, model="QuickNet")
+    prev = _line(old_leg_qps=3.0, model="ResNet50")
+    diff = bench_diff.compare(cur, prev)
+    assert diff.ok
+    assert "new_leg_tokens_per_sec" in diff.added
+    assert "old_leg_qps" in diff.removed
+    assert [d["name"] for d in diff.drift] == ["model"]
+
+
+def test_schema_mismatch_downgrades_to_report_only():
+    cur = _line(value=500.0, bench_schema_version=2)
+    prev = _line(value=1000.0, bench_schema_version=1)
+    diff = bench_diff.compare(cur, prev)
+    assert diff.schema_mismatch
+    assert diff.ok  # a 50% drop would gate, but renames would lie
+    assert "REPORT-ONLY" in diff.report()
+
+
+def test_zero_previous_reports_as_drift():
+    diff = bench_diff.compare(
+        _line(serve_p50_ms=5.0), _line(serve_p50_ms=0.0)
+    )
+    assert diff.ok
+    assert any(d["name"] == "serve_p50_ms" for d in diff.drift)
+
+
+def test_negative_unknown_sentinel_reports_as_drift():
+    # -1.0 is the repo-wide "unknown" sentinel (MFU without cost
+    # analysis, HBM without memory_stats): a measurement gap must not
+    # gate as a fake regression in either direction.
+    diff = bench_diff.compare(
+        _line(vs_baseline=-1.0), _line(vs_baseline=0.34)
+    )
+    assert diff.ok
+    assert any(d["name"] == "vs_baseline" for d in diff.drift)
+    diff = bench_diff.compare(
+        _line(vs_baseline=0.34), _line(vs_baseline=-1.0)
+    )
+    assert diff.ok
+    assert not diff.improvements
+
+
+def test_bools_and_strings_never_gate():
+    diff = bench_diff.compare(
+        _line(host_aug_native_available=True, peak_flops_source="measured"),
+        _line(host_aug_native_available=False, peak_flops_source="env"),
+    )
+    assert diff.ok
+    assert {d["name"] for d in diff.drift} == {
+        "host_aug_native_available",
+        "peak_flops_source",
+    }
+
+
+# -- loading -------------------------------------------------------------
+
+
+def test_load_raw_line_and_driver_wrapper(tmp_path):
+    raw = tmp_path / "raw.json"
+    raw.write_text(json.dumps(_line()))
+    assert bench_diff.load_bench_json(str(raw))["value"] == 1000.0
+    # The committed BENCH_r*.json driver wrapper nests the line under
+    # "parsed".
+    wrapped = tmp_path / "wrapped.json"
+    wrapped.write_text(
+        json.dumps({"n": 5, "cmd": "bench", "rc": 0, "parsed": _line()})
+    )
+    assert bench_diff.load_bench_json(str(wrapped))["value"] == 1000.0
+
+
+def test_load_rejects_non_bench_documents(tmp_path):
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps({"unrelated": 1}))
+    with pytest.raises(ValueError):
+        bench_diff.load_bench_json(str(bad))
+    notdict = tmp_path / "notdict.json"
+    notdict.write_text("[1, 2]")
+    with pytest.raises(ValueError):
+        bench_diff.load_bench_json(str(notdict))
+
+
+def test_committed_artifacts_load():
+    """The CI gate compares against the committed latest BENCH_r*.json:
+    every committed artifact must stay loadable. (MULTICHIP_r*.json are
+    pass/fail dryrun records with no metric line — out of scope.)"""
+    import glob
+
+    paths = sorted(glob.glob("BENCH_r*.json"))
+    assert paths
+    for p in paths:
+        doc = bench_diff.load_bench_json(p)
+        assert "metric" in doc or "value" in doc
+
+
+# -- CLI contract --------------------------------------------------------
+
+
+def _write(tmp_path, name, doc):
+    p = tmp_path / name
+    p.write_text(json.dumps(doc))
+    return str(p)
+
+
+def test_cli_exit_codes(tmp_path, capsys):
+    cur = _write(tmp_path, "cur.json", _line(value=800.0))
+    prev = _write(tmp_path, "prev.json", _line(value=1000.0))
+    same = _write(tmp_path, "same.json", _line(value=1000.0))
+    assert bench_diff.main([same, prev]) == 0
+    assert bench_diff.main([cur, prev]) == 3
+    assert bench_diff.main([cur, prev, "--allow-regression"]) == 0
+    assert bench_diff.main([cur, str(tmp_path / "missing.json")]) == 2
+    capsys.readouterr()
+
+
+def test_cli_writes_diff_artifact(tmp_path, capsys):
+    cur = _write(tmp_path, "cur.json", _line(value=800.0))
+    prev = _write(tmp_path, "prev.json", _line(value=1000.0))
+    out = tmp_path / "diff.json"
+    assert bench_diff.main([cur, prev, "--json", str(out)]) == 3
+    doc = json.loads(out.read_text())
+    assert doc["ok"] is False
+    assert doc["regressions"][0]["name"] == "value"
+    capsys.readouterr()
+
+
+def test_cli_custom_tolerance(tmp_path, capsys):
+    cur = _write(tmp_path, "cur.json", _line(value=850.0))
+    prev = _write(tmp_path, "prev.json", _line(value=1000.0))
+    assert bench_diff.main([cur, prev]) == 3  # default 10%
+    assert bench_diff.main([cur, prev, "--tol", "0.20"]) == 0
+    capsys.readouterr()
+
+
+def test_bench_main_wires_compare(tmp_path):
+    """bench.py --compare parses and threads through to the gate (the
+    full bench run needs a device; the arg contract is what CI relies
+    on)."""
+    import bench
+
+    args = bench.parse_args(["--compare", "BENCH_r05.json"])
+    assert args.compare == "BENCH_r05.json"
+    assert args.compare_out is None
+    args = bench.parse_args([])
+    assert args.compare is None

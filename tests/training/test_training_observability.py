@@ -273,3 +273,154 @@ def test_profiling_window_still_closed_on_clean_run(tmp_path, monkeypatch):
     exp.run()
     assert calls["start"] == 1
     assert calls["stop"] == 1
+
+
+# -- device-side ledger / step-time watchdog / live MFU (docs §14) -------
+
+
+def test_live_run_publishes_step_time_and_mfu_gauges(tmp_path):
+    """The acceptance artifact: a real (eager, log_every-synced)
+    training run publishes zk_train_step_time_ms and zk_train_mfu from
+    ledger FLOPs / measured step time / the shared reference peak —
+    and the gauge agrees with the hand computation from its own
+    inputs."""
+    from zookeeper_tpu.observability.ledger import default_ledger, mfu
+    from zookeeper_tpu.observability.peaks import reference_peak_flops
+
+    exp = make_experiment(tmp_path, {"log_every": 2})
+    exp.run()
+    reg = exp.obs_registry
+    step_ms = reg.gauge("zk_train_step_time_ms").value
+    assert step_ms > 0
+    mfu_value = reg.gauge("zk_train_mfu").value
+    rec = default_ledger().latest("train_step")
+    assert rec is not None and rec.dispatches > 0
+    if rec.flops:
+        expected = mfu(rec.flops, step_ms / 1e3, reference_peak_flops()[0])
+        assert mfu_value == pytest.approx(expected, rel=1e-6)
+        assert 0 < mfu_value < 1
+    else:
+        assert mfu_value == -1  # unknown renders as the sentinel
+
+
+def test_fused_run_ledgers_multi_step_and_divides_flops_by_unroll(
+    tmp_path,
+):
+    """The fused (unroll>1) loop's MFU divides the slab executable's
+    FLOPs by the unroll factor — per-STEP utilization, same definition
+    as the eager loop."""
+    from zookeeper_tpu.observability.ledger import default_ledger, mfu
+    from zookeeper_tpu.observability.peaks import reference_peak_flops
+
+    exp = make_experiment(tmp_path, {"unroll": 2, "log_every": 2})
+    exp.run()
+    rec = default_ledger().latest("multi_step")
+    assert rec is not None
+    assert rec.compile_ms is not None
+    reg = exp.obs_registry
+    step_ms = reg.gauge("zk_train_step_time_ms").value
+    assert step_ms > 0
+    if rec.flops:
+        expected = mfu(
+            rec.flops / 2, step_ms / 1e3, reference_peak_flops()[0]
+        )
+        assert reg.gauge("zk_train_mfu").value == pytest.approx(
+            expected, rel=1e-6
+        )
+
+
+def test_mfu_divides_by_recorded_slab_size_not_configured_unroll(
+    tmp_path,
+):
+    """A partial first slab (mid-epoch resume, spe < unroll) compiles
+    the recorded multi_step program for k < unroll steps; the MFU
+    divisor must be the program's actual slab size, not the config."""
+    from zookeeper_tpu.observability.ledger import ProgramRecord, mfu
+    from zookeeper_tpu.observability.peaks import reference_peak_flops
+
+    exp = make_experiment(tmp_path, {"unroll": 8})
+
+    class FakeProgram:
+        ledger_entry = ProgramRecord(
+            kind="multi_step", key="k", flops=9e9, attrs={"steps": 3}
+        )
+
+    exp._publish_mfu(0.5, FakeProgram())
+    expected = mfu(9e9 / 3, 0.5, reference_peak_flops()[0])
+    assert exp.obs_registry.gauge("zk_train_mfu").value == pytest.approx(
+        expected, rel=1e-6
+    )
+
+
+def test_steady_run_fires_no_step_anomalies(tmp_path):
+    """False-positive half of the watchdog contract at integration
+    level: a short steady run's sync-stream observations sit inside
+    the warmup window, so the anomaly counter is exactly zero."""
+    exp = make_experiment(tmp_path, {"log_every": 2})
+    exp.run()
+    reg = exp.obs_registry
+    assert reg.counter(
+        "zk_step_time_anomalies_total", labels={"stream": "train_step"}
+    ).value == 0
+    # The dispatch stream baselined (its EWMA gauge moved off zero).
+    assert reg.gauge(
+        "zk_step_time_ewma_ms", labels={"stream": "train_dispatch"}
+    ).value > 0
+
+
+def test_metrics_endpoint_serves_mfu_and_hbm_series(tmp_path):
+    """CI-smoke contract: with metrics_port on, the new gauges render
+    as valid exposition text and the zk-device-probe's zk_hbm_* series
+    exist from the first scrape (-1 sentinel on statless backends)."""
+    import re
+    import urllib.request
+
+    seen = {}
+    exp = make_experiment(tmp_path, {"log_every": 2, "metrics_port": 0})
+
+    # Scrape DURING the run via the checkpointer save hook (the
+    # endpoint tears down at run end).
+    orig_save = exp.checkpointer.save
+
+    def save_and_scrape(*a, **k):
+        if "body" not in seen and getattr(exp, "obs_server", None):
+            url = f"http://127.0.0.1:{exp.obs_server.port}/metrics"
+            seen["body"] = urllib.request.urlopen(url).read().decode()
+        return orig_save(*a, **k)
+
+    exp.checkpointer.save = save_and_scrape
+    exp.run()
+    body = seen["body"]
+    assert "zk_hbm_bytes_in_use" in body
+    line_re = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? [^ ]+$")
+    samples = [
+        l for l in body.splitlines() if l and not l.startswith("#")
+    ]
+    assert samples and all(line_re.match(l) for l in samples)
+    assert getattr(exp, "obs_probe", None) is None  # torn down
+
+
+def test_trace_export_with_profile_dir_logs_paired_artifacts(
+    tmp_path, capsys
+):
+    """Satellite: the docs §13 Perfetto merge recipe is automated —
+    one teardown writes the host spans AND closes the device capture,
+    logging both artifact locations as a pair."""
+    prof = tmp_path / "prof"
+    out = tmp_path / "host_trace.json"
+    exp = make_experiment(
+        tmp_path,
+        {
+            "trace_export": str(out),
+            "profile_dir": str(prof),
+            "verbose": True,
+        },
+    )
+    exp.run()
+    assert out.exists()
+    doc = json.loads(out.read_text())
+    assert doc["traceEvents"]
+    text = capsys.readouterr().out
+    assert "paired trace artifacts" in text
+    assert str(out) in text and str(prof) in text
+    assert not getattr(exp, "_jax_trace_active", False)

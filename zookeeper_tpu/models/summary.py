@@ -179,16 +179,24 @@ def model_summary(
     flops = None
     if compute_flops:
         try:
+            from zookeeper_tpu.observability.ledger import default_ledger
+
             # Lower from the abstract eval_shape tree directly — no
-            # parameter materialization even at ImageNet scale.
+            # parameter materialization even at ImageNet scale. FLOPs
+            # extraction goes through the ONE shared cost_analysis
+            # wrapper (docs/DESIGN.md §14) — the same helper the
+            # program ledger, the serving engine, and bench.py use, so
+            # backend quirks (None / [dict] / missing keys) are
+            # handled in exactly one place.
             lowered = jax.jit(
                 lambda v, x: module.apply(v, x, training=False)
             ).lower(variables, x)
-            analysis = lowered.cost_analysis()
-            if isinstance(analysis, list):
-                analysis = analysis[0]
-            if analysis and "flops" in analysis:
-                flops = float(analysis["flops"])
+            flops = default_ledger().record(
+                "summary_forward",
+                f"{type(module).__name__}/b1x"
+                + "x".join(str(s) for s in input_shape),
+                lowered=lowered,
+            ).flops
         except Exception:
             flops = None
 

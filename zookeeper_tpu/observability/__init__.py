@@ -16,12 +16,39 @@ only, all zero-cost until opted in:
   ``/metrics``-``/statusz``-``/trace`` endpoint
   (``TrainingExperiment.metrics_port`` / ``ServingConfig.metrics_port``
   opt in).
+
+The device-side half (docs/DESIGN.md §14) rides the same substrate:
+
+- ``ledger`` — the process-global program ledger: every lower/compile
+  seam records identity key, XLA cost-analysis FLOPs/bytes, compile
+  wall time and compiled memory analysis; feeds the ``zk_train_mfu`` /
+  ``zk_serve_mfu`` gauges and a ``/statusz`` section.
+- ``watchdog`` — EWMA+MAD step-time anomaly detection over the
+  slab/step/dispatch duration streams (``step_time_anomaly`` /
+  ``recompile_detected`` events + counters).
+- ``device`` — the ``zk-device-probe`` ``memory_stats()`` poller
+  behind the live ``zk_hbm_*`` per-device gauges.
+- ``peaks`` — the hardware peak anchors (datasheet tables + the
+  measured-peak aggregation) shared with ``bench.py`` so live and
+  offline MFU divide by the same roofline.
 """
 
 from zookeeper_tpu.observability import trace
+from zookeeper_tpu.observability.device import (
+    DeviceProbe,
+    device_memory_stats,
+)
 from zookeeper_tpu.observability.export import (
     ObservabilityServer,
     render_prometheus,
+)
+from zookeeper_tpu.observability.ledger import (
+    LedgeredExecutable,
+    ProgramLedger,
+    cost_analysis_dict,
+    cost_flops,
+    default_ledger,
+    mfu,
 )
 from zookeeper_tpu.observability.registry import (
     Counter,
@@ -37,17 +64,27 @@ from zookeeper_tpu.observability.trace import (
     span,
     to_chrome_trace,
 )
+from zookeeper_tpu.observability.watchdog import StepTimeWatchdog
 
 __all__ = [
     "Counter",
+    "DeviceProbe",
     "Gauge",
     "Histogram",
+    "LedgeredExecutable",
     "MetricsRegistry",
     "ObservabilityServer",
+    "ProgramLedger",
+    "StepTimeWatchdog",
     "Tracer",
+    "cost_analysis_dict",
+    "cost_flops",
+    "default_ledger",
     "default_registry",
+    "device_memory_stats",
     "event",
     "export_chrome_trace",
+    "mfu",
     "render_prometheus",
     "span",
     "to_chrome_trace",

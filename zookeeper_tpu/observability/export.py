@@ -192,6 +192,17 @@ class ObservabilityServer:
         }
         for registry in self._registries:
             status["metrics"].update(registry.as_flat_dict())
+        # The program ledger renders on EVERY statusz (docs/DESIGN.md
+        # §14): which compiled programs exist, their FLOPs/memory, and
+        # what compilation cost — the device-side complement of the
+        # metric view. Import is local (export must stay importable
+        # even if the ledger module grows heavier deps).
+        try:
+            from zookeeper_tpu.observability.ledger import default_ledger
+
+            status["programs"] = default_ledger().as_status()
+        except Exception as e:  # a ledger bug must not 500 /statusz
+            status["programs"] = {"error": repr(e)}
         for name, provider in self._providers.items():
             try:
                 status[name] = provider()

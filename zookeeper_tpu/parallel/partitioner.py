@@ -23,6 +23,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from zookeeper_tpu.core import Field, component
+from zookeeper_tpu.observability.ledger import LedgeredExecutable
 from zookeeper_tpu.parallel.rules import PartitionRule, match_partition_rules
 
 
@@ -32,6 +33,27 @@ class Partitioner:
 
     def setup(self) -> None:
         """Create the mesh (if any). Idempotent."""
+
+    def _ledgered(self, kind: str, jitted: Any) -> LedgeredExecutable:
+        """Wrap a compiled-seam callable so its (lazy) lower + compile
+        is timed and recorded in the process program ledger
+        (docs/DESIGN.md §14): identity key, XLA cost-analysis FLOPs,
+        compile wall time, compiled memory analysis. The wrapper's
+        steady-state dispatch is the AOT-compiled executable — the
+        same program the jit would have cached, one attribute read
+        away."""
+        mesh = self.mesh
+        mesh_desc = (
+            "x".join(f"{k}:{v}" for k, v in mesh.shape.items())
+            if mesh is not None
+            else "1"
+        )
+        return LedgeredExecutable(
+            jitted,
+            kind=kind,
+            key=f"{type(self).__name__}/mesh={mesh_desc}",
+            attrs={"partitioner": type(self).__name__},
+        )
 
     @property
     def mesh(self) -> Optional[Mesh]:
@@ -126,7 +148,10 @@ class SingleDevicePartitioner(Partitioner):
     """Plain jit on the default device."""
 
     def compile_step(self, step_fn, state, *, donate_state: bool = True):
-        return jax.jit(step_fn, donate_argnums=(0,) if donate_state else ())
+        return self._ledgered(
+            "train_step",
+            jax.jit(step_fn, donate_argnums=(0,) if donate_state else ()),
+        )
 
     def compile_multi_step(
         self,
@@ -141,10 +166,12 @@ class SingleDevicePartitioner(Partitioner):
             for i, d in enumerate((donate_state, donate_slab))
             if d
         )
-        return jax.jit(multi_step_fn, donate_argnums=donate)
+        return self._ledgered(
+            "multi_step", jax.jit(multi_step_fn, donate_argnums=donate)
+        )
 
     def compile_eval(self, eval_fn, state):
-        return jax.jit(eval_fn)
+        return self._ledgered("eval_step", jax.jit(eval_fn))
 
     def compile_forward(self, forward_fn, variables, *, batch_rows=None):
         return jax.jit(forward_fn)
@@ -320,11 +347,14 @@ class MeshPartitioner(Partitioner):
         state_sh = self.state_sharding(state)
         batch_sh = self.batch_sharding()
         metrics_sh = NamedSharding(self.mesh, PartitionSpec())
-        return jax.jit(
-            self._with_activation_scope(step_fn),
-            in_shardings=(state_sh, batch_sh),
-            out_shardings=(state_sh, metrics_sh),
-            donate_argnums=(0,) if donate_state else (),
+        return self._ledgered(
+            "train_step",
+            jax.jit(
+                self._with_activation_scope(step_fn),
+                in_shardings=(state_sh, batch_sh),
+                out_shardings=(state_sh, metrics_sh),
+                donate_argnums=(0,) if donate_state else (),
+            ),
         )
 
     def compile_multi_step(
@@ -343,20 +373,26 @@ class MeshPartitioner(Partitioner):
         donate = tuple(
             i for i, d in enumerate((donate_state, donate_slab)) if d
         )
-        return jax.jit(
-            self._with_activation_scope(multi_step_fn),
-            in_shardings=(state_sh, slab_sh),
-            out_shardings=(state_sh, metrics_sh),
-            donate_argnums=donate,
+        return self._ledgered(
+            "multi_step",
+            jax.jit(
+                self._with_activation_scope(multi_step_fn),
+                in_shardings=(state_sh, slab_sh),
+                out_shardings=(state_sh, metrics_sh),
+                donate_argnums=donate,
+            ),
         )
 
     def compile_eval(self, eval_fn, state):
         state_sh = self.state_sharding(state)
         batch_sh = self.batch_sharding()
-        return jax.jit(
-            self._with_activation_scope(eval_fn),
-            in_shardings=(state_sh, batch_sh),
-            out_shardings=NamedSharding(self.mesh, PartitionSpec()),
+        return self._ledgered(
+            "eval_step",
+            jax.jit(
+                self._with_activation_scope(eval_fn),
+                in_shardings=(state_sh, batch_sh),
+                out_shardings=NamedSharding(self.mesh, PartitionSpec()),
+            ),
         )
 
     def variables_sharding(self, variables: Any) -> Any:

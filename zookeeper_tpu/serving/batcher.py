@@ -44,6 +44,7 @@ timing). Async mode adds one worker thread that dispatches whenever the
 largest bucket fills or the oldest request has waited ``max_delay_ms``.
 """
 
+import logging
 import threading
 import time
 from typing import Any, List, Optional, Tuple
@@ -54,6 +55,8 @@ from zookeeper_tpu.core import Field, component
 from zookeeper_tpu.observability import trace as _trace
 
 Array = Any
+
+logger = logging.getLogger(__name__)
 
 
 class RejectedError(RuntimeError):
@@ -459,12 +462,27 @@ class MicroBatcher:
         )
         try:
             with dispatch_span:
+                t0 = time.perf_counter()
                 batch = (
                     plan[0][1]
                     if len(plan) == 1
                     else np.concatenate([part for _, part in plan])
                 )
                 out = np.asarray(jax.device_get(self._engine.infer(batch)))
+                dispatch_s = time.perf_counter() - t0
+            # The device_get above bounds the dispatch honestly: feed
+            # the engine's serve watchdog + live MFU gauge (a no-op
+            # for engine doubles in tests that don't implement it).
+            # Suppressed: the inference already succeeded — a metrics
+            # failure must not fail the plan's requests.
+            observe = getattr(self._engine, "observe_dispatch", None)
+            if observe is not None:
+                try:
+                    observe(rows, dispatch_s)
+                except Exception:
+                    logger.warning(
+                        "observe_dispatch failed", exc_info=True
+                    )
             if self._metrics is not None:
                 self._metrics.record_dispatch(
                     rows, self._engine.bucket_for(rows)

@@ -140,6 +140,12 @@ class InferenceEngine:
         )
         object.__setattr__(self, "_cache", {})
         object.__setattr__(self, "_compile_count", 0)
+        # Recompile-watchdog state (docs/DESIGN.md §14): a rebind is a
+        # fresh program family — "warmed" starts over.
+        object.__setattr__(self, "_warmed", False)
+        object.__setattr__(self, "_recompiles_detected", 0)
+        object.__setattr__(self, "_flops_by_key", {})
+        object.__setattr__(self, "_last_dispatch_flops", None)
         return self
 
     def _place_variables(self, variables: Any) -> Any:
@@ -309,11 +315,26 @@ class InferenceEngine:
             shape = (bucket, seq_bucket, *self._input_shape[1:])
         return shape
 
-    def _compiled(self, bucket: int, seq_bucket: Optional[int], dtype):
+    def _compiled(
+        self,
+        bucket: int,
+        seq_bucket: Optional[int],
+        dtype,
+        *,
+        during_dispatch: bool = False,
+    ):
         """The AOT-compiled forward for one shape bucket, plus whether
         the OUTPUT carries the sequence axis (cache-keyed on bucket,
         dtype, and the partitioner's mesh — a rebound mesh must never
-        serve another mesh's executable)."""
+        serve another mesh's executable).
+
+        ``during_dispatch`` marks a compile triggered by ``infer``
+        rather than ``warmup()``: once the engine has been warmed, any
+        such compile is a serving stall that the bucket ladder was
+        supposed to prevent — it emits a ``recompile_detected`` trace
+        event and bumps ``zk_serving_recompiles_total`` so a recompile
+        eating tail latency is self-announcing instead of forensic
+        (the ``compile_count`` delta was only visible to tests)."""
         import jax
 
         self._require_bound()
@@ -321,6 +342,35 @@ class InferenceEngine:
         cached = self._cache.get(key)
         if cached is not None:
             return cached
+        if during_dispatch and getattr(self, "_warmed", False):
+            from zookeeper_tpu.observability.registry import default_registry
+
+            object.__setattr__(
+                self,
+                "_recompiles_detected",
+                getattr(self, "_recompiles_detected", 0) + 1,
+            )
+            default_registry().counter(
+                "zk_serving_recompiles_total",
+                help="post-warmup compiles triggered on the request "
+                "path (each one is a serving stall)",
+            ).inc()
+            _trace.event(
+                "recompile_detected",
+                attrs={
+                    "bucket": bucket,
+                    "seq_bucket": seq_bucket,
+                    "dtype": str(np.dtype(dtype)),
+                },
+            )
+            logger.warning(
+                "post-warmup recompile on the request path "
+                "(bucket=%d, seq=%s, dtype=%s): requests are stalling "
+                "on XLA — widen/rewarm the bucket ladder",
+                bucket,
+                seq_bucket,
+                np.dtype(dtype),
+            )
         apply_fn = self._apply_fn
 
         def forward(variables, x):
@@ -353,10 +403,35 @@ class InferenceEngine:
         dummy = jax.ShapeDtypeStruct(
             self._bucket_shape(bucket, seq_bucket), np.dtype(dtype)
         )
-        compiled = (jitted.lower(self._variables, dummy).compile(),
-                    out_tracks_seq)
+        t0 = time.perf_counter()
+        lowered = jitted.lower(self._variables, dummy)
+        t1 = time.perf_counter()
+        executable = lowered.compile()
+        t2 = time.perf_counter()
+        compiled = (executable, out_tracks_seq)
         self._cache[key] = compiled
         object.__setattr__(self, "_compile_count", self._compile_count + 1)
+        # Ledger row (docs/DESIGN.md §14): this bucket's identity,
+        # FLOPs/bytes, compile wall time, and memory analysis — the
+        # per-program accounting behind zk_serve_mfu and /statusz.
+        from zookeeper_tpu.observability.ledger import default_ledger
+
+        record = default_ledger().record(
+            "serve_forward",
+            f"{type(self._partitioner).__name__}/b{bucket}"
+            + (f"s{seq_bucket}" if seq_bucket is not None else "")
+            + f"/{np.dtype(dtype)}",
+            lowered=lowered,
+            compiled=executable,
+            lower_ms=(t1 - t0) * 1e3,
+            compile_ms=(t2 - t1) * 1e3,
+            attrs={
+                "bucket": bucket,
+                "seq_bucket": seq_bucket,
+                "during_dispatch": bool(during_dispatch),
+            },
+        )
+        self._flops_by_key[key] = record.flops
         return compiled
 
     def warmup(self) -> int:
@@ -367,7 +442,68 @@ class InferenceEngine:
         for bucket in self.batch_buckets:
             for seq in seqs:
                 self._compiled(int(bucket), seq, self._dtype)
+        # From here on, a request-path compile is a detected recompile.
+        object.__setattr__(self, "_warmed", True)
         return len(self._cache)
+
+    @property
+    def recompiles_detected(self) -> int:
+        """Post-warmup compiles triggered on the request path (each
+        one stalled requests on XLA); mirrored to the
+        ``zk_serving_recompiles_total`` counter and a
+        ``recompile_detected`` trace event as they happen."""
+        return getattr(self, "_recompiles_detected", 0)
+
+    def observe_dispatch(self, rows: int, seconds: float) -> None:
+        """Record one completed (readback-bounded) dispatch: feed the
+        serve-dispatch watchdog and publish ``zk_serve_mfu`` /
+        ``zk_serve_dispatch_ms``. Called by the MicroBatcher after its
+        ``device_get`` — the only place dispatch wall time is honest
+        (``infer`` returns an un-synced device array). The FLOPs are
+        the LAST dispatched bucket's ledger row; with the batcher's
+        single dispatch path the pairing is exact. ``rows`` (occupied,
+        pre-padding) renders as ``zk_serve_dispatch_rows`` — it does
+        NOT scale the MFU: the device executes the padded bucket, so
+        bucket FLOPs over wall time IS hardware utilization, and the
+        rows gauge is how far from it request goodput sits."""
+        from zookeeper_tpu.observability import ledger as _ledger
+        from zookeeper_tpu.observability.registry import default_registry
+
+        if seconds <= 0:
+            return
+        dog = getattr(self, "_dispatch_watchdog", None)
+        if dog is None:
+            from zookeeper_tpu.observability.watchdog import StepTimeWatchdog
+
+            # Same 5ms-excess false-positive floor as training; see
+            # docs/DESIGN.md §14.
+            dog = StepTimeWatchdog("serve_dispatch", min_excess_s=0.005)
+            object.__setattr__(self, "_dispatch_watchdog", dog)
+        dog.observe(seconds)
+        reg = default_registry()
+        reg.gauge(
+            "zk_serve_dispatch_ms",
+            help="last coalesced dispatch wall time (readback-bounded)",
+        ).set(seconds * 1e3)
+        reg.gauge(
+            "zk_serve_dispatch_rows",
+            help="occupied (pre-padding) rows of the last coalesced "
+            "dispatch — goodput context for the padded-bucket MFU",
+        ).set(max(0, int(rows)))
+        flops = getattr(self, "_last_dispatch_flops", None)
+        peak = getattr(self, "_mfu_peak", None)
+        if peak is None:
+            from zookeeper_tpu.observability.peaks import reference_peak_flops
+
+            peak = reference_peak_flops()[0]
+            object.__setattr__(self, "_mfu_peak", peak)
+        value = _ledger.mfu(flops, seconds, peak)
+        reg.gauge(
+            "zk_serve_mfu",
+            help="last dispatch: ledger FLOPs / wall time / reference "
+            "bf16 peak (-1 = cost analysis unavailable)",
+            initial=-1,
+        ).set(value if value is not None else -1)
 
     # -- serving ---------------------------------------------------------
 
@@ -401,7 +537,19 @@ class InferenceEngine:
         if any(p != (0, 0) for p in pad):
             x = np.pad(x, pad)  # zero padding: row-independent forward
         x = x.astype(self._dtype, copy=False)
-        compiled, out_tracks_seq = self._compiled(bucket, seq_bucket, x.dtype)
+        compiled, out_tracks_seq = self._compiled(
+            bucket, seq_bucket, x.dtype, during_dispatch=True
+        )
+        # The bucket this dispatch runs under, for observe_dispatch's
+        # MFU pairing (single dispatch path: the batcher's readback
+        # immediately follows this infer).
+        object.__setattr__(
+            self,
+            "_last_dispatch_flops",
+            self._flops_by_key.get(
+                (bucket, seq_bucket, str(x.dtype), self._partitioner.mesh)
+            ),
+        )
         with _trace.span(
             "engine_infer",
             attrs=(

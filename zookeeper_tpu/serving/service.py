@@ -198,6 +198,9 @@ class ServingConfig(Experiment):
             "weights": self.weights,
             "batch_buckets": [int(b) for b in self.engine.batch_buckets],
             "compiles": self.engine.compile_count,
+            # Post-warmup request-path compiles: nonzero means traffic
+            # is stalling on XLA (the recompile watchdog fired).
+            "recompiles_detected": self.engine.recompiles_detected,
             "queue_rows": self.batcher.queue_rows,
             "watcher_alive": (
                 watcher.alive if watcher is not None else None
@@ -208,7 +211,10 @@ class ServingConfig(Experiment):
         }
 
     def _start_obs_server(self):
-        from zookeeper_tpu.observability import ObservabilityServer
+        from zookeeper_tpu.observability import (
+            DeviceProbe,
+            ObservabilityServer,
+        )
         from zookeeper_tpu.observability.registry import default_registry
 
         server = ObservabilityServer(
@@ -218,6 +224,12 @@ class ServingConfig(Experiment):
         )
         server.start()
         object.__setattr__(self, "obs_server", server)
+        # Live HBM gauges for the serving process (zk-device-probe):
+        # eager first poll so zk_hbm_* renders from the first scrape.
+        probe = DeviceProbe()
+        probe.poll_once()
+        probe.start()
+        object.__setattr__(self, "obs_probe", probe)
         if self.verbose:
             print(
                 f"observability endpoint: {server.url}/metrics",
@@ -270,6 +282,10 @@ class ServingConfig(Experiment):
         if server is not None:
             object.__setattr__(self, "obs_server", None)
             server.stop()
+        probe = getattr(self, "obs_probe", None)
+        if probe is not None:
+            object.__setattr__(self, "obs_probe", None)
+            probe.stop()
 
     def _teardown_service(self, *, suppress: bool = False) -> None:
         """The ONE teardown sequence (watcher daemon, /metrics port,

@@ -318,7 +318,10 @@ class TrainingExperiment(Experiment):
             self._trace_enabled_here = not _obs_trace.enabled()
             _obs_trace.enable()
         if self.metrics_port >= 0:
-            from zookeeper_tpu.observability import ObservabilityServer
+            from zookeeper_tpu.observability import (
+                DeviceProbe,
+                ObservabilityServer,
+            )
             from zookeeper_tpu.observability.registry import default_registry
 
             server = ObservabilityServer(
@@ -328,6 +331,14 @@ class TrainingExperiment(Experiment):
             )
             server.start()
             self.obs_server = server
+            # Live HBM gauges ride the endpoint's lifetime: an eager
+            # first poll so zk_hbm_* exists from the first scrape, then
+            # the zk-device-probe daemon keeps it fresh. Allocator
+            # counters only — the probe never dispatches device work.
+            probe = DeviceProbe()
+            probe.poll_once()
+            probe.start()
+            self.obs_probe = probe
             self._log(f"observability endpoint: {server.url}/metrics")
 
     def _finish_host_trace(self) -> None:
@@ -339,6 +350,18 @@ class TrainingExperiment(Experiment):
                 f"host trace: {n} events -> {self.trace_export} "
                 "(open in Perfetto)"
             )
+            if self.profile_dir is not None:
+                # The docs §13 merge recipe, automated: this teardown
+                # already closed any open device capture window
+                # (_abort_jax_trace runs first), so both halves of the
+                # timeline are final and PAIRED here — no hand-merging,
+                # one log line says exactly what to open side by side.
+                self._log(
+                    "paired trace artifacts: host spans "
+                    f"{self.trace_export} (Chrome JSON) + device xplane "
+                    f"{self.profile_dir} — load both in Perfetto and "
+                    "align on wall time (docs/DESIGN.md §13)"
+                )
             if getattr(self, "_trace_enabled_here", False):
                 _obs_trace.disable()
 
@@ -347,6 +370,143 @@ class TrainingExperiment(Experiment):
         if server is not None:
             self.obs_server = None
             server.stop()
+        probe = getattr(self, "obs_probe", None)
+        if probe is not None:
+            self.obs_probe = None
+            probe.stop()
+
+    # -- step-time watchdog + live MFU (docs/DESIGN.md §14) --------------
+
+    def _watchdog(self, stream: str):
+        """Per-stream anomaly watchdog, lazily created, counters in
+        this experiment's registry."""
+        dogs = getattr(self, "_watchdogs", None)
+        if dogs is None:
+            dogs = {}
+            self._watchdogs = dogs
+        dog = dogs.get(stream)
+        if dog is None:
+            from zookeeper_tpu.observability.watchdog import StepTimeWatchdog
+
+            # 5ms excess floor: a flagged straggler must be worth a
+            # human's attention on any backend — sub-ms host jitter on
+            # fast CPU steps never is (docs/DESIGN.md §14 policy).
+            dog = StepTimeWatchdog(
+                stream, min_excess_s=0.005, registry=self.obs_registry
+            )
+            dogs[stream] = dog
+        return dog
+
+    def _obs_reset_timers(self) -> None:
+        """Start-of-run timer state (one dict, not Fields: pure
+        runtime)."""
+        self._obs_timer = {
+            "iter_t": None,
+            "iter_dirty": False,
+            "sync_t": None,
+            "sync_step": None,
+            "sync_dirty": False,
+        }
+        self._mfu_peaks = None
+
+    def _obs_mark_stall(self, sync: bool = True) -> None:
+        """Mark the current timing intervals polluted by a known
+        non-step phase (checkpoint save, profiler window open/close,
+        epoch boundary with validation): the watchdogs must not read a
+        deliberate stall as a straggler — the false-positive policy of
+        docs/DESIGN.md §14. ``sync=False`` marks only the
+        inter-dispatch stream (a metrics readback inflates the
+        iteration it rides in, but IS the sync stream's clean
+        boundary)."""
+        timer = getattr(self, "_obs_timer", None)
+        if timer is not None:
+            timer["iter_dirty"] = True
+            if sync:
+                timer["sync_dirty"] = True
+
+    def _obs_iteration_end(self, k: int, global_step: int) -> None:
+        """End of one train-loop iteration (k steps dispatched): feed
+        the host-side inter-dispatch duration stream. This wall time is
+        data wait + dispatch Python — an INPUT/HOST straggler signal
+        (the device runs behind asynchronously; honest device-throttled
+        timing comes from the sync points below)."""
+        timer = self._obs_timer
+        t = time.perf_counter()
+        prev = timer["iter_t"]
+        timer["iter_t"] = t
+        if timer["iter_dirty"]:
+            timer["iter_dirty"] = False
+            return
+        if prev is not None:
+            self._watchdog("train_dispatch").observe(
+                (t - prev) / max(1, k), step=global_step
+            )
+
+    def _obs_sync_point(self, global_step: int, program: Any) -> None:
+        """A metrics readback just completed — a true completion
+        barrier for every step up to ``global_step``. The interval
+        since the previous barrier is honest device-throttled time:
+        feed the step-time watchdog and publish the live gauges
+        (``zk_train_step_time_ms``, ``zk_train_mfu`` — ledger FLOPs /
+        measured step time / reference peak, -1 while unknown)."""
+        timer = getattr(self, "_obs_timer", None)
+        if timer is None:
+            return
+        t = time.perf_counter()
+        prev_t, prev_step = timer["sync_t"], timer["sync_step"]
+        timer["sync_t"], timer["sync_step"] = t, global_step
+        if timer["sync_dirty"]:
+            timer["sync_dirty"] = False
+            return
+        if prev_t is None or prev_step is None or global_step <= prev_step:
+            return
+        per_step = (t - prev_t) / (global_step - prev_step)
+        self._watchdog("train_step").observe(per_step, step=global_step)
+        self._publish_mfu(per_step, program)
+
+    def _publish_mfu(self, per_step_seconds: float, program: Any) -> None:
+        from zookeeper_tpu.observability import ledger as _ledger
+
+        reg = self.obs_registry
+        reg.gauge(
+            "zk_train_step_time_ms",
+            help="measured steady-state seconds/step (readback-bounded)",
+        ).set(per_step_seconds * 1e3)
+        entry = getattr(program, "ledger_entry", None)
+        flops = getattr(entry, "flops", None)
+        per_step_flops = (
+            flops / max(1, int(entry.attrs.get("steps", self.unroll)))
+            if flops is not None and entry.kind == "multi_step"
+            else flops
+        )
+        peaks = getattr(self, "_mfu_peaks", None)
+        if peaks is None:
+            from zookeeper_tpu.observability.peaks import (
+                reference_int8_peak_flops,
+                reference_peak_flops,
+            )
+
+            peaks = (
+                reference_peak_flops()[0],
+                reference_int8_peak_flops()[0]
+                if getattr(self.model, "binary_compute", None) == "int8"
+                else None,
+            )
+            self._mfu_peaks = peaks
+        value = _ledger.mfu(per_step_flops, per_step_seconds, peaks[0])
+        reg.gauge(
+            "zk_train_mfu",
+            help="ledger FLOPs / measured step time / reference bf16 "
+            "peak (-1 = cost analysis or timing unavailable)",
+            initial=-1,
+        ).set(value if value is not None else -1)
+        if peaks[1] is not None:
+            value8 = _ledger.mfu(per_step_flops, per_step_seconds, peaks[1])
+            reg.gauge(
+                "zk_train_mfu_int8",
+                help="same step against the int8 MXU reference peak",
+                initial=-1,
+            ).set(value8 if value8 is not None else -1)
 
     # -- jax profiler window (device trace) ------------------------------
 
@@ -455,15 +615,23 @@ class TrainingExperiment(Experiment):
             {f"train/{k}": v for k, v in row.items()},
         )
 
-    def _mark_first_step(self, metrics) -> None:
+    def _mark_first_step(self, metrics, global_step: int = 0) -> None:
         """Timestamp the completion of THIS RUN's first train step (one
         deliberate device sync, once per run): the supervisor reads it
-        to report restore latency (restart -> first post-resume step)."""
+        to report restore latency (restart -> first post-resume step).
+        The same barrier seeds the step-time stream's baseline — the
+        first honest post-compile sync, so a ``log_every=0`` run can
+        still publish ``zk_train_mfu`` from its epoch-end readback."""
         if getattr(self, "first_step_at", None) is None:
             import jax
 
             jax.block_until_ready(metrics["loss"])
             self.first_step_at = time.perf_counter()
+            timer = getattr(self, "_obs_timer", None)
+            if timer is not None:
+                timer["sync_t"] = self.first_step_at
+                timer["sync_step"] = int(global_step)
+                timer["sync_dirty"] = False
 
     def _boundary_check(self, state, global_step: int) -> None:
         """Preemption check at a safe boundary (a step/slab end, where
@@ -566,16 +734,25 @@ class TrainingExperiment(Experiment):
                 step_idx >= p_start or step_idx + k >= spe
             ):
                 self._start_jax_trace()
+                self._obs_mark_stall()
                 tracing, trace_first = True, step_idx
             with slab_annotation(slab_idx, num_steps=k), _obs_trace.span(
                 "dispatch", step=epoch * spe + step_idx, slab=slab_idx
             ):
                 state, metrics = multi_step(state, slab)
+            entry = getattr(multi_step, "ledger_entry", None)
+            if entry is not None and "steps" not in entry.attrs:
+                # The first dispatch is the one that compiled the
+                # recorded program, so THIS slab's size is the FLOPs
+                # divisor — the configured unroll is wrong when the
+                # first slab is partial (mid-epoch resume, spe<unroll).
+                entry.attrs["steps"] = k
             accum.append(metrics)
-            self._mark_first_step(metrics)
+            self._mark_first_step(metrics, epoch * spe + step_idx + k)
             if tracing and step_idx + k > p_stop:
                 jax.block_until_ready(metrics["loss"])
                 self._stop_jax_trace()
+                self._obs_mark_stall()
                 profiling = tracing = False
                 self._log_profile_breakdown(step_idx + k - trace_first)
             if any(
@@ -587,6 +764,7 @@ class TrainingExperiment(Experiment):
                     slab=slab_idx,
                 ):
                     self.checkpointer.save(state)
+                self._obs_mark_stall()
             if self.log_every:
                 bounds = [
                     s
@@ -601,6 +779,14 @@ class TrainingExperiment(Experiment):
                         slab=slab_idx,
                     ):
                         hm = jax.device_get(metrics)
+                    # The readback is the step-time stream's honest
+                    # completion barrier (and it pollutes the current
+                    # inter-dispatch interval, which is why the
+                    # dispatch stream skips this iteration).
+                    self._obs_mark_stall(sync=False)
+                    self._obs_sync_point(
+                        epoch * spe + step_idx + k, multi_step
+                    )
                     self._check_halt(hm, epoch * spe + step_idx + k)
                     for s in bounds:
                         self._log_step_scalars(
@@ -615,6 +801,7 @@ class TrainingExperiment(Experiment):
             # here is a valid exact-resume point (same quantization as
             # step-cadence checkpoints).
             self._boundary_check(state, epoch * spe + step_idx)
+            self._obs_iteration_end(k, epoch * spe + step_idx)
         return state, step_idx - start_b
 
     def run(self) -> Dict[str, List[Dict[str, float]]]:
@@ -769,6 +956,8 @@ class TrainingExperiment(Experiment):
             )
             # Per-run restore-latency probe (read by run_with_recovery).
             self.first_step_at = None
+            # Step-time watchdog + live-MFU timer state (docs §14).
+            self._obs_reset_timers()
             # Per-run preemption-save wait probe (ms spent draining the
             # in-flight async checkpoint write before the final sync save;
             # 0.0 in sync mode — also read by run_with_recovery).
@@ -809,15 +998,19 @@ class TrainingExperiment(Experiment):
                             break
                         if profiling and step_idx == p_start:
                             self._start_jax_trace()
+                            self._obs_mark_stall()
                         with _obs_trace.span(
                             "dispatch", step=epoch * spe + step_idx
                         ):
                             state, metrics = train_step(state, batch)
                         accum.append(metrics)
-                        self._mark_first_step(metrics)
+                        self._mark_first_step(
+                            metrics, epoch * spe + step_idx + 1
+                        )
                         if profiling and step_idx == p_stop:
                             jax.block_until_ready(metrics["loss"])
                             self._stop_jax_trace()
+                            self._obs_mark_stall()
                             profiling = False
                             # Steps p_start..p_stop run INSIDE the trace
                             # window, inclusive on both ends.
@@ -828,6 +1021,7 @@ class TrainingExperiment(Experiment):
                                 step=epoch * spe + step_idx + 1,
                             ):
                                 self.checkpointer.save(state)
+                            self._obs_mark_stall()
                         if self.log_every and (step_idx + 1) % self.log_every == 0:
                             # Per-step scalars ride the host pull that log_every
                             # already paid for — finer than epoch granularity at
@@ -836,6 +1030,10 @@ class TrainingExperiment(Experiment):
                                 "readback", step=epoch * spe + step_idx + 1
                             ):
                                 hm = jax.device_get(metrics)
+                            self._obs_mark_stall(sync=False)
+                            self._obs_sync_point(
+                                epoch * spe + step_idx + 1, train_step
+                            )
                             self._check_halt(hm, epoch * spe + step_idx + 1)
                             self._log_step_scalars(
                                 epoch, step_idx, spe,
@@ -843,6 +1041,9 @@ class TrainingExperiment(Experiment):
                             )
                         self._boundary_check(
                             state, epoch * spe + step_idx + 1
+                        )
+                        self._obs_iteration_end(
+                            1, epoch * spe + step_idx + 1
                         )
                     steps_trained = len(accum)
                 # One host sync per epoch: pull all accumulated device scalars
@@ -855,6 +1056,11 @@ class TrainingExperiment(Experiment):
                     "readback", step=epoch * spe + start_b + steps_trained
                 ):
                     host_accum = jax.device_get(accum)
+                self._obs_mark_stall(sync=False)
+                self._obs_sync_point(
+                    epoch * spe + start_b + steps_trained,
+                    multi_step if multi_step is not None else train_step,
+                )
                 self._check_halt(
                     host_accum, epoch * spe + start_b + steps_trained
                 )
@@ -901,6 +1107,8 @@ class TrainingExperiment(Experiment):
                         self.loader, "validation", eval_step, state,
                         batch_sharding, epoch=epoch,
                     ) or None
+                    # Validation is a deliberate pause, not step time.
+                    self._obs_mark_stall()
                     if vmetrics is not None:
                         history["validation"].append(vmetrics)
                         line += (
@@ -961,6 +1169,7 @@ class TrainingExperiment(Experiment):
                             "checkpoint", step=(epoch + 1) * spe
                         ):
                             self.checkpointer.save(state, metrics=scored)
+                        self._obs_mark_stall()
 
                 if self.early_stop_metric is not None and scored is not None:
                     if self.early_stop_metric not in scored:
