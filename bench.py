@@ -749,6 +749,135 @@ def measure_checkpoint_stall(env=None):
     }
 
 
+def measure_decode_throughput(env=None):
+    """``ZK_BENCH_DECODE=1`` leg: tokens/s/chip and TTFT percentiles of
+    the continuous-batching decode engine under MIXED prefill/decode
+    traffic (docs/DESIGN.md §15).
+
+    The workload is the steady-state serving shape: many more requests
+    than slots, submitted up front, so after the first cohort every
+    prefill dispatch (a finished stream's slot being REFILLED) lands
+    between decode dispatches of the still-active streams — prefill and
+    decode interleave on one device exactly as they do in production.
+    The whole run is asserted compile-free after warmup (a recompile
+    would invalidate the numbers AND the engine contract).
+
+    Metrics: ``serve_decode_tokens_per_sec_per_chip`` (generated tokens
+    over the serve wall time, per chip), ``decode_ttft_p50/p99_ms``
+    (submit-to-first-token; p99 is the interactive-latency gate),
+    ``decode_token_p50_ms`` (one decode dispatch = one token for every
+    active slot), ``decode_prefill_p50_ms``, and the slot-refill count.
+
+    Knobs: ``ZK_BENCH_DECODE_REQUESTS`` (default 64),
+    ``ZK_BENCH_DECODE_SLOTS`` (default 8),
+    ``ZK_BENCH_DECODE_NEW_TOKENS`` (per-request budget, default 32),
+    ``ZK_BENCH_DECODE_PROMPT`` (max prompt length, default 32),
+    ``ZK_BENCH_DECODE_LAYERS``/``_DMODEL``/``_HEADS`` (model geometry,
+    default 4/256/4 — small enough to run everywhere, big enough that
+    the decode dispatch is device work rather than host overhead)."""
+    import numpy as np
+
+    from zookeeper_tpu.core import configure
+    from zookeeper_tpu.models import TransformerLM
+    from zookeeper_tpu.serving.decode import (
+        DecodeEngine,
+        DecodeMetrics,
+        DecodeScheduler,
+    )
+
+    env = os.environ if env is None else env
+    n_requests = int(env.get("ZK_BENCH_DECODE_REQUESTS", "64"))
+    slots = int(env.get("ZK_BENCH_DECODE_SLOTS", "8"))
+    new_tokens = int(env.get("ZK_BENCH_DECODE_NEW_TOKENS", "32"))
+    max_prompt = int(env.get("ZK_BENCH_DECODE_PROMPT", "32"))
+    num_layers = int(env.get("ZK_BENCH_DECODE_LAYERS", "4"))
+    d_model = int(env.get("ZK_BENCH_DECODE_DMODEL", "256"))
+    num_heads = int(env.get("ZK_BENCH_DECODE_HEADS", "4"))
+    vocab = 512
+    # Positional capacity: prompts + budgets must fit with headroom.
+    seq_len = max(128, 2 * (max_prompt + new_tokens))
+
+    model = TransformerLM()
+    configure(
+        model,
+        {
+            "num_layers": num_layers,
+            "d_model": d_model,
+            "num_heads": num_heads,
+            "max_seq_len": seq_len,
+            # Dense prefill: at <= max_prompt tokens the flash kernels
+            # buy nothing (and interpret-mode Pallas would dominate
+            # off-TPU); the decode dispatch is cached_attention either
+            # way.
+            "attention": "dense",
+        },
+        name="decode_bench_model",
+    )
+    module = model.build((seq_len,), vocab)
+    params, model_state = model.initialize(module, (seq_len,), seed=0)
+    engine = DecodeEngine()
+    configure(
+        engine,
+        {
+            "slots": slots,
+            "seq_buckets": (max_prompt,),
+            "kv_capacity": seq_len,
+        },
+        name="decode_bench_engine",
+    )
+    engine.bind(module, params, model_state)
+    engine.warmup()
+    warm_compiles = engine.compile_count
+    metrics = DecodeMetrics()
+    configure(metrics, {}, name="decode_bench_metrics")
+    scheduler = DecodeScheduler()
+    configure(scheduler, {"max_new_tokens": new_tokens}, name="decode_bench_sched")
+    scheduler.bind(engine, metrics=metrics)
+
+    rng = np.random.default_rng(0)
+    prompts = [
+        rng.integers(1, vocab, size=int(rng.integers(1, max_prompt + 1)))
+        .astype(np.int32)
+        for _ in range(n_requests)
+    ]
+    t0 = time.perf_counter()
+    streams = [scheduler.submit(p) for p in prompts]
+    scheduler.drain()
+    dt = time.perf_counter() - t0
+    tokens = sum(int(s.result().shape[0]) for s in streams)
+    if engine.compile_count != warm_compiles:
+        raise RuntimeError(
+            f"decode leg recompiled mid-traffic ({warm_compiles} -> "
+            f"{engine.compile_count}); the throughput numbers are invalid."
+        )
+    # Per-chip means per chip the engine actually SERVES on (the
+    # default bind: one device) — dividing by the host's device_count
+    # would make the gated key depend on idle-host topology, an 8x
+    # phantom swing between a 1-chip and an 8-chip runner.
+    mesh = engine._partitioner.mesh
+    n_chips = int(mesh.size) if mesh is not None else 1
+    snap = metrics.snapshot()
+    return {
+        "serve_decode_tokens_per_sec_per_chip": round(
+            tokens / dt / n_chips, 1
+        ),
+        "decode_ttft_p50_ms": round(snap.get("ttft_p50_ms", -1.0), 3),
+        "decode_ttft_p99_ms": round(snap.get("ttft_p99_ms", -1.0), 3),
+        "decode_token_p50_ms": round(snap.get("token_p50_ms", -1.0), 3),
+        "decode_prefill_p50_ms": round(snap.get("prefill_p50_ms", -1.0), 3),
+        # Informational context (never gates): workload + refill shape.
+        "decode_requests": n_requests,
+        "decode_slots": slots,
+        "decode_new_tokens": new_tokens,
+        # Admissions beyond the first slot-array cohort = slots that
+        # were REFILLED mid-traffic without a drain or recompile.
+        "decode_refills": max(
+            0, int(snap["requests_total"]) - min(slots, n_requests)
+        ),
+        "decode_generated_tokens": tokens,
+    }
+
+
 def measure_trace_overhead(env=None):
     """``ZK_BENCH_OBS=1`` leg: the host-tracing cost on the step-time
     anchor — the observability layer's acceptance number
@@ -1623,6 +1752,21 @@ def main(argv=None):
             )
             ckpt_metrics = None
 
+    # Decode-serving leg (env-gated: a full continuous-batching serve of
+    # ZK_BENCH_DECODE_REQUESTS streams): tokens/s/chip + TTFT p99 under
+    # mixed prefill/decode traffic, compile-free-after-warmup asserted.
+    decode_metrics = None
+    if _env_flag(os.environ, "ZK_BENCH_DECODE"):
+        try:
+            decode_metrics = measure_decode_throughput()
+        except Exception as e:  # never lose the primary metric
+            print(
+                f"decode leg failed ({e}); omitting decode_*",
+                file=sys.stderr,
+                flush=True,
+            )
+            decode_metrics = None
+
     # Observability-overhead leg (env-gated: interleaved traced/untraced
     # step chains): host-span tracing cost on the step-time anchor —
     # the <= 2% budget docs/DESIGN.md §13 commits to.
@@ -1662,6 +1806,8 @@ def main(argv=None):
         extras.update(shed_metrics)
     if ckpt_metrics is not None:
         extras.update(ckpt_metrics)
+    if decode_metrics is not None:
+        extras.update(decode_metrics)
     if obs_metrics is not None:
         extras.update(obs_metrics)
     if loop_time is not None:

@@ -1,0 +1,42 @@
+"""Token-streaming LM serving: the continuous-batching decode engine
+behind a CLI task.
+
+The deployment pairing for ``lm_experiment.py``: train there, stream
+tokens here — the interactive half of the north star (train -> ship
+weights -> paged-KV continuous-batching decode) in two commands::
+
+    # 1) train a small LM and export/checkpoint it:
+    python examples/lm_experiment.py TrainLM epochs=3 \\
+        checkpointer.directory=/tmp/lm_ckpt
+
+    # 2) stream generations through the decode engine and report
+    #    tokens/s + TTFT percentiles (one JSON line):
+    python examples/serve_lm.py ServeLM checkpoint=/tmp/lm_ckpt \\
+        seq_len=64 vocab_size=61
+
+    # Fresh-init smoke (no training run needed — compile/latency only):
+    python examples/serve_lm.py ServeLM requests=16
+
+    # More slots / longer generations / a live /metrics + /statusz
+    # endpoint:
+    python examples/serve_lm.py ServeLM engine.slots=16 new_tokens=64 \\
+        metrics_port=8080
+
+Every request rides the REAL serving path — bucketed prefill into a
+KV slot, slot-refill continuous batching, per-token streaming — so the
+reported numbers are the decode subsystem's, not a synthetic loop's
+(docs/DESIGN.md §15).
+"""
+
+from zookeeper_tpu import cli, task
+from zookeeper_tpu.serving import LMServingConfig
+
+
+@task
+class ServeLM(LMServingConfig):
+    """Serve a causal LM through the continuous-batching decode engine
+    (synthetic deterministic prompt stream; see LMServingConfig)."""
+
+
+if __name__ == "__main__":
+    cli()

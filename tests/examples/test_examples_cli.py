@@ -233,3 +233,59 @@ def test_serve_classifier_end_to_end(tmp_path):
     assert abs(result["accuracy"] - trained_acc) < 0.05, (result, trained_acc)
     assert result["examples"] == 359  # full validation split coverage
     assert result["latency_p50_ms"] > 0.0
+
+
+def test_serve_lm_fresh_init_smoke():
+    """The decode subsystem from its CLI: fresh-init weights, a real
+    continuous-batching serve (requests > slots => slot refills), one
+    JSON result line with the decode metrics family, zero recompiles
+    after warmup."""
+    import json
+
+    out = run_example(
+        "serve_lm.py", "ServeLM",
+        "model.num_layers=2", "model.d_model=32", "model.num_heads=4",
+        "model.attention=dense", "seq_len=64", "vocab_size=50",
+        "engine.slots=2", "engine.seq_buckets=(8,)",
+        "requests=5", "max_prompt=8", "new_tokens=4",
+    )
+    result = json.loads(out.strip().splitlines()[-1])
+    assert result["recompiles_after_warmup"] == 0
+    assert result["compiles"] == 2  # one prefill bucket pair + decode
+    assert result["requests"] == 5
+    assert result["generated_tokens"] == 5 * 4
+    assert result["tokens_per_sec"] > 0
+    assert result["ttft_p99_ms"] > 0
+    assert result["token_p50_ms"] > 0
+
+
+def test_train_then_serve_lm_end_to_end(tmp_path):
+    """The token-streaming north-star loop from the CLI: TrainLM into a
+    checkpointer directory, then ServeLM streams generations from the
+    shipped weights through the paged-KV decode engine."""
+    import json
+
+    ckpt = str(tmp_path / "lm_ckpt")
+    out = run_example(
+        "lm_experiment.py", "TrainLM",
+        "epochs=2", "seq_len=32", "batch_size=16",
+        "loader.dataset.num_train_examples=128",
+        "loader.dataset.vocab_size=31",
+        "model.num_layers=2", "model.d_model=64", "model.num_heads=2",
+        "model.attention=dense",
+        f"checkpointer.directory='{ckpt}'",
+    )
+    assert "epoch 2/2" in out
+    out = run_example(
+        "serve_lm.py", "ServeLM",
+        f"checkpoint='{ckpt}'",
+        "model.num_layers=2", "model.d_model=64", "model.num_heads=2",
+        "model.attention=dense", "seq_len=32", "vocab_size=31",
+        "engine.slots=2", "engine.seq_buckets=(8,16)",
+        "requests=4", "max_prompt=8", "new_tokens=6",
+    )
+    result = json.loads(out.strip().splitlines()[-1])
+    assert result["recompiles_after_warmup"] == 0
+    assert result["requests"] == 4
+    assert result["generated_tokens"] == 4 * 6
+    assert result["weights"] == "auto"

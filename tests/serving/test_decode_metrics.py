@@ -1,0 +1,126 @@
+"""DecodeMetrics: recorder exactness, snapshot percentiles, Prometheus
+exposition of the full ``zk_decode_*`` family, and in-place reset (the
+live-scrape identity contract ServingMetrics established)."""
+
+import re
+
+import numpy as np
+import pytest
+
+from zookeeper_tpu.core import configure
+from zookeeper_tpu.observability.export import render_prometheus
+from zookeeper_tpu.serving.decode import DecodeMetrics
+
+pytestmark = pytest.mark.serving
+
+_LINE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? [^ ]+$")
+
+
+def make_metrics(**conf):
+    m = DecodeMetrics()
+    configure(m, dict(conf), name="metrics")
+    return m
+
+
+def test_recorders_and_totals():
+    m = make_metrics()
+    m.record_prefill(5.0, 2)
+    m.record_first_tokens(2)
+    m.record_ttft(12.0)
+    m.record_ttft(18.0)
+    m.record_decode_step(1.5, 2)
+    m.record_decode_step(2.5, 1)
+    m.record_rejected()
+    m.record_deadline_expired()
+    m.record_worker_restart()
+    m.record_weight_swap(step=42)
+    t = m.totals
+    assert t["tokens_total"] == 2 + 3  # first tokens + decode tokens
+    assert t["requests_total"] == 2
+    assert t["prefills_total"] == 1
+    assert t["decode_steps_total"] == 2
+    assert t["rejected_total"] == 1
+    assert t["deadline_expired_total"] == 1
+    assert t["worker_restarts_total"] == 1
+    assert t["weight_swaps_total"] == 1
+
+
+def test_snapshot_percentiles_exact():
+    m = make_metrics()
+    for v in (1.0, 2.0, 3.0, 4.0):
+        m.record_decode_step(v, 1)
+    snap = m.snapshot()
+    assert snap["token_p50_ms"] == pytest.approx(np.percentile([1, 2, 3, 4], 50))
+    assert snap["token_p99_ms"] == pytest.approx(np.percentile([1, 2, 3, 4], 99))
+    assert snap["token_mean_ms"] == pytest.approx(2.5)
+    # Absent series are omitted, not zero-filled.
+    assert "ttft_p50_ms" not in snap
+
+
+def test_occupancy_gauges():
+    m = make_metrics()
+    m.record_occupancy(3, 4, 7, 12)
+    r = {i.name: i for i in m.registry.collect()}
+    assert r["zk_decode_active_slots"].value == 3
+    assert r["zk_decode_slot_occupancy"].value == pytest.approx(0.75)
+    assert r["zk_decode_queue_depth"].value == 7
+    assert r["zk_decode_kv_pages_in_use"].value == 12
+    assert r["zk_decode_serving_weights_step"].value == -1
+    m.record_weight_swap(step=5)
+    assert r["zk_decode_serving_weights_step"].value == 5
+
+
+def test_full_family_renders_as_valid_exposition():
+    """Every registered zk_decode_* instrument renders as valid
+    Prometheus text exposition (the CI scrape smoke's contract)."""
+    m = make_metrics()
+    m.record_prefill(5.0, 1)
+    m.record_ttft(12.0)
+    m.record_decode_step(1.5, 1)
+    m.record_occupancy(1, 4, 0, 3)
+    body = render_prometheus([m.registry])
+    samples = [l for l in body.splitlines() if l and not l.startswith("#")]
+    bad = [l for l in samples if not _LINE.match(l)]
+    assert samples and not bad, bad[:5]
+    for inst in m.registry.collect():
+        assert inst.name in body, inst.name
+    for required in (
+        "zk_decode_tokens_total",
+        "zk_decode_ttft_ms_bucket",
+        "zk_decode_token_ms_bucket",
+        "zk_decode_slot_occupancy",
+        "zk_decode_kv_pages_in_use",
+    ):
+        assert required in body, required
+
+
+def test_reset_zeros_in_place():
+    m = make_metrics()
+    m.record_decode_step(3.0, 2)
+    m.record_occupancy(2, 4, 1, 5)
+    before = {id(i) for i in m.registry.collect()}
+    m.reset()
+    assert {id(i) for i in m.registry.collect()} == before  # identity kept
+    assert m.totals["tokens_total"] == 0
+    assert "token_p50_ms" not in m.snapshot()
+    # Still renders after reset (live endpoint keeps scraping).
+    assert "zk_decode_tokens_total" in render_prometheus([m.registry])
+
+
+def test_emit_through_writer():
+    class FakeWriter:
+        def __init__(self):
+            self.rows = []
+
+        def write_scalars(self, step, scalars):
+            self.rows.append((step, dict(scalars)))
+
+    m = make_metrics()
+    m.record_decode_step(2.0, 3)
+    w = FakeWriter()
+    snap = m.emit(w, step=5, extra={"tokens_per_sec": 99.0})
+    assert snap["tokens_total"] == 3
+    step, scalars = w.rows[0]
+    assert step == 5
+    assert scalars["decode/tokens_total"] == 3.0
+    assert scalars["decode/tokens_per_sec"] == 99.0

@@ -1,0 +1,432 @@
+"""DecodeScheduler behavior: continuous batching semantics, streaming,
+admission control (PR 4 machinery re-expressed for streams), async
+worker mode, and the drain-boundary weight hot-swap contract.
+
+Sync-mode tests are thread- and clock-free (the caller drives the
+loop); deadline tests use the ``deadline_ms=0`` expiry-by-construction
+idiom from the batcher suite."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from zookeeper_tpu.core import configure
+from zookeeper_tpu.serving import (
+    DeadlineExpiredError,
+    DecodeMetrics,
+    RejectedError,
+)
+from zookeeper_tpu.serving.decode import DecodeScheduler
+
+from tests.serving.test_decode_engine import (
+    VOCAB,
+    build_lm,
+    make_engine,
+    oracle,
+)
+
+pytestmark = pytest.mark.serving
+
+
+@pytest.fixture(scope="module")
+def lm():
+    return build_lm()
+
+
+@pytest.fixture(scope="module")
+def warm_engine(lm):
+    module, params, state, _ = lm
+    engine = make_engine(module, params, state, slots=3)
+    engine.warmup()
+    return engine
+
+
+def make_sched(engine, metrics=False, **conf):
+    m = None
+    if metrics:
+        m = DecodeMetrics()
+        configure(m, {}, name="metrics")
+    s = DecodeScheduler()
+    configure(s, dict(conf), name="sched")
+    s.bind(engine, metrics=m)
+    return s, m
+
+
+# -- basic semantics -------------------------------------------------------
+
+
+def test_generate_one_call_api(lm, warm_engine):
+    module, _, _, variables = lm
+    sched, _ = make_sched(warm_engine)
+    prompt = np.arange(1, 9, dtype=np.int32)
+    out = sched.generate(prompt, max_new_tokens=5)
+    np.testing.assert_array_equal(out, oracle(module, variables, prompt, 5))
+
+
+def test_streaming_iteration_yields_tokens_incrementally(lm, warm_engine):
+    module, _, _, variables = lm
+    sched, _ = make_sched(warm_engine)
+    prompt = np.arange(1, 6, dtype=np.int32)
+    stream = sched.submit(prompt, max_new_tokens=7)
+    seen = []
+    for token in stream:
+        seen.append(int(token))
+        # Tokens arrive before the stream is complete (streaming, not
+        # batch delivery) — at least the first one.
+        if len(seen) == 1:
+            assert not stream.done or stream._max_new == 1
+    np.testing.assert_array_equal(
+        np.asarray(seen, np.int32), oracle(module, variables, prompt, 7)
+    )
+    np.testing.assert_array_equal(stream.result(), seen)
+
+
+def test_eos_finishes_stream_with_token_delivered(lm, warm_engine):
+    """EOS stops generation WITH the eos token delivered; other streams
+    in the same slot array are unaffected."""
+    module, _, _, variables = lm
+    sched, _ = make_sched(warm_engine)
+    prompt = np.arange(1, 6, dtype=np.int32)
+    want = oracle(module, variables, prompt, 8)
+    eos = int(want[3])
+    stream = sched.submit(prompt, max_new_tokens=8, eos_token=eos)
+    other = sched.submit(prompt[:3], max_new_tokens=8)
+    sched.drain()
+    got = stream.result()
+    assert stream.finish_reason == "eos"
+    assert got.shape[0] == 4 and got[-1] == eos
+    np.testing.assert_array_equal(got, want[:4])
+    assert other.finish_reason == "length"
+    np.testing.assert_array_equal(
+        other.result(), oracle(module, variables, prompt[:3], 8)
+    )
+
+
+def test_component_level_eos_default(lm, warm_engine):
+    module, _, _, variables = lm
+    prompt = np.arange(1, 6, dtype=np.int32)
+    want = oracle(module, variables, prompt, 8)
+    sched, _ = make_sched(warm_engine, eos_token=int(want[2]))
+    got = sched.submit(prompt, max_new_tokens=8).result()
+    assert got.shape[0] == 3
+
+
+def test_fifo_order_across_refills(lm, warm_engine):
+    """Requests admit in submission order as slots free (FIFO): with 3
+    slots and 7 requests, TTFT ordering follows submission order."""
+    module, _, _, variables = lm
+    sched, _ = make_sched(warm_engine)
+    rng = np.random.default_rng(0)
+    prompts = [
+        rng.integers(1, VOCAB, size=4).astype(np.int32) for _ in range(7)
+    ]
+    streams = [sched.submit(p, max_new_tokens=3) for p in prompts]
+    sched.drain()
+    for p, s in zip(prompts, streams):
+        np.testing.assert_array_equal(s.result(), oracle(module, variables, p, 3))
+    ttfts = [s.ttft_ms for s in streams]
+    assert all(t is not None for t in ttfts)
+    # Slot-array cohorts admit in order: the last request's first token
+    # can never land before the first request's.
+    assert ttfts[0] <= ttfts[-1]
+
+
+def test_submit_validation(warm_engine):
+    sched, _ = make_sched(warm_engine)
+    with pytest.raises(ValueError, match="non-empty 1-D"):
+        sched.submit(np.zeros((0,), np.int32))
+    with pytest.raises(ValueError, match="non-empty 1-D"):
+        sched.submit(np.zeros((2, 2), np.int32))
+    with pytest.raises(ValueError, match="largest seq bucket"):
+        sched.submit(np.zeros((17,), np.int32))
+    with pytest.raises(ValueError, match="max_new_tokens"):
+        sched.submit(np.array([1], np.int32), max_new_tokens=0)
+    with pytest.raises(RuntimeError, match="not bound"):
+        DecodeScheduler().submit(np.array([1], np.int32))
+
+
+def test_bind_validation(warm_engine):
+    for conf, match in [
+        ({"max_new_tokens": 0}, "max_new_tokens"),
+        ({"shed_above": -1}, "shed_above"),
+        ({"default_deadline_ms": -1.0}, "shed_above"),
+        ({"max_queue": 0}, "max_queue"),
+    ]:
+        s = DecodeScheduler()
+        configure(s, conf, name="sched")
+        with pytest.raises(ValueError, match=match):
+            s.bind(warm_engine)
+
+
+# -- admission control -----------------------------------------------------
+
+
+def test_shedding_rejects_past_threshold(lm, warm_engine):
+    sched, m = make_sched(warm_engine, metrics=True, shed_above=2)
+    p = np.array([1, 2], np.int32)
+    ok = [sched.submit(p, max_new_tokens=2) for _ in range(2)]
+    with pytest.raises(RejectedError, match="shed"):
+        sched.submit(p, max_new_tokens=2)
+    assert m.totals["rejected_total"] == 1
+    sched.drain()
+    for s in ok:
+        assert s.result().shape[0] == 2
+    # An empty queue always admits (the never-shed-into-empty contract).
+    assert sched.submit(p, max_new_tokens=1).result().shape[0] == 1
+
+
+def test_explicit_zero_deadline_expires_queued(lm, warm_engine):
+    """deadline_ms=0 = expired-by-construction: failed at admission
+    planning, never prefilled; partial output empty; result() raises."""
+    sched, m = make_sched(warm_engine, metrics=True)
+    p = np.array([1, 2, 3], np.int32)
+    doomed = sched.submit(p, max_new_tokens=4, deadline_ms=0)
+    alive = sched.submit(p, max_new_tokens=4)
+    sched.drain()
+    with pytest.raises(DeadlineExpiredError):
+        doomed.result()
+    assert doomed.tokens_so_far.shape[0] == 0
+    assert alive.result().shape[0] == 4
+    assert m.totals["deadline_expired_total"] == 1
+
+
+def test_default_deadline_component_field(warm_engine):
+    sched, m = make_sched(warm_engine, metrics=True, default_deadline_ms=1e9)
+    assert sched.submit(np.array([1], np.int32)).result().shape[0] >= 1
+
+
+def test_result_never_blocks_past_deadline_without_worker(warm_engine):
+    """A stream whose deadline passes while NOTHING drives the loop
+    still fails promptly in result() — it never hangs."""
+    sched, _ = make_sched(warm_engine)
+    stream = sched.submit(
+        np.array([1, 2], np.int32), max_new_tokens=4, deadline_ms=0
+    )
+    with pytest.raises(DeadlineExpiredError):
+        stream.result()
+
+
+def test_mid_stream_deadline_expiry_keeps_partial_tokens(
+    lm, warm_engine, monkeypatch
+):
+    """A deadline that expires between decode dispatches fails the
+    stream mid-flight — partial tokens stay readable, the slot frees
+    for the next admit."""
+    module, _, _, variables = lm
+    sched, m = make_sched(warm_engine, metrics=True)
+    prompt = np.arange(1, 5, dtype=np.int32)
+    stream = sched.submit(prompt, max_new_tokens=8, deadline_ms=1e9)
+    # Drive: prefill + 2 decode steps, then force the deadline into the
+    # past (deterministic mid-stream expiry without real clocks).
+    sched._pump()
+    sched._pump()
+    got_before = stream.tokens_so_far
+    assert got_before.shape[0] >= 2
+    stream._deadline_at = 0.0
+    sched.drain()
+    with pytest.raises(DeadlineExpiredError):
+        stream.result()
+    partial = stream.tokens_so_far
+    assert partial.shape[0] >= got_before.shape[0]
+    np.testing.assert_array_equal(
+        partial, oracle(module, variables, prompt, partial.shape[0])
+    )
+    assert sched.active_slots == 0
+    assert m.totals["deadline_expired_total"] == 1
+
+
+def test_sync_backpressure_drains_inline(lm, warm_engine):
+    module, _, _, variables = lm
+    sched, _ = make_sched(warm_engine, max_queue=2)
+    rng = np.random.default_rng(5)
+    prompts = [
+        rng.integers(1, VOCAB, size=3).astype(np.int32) for _ in range(6)
+    ]
+    streams = [sched.submit(p, max_new_tokens=2) for p in prompts]
+    sched.drain()
+    for p, s in zip(prompts, streams):
+        np.testing.assert_array_equal(s.result(), oracle(module, variables, p, 2))
+
+
+# -- weight hot-swap (drain-boundary contract) -----------------------------
+
+
+def test_request_swap_validates_eagerly(lm, warm_engine):
+    sched, _ = make_sched(warm_engine)
+    _, bad_params, bad_state, _ = build_lm(d_model=64)
+    with pytest.raises(ValueError, match="mismatch"):
+        sched.request_swap(bad_params, bad_state)
+    assert not sched.swap_pending
+
+
+def test_swap_applies_at_drain_boundary_one_version_per_sequence(lm):
+    """The one-weight-version-per-SEQUENCE contract: streams in flight
+    when the swap is requested finish ENTIRELY on the old weights;
+    streams submitted after run entirely on the new; zero compiles."""
+    module, params, state, variables = lm
+    _, params_b, state_b, variables_b = build_lm(seed=11)
+    engine = make_engine(module, params, state, slots=2)
+    warm = engine.warmup()
+    sched, m = make_sched(engine, metrics=True)
+    rng = np.random.default_rng(6)
+    p1 = rng.integers(1, VOCAB, size=5).astype(np.int32)
+    p2 = rng.integers(1, VOCAB, size=7).astype(np.int32)
+    s1 = sched.submit(p1, max_new_tokens=6)
+    s2 = sched.submit(p2, max_new_tokens=4)
+    # Start decoding, then stage the swap mid-flight.
+    sched._pump()
+    assert sched.active_slots == 2
+    sched.request_swap(params_b, state_b, step=123)
+    assert sched.swap_pending
+    s3 = sched.submit(p1, max_new_tokens=6)  # queued BEHIND the swap
+    sched.drain()
+    assert not sched.swap_pending
+    # In-flight streams: old weights, oracle-exact.
+    np.testing.assert_array_equal(s1.result(), oracle(module, variables, p1, 6))
+    np.testing.assert_array_equal(s2.result(), oracle(module, variables, p2, 4))
+    # Post-swap stream: NEW weights, oracle-exact against them.
+    np.testing.assert_array_equal(
+        s3.result(), oracle(module, variables_b, p1, 6)
+    )
+    assert engine.compile_count == warm
+    assert m.totals["weight_swaps_total"] == 1
+    assert m.snapshot()["weight_swaps_total"] == 1
+
+
+def test_swap_supersede_newest_wins(lm):
+    module, params, state, variables = lm
+    _, params_b, state_b, variables_b = build_lm(seed=11)
+    engine = make_engine(module, params, state, slots=1)
+    engine.warmup()
+    sched, _ = make_sched(engine)
+    sched.request_swap(params_b, state_b)
+    sched.request_swap(params, state)  # replaces the staged swap
+    sched.drain()
+    assert not sched.swap_pending
+    p = np.array([1, 2, 3], np.int32)
+    np.testing.assert_array_equal(
+        sched.generate(p, max_new_tokens=4), oracle(module, variables, p, 4)
+    )
+
+
+# -- async worker mode -----------------------------------------------------
+
+
+def test_async_mode_serves_and_names_thread(lm, warm_engine):
+    module, _, _, variables = lm
+    sched, _ = make_sched(warm_engine, synchronous=False)
+    try:
+        rng = np.random.default_rng(7)
+        prompts = [
+            rng.integers(1, VOCAB, size=int(rng.integers(2, 9))).astype(np.int32)
+            for _ in range(5)
+        ]
+        streams = [sched.submit(p, max_new_tokens=4) for p in prompts]
+        for p, s in zip(prompts, streams):
+            np.testing.assert_array_equal(
+                s.result(timeout=120), oracle(module, variables, p, 4)
+            )
+        names = [t.name for t in threading.enumerate()]
+        assert "zk-decode-scheduler" in names
+    finally:
+        sched.close()
+
+
+def test_close_fails_pending_streams(warm_engine):
+    sched, _ = make_sched(warm_engine)
+    stream = sched.submit(np.array([1, 2], np.int32), max_new_tokens=4)
+    sched.close(drain=False)
+    with pytest.raises(RuntimeError, match="closed"):
+        stream.result()
+    # close() is idempotent and safe unbound.
+    sched.close()
+    DecodeScheduler().close()
+
+
+def test_close_with_drain_serves_first(lm, warm_engine):
+    module, _, _, variables = lm
+    sched, _ = make_sched(warm_engine)
+    p = np.array([3, 1, 4], np.int32)
+    stream = sched.submit(p, max_new_tokens=3)
+    sched.close(drain=True)
+    np.testing.assert_array_equal(stream.result(), oracle(module, variables, p, 3))
+
+
+# -- introspection / statusz ----------------------------------------------
+
+
+def test_status_section(warm_engine):
+    sched, _ = make_sched(warm_engine)
+    stream = sched.submit(np.array([1, 2, 3], np.int32), max_new_tokens=3)
+    sched._pump()  # prefill happened: one active slot
+    status = sched.status()
+    assert status["slots"] == 3
+    assert status["active_slots"] == 1
+    assert status["queue_depth"] == 0
+    assert status["kv_pages_in_use"] >= 1
+    assert status["recompiles_detected"] == 0
+    assert status["compiles"] == warm_engine.compile_count
+    sched.drain()
+    assert stream.result().shape[0] == 3
+    assert sched.status()["active_slots"] == 0
+
+
+def test_concurrent_first_submits_spawn_one_worker(lm, warm_engine):
+    """Racing first submits on an idle async scheduler must not each
+    spawn a zk-decode-scheduler thread (an orphaned duplicate would
+    keep pumping a closed scheduler): worker spawn is check-and-start
+    under the scheduler lock."""
+    module, _, _, variables = lm
+    sched, _ = make_sched(warm_engine, synchronous=False)
+    try:
+        barrier = threading.Barrier(4)
+        streams, errors = [], []
+
+        def go():
+            try:
+                barrier.wait()
+                streams.append(
+                    sched.submit(
+                        np.arange(1, 5, dtype=np.int32), max_new_tokens=3
+                    )
+                )
+            except BaseException as e:  # surfaced below
+                errors.append(e)
+
+        threads = [threading.Thread(target=go) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors
+        for s in streams:
+            assert s.result(timeout=120).shape[0] == 3
+        workers = [
+            t
+            for t in threading.enumerate()
+            if t.name == "zk-decode-scheduler" and t.is_alive()
+        ]
+        assert len(workers) <= 1, [t.name for t in workers]
+    finally:
+        sched.close()
+
+
+def test_prompt_at_token_limit_rejected_at_submit(lm):
+    """A prompt of token_limit tokens has no room to generate even one
+    token within the truncate-at-EXACTLY-token_limit contract — submit
+    rejects it eagerly instead of emitting an un-certifiable token."""
+    module, params, state, _ = lm
+    engine = make_engine(
+        module, params, state, slots=1, seq_buckets=(16,), kv_capacity=16
+    )
+    engine.warmup()
+    assert engine.token_limit == 16
+    sched, _ = make_sched(engine)
+    with pytest.raises(ValueError, match="no room to generate"):
+        sched.submit(np.arange(1, 17, dtype=np.int32))  # 16 == limit
+    # One token under the limit serves and truncates at the boundary.
+    stream = sched.submit(np.arange(1, 16, dtype=np.int32))
+    sched.drain()
+    assert stream.result().shape[0] == 1
+    assert stream.finish_reason == "capacity"

@@ -27,6 +27,13 @@ from tools import bench_diff
         ("recovery_restore_ms", "lower"),
         ("ckpt_async_save_stall_ms", "lower"),
         ("shed_rate", "lower"),
+        # Decode-serving leg (ZK_BENCH_DECODE): the two gated keys the
+        # acceptance criteria name, plus the ride-along latencies.
+        ("serve_decode_tokens_per_sec_per_chip", "higher"),
+        ("decode_ttft_p99_ms", "lower"),
+        ("decode_ttft_p50_ms", "lower"),
+        ("decode_token_p50_ms", "lower"),
+        ("decode_prefill_p50_ms", "lower"),
     ],
 )
 def test_classify_metric_directions(name, expected):
@@ -44,6 +51,9 @@ def test_classify_metric_directions(name, expected):
         # explains the gated numbers and must not gate itself.
         "measured_bf16_peak_tflops", "measured_int8_peak_tops",
         "model_step_tflops",
+        # Decode-leg workload shape: config, not performance.
+        "decode_requests", "decode_slots", "decode_new_tokens",
+        "decode_refills", "decode_generated_tokens",
     ],
 )
 def test_identity_and_context_keys_never_gate(name):

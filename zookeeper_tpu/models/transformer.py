@@ -35,7 +35,11 @@ import jax.numpy as jnp
 
 from zookeeper_tpu.core import Field, component
 from zookeeper_tpu.models.base import Model
-from zookeeper_tpu.ops import attention_reference, flash_attention
+from zookeeper_tpu.ops import (
+    attention_reference,
+    cached_attention,
+    flash_attention,
+)
 from zookeeper_tpu.parallel.sharding import constrain_batch_sharded
 
 
@@ -79,34 +83,42 @@ class RMSNorm(nn.Module):
 
 
 class _Block(nn.Module):
+    """One pre-norm decoder block.
+
+    ``setup()``-structured (not ``nn.compact``) so the SAME weights
+    serve two traced programs: the full-context ``__call__`` (training
+    / prefill) and the single-position :meth:`decode` (cached
+    attention over a KV buffer). Submodule names are pinned to the
+    names the original compact implementation auto-assigned
+    (``RMSNorm_0``/``RMSNorm_1``/``qkv``/``proj``/``up``/``down``) so
+    every existing checkpoint and partition rule keeps matching.
+    """
+
+    d_model: int
     num_heads: int
     mlp_ratio: int
     attention: Any
     dtype: Any
     pin_activations: bool = True
 
-    @nn.compact
-    def __call__(self, x, training: bool):
-        b, s, d = x.shape
-        head_dim = d // self.num_heads
-
-        h = RMSNorm(dtype=self.dtype)(x)
-        qkv = nn.Dense(3 * d, use_bias=False, dtype=self.dtype, name="qkv")(h)
-        q, k, v = jnp.split(qkv, 3, axis=-1)
-        to_heads = lambda t: t.reshape(b, s, self.num_heads, head_dim)
-        attn = _resolve_attention(self.attention)
-        o = attn(to_heads(q), to_heads(k), to_heads(v), causal=True)
-        o = nn.Dense(
-            d, use_bias=False, dtype=self.dtype, name="proj"
-        )(o.reshape(b, s, d))
-        x = x + o
-
-        h = RMSNorm(dtype=self.dtype)(x)
-        h = nn.Dense(
+    def setup(self):
+        d = self.d_model
+        self.ln1 = RMSNorm(dtype=self.dtype, name="RMSNorm_0")
+        self.wqkv = nn.Dense(
+            3 * d, use_bias=False, dtype=self.dtype, name="qkv"
+        )
+        self.wproj = nn.Dense(d, use_bias=False, dtype=self.dtype, name="proj")
+        self.ln2 = RMSNorm(dtype=self.dtype, name="RMSNorm_1")
+        self.wup = nn.Dense(
             self.mlp_ratio * d, use_bias=False, dtype=self.dtype, name="up"
-        )(h)
+        )
+        self.wdown = nn.Dense(d, use_bias=False, dtype=self.dtype, name="down")
+
+    def _mlp(self, x):
+        h = self.ln2(x)
+        h = self.wup(h)
         h = nn.gelu(h)
-        h = nn.Dense(d, use_bias=False, dtype=self.dtype, name="down")(h)
+        h = self.wdown(h)
         # Pin the residual stream to the canonical layout (batch on the
         # data axes) at every block boundary: without the pin, GSPMD
         # was observed picking an FSDP-axis-spread layout for the
@@ -118,6 +130,49 @@ class _Block(nn.Module):
         if self.pin_activations:
             out = constrain_batch_sharded(out)
         return out
+
+    def __call__(self, x, training: bool, return_kv: bool = False):
+        b, s, d = x.shape
+        head_dim = d // self.num_heads
+
+        h = self.ln1(x)
+        qkv = self.wqkv(h)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        to_heads = lambda t: t.reshape(b, s, self.num_heads, head_dim)
+        kh, vh = to_heads(k), to_heads(v)
+        attn = _resolve_attention(self.attention)
+        o = attn(to_heads(q), kh, vh, causal=True)
+        x = x + self.wproj(o.reshape(b, s, d))
+        out = self._mlp(x)
+        if return_kv:
+            return out, (kh, vh)
+        return out
+
+    def decode(self, x, k_cache, v_cache, lengths):
+        """One cached-attention step: ``x [b, 1, d]`` is the new token's
+        residual stream, ``k_cache/v_cache [b, capacity, heads,
+        head_dim]`` the slot KV buffers, ``lengths [b]`` the tokens
+        already cached. Writes the new position's K/V at index
+        ``lengths`` (clamped to the last row — the scheduler never
+        decodes past capacity; the clamp only keeps an inactive slot's
+        idle write in bounds), attends rows ``0..lengths``, and returns
+        ``(x_out, k_cache, v_cache)``. Same projections/norms as
+        ``__call__`` — the weights are literally the same submodules."""
+        b = x.shape[0]
+        head_dim = self.d_model // self.num_heads
+
+        h = self.ln1(x)
+        qkv = self.wqkv(h)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        to_heads = lambda t: t.reshape(b, 1, self.num_heads, head_dim)
+        q, k, v = to_heads(q), to_heads(k), to_heads(v)
+        write = jnp.clip(lengths, 0, k_cache.shape[1] - 1)
+        rows = jnp.arange(b)
+        k_cache = k_cache.at[rows, write].set(k[:, 0], mode="drop")
+        v_cache = v_cache.at[rows, write].set(v[:, 0], mode="drop")
+        o = cached_attention(q, k_cache, v_cache, lengths)
+        x = x + self.wproj(o.reshape(b, 1, self.d_model))
+        return self._mlp(x), k_cache, v_cache
 
 
 def _auto_pin_activations(attention, pin_activations):
@@ -141,6 +196,25 @@ def _auto_pin_activations(attention, pin_activations):
 
 
 class TransformerLMModule(nn.Module):
+    """The causal LM module. ``setup()``-structured so three methods
+    share one weight set and one param tree (names unchanged from the
+    original compact layout):
+
+    - ``__call__`` — the full-context forward (training, eval, the
+      full-recompute ``greedy_decode`` oracle).
+    - ``prefill`` — full-context forward that ALSO returns every
+      layer's K/V heads (to seed a decode engine's KV cache) and the
+      next-token logits at each sequence's true last position.
+    - ``decode_step`` — one token per sequence through the cached-
+      attention path (``ops.cached_attention``) over caller-owned KV
+      buffers.
+
+    Prefill/decode share weights AND numerics with ``__call__`` by
+    construction — same submodules, same einsum/precision discipline —
+    which is what the decode-parity certification pins
+    (docs/DESIGN.md §15).
+    """
+
     vocab_size: int
     num_layers: int
     d_model: int
@@ -152,8 +226,49 @@ class TransformerLMModule(nn.Module):
     #: None = auto (see ``_auto_pin_activations``); bool overrides.
     pin_activations: Any = None
 
-    @nn.compact
-    def __call__(self, tokens, training: bool = False):
+    def setup(self):
+        self.embed = self.param(
+            "embed",
+            nn.initializers.normal(0.02),
+            (self.vocab_size, self.d_model),
+        )
+        self.pos = self.param(
+            "pos",
+            nn.initializers.normal(0.02),
+            (self.max_seq_len, self.d_model),
+        )
+        pin = _auto_pin_activations(self.attention, self.pin_activations)
+        self.blocks = [
+            _Block(
+                d_model=self.d_model,
+                num_heads=self.num_heads,
+                mlp_ratio=self.mlp_ratio,
+                attention=self.attention,
+                dtype=self.dtype,
+                pin_activations=pin,
+                name=f"block{i}",
+            )
+            for i in range(self.num_layers)
+        ]
+        self.final_norm = RMSNorm(dtype=self.dtype, name="RMSNorm_0")
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.num_heads
+
+    def _pin(self) -> bool:
+        return _auto_pin_activations(self.attention, self.pin_activations)
+
+    def _logits(self, x):
+        x = self.final_norm(x)
+        # Weight-tied LM head: logits in fp32 (the loss reduction dtype).
+        return jnp.einsum(
+            "bsd,vd->bsv",
+            x.astype(jnp.float32),
+            self.embed.astype(jnp.float32),
+        )
+
+    def _backbone(self, tokens, training: bool, collect_kv: bool):
         if tokens.ndim != 2:
             raise ValueError(
                 f"TransformerLM expects [batch, seq] int tokens, got "
@@ -165,34 +280,61 @@ class TransformerLMModule(nn.Module):
                 f"Sequence length {s} exceeds max_seq_len "
                 f"{self.max_seq_len} (the positional table size)."
             )
-        embed = self.param(
-            "embed",
-            nn.initializers.normal(0.02),
-            (self.vocab_size, self.d_model),
-        )
-        pos = self.param(
-            "pos",
-            nn.initializers.normal(0.02),
-            (self.max_seq_len, self.d_model),
-        )
-        pin = _auto_pin_activations(self.attention, self.pin_activations)
-        x = (embed[tokens] + pos[None, :s]).astype(self.dtype)
-        if pin:
+        x = (self.embed[tokens] + self.pos[None, :s]).astype(self.dtype)
+        if self._pin():
             x = constrain_batch_sharded(x)
-        for i in range(self.num_layers):
-            x = _Block(
-                num_heads=self.num_heads,
-                mlp_ratio=self.mlp_ratio,
-                attention=self.attention,
-                dtype=self.dtype,
-                pin_activations=pin,
-                name=f"block{i}",
-            )(x, training)
-        x = RMSNorm(dtype=self.dtype)(x)
-        # Weight-tied LM head: logits in fp32 (the loss reduction dtype).
-        return jnp.einsum(
-            "bsd,vd->bsv", x.astype(jnp.float32), embed.astype(jnp.float32)
-        )
+        kv = []
+        for block in self.blocks:
+            if collect_kv:
+                x, layer_kv = block(x, training, return_kv=True)
+                kv.append(layer_kv)
+            else:
+                x = block(x, training)
+        return x, kv
+
+    def __call__(self, tokens, training: bool = False):
+        x, _ = self._backbone(tokens, training, collect_kv=False)
+        return self._logits(x)
+
+    def prefill(self, tokens, lengths):
+        """Write-path of the decode engine's two-program split: run the
+        ordinary full-context forward over a right-padded prompt batch
+        ``tokens [b, s]`` (``lengths [b]`` true prompt lengths), and
+        return ``(last_logits [b, vocab], kv)`` where ``last_logits``
+        is each sequence's next-token distribution at its TRUE last
+        position (right padding cannot influence it — causal) and
+        ``kv`` is a per-layer tuple of ``(k, v) [b, s, heads,
+        head_dim]`` head tensors for the caller to scatter into its KV
+        cache. Numerically the same program as ``__call__`` — the
+        first emitted token is the full-context oracle's."""
+        x, kv = self._backbone(tokens, False, collect_kv=True)
+        logits = self._logits(x)
+        idx = jnp.clip(lengths - 1, 0, tokens.shape[1] - 1)
+        last = jnp.take_along_axis(logits, idx[:, None, None], axis=1)[:, 0]
+        return last, tuple(kv)
+
+    def decode_step(self, tokens, lengths, cache):
+        """One incremental token per sequence. ``tokens [b] int`` are
+        the CURRENT input tokens (each sits at position ``lengths``),
+        ``cache`` is a per-layer tuple of ``{"k", "v"}`` buffers
+        ``[b, capacity, heads, head_dim]``. Returns ``(logits [b,
+        vocab], new_cache)`` — the caller owns length bookkeeping and
+        feeds ``argmax(logits)`` back as the next step's ``tokens``."""
+        if len(cache) != self.num_layers:
+            raise ValueError(
+                f"cache has {len(cache)} layers, model has "
+                f"{self.num_layers}."
+            )
+        pos_idx = jnp.clip(lengths, 0, self.max_seq_len - 1)
+        x = (self.embed[tokens] + self.pos[pos_idx]).astype(self.dtype)
+        x = x[:, None, :]
+        if self._pin():
+            x = constrain_batch_sharded(x)
+        new_cache = []
+        for block, layer in zip(self.blocks, cache):
+            x, kc, vc = block.decode(x, layer["k"], layer["v"], lengths)
+            new_cache.append({"k": kc, "v": vc})
+        return self._logits(x)[:, 0], tuple(new_cache)
 
 
 def greedy_decode(

@@ -84,6 +84,56 @@ def attention_reference(
     ).astype(q.dtype)
 
 
+def cached_attention(
+    q: jax.Array,
+    k_cache: jax.Array,
+    v_cache: jax.Array,
+    lengths: jax.Array,
+    *,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Single-position attention over a per-sequence KV cache — the
+    incremental-decode counterpart of :func:`attention_reference`.
+
+    Shapes: ``q [batch, 1, heads, head_dim]`` (the ONE new token per
+    sequence), ``k_cache/v_cache [batch, capacity, heads, head_dim]``
+    (the ring/paged KV buffers, already containing the new token's K/V
+    at index ``lengths``), ``lengths [batch] int32`` — the number of
+    PREVIOUSLY cached tokens per sequence, so cache rows ``0..lengths``
+    inclusive are attended and everything past them (stale K/V from a
+    refilled slot's previous occupant, not-yet-overwritten prefill
+    padding) is masked out. Output ``[batch, 1, heads, head_dim]``.
+
+    Numerics deliberately mirror :func:`attention_reference` op for op
+    (fp32 HIGHEST-precision einsums, the same finite ``_MASK_VALUE``,
+    ``jax.nn.softmax``): masked scores underflow to exactly 0.0 after
+    the softmax shift, so the only divergence from the full-context
+    oracle's row at the same position is dot-reduction reassociation
+    over the (capacity vs sequence) axis — ULP-level, and pinned
+    token-exact by the decode parity certification (docs/DESIGN.md
+    §15).
+    """
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    s = jnp.einsum(
+        "bqhd,bkhd->bhqk",
+        q,
+        k_cache,
+        preferred_element_type=jnp.float32,
+        precision=lax.Precision.HIGHEST,
+    ) * jnp.float32(scale)
+    ki = lax.broadcasted_iota(jnp.int32, (k_cache.shape[1],), 0)
+    mask = ki[None, None, None, :] <= lengths[:, None, None, None]
+    s = jnp.where(mask, s, _MASK_VALUE)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum(
+        "bhqk,bkhd->bqhd",
+        p,
+        v_cache.astype(jnp.float32),
+        precision=lax.Precision.HIGHEST,
+    ).astype(q.dtype)
+
+
 def _check_self_attention_shapes(q, k, v):
     """Identical q/k/v shapes are the supported contract for the SP
     kernels. Checked INSIDE the local programs (not just the shard_map

@@ -142,6 +142,19 @@ class Partitioner:
         product to serve fully sharded."""
         raise NotImplementedError
 
+    def decode_cache_sharding(self, cache: Any) -> Any:
+        """Sharding pytree for a decode engine's KV-cache state
+        (``serving.decode``): per-layer ``k``/``v`` buffers ``[slots,
+        capacity, heads, head_dim]``. None = default placement (single
+        device); mesh partitioners shard slots over the data axes and
+        heads over the model axis via
+        :func:`zookeeper_tpu.parallel.rules.decode_cache_rules`. The
+        ENGINE checks divisibility (slots vs the data-axis product,
+        heads vs the model axis) and falls back to replicated cache
+        state when the shapes cannot split — the same degrade-don't-die
+        posture ``compile_forward``'s small buckets take."""
+        return None
+
 
 @component
 class SingleDevicePartitioner(Partitioner):
@@ -400,6 +413,18 @@ class MeshPartitioner(Partitioner):
         # full paths, and an inference dict's ``params/...`` /
         # ``batch_stats/...`` paths are exactly the training prefixes.
         return self._sharding_from_rules(variables, self.rules)
+
+    def decode_cache_sharding(self, cache: Any) -> Any:
+        from zookeeper_tpu.parallel.rules import decode_cache_rules
+
+        model_axes = tuple(
+            a for a in self.mesh_axes if a not in set(self.data_axes)
+        )
+        rules = decode_cache_rules(
+            tuple(self.data_axes),
+            model_axes[0] if model_axes else None,
+        )
+        return self._sharding_from_rules(cache, rules)
 
     def compile_forward(self, forward_fn, variables, *, batch_rows=None):
         vars_sh = self.variables_sharding(variables)

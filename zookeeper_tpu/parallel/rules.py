@@ -69,6 +69,27 @@ def transformer_tp_rules(model_axis: str = "model") -> List[PartitionRule]:
     ]
 
 
+def decode_cache_rules(
+    data_axes: Sequence[str] = ("data",),
+    model_axis: str = None,
+) -> List[PartitionRule]:
+    """Partition rules for a decode engine's KV-cache state tree
+    (``serving.decode``): the per-layer ``k``/``v`` buffers are
+    ``[slots, capacity, heads, head_dim]`` — SLOTS shard over the data
+    axes (each device owns a contiguous run of sequence slots, exactly
+    how a training batch shards) and HEADS over ``model_axis`` when one
+    exists (matching :func:`transformer_tp_rules`, whose column-
+    parallel qkv kernel produces head-sharded K/V in the first place —
+    co-sharding the cache means the decode program writes and reads
+    K/V without any resharding collective). Everything else in the
+    tree replicates.
+    """
+    P = PartitionSpec
+    return [
+        (r"(^|/)(k|v)$", P(tuple(data_axes), None, model_axis, None)),
+    ]
+
+
 def auto_fsdp_rules(
     params: Any,
     axis_size: int,

@@ -107,6 +107,11 @@ class FaultPlan:
     - ``serving_worker_crash``: the next N MicroBatcher worker dispatch
       iterations crash the worker thread (exercises worker-death
       cleanup + restart).
+    - ``decode_worker_crash``: the next N DecodeScheduler loop
+      iterations crash mid-decode (exercises clean failure of every
+      in-flight token STREAM plus queued requests, and the restart on
+      the next submit — the continuous-batching analogue of
+      ``serving_worker_crash``).
     - ``fail_async_finalize``: the next N ASYNC checkpoint writes fail
       at the finalize boundary — the data is written but never
       atomically renamed into place, so a torn UNFINALIZED remnant is
@@ -126,6 +131,7 @@ class FaultPlan:
     fail_save_io: int = 0
     nan_at_step: Optional[int] = None
     serving_worker_crash: int = 0
+    decode_worker_crash: int = 0
     fail_async_finalize: int = 0
     kill_during_async_write: Optional[int] = None
 
@@ -168,6 +174,15 @@ class FaultPlan:
             if self.serving_worker_crash > 0:
                 self.serving_worker_crash -= 1
                 _injection_event("serving_worker_crash")
+                return True
+        return False
+
+    def take_decode_worker_crash(self) -> bool:
+        """Consume one injected decode-scheduler crash."""
+        with self._lock:
+            if self.decode_worker_crash > 0:
+                self.decode_worker_crash -= 1
+                _injection_event("decode_worker_crash")
                 return True
         return False
 

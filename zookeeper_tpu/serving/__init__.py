@@ -26,6 +26,13 @@ per-step cost — many requests ride one compiled program.
 - :class:`ServingConfig` (``service.py``): the ``Component`` tying model
   + checkpoint (EMA-vs-raw weight selection) + engine + batcher +
   metrics into one CLI-drivable task tree.
+- ``zookeeper_tpu.serving.decode``: the autoregressive token-streaming
+  half — paged/ring KV-cache :class:`DecodeEngine` (bucketed prefill +
+  single decode-step compiled programs), slot-refill continuous
+  batching in :class:`DecodeScheduler` (``generate()`` streaming API,
+  deadlines/shedding/crash recovery, drain-boundary weight hot-swap),
+  ``zk_decode_*`` metrics, and the :class:`LMServingConfig` CLI task
+  (docs/DESIGN.md §15).
 """
 
 from zookeeper_tpu.serving.batcher import (
@@ -35,6 +42,13 @@ from zookeeper_tpu.serving.batcher import (
     RejectedError,
     WorkerCrashedError,
 )
+from zookeeper_tpu.serving.decode import (
+    DecodeEngine,
+    DecodeMetrics,
+    DecodeScheduler,
+    DecodeStream,
+    LMServingConfig,
+)
 from zookeeper_tpu.serving.engine import CheckpointWatcher, InferenceEngine
 from zookeeper_tpu.serving.metrics import ServingMetrics
 from zookeeper_tpu.serving.service import ServingConfig
@@ -42,7 +56,12 @@ from zookeeper_tpu.serving.service import ServingConfig
 __all__ = [
     "CheckpointWatcher",
     "DeadlineExpiredError",
+    "DecodeEngine",
+    "DecodeMetrics",
+    "DecodeScheduler",
+    "DecodeStream",
     "InferenceEngine",
+    "LMServingConfig",
     "MicroBatcher",
     "PendingResult",
     "RejectedError",

@@ -1,0 +1,50 @@
+"""Continuous-batching autoregressive LM decode (docs/DESIGN.md §15).
+
+The token-streaming half of the serving stack — the ROADMAP's
+"millions-of-users" interactive workload:
+
+- :mod:`~zookeeper_tpu.serving.decode.cache` — paged/ring KV-cache
+  state: per-layer ``[slots, capacity, heads, head_dim]`` buffers,
+  device-resident, slots sharded on the data axes and heads on the
+  model axis via the Partitioner rule tables.
+- :class:`DecodeEngine` — the two compiled programs: a bucketed
+  ``prefill`` (writes a request's KV pages, emits its first token) and
+  ONE ``decode_step`` (one token per slot over the full slot array),
+  AOT-warmed with the forward engine's zero-recompile discipline and
+  ledgered in the ProgramLedger.
+- :class:`DecodeScheduler` — slot-refill continuous batching: a
+  finished sequence's slot is refilled from the queue without draining
+  or recompiling; deadlines/shedding/crash-recovery reuse the PR 4
+  machinery; ``generate()`` / :class:`DecodeStream` surface streaming
+  results; ``request_swap`` applies weight hot-swaps at slot-array
+  drain boundaries (one weight version per sequence).
+- :class:`DecodeMetrics` — TTFT + per-token latency histograms, token
+  counters, slot-occupancy and KV-page gauges (``zk_decode_*``).
+- :class:`LMServingConfig` — the config-system citizen tying model +
+  checkpoint + engine + scheduler into a CLI task
+  (``examples/serve_lm.py``).
+"""
+
+from zookeeper_tpu.serving.decode.cache import (
+    allocate_kv_cache,
+    kv_cache_bytes,
+    pages_in_use,
+)
+from zookeeper_tpu.serving.decode.engine import DecodeEngine
+from zookeeper_tpu.serving.decode.metrics import DecodeMetrics
+from zookeeper_tpu.serving.decode.scheduler import (
+    DecodeScheduler,
+    DecodeStream,
+)
+from zookeeper_tpu.serving.decode.service import LMServingConfig
+
+__all__ = [
+    "DecodeEngine",
+    "DecodeMetrics",
+    "DecodeScheduler",
+    "DecodeStream",
+    "LMServingConfig",
+    "allocate_kv_cache",
+    "kv_cache_bytes",
+    "pages_in_use",
+]

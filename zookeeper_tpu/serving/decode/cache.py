@@ -1,0 +1,80 @@
+"""KV-cache state for the continuous-batching decode engine.
+
+Layout (docs/DESIGN.md §15): per transformer layer, one ``k`` and one
+``v`` buffer of shape ``[slots, capacity, heads, head_dim]`` in the
+model's compute dtype, carried as DEVICE-RESIDENT engine state and
+donated through every prefill/decode dispatch (the update is in-place;
+the cache never round-trips the host). ``capacity`` is page-aligned
+(rounded up to a multiple of ``page_size``) so the layout is directly
+adoptable by a future paged-gather Pallas kernel; today the pages of
+one slot are contiguous — a ring of SLOTS rather than an indirection
+table of pages, because without a gather kernel page indirection buys
+no memory (every slot's worst case must be provisioned anyway) while
+costing a scatter/gather on the hot path. Page granularity still does
+real work host-side: ``pages_in_use`` is the occupancy number the
+``zk_decode_kv_pages_in_use`` gauge and ``/statusz`` report.
+
+Validity invariant (the slot-refill masking contract): a slot's cache
+row ``j`` is meaningful iff ``j < length`` for that slot's CURRENT
+occupant. Prefill writes rows ``[0, seq_bucket)`` (rows past the true
+prompt length hold padding-token garbage), each decode step writes row
+``length`` then advances ``length`` — so garbage rows are always at
+``j >= length`` and the decode attention masks them
+(``ops.cached_attention``). Refilling a slot therefore needs NO cache
+zeroing: the new occupant's prefill overwrites rows up to its bucket
+and its length masks everything beyond.
+"""
+
+import math
+from typing import Any, Tuple
+
+__all__ = ["allocate_kv_cache", "kv_cache_bytes", "pages_in_use"]
+
+
+def allocate_kv_cache(
+    num_layers: int,
+    slots: int,
+    capacity: int,
+    num_heads: int,
+    head_dim: int,
+    dtype: Any,
+) -> Tuple[dict, ...]:
+    """Zero-initialized KV cache pytree: a per-layer tuple of
+    ``{"k", "v"}`` buffers ``[slots, capacity, heads, head_dim]``.
+    Returned on the default device; the engine places it under the
+    partitioner's decode-cache sharding."""
+    import jax.numpy as jnp
+
+    if slots < 1 or capacity < 1:
+        raise ValueError(
+            f"KV cache needs slots >= 1 and capacity >= 1, got "
+            f"slots={slots}, capacity={capacity}."
+        )
+    shape = (slots, capacity, num_heads, head_dim)
+    return tuple(
+        {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+        for _ in range(num_layers)
+    )
+
+
+def kv_cache_bytes(
+    num_layers: int,
+    slots: int,
+    capacity: int,
+    num_heads: int,
+    head_dim: int,
+    itemsize: int,
+) -> int:
+    """Total HBM the cache occupies (k + v, all layers) — the decode
+    engine's capacity-planning number (docs/DESIGN.md §15 cost model)."""
+    return 2 * num_layers * slots * capacity * num_heads * head_dim * itemsize
+
+
+def pages_in_use(lengths, page_size: int) -> int:
+    """KV pages currently holding live tokens: ``sum(ceil(len /
+    page_size))`` over the ACTIVE slots' lengths. Host-side accounting
+    only (the gauge/statusz number) — storage itself is provisioned at
+    full capacity per slot."""
+    if page_size < 1:
+        raise ValueError(f"page_size={page_size} must be >= 1.")
+    return int(sum(math.ceil(int(n) / page_size) for n in lengths if n > 0))

@@ -1,0 +1,683 @@
+"""The autoregressive decode engine: two compiled programs over
+device-resident KV-cache state.
+
+The forward-only serving engine re-runs the full context per token —
+O(s^2) work per emitted token and no sequence state between requests.
+This engine is the real decode path (ROADMAP item 1): the KV cache
+lives on device as engine state (``cache.py`` — per-layer
+``[slots, capacity, heads, head_dim]`` buffers, slots sharded on the
+data axes and heads on the model axis via the Partitioner rule
+tables), and exactly TWO program families serve all traffic:
+
+- **prefill** — bucketed like the forward engine (``prefill_buckets``
+  x ``seq_buckets`` shape buckets, one AOT compile each at
+  ``warmup()``): runs the ordinary full-context forward over a
+  right-padded prompt group, scatters every layer's K/V heads into the
+  group's slots, and emits each request's FIRST token (the TTFT
+  token). Ledgered as ``prefill`` in the ProgramLedger.
+- **decode_step** — ONE program regardless of traffic: one token for
+  every slot in the slot array per dispatch (inactive slots compute
+  masked garbage that is never delivered — the fixed shape is what
+  makes slot refill compile-free). Ledgered as ``decode_step``.
+
+Compilation discipline is the forward engine's, verbatim: explicit
+compile cache keyed on (program, buckets, mesh), ``warmup()``
+pre-compiles everything, ``compile_count`` pins at zero growth after
+warmup, and any post-warmup dispatch-path compile bumps
+``zk_serving_recompiles_total`` + a ``recompile_detected`` trace event
+(a recompile mid-traffic is a serving stall, and with continuous
+batching it stalls EVERY active stream at once).
+
+The cache is DONATED through both programs (the update is in-place on
+device; the engine always adopts the returned reference), while the
+weights are never donated and are read through ONE reference per
+dispatch — ``swap_weights`` is therefore atomic per dispatch exactly
+like the forward engine's (the per-SEQUENCE weight-version contract
+lives a level up, in ``DecodeScheduler.request_swap``).
+"""
+
+import logging
+import time
+from typing import Any, Sequence
+
+import numpy as np
+
+from zookeeper_tpu.core import Field, component
+from zookeeper_tpu.observability import trace as _trace
+from zookeeper_tpu.serving.decode.cache import (
+    allocate_kv_cache,
+    kv_cache_bytes,
+    pages_in_use,
+)
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["DecodeEngine"]
+
+
+@component
+class DecodeEngine:
+    """Paged/ring KV-cache decode engine over a cached-attention LM
+    module (``TransformerLMModule``-shaped: ``prefill`` and
+    ``decode_step`` apply methods sharing the ``__call__`` weights).
+
+    Configure the slot array and buckets as Fields; bind the runtime
+    objects with :meth:`bind`. The engine is the DEVICE half only —
+    request queueing, slot assignment, EOS/deadline bookkeeping and
+    streaming live in :class:`~zookeeper_tpu.serving.decode.scheduler.\
+DecodeScheduler`.
+    """
+
+    #: Concurrent sequence slots — the decode program's fixed batch.
+    #: More slots = more sequences per dispatch (throughput) at
+    #: slots x capacity KV HBM; keep it a multiple of the mesh's
+    #: data-axis product to serve with a sharded cache.
+    slots: int = Field(8)
+    #: Prompt-length buckets for the prefill program (ascending). One
+    #: compile per (prefill_bucket, seq_bucket) pair at warmup; a
+    #: prompt rides the smallest bucket that holds it (right padding —
+    #: causal attention keeps padded rows out of the emitted token).
+    seq_buckets: Sequence[int] = Field((16, 64))
+    #: Batch buckets for the prefill program: how many queued requests
+    #: one prefill dispatch admits together. Default singleton — one
+    #: request per prefill keeps warmup cheap; widen under high
+    #: admission rates.
+    prefill_buckets: Sequence[int] = Field((1,))
+    #: Per-slot KV capacity in TOKENS. -1 sizes it to the module's
+    #: positional table (``max_seq_len`` — nothing can decode past it
+    #: anyway); an explicit smaller value caps memory and truncates
+    #: generation at capacity. Rounded up to a ``page_size`` multiple.
+    kv_capacity: int = Field(-1)
+    #: KV page granularity (tokens): the accounting/alignment unit for
+    #: capacity and the ``kv_pages_in_use`` occupancy numbers.
+    page_size: int = Field(16)
+
+    # -- binding ---------------------------------------------------------
+
+    def bind(
+        self,
+        module: Any,
+        params: Any,
+        model_state: Any = None,
+        *,
+        partitioner: Any = None,
+    ) -> "DecodeEngine":
+        """Attach the LM module to decode. ``module`` must expose the
+        cached-attention seam (``prefill`` / ``decode_step`` methods
+        plus the ``num_layers/num_heads/d_model/max_seq_len/dtype``
+        geometry attributes — ``TransformerLMModule`` does).
+        ``partitioner`` defaults to single-device; pass the training
+        partitioner to decode under the training dp/tp layout (KV slots
+        shard over the data axes, heads over the model axis)."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        for method in ("prefill", "decode_step"):
+            if not hasattr(module, method):
+                raise ValueError(
+                    f"DecodeEngine needs a module with a {method!r} "
+                    "apply method (the cached-attention decode seam — "
+                    "see TransformerLMModule); got "
+                    f"{type(module).__name__}."
+                )
+        seq_buckets = tuple(int(s) for s in self.seq_buckets)
+        if not seq_buckets or any(s < 1 for s in seq_buckets) or list(
+            seq_buckets
+        ) != sorted(set(seq_buckets)):
+            raise ValueError(
+                f"seq_buckets={self.seq_buckets!r} must be a non-empty, "
+                "strictly-ascending tuple of positive lengths."
+            )
+        prefill_buckets = tuple(int(b) for b in self.prefill_buckets)
+        if not prefill_buckets or any(
+            b < 1 for b in prefill_buckets
+        ) or list(prefill_buckets) != sorted(set(prefill_buckets)):
+            raise ValueError(
+                f"prefill_buckets={self.prefill_buckets!r} must be a "
+                "non-empty, strictly-ascending tuple of positive sizes."
+            )
+        if self.slots < 1:
+            raise ValueError(f"slots={self.slots} must be >= 1.")
+        if max(prefill_buckets) > self.slots:
+            raise ValueError(
+                f"largest prefill bucket {max(prefill_buckets)} exceeds "
+                f"slots={self.slots}; a prefill group can never admit "
+                "more sequences than there are slots."
+            )
+        if self.page_size < 1:
+            raise ValueError(f"page_size={self.page_size} must be >= 1.")
+        position_cap = int(module.max_seq_len)
+        if self.kv_capacity == -1:
+            capacity = position_cap
+        elif self.kv_capacity > 0:
+            capacity = int(self.kv_capacity)
+        else:
+            raise ValueError(
+                f"kv_capacity={self.kv_capacity}: expected a positive "
+                "token capacity or -1 (size to the positional table)."
+            )
+        # Page-align up: the layout unit a paged kernel would gather.
+        capacity = -(-capacity // self.page_size) * self.page_size
+        if max(seq_buckets) > capacity:
+            raise ValueError(
+                f"largest seq bucket {max(seq_buckets)} exceeds the KV "
+                f"capacity {capacity}; shrink the buckets or raise "
+                "kv_capacity."
+            )
+        if max(seq_buckets) > position_cap:
+            # warmup() TRACES the prefill program at every bucket; a
+            # bucket past the positional table would die inside the
+            # module's forward — fail here with the config-level story.
+            raise ValueError(
+                f"largest seq bucket {max(seq_buckets)} exceeds the "
+                f"module's positional table ({position_cap}); prompts "
+                "can never be that long."
+            )
+
+        if partitioner is None:
+            from zookeeper_tpu.parallel.partitioner import (
+                SingleDevicePartitioner,
+            )
+
+            partitioner = SingleDevicePartitioner()
+        partitioner.setup()
+        object.__setattr__(self, "_module", module)
+        object.__setattr__(self, "_partitioner", partitioner)
+        object.__setattr__(self, "_seq_buckets", seq_buckets)
+        object.__setattr__(self, "_prefill_buckets", prefill_buckets)
+        object.__setattr__(self, "_capacity", capacity)
+        object.__setattr__(self, "_position_cap", position_cap)
+
+        variables = {"params": params, **dict(model_state or {})}
+        object.__setattr__(
+            self, "_variables", self._place_variables(variables)
+        )
+
+        head_dim = int(module.d_model) // int(module.num_heads)
+        cache = self._allocate_cache()
+        mesh = partitioner.mesh
+        cache_sharding = None
+        if mesh is not None:
+            cache_sharding = partitioner.decode_cache_sharding(cache)
+            if cache_sharding is not None:
+                # Divisibility: slots over the data axes, heads over the
+                # model axis. When the shapes cannot split, fall back to
+                # a fully-replicated cache (correct, memory-redundant)
+                # rather than dying — the compile_forward small-bucket
+                # posture.
+                try:
+                    jax.tree.map(
+                        lambda x, s: s.shard_shape(np.shape(x)),
+                        cache,
+                        cache_sharding,
+                    )
+                except (ValueError, ZeroDivisionError) as e:
+                    logger.warning(
+                        "KV cache [slots=%d, heads=%d] does not divide "
+                        "over the %s mesh (%s); decoding with a "
+                        "REPLICATED cache — size slots/heads in "
+                        "multiples of the mesh axes to shard",
+                        self.slots,
+                        int(module.num_heads),
+                        dict(mesh.shape),
+                        e,
+                    )
+                    cache_sharding = jax.tree.map(
+                        lambda _: NamedSharding(mesh, PartitionSpec()),
+                        cache,
+                    )
+        object.__setattr__(self, "_cache_sharding", cache_sharding)
+        object.__setattr__(self, "_cache", self._place_cache(cache))
+        object.__setattr__(self, "_cache_nbytes", kv_cache_bytes(
+            int(module.num_layers),
+            int(self.slots),
+            capacity,
+            int(module.num_heads),
+            head_dim,
+            np.dtype(module.dtype).itemsize,
+        ))
+        object.__setattr__(self, "_compiled_cache", {})
+        object.__setattr__(self, "_compile_count", 0)
+        object.__setattr__(self, "_warmed", False)
+        object.__setattr__(self, "_recompiles_detected", 0)
+        return self
+
+    def _place_variables(self, variables: Any) -> Any:
+        """One placement path shared by ``bind`` and ``swap_weights`` —
+        same contract as the forward engine's."""
+        import jax
+
+        sharding = self._partitioner.variables_sharding(variables)
+        if sharding is not None:
+            return jax.tree.map(jax.device_put, variables, sharding)
+        return jax.device_put(variables)
+
+    def _require_bound(self) -> None:
+        if getattr(self, "_module", None) is None:
+            raise RuntimeError(
+                "DecodeEngine is not bound: call engine.bind(module, "
+                "params, model_state) before warmup()/prefill()/decode()."
+            )
+
+    def _allocate_cache(self):
+        """The ONE cache-geometry call (``bind`` and ``_reset_cache``
+        must allocate identical trees — a layout change made in one
+        place would serve post-crash resubmits from a diverged cache)."""
+        module = self._module
+        return allocate_kv_cache(
+            int(module.num_layers),
+            int(self.slots),
+            self._capacity,
+            int(module.num_heads),
+            int(module.d_model) // int(module.num_heads),
+            module.dtype,
+        )
+
+    def _place_cache(self, cache):
+        """Place a cache tree under the bound sharding (replicated /
+        sharded / single-device) — shared by ``bind`` and
+        ``_reset_cache``."""
+        import jax
+
+        if self._cache_sharding is not None:
+            return jax.tree.map(jax.device_put, cache, self._cache_sharding)
+        return jax.device_put(cache)
+
+    def _reset_cache(self) -> None:
+        """Reallocate a fresh zeroed KV cache under the bound sharding.
+
+        The dispatch path DONATES the cache buffers; if the compiled
+        call itself raises (transient device/runtime failure), the old
+        buffers may already be invalidated while the success-path
+        reference assignment never ran — without this reset every later
+        dispatch would die on deleted arrays, breaking the scheduler's
+        resubmit-after-restart contract. A zeroed cache is consistent:
+        a crash fails every in-flight stream, so no slot's previous
+        contents are live."""
+        object.__setattr__(
+            self, "_cache", self._place_cache(self._allocate_cache())
+        )
+
+    # -- geometry --------------------------------------------------------
+
+    @property
+    def capacity(self) -> int:
+        """Per-slot KV capacity in tokens (page-aligned)."""
+        self._require_bound()
+        return self._capacity
+
+    @property
+    def position_cap(self) -> int:
+        """The module's positional-table bound: no sequence can extend
+        past ``min(position_cap, capacity)`` total tokens."""
+        self._require_bound()
+        return self._position_cap
+
+    @property
+    def token_limit(self) -> int:
+        """Hard per-sequence total-token bound (prompt + generated)."""
+        return min(self.capacity, self.position_cap)
+
+    @property
+    def max_prompt(self) -> int:
+        """Longest admissible prompt (the largest seq bucket)."""
+        self._require_bound()
+        return max(self._seq_buckets)
+
+    @property
+    def kv_cache_nbytes(self) -> int:
+        self._require_bound()
+        return self._cache_nbytes
+
+    def kv_pages_in_use(self, lengths) -> int:
+        """Occupancy accounting for the gauge/statusz (``lengths`` are
+        the ACTIVE slots' token counts)."""
+        return pages_in_use(lengths, int(self.page_size))
+
+    @property
+    def compile_count(self) -> int:
+        """XLA compiles so far. After ``warmup()`` this is exactly
+        ``len(prefill_buckets) * len(seq_buckets) + 1`` and continuous
+        slot refill must never move it."""
+        return getattr(self, "_compile_count", 0)
+
+    @property
+    def recompiles_detected(self) -> int:
+        """Post-warmup dispatch-path compiles (mirrored to
+        ``zk_serving_recompiles_total``)."""
+        return getattr(self, "_recompiles_detected", 0)
+
+    def seq_bucket_for(self, length: int) -> int:
+        for s in self._seq_buckets:
+            if s >= length:
+                return s
+        raise ValueError(
+            f"prompt of {length} tokens exceeds the largest seq bucket "
+            f"{max(self._seq_buckets)}; widen seq_buckets."
+        )
+
+    def prefill_bucket_for(self, n: int) -> int:
+        for b in self._prefill_buckets:
+            if b >= n:
+                return b
+        raise ValueError(
+            f"prefill group of {n} exceeds the largest prefill bucket "
+            f"{max(self._prefill_buckets)}."
+        )
+
+    # -- compile cache ---------------------------------------------------
+
+    def _note_dispatch_compile(self, key) -> None:
+        """Post-warmup compile on the dispatch path: the recompile
+        watchdog (shared counter with the forward engine — one series
+        alerts on ALL serving stalls)."""
+        from zookeeper_tpu.observability.registry import default_registry
+
+        object.__setattr__(
+            self,
+            "_recompiles_detected",
+            getattr(self, "_recompiles_detected", 0) + 1,
+        )
+        default_registry().counter(
+            "zk_serving_recompiles_total",
+            help="post-warmup compiles triggered on the request "
+            "path (each one is a serving stall)",
+        ).inc()
+        _trace.event("recompile_detected", attrs={"program": str(key)})
+        logger.warning(
+            "post-warmup decode-engine recompile on the dispatch path "
+            "(%s): every active stream is stalling on XLA — warm the "
+            "full bucket grid",
+            key,
+        )
+
+    def _replicated(self):
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        mesh = self._partitioner.mesh
+        if mesh is None:
+            return None
+        return NamedSharding(mesh, PartitionSpec())
+
+    def _aot(self, key: str, fn, example_args, *, donate_cache_at: int):
+        """AOT lower+compile ``fn`` with the engine's sharding
+        discipline, timed and recorded in the process ProgramLedger
+        under ``key`` ('prefill' / 'decode_step')."""
+        import jax
+
+        mesh = self._partitioner.mesh
+        if mesh is None:
+            jitted = jax.jit(fn, donate_argnums=(donate_cache_at,))
+        else:
+            repl = self._replicated()
+            vars_sh = self._partitioner.variables_sharding(self._variables)
+            if vars_sh is None:
+                vars_sh = jax.tree.map(lambda _: repl, self._variables)
+            cache_sh = self._cache_sharding
+            in_shardings = [vars_sh, cache_sh] + [
+                repl for _ in example_args[2:]
+            ]
+            out_shardings = (cache_sh, repl)
+            jitted = jax.jit(
+                fn,
+                in_shardings=tuple(in_shardings),
+                out_shardings=out_shardings,
+                donate_argnums=(donate_cache_at,),
+            )
+        t0 = time.perf_counter()
+        lowered = jitted.lower(*example_args)
+        t1 = time.perf_counter()
+        compiled = lowered.compile()
+        t2 = time.perf_counter()
+        from zookeeper_tpu.observability.ledger import default_ledger
+
+        mesh_desc = (
+            "x".join(f"{k}:{v}" for k, v in mesh.shape.items())
+            if mesh is not None
+            else "1"
+        )
+        default_ledger().record(
+            key.split("/")[0],
+            f"{type(self._partitioner).__name__}/mesh={mesh_desc}/{key}",
+            lowered=lowered,
+            compiled=compiled,
+            lower_ms=(t1 - t0) * 1e3,
+            compile_ms=(t2 - t1) * 1e3,
+            attrs={"slots": int(self.slots)},
+        )
+        object.__setattr__(self, "_compile_count", self._compile_count + 1)
+        return compiled
+
+    def _decode_compiled(self, *, during_dispatch: bool = False):
+        import jax
+        import jax.numpy as jnp
+
+        self._require_bound()
+        key = ("decode_step", self._partitioner.mesh)
+        cached = self._compiled_cache.get(key)
+        if cached is not None:
+            return cached
+        if during_dispatch and self._warmed:
+            self._note_dispatch_compile("decode_step")
+        module = self._module
+
+        def decode_fn(variables, cache, tokens, lengths):
+            logits, new_cache = module.apply(
+                variables, tokens, lengths, cache, method="decode_step"
+            )
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            return new_cache, nxt
+
+        n = int(self.slots)
+        example = (
+            self._variables,
+            self._cache,
+            jax.ShapeDtypeStruct((n,), np.int32),
+            jax.ShapeDtypeStruct((n,), np.int32),
+        )
+        compiled = self._aot(
+            "decode_step", decode_fn, example, donate_cache_at=1
+        )
+        self._compiled_cache[key] = compiled
+        return compiled
+
+    def _prefill_compiled(
+        self, pb: int, sb: int, *, during_dispatch: bool = False
+    ):
+        import jax
+        import jax.numpy as jnp
+
+        self._require_bound()
+        key = ("prefill", pb, sb, self._partitioner.mesh)
+        cached = self._compiled_cache.get(key)
+        if cached is not None:
+            return cached
+        if during_dispatch and self._warmed:
+            self._note_dispatch_compile(f"prefill/b{pb}s{sb}")
+        module = self._module
+
+        def prefill_fn(variables, cache, tokens, lengths, slot_ids):
+            last_logits, kv = module.apply(
+                variables, tokens, lengths, method="prefill"
+            )
+            new_cache = []
+            for layer, (k, v) in zip(cache, kv):
+                # Scatter the group's K/V heads into its slots' first
+                # sb rows. mode="drop": the PADDING rows of a partial
+                # group carry slot id == slots (out of bounds) and must
+                # write nowhere.
+                new_cache.append({
+                    "k": layer["k"].at[slot_ids, :sb].set(k, mode="drop"),
+                    "v": layer["v"].at[slot_ids, :sb].set(v, mode="drop"),
+                })
+            first = jnp.argmax(last_logits, axis=-1).astype(jnp.int32)
+            return tuple(new_cache), first
+
+        example = (
+            self._variables,
+            self._cache,
+            jax.ShapeDtypeStruct((pb, sb), np.int32),
+            jax.ShapeDtypeStruct((pb,), np.int32),
+            jax.ShapeDtypeStruct((pb,), np.int32),
+        )
+        compiled = self._aot(
+            f"prefill/b{pb}s{sb}", prefill_fn, example, donate_cache_at=1
+        )
+        self._compiled_cache[key] = compiled
+        return compiled
+
+    def warmup(self) -> int:
+        """Pre-compile the full program grid (every prefill bucket pair
+        + the decode step) so no stream ever waits on XLA. Returns the
+        number of cached executables."""
+        self._require_bound()
+        for pb in self._prefill_buckets:
+            for sb in self._seq_buckets:
+                self._prefill_compiled(pb, sb)
+        self._decode_compiled()
+        object.__setattr__(self, "_warmed", True)
+        return len(self._compiled_cache)
+
+    # -- dispatch --------------------------------------------------------
+
+    def prefill(self, prompts: Sequence[np.ndarray], slot_ids: Sequence[int]):
+        """Admit a group: write each prompt's KV into its slot and emit
+        each sequence's FIRST token. ``prompts`` are 1-D int arrays (up
+        to the largest prefill bucket of them, each at most
+        ``max_prompt`` tokens); ``slot_ids`` the target slots (unique).
+        Returns the first tokens as a host ``[len(prompts)] int32``
+        array. The TTFT token: the scheduler stamps time-to-first-token
+        off this call's readback."""
+        import jax
+
+        self._require_bound()
+        n = len(prompts)
+        if n == 0:
+            return np.zeros((0,), np.int32)
+        if n != len(set(int(s) for s in slot_ids)) or n != len(slot_ids):
+            raise ValueError(
+                f"slot_ids {list(slot_ids)!r} must be unique and match "
+                f"the {n} prompts."
+            )
+        lens = [int(np.shape(p)[0]) for p in prompts]
+        if min(lens) < 1:
+            raise ValueError("empty prompt is not servable.")
+        pb = self.prefill_bucket_for(n)
+        sb = self.seq_bucket_for(max(lens))
+        tokens = np.zeros((pb, sb), np.int32)
+        lengths = np.ones((pb,), np.int32)  # pad rows: len 1, dropped
+        ids = np.full((pb,), int(self.slots), np.int32)  # OOB => dropped
+        for i, (p, s) in enumerate(zip(prompts, slot_ids)):
+            tokens[i, : lens[i]] = np.asarray(p, np.int32)
+            lengths[i] = lens[i]
+            ids[i] = int(s)
+        compiled = self._prefill_compiled(pb, sb, during_dispatch=True)
+        with _trace.span(
+            "prefill_dispatch",
+            attrs=(
+                {"requests": n, "bucket": pb, "seq_bucket": sb}
+                if _trace.enabled()
+                else None
+            ),
+        ):
+            try:
+                new_cache, first = compiled(
+                    self._variables, self._cache, tokens, lengths, ids
+                )
+            except BaseException:
+                # Donation already consumed the old buffers: restore a
+                # usable (zeroed) cache before propagating so the
+                # restarted scheduler can serve resubmits.
+                self._reset_cache()
+                raise
+            object.__setattr__(self, "_cache", new_cache)
+            first = np.asarray(jax.device_get(first))
+        return first[:n].astype(np.int32)
+
+    def decode(self, tokens: np.ndarray, lengths: np.ndarray) -> np.ndarray:
+        """One token for EVERY slot: feed the current input token per
+        slot (each sits at position ``lengths[slot]``), write its K/V,
+        and return the argmax next token per slot as a host ``[slots]
+        int32`` array. Inactive slots ride along (fixed shape) — the
+        scheduler ignores their output and never advances their
+        lengths."""
+        import jax
+
+        self._require_bound()
+        tokens = np.asarray(tokens, np.int32)
+        lengths = np.asarray(lengths, np.int32)
+        if tokens.shape != (int(self.slots),) or lengths.shape != (
+            int(self.slots),
+        ):
+            raise ValueError(
+                f"decode expects [slots]={self.slots} token and length "
+                f"arrays, got {tokens.shape} / {lengths.shape}."
+            )
+        compiled = self._decode_compiled(during_dispatch=True)
+        with _trace.span(
+            "decode_dispatch",
+            attrs=(
+                {"slots": int(self.slots)} if _trace.enabled() else None
+            ),
+        ):
+            try:
+                new_cache, nxt = compiled(
+                    self._variables, self._cache, tokens, lengths
+                )
+            except BaseException:
+                self._reset_cache()  # donation consumed the buffers
+                raise
+            object.__setattr__(self, "_cache", new_cache)
+            nxt = np.asarray(jax.device_get(nxt))
+        return nxt.astype(np.int32)
+
+    # -- hot swap --------------------------------------------------------
+
+    def check_swap(self, params: Any, model_state: Any = None) -> Any:
+        """Validate a candidate weight set against the bound one
+        (structure + leaf shapes/dtypes — the compiled programs serve
+        ONE architecture) WITHOUT applying it. Returns the assembled
+        variables dict. Raises ``ValueError`` on mismatch."""
+        import jax
+
+        self._require_bound()
+        new = {"params": params, **dict(model_state or {})}
+        cur = self._variables
+        want_s, got_s = jax.tree.structure(cur), jax.tree.structure(new)
+        if want_s != got_s:
+            raise ValueError(
+                "swap_weights: new variables tree does not match the "
+                f"bound structure (bound {want_s}, got {got_s}); the "
+                "compiled decode programs serve ONE architecture."
+            )
+        bad = [
+            f"{np.shape(g)}/{np.dtype(getattr(g, 'dtype', type(g)))} where "
+            f"the engine serves {np.shape(w)}/{np.dtype(w.dtype)}"
+            for w, g in zip(jax.tree.leaves(cur), jax.tree.leaves(new))
+            if tuple(np.shape(g)) != tuple(np.shape(w))
+            or np.dtype(getattr(g, "dtype", np.float32)) != np.dtype(w.dtype)
+        ]
+        if bad:
+            raise ValueError(
+                "swap_weights: leaf shape/dtype mismatch — "
+                + "; ".join(bad[:4])
+                + (" ..." if len(bad) > 4 else "")
+                + ". The compiled prefill/decode programs were compiled "
+                "for the bound shapes; a differently-sized checkpoint "
+                "needs a fresh bind()."
+            )
+        return new
+
+    def swap_weights(self, params: Any, model_state: Any = None) -> None:
+        """Atomically replace the decoded weights WITHOUT recompiling
+        (one reference assignment; each dispatch reads the reference
+        once). NOTE: with continuous batching, per-DISPATCH atomicity
+        is not per-SEQUENCE atomicity — an in-flight stream would
+        straddle weight versions. ``DecodeScheduler.request_swap`` is
+        the seam that upholds the one-version-per-sequence contract;
+        call this directly only when no streams are in flight."""
+        new = self.check_swap(params, model_state)
+        with _trace.span("weight_swap"):
+            placed = self._place_variables(new)
+            object.__setattr__(self, "_variables", placed)

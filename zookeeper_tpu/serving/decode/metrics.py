@@ -1,0 +1,234 @@
+"""Decode-path observability: TTFT, per-token latency, slot occupancy.
+
+The decode analogue of :class:`~zookeeper_tpu.serving.metrics.\
+ServingMetrics`, built the same way on the typed registry
+(docs/DESIGN.md §13): every lifetime total is a Counter, every sampled
+series feeds a bounded window (exact ``np.percentile`` snapshots) plus
+a fixed-bucket Histogram (live ``/metrics`` scraping), recorders are
+O(1) and thread-safe. Every instrument renders as ``zk_decode_*`` in
+Prometheus text exposition — the CI scrape smoke asserts the whole
+family.
+
+The tracked quantities are the decode cost model's levers
+(docs/DESIGN.md §15):
+
+- ``zk_decode_ttft_ms`` — submit-to-first-token wall time (prefill
+  queue wait + the bucketed prefill dispatch): the interactive-latency
+  number, dominated by slot availability under load.
+- ``zk_decode_token_ms`` — wall time of one decode dispatch (one token
+  for EVERY active slot): the steady-state streaming rate; tokens/s =
+  active_slots / token_ms.
+- ``zk_decode_active_slots`` / ``zk_decode_slot_occupancy`` — how full
+  the slot array runs; sustained occupancy 1.0 with queue depth > 0
+  means the slot array, not the chip, is the bottleneck (add slots).
+- ``zk_decode_kv_pages_in_use`` — live KV pages across active slots
+  (page-granular occupancy of the provisioned cache HBM).
+"""
+
+from collections import deque
+from typing import Dict, Mapping, Optional
+
+import numpy as np
+
+from zookeeper_tpu.core import Field, component
+from zookeeper_tpu.observability.registry import (
+    DEFAULT_MS_BUCKETS,
+    MetricsRegistry,
+)
+from zookeeper_tpu.serving.metrics import (
+    _emit_snapshot,
+    _get_or_build_obs,
+    _observe_sample,
+    _reset_obs,
+    _window_series,
+)
+
+__all__ = ["DecodeMetrics"]
+
+_PREFIX = "zk_decode_"
+
+#: Lifetime counters, in ``totals`` reporting order.
+_COUNTER_NAMES = (
+    # Generated tokens delivered to streams (the throughput numerator).
+    "tokens_total",
+    "requests_total",
+    # Prefill dispatches (slot admissions — continuous-batching refills
+    # included; requests_total - slots at steady state ~= refills).
+    "prefills_total",
+    # Decode dispatches (each serves every active slot one token).
+    "decode_steps_total",
+    # PR 4 admission-control family.
+    "rejected_total",
+    "deadline_expired_total",
+    "worker_restarts_total",
+    "weight_swaps_total",
+)
+
+
+@component
+class DecodeMetrics:
+    """Bounded-window aggregator for decode samples (see module
+    docstring); API shape mirrors ``ServingMetrics``."""
+
+    #: Samples retained per series; percentiles reduce this window.
+    window: int = Field(4096)
+
+    # -- lazy state ------------------------------------------------------
+
+    def _obs(self) -> dict:
+        return _get_or_build_obs(self, self._build_obs)
+
+    def _build_obs(self) -> dict:
+        registry = MetricsRegistry()
+        return {
+            "registry": registry,
+            "counters": {
+                name: registry.counter(
+                    _PREFIX + name, help=f"lifetime decode {name}"
+                )
+                for name in _COUNTER_NAMES
+            },
+            "gauges": {
+                "active_slots": registry.gauge(
+                    _PREFIX + "active_slots",
+                    help="sequence slots currently decoding",
+                ),
+                "slot_occupancy": registry.gauge(
+                    _PREFIX + "slot_occupancy",
+                    help="active slots / total slots (1.0 = the slot "
+                    "array is the bottleneck when the queue is nonempty)",
+                ),
+                "queue_depth": registry.gauge(
+                    _PREFIX + "queue_depth",
+                    help="requests waiting for a slot",
+                ),
+                "kv_pages_in_use": registry.gauge(
+                    _PREFIX + "kv_pages_in_use",
+                    help="KV pages holding live tokens across active "
+                    "slots",
+                ),
+                "weights_step": registry.gauge(
+                    _PREFIX + "serving_weights_step",
+                    help="training step whose weights are live (-1 = "
+                    "bind-time weights)",
+                    initial=-1,
+                ),
+            },
+            "hist": {
+                "ttft_ms": registry.histogram(
+                    _PREFIX + "ttft_ms",
+                    buckets=DEFAULT_MS_BUCKETS,
+                    help="submit-to-first-token wall time",
+                ),
+                "token_ms": registry.histogram(
+                    _PREFIX + "token_ms",
+                    buckets=DEFAULT_MS_BUCKETS,
+                    help="one decode dispatch (one token per active "
+                    "slot)",
+                ),
+                "prefill_ms": registry.histogram(
+                    _PREFIX + "prefill_ms",
+                    buckets=DEFAULT_MS_BUCKETS,
+                    help="one prefill dispatch (KV write + first token)",
+                ),
+            },
+            "windows": {},
+        }
+
+    @property
+    def registry(self) -> MetricsRegistry:
+        """The typed instrument registry — attach to an
+        ``ObservabilityServer`` to scrape every ``zk_decode_*`` series."""
+        return self._obs()["registry"]
+
+    def _series(self, name: str) -> deque:
+        return _window_series(self._obs(), name, self.window)
+
+    def _observe(self, name: str, value: float) -> None:
+        _observe_sample(self._obs(), name, value, self.window)
+
+    # -- recorders (called by DecodeScheduler) ---------------------------
+
+    def record_ttft(self, ttft_ms: float) -> None:
+        """A request's first token landed (prefill emission)."""
+        self._observe("ttft_ms", ttft_ms)
+
+    def record_prefill(self, prefill_ms: float, requests: int) -> None:
+        obs = self._obs()
+        self._observe("prefill_ms", prefill_ms)
+        obs["counters"]["prefills_total"].inc()
+        obs["counters"]["requests_total"].inc(int(requests))
+
+    def record_decode_step(self, step_ms: float, tokens: int) -> None:
+        """One decode dispatch delivered ``tokens`` stream tokens."""
+        obs = self._obs()
+        self._observe("token_ms", step_ms)
+        obs["counters"]["decode_steps_total"].inc()
+        obs["counters"]["tokens_total"].inc(int(tokens))
+
+    def record_first_tokens(self, n: int) -> None:
+        """Prefill-emitted tokens count toward the stream total too."""
+        self._obs()["counters"]["tokens_total"].inc(int(n))
+
+    def record_occupancy(
+        self, active: int, slots: int, queue_depth: int, kv_pages: int
+    ) -> None:
+        gauges = self._obs()["gauges"]
+        gauges["active_slots"].set(int(active))
+        gauges["slot_occupancy"].set(active / slots if slots else 0.0)
+        gauges["queue_depth"].set(int(queue_depth))
+        gauges["kv_pages_in_use"].set(int(kv_pages))
+
+    def record_rejected(self) -> None:
+        self._obs()["counters"]["rejected_total"].inc()
+
+    def record_deadline_expired(self) -> None:
+        self._obs()["counters"]["deadline_expired_total"].inc()
+
+    def record_worker_restart(self) -> None:
+        self._obs()["counters"]["worker_restarts_total"].inc()
+
+    def record_weight_swap(self, step: Optional[int] = None) -> None:
+        obs = self._obs()
+        obs["counters"]["weight_swaps_total"].inc()
+        if step is not None:
+            obs["gauges"]["weights_step"].set(int(step))
+
+    # -- reduction -------------------------------------------------------
+
+    @property
+    def totals(self) -> Dict[str, int]:
+        obs = self._obs()
+        return {
+            name: int(obs["counters"][name].value)
+            for name in _COUNTER_NAMES
+        }
+
+    def snapshot(self) -> Dict[str, float]:
+        """Flat aggregate of the current windows + totals (absent
+        series omitted — an idle engine emits only counters)."""
+        windows = self._obs()["windows"]
+        out: Dict[str, float] = {
+            k: float(v) for k, v in self.totals.items()
+        }
+        for name in ("ttft_ms", "token_ms", "prefill_ms"):
+            series = windows.get(name)
+            if series:
+                arr = np.asarray(series)
+                out[f"{name[:-3]}_p50_ms"] = float(np.percentile(arr, 50))
+                out[f"{name[:-3]}_p99_ms"] = float(np.percentile(arr, 99))
+                out[f"{name[:-3]}_mean_ms"] = float(arr.mean())
+        return out
+
+    def emit(
+        self, writer, step: int = 0, extra: Optional[Mapping[str, float]] = None
+    ) -> Dict[str, float]:
+        """Write the snapshot through a training-family MetricsWriter
+        under the ``decode/`` prefix; returns the snapshot."""
+        return _emit_snapshot(self, writer, step, extra, "decode")
+
+    def reset(self) -> None:
+        """Zero every series IN PLACE (instrument identity preserved —
+        a live ``/metrics`` server keeps rendering; same contract as
+        ``ServingMetrics.reset``)."""
+        _reset_obs(self)

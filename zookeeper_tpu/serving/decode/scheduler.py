@@ -1,0 +1,862 @@
+"""Slot-refill continuous batching: the host loop over the two decode
+programs.
+
+The ``MicroBatcher`` coalesces independent forwards; autoregressive
+streams need a different shape — a sequence OCCUPIES device state (its
+KV slot) across many dispatches, so the scheduling unit is the SLOT,
+not the request. The ``DecodeScheduler`` owns that loop:
+
+1. **Admit**: free slots are refilled from the FIFO queue — a group of
+   queued prompts rides one bucketed prefill dispatch, which writes
+   their KV pages and emits each request's first token (the TTFT
+   emission). A finished sequence's slot is refilled WITHOUT draining
+   or recompiling anything: the decode program's shape is the full
+   slot array, always.
+2. **Decode**: one ``decode_step`` dispatch advances EVERY active slot
+   one token; tokens stream into each request's
+   :class:`DecodeStream` as they are read back.
+3. **Finish**: EOS, per-request ``max_new_tokens``, the engine's
+   KV/positional capacity, or a deadline ends a stream and frees its
+   slot for the next admit round.
+
+Admission control is the PR 4 machinery re-expressed for streams:
+``shed_above`` sheds with :class:`RejectedError` before enqueueing,
+per-request deadlines fail with :class:`DeadlineExpiredError` — at
+admission planning (never prefilled late) and mid-stream (a stream
+never runs past its deadline; ``result()`` never blocks past it) —
+and an injected or real crash of the scheduling loop fails every
+queued AND in-flight stream cleanly with :class:`WorkerCrashedError`
+(``FaultPlan.decode_worker_crash`` drives the leg deterministically),
+restarting on the next ``submit()``.
+
+Weight hot-swaps go through :meth:`request_swap`, which upholds the
+one-weight-version-per-SEQUENCE contract the dispatch-atomic
+``swap_weights`` alone cannot (a stream spans many dispatches): the
+swap is deferred, admission pauses so the slot array drains naturally
+(bounded by ``max_new_tokens``/deadlines), and the swap applies at the
+first empty-slot-array boundary — every in-flight stream finishes
+entirely on the weights it started with, every stream admitted after
+the swap runs entirely on the new ones.
+
+Threading mirrors the batcher: ``synchronous=True`` (default) is
+thread- and clock-free — the caller drives via ``drain()`` /
+``result()`` (deterministic tier-1 mode; deadline tests use
+``deadline_ms=0`` = expiry-by-construction); async mode runs the loop
+on one ``zk-decode-scheduler`` daemon thread.
+"""
+
+import logging
+import threading
+import time
+from collections import deque
+from typing import Any, List, Optional
+
+import numpy as np
+
+from zookeeper_tpu.core import Field, component
+from zookeeper_tpu.observability import trace as _trace
+from zookeeper_tpu.serving.batcher import (
+    DeadlineExpiredError,
+    RejectedError,
+    WorkerCrashedError,
+)
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["DecodeScheduler", "DecodeStream"]
+
+
+class DecodeStream:
+    """Handle for one generation request: tokens stream in as the
+    scheduler produces them; ``result()`` yields the full generated
+    array. Iterating the handle yields tokens incrementally (in
+    synchronous mode iteration DRIVES the scheduler, like
+    ``PendingResult.result`` drives the batcher)."""
+
+    def __init__(
+        self,
+        scheduler: "DecodeScheduler",
+        prompt: np.ndarray,
+        max_new_tokens: int,
+        deadline_at: Optional[float],
+        eos_token: Optional[int],
+    ) -> None:
+        self._scheduler = scheduler
+        self.prompt = prompt
+        self._max_new = int(max_new_tokens)
+        self._deadline_at = deadline_at
+        self._eos = eos_token
+        self._tokens: List[int] = []
+        self._done = False
+        self._error: Optional[BaseException] = None
+        self._finish_reason: Optional[str] = None
+        self._t_submit = time.perf_counter()
+        #: Submit-to-first-token milliseconds (None until it lands).
+        self.ttft_ms: Optional[float] = None
+        # Completion races between the worker (finish), a crash handler
+        # (fail) and the caller's deadline expiry: first wins.
+        self._cond = threading.Condition()
+
+    # -- state -----------------------------------------------------------
+
+    @property
+    def done(self) -> bool:
+        return self._done
+
+    @property
+    def error(self) -> Optional[BaseException]:
+        return self._error
+
+    @property
+    def finish_reason(self) -> Optional[str]:
+        """"eos" / "length" (max_new_tokens) / "capacity" (KV or
+        positional limit) — None while streaming or on failure."""
+        return self._finish_reason
+
+    @property
+    def tokens_so_far(self) -> np.ndarray:
+        """Generated tokens delivered so far (valid even for a stream
+        that later failed on deadline/crash — partial output is real
+        output)."""
+        with self._cond:
+            return np.asarray(self._tokens, np.int32)
+
+    def expired(self, now: Optional[float] = None) -> bool:
+        if self._deadline_at is None:
+            return False
+        return (
+            time.perf_counter() if now is None else now
+        ) >= self._deadline_at
+
+    # -- scheduler-side transitions --------------------------------------
+
+    def _deliver(self, token: int) -> None:
+        with self._cond:
+            if self._done:
+                return
+            self._tokens.append(int(token))
+            self._cond.notify_all()
+
+    def _finish(self, reason: str) -> None:
+        with self._cond:
+            if self._done:
+                return
+            self._done = True
+            self._finish_reason = reason
+            self._cond.notify_all()
+
+    def _fail(self, error: BaseException) -> bool:
+        with self._cond:
+            if self._done:
+                return False
+            self._done = True
+            self._error = error
+            self._cond.notify_all()
+            return True
+
+    def _expire(self) -> bool:
+        waited_ms = (time.perf_counter() - self._t_submit) * 1e3
+        return self._fail(
+            DeadlineExpiredError(
+                f"generation deadline expired after {waited_ms:.1f}ms "
+                f"({len(self._tokens)} of {self._max_new} tokens "
+                "generated; partial output in tokens_so_far)"
+            )
+        )
+
+    # -- caller side -----------------------------------------------------
+
+    def result(self, timeout: Optional[float] = None) -> np.ndarray:
+        """The full generated token array. Synchronous mode drives the
+        scheduler to completion; async mode blocks — but NEVER past the
+        request's deadline (on expiry the stream fails with
+        :class:`DeadlineExpiredError` even if the worker is stalled)."""
+        if not self._done:
+            self._scheduler._drive(self, timeout)
+        if self._error is not None:
+            raise self._error
+        return np.asarray(self._tokens, np.int32)
+
+    def __iter__(self):
+        """Incremental token stream (generated tokens, in order)."""
+        served = 0
+        while True:
+            with self._cond:
+                available = len(self._tokens)
+            while served < available:
+                yield self._tokens[served]
+                served += 1
+            if self._done:
+                if self._error is not None:
+                    raise self._error
+                with self._cond:
+                    remaining = self._tokens[served:]
+                yield from remaining
+                return
+            self._scheduler._advance(self)
+
+
+@component
+class DecodeScheduler:
+    """Continuous-batching scheduler over a
+    :class:`~zookeeper_tpu.serving.decode.engine.DecodeEngine` (see
+    module docstring)."""
+
+    #: Default generation budget per request (``submit`` overrides).
+    max_new_tokens: int = Field(32)
+    #: Default per-request deadline in ms (0 = none); ``submit``'s
+    #: ``deadline_ms`` overrides. Expired requests fail with
+    #: :class:`DeadlineExpiredError` — queued, mid-stream, and in
+    #: ``result()`` (which never blocks past it).
+    default_deadline_ms: float = Field(0.0)
+    #: Load-shedding threshold in QUEUED REQUESTS (0 = off): a submit
+    #: that would grow the wait queue past this raises
+    #: :class:`RejectedError` instead of queueing — overload fails
+    #: fast. An empty queue always admits one request.
+    shed_above: int = Field(0)
+    #: Backpressure bound on the wait queue (requests): synchronous
+    #: mode drains the backlog inline, async mode blocks the submitter.
+    max_queue: int = Field(4096)
+    #: End-of-sequence token id (-1 = none); ``submit`` overrides.
+    #: Generation stops WITH the EOS token delivered.
+    eos_token: int = Field(-1)
+    #: Thread- and clock-free deterministic mode (tier-1 default):
+    #: the caller drives via drain()/result(). False = one
+    #: ``zk-decode-scheduler`` daemon thread runs the loop.
+    synchronous: bool = Field(True)
+
+    # -- wiring ----------------------------------------------------------
+
+    def bind(self, engine, metrics=None) -> "DecodeScheduler":
+        if self.max_new_tokens < 1:
+            raise ValueError(
+                f"max_new_tokens={self.max_new_tokens} must be >= 1 "
+                "(prefill always emits one token)."
+            )
+        if self.shed_above < 0 or self.default_deadline_ms < 0:
+            raise ValueError(
+                f"shed_above={self.shed_above} and default_deadline_ms="
+                f"{self.default_deadline_ms} must be >= 0 (0 disables)."
+            )
+        if self.max_queue < 1:
+            raise ValueError(f"max_queue={self.max_queue} must be >= 1.")
+        engine._require_bound()
+        object.__setattr__(self, "_engine", engine)
+        object.__setattr__(self, "_metrics", metrics)
+        n = int(engine.slots)
+        object.__setattr__(self, "_queue", deque())
+        object.__setattr__(self, "_slot_stream", [None] * n)
+        object.__setattr__(self, "_slot_lengths", np.zeros(n, np.int64))
+        object.__setattr__(self, "_slot_tokens", np.zeros(n, np.int32))
+        object.__setattr__(self, "_lock", threading.RLock())
+        # Serializes scheduler ITERATIONS (plan -> dispatch -> commit)
+        # so ``_lock`` can be released across the device dispatches:
+        # submit()/status() only ever wait on bookkeeping, never on a
+        # prefill/decode wall time (the MicroBatcher dispatch-outside-
+        # the-lock discipline).
+        object.__setattr__(self, "_step_lock", threading.Lock())
+        object.__setattr__(self, "_cv", threading.Condition())
+        object.__setattr__(self, "_worker", None)
+        object.__setattr__(self, "_stop", threading.Event())
+        object.__setattr__(self, "_swap_pending", None)
+        return self
+
+    def _require_bound(self) -> None:
+        if getattr(self, "_engine", None) is None:
+            raise RuntimeError(
+                "DecodeScheduler is not bound: call "
+                "scheduler.bind(engine) before submit()."
+            )
+
+    # -- submission ------------------------------------------------------
+
+    def _deadline_at(self, deadline_ms: Optional[float]) -> Optional[float]:
+        if deadline_ms is None:
+            deadline_ms = self.default_deadline_ms or None
+        if deadline_ms is None:
+            return None
+        if deadline_ms < 0:
+            raise ValueError(f"deadline_ms={deadline_ms} must be >= 0.")
+        return time.perf_counter() + deadline_ms / 1e3
+
+    def submit(
+        self,
+        prompt: Any,
+        *,
+        max_new_tokens: Optional[int] = None,
+        deadline_ms: Optional[float] = None,
+        eos_token: Optional[int] = None,
+    ) -> DecodeStream:
+        """Enqueue one prompt (1-D int tokens); returns a
+        :class:`DecodeStream`. ``deadline_ms=None`` falls back to the
+        component default (0 = none) while an EXPLICIT ``0`` is
+        already-expired (the deterministic clock-free chaos idiom).
+        Raises :class:`RejectedError` without enqueueing past the shed
+        threshold."""
+        self._require_bound()
+        prompt = np.asarray(prompt, np.int32)
+        if prompt.ndim != 1 or prompt.shape[0] < 1:
+            raise ValueError(
+                f"prompt must be a non-empty 1-D int token array, got "
+                f"shape {prompt.shape}."
+            )
+        engine = self._engine
+        if prompt.shape[0] > engine.max_prompt:
+            raise ValueError(
+                f"prompt of {prompt.shape[0]} tokens exceeds the "
+                f"largest seq bucket {engine.max_prompt}; widen "
+                "engine.seq_buckets."
+            )
+        if prompt.shape[0] >= engine.token_limit:
+            # token_limit is the hard TOTAL (prompt + generated); a
+            # prompt at or past it leaves no room to emit even the
+            # first token within the truncate-at-EXACTLY-token_limit
+            # contract (docs/DESIGN.md §15).
+            raise ValueError(
+                f"prompt of {prompt.shape[0]} tokens leaves no room to "
+                f"generate within token_limit={engine.token_limit} "
+                f"(min of KV capacity {engine.capacity} and positional "
+                f"table {engine.position_cap}); shorten the prompt or "
+                "raise kv_capacity / the model's max_seq_len."
+            )
+        new = int(
+            max_new_tokens if max_new_tokens is not None
+            else self.max_new_tokens
+        )
+        if new < 1:
+            raise ValueError(f"max_new_tokens={new} must be >= 1.")
+        eos = eos_token if eos_token is not None else (
+            int(self.eos_token) if int(self.eos_token) >= 0 else None
+        )
+        stream = DecodeStream(
+            self,
+            prompt,
+            new,
+            self._deadline_at(deadline_ms),
+            eos,
+        )
+        with self._lock:
+            if (
+                self.shed_above > 0
+                and self._queue
+                and len(self._queue) + 1 > self.shed_above
+            ):
+                if self._metrics is not None:
+                    self._metrics.record_rejected()
+                if _trace.enabled():
+                    _trace.event(
+                        "decode_request_shed",
+                        attrs={"queue_depth": len(self._queue)},
+                    )
+                raise RejectedError(
+                    f"decode queue at {len(self._queue)} requests; "
+                    f"admitting one more would exceed shed_above="
+                    f"{self.shed_above} — request shed (service "
+                    "overloaded, retry with backoff)."
+                )
+            backpressure = len(self._queue) + 1 > self.max_queue
+            if not backpressure:
+                self._queue.append(stream)
+                if _trace.enabled():
+                    _trace.event(
+                        "decode_request_enqueue",
+                        attrs={
+                            "prompt_tokens": int(prompt.shape[0]),
+                            "queue_depth": len(self._queue),
+                        },
+                    )
+        if backpressure:
+            if self.synchronous:
+                self.drain()  # serve the backlog inline, then queue
+                with self._lock:
+                    self._queue.append(stream)
+            else:
+                while True:
+                    with self._lock:
+                        if len(self._queue) + 1 <= self.max_queue:
+                            self._queue.append(stream)
+                            break
+                    if self._stop.is_set():
+                        raise RuntimeError(
+                            "DecodeScheduler closed while submit was "
+                            "blocked on backpressure."
+                        )
+                    # Bounded cv wait, not a busy-poll: the scheduler
+                    # notifies per iteration; the timeout re-checks
+                    # _stop/worker death (no lost-wakeup hang).
+                    with self._cv:
+                        self._cv.wait(0.01)
+        if not self.synchronous:
+            self._ensure_worker()
+            with self._cv:
+                self._cv.notify_all()
+        return stream
+
+    def generate(self, prompt: Any, **kwargs) -> np.ndarray:
+        """Submit + block for the full generation — the one-call API
+        (``tokens = scheduler.generate(prompt, max_new_tokens=64)``)."""
+        return self.submit(prompt, **kwargs).result()
+
+    # -- weight hot-swap -------------------------------------------------
+
+    def request_swap(
+        self, params: Any, model_state: Any = None, *, step: Optional[int] = None
+    ) -> None:
+        """Stage a weight hot-swap that preserves the one-weight-
+        version-per-sequence contract: validation runs HERE (config
+        bugs surface at the call site), admission pauses, in-flight
+        streams finish on the weights they started with, and the swap
+        applies at the first empty-slot-array boundary — zero
+        recompiles. A second request before the first applies REPLACES
+        it (newest wins, like the async checkpointer's supersede)."""
+        self._require_bound()
+        self._engine.check_swap(params, model_state)
+        with self._lock:
+            object.__setattr__(
+                self, "_swap_pending", (params, model_state, step)
+            )
+        if not self.synchronous:
+            self._ensure_worker()
+            with self._cv:
+                self._cv.notify_all()
+
+    @property
+    def swap_pending(self) -> bool:
+        return getattr(self, "_swap_pending", None) is not None
+
+    def _maybe_apply_swap(self) -> None:
+        pending = getattr(self, "_swap_pending", None)
+        if pending is None:
+            return
+        if any(s is not None for s in self._slot_stream):
+            return  # in-flight sequences keep their weight version
+        params, model_state, step = pending
+        self._engine.swap_weights(params, model_state)
+        object.__setattr__(self, "_swap_pending", None)
+        _trace.event(
+            "decode_weight_swap",
+            step=step,
+            attrs={"deferred": True},
+        )
+        if self._metrics is not None:
+            self._metrics.record_weight_swap(step)
+        logger.info(
+            "decode weights hot-swapped%s (slot array drained, no "
+            "recompile)",
+            f" to training step {step}" if step is not None else "",
+        )
+
+    # -- the scheduling loop ---------------------------------------------
+
+    def _has_work(self) -> bool:
+        with self._lock:
+            return bool(self._queue) or any(
+                s is not None for s in self._slot_stream
+            )
+
+    def _free_slot(self, slot: int) -> None:
+        self._slot_stream[slot] = None
+
+    def _finish_or_continue(self, slot: int, token: int) -> None:
+        """Deliver ``token`` to the slot's stream and retire the slot
+        when the stream is complete. Caller holds the lock."""
+        stream = self._slot_stream[slot]
+        stream._deliver(token)
+        reason = None
+        if stream._eos is not None and token == stream._eos:
+            reason = "eos"
+        elif len(stream._tokens) >= stream._max_new:
+            reason = "length"
+        elif self._slot_lengths[slot] + 1 >= self._engine.token_limit:
+            # The sequence now totals token_limit tokens (cached
+            # lengths + the token just delivered): feeding the delivered
+            # token back would write past the KV capacity or the
+            # positional table. Truncate at EXACTLY token_limit, so
+            # every delivered token is full-context-oracle-verifiable.
+            reason = "capacity"
+        if reason is not None:
+            stream._finish(reason)
+            self._free_slot(slot)
+            if _trace.enabled():
+                _trace.event(
+                    "decode_stream_finish",
+                    attrs={
+                        "slot": slot,
+                        "reason": reason,
+                        "tokens": len(stream._tokens),
+                    },
+                )
+
+    def _expire_queued(self) -> None:
+        now = time.perf_counter()
+        if not any(s.expired(now) for s in self._queue):
+            return
+        kept = deque()
+        for stream in self._queue:
+            if stream.expired(now):
+                if stream._expire() and self._metrics is not None:
+                    self._metrics.record_deadline_expired()
+            else:
+                kept.append(stream)
+        object.__setattr__(self, "_queue", kept)
+
+    def _expire_active(self) -> None:
+        now = time.perf_counter()
+        for slot, stream in enumerate(self._slot_stream):
+            if stream is not None and stream.expired(now):
+                if stream._expire() and self._metrics is not None:
+                    self._metrics.record_deadline_expired()
+                self._free_slot(slot)
+
+    def _admit(self) -> None:
+        """Refill free slots from the queue head: one bucketed prefill
+        dispatch per admitted group. Paused while a weight swap is
+        pending (the drain that makes the swap safe). Caller holds
+        ``_step_lock``; ``_lock`` is taken per phase so the prefill
+        dispatch itself runs unlocked — admitted streams are RESERVED
+        into the slot array first, so ``close()``/``_on_crash`` see
+        (and can fail) them mid-dispatch."""
+        engine = self._engine
+        while True:
+            with self._lock:
+                if self._swap_pending is not None or not self._queue:
+                    return
+                free = [
+                    i for i, s in enumerate(self._slot_stream) if s is None
+                ]
+                if not free:
+                    return
+                group: List[DecodeStream] = []
+                slots: List[int] = []
+                cap = min(len(free), max(engine._prefill_buckets))
+                while self._queue and len(group) < cap:
+                    stream = self._queue.popleft()
+                    if stream.expired():
+                        if stream._expire() and self._metrics is not None:
+                            self._metrics.record_deadline_expired()
+                        continue
+                    group.append(stream)
+                    slots.append(free[len(group) - 1])
+                if not group:
+                    continue
+                for stream, slot in zip(group, slots):
+                    self._slot_stream[slot] = stream
+                    self._slot_lengths[slot] = int(stream.prompt.shape[0])
+            t0 = time.perf_counter()
+            first = engine.prefill([s.prompt for s in group], slots)
+            dt_ms = (time.perf_counter() - t0) * 1e3
+            with self._lock:
+                now = time.perf_counter()
+                delivered = 0
+                for stream, slot, token in zip(group, slots, first):
+                    if self._slot_stream[slot] is not stream:
+                        continue  # failed by close()/crash mid-dispatch
+                    stream.ttft_ms = (now - stream._t_submit) * 1e3
+                    if self._metrics is not None:
+                        self._metrics.record_ttft(stream.ttft_ms)
+                    self._slot_tokens[slot] = int(token)
+                    self._finish_or_continue(slot, int(token))
+                    delivered += 1
+                if self._metrics is not None:
+                    # Count tokens/requests actually DELIVERED (a
+                    # stream failed mid-dispatch got no token) — the
+                    # dispatch itself still counts once.
+                    self._metrics.record_prefill(dt_ms, delivered)
+                    self._metrics.record_first_tokens(delivered)
+
+    def _decode(self) -> None:
+        """One decode dispatch over the whole slot array; deliver each
+        active slot's token. Caller holds ``_step_lock``; the dispatch
+        runs outside ``_lock`` over a snapshot of the slot arrays — a
+        slot whose stream was failed mid-dispatch (``close()``, crash)
+        skips delivery (its cache row write is masked garbage at
+        ``j >= length`` for the next occupant, per the refill
+        invariant)."""
+        engine = self._engine
+        with self._lock:
+            snapshot = list(self._slot_stream)
+            active = [i for i, s in enumerate(snapshot) if s is not None]
+            if not active:
+                return
+            tokens = self._slot_tokens.astype(np.int32)
+            lengths = self._slot_lengths.astype(np.int32)
+        t0 = time.perf_counter()
+        nxt = engine.decode(tokens, lengths)
+        dt_ms = (time.perf_counter() - t0) * 1e3
+        with self._lock:
+            delivered = 0
+            for slot in active:
+                if self._slot_stream[slot] is not snapshot[slot]:
+                    continue  # failed by close()/crash mid-dispatch
+                self._slot_lengths[slot] += 1
+                token = int(nxt[slot])
+                self._slot_tokens[slot] = token
+                self._finish_or_continue(slot, token)
+                delivered += 1
+            if self._metrics is not None:
+                self._metrics.record_decode_step(dt_ms, delivered)
+
+    def _update_occupancy(self) -> None:
+        if self._metrics is None:
+            return
+        active_lengths = [
+            int(self._slot_lengths[i])
+            for i, s in enumerate(self._slot_stream)
+            if s is not None
+        ]
+        self._metrics.record_occupancy(
+            len(active_lengths),
+            int(self._engine.slots),
+            len(self._queue),
+            self._engine.kv_pages_in_use(active_lengths),
+        )
+
+    def _step_once(self) -> bool:
+        """One scheduler iteration: swap boundary, deadline sweeps,
+        admit (prefill), decode. Returns whether work remains.
+
+        ``_step_lock`` serializes iterations (sync mode admits
+        multi-threaded callers); ``_lock`` guards only the bookkeeping
+        phases and is RELEASED across the device dispatches inside
+        ``_admit``/``_decode`` so a concurrent ``submit()`` or
+        ``/statusz`` ``status()`` never waits out a prefill or decode
+        wall time."""
+        from zookeeper_tpu.resilience import faults
+
+        with self._step_lock:
+            with self._lock:
+                plan = faults.active()
+                if plan is not None and plan.take_decode_worker_crash():
+                    raise WorkerCrashedError(
+                        "injected decode scheduler crash "
+                        "(FaultPlan.decode_worker_crash)"
+                    )
+                self._maybe_apply_swap()
+                self._expire_queued()
+                self._expire_active()
+            self._admit()
+            self._decode()
+            with self._lock:
+                self._maybe_apply_swap()  # slot array may have drained
+                self._update_occupancy()
+        # Wake backpressured submitters and drain()/iterator waiters:
+        # queue room and stream progress both change per iteration.
+        with self._cv:
+            self._cv.notify_all()
+        return self._has_work()
+
+    def _pump(self) -> bool:
+        """_step_once with the crash contract: ANY loop failure fails
+        every queued and in-flight stream cleanly (no result() ever
+        hangs), then re-raises — the async worker's catch restarts on
+        the next submit; synchronous callers see the error with the
+        streams already failed."""
+        try:
+            return self._step_once()
+        except BaseException as e:
+            self._on_crash(e)
+            raise
+
+    def _on_crash(self, error: BaseException) -> None:
+        with self._lock:
+            streams = [s for s in self._slot_stream if s is not None]
+            streams += list(self._queue)
+            self._queue.clear()
+            for i in range(len(self._slot_stream)):
+                self._slot_stream[i] = None
+            object.__setattr__(self, "_worker", None)
+            _trace.event(
+                "decode_worker_crash",
+                attrs={
+                    "error": type(error).__name__,
+                    "failed_streams": len(streams),
+                },
+            )
+            if self._metrics is not None:
+                self._metrics.record_worker_restart()
+            wrapped = WorkerCrashedError(
+                f"DecodeScheduler crashed ({error!r}); this stream was "
+                "failed cleanly (partial tokens in tokens_so_far) — "
+                "resubmit to run on the restarted scheduler."
+            )
+            wrapped.__cause__ = error
+            for stream in streams:
+                stream._fail(wrapped)
+            self._update_occupancy()
+
+    # -- driving (synchronous mode) --------------------------------------
+
+    def drain(self) -> None:
+        """Serve everything: run the loop until the queue and the slot
+        array are empty (sync), or block until the worker drains them
+        (async; returns early — with streams already failed clean — if
+        the worker dies)."""
+        self._require_bound()
+        if self.synchronous:
+            while self._has_work():
+                self._pump()
+            with self._lock:
+                self._maybe_apply_swap()
+            return
+        self._ensure_worker()
+        with self._cv:
+            self._cv.notify_all()
+        while self._has_work() and not self._stop.is_set():
+            worker = getattr(self, "_worker", None)
+            if worker is None or not worker.is_alive():
+                break  # crash cleanup already failed the streams
+            with self._cv:
+                self._cv.wait(0.01)
+
+    def _drive(self, stream: DecodeStream, timeout: Optional[float]) -> None:
+        """Block/drive until ``stream`` completes; never past its
+        deadline."""
+        if self.synchronous:
+            while not stream._done and self._has_work():
+                self._pump()
+            if not stream._done and stream.expired():
+                if stream._expire() and self._metrics is not None:
+                    self._metrics.record_deadline_expired()
+            return
+        self._ensure_worker()
+        with self._cv:
+            self._cv.notify_all()
+        deadline = stream._deadline_at
+        t_end = (
+            time.perf_counter() + timeout if timeout is not None else None
+        )
+        with stream._cond:
+            while not stream._done:
+                now = time.perf_counter()
+                if deadline is not None and now >= deadline:
+                    break
+                if t_end is not None and now >= t_end:
+                    break
+                waits = [0.05]
+                if deadline is not None:
+                    waits.append(deadline - now)
+                if t_end is not None:
+                    waits.append(t_end - now)
+                stream._cond.wait(max(0.0, min(waits)))
+        if not stream._done:
+            if stream.expired():
+                if stream._expire() and self._metrics is not None:
+                    self._metrics.record_deadline_expired()
+            else:
+                raise TimeoutError(
+                    f"generation not complete within {timeout}s (worker "
+                    "stalled, or close() was called)."
+                )
+
+    def _advance(self, stream: DecodeStream) -> None:
+        """One increment of progress for an iterating consumer."""
+        if self.synchronous:
+            if not stream._done and self._has_work():
+                self._pump()
+            elif not stream._done and stream.expired():
+                if stream._expire() and self._metrics is not None:
+                    self._metrics.record_deadline_expired()
+        else:
+            with stream._cond:
+                if not stream._done:
+                    stream._cond.wait(0.05)
+            # The deadline binds the STREAMING consumer too (same
+            # posture as result()/_drive): a wedged worker must not
+            # block an iterator past the request's deadline.
+            if not stream._done and stream.expired():
+                if stream._expire() and self._metrics is not None:
+                    self._metrics.record_deadline_expired()
+
+    # -- async worker ----------------------------------------------------
+
+    def _ensure_worker(self) -> None:
+        # Check-and-spawn under the lock: concurrent first submits must
+        # not each start a worker (an orphaned duplicate would keep
+        # pumping a closed scheduler — the liveness-under-lock rule the
+        # MicroBatcher documents).
+        with self._lock:
+            worker = getattr(self, "_worker", None)
+            if worker is None or not worker.is_alive():
+                thread = threading.Thread(
+                    target=self._worker_loop,
+                    name="zk-decode-scheduler",
+                    daemon=True,
+                )
+                object.__setattr__(self, "_worker", thread)
+                thread.start()
+
+    def _worker_loop(self) -> None:
+        while not self._stop.is_set():
+            if not self._has_work() and not self.swap_pending:
+                with self._cv:
+                    self._cv.wait(0.05)
+                continue
+            try:
+                self._pump()
+            except BaseException:
+                # Streams already failed clean in _on_crash; the next
+                # submit() starts a fresh worker.
+                return
+
+    def close(self, drain: bool = False) -> None:
+        """Stop the scheduler. ``drain=True`` serves everything first;
+        otherwise pending streams are FAILED so no result() blocks
+        forever. Safe to call repeatedly / unbound."""
+        if getattr(self, "_engine", None) is None:
+            return
+        if drain:
+            try:
+                self.drain()
+            except Exception:
+                pass  # per-stream errors already delivered
+        self._stop.set()
+        worker = getattr(self, "_worker", None)
+        if worker is not None:
+            with self._cv:
+                self._cv.notify_all()
+            worker.join(timeout=5)
+            object.__setattr__(self, "_worker", None)
+        err = RuntimeError("DecodeScheduler closed with streams pending.")
+        with self._lock:
+            for stream in list(self._queue):
+                stream._fail(err)
+            self._queue.clear()
+            for i, stream in enumerate(self._slot_stream):
+                if stream is not None:
+                    stream._fail(err)
+                    self._slot_stream[i] = None
+        self._stop.clear()
+
+    # -- introspection ---------------------------------------------------
+
+    @property
+    def queue_depth(self) -> int:
+        with self._lock:
+            return len(self._queue)
+
+    @property
+    def active_slots(self) -> int:
+        with self._lock:
+            return sum(1 for s in self._slot_stream if s is not None)
+
+    def status(self) -> dict:
+        """``/statusz`` decode section: the numbers an operator checks
+        before trusting the stream metrics."""
+        engine = self._engine
+        with self._lock:
+            active_lengths = [
+                int(self._slot_lengths[i])
+                for i, s in enumerate(self._slot_stream)
+                if s is not None
+            ]
+            return {
+                "slots": int(engine.slots),
+                "active_slots": len(active_lengths),
+                "queue_depth": len(self._queue),
+                "kv_pages_in_use": engine.kv_pages_in_use(active_lengths),
+                "kv_capacity_tokens": engine.capacity,
+                "kv_cache_mb": round(engine.kv_cache_nbytes / 2**20, 2),
+                "compiles": engine.compile_count,
+                "recompiles_detected": engine.recompiles_detected,
+                "swap_pending": self.swap_pending,
+            }

@@ -1,0 +1,251 @@
+"""The LM decode service config: checkpoint -> warmed decode engine.
+
+The ``ServingConfig`` counterpart for token streaming: point it at a
+``save_model`` export or ``Checkpointer`` directory of a
+``TransformerLM`` run (EMA-vs-raw selection identical), and
+``build_service()`` returns a warmed :class:`DecodeEngine` +
+:class:`DecodeScheduler` pair. ``run()`` is the demo/bench driver: a
+deterministic synthetic prompt stream through the continuous-batching
+loop, one JSON result line (tokens/s, TTFT percentiles, refill count,
+compile counts) through the same MetricsWriter sinks — so
+``python examples/serve_lm.py ServeLM checkpoint=...`` is an
+end-to-end smoke of the whole decode subsystem.
+"""
+
+import json
+import time
+from typing import Any, Dict, Optional
+
+from zookeeper_tpu.core import ComponentField, Field, component, pretty_print
+from zookeeper_tpu.models.base import Model
+from zookeeper_tpu.models.transformer import TransformerLM
+from zookeeper_tpu.parallel.partitioner import (
+    Partitioner,
+    SingleDevicePartitioner,
+)
+from zookeeper_tpu.serving.decode.engine import DecodeEngine
+from zookeeper_tpu.serving.decode.metrics import DecodeMetrics
+from zookeeper_tpu.serving.decode.scheduler import DecodeScheduler
+from zookeeper_tpu.training.experiment import Experiment
+from zookeeper_tpu.training.metrics import CompositeMetricsWriter, MetricsWriter
+
+__all__ = ["LMServingConfig"]
+
+
+@component
+class LMServingConfig(Experiment):
+    """Configurable token-streaming service over a causal LM.
+
+    Subclass with ``@task`` for a CLI entry point — see
+    ``examples/serve_lm.py``.
+    """
+
+    model: Model = ComponentField(TransformerLM)
+    partitioner: Partitioner = ComponentField(SingleDevicePartitioner)
+    engine: DecodeEngine = ComponentField(DecodeEngine)
+    scheduler: DecodeScheduler = ComponentField(DecodeScheduler)
+    metrics: DecodeMetrics = ComponentField(DecodeMetrics)
+    writer: MetricsWriter = ComponentField(CompositeMetricsWriter)
+
+    #: Deployment artifact: a ``save_model`` export or a full
+    #: ``Checkpointer`` directory (latest step). None = fresh-init
+    #: weights (compile/latency smoke without a training run).
+    checkpoint: Optional[str] = Field(None)
+    #: EMA-vs-raw weight selection (same contract as ServingConfig).
+    weights: str = Field("auto")
+
+    #: Model build geometry: the positional capacity the module is
+    #: built with (prompt + generated tokens must fit) and the vocab.
+    seq_len: int = Field(128)
+    vocab_size: int = Field(256)
+    seed: int = Field(0)
+
+    #: Pre-compile the full prefill/decode program grid before traffic.
+    warmup: bool = Field(True)
+    #: Demo-driver knobs for ``run()``: request count, prompt-length
+    #: range, and the per-request generation budget.
+    requests: int = Field(32)
+    max_prompt: int = Field(12)
+    new_tokens: int = Field(16)
+    verbose: bool = Field(True)
+    #: Live observability endpoint: ``/metrics`` (every ``zk_decode_*``
+    #: series) + ``/statusz`` decode section (active slots, queue
+    #: depth, KV pages in use). -1 = off; 0 = ephemeral port.
+    metrics_port: int = Field(-1)
+
+    def build_service(self):
+        """Load weights, bind + warm the engine, bind the scheduler.
+        Returns ``(engine, scheduler)`` (also kept on self)."""
+        if self.weights not in ("auto", "ema", "raw"):
+            raise ValueError(
+                f"weights={self.weights!r} unknown; choose auto/ema/raw."
+            )
+        if self.requests < 0 or self.max_prompt < 1 or self.new_tokens < 1:
+            raise ValueError(
+                f"requests={self.requests} must be >= 0, max_prompt="
+                f"{self.max_prompt} and new_tokens={self.new_tokens} "
+                ">= 1."
+            )
+        module = self.model.build((self.seq_len,), self.vocab_size)
+        if self.checkpoint:
+            import jax
+
+            from zookeeper_tpu.training.checkpoint import (
+                load_inference_model,
+            )
+
+            abstract = jax.eval_shape(
+                lambda: self.model.initialize(
+                    module, (self.seq_len,), seed=self.seed
+                )
+            )
+            params, model_state = load_inference_model(
+                self.checkpoint,
+                weights=self.weights,
+                params_like=abstract[0],
+                model_state_like=abstract[1],
+            )
+        else:
+            params, model_state = self.model.initialize(
+                module, (self.seq_len,), seed=self.seed
+            )
+        self.partitioner.setup()
+        self.engine.bind(
+            module,
+            params,
+            model_state,
+            partitioner=self.partitioner,
+        )
+        if self.warmup:
+            self.engine.warmup()
+        self.scheduler.bind(self.engine, metrics=self.metrics)
+        if self.metrics_port >= 0:
+            try:
+                self._start_obs_server()
+            except BaseException:
+                self._teardown_service(suppress=True)
+                raise
+        return self.engine, self.scheduler
+
+    def _start_obs_server(self):
+        from zookeeper_tpu.observability import (
+            DeviceProbe,
+            ObservabilityServer,
+        )
+        from zookeeper_tpu.observability.registry import default_registry
+
+        server = ObservabilityServer(
+            [default_registry(), self.metrics.registry],
+            port=self.metrics_port,
+            status_providers={"decode": self.scheduler.status},
+        )
+        server.start()
+        object.__setattr__(self, "obs_server", server)
+        probe = DeviceProbe()
+        probe.poll_once()
+        probe.start()
+        object.__setattr__(self, "obs_probe", probe)
+        if self.verbose:
+            print(
+                f"observability endpoint: {server.url}/metrics",
+                flush=True,
+            )
+        return server
+
+    def _teardown_service(self, *, suppress: bool = False) -> None:
+        """The ONE teardown sequence (endpoint port, device probe,
+        scheduler worker) shared by every exit path — the
+        ``run_teardown_steps`` contract ``ServingConfig`` uses."""
+        from zookeeper_tpu.serving.service import run_teardown_steps
+
+        steps = []
+        server = getattr(self, "obs_server", None)
+        if server is not None:
+            object.__setattr__(self, "obs_server", None)
+            steps.append(server.stop)
+        probe = getattr(self, "obs_probe", None)
+        if probe is not None:
+            object.__setattr__(self, "obs_probe", None)
+            steps.append(probe.stop)
+        steps.append(self.scheduler.close)
+        run_teardown_steps(steps, suppress=suppress)
+
+    def finish_report(
+        self,
+        *,
+        warm_compiles: int,
+        n_requests: int,
+        tokens: int,
+        dt: float,
+        writer_extra: Optional[Dict[str, float]] = None,
+        result_extra: Optional[Dict[str, Any]] = None,
+    ) -> Dict[str, Any]:
+        """The one reporting path: metrics snapshot through the writer,
+        one JSON result line, teardown."""
+        tokens_per_sec = tokens / dt if dt > 0 else 0.0
+        snapshot = self.metrics.emit(
+            self.writer,
+            step=0,
+            extra={"tokens_per_sec": tokens_per_sec, **(writer_extra or {})},
+        )
+        self.writer.flush()
+        result = {
+            **{k: round(float(v), 4) for k, v in snapshot.items()},
+            "model": type(self.model).__name__,
+            "weights": self.weights,
+            "slots": int(self.engine.slots),
+            "seq_buckets": [int(s) for s in self.engine.seq_buckets],
+            "kv_capacity": self.engine.capacity,
+            "compiles": self.engine.compile_count,
+            "recompiles_after_warmup": (
+                self.engine.compile_count - warm_compiles
+            ),
+            "requests": n_requests,
+            "generated_tokens": tokens,
+            "tokens_per_sec": round(tokens_per_sec, 1),
+            **(result_extra or {}),
+        }
+        if self.verbose:
+            print(json.dumps(result), flush=True)
+        self._teardown_service()
+        return result
+
+    def run(self) -> Dict[str, Any]:
+        """Serve a deterministic synthetic prompt stream and report."""
+        import numpy as np
+
+        if self.verbose:
+            print(pretty_print(self), flush=True)
+        engine, scheduler = self.build_service()
+        try:
+            warm_compiles = engine.compile_count
+            rng = np.random.default_rng(self.seed)
+            max_prompt = min(self.max_prompt, engine.max_prompt)
+            t0 = time.perf_counter()
+            streams = []
+            for _ in range(self.requests):
+                n = int(rng.integers(1, max_prompt + 1))
+                prompt = rng.integers(
+                    1, self.vocab_size, size=n
+                ).astype(np.int32)
+                streams.append(
+                    scheduler.submit(
+                        prompt, max_new_tokens=self.new_tokens
+                    )
+                )
+            scheduler.drain()
+            dt = time.perf_counter() - t0
+            tokens = 0
+            for stream in streams:
+                out = stream.result()
+                assert out.shape[0] >= 1, out.shape
+                tokens += int(out.shape[0])
+        except BaseException:
+            self._teardown_service(suppress=True)
+            raise
+        return self.finish_report(
+            warm_compiles=warm_compiles,
+            n_requests=self.requests,
+            tokens=tokens,
+            dt=dt,
+        )

@@ -58,6 +58,82 @@ _PREFIX = "zk_serving_"
 #: half-initialized one would silently eat its thread's samples).
 _INIT_LOCK = threading.Lock()
 
+
+# -- shared windowed-registry machinery -----------------------------------
+#
+# ServingMetrics and DecodeMetrics are the same aggregator shape with
+# different instrument tables: lazily-built registry state ("counters"/
+# "gauges"/"hist" dicts + bounded sample "windows"), O(1) thread-safe
+# recorders, exact-percentile snapshots, in-place reset. The shape lives
+# HERE once so a fix to the shared contract (the racing-first-touch
+# init, the reset-zeros-in-place /metrics guarantee) lands in one place.
+
+
+def _get_or_build_obs(metrics, build) -> dict:
+    """Double-checked lazy init of a metrics component's ``_obs_state``
+    (one registry per instance even under racing first recorders)."""
+    obs = getattr(metrics, "_obs_state", None)
+    if obs is None:
+        with _INIT_LOCK:
+            obs = getattr(metrics, "_obs_state", None)
+            if obs is not None:
+                return obs
+            obs = build()
+            object.__setattr__(metrics, "_obs_state", obs)
+    return obs
+
+
+def _window_series(obs: dict, name: str, window: int) -> deque:
+    series = obs["windows"].get(name)
+    if series is None:
+        # setdefault: two threads racing the first sample of a series
+        # must share ONE deque, not drop one of them.
+        series = obs["windows"].setdefault(
+            name, deque(maxlen=max(1, int(window)))
+        )
+    return series
+
+
+def _observe_sample(obs: dict, name: str, value: float, window: int) -> None:
+    """One sample: window append (exact percentile source) + fixed-
+    bucket histogram observe (live scrape source)."""
+    _window_series(obs, name, window).append(float(value))
+    hist = obs["hist"].get(name)
+    if hist is not None:
+        hist.observe(value)
+
+
+def _reset_obs(metrics) -> None:
+    """Zero every instrument IN PLACE. The registry and instrument
+    objects survive (an ``ObservabilityServer`` that captured
+    ``registry`` at startup keeps rendering this aggregator — a scraper
+    just sees an ordinary counter reset); dropping ``_obs_state``
+    instead would silently disconnect ``/metrics`` from all future
+    samples."""
+    obs = getattr(metrics, "_obs_state", None)
+    if obs is None:
+        return
+    for inst in (
+        *obs["counters"].values(),
+        *obs["gauges"].values(),
+        *obs["hist"].values(),
+    ):
+        inst.reset()
+    obs["windows"].clear()
+
+
+def _emit_snapshot(metrics, writer, step, extra, prefix) -> Dict[str, float]:
+    """Write ``metrics.snapshot()`` through a training-family
+    MetricsWriter under ``prefix/``; returns the snapshot."""
+    snap = metrics.snapshot()
+    scalars = {f"{prefix}/{k}": float(v) for k, v in snap.items()}
+    if extra:
+        scalars.update(
+            {f"{prefix}/{k}": float(v) for k, v in extra.items()}
+        )
+    writer.write_scalars(int(step), scalars)
+    return snap
+
 #: Lifetime counters, in the order ``totals`` has always reported them.
 _COUNTER_NAMES = (
     "requests",
@@ -96,15 +172,7 @@ class ServingMetrics:
     # -- lazy state ------------------------------------------------------
 
     def _obs(self) -> dict:
-        obs = getattr(self, "_obs_state", None)
-        if obs is None:
-            with _INIT_LOCK:
-                obs = getattr(self, "_obs_state", None)
-                if obs is not None:
-                    return obs
-                obs = self._build_obs()
-                object.__setattr__(self, "_obs_state", obs)
-        return obs
+        return _get_or_build_obs(self, self._build_obs)
 
     def _build_obs(self) -> dict:
         registry = MetricsRegistry()
@@ -116,20 +184,22 @@ class ServingMetrics:
                 )
                 for name in _COUNTER_NAMES
             },
-            # WHICH training step is live — the dashboard gauge that
-            # says how stale the served model is relative to the
-            # training run (-1 = the bind()-time weights, never
-            # swapped).
-            "weights_step": registry.gauge(
-                _PREFIX + "serving_weights_step",
-                help="training step whose weights are live (-1 = "
-                "bind-time weights)",
-                initial=-1,
-            ),
-            "queue_depth": registry.gauge(
-                _PREFIX + "queue_depth",
-                help="pending rows at the last submit",
-            ),
+            "gauges": {
+                # WHICH training step is live — the dashboard gauge
+                # that says how stale the served model is relative to
+                # the training run (-1 = the bind()-time weights,
+                # never swapped).
+                "weights_step": registry.gauge(
+                    _PREFIX + "serving_weights_step",
+                    help="training step whose weights are live (-1 = "
+                    "bind-time weights)",
+                    initial=-1,
+                ),
+                "queue_depth": registry.gauge(
+                    _PREFIX + "queue_depth",
+                    help="pending rows at the last submit",
+                ),
+            },
             "hist": {
                 "latency_ms": registry.histogram(
                     _PREFIX + "latency_ms",
@@ -163,23 +233,10 @@ class ServingMetrics:
         return self._obs()["registry"]
 
     def _series(self, name: str) -> deque:
-        windows = self._obs()["windows"]
-        series = windows.get(name)
-        if series is None:
-            # setdefault: two threads racing the first sample of a
-            # series must share ONE deque, not drop one of them.
-            series = windows.setdefault(
-                name, deque(maxlen=max(1, int(self.window)))
-            )
-        return series
+        return _window_series(self._obs(), name, self.window)
 
     def _observe(self, name: str, value: float) -> None:
-        """One sample: window append (exact percentile source) + fixed-
-        bucket histogram observe (live scrape source)."""
-        self._series(name).append(float(value))
-        hist = self._obs()["hist"].get(name)
-        if hist is not None:
-            hist.observe(value)
+        _observe_sample(self._obs(), name, value, self.window)
 
     # -- recorders (called by MicroBatcher / ServingConfig) --------------
 
@@ -191,7 +248,7 @@ class ServingMetrics:
 
     def record_queue_depth(self, rows: int) -> None:
         self._series("queue_depth").append(float(rows))
-        self._obs()["queue_depth"].set(rows)
+        self._obs()["gauges"]["queue_depth"].set(rows)
 
     def record_rejected(self) -> None:
         """A submit was shed (``RejectedError``) instead of enqueued."""
@@ -213,7 +270,7 @@ class ServingMetrics:
         obs = self._obs()
         self._observe("weight_swap_ms", swap_ms)
         obs["counters"]["weight_swaps"].inc()
-        obs["weights_step"].set(int(step))
+        obs["gauges"]["weights_step"].set(int(step))
 
     def record_watcher_stopped(self) -> None:
         """The checkpoint watcher's daemon died on a fatal error:
@@ -224,7 +281,7 @@ class ServingMetrics:
         """Set the live-weights gauge WITHOUT counting a swap — the
         bind-time weights of a service that loaded ``step`` at startup
         (``CheckpointWatcher(initial_step=...)``)."""
-        self._obs()["weights_step"].set(int(step))
+        self._obs()["gauges"]["weights_step"].set(int(step))
 
     def record_dispatch(self, real_rows: int, bucket_rows: int) -> None:
         if bucket_rows <= 0:
@@ -246,7 +303,9 @@ class ServingMetrics:
             if name == "weight_swaps":
                 # Historical key order: the gauge sits between the swap
                 # counter and watcher_stopped.
-                out["serving_weights_step"] = int(obs["weights_step"].value)
+                out["serving_weights_step"] = int(
+                    obs["gauges"]["weights_step"].value
+                )
         return out
 
     def snapshot(self) -> Dict[str, float]:
@@ -277,29 +336,9 @@ class ServingMetrics:
     ) -> Dict[str, float]:
         """Write the snapshot through a training-family MetricsWriter
         under the ``serve/`` prefix; returns the snapshot."""
-        snap = self.snapshot()
-        scalars = {f"serve/{k}": float(v) for k, v in snap.items()}
-        if extra:
-            scalars.update(
-                {f"serve/{k}": float(v) for k, v in extra.items()}
-            )
-        writer.write_scalars(int(step), scalars)
-        return snap
+        return _emit_snapshot(self, writer, step, extra, "serve")
 
     def reset(self) -> None:
-        """Zero every series IN PLACE. The registry and instrument
-        objects survive (an ``ObservabilityServer`` that captured
-        ``self.registry`` at startup keeps rendering this aggregator —
-        a scraper just sees an ordinary counter reset); dropping
-        ``_obs_state`` instead would silently disconnect ``/metrics``
-        from all future samples."""
-        obs = getattr(self, "_obs_state", None)
-        if obs is None:
-            return
-        for counter in obs["counters"].values():
-            counter.reset()
-        obs["weights_step"].reset()
-        obs["queue_depth"].reset()
-        for hist in obs["hist"].values():
-            hist.reset()
-        obs["windows"].clear()
+        """Zero every series IN PLACE (see :func:`_reset_obs` for the
+        live-``/metrics`` contract)."""
+        _reset_obs(self)

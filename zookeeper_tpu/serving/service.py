@@ -34,6 +34,24 @@ from zookeeper_tpu.training.experiment import Experiment
 from zookeeper_tpu.training.metrics import CompositeMetricsWriter, MetricsWriter
 
 
+def run_teardown_steps(steps, *, suppress: bool = False) -> None:
+    """The service-teardown contract, shared by ``ServingConfig`` and
+    ``LMServingConfig``: every step runs even when an earlier one
+    raises (a failed watcher stop must not leak the /metrics port or
+    the worker thread), and the FIRST failure is re-raised at the end
+    unless ``suppress`` (error paths, where a cleanup failure must not
+    mask the original exception)."""
+    first: Optional[BaseException] = None
+    for step in steps:
+        try:
+            step()
+        except BaseException as e:
+            if first is None:
+                first = e
+    if first is not None and not suppress:
+        raise first
+
+
 @component
 class ServingConfig(Experiment):
     """Configurable inference service over an exported model.
@@ -289,23 +307,12 @@ class ServingConfig(Experiment):
 
     def _teardown_service(self, *, suppress: bool = False) -> None:
         """The ONE teardown sequence (watcher daemon, /metrics port,
-        batcher worker) shared by every exit path. Each step runs even
-        when an earlier one raises; the first failure is re-raised at
-        the end unless ``suppress`` (error paths, where a cleanup
-        failure must not mask the original exception)."""
-        first: Optional[BaseException] = None
+        batcher worker) shared by every exit path."""
         watcher = getattr(self, "watcher", None)
         steps = [self._teardown_obs_server, self.batcher.close]
         if watcher is not None:
             steps.insert(0, watcher.stop)
-        for step in steps:
-            try:
-                step()
-            except BaseException as e:
-                if first is None:
-                    first = e
-        if first is not None and not suppress:
-            raise first
+        run_teardown_steps(steps, suppress=suppress)
 
     def run(self) -> Dict[str, Any]:
         """Serve a deterministic synthetic request stream and report."""
