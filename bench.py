@@ -982,6 +982,7 @@ def measure_trace_overhead(env=None):
     # along informationally.
     from zookeeper_tpu.observability.device import DeviceProbe
     from zookeeper_tpu.observability.registry import MetricsRegistry
+    from zookeeper_tpu.observability.requests import RequestLog, next_rid
     from zookeeper_tpu.observability.watchdog import StepTimeWatchdog
 
     obs_reg = MetricsRegistry()
@@ -994,6 +995,26 @@ def measure_trace_overhead(env=None):
     for _ in range(20):
         probe.poll_once()
     hbm_poll_us = (time.perf_counter() - t0) / 20 * 1e6
+    # Request-tracing era (docs/DESIGN.md §16): rid minting and the
+    # RequestLog terminal-summary append ride the serving request path
+    # (submit + completion), so their component costs join the gated
+    # sum — conservatively one mint + one append per step-equivalent
+    # (a real step serves at most one request's bookkeeping per
+    # dispatch slot; coalescing only amortizes it further).
+    rid_mint_us = call_cost_us(next_rid)
+    probe_log = RequestLog("obs_bench_probe", capacity=4096)
+    requestlog_us = call_cost_us(
+        lambda: probe_log.append(
+            1,
+            "ok",
+            enqueue_ns=0,
+            dispatch_ns=1,
+            complete_ns=2,
+            rows=1,
+            bucket=8,
+            weights_step=-1,
+        )
+    )
 
     prior_tracer = trace.get_tracer()
     state, m = step(state, batch)  # compile outside every timed window
@@ -1030,13 +1051,19 @@ def measure_trace_overhead(env=None):
     spans_per_step = 2
     step_floor_ms = min(untraced_best, traced_best) / steps * 1e3
     overhead_frac = (
-        (enabled_us - noop_us) * spans_per_step + watchdog_us + gauge_us
+        (enabled_us - noop_us) * spans_per_step
+        + watchdog_us
+        + gauge_us
+        + rid_mint_us
+        + requestlog_us
     ) / 1e3 / step_floor_ms
     return {
         "obs_span_cost_us": round(enabled_us, 4),
         "obs_span_noop_cost_us": round(noop_us, 4),
         "obs_watchdog_cost_us": round(watchdog_us, 4),
         "obs_gauge_cost_us": round(gauge_us, 4),
+        "obs_rid_mint_cost_us": round(rid_mint_us, 4),
+        "obs_requestlog_append_cost_us": round(requestlog_us, 4),
         "obs_hbm_poll_us": round(hbm_poll_us, 3),
         "obs_spans_per_step": spans_per_step,
         "obs_step_time_ms_untraced": round(

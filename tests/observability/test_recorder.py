@@ -1,0 +1,313 @@
+"""FlightRecorder: bundle contents, rate limiting, bounded retention,
+the manual /debugz trigger, and the snapshot-not-drain audit
+(docs/DESIGN.md §16)."""
+
+import json
+import os
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+
+from zookeeper_tpu.observability import trace
+from zookeeper_tpu.observability import recorder as recorder_mod
+from zookeeper_tpu.observability.export import ObservabilityServer
+from zookeeper_tpu.observability.recorder import FlightRecorder
+from zookeeper_tpu.observability.registry import MetricsRegistry
+from zookeeper_tpu.observability.requests import RequestLog
+
+
+@pytest.fixture
+def fresh_tracer():
+    prior = trace.get_tracer()
+    trace.install(trace.Tracer(1024))
+    yield trace.get_tracer()
+    trace.install(prior)
+
+
+@pytest.fixture
+def no_global_recorder():
+    prior = recorder_mod.get_recorder()
+    recorder_mod.uninstall()
+    yield
+    recorder_mod.install(prior) if prior is not None else recorder_mod.uninstall()
+
+
+def make_recorder(tmp_path, **kw):
+    reg = MetricsRegistry()
+    reg.counter("zk_test_total", help="t").inc(3)
+    log = RequestLog("svc")
+    kw.setdefault("synchronous", True)
+    kw.setdefault("min_interval_s", 0.0)
+    rec = FlightRecorder(
+        str(tmp_path / "bundles"),
+        registries=[reg],
+        status_providers={"svc": lambda: {"alive": True}},
+        request_logs={"svc": log},
+        **kw,
+    )
+    return rec, reg, log
+
+
+def test_bundle_contents_join_every_layer(tmp_path, fresh_tracer):
+    """The acceptance shape: one bundle carries trace JSON, exposition
+    text, the ledger table, statusz sections, the RequestLog tail and
+    a manifest naming the trigger."""
+    rec, reg, log = make_recorder(tmp_path)
+    with trace.span("request_submit", rid=11):
+        pass
+    trace.event("request_complete", rid=11)
+    log.append(11, "crashed", rows=2, detail="WorkerCrashedError")
+    path = rec.trigger("worker_crash", step=5, attrs={"error": "boom"})
+    assert path is not None and os.path.isdir(path)
+    names = sorted(os.listdir(path))
+    assert names == [
+        "manifest.json", "metrics.prom", "programs.json",
+        "requestlog.json", "statusz.json", "trace.json",
+    ]
+    doc = json.load(open(os.path.join(path, "trace.json")))
+    flow_ids = {
+        e["id"] for e in doc["traceEvents"] if e.get("cat") == "rid"
+    }
+    assert flow_ids == {11}
+    prom = open(os.path.join(path, "metrics.prom")).read()
+    assert "zk_test_total 3" in prom
+    statusz = json.load(open(os.path.join(path, "statusz.json")))
+    assert statusz["svc"] == {"alive": True}
+    assert statusz["metrics"]["zk_test_total"] == 3.0
+    requestlog = json.load(open(os.path.join(path, "requestlog.json")))
+    assert requestlog["svc"]["tail"][0]["rid"] == 11
+    assert requestlog["svc"]["tail"][0]["outcome"] == "crashed"
+    manifest = json.load(open(os.path.join(path, "manifest.json")))
+    assert manifest["trigger"] == {
+        "kind": "worker_crash", "step": 5, "attrs": {"error": "boom"},
+    }
+    assert isinstance(manifest["time_unix"], float)
+    # Provenance via bench_metadata (git sha present on this checkout).
+    assert "git_sha" in manifest["metadata"]
+    programs = json.load(open(os.path.join(path, "programs.json")))
+    assert "programs" in programs
+
+
+def test_rate_limit_suppresses_and_force_bypasses(tmp_path):
+    rec, _, _ = make_recorder(tmp_path, min_interval_s=3600.0)
+    first = rec.trigger("step_time_anomaly")
+    assert first is not None
+    assert rec.trigger("step_time_anomaly") is None  # inside the window
+    assert rec.bundles_suppressed == 1
+    forced = rec.trigger("manual", force=True)  # /debugz semantics
+    assert forced is not None and forced != first
+    assert rec.bundles_written == 2
+
+
+def test_retention_keeps_last_k(tmp_path):
+    rec, _, _ = make_recorder(tmp_path, keep=2)
+    paths = [rec.trigger(f"kind{i}") for i in range(5)]
+    remaining = rec.bundles()
+    assert len(remaining) == 2
+    assert remaining == paths[-2:]
+
+
+def test_injected_clock_is_the_manifest_timestamp(tmp_path):
+    rec, _, _ = make_recorder(tmp_path, clock=lambda: 1234.5)
+    path = rec.trigger("manual")
+    manifest = json.load(open(os.path.join(path, "manifest.json")))
+    assert manifest["time_unix"] == 1234.5
+
+
+def test_async_mode_writes_on_worker_thread(tmp_path):
+    rec, _, _ = make_recorder(tmp_path, synchronous=False)
+    assert rec.trigger("worker_crash") is None  # queued, not written
+    assert rec.flush(timeout=10)
+    assert rec.bundles_written == 1
+    assert rec.last_bundle is not None
+    rec.close()
+
+
+def test_trigger_never_raises(tmp_path, monkeypatch):
+    """The call sites are crash handlers: a broken provider or an
+    unwritable directory must produce a warning, not an exception."""
+    rec = FlightRecorder(
+        str(tmp_path / "bundles"),
+        status_providers={"bad": lambda: (_ for _ in ()).throw(OSError())},
+        synchronous=True,
+        min_interval_s=0.0,
+    )
+    path = rec.trigger("manual")  # provider error -> section error
+    statusz = json.load(open(os.path.join(path, "statusz.json")))
+    assert "error" in statusz["bad"]
+    # Unwritable directory: trigger returns None instead of raising.
+    rec2 = FlightRecorder(
+        "/proc/definitely/not/writable",
+        synchronous=True,
+        min_interval_s=0.0,
+    )
+    assert rec2.trigger("manual") is None
+
+
+def test_notify_is_noop_without_recorder(no_global_recorder):
+    recorder_mod.notify("worker_crash")  # must not raise
+
+
+def test_notify_routes_to_installed_recorder(tmp_path, no_global_recorder):
+    rec, _, _ = make_recorder(tmp_path)
+    recorder_mod.install(rec)
+    recorder_mod.notify("fault_injected", step=3, attrs={"kind": "x"})
+    assert rec.bundles_written == 1
+    manifest = json.load(
+        open(os.path.join(rec.last_bundle, "manifest.json"))
+    )
+    assert manifest["trigger"]["kind"] == "fault_injected"
+    recorder_mod.uninstall(rec)
+
+
+def test_uninstall_only_evicts_own_recorder(tmp_path, no_global_recorder):
+    rec_a, _, _ = make_recorder(tmp_path / "a")
+    rec_b, _, _ = make_recorder(tmp_path / "b")
+    recorder_mod.install(rec_a)
+    recorder_mod.install(rec_b)  # replacement
+    recorder_mod.uninstall(rec_a)  # stale teardown: must be a no-op
+    assert recorder_mod.get_recorder() is rec_b
+    recorder_mod.uninstall(rec_b)
+    assert recorder_mod.get_recorder() is None
+
+
+def test_debugz_post_writes_bundle_inline(
+    tmp_path, fresh_tracer, no_global_recorder
+):
+    rec, reg, _ = make_recorder(tmp_path, min_interval_s=3600.0)
+    recorder_mod.install(rec)
+    server = ObservabilityServer([reg], port=0).start()
+    try:
+        # Rate limiter already consumed by a prior trigger: the manual
+        # POST must still land (force semantics).
+        rec.trigger("step_time_anomaly")
+        req = urllib.request.Request(
+            f"{server.url}/debugz", data=b"", method="POST"
+        )
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            body = json.loads(resp.read().decode())
+        assert body["bundle"] is not None
+        assert os.path.isdir(body["bundle"])
+        manifest = json.load(
+            open(os.path.join(body["bundle"], "manifest.json"))
+        )
+        assert manifest["trigger"]["kind"] == "manual"
+        # /statusz reports the armed recorder.
+        with urllib.request.urlopen(
+            f"{server.url}/statusz", timeout=10
+        ) as resp:
+            statusz = json.loads(resp.read().decode())
+        assert statusz["flight_recorder"]["installed"] is True
+        assert statusz["flight_recorder"]["bundles_written"] == 2
+    finally:
+        server.stop()
+        recorder_mod.uninstall(rec)
+
+
+def test_debugz_post_without_recorder_is_503(no_global_recorder):
+    reg = MetricsRegistry()
+    server = ObservabilityServer([reg], port=0).start()
+    try:
+        req = urllib.request.Request(
+            f"{server.url}/debugz", data=b"", method="POST"
+        )
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(req, timeout=10)
+        assert err.value.code == 503
+    finally:
+        server.stop()
+
+
+def test_concurrent_trace_scrapes_and_bundle_see_same_ring(
+    tmp_path, fresh_tracer, no_global_recorder
+):
+    """The destructive-read audit pin: every LIVE read path goes
+    through Tracer.snapshot(), so two concurrent /trace scrapes plus a
+    recorder bundle all see the SAME ring contents — none of them
+    drains records out from under the others."""
+    rec, reg, _ = make_recorder(tmp_path)
+    recorder_mod.install(rec)
+    server = ObservabilityServer([reg], port=0).start()
+    try:
+        for i in range(25):
+            trace.event("marker", attrs={"i": i})
+        results = {}
+        errors = []
+
+        def scrape(name):
+            try:
+                with urllib.request.urlopen(
+                    f"{server.url}/trace", timeout=10
+                ) as resp:
+                    results[name] = json.loads(resp.read().decode())
+            except Exception as e:  # pragma: no cover - failure detail
+                errors.append(e)
+
+        threads = [
+            threading.Thread(target=scrape, args=(f"scrape{i}",))
+            for i in range(2)
+        ]
+        for t in threads:
+            t.start()
+        bundle = rec.trigger("manual")
+        for t in threads:
+            t.join()
+        assert not errors
+        bundle_doc = json.load(
+            open(os.path.join(bundle, "trace.json"))
+        )
+
+        def markers(doc):
+            return [
+                e["args"]["i"]
+                for e in doc["traceEvents"]
+                if e.get("name") == "marker"
+            ]
+
+        expected = list(range(25))
+        assert markers(bundle_doc) == expected
+        assert markers(results["scrape0"]) == expected
+        assert markers(results["scrape1"]) == expected
+        # And the ring still holds every record afterwards: nothing
+        # drained (drain() is reserved for the final teardown export).
+        assert len(trace.get_tracer()) == 25
+    finally:
+        server.stop()
+        recorder_mod.uninstall(rec)
+
+
+def test_seq_resumes_from_disk_across_recorder_restarts(tmp_path):
+    """A restarted process over the same directory (the crash-loop
+    case) extends the bundle series — it must never overwrite
+    bundle-000001 or have retention GC its own fresh write."""
+    rec, _, _ = make_recorder(tmp_path)
+    first = rec.trigger("worker_crash")
+    # Fresh recorder over the same directory (same construction path).
+    rec2 = FlightRecorder(
+        rec.directory, synchronous=True, min_interval_s=0.0
+    )
+    second = rec2.trigger("worker_crash")
+    assert second != first
+    assert os.path.isdir(first) and os.path.isdir(second)
+    assert os.path.basename(second) > os.path.basename(first)  # seq grew
+
+
+def test_forced_trigger_does_not_arm_the_rate_limiter(tmp_path):
+    """A /debugz poke right before a crash must not suppress the
+    crash's automatic bundle: force bypasses the limiter WITHOUT
+    stamping it."""
+    rec, _, _ = make_recorder(tmp_path, min_interval_s=3600.0)
+    assert rec.trigger("manual", force=True) is not None
+    assert rec.trigger("worker_crash") is not None  # NOT suppressed
+    assert rec.bundles_suppressed == 0
+
+
+def test_request_log_tail_zero_is_empty():
+    from zookeeper_tpu.observability.requests import RequestLog
+
+    log = RequestLog("svc")
+    log.append(1, "ok")
+    assert log.tail(0) == []
+    assert log.as_status(tail=0)["tail"] == []

@@ -203,3 +203,91 @@ def test_histogram_isinstance_check():
     h = r.histogram("h", buckets=(1.0,))
     assert isinstance(h, Histogram)
     assert h.kind == "histogram"
+
+
+# -- label-cardinality guard (docs/DESIGN.md §16 satellite) ---------------
+
+
+def test_label_variants_capped_with_dropped_counter(caplog):
+    import logging
+
+    r = MetricsRegistry(max_label_variants=3)
+    live = [
+        r.counter("zk_capped", labels={"tenant": f"t{i}"}) for i in range(3)
+    ]
+    with caplog.at_level(logging.WARNING):
+        dropped = r.counter("zk_capped", labels={"tenant": "t99"})
+    # The detached instrument is fully usable...
+    dropped.inc(5)
+    assert dropped.value == 5
+    # ...but never collected: /metrics stays bounded at the cap.
+    rendered = [
+        inst for inst in r.collect() if inst.name == "zk_capped"
+    ]
+    assert len(rendered) == 3
+    assert all(inst is not dropped for inst in rendered)
+    # The drop is accounted and warned once.
+    assert (
+        r.counter(_dropped_labels()[0], labels=_dropped_labels()[1]).value
+        == 1
+    )
+    assert sum(
+        "label-cardinality cap" in rec.message for rec in caplog.records
+    ) == 1
+
+
+def _dropped_labels():
+    return "zk_labels_dropped_total", {"metric": "zk_capped"}
+
+
+def test_cap_warns_once_and_counts_every_drop():
+    r = MetricsRegistry(max_label_variants=2)
+    for i in range(2):
+        r.gauge("zk_g", labels={"k": str(i)})
+    for i in range(4):
+        r.gauge("zk_g", labels={"k": f"over{i}"})
+    assert (
+        r.counter(
+            "zk_labels_dropped_total", labels={"metric": "zk_g"}
+        ).value
+        == 4
+    )
+
+
+def test_existing_variants_survive_the_cap():
+    """Re-requesting an ALREADY-registered variant returns the shared
+    instrument even when the name is at the cap — only NEW variants
+    drop."""
+    r = MetricsRegistry(max_label_variants=2)
+    a = r.counter("zk_c", labels={"k": "a"})
+    b = r.counter("zk_c", labels={"k": "b"})
+    assert r.counter("zk_c", labels={"k": "a"}) is a
+    assert r.counter("zk_c", labels={"k": "b"}) is b
+    assert (
+        r.counter("zk_labels_dropped_total", labels={"metric": "zk_c"}).value
+        == 0
+    )
+
+
+def test_dropped_series_renders_in_exposition():
+    r = MetricsRegistry(max_label_variants=1)
+    r.counter("zk_c", labels={"k": "a"})
+    r.counter("zk_c", labels={"k": "b"})  # dropped
+    text = render_prometheus([r])
+    assert 'zk_labels_dropped_total{metric="zk_c"} 1' in text
+    assert 'zk_c{k="a"}' in text
+    assert 'zk_c{k="b"}' not in text
+
+
+def test_dropped_counter_itself_is_exempt_from_the_cap():
+    r = MetricsRegistry(max_label_variants=1)
+    for name in ("zk_a", "zk_b", "zk_c"):
+        r.counter(name, labels={"k": "x"})
+        r.counter(name, labels={"k": "y"})  # each name's drop
+    for name in ("zk_a", "zk_b", "zk_c"):
+        assert (
+            r.counter(
+                "zk_labels_dropped_total", labels={"metric": name}
+            ).value
+            == 1
+        )

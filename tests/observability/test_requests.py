@@ -1,0 +1,139 @@
+"""Request-scoped tracing primitives: rid minting, the RequestLog
+ring, and rid-tagged trace records rendering as Chrome flow events
+(docs/DESIGN.md §16)."""
+
+import threading
+
+import pytest
+
+from zookeeper_tpu.observability import trace
+from zookeeper_tpu.observability.requests import OUTCOMES, RequestLog, next_rid
+
+
+def test_rids_are_monotone_and_unique_across_threads():
+    seen = []
+    lock = threading.Lock()
+
+    def mint(n):
+        local = [next_rid() for _ in range(n)]
+        with lock:
+            seen.extend(local)
+
+    threads = [
+        threading.Thread(target=mint, args=(200,)) for _ in range(8)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(seen) == len(set(seen)) == 1600
+    # Monotone within any single thread's minting order is implied by
+    # process-global monotone: the full set is gap-free ascending.
+    assert sorted(seen) == list(range(min(seen), min(seen) + 1600))
+
+
+def test_request_log_bounds_and_counts():
+    log = RequestLog("svc", capacity=4)
+    for i in range(10):
+        log.append(i, "ok", rows=1)
+    assert len(log) == 4
+    assert log.total == 10
+    assert [r["rid"] for r in log.tail(2)] == [8, 9]
+    assert log.find(9)["rid"] == 9
+    assert log.find(0) is None  # evicted
+    status = log.as_status(tail=3)
+    assert status["service"] == "svc"
+    assert status["recorded_total"] == 10
+    assert status["by_outcome"] == {"ok": 10}
+    assert [r["rid"] for r in status["tail"]] == [7, 8, 9]
+
+
+def test_request_log_outcome_taxonomy_and_fields():
+    log = RequestLog("svc")
+    rec = log.append(
+        7,
+        "crashed",
+        enqueue_ns=100,
+        dispatch_ns=200,
+        complete_ns=300,
+        rows=3,
+        bucket=8,
+        weights_step=42,
+        detail="WorkerCrashedError",
+    )
+    assert rec["outcome"] in OUTCOMES
+    got = log.find(7)
+    assert got["enqueue_ns"] == 100
+    assert got["dispatch_ns"] == 200
+    assert got["complete_ns"] == 300
+    assert got["rows"] == 3
+    assert got["bucket"] == 8
+    assert got["weights_step"] == 42
+    assert got["detail"] == "WorkerCrashedError"
+
+
+def test_request_log_rejects_bad_capacity():
+    with pytest.raises(ValueError):
+        RequestLog("svc", capacity=0)
+
+
+@pytest.fixture
+def fresh_tracer():
+    prior = trace.get_tracer()
+    trace.install(trace.Tracer(1024))
+    yield trace.get_tracer()
+    trace.install(prior)
+
+
+def test_rid_tagged_records_render_as_flow_chain(fresh_tracer):
+    """The flow-event encoding contract: a rid's timeline-ordered
+    records become one s -> t -> f chain with the rid as the flow id,
+    each point INSIDE its record so Perfetto binds the arrow to the
+    enclosing slice."""
+    rid = next_rid()
+    with trace.span("request_submit", rid=rid):
+        pass
+    trace.event("request_dispatch", rid=rid)
+    trace.event("request_complete", rid=rid)
+    # An untagged span must not join anyone's flow.
+    with trace.span("dispatch"):
+        pass
+    doc = trace.to_chrome_trace()
+    flows = sorted(
+        (e for e in doc["traceEvents"] if e.get("cat") == "rid"),
+        key=lambda e: e["ts"],
+    )
+    assert [f["ph"] for f in flows] == ["s", "t", "f"]
+    assert all(f["id"] == rid for f in flows)
+    # Binding: non-start points bind to the enclosing slice.
+    assert "bp" not in flows[0] and flows[1]["bp"] == "e"
+    # rid also lands in args of the underlying records.
+    tagged = [
+        e
+        for e in doc["traceEvents"]
+        if e.get("args", {}).get("rid") == rid
+    ]
+    assert {e["name"] for e in tagged} == {
+        "request_submit", "request_dispatch", "request_complete",
+    }
+
+
+def test_single_record_rid_emits_no_flow(fresh_tracer):
+    trace.event("request_enqueue", rid=next_rid())
+    doc = trace.to_chrome_trace()
+    assert not [e for e in doc["traceEvents"] if e.get("cat") == "rid"]
+
+
+def test_flow_chains_are_per_rid(fresh_tracer):
+    a, b = next_rid(), next_rid()
+    for rid in (a, b):
+        trace.event("request_enqueue", rid=rid)
+        trace.event("request_complete", rid=rid)
+    doc = trace.to_chrome_trace()
+    for rid in (a, b):
+        chain = [
+            e
+            for e in doc["traceEvents"]
+            if e.get("cat") == "rid" and e["id"] == rid
+        ]
+        assert sorted(e["ph"] for e in chain) == ["f", "s"]

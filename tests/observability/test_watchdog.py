@@ -159,3 +159,46 @@ def test_thread_safe_under_concurrent_observers():
         t.join()
     assert not errors
     assert dog.anomalies == 0
+
+
+def test_on_anomaly_seam_and_recorder_trigger(tmp_path):
+    """The flight-recorder subscription seam (docs/DESIGN.md §16): a
+    flagged straggler fires the on_anomaly callback AND triggers the
+    installed recorder; a broken callback is logged, never raised."""
+    from zookeeper_tpu.observability import recorder as recorder_mod
+    from zookeeper_tpu.observability.recorder import FlightRecorder
+
+    fired = []
+    dog = _dog(on_anomaly=lambda stream, s, step: fired.append((stream, step)))
+    rec = FlightRecorder(
+        str(tmp_path / "bundles"), synchronous=True, min_interval_s=0.0
+    )
+    prior = recorder_mod.get_recorder()
+    recorder_mod.install(rec)
+    try:
+        for i in range(50):
+            dog.observe(0.100, step=i)
+        assert dog.observe(0.400, step=50)
+        assert fired == [("test_stream", 50)]
+        assert rec.bundles_written == 1
+        import json
+        import os
+
+        manifest = json.load(
+            open(os.path.join(rec.last_bundle, "manifest.json"))
+        )
+        assert manifest["trigger"]["kind"] == "step_time_anomaly"
+        assert manifest["trigger"]["step"] == 50
+        assert manifest["trigger"]["attrs"]["stream"] == "test_stream"
+    finally:
+        (
+            recorder_mod.install(prior)
+            if prior is not None
+            else recorder_mod.uninstall()
+        )
+
+    # A raising callback must not break observe().
+    bad = _dog(on_anomaly=lambda *a: (_ for _ in ()).throw(RuntimeError()))
+    for i in range(50):
+        bad.observe(0.100, step=i)
+    assert bad.observe(0.400, step=50)  # no raise

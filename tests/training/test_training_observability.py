@@ -424,3 +424,64 @@ def test_trace_export_with_profile_dir_logs_paired_artifacts(
     assert "paired trace artifacts" in text
     assert str(out) in text and str(prof) in text
     assert not getattr(exp, "_jax_trace_active", False)
+
+
+# -- flight recorder (docs/DESIGN.md §16) ---------------------------------
+
+
+@pytest.mark.chaos
+def test_nan_halt_and_recovery_each_write_a_bundle(tmp_path):
+    """flight_recorder_dir= arms the recorder for the run: the NaN
+    halt bundles its evidence at the readback boundary, and the
+    supervisor writes one more bundle per recovery — with the recorder
+    still installed across the restart (run() teardown leaves it in
+    place deliberately)."""
+    import os
+
+    from zookeeper_tpu.observability import recorder as recorder_mod
+    from zookeeper_tpu.resilience import faults, run_with_recovery
+
+    bundles_dir = tmp_path / "bundles"
+    exp = make_experiment(
+        tmp_path,
+        {
+            "nan_policy": "halt",
+            "log_every": 1,
+            "checkpointer.save_every_steps": 1,
+            "flight_recorder_dir": str(bundles_dir),
+            "flight_recorder_interval_s": 0.0,
+        },
+    )
+    prior = recorder_mod.get_recorder()
+    try:
+        with faults.injected(faults.FaultPlan(nan_at_step=3)):
+            result = run_with_recovery(
+                exp, max_restarts=1, backoff_s=0.0, sleep=lambda s: None
+            )
+        assert result.restarts == 1
+        rec = exp.flight_recorder
+        kinds = [
+            json.load(open(os.path.join(b, "manifest.json")))["trigger"][
+                "kind"
+            ]
+            for b in rec.bundles()
+        ]
+        assert "nan_halt" in kinds, kinds
+        assert "supervisor_restart" in kinds, kinds
+        nan_bundle = rec.bundles()[kinds.index("nan_halt")]
+        manifest = json.load(
+            open(os.path.join(nan_bundle, "manifest.json"))
+        )
+        assert manifest["trigger"]["attrs"]["skipped_steps"] >= 1
+        # The bundle carries the run's /statusz section + metrics text.
+        statusz = json.load(
+            open(os.path.join(nan_bundle, "statusz.json"))
+        )
+        assert statusz["training"]["model"] == "Mlp"
+        assert os.path.getsize(os.path.join(nan_bundle, "metrics.prom")) >= 0
+    finally:
+        (
+            recorder_mod.install(prior)
+            if prior is not None
+            else recorder_mod.uninstall()
+        )

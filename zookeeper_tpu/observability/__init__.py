@@ -31,6 +31,17 @@ The device-side half (docs/DESIGN.md §14) rides the same substrate:
 - ``peaks`` — the hardware peak anchors (datasheet tables + the
   measured-peak aggregation) shared with ``bench.py`` so live and
   offline MFU divide by the same roofline.
+
+The request-scoped half (docs/DESIGN.md §16) joins the layers:
+
+- ``requests`` — monotone rid minting + the bounded per-service
+  ``RequestLog`` of terminal request summaries; rids tag trace records
+  and render as Chrome flow events.
+- ``recorder`` — the anomaly-triggered ``FlightRecorder``: watchdog
+  anomalies, recompiles, worker crashes, NaN-halts, fault injections
+  and manual ``POST /debugz`` dump a rate-limited, bounded-retention
+  bundle (trace ring + exposition text + ledger + statusz +
+  RequestLog tails + manifest).
 """
 
 from zookeeper_tpu.observability import trace
@@ -50,6 +61,7 @@ from zookeeper_tpu.observability.ledger import (
     default_ledger,
     mfu,
 )
+from zookeeper_tpu.observability.recorder import FlightRecorder
 from zookeeper_tpu.observability.registry import (
     Counter,
     Gauge,
@@ -57,6 +69,7 @@ from zookeeper_tpu.observability.registry import (
     MetricsRegistry,
     default_registry,
 )
+from zookeeper_tpu.observability.requests import RequestLog, next_rid
 from zookeeper_tpu.observability.trace import (
     Tracer,
     event,
@@ -69,12 +82,14 @@ from zookeeper_tpu.observability.watchdog import StepTimeWatchdog
 __all__ = [
     "Counter",
     "DeviceProbe",
+    "FlightRecorder",
     "Gauge",
     "Histogram",
     "LedgeredExecutable",
     "MetricsRegistry",
     "ObservabilityServer",
     "ProgramLedger",
+    "RequestLog",
     "StepTimeWatchdog",
     "Tracer",
     "cost_analysis_dict",
@@ -85,6 +100,7 @@ __all__ = [
     "event",
     "export_chrome_trace",
     "mfu",
+    "next_rid",
     "render_prometheus",
     "span",
     "to_chrome_trace",

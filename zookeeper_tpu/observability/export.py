@@ -19,6 +19,13 @@ end-of-run JSON lines and TensorBoard files). Two pieces:
     rows, ...).
   - ``/trace`` — the current host-span ring as Chrome trace-event JSON
     (save the response, open in Perfetto) when tracing is enabled.
+    Reads through ``Tracer.snapshot()`` (non-destructive): concurrent
+    scrapes, flight-recorder bundles and the teardown export all see
+    the same ring — ``drain()`` stays reserved for the final teardown.
+  - ``POST /debugz`` — the manual flight-recorder trigger: when a
+    ``FlightRecorder`` is installed (``observability.recorder``),
+    writes one bundle inline (rate limit bypassed — a human asked) and
+    returns its path; 503 when none is installed.
 
 Stdlib only, opt-in, and off the hot path by construction: scrapes
 read instrument values under their per-instrument locks; recorders
@@ -190,6 +197,25 @@ class ObservabilityServer:
             "trace_spans_buffered": len(tracer) if tracer is not None else 0,
             "metrics": {},
         }
+        # Flight-recorder vitals (docs/DESIGN.md §16): is the capture
+        # mechanism armed, and where did the last bundle land.
+        try:
+            from zookeeper_tpu.observability import recorder as _recorder
+
+            rec = _recorder.get_recorder()
+            status["flight_recorder"] = (
+                {
+                    "installed": True,
+                    "directory": rec.directory,
+                    "bundles_written": rec.bundles_written,
+                    "bundles_suppressed": rec.bundles_suppressed,
+                    "last_bundle": rec.last_bundle,
+                }
+                if rec is not None
+                else {"installed": False}
+            )
+        except Exception as e:  # a recorder bug must not 500 /statusz
+            status["flight_recorder"] = {"error": repr(e)}
         for registry in self._registries:
             status["metrics"].update(registry.as_flat_dict())
         # The program ledger renders on EVERY statusz (docs/DESIGN.md
@@ -251,6 +277,46 @@ class ObservabilityServer:
                     else:
                         self._send(404, "text/plain", b"not found\n")
                 except BrokenPipeError:  # scraper hung up mid-response
+                    pass
+
+            def do_POST(self):
+                path = self.path.split("?", 1)[0]
+                try:
+                    if path == "/debugz":
+                        from zookeeper_tpu.observability import (
+                            recorder as _recorder,
+                        )
+
+                        rec = _recorder.get_recorder()
+                        if rec is None:
+                            self._send(
+                                503,
+                                "application/json",
+                                json.dumps(
+                                    {
+                                        "error": "no flight recorder "
+                                        "installed (set "
+                                        "flight_recorder_dir=)"
+                                    }
+                                ).encode(),
+                            )
+                            return
+                        # force=True: a human asked — bypass the rate
+                        # limit and write inline so the response can
+                        # carry the bundle path.
+                        bundle = rec.trigger(
+                            "manual",
+                            attrs={"source": "POST /debugz"},
+                            force=True,
+                        )
+                        self._send(
+                            200,
+                            "application/json",
+                            json.dumps({"bundle": bundle}).encode(),
+                        )
+                    else:
+                        self._send(404, "text/plain", b"not found\n")
+                except BrokenPipeError:
                     pass
 
         self._httpd = ThreadingHTTPServer(

@@ -26,12 +26,24 @@ get-or-create keyed on ``(name, labels)`` so two subsystems asking for
 the same series share one instrument, while a same-name different-TYPE
 registration fails loudly (a silent type fork would render invalid
 exposition text).
+
+Label-cardinality guard: one metric NAME may register at most
+``max_label_variants`` distinct label-value combinations (default 64).
+Beyond the cap a registration returns a DETACHED instrument — fully
+usable by the caller, but never collected, so ``/metrics`` stays
+bounded — while ``zk_labels_dropped_total{metric=<name>}`` counts the
+drops and one WARNING names the runaway series. An unbounded label (a
+future per-tenant or per-bucket label fed from request data) must
+never grow the exposition without bound.
 """
 
 import bisect
+import logging
 import math
 import threading
-from typing import Dict, List, Mapping, Optional, Sequence, Tuple
+from typing import Dict, List, Mapping, Optional, Sequence, Set, Tuple
+
+logger = logging.getLogger(__name__)
 
 __all__ = [
     "Counter",
@@ -204,21 +216,39 @@ class Histogram(_Instrument):
             return out, self._count, self._sum
 
 
+#: The drop-accounting series itself is exempt from the cardinality
+#: guard (its variant count is bounded by the number of DISTINCT capped
+#: metric names, and capping it would hide the very overflow it
+#: reports).
+_DROPPED_SERIES = "zk_labels_dropped_total"
+
+
 class MetricsRegistry:
     """Name table of typed instruments.
 
     Get-or-create: ``counter/gauge/histogram`` return the existing
     instrument when ``(name, labels)`` was already registered with the
     same type (and, for histograms, the same bounds); a type or bounds
-    conflict raises — one name must mean one series shape.
+    conflict raises — one name must mean one series shape. A NEW label
+    variant past ``max_label_variants`` per name is dropped (detached
+    instrument returned; ``zk_labels_dropped_total{metric=}`` bumped,
+    warned once per name) — see the module docstring.
     """
 
-    def __init__(self) -> None:
+    def __init__(self, max_label_variants: int = 64) -> None:
+        if max_label_variants < 1:
+            raise ValueError(
+                f"max_label_variants={max_label_variants} must be >= 1."
+            )
+        self.max_label_variants = int(max_label_variants)
         self._lock = threading.Lock()
         self._instruments: Dict[Tuple[str, _LabelsKey], _Instrument] = {}
+        self._variant_counts: Dict[str, int] = {}
+        self._cardinality_warned: Set[str] = set()
 
     def _get_or_create(self, cls, name, labels, factory):
         key = (str(name), _labels_key(labels))
+        warn = False
         with self._lock:
             existing = self._instruments.get(key)
             if existing is not None:
@@ -228,9 +258,42 @@ class MetricsRegistry:
                         f"{existing.kind}, not {cls.kind}."
                     )
                 return existing
-            inst = factory()
-            self._instruments[key] = inst
-            return inst
+            variants = self._variant_counts.get(key[0], 0)
+            if (
+                key[0] != _DROPPED_SERIES
+                and variants >= self.max_label_variants
+            ):
+                # Over the cap: fall through to the detached path below
+                # (the drop counter is registered OUTSIDE this lock —
+                # it goes through _get_or_create itself).
+                if key[0] not in self._cardinality_warned:
+                    self._cardinality_warned.add(key[0])
+                    warn = True
+            else:
+                inst = factory()
+                self._instruments[key] = inst
+                self._variant_counts[key[0]] = variants + 1
+                return inst
+        if warn:
+            logger.warning(
+                "metric %r is at its label-cardinality cap (%d distinct "
+                "label combinations): new variants record into detached "
+                "instruments and are NOT exported — an unbounded label "
+                "value is feeding this series "
+                "(zk_labels_dropped_total{metric=%r} counts the drops)",
+                key[0],
+                self.max_label_variants,
+                key[0],
+            )
+        self.counter(
+            _DROPPED_SERIES,
+            help="label variants dropped by the per-metric cardinality "
+            "cap",
+            labels={"metric": key[0]},
+        ).inc()
+        # Detached: the caller gets a real, recordable instrument of
+        # the right shape; it simply never renders.
+        return factory()
 
     def counter(self, name: str, help: str = "", labels=None) -> Counter:
         return self._get_or_create(

@@ -33,6 +33,15 @@ Records carry thread identity + name (satellite: every background
 thread here is ``zk-``-prefixed named) and optional ``step``/``slab``
 attribution so a span is traceable to the training-loop coordinate
 that produced it.
+
+Request-scoped flow (docs/DESIGN.md §16): records may additionally
+carry a ``rid`` — the monotonically-minted request id from
+``observability.requests`` — and the Chrome exporter synthesizes flow
+events (``s``/``t``/``f`` phases keyed on the rid) from every
+rid-tagged record, so Perfetto draws one arrow from the submitting
+thread through the batcher/decode worker to the dispatch span and the
+completion. The rid rides the SAME record tuple (one extra slot), so
+tagging costs nothing beyond the span/event itself.
 """
 
 import json
@@ -80,14 +89,17 @@ _NOOP = _NoopSpan()
 class _Span:
     """One live span: records its interval on ``__exit__``."""
 
-    __slots__ = ("_tracer", "_name", "_step", "_slab", "_attrs", "_t0")
+    __slots__ = (
+        "_tracer", "_name", "_step", "_slab", "_attrs", "_rid", "_t0",
+    )
 
-    def __init__(self, tracer, name, step, slab, attrs):
+    def __init__(self, tracer, name, step, slab, attrs, rid):
         self._tracer = tracer
         self._name = name
         self._step = step
         self._slab = slab
         self._attrs = attrs
+        self._rid = rid
         self._t0 = 0
 
     def __enter__(self) -> "_Span":
@@ -108,6 +120,7 @@ class _Span:
                 self._step,
                 self._slab,
                 self._attrs,
+                self._rid,
             )
         )
         return False
@@ -128,10 +141,10 @@ class Tracer:
         self.capacity = int(capacity)
         self._ring: deque = deque(maxlen=self.capacity)
 
-    def span(self, name, step=None, slab=None, attrs=None) -> _Span:
-        return _Span(self, name, step, slab, attrs)
+    def span(self, name, step=None, slab=None, attrs=None, rid=None) -> _Span:
+        return _Span(self, name, step, slab, attrs, rid)
 
-    def event(self, name, step=None, attrs=None) -> None:
+    def event(self, name, step=None, attrs=None, rid=None) -> None:
         thread = threading.current_thread()
         self._ring.append(
             (
@@ -144,6 +157,7 @@ class Tracer:
                 step,
                 None,
                 attrs,
+                rid,
             )
         )
 
@@ -195,8 +209,11 @@ class Tracer:
                 "step": step,
                 "slab": slab,
                 "attrs": attrs,
+                "rid": rid,
             }
-            for (ph, name, ts, dur, tid, tname, step, slab, attrs) in records
+            for (
+                ph, name, ts, dur, tid, tname, step, slab, attrs, rid,
+            ) in records
         ]
 
 
@@ -243,24 +260,26 @@ def get_tracer() -> Optional[Tracer]:
     return _TRACER
 
 
-def span(name: str, step=None, slab=None, attrs=None):
+def span(name: str, step=None, slab=None, attrs=None, rid=None):
     """A timed interval on the calling thread. Returns the shared no-op
     when tracing is disabled — one global read, zero allocation (the
     cost contract the hot loops rely on). ``attrs`` is an optional
     pre-built dict; build it only behind an ``enabled()`` check if its
-    construction is itself nontrivial."""
+    construction is itself nontrivial. ``rid`` tags the record with a
+    request id (``observability.requests``) so the Chrome exporter can
+    draw its cross-thread flow arrow."""
     tracer = _TRACER
     if tracer is None:
         return _NOOP
-    return tracer.span(name, step, slab, attrs)
+    return tracer.span(name, step, slab, attrs, rid)
 
 
-def event(name: str, step=None, attrs=None) -> None:
+def event(name: str, step=None, attrs=None, rid=None) -> None:
     """An instant marker (fault injection, enqueue, restart...). Free
     when disabled, same contract as :func:`span`."""
     tracer = _TRACER
     if tracer is not None:
-        tracer.event(name, step, attrs)
+        tracer.event(name, step, attrs, rid)
 
 
 # -- Chrome trace-event export -------------------------------------------
@@ -279,12 +298,22 @@ def to_chrome_trace(tracer: Optional[Tracer] = None) -> Dict[str, Any]:
     detail pane). Timestamps are ``perf_counter_ns``-based — the same
     monotonic clock within one process, so host spans from every thread
     share one timeline.
+
+    Rid-tagged records additionally synthesize Chrome FLOW events
+    (docs/DESIGN.md §16): per rid with two or more records, the
+    timeline-ordered chain gets ``s`` (start) / ``t`` (step) / ``f``
+    (end) flow phases, ``id`` = the rid, ``cat`` = ``"rid"``, each flow
+    point timestamped INSIDE its record (mid-span for ``X`` records) so
+    Perfetto binds it to the enclosing slice (``bp: "e"``) and draws
+    one arrow from the submitting thread through the worker's dispatch
+    to the completion.
     """
     tracer = tracer if tracer is not None else _TRACER
     records = tracer.snapshot() if tracer is not None else []
     pid = os.getpid()
     events: List[dict] = []
     seen_threads: Dict[int, str] = {}
+    flows: Dict[Any, List[dict]] = {}
     for rec in records:
         tid = rec["thread_id"]
         if tid not in seen_threads:
@@ -303,6 +332,9 @@ def to_chrome_trace(tracer: Optional[Tracer] = None) -> Dict[str, Any]:
             args["step"] = rec["step"]
         if rec["slab"] is not None:
             args["slab"] = rec["slab"]
+        rid = rec.get("rid")
+        if rid is not None:
+            args["rid"] = rid
         out = {
             "ph": rec["phase"],
             "name": rec["name"],
@@ -316,6 +348,34 @@ def to_chrome_trace(tracer: Optional[Tracer] = None) -> Dict[str, Any]:
         else:
             out["s"] = "t"  # instant scoped to its thread
         events.append(out)
+        if rid is not None:
+            # Flow point INSIDE the record: mid-span for X so the point
+            # falls within the slice Perfetto binds the arrow to.
+            flows.setdefault(rid, []).append(
+                {
+                    "tid": tid,
+                    "ts": (rec["ts_ns"] + rec["dur_ns"] // 2) / 1e3,
+                }
+            )
+    for rid, points in flows.items():
+        if len(points) < 2:
+            continue  # an arrow needs two ends
+        points.sort(key=lambda p: p["ts"])
+        last = len(points) - 1
+        for i, point in enumerate(points):
+            ph = "s" if i == 0 else ("f" if i == last else "t")
+            flow = {
+                "ph": ph,
+                "name": "request",
+                "cat": "rid",
+                "id": rid,
+                "pid": pid,
+                "tid": point["tid"],
+                "ts": point["ts"],
+            }
+            if ph != "s":
+                flow["bp"] = "e"  # bind to the enclosing slice
+            events.append(flow)
     return {"traceEvents": events, "displayTimeUnit": "ms"}
 
 

@@ -31,13 +31,25 @@ counter bump. Anomalous samples still update the EWMA and the window —
 a persistent regression fires for a bounded burst (~1/alpha
 observations) while it is news, then becomes the new baseline instead
 of alerting forever.
+
+Anomalies are also a FLIGHT-RECORDER trigger source (docs/DESIGN.md
+§16): every trigger notifies the process-global recorder (one global
+read when none is installed) so the evidence — trace ring, metrics,
+RequestLog — is bundled while the straggler's spans still exist; the
+``on_anomaly`` callback seam lets a caller subscribe its own handler
+on top (called OUTSIDE the watchdog lock; its failures are logged,
+never raised into the step path).
 """
 
+import logging
 import threading
 from collections import deque
-from typing import Optional
+from typing import Callable, Optional
 
+from zookeeper_tpu.observability import recorder as _recorder
 from zookeeper_tpu.observability import trace as _trace
+
+logger = logging.getLogger(__name__)
 from zookeeper_tpu.observability.registry import (
     MetricsRegistry,
     default_registry,
@@ -71,6 +83,9 @@ class StepTimeWatchdog:
         min_excess_s: float = 0.0,
         recompute_every: int = 8,
         registry: Optional[MetricsRegistry] = None,
+        on_anomaly: Optional[
+            Callable[[str, float, Optional[int]], None]
+        ] = None,
     ) -> None:
         if not 0.0 < alpha <= 1.0:
             raise ValueError(f"alpha={alpha} must be in (0, 1].")
@@ -94,6 +109,11 @@ class StepTimeWatchdog:
         #: dispatcher) where baseline AND spread are so tiny that
         #: host-scheduler jitter satisfies every relative test.
         self.min_excess_s = float(min_excess_s)
+        #: Subscriber seam: ``on_anomaly(stream, seconds, step)`` fires
+        #: per flagged sample, after the counter/event, outside the
+        #: lock. The flight recorder is notified regardless (module
+        #: global; no-op when none installed).
+        self.on_anomaly = on_anomaly
         self._recompute_every = max(1, int(recompute_every))
         self._window: deque = deque(maxlen=int(window))
         self._lock = threading.Lock()
@@ -185,4 +205,24 @@ class StepTimeWatchdog:
                     "baseline_ms": round((ewma or 0.0) * 1e3, 3),
                 },
             )
+            # Flight-recorder trigger + the caller's seam, both outside
+            # the lock and both failure-isolated from the step path.
+            _recorder.notify(
+                "step_time_anomaly",
+                step=step,
+                attrs={
+                    "stream": self.stream,
+                    "observed_ms": round(seconds * 1e3, 3),
+                    "baseline_ms": round((ewma or 0.0) * 1e3, 3),
+                },
+            )
+            callback = self.on_anomaly
+            if callback is not None:
+                try:
+                    callback(self.stream, seconds, step)
+                except Exception:
+                    logger.warning(
+                        "watchdog on_anomaly callback failed",
+                        exc_info=True,
+                    )
         return anomalous

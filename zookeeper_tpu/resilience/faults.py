@@ -36,6 +36,7 @@ import threading
 from dataclasses import dataclass, field
 from typing import Iterator, Optional
 
+from zookeeper_tpu.observability import recorder as _recorder
 from zookeeper_tpu.observability import trace as _trace
 
 
@@ -43,8 +44,13 @@ def _injection_event(kind: str, step: Optional[int] = None) -> None:
     """Every fault that actually FIRES marks the host trace, so a
     chaos-test timeline is self-explaining: the injected kill/IO-
     failure/crash appears as an instant event exactly where the
-    recovery machinery it triggered starts its spans."""
+    recovery machinery it triggered starts its spans. It is also a
+    flight-recorder trigger (docs/DESIGN.md §16): a chaos leg bundles
+    its own evidence, so ``fault_injected{kind}`` timelines come with
+    the trace ring + RequestLog that explain them. ``notify`` is one
+    global read when no recorder is installed."""
     _trace.event("fault_injected", step=step, attrs={"kind": kind})
+    _recorder.notify("fault_injected", step=step, attrs={"kind": kind})
 
 
 class Preempted(Exception):

@@ -24,6 +24,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Tuple, Type
 
+from zookeeper_tpu.observability import recorder as _recorder
 from zookeeper_tpu.observability import trace as _trace
 from zookeeper_tpu.resilience.faults import NonFiniteLossError, Preempted
 
@@ -144,6 +145,18 @@ def run_with_recovery(
                     "attempt": attempt + 1,
                     "cause": type(e).__name__,
                     "backoff_s": delay,
+                },
+            )
+            # One flight-recorder bundle per recovery (docs/DESIGN.md
+            # §16): the state the run died in — trace ring, metrics,
+            # ledger — captured before the restart overwrites it. One
+            # global read when no recorder is installed.
+            _recorder.notify(
+                "supervisor_restart",
+                step=getattr(e, "step", None),
+                attrs={
+                    "attempt": attempt + 1,
+                    "cause": type(e).__name__,
                 },
             )
             if delay > 0:

@@ -52,7 +52,9 @@ from typing import Any, List, Optional, Tuple
 import numpy as np
 
 from zookeeper_tpu.core import Field, component
+from zookeeper_tpu.observability import recorder as _recorder
 from zookeeper_tpu.observability import trace as _trace
+from zookeeper_tpu.observability.requests import RequestLog, next_rid
 
 Array = Any
 
@@ -77,14 +79,33 @@ class WorkerCrashedError(RuntimeError):
     again runs on a freshly restarted worker."""
 
 
+def outcome_of(error: Optional[BaseException]) -> str:
+    """Map a terminal error to the RequestLog outcome taxonomy
+    (``observability.requests.OUTCOMES``) — shared by the batcher and
+    the decode scheduler so the two services' summaries read the
+    same."""
+    if error is None:
+        return "ok"
+    if isinstance(error, DeadlineExpiredError):
+        return "deadline_expired"
+    if isinstance(error, WorkerCrashedError):
+        return "crashed"
+    if isinstance(error, RejectedError):
+        return "shed"
+    return "error"
+
+
 class PendingResult:
     """Handle for one submitted request; ``result()`` yields the
-    ``[n, ...]`` output rows in submission order."""
+    ``[n, ...]`` output rows in submission order. Carries the request's
+    ``rid`` (minted at submit, docs/DESIGN.md §16) so its trace records
+    link up as one flow and its terminal summary lands in the
+    batcher's ``RequestLog``."""
 
     __slots__ = (
         "_batcher", "_event", "_parts", "_rows", "_rows_done",
         "_value", "_error", "_done", "_t_submit", "_deadline_at",
-        "_lock",
+        "_lock", "rid", "_t_dispatch_ns", "_bucket",
     )
 
     def __init__(
@@ -93,6 +114,7 @@ class PendingResult:
         rows: int,
         event,
         deadline_at: Optional[float] = None,
+        rid: Optional[int] = None,
     ) -> None:
         self._batcher = batcher
         self._event = event  # None in synchronous mode
@@ -104,6 +126,11 @@ class PendingResult:
         self._done = False
         self._t_submit = time.perf_counter()
         self._deadline_at = deadline_at  # absolute perf_counter secs
+        #: Request id (process-monotonic; None only for handles built
+        #: outside submit(), e.g. direct construction in tests).
+        self.rid = rid
+        self._t_dispatch_ns: Optional[int] = None
+        self._bucket: Optional[int] = None
         # Completion can race between the worker (deliver), a crash
         # handler (fail), and the caller's deadline expiry (fail):
         # first transition wins, the rest are no-ops.
@@ -236,7 +263,7 @@ class MicroBatcher:
 
     # -- wiring ----------------------------------------------------------
 
-    def bind(self, engine, metrics=None) -> "MicroBatcher":
+    def bind(self, engine, metrics=None, request_log=None) -> "MicroBatcher":
         if self.max_queue_rows < 1:
             raise ValueError(
                 f"max_queue_rows={self.max_queue_rows} must be >= 1."
@@ -253,6 +280,14 @@ class MicroBatcher:
             )
         object.__setattr__(self, "_engine", engine)
         object.__setattr__(self, "_metrics", metrics)
+        # Per-service terminal-request ring (docs/DESIGN.md §16): one
+        # compact summary per request that reached an outcome, exposed
+        # at /statusz and dumped into flight-recorder bundles.
+        object.__setattr__(
+            self,
+            "_request_log",
+            request_log if request_log is not None else RequestLog("serving"),
+        )
         # Queue of (request, x, lo, hi): row slice [lo, hi) of request
         # still owed. Oversized/partially-taken requests stay at the
         # head with lo advanced, so delivery is always in row order.
@@ -273,10 +308,17 @@ class MicroBatcher:
                 "before submit()."
             )
 
+    def _weights_step(self) -> Optional[int]:
+        return (
+            self._metrics.weights_step if self._metrics is not None else None
+        )
+
     def _record_done(self, req: PendingResult, latency_ms: float) -> None:
+        outcome = outcome_of(req._error)
         if _trace.enabled():
             _trace.event(
                 "request_complete",
+                rid=req.rid,
                 attrs={
                     "rows": req._rows,
                     "latency_ms": round(latency_ms, 3),
@@ -284,6 +326,22 @@ class MicroBatcher:
                     if req._error is not None
                     else None,
                 },
+            )
+        if req.rid is not None:
+            self._request_log.append(
+                req.rid,
+                outcome,
+                enqueue_ns=int(req._t_submit * 1e9),
+                dispatch_ns=req._t_dispatch_ns,
+                complete_ns=time.perf_counter_ns(),
+                rows=req._rows,
+                bucket=req._bucket,
+                weights_step=self._weights_step(),
+                detail=(
+                    type(req._error).__name__
+                    if req._error is not None
+                    else None
+                ),
             )
         if self._metrics is not None and req._error is None:
             self._metrics.record_request(latency_ms, req._rows)
@@ -297,6 +355,11 @@ class MicroBatcher:
     def queue_rows(self) -> int:
         return getattr(self, "_queue_rows", 0)
 
+    @property
+    def request_log(self) -> Optional[RequestLog]:
+        """This batcher's terminal-request ring (None before bind)."""
+        return getattr(self, "_request_log", None)
+
     # -- submission ------------------------------------------------------
 
     def _deadline_at(self, deadline_ms: Optional[float]) -> Optional[float]:
@@ -308,7 +371,7 @@ class MicroBatcher:
             raise ValueError(f"deadline_ms={deadline_ms} must be >= 0.")
         return time.perf_counter() + deadline_ms / 1e3
 
-    def _shed_check(self, n: int) -> None:
+    def _shed_check(self, n: int, rid: Optional[int] = None) -> None:
         """Raise ``RejectedError`` when admitting ``n`` more rows would
         pass the shed threshold (caller holds the lock in async mode)."""
         if (
@@ -321,7 +384,21 @@ class MicroBatcher:
             if _trace.enabled():
                 _trace.event(
                     "request_shed",
+                    rid=rid,
                     attrs={"rows": n, "queue_rows": self._queue_rows},
+                )
+            if rid is not None:
+                # The one terminal path with no PendingResult: the
+                # request was never enqueued, so its summary lands
+                # here.
+                now_ns = time.perf_counter_ns()
+                self._request_log.append(
+                    rid,
+                    "shed",
+                    enqueue_ns=now_ns,
+                    complete_ns=now_ns,
+                    rows=n,
+                    weights_step=self._weights_step(),
                 )
             raise RejectedError(
                 f"queue at {self._queue_rows} rows; admitting {n} more "
@@ -350,11 +427,17 @@ class MicroBatcher:
             )
         n = int(x.shape[0])
         deadline_at = self._deadline_at(deadline_ms)
+        # The rid is minted HERE — before shed/backpressure — so every
+        # outcome (including a shed that never enqueues) is traceable
+        # and RequestLog-recorded under one id (docs/DESIGN.md §16).
+        rid = next_rid()
         if self.synchronous:
-            self._shed_check(n)
+            self._shed_check(n, rid)
             if self._queue and self._queue_rows + n > self.max_queue_rows:
                 self.flush()  # backpressure: drain the backlog inline
-            req = PendingResult(self, n, event=None, deadline_at=deadline_at)
+            req = PendingResult(
+                self, n, event=None, deadline_at=deadline_at, rid=rid
+            )
             self._queue.append((req, x, 0, n))
             object.__setattr__(self, "_queue_rows", self._queue_rows + n)
             if self._metrics is not None:
@@ -362,14 +445,16 @@ class MicroBatcher:
             if _trace.enabled():
                 _trace.event(
                     "request_enqueue",
+                    rid=rid,
                     attrs={"rows": n, "queue_rows": self._queue_rows},
                 )
             return req
         req = PendingResult(
-            self, n, event=threading.Event(), deadline_at=deadline_at
+            self, n, event=threading.Event(), deadline_at=deadline_at,
+            rid=rid,
         )
         with self._cv:
-            self._shed_check(n)
+            self._shed_check(n, rid)
             while (
                 self._queue
                 and self._queue_rows + n > self.max_queue_rows
@@ -383,6 +468,7 @@ class MicroBatcher:
             if _trace.enabled():
                 _trace.event(
                     "request_enqueue",
+                    rid=rid,
                     attrs={"rows": n, "queue_rows": self._queue_rows},
                 )
             # Worker liveness is checked UNDER the lock, after the
@@ -463,11 +549,28 @@ class MicroBatcher:
         try:
             with dispatch_span:
                 t0 = time.perf_counter()
+                t0_ns = time.perf_counter_ns()
                 batch = (
                     plan[0][1]
                     if len(plan) == 1
                     else np.concatenate([part for _, part in plan])
                 )
+                bucket = self._engine.bucket_for(rows)
+                # First-dispatch attribution BEFORE the device work: a
+                # crash mid-infer still leaves the summary saying the
+                # request reached dispatch. The per-rid instants sit
+                # INSIDE this span, so the exporter's flow arrows bind
+                # submit -> this dispatch slice.
+                for req, _ in plan:
+                    if req._t_dispatch_ns is None:
+                        req._t_dispatch_ns = t0_ns
+                        req._bucket = bucket
+                    if _trace.enabled() and req.rid is not None:
+                        _trace.event(
+                            "request_dispatch",
+                            rid=req.rid,
+                            attrs={"bucket": bucket},
+                        )
                 out = np.asarray(jax.device_get(self._engine.infer(batch)))
                 dispatch_s = time.perf_counter() - t0
             # The device_get above bounds the dispatch honestly: feed
@@ -484,9 +587,7 @@ class MicroBatcher:
                         "observe_dispatch failed", exc_info=True
                     )
             if self._metrics is not None:
-                self._metrics.record_dispatch(
-                    rows, self._engine.bucket_for(rows)
-                )
+                self._metrics.record_dispatch(rows, bucket)
             offset = 0
             for req, part in plan:
                 k = part.shape[0]
@@ -627,6 +728,20 @@ class MicroBatcher:
             for req in inflight + pending:
                 req._fail(wrapped)
             self._cv.notify_all()
+        # Flight-recorder trigger (docs/DESIGN.md §16), fired AFTER the
+        # fails so the bundle's RequestLog tail already carries the
+        # crashed requests' outcome=crashed summaries alongside their
+        # flow events — and OUTSIDE the lock, so a synchronous bundle
+        # write (disk IO) never stalls concurrent submitters waiting on
+        # _cv. notify() is one global read when no recorder is
+        # installed and never raises into this cleanup path.
+        _recorder.notify(
+            "worker_crash",
+            attrs={
+                "error": type(error).__name__,
+                "failed_requests": len(inflight) + len(pending),
+            },
+        )
 
     def close(self, drain: bool = False) -> None:
         """Stop the async worker. ``drain=True`` serves everything still

@@ -384,6 +384,14 @@ DecodeScheduler`.
             "path (each one is a serving stall)",
         ).inc()
         _trace.event("recompile_detected", attrs={"program": str(key)})
+        # Flight-recorder trigger (docs/DESIGN.md §16): with continuous
+        # batching a dispatch-path recompile stalls EVERY active
+        # stream — bundle the evidence while their spans exist.
+        from zookeeper_tpu.observability import recorder as _recorder
+
+        _recorder.notify(
+            "recompile_detected", attrs={"program": str(key)}
+        )
         logger.warning(
             "post-warmup decode-engine recompile on the dispatch path "
             "(%s): every active stream is stalling on XLA — warm the "

@@ -197,6 +197,12 @@ class DecodeMetrics:
     # -- reduction -------------------------------------------------------
 
     @property
+    def weights_step(self) -> int:
+        """The live-weights gauge as a plain int (-1 = bind-time
+        weights); stamped onto RequestLog summaries."""
+        return int(self._obs()["gauges"]["weights_step"].value)
+
+    @property
     def totals(self) -> Dict[str, int]:
         obs = self._obs()
         return {

@@ -54,11 +54,14 @@ from typing import Any, List, Optional
 import numpy as np
 
 from zookeeper_tpu.core import Field, component
+from zookeeper_tpu.observability import recorder as _recorder
 from zookeeper_tpu.observability import trace as _trace
+from zookeeper_tpu.observability.requests import RequestLog, next_rid
 from zookeeper_tpu.serving.batcher import (
     DeadlineExpiredError,
     RejectedError,
     WorkerCrashedError,
+    outcome_of,
 )
 
 logger = logging.getLogger(__name__)
@@ -80,6 +83,7 @@ class DecodeStream:
         max_new_tokens: int,
         deadline_at: Optional[float],
         eos_token: Optional[int],
+        rid: Optional[int] = None,
     ) -> None:
         self._scheduler = scheduler
         self.prompt = prompt
@@ -93,6 +97,12 @@ class DecodeStream:
         self._t_submit = time.perf_counter()
         #: Submit-to-first-token milliseconds (None until it lands).
         self.ttft_ms: Optional[float] = None
+        #: Request id minted at submit (docs/DESIGN.md §16); its trace
+        #: records render as one Perfetto flow and its terminal summary
+        #: lands in the scheduler's RequestLog.
+        self.rid = rid
+        self._t_dispatch_ns: Optional[int] = None
+        self._slot: Optional[int] = None
         # Completion races between the worker (finish), a crash handler
         # (fail) and the caller's deadline expiry: first wins.
         self._cond = threading.Condition()
@@ -144,6 +154,9 @@ class DecodeStream:
             self._done = True
             self._finish_reason = reason
             self._cond.notify_all()
+        # Outside the cond (first-transition-wins above guarantees
+        # exactly one terminal record per stream).
+        self._scheduler._log_terminal(self, "ok", detail=reason)
 
     def _fail(self, error: BaseException) -> bool:
         with self._cond:
@@ -152,7 +165,10 @@ class DecodeStream:
             self._done = True
             self._error = error
             self._cond.notify_all()
-            return True
+        self._scheduler._log_terminal(
+            self, outcome_of(error), detail=type(error).__name__
+        )
+        return True
 
     def _expire(self) -> bool:
         waited_ms = (time.perf_counter() - self._t_submit) * 1e3
@@ -227,7 +243,7 @@ class DecodeScheduler:
 
     # -- wiring ----------------------------------------------------------
 
-    def bind(self, engine, metrics=None) -> "DecodeScheduler":
+    def bind(self, engine, metrics=None, request_log=None) -> "DecodeScheduler":
         if self.max_new_tokens < 1:
             raise ValueError(
                 f"max_new_tokens={self.max_new_tokens} must be >= 1 "
@@ -243,6 +259,12 @@ class DecodeScheduler:
         engine._require_bound()
         object.__setattr__(self, "_engine", engine)
         object.__setattr__(self, "_metrics", metrics)
+        # Per-service terminal-request ring (docs/DESIGN.md §16).
+        object.__setattr__(
+            self,
+            "_request_log",
+            request_log if request_log is not None else RequestLog("decode"),
+        )
         n = int(engine.slots)
         object.__setattr__(self, "_queue", deque())
         object.__setattr__(self, "_slot_stream", [None] * n)
@@ -267,6 +289,44 @@ class DecodeScheduler:
                 "DecodeScheduler is not bound: call "
                 "scheduler.bind(engine) before submit()."
             )
+
+    @property
+    def request_log(self) -> Optional[RequestLog]:
+        """This scheduler's terminal-request ring (None before bind)."""
+        return getattr(self, "_request_log", None)
+
+    def _log_terminal(
+        self, stream: "DecodeStream", outcome: str, detail: Optional[str]
+    ) -> None:
+        """One compact RequestLog summary per TERMINAL stream (called
+        by the stream's first-wins finish/fail transition)."""
+        log = getattr(self, "_request_log", None)
+        if log is None or stream.rid is None:
+            return
+        if outcome != "ok" and _trace.enabled():
+            # The ok path already marked its terminal record
+            # (decode_stream_finish, rid-tagged); failed streams get
+            # theirs here so every outcome's flow chain has a terminus.
+            _trace.event(
+                "decode_stream_fail",
+                rid=stream.rid,
+                attrs={"outcome": outcome, "detail": detail},
+            )
+        log.append(
+            stream.rid,
+            outcome,
+            enqueue_ns=int(stream._t_submit * 1e9),
+            dispatch_ns=stream._t_dispatch_ns,
+            complete_ns=time.perf_counter_ns(),
+            tokens=len(stream._tokens),
+            slot=stream._slot,
+            weights_step=(
+                self._metrics.weights_step
+                if self._metrics is not None
+                else None
+            ),
+            detail=detail,
+        )
 
     # -- submission ------------------------------------------------------
 
@@ -328,12 +388,16 @@ class DecodeScheduler:
         eos = eos_token if eos_token is not None else (
             int(self.eos_token) if int(self.eos_token) >= 0 else None
         )
+        # Minted before admission control, so shed streams are
+        # traceable and RequestLog-recorded too (docs/DESIGN.md §16).
+        rid = next_rid()
         stream = DecodeStream(
             self,
             prompt,
             new,
             self._deadline_at(deadline_ms),
             eos,
+            rid=rid,
         )
         with self._lock:
             if (
@@ -346,8 +410,10 @@ class DecodeScheduler:
                 if _trace.enabled():
                     _trace.event(
                         "decode_request_shed",
+                        rid=rid,
                         attrs={"queue_depth": len(self._queue)},
                     )
+                self._log_terminal(stream, "shed", detail="RejectedError")
                 raise RejectedError(
                     f"decode queue at {len(self._queue)} requests; "
                     f"admitting one more would exceed shed_above="
@@ -360,6 +426,7 @@ class DecodeScheduler:
                 if _trace.enabled():
                     _trace.event(
                         "decode_request_enqueue",
+                        rid=rid,
                         attrs={
                             "prompt_tokens": int(prompt.shape[0]),
                             "queue_depth": len(self._queue),
@@ -480,6 +547,7 @@ class DecodeScheduler:
             if _trace.enabled():
                 _trace.event(
                     "decode_stream_finish",
+                    rid=stream.rid,
                     attrs={
                         "slot": slot,
                         "reason": reason,
@@ -539,9 +607,23 @@ class DecodeScheduler:
                     slots.append(free[len(group) - 1])
                 if not group:
                     continue
+                t0_ns = time.perf_counter_ns()
                 for stream, slot in zip(group, slots):
                     self._slot_stream[slot] = stream
                     self._slot_lengths[slot] = int(stream.prompt.shape[0])
+                    # Dispatch attribution BEFORE the device work (a
+                    # crash mid-prefill still shows the stream reached
+                    # dispatch), rid-tagged so the exporter links the
+                    # submit event to this slot's prefill.
+                    stream._slot = slot
+                    if stream._t_dispatch_ns is None:
+                        stream._t_dispatch_ns = t0_ns
+                    if _trace.enabled() and stream.rid is not None:
+                        _trace.event(
+                            "decode_request_dispatch",
+                            rid=stream.rid,
+                            attrs={"slot": slot},
+                        )
             t0 = time.perf_counter()
             first = engine.prefill([s.prompt for s in group], slots)
             dt_ms = (time.perf_counter() - t0) * 1e3
@@ -683,6 +765,18 @@ class DecodeScheduler:
             for stream in streams:
                 stream._fail(wrapped)
             self._update_occupancy()
+        # Flight-recorder trigger, AFTER the fails (the bundle's
+        # RequestLog tail already carries outcome=crashed) and OUTSIDE
+        # the lock (a synchronous bundle write must not stall
+        # submit()/status() waiting on _lock). One global read when no
+        # recorder is installed; never raises (docs/DESIGN.md §16).
+        _recorder.notify(
+            "decode_worker_crash",
+            attrs={
+                "error": type(error).__name__,
+                "failed_streams": len(streams),
+            },
+        )
 
     # -- driving (synchronous mode) --------------------------------------
 

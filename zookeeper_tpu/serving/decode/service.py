@@ -72,6 +72,12 @@ class LMServingConfig(Experiment):
     #: series) + ``/statusz`` decode section (active slots, queue
     #: depth, KV pages in use). -1 = off; 0 = ephemeral port.
     metrics_port: int = Field(-1)
+    #: Flight recorder (docs/DESIGN.md §16): directory for rate-limited
+    #: debug bundles on decode-worker crashes, recompiles, watchdog
+    #: anomalies, fault injections and ``POST /debugz``. None = off.
+    flight_recorder_dir: Optional[str] = Field(None)
+    #: Minimum seconds between bundles (manual ``/debugz`` bypasses).
+    flight_recorder_interval_s: float = Field(30.0)
 
     def build_service(self):
         """Load weights, bind + warm the engine, bind the scheduler.
@@ -119,13 +125,52 @@ class LMServingConfig(Experiment):
         if self.warmup:
             self.engine.warmup()
         self.scheduler.bind(self.engine, metrics=self.metrics)
-        if self.metrics_port >= 0:
+        if self.metrics_port >= 0 or self.flight_recorder_dir:
             try:
-                self._start_obs_server()
+                if self.flight_recorder_dir:
+                    self._start_flight_recorder()
+                if self.metrics_port >= 0:
+                    self._start_obs_server()
             except BaseException:
                 self._teardown_service(suppress=True)
                 raise
         return self.engine, self.scheduler
+
+    def _request_log_status(self):
+        """``/statusz`` + bundle section: the recent terminal-stream
+        tail (rid, timestamps, outcome — docs/DESIGN.md §16)."""
+        log = self.scheduler.request_log
+        return log.as_status() if log is not None else {}
+
+    def _start_flight_recorder(self):
+        from zookeeper_tpu.observability import recorder as _recorder
+        from zookeeper_tpu.observability.registry import default_registry
+
+        rec = _recorder.arm(
+            self.flight_recorder_dir,
+            registries=[default_registry(), self.metrics.registry],
+            status_providers={
+                "decode": self.scheduler.status,
+                "requests": self._request_log_status,
+            },
+            request_logs={"decode": self.scheduler.request_log},
+            min_interval_s=self.flight_recorder_interval_s,
+        )
+        object.__setattr__(self, "flight_recorder", rec)
+        if self.verbose:
+            print(
+                f"flight recorder armed: {self.flight_recorder_dir}",
+                flush=True,
+            )
+        return rec
+
+    def _stop_flight_recorder(self):
+        from zookeeper_tpu.observability import recorder as _recorder
+
+        rec = getattr(self, "flight_recorder", None)
+        if rec is not None:
+            object.__setattr__(self, "flight_recorder", None)
+            _recorder.disarm(rec)
 
     def _start_obs_server(self):
         from zookeeper_tpu.observability import (
@@ -137,7 +182,10 @@ class LMServingConfig(Experiment):
         server = ObservabilityServer(
             [default_registry(), self.metrics.registry],
             port=self.metrics_port,
-            status_providers={"decode": self.scheduler.status},
+            status_providers={
+                "decode": self.scheduler.status,
+                "requests": self._request_log_status,
+            },
         )
         server.start()
         object.__setattr__(self, "obs_server", server)
@@ -167,6 +215,7 @@ class LMServingConfig(Experiment):
         if probe is not None:
             object.__setattr__(self, "obs_probe", None)
             steps.append(probe.stop)
+        steps.append(self._stop_flight_recorder)
         steps.append(self.scheduler.close)
         run_teardown_steps(steps, suppress=suppress)
 

@@ -363,6 +363,19 @@ class InferenceEngine:
                     "dtype": str(np.dtype(dtype)),
                 },
             )
+            # Flight-recorder trigger (docs/DESIGN.md §16): a recompile
+            # mid-traffic is exactly the stall whose evidence (which
+            # requests waited, what shapes arrived) evicts fast.
+            from zookeeper_tpu.observability import recorder as _recorder
+
+            _recorder.notify(
+                "recompile_detected",
+                attrs={
+                    "bucket": bucket,
+                    "seq_bucket": seq_bucket,
+                    "dtype": str(np.dtype(dtype)),
+                },
+            )
             logger.warning(
                 "post-warmup recompile on the request path "
                 "(bucket=%d, seq=%s, dtype=%s): requests are stalling "

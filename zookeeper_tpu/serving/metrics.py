@@ -295,6 +295,13 @@ class ServingMetrics:
     # -- reduction -------------------------------------------------------
 
     @property
+    def weights_step(self) -> int:
+        """The live-weights gauge as a plain int (-1 = bind-time
+        weights) — the cheap read the per-request RequestLog summaries
+        stamp without assembling ``totals``."""
+        return int(self._obs()["gauges"]["weights_step"].value)
+
+    @property
     def totals(self) -> Dict[str, int]:
         obs = self._obs()
         out: Dict[str, int] = {}

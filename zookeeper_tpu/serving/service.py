@@ -111,6 +111,18 @@ class ServingConfig(Experiment):
     #: ``/trace``. -1 = off (default); 0 = ephemeral port (readable via
     #: ``self.obs_server.port`` — the CI scrape smoke uses this).
     metrics_port: int = Field(-1)
+    #: Flight recorder (docs/DESIGN.md §16): when set, a
+    #: ``FlightRecorder`` writing rate-limited debug bundles to this
+    #: directory is installed for the service's lifetime — worker
+    #: crashes, recompiles, watchdog anomalies, fault injections and
+    #: ``POST /debugz`` each dump the trace ring, /metrics text,
+    #: program ledger, statusz sections and the RequestLog tail into
+    #: one directory. None = off.
+    flight_recorder_dir: Optional[str] = Field(None)
+    #: Minimum seconds between flight-recorder bundles (rate limit; a
+    #: crash loop must not fill the disk). Manual ``/debugz`` triggers
+    #: bypass it.
+    flight_recorder_interval_s: float = Field(30.0)
 
     @property
     def input_shape(self):
@@ -194,9 +206,12 @@ class ServingConfig(Experiment):
                     initial_step=watch_baseline,
                 ),
             )
-        if self.metrics_port >= 0:
+        if self.metrics_port >= 0 or self.flight_recorder_dir:
             try:
-                self._start_obs_server()
+                if self.flight_recorder_dir:
+                    self._start_flight_recorder()
+                if self.metrics_port >= 0:
+                    self._start_obs_server()
             except BaseException:
                 # The service half-exists (watcher daemon polling,
                 # batcher bound) and run()'s cleanup paths only cover
@@ -206,6 +221,44 @@ class ServingConfig(Experiment):
                 self._teardown_service(suppress=True)
                 raise
         return self.engine, self.batcher
+
+    def _request_log_status(self):
+        """``/statusz`` + bundle section: the recent terminal-request
+        tail (rid, timestamps, outcome — docs/DESIGN.md §16)."""
+        log = self.batcher.request_log
+        return log.as_status() if log is not None else {}
+
+    def _start_flight_recorder(self):
+        from zookeeper_tpu.observability import recorder as _recorder
+        from zookeeper_tpu.observability.registry import default_registry
+
+        rec = _recorder.arm(
+            self.flight_recorder_dir,
+            registries=[default_registry(), self.metrics.registry],
+            status_providers={
+                "serving": self._obs_status,
+                "requests": self._request_log_status,
+            },
+            request_logs={"serving": self.batcher.request_log},
+            min_interval_s=self.flight_recorder_interval_s,
+        )
+        object.__setattr__(self, "flight_recorder", rec)
+        if self.verbose:
+            print(
+                f"flight recorder armed: {self.flight_recorder_dir} "
+                f"(>= {self.flight_recorder_interval_s:.0f}s between "
+                "bundles; POST /debugz for a manual one)",
+                flush=True,
+            )
+        return rec
+
+    def _stop_flight_recorder(self):
+        from zookeeper_tpu.observability import recorder as _recorder
+
+        rec = getattr(self, "flight_recorder", None)
+        if rec is not None:
+            object.__setattr__(self, "flight_recorder", None)
+            _recorder.disarm(rec)
 
     def _obs_status(self):
         """``/statusz`` section: the serving-process vitals an operator
@@ -238,7 +291,10 @@ class ServingConfig(Experiment):
         server = ObservabilityServer(
             [default_registry(), self.metrics.registry],
             port=self.metrics_port,
-            status_providers={"serving": self._obs_status},
+            status_providers={
+                "serving": self._obs_status,
+                "requests": self._request_log_status,
+            },
         )
         server.start()
         object.__setattr__(self, "obs_server", server)
@@ -307,9 +363,13 @@ class ServingConfig(Experiment):
 
     def _teardown_service(self, *, suppress: bool = False) -> None:
         """The ONE teardown sequence (watcher daemon, /metrics port,
-        batcher worker) shared by every exit path."""
+        flight recorder, batcher worker) shared by every exit path."""
         watcher = getattr(self, "watcher", None)
-        steps = [self._teardown_obs_server, self.batcher.close]
+        steps = [
+            self._teardown_obs_server,
+            self._stop_flight_recorder,
+            self.batcher.close,
+        ]
         if watcher is not None:
             steps.insert(0, watcher.stop)
         run_teardown_steps(steps, suppress=suppress)

@@ -165,6 +165,18 @@ class TrainingExperiment(Experiment):
     #: (default); 0 = bind an ephemeral port (logged, and readable via
     #: ``self.obs_server.port``).
     metrics_port: int = Field(-1)
+    #: Flight recorder (docs/DESIGN.md §16): when set, a
+    #: ``FlightRecorder`` writing to this directory is installed for
+    #: the run, so watchdog anomalies, NaN-halts, fault injections and
+    #: supervisor recoveries each dump a rate-limited debug bundle
+    #: (trace ring + /metrics text + program ledger + statusz +
+    #: manifest). None = off. Under ``run_with_recovery`` the recorder
+    #: persists across restarts (same experiment object, same Field),
+    #: so every recovery writes its bundle.
+    flight_recorder_dir: Optional[str] = Field(None)
+    #: Minimum seconds between flight-recorder bundles (manual
+    #: ``/debugz`` triggers bypass it).
+    flight_recorder_interval_s: float = Field(30.0)
     #: Report the per-step sign-flip fraction of binary kernels
     #: (larq FlipRatio capability) in the train metrics.
     track_flip_ratio: bool = Field(False)
@@ -340,6 +352,27 @@ class TrainingExperiment(Experiment):
             probe.start()
             self.obs_probe = probe
             self._log(f"observability endpoint: {server.url}/metrics")
+        if self.flight_recorder_dir:
+            from zookeeper_tpu.observability import recorder as _obs_recorder
+            from zookeeper_tpu.observability.registry import default_registry
+
+            rec = getattr(self, "flight_recorder", None)
+            if rec is None or rec.directory != self.flight_recorder_dir:
+                rec = _obs_recorder.arm(
+                    self.flight_recorder_dir,
+                    registries=[default_registry(), self.obs_registry],
+                    status_providers={"training": self._obs_status},
+                    min_interval_s=self.flight_recorder_interval_s,
+                )
+                self.flight_recorder = rec
+            # Installed for the PROCESS, not the run: run() teardown
+            # deliberately leaves it in place, because the supervisor's
+            # bundle-per-recovery trigger fires AFTER run() has exited
+            # with the recoverable status (docs/DESIGN.md §16). The
+            # same experiment object re-runs under run_with_recovery
+            # and reuses this recorder (re-install covers a replacement
+            # installed by an interleaved service in the meantime).
+            _obs_recorder.install(rec)
 
     def _finish_host_trace(self) -> None:
         """Teardown: write the Chrome trace-event JSON and restore the
@@ -676,6 +709,16 @@ class TrainingExperiment(Experiment):
             if "skipped_steps" in m
         )
         if skipped > 0:
+            # Flight-recorder trigger (docs/DESIGN.md §16): the trace
+            # ring around the NaN step is the forensic record — bundle
+            # it before the supervisor's restore discards the run.
+            from zookeeper_tpu.observability import recorder as _obs_recorder
+
+            _obs_recorder.notify(
+                "nan_halt",
+                step=global_step,
+                attrs={"skipped_steps": int(skipped)},
+            )
             raise NonFiniteLossError(global_step, int(skipped))
 
     def _run_fused_epoch(
