@@ -749,32 +749,15 @@ def measure_checkpoint_stall(env=None):
     }
 
 
-def measure_decode_throughput(env=None):
-    """``ZK_BENCH_DECODE=1`` leg: tokens/s/chip and TTFT percentiles of
-    the continuous-batching decode engine under MIXED prefill/decode
-    traffic (docs/DESIGN.md §15).
-
-    The workload is the steady-state serving shape: many more requests
-    than slots, submitted up front, so after the first cohort every
-    prefill dispatch (a finished stream's slot being REFILLED) lands
-    between decode dispatches of the still-active streams — prefill and
-    decode interleave on one device exactly as they do in production.
-    The whole run is asserted compile-free after warmup (a recompile
-    would invalidate the numbers AND the engine contract).
-
-    Metrics: ``serve_decode_tokens_per_sec_per_chip`` (generated tokens
-    over the serve wall time, per chip), ``decode_ttft_p50/p99_ms``
-    (submit-to-first-token; p99 is the interactive-latency gate),
-    ``decode_token_p50_ms`` (one decode dispatch = one token for every
-    active slot), ``decode_prefill_p50_ms``, and the slot-refill count.
-
-    Knobs: ``ZK_BENCH_DECODE_REQUESTS`` (default 64),
-    ``ZK_BENCH_DECODE_SLOTS`` (default 8),
-    ``ZK_BENCH_DECODE_NEW_TOKENS`` (per-request budget, default 32),
-    ``ZK_BENCH_DECODE_PROMPT`` (max prompt length, default 32),
-    ``ZK_BENCH_DECODE_LAYERS``/``_DMODEL``/``_HEADS`` (model geometry,
-    default 4/256/4 — small enough to run everywhere, big enough that
-    the decode dispatch is device work rather than host overhead)."""
+def _run_decode_flavor(env, decode_attention, tag):
+    """One decode-bench serve at a given ``decode_attention`` flavor:
+    build + warm an engine, push the steady-state mixed prefill/decode
+    workload through the continuous-batching scheduler, assert
+    compile-free, and return ``(tokens, dt, snap, engine, outputs,
+    shape)`` where ``shape`` is the env-resolved workload (requests /
+    slots / new_tokens — parsed HERE, once, so the reported keys can
+    never disagree with the workload actually run). Shared by the
+    headline run and the kernel-vs-reference A/B."""
     import numpy as np
 
     from zookeeper_tpu.core import configure
@@ -785,7 +768,6 @@ def measure_decode_throughput(env=None):
         DecodeScheduler,
     )
 
-    env = os.environ if env is None else env
     n_requests = int(env.get("ZK_BENCH_DECODE_REQUESTS", "64"))
     slots = int(env.get("ZK_BENCH_DECODE_SLOTS", "8"))
     new_tokens = int(env.get("ZK_BENCH_DECODE_NEW_TOKENS", "32"))
@@ -807,11 +789,11 @@ def measure_decode_throughput(env=None):
             "max_seq_len": seq_len,
             # Dense prefill: at <= max_prompt tokens the flash kernels
             # buy nothing (and interpret-mode Pallas would dominate
-            # off-TPU); the decode dispatch is cached_attention either
-            # way.
+            # off-TPU); the decode dispatch's flavor is the engine's
+            # decode_attention Field.
             "attention": "dense",
         },
-        name="decode_bench_model",
+        name=f"decode_bench_model_{tag}",
     )
     module = model.build((seq_len,), vocab)
     params, model_state = model.initialize(module, (seq_len,), seed=0)
@@ -822,16 +804,21 @@ def measure_decode_throughput(env=None):
             "slots": slots,
             "seq_buckets": (max_prompt,),
             "kv_capacity": seq_len,
+            "decode_attention": decode_attention,
         },
-        name="decode_bench_engine",
+        name=f"decode_bench_engine_{tag}",
     )
     engine.bind(module, params, model_state)
     engine.warmup()
     warm_compiles = engine.compile_count
     metrics = DecodeMetrics()
-    configure(metrics, {}, name="decode_bench_metrics")
+    configure(metrics, {}, name=f"decode_bench_metrics_{tag}")
     scheduler = DecodeScheduler()
-    configure(scheduler, {"max_new_tokens": new_tokens}, name="decode_bench_sched")
+    configure(
+        scheduler,
+        {"max_new_tokens": new_tokens},
+        name=f"decode_bench_sched_{tag}",
+    )
     scheduler.bind(engine, metrics=metrics)
 
     rng = np.random.default_rng(0)
@@ -844,20 +831,84 @@ def measure_decode_throughput(env=None):
     streams = [scheduler.submit(p) for p in prompts]
     scheduler.drain()
     dt = time.perf_counter() - t0
-    tokens = sum(int(s.result().shape[0]) for s in streams)
+    outputs = [s.result() for s in streams]
+    tokens = sum(int(o.shape[0]) for o in outputs)
     if engine.compile_count != warm_compiles:
         raise RuntimeError(
-            f"decode leg recompiled mid-traffic ({warm_compiles} -> "
-            f"{engine.compile_count}); the throughput numbers are invalid."
+            f"decode leg ({decode_attention}) recompiled mid-traffic "
+            f"({warm_compiles} -> {engine.compile_count}); the "
+            "throughput numbers are invalid."
         )
+    shape = {
+        "requests": n_requests,
+        "slots": slots,
+        "new_tokens": new_tokens,
+    }
+    return tokens, dt, metrics.snapshot(), engine, outputs, shape
+
+
+def measure_decode_throughput(env=None):
+    """``ZK_BENCH_DECODE=1`` leg: tokens/s/chip and TTFT percentiles of
+    the continuous-batching decode engine under MIXED prefill/decode
+    traffic (docs/DESIGN.md §15), plus the paged-decode-kernel A/B
+    (§17).
+
+    The workload is the steady-state serving shape: many more requests
+    than slots, submitted up front, so after the first cohort every
+    prefill dispatch (a finished stream's slot being REFILLED) lands
+    between decode dispatches of the still-active streams — prefill and
+    decode interleave on one device exactly as they do in production.
+    Every flavor's run is asserted compile-free after warmup (a
+    recompile would invalidate the numbers AND the engine contract).
+
+    Headline metrics come from the flavor ``decode_attention="auto"``
+    resolves to on this backend (the Pallas paged kernel on TPU, the
+    reference einsum elsewhere — interpret-mode Pallas is a grid-loop
+    interpreter whose timings measure the interpreter, not the
+    kernel): ``serve_decode_tokens_per_sec_per_chip`` (generated
+    tokens over the serve wall time, per chip),
+    ``decode_ttft_p50/p99_ms`` (submit-to-first-token; p99 is the
+    interactive-latency gate), ``decode_token_p50_ms`` (one decode
+    dispatch = one token for every active slot),
+    ``decode_prefill_p50_ms``, the slot-refill count, and
+    ``decode_mbu`` (last dispatch's bytes/time/bandwidth — the
+    memory-bound roofline, -1 when cost analysis is unavailable).
+
+    The A/B (``ZK_BENCH_DECODE_AB=0`` disables) times BOTH flavors on
+    the same workload and reports
+    ``decode_kernel_tokens_per_sec_per_chip`` /
+    ``decode_reference_tokens_per_sec_per_chip`` /
+    ``decode_kernel_speedup``, and asserts the two flavors emitted
+    token-identical streams — the bench re-pins the numerics contract
+    on every run. On TPU the speedup is the PR's acceptance number
+    (length-bounded HBM reads on a memory-bound step); on CPU the
+    kernel leg runs interpreted and records the honest (slower) number.
+
+    Knobs: ``ZK_BENCH_DECODE_REQUESTS`` (default 64),
+    ``ZK_BENCH_DECODE_SLOTS`` (default 8),
+    ``ZK_BENCH_DECODE_NEW_TOKENS`` (per-request budget, default 32),
+    ``ZK_BENCH_DECODE_PROMPT`` (max prompt length, default 32),
+    ``ZK_BENCH_DECODE_LAYERS``/``_DMODEL``/``_HEADS`` (model geometry,
+    default 4/256/4 — small enough to run everywhere, big enough that
+    the decode dispatch is device work rather than host overhead)."""
+    import numpy as np
+
+    env = os.environ if env is None else env
+    # The headline run serves with "auto" — the deployed default — and
+    # the RESOLVED flavor is read back from the engine: one source of
+    # truth (DecodeEngine._resolve_decode_attention), so a future auto
+    # policy change cannot silently desync the bench from production.
+    tokens, dt, snap, engine, outputs, shape = _run_decode_flavor(
+        env, "auto", tag="auto"
+    )
+    headline = engine.decode_attention_flavor
     # Per-chip means per chip the engine actually SERVES on (the
     # default bind: one device) — dividing by the host's device_count
     # would make the gated key depend on idle-host topology, an 8x
     # phantom swing between a 1-chip and an 8-chip runner.
     mesh = engine._partitioner.mesh
     n_chips = int(mesh.size) if mesh is not None else 1
-    snap = metrics.snapshot()
-    return {
+    out = {
         "serve_decode_tokens_per_sec_per_chip": round(
             tokens / dt / n_chips, 1
         ),
@@ -865,17 +916,79 @@ def measure_decode_throughput(env=None):
         "decode_ttft_p99_ms": round(snap.get("ttft_p99_ms", -1.0), 3),
         "decode_token_p50_ms": round(snap.get("token_p50_ms", -1.0), 3),
         "decode_prefill_p50_ms": round(snap.get("prefill_p50_ms", -1.0), 3),
-        # Informational context (never gates): workload + refill shape.
-        "decode_requests": n_requests,
-        "decode_slots": slots,
-        "decode_new_tokens": new_tokens,
+        # MBU at the run's MEDIAN dispatch time (the gauge's last-
+        # dispatch sample is the drain tail — a single-sample gated key
+        # would be flaky by construction).
+        "decode_mbu": round(
+            engine.decode_mbu_for(snap.get("token_p50_ms", -1.0) / 1e3), 4
+        ),
+        # Informational context (never gates): the RESOLVED flavor (a
+        # geometry-degraded "pallas" reports "reference" — the number
+        # must be labeled with the program that produced it), plus the
+        # workload shape.
+        "decode_attention_flavor": engine.decode_attention_flavor,
+        "decode_requests": shape["requests"],
+        "decode_slots": shape["slots"],
+        "decode_new_tokens": shape["new_tokens"],
         # Admissions beyond the first slot-array cohort = slots that
         # were REFILLED mid-traffic without a drain or recompile.
         "decode_refills": max(
-            0, int(snap["requests_total"]) - min(slots, n_requests)
+            0,
+            int(snap["requests_total"])
+            - min(shape["slots"], shape["requests"]),
         ),
         "decode_generated_tokens": tokens,
     }
+    if _env_flag(env, "ZK_BENCH_DECODE_AB", "1"):
+        other = "reference" if headline == "pallas" else "pallas"
+        # Everything the headline engine had to answer is captured in
+        # `out`/`headline`: release its device state (KV cache +
+        # weights) before building the B-leg engine, or the A/B would
+        # DOUBLE the HBM footprint and OOM at cache sizes the headline
+        # run alone serves fine.
+        engine = None
+        tokens_b, dt_b, _, engine_b, outputs_b, _ = _run_decode_flavor(
+            env, other, tag=other
+        )
+        if engine_b.decode_attention_flavor == headline:
+            # Geometry degraded the kernel leg to the reference (see
+            # DecodeEngine._resolve_decode_attention): both runs timed
+            # the SAME program, and recording that as a kernel
+            # measurement would seed bench_diff with a fake ~1.0
+            # speedup baseline. Omit the A/B keys — absent keys never
+            # gate.
+            print(
+                "bench: decode A/B skipped — both flavors resolved to "
+                f"{headline!r} (kernel-unsupported geometry); no "
+                "kernel numbers to record",
+                file=sys.stderr,
+            )
+            return out
+        mismatch = sum(
+            1 for a, b in zip(outputs, outputs_b)
+            if not np.array_equal(a, b)
+        )
+        if mismatch:
+            raise RuntimeError(
+                f"decode A/B: {mismatch}/{len(outputs)} streams differ "
+                "between the kernel and reference flavors — the "
+                "token-exact numerics contract is broken; the "
+                "throughput comparison is meaningless."
+            )
+        by_flavor = {
+            headline: tokens / dt / n_chips,
+            other: tokens_b / dt_b / n_chips,
+        }
+        out["decode_kernel_tokens_per_sec_per_chip"] = round(
+            by_flavor["pallas"], 1
+        )
+        out["decode_reference_tokens_per_sec_per_chip"] = round(
+            by_flavor["reference"], 1
+        )
+        out["decode_kernel_speedup"] = round(
+            by_flavor["pallas"] / by_flavor["reference"], 3
+        ) if by_flavor["reference"] > 0 else -1.0
+    return out
 
 
 def measure_trace_overhead(env=None):

@@ -22,6 +22,11 @@ weights -> paged-KV continuous-batching decode) in two commands::
     python examples/serve_lm.py ServeLM engine.slots=16 new_tokens=64 \\
         metrics_port=8080
 
+    # Decode-attention flavor (docs/DESIGN.md §17): auto = the
+    # length-aware Pallas paged decode kernel on TPU, the reference
+    # einsum elsewhere; force either for an A/B:
+    python examples/serve_lm.py ServeLM engine.decode_attention=pallas
+
 Every request rides the REAL serving path — bucketed prefill into a
 KV slot, slot-refill continuous batching, per-token streaming — so the
 reported numbers are the decode subsystem's, not a synthetic loop's

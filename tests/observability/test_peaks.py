@@ -105,3 +105,58 @@ def test_live_anchor_agrees_with_bench_fallback_path():
             else peaks.ACHIEVABLE_FRACTION * sheet
         )
         assert live == pytest.approx(offline)
+
+
+# -- HBM bandwidth anchors (the decode MBU roofline, DESIGN.md §17) -------
+
+
+def test_reference_hbm_bandwidth_env_override_wins():
+    value, source = peaks.reference_hbm_bandwidth(
+        "TPU v5e", env={"ZK_BENCH_HBM_BANDWIDTH": "1.0e12"}
+    )
+    assert (value, source) == (1.0e12, "env")
+
+
+def test_reference_hbm_bandwidth_datasheet_by_generation():
+    for kind, gbps in (
+        ("TPU v5 lite", 819.0),
+        ("TPU v4", 1228.0),
+        ("TPU v5p", 2765.0),
+        ("TPU v6e", 1640.0),
+    ):
+        value, source = peaks.reference_hbm_bandwidth(kind, env={})
+        assert value == pytest.approx(gbps * 1e9)
+        assert source == "datasheet"
+
+
+def test_reference_hbm_bandwidth_unknown_falls_back_v5e():
+    value, source = peaks.reference_hbm_bandwidth("FutureChip 9", env={})
+    assert value == peaks.HBM_BANDWIDTH_FALLBACK
+    assert source == "fallback_v5e"
+    # Total without jax/device_kind too (gauge updates never raise).
+    value, source = peaks.reference_hbm_bandwidth(None, env={})
+    assert value > 0
+
+
+def test_reference_hbm_bandwidth_malformed_env_ignored(caplog):
+    import logging
+
+    with caplog.at_level(logging.WARNING):
+        value, source = peaks.reference_hbm_bandwidth(
+            "TPU v5e", env={"ZK_BENCH_HBM_BANDWIDTH": "fast"}
+        )
+    assert source == "datasheet"  # the override was warn-and-ignored
+    assert any("ZK_BENCH_HBM_BANDWIDTH" in r.message for r in caplog.records)
+
+
+def test_mbu_totality_and_value():
+    from zookeeper_tpu.observability.ledger import mbu
+
+    assert mbu(819e9, 1.0, 819e9) == pytest.approx(1.0)
+    assert mbu(40.95e9, 0.1, 819e9) == pytest.approx(0.5)
+    # Unknown bytes / zero time / missing bandwidth -> None (the gauge
+    # publishes -1), never a raise.
+    assert mbu(None, 0.01, 819e9) is None
+    assert mbu(1e9, 0.0, 819e9) is None
+    assert mbu(1e9, 0.01, None) is None
+    assert mbu(-5.0, 0.01, 819e9) is None

@@ -385,6 +385,67 @@ def test_decode_parity_on_dp_tp_mesh():
 
 
 @pytest.mark.slow
+def test_decode_kernel_parity_on_dp_tp_mesh():
+    """The PALLAS paged decode kernel under the sharded path: slots on
+    'data', heads on 'model' via the shard_map-composed
+    ``sharded_paged_decode_attention`` (docs/DESIGN.md §17) — still
+    token-exact vs the full-context oracle, zero post-warmup compiles.
+    The dryrun_multichip decode leg re-certifies this with the SPMD log
+    asserted clean."""
+    from zookeeper_tpu.parallel.partitioner import MeshPartitioner
+
+    module, params, state, variables = build_lm()
+    part = MeshPartitioner()
+    configure(
+        part,
+        {
+            "mesh_shape": (2, 4),
+            "mesh_axes": ("data", "model"),
+            "data_axes": ("data",),
+        },
+        name="part",
+    )
+    part.setup()
+    engine = make_engine(
+        module, params, state, slots=4, partitioner=part,
+        decode_attention="pallas",
+    )
+    assert engine.decode_attention_flavor == "pallas"
+    warm = engine.warmup()
+    sched = make_scheduler(engine)
+    rng = np.random.default_rng(11)
+    prompts = [
+        rng.integers(1, VOCAB, size=int(rng.integers(2, 15))).astype(np.int32)
+        for _ in range(6)
+    ]
+    streams = [sched.submit(p, max_new_tokens=8) for p in prompts]
+    sched.drain()
+    for p, s in zip(prompts, streams):
+        np.testing.assert_array_equal(
+            s.result(), oracle(module, variables, p, 8)
+        )
+    assert engine.compile_count == warm
+    assert not engine._cache[0]["k"].sharding.is_fully_replicated
+
+    # The indivisible-geometry posture with the kernel selected:
+    # slots=3 cannot shard over the 2-way data axis, the cache goes
+    # REPLICATED, and the kernel runs under fully-replicated shard_map
+    # specs — still token-exact.
+    engine3 = make_engine(
+        module, params, state, slots=3, partitioner=part,
+        decode_attention="pallas",
+    )
+    assert engine3.decode_attention_flavor == "pallas"
+    assert engine3._cache_replicated
+    engine3.warmup()
+    p = np.arange(1, 8, dtype=np.int32)
+    np.testing.assert_array_equal(
+        make_scheduler(engine3).generate(p, max_new_tokens=6),
+        oracle(module, variables, p, 6),
+    )
+
+
+@pytest.mark.slow
 def test_indivisible_cache_falls_back_replicated(caplog):
     """slots=3 on a 2-way data mesh cannot shard — the engine warns and
     decodes with a REPLICATED cache, still token-exact."""

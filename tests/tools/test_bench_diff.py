@@ -257,3 +257,34 @@ def test_bench_main_wires_compare(tmp_path):
     assert args.compare_out is None
     args = bench.parse_args([])
     assert args.compare is None
+
+
+def test_decode_kernel_era_keys_classify():
+    """The paged-decode-kernel A/B + MBU keys (DESIGN.md §17) gate
+    direction-aware; the flavor tag is config, not perf."""
+    assert bench_diff.classify_metric("decode_mbu") == "higher"
+    assert bench_diff.classify_metric("decode_kernel_speedup") == "higher"
+    for key in (
+        "decode_kernel_tokens_per_sec_per_chip",
+        "decode_reference_tokens_per_sec_per_chip",
+    ):
+        assert bench_diff.classify_metric(key) == "higher"
+    assert bench_diff.classify_metric("decode_attention_flavor") is None
+
+
+def test_decode_kernel_keys_gate_with_registered_tolerances():
+    from tools.bench_diff import TOLERANCES, compare
+
+    for key in (
+        "decode_mbu",
+        "decode_kernel_speedup",
+        "decode_kernel_tokens_per_sec_per_chip",
+        "decode_reference_tokens_per_sec_per_chip",
+    ):
+        tol = TOLERANCES[key]
+        prev = {"metric": "x", key: 1.0}
+        # Just inside tolerance: no gate; past it: regression.
+        ok = compare({"metric": "x", key: 1.0 - tol * 0.9}, prev)
+        assert ok.ok, key
+        bad = compare({"metric": "x", key: 1.0 - tol * 1.5}, prev)
+        assert not bad.ok and bad.regressions[0]["name"] == key

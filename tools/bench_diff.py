@@ -68,12 +68,22 @@ TOLERANCES = {
     "decode_ttft_p50_ms": 0.40,
     "decode_token_p50_ms": 0.40,
     "decode_prefill_p50_ms": 0.40,
+    # Paged-decode-kernel era (docs/DESIGN.md §17): the A/B throughputs
+    # gate like the headline (same wall-clock jitter class); the
+    # speedup is a RATIO of two jittery numbers and scatters more; MBU
+    # divides a millisecond-scale dispatch time into cost-analysis
+    # bytes, so shared-host scheduling noise passes straight through.
+    "decode_kernel_tokens_per_sec_per_chip": 0.30,
+    "decode_reference_tokens_per_sec_per_chip": 0.25,
+    "decode_kernel_speedup": 0.35,
+    "decode_mbu": 0.35,
 }
 
-#: HIGHER-better metric name patterns (throughput family).
+#: HIGHER-better metric name patterns (throughput family). MBU joins
+#: MFU: both are utilization-of-roofline ratios where down = regressed.
 _HIGHER = re.compile(
     r"(_per_sec|_per_sec_per_chip|_per_sec_per_core|_qps|qps_per_chip"
-    r"|^value$|^vs_baseline$|^mfu_|_mfu$|_speedup"
+    r"|^value$|^vs_baseline$|^mfu_|_mfu$|_mbu$|_speedup"
     r"|tokens_per_sec|images_per_sec|steps_overlapped)"
 )
 

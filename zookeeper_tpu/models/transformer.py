@@ -39,6 +39,7 @@ from zookeeper_tpu.ops import (
     attention_reference,
     cached_attention,
     flash_attention,
+    paged_decode_attention,
 )
 from zookeeper_tpu.parallel.sharding import constrain_batch_sharded
 
@@ -61,6 +62,27 @@ def _resolve_attention(attention):
     raise ValueError(
         f"attention={attention!r}: expected 'flash', 'dense', or an "
         "attention callable."
+    )
+
+
+def _resolve_decode_attention(decode_attention):
+    """``"reference"`` / ``"pallas"`` / any ``callable(q, k_cache,
+    v_cache, lengths)`` — the decode-path analogue of
+    :func:`_resolve_attention`. ``"reference"`` is the
+    :func:`cached_attention` oracle einsum; ``"pallas"`` the
+    length-aware paged decode kernel (auto interpret off-TPU); the
+    callable form is how the decode engine injects the mesh-composed
+    ``sharded_paged_decode_attention`` (or any future flavor) without
+    rebuilding the module — see ``DecodeEngine.decode_attention``."""
+    if callable(decode_attention):
+        return decode_attention
+    if decode_attention == "reference":
+        return cached_attention
+    if decode_attention == "pallas":
+        return paged_decode_attention
+    raise ValueError(
+        f"decode_attention={decode_attention!r}: expected 'reference', "
+        "'pallas', or a callable(q, k_cache, v_cache, lengths)."
     )
 
 
@@ -100,6 +122,10 @@ class _Block(nn.Module):
     attention: Any
     dtype: Any
     pin_activations: bool = True
+    #: Decode-path attention flavor: "reference" (the cached_attention
+    #: oracle), "pallas" (the paged decode kernel), or a callable. A
+    #: per-call ``attention_override`` (the engine seam) wins.
+    decode_attention: Any = "reference"
 
     def setup(self):
         d = self.d_model
@@ -148,7 +174,7 @@ class _Block(nn.Module):
             return out, (kh, vh)
         return out
 
-    def decode(self, x, k_cache, v_cache, lengths):
+    def decode(self, x, k_cache, v_cache, lengths, attention_override=None):
         """One cached-attention step: ``x [b, 1, d]`` is the new token's
         residual stream, ``k_cache/v_cache [b, capacity, heads,
         head_dim]`` the slot KV buffers, ``lengths [b]`` the tokens
@@ -157,7 +183,10 @@ class _Block(nn.Module):
         decodes past capacity; the clamp only keeps an inactive slot's
         idle write in bounds), attends rows ``0..lengths``, and returns
         ``(x_out, k_cache, v_cache)``. Same projections/norms as
-        ``__call__`` — the weights are literally the same submodules."""
+        ``__call__`` — the weights are literally the same submodules.
+        The attention over the cache runs ``attention_override`` when
+        given (the decode engine's flavor seam), else the block's
+        ``decode_attention`` setting."""
         b = x.shape[0]
         head_dim = self.d_model // self.num_heads
 
@@ -170,7 +199,12 @@ class _Block(nn.Module):
         rows = jnp.arange(b)
         k_cache = k_cache.at[rows, write].set(k[:, 0], mode="drop")
         v_cache = v_cache.at[rows, write].set(v[:, 0], mode="drop")
-        o = cached_attention(q, k_cache, v_cache, lengths)
+        attn = (
+            attention_override
+            if attention_override is not None
+            else _resolve_decode_attention(self.decode_attention)
+        )
+        o = attn(q, k_cache, v_cache, lengths)
         x = x + self.wproj(o.reshape(b, 1, self.d_model))
         return self._mlp(x), k_cache, v_cache
 
@@ -225,6 +259,10 @@ class TransformerLMModule(nn.Module):
     dtype: Any
     #: None = auto (see ``_auto_pin_activations``); bool overrides.
     pin_activations: Any = None
+    #: Decode-path attention flavor ("reference" | "pallas" |
+    #: callable); a ``decode_step`` per-call override wins — see
+    #: ``_resolve_decode_attention``.
+    decode_attention: Any = "reference"
 
     def setup(self):
         self.embed = self.param(
@@ -246,6 +284,7 @@ class TransformerLMModule(nn.Module):
                 attention=self.attention,
                 dtype=self.dtype,
                 pin_activations=pin,
+                decode_attention=self.decode_attention,
                 name=f"block{i}",
             )
             for i in range(self.num_layers)
@@ -313,13 +352,19 @@ class TransformerLMModule(nn.Module):
         last = jnp.take_along_axis(logits, idx[:, None, None], axis=1)[:, 0]
         return last, tuple(kv)
 
-    def decode_step(self, tokens, lengths, cache):
+    def decode_step(self, tokens, lengths, cache, attention_override=None):
         """One incremental token per sequence. ``tokens [b] int`` are
         the CURRENT input tokens (each sits at position ``lengths``),
         ``cache`` is a per-layer tuple of ``{"k", "v"}`` buffers
         ``[b, capacity, heads, head_dim]``. Returns ``(logits [b,
         vocab], new_cache)`` — the caller owns length bookkeeping and
-        feeds ``argmax(logits)`` back as the next step's ``tokens``."""
+        feeds ``argmax(logits)`` back as the next step's ``tokens``.
+        ``attention_override`` (a ``callable(q, k_cache, v_cache,
+        lengths)``) selects the cache-attention flavor for THIS trace,
+        overriding the module's ``decode_attention`` — the seam the
+        decode engine threads its config-selected kernel (or the
+        mesh-composed sharded wrapper) through without rebuilding the
+        module."""
         if len(cache) != self.num_layers:
             raise ValueError(
                 f"cache has {len(cache)} layers, model has "
@@ -332,7 +377,10 @@ class TransformerLMModule(nn.Module):
             x = constrain_batch_sharded(x)
         new_cache = []
         for block, layer in zip(self.blocks, cache):
-            x, kc, vc = block.decode(x, layer["k"], layer["v"], lengths)
+            x, kc, vc = block.decode(
+                x, layer["k"], layer["v"], lengths,
+                attention_override=attention_override,
+            )
             new_cache.append({"k": kc, "v": vc})
         return self._logits(x)[:, 0], tuple(new_cache)
 
@@ -394,6 +442,14 @@ class TransformerLM(Model):
     #: "flash" (Pallas kernels, long-context default) or "dense" (the
     #: oracle path).
     attention: str = Field("flash")
+    #: Decode-path (KV-cache) attention flavor: "reference" (the
+    #: ``cached_attention`` oracle einsum — reads the full capacity
+    #: axis every step) or "pallas" (the length-aware paged decode
+    #: kernel). The DEFAULT stays the reference so direct module users
+    #: keep oracle numerics; the serving engine's own
+    #: ``decode_attention="auto"`` Field selects the kernel on TPU —
+    #: see ``DecodeEngine``.
+    decode_attention: str = Field("reference")
     #: Positional-table capacity. -1 (the default) sizes it to the
     #: sequence length ``build()`` receives — the common case, and it
     #: keeps one ``seq_len`` knob sufficient in CLI tasks. Set
@@ -416,6 +472,18 @@ class TransformerLM(Model):
             )
         object.__setattr__(self, "_attention_override", fn)
 
+    def set_decode_attention_override(self, fn) -> None:
+        """The decode-path twin of :meth:`set_attention_override`: a
+        mesh-owning caller installs a ``callable(q, k_cache, v_cache,
+        lengths)`` here before ``build()`` and it takes precedence over
+        the string ``decode_attention`` Field. ``None`` clears."""
+        if fn is not None and not callable(fn):
+            raise ValueError(
+                f"decode attention override must be callable(q, k_cache, "
+                f"v_cache, lengths) or None, got {fn!r}."
+            )
+        object.__setattr__(self, "_decode_attention_override", fn)
+
     def build(self, input_shape: Sequence[int], num_classes: int) -> nn.Module:
         if len(input_shape) != 1:
             raise ValueError(
@@ -430,6 +498,10 @@ class TransformerLM(Model):
         if attention is None:
             _resolve_attention(self.attention)
             attention = self.attention
+        decode_attention = getattr(self, "_decode_attention_override", None)
+        if decode_attention is None:
+            _resolve_decode_attention(self.decode_attention)
+            decode_attention = self.decode_attention
         if self.d_model % self.num_heads != 0:
             raise ValueError(
                 f"d_model={self.d_model} not divisible by "
@@ -460,6 +532,7 @@ class TransformerLM(Model):
             attention=attention,
             max_seq_len=max_seq_len,
             dtype=self.dtype(),
+            decode_attention=decode_attention,
         )
 
     def initialize(

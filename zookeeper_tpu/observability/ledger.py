@@ -58,6 +58,7 @@ __all__ = [
     "cost_bytes",
     "cost_flops",
     "default_ledger",
+    "mbu",
     "mfu",
 ]
 
@@ -332,6 +333,23 @@ def mfu(
         return None
     value = flops / seconds / peak_flops
     return value if value == value and value != float("inf") else None
+
+
+def mbu(
+    bytes_accessed: Optional[float],
+    seconds: Optional[float],
+    peak_bytes_per_sec: Optional[float],
+) -> Optional[float]:
+    """Memory-bandwidth utilization: ``bytes / seconds / bandwidth`` —
+    the roofline lens for MEMORY-bound programs (decode_step reads the
+    KV cache and weights every token; its MFU is meaninglessly low by
+    construction). Same totality contract as :func:`mfu`: None unless
+    every input is positive and finite, so the ``zk_decode_mbu`` gauge
+    renders -1-unknown instead of raising or lying. NOTE the bytes side
+    is XLA's STATIC cost analysis — with a length-aware kernel the true
+    bytes read are lower, so the gauge is an upper bound
+    (docs/DESIGN.md §17)."""
+    return mfu(bytes_accessed, seconds, peak_bytes_per_sec)
 
 
 # -- the compile-seam wrapper --------------------------------------------

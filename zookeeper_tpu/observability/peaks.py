@@ -64,15 +64,19 @@ __all__ = [
     "ACHIEVABLE_FRACTION",
     "BF16_PEAK_FALLBACK",
     "DATASHEET_HEADROOM",
+    "HBM_BANDWIDTH_FALLBACK",
     "INT8_FACTOR_UPPER_BOUND",
     "INT8_PEAK_FALLBACK",
     "TPU_DATASHEET_BF16_TFLOPS",
+    "TPU_DATASHEET_HBM_GBPS",
     "TPU_INT8_FACTOR",
     "V5E_KEYS",
     "aggregate_peak_attempts",
     "check_peak_against_datasheet",
     "datasheet_bf16_peak",
+    "datasheet_hbm_bandwidth",
     "datasheet_match",
+    "reference_hbm_bandwidth",
     "reference_int8_peak_flops",
     "reference_peak_flops",
 ]
@@ -134,6 +138,32 @@ TPU_INT8_FACTOR = {
 }
 INT8_FACTOR_UPPER_BOUND = 2.0
 
+# Public datasheet HBM bandwidths (GB/s per chip), same substring-keyed
+# table discipline as the bf16 peaks: the roofline the decode MBU gauge
+# (memory-bound programs — docs/DESIGN.md §17) divides by. Deliberately
+# the DATASHEET number with no "achievable fraction" prior: unlike the
+# flops anchor, no on-chip bandwidth measurement has been recorded in
+# this repo, and inventing a transfer fraction would be exactly the
+# fabricated-anchor pathology rounds 2-5 document. A sustained-copy
+# measurement can later join as a recorded fallback the way
+# BF16_PEAK_FALLBACK did.
+TPU_DATASHEET_HBM_GBPS = {
+    "v2": 700.0,
+    "v3": 900.0,
+    "v4": 1228.0,
+    "v5 lite": 819.0,
+    "v5litepod": 819.0,
+    "v5e": 819.0,
+    "v5p": 2765.0,
+    "v6 lite": 1640.0,
+    "v6e": 1640.0,
+}
+
+#: Fallback HBM bandwidth (bytes/s) when the generation is
+#: unrecognized: the v5e datasheet number — the same fallback posture
+#: as BF16_PEAK_FALLBACK (this machine's part).
+HBM_BANDWIDTH_FALLBACK = 819e9
+
 #: The v5e table keys: the generation whose RECORDED on-chip measurement
 #: (BF16_PEAK_FALLBACK) exists, distinguished by key rather than by
 #: comparing datasheet numbers (float identity would silently drift if a
@@ -146,15 +176,24 @@ V5E_KEYS = frozenset({"v5 lite", "v5litepod", "v5e"})
 ACHIEVABLE_FRACTION = 0.93
 
 
+def _match_datasheet_table(device_kind, table) -> Optional[Tuple[str, float]]:
+    """Longest-substring table match shared by every datasheet lookup
+    (flops AND bandwidth — one matching rule, so a future device_kind
+    normalization cannot apply to one table and silently miss the
+    other). Returns ``(table_key, raw_table_value)`` or None."""
+    kind = (device_kind or "").lower()
+    best = None
+    for key, value in table.items():
+        if key in kind and (best is None or len(key) > len(best[0])):
+            best = (key, value)
+    return best
+
+
 def datasheet_match(device_kind) -> Optional[Tuple[str, float]]:
     """``(table_key, peak_flops)`` for the longest table key contained in
     ``device_kind``, or None when the generation is unrecognized."""
-    kind = (device_kind or "").lower()
-    best = None
-    for key, tflops in TPU_DATASHEET_BF16_TFLOPS.items():
-        if key in kind and (best is None or len(key) > len(best[0])):
-            best = (key, tflops * 1e12)
-    return best
+    best = _match_datasheet_table(device_kind, TPU_DATASHEET_BF16_TFLOPS)
+    return None if best is None else (best[0], best[1] * 1e12)
 
 
 def datasheet_bf16_peak(device_kind) -> Optional[float]:
@@ -163,6 +202,42 @@ def datasheet_bf16_peak(device_kind) -> Optional[float]:
     clamped to a stale table)."""
     match = datasheet_match(device_kind)
     return None if match is None else match[1]
+
+
+def datasheet_hbm_bandwidth(device_kind) -> Optional[float]:
+    """Datasheet HBM bandwidth (bytes/s) for a jax ``device_kind``
+    string, or None when the generation is unrecognized — the same
+    longest-substring matcher as :func:`datasheet_match`."""
+    best = _match_datasheet_table(device_kind, TPU_DATASHEET_HBM_GBPS)
+    return None if best is None else best[1] * 1e9
+
+
+def reference_hbm_bandwidth(
+    device_kind: Optional[str] = None, env=None
+) -> Tuple[float, str]:
+    """The HBM-bandwidth anchor for live MBU gauges (``zk_decode_mbu``),
+    resolved WITHOUT touching the device — the bandwidth twin of
+    :func:`reference_peak_flops`: ``ZK_BENCH_HBM_BANDWIDTH`` override
+    (bytes/s) > the generation's datasheet bandwidth > the v5e
+    fallback. Returns ``(bytes_per_sec, source_tag)``; resolution stays
+    total even without jax/backends, so a gauge update can never raise
+    (gauges publish -1 when the BYTES side is unknown, never because of
+    this anchor)."""
+    env = os.environ if env is None else env
+    override = _env_peak(env, "ZK_BENCH_HBM_BANDWIDTH")
+    if override is not None:
+        return override, "env"
+    if device_kind is None:
+        try:
+            import jax
+
+            device_kind = jax.devices()[0].device_kind
+        except Exception:
+            device_kind = None
+    sheet = datasheet_hbm_bandwidth(device_kind)
+    if sheet is not None:
+        return sheet, "datasheet"
+    return HBM_BANDWIDTH_FALLBACK, "fallback_v5e"
 
 
 def check_peak_against_datasheet(peak, device_kind) -> None:

@@ -134,6 +134,309 @@ def cached_attention(
     ).astype(q.dtype)
 
 
+def decode_attention_supported(num_heads: int, head_dim: int) -> bool:
+    """Whether :func:`paged_decode_attention` serves this geometry.
+
+    The kernel's in-VMEM tiles put ``head_dim`` on the lane dimension
+    and the head block on sublanes; Mosaic pads either to the hardware
+    tile, but a head_dim off the fp32 sublane quantum (8) is untested
+    territory on real silicon, so such geometries take the reference
+    einsum instead of risking a Mosaic lowering failure on the serving
+    hot path. Interpret mode has no such constraint, but the predicate
+    is deliberately backend-independent: a config must resolve to the
+    same flavor on the CPU tier-1 runner as on the TPU it deploys to.
+    """
+    return num_heads >= 1 and head_dim >= 8 and head_dim % 8 == 0
+
+
+def _decode_vmem_estimate(block_kv, block_h, head_dim, itemsize):
+    """Rough bytes one decode-kernel grid step keeps resident: the
+    double-buffered K and V tiles at the operand dtype plus the fp32
+    broadcast intermediates (scores and the p*v product both
+    materialize ``[block_kv, block_h, head_dim]``) and the per-head
+    accumulators."""
+    tiles = 2 * 2 * block_kv * block_h * head_dim * itemsize
+    intermediates = 2 * block_kv * block_h * head_dim * 4
+    accumulators = (block_h * head_dim + 2 * block_h) * 4
+    return tiles + intermediates + accumulators
+
+
+def _default_decode_blocks(
+    capacity, num_heads, head_dim, page_size=1, itemsize=4,
+    block_kv=None, block_h=None,
+):
+    """Auto block policy for the decode kernel — the
+    ``_default_flash_blocks`` discipline applied to the KV-read axis:
+    the LARGEST aligned candidate that divides ``capacity``, nests with
+    the KV page size (equal, multiple, or divisor — so a block never
+    straddles a page boundary and the per-slot read bound stays
+    page-granular), and fits the VMEM budget. Large blocks amortize the
+    sequential grid iteration; small blocks tighten the length-bounded
+    read (expected overshoot is block/2 rows per slot) — 256 caps the
+    candidates because decode is memory-bound and past that the read
+    overshoot costs more HBM than the grid overhead saves. Falls back
+    to ``page_size`` (capacity is page-aligned by the engine) and
+    finally to a single ``capacity`` block — which, for a capacity no
+    candidate divides at ``page_size=1``, is taken WITHOUT a VMEM check
+    (there is no smaller legal block to demote to): such geometries are
+    unreachable through the engine (page-aligned capacity, nesting
+    page_size), and a direct op caller with a huge indivisible capacity
+    should pass ``block_kv`` explicitly. Explicit ``block_kv`` /
+    ``block_h`` pass through unchecked except for divisibility."""
+    if block_h is None:
+        block_h = num_heads
+        while block_h > 1 and _decode_vmem_estimate(
+            8, block_h, head_dim, itemsize
+        ) > _FLASH_VMEM_BUDGET:
+            block_h = block_h // 2
+    if num_heads % block_h != 0:
+        raise ValueError(
+            f"block_h={block_h} does not divide num_heads={num_heads}."
+        )
+    if block_kv is None:
+        block_kv = capacity
+        for cand in (256, 128, 64, 32, 16, 8):
+            if capacity % cand:
+                continue
+            if cand % page_size and page_size % cand:
+                continue  # block/page must nest (page-granular reads)
+            if _decode_vmem_estimate(
+                cand, block_h, head_dim, itemsize
+            ) > _FLASH_VMEM_BUDGET:
+                continue
+            block_kv = cand
+            break
+        if block_kv == capacity and page_size > 1 and capacity % page_size == 0:
+            if capacity > page_size and _decode_vmem_estimate(
+                capacity, block_h, head_dim, itemsize
+            ) > _FLASH_VMEM_BUDGET:
+                block_kv = page_size
+    if capacity % block_kv != 0:
+        raise ValueError(
+            f"block_kv={block_kv} does not divide the KV capacity "
+            f"{capacity}."
+        )
+    return int(block_kv), int(block_h)
+
+
+def paged_decode_attention(
+    q: jax.Array,
+    k_cache: jax.Array,
+    v_cache: jax.Array,
+    lengths: jax.Array,
+    *,
+    scale: Optional[float] = None,
+    page_size: int = 1,
+    block_kv: Optional[int] = None,
+    block_h: Optional[int] = None,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Pallas TPU single-position decode attention over a paged KV
+    cache — the length-aware replacement for :func:`cached_attention`
+    in the decode hot loop.
+
+    Same contract and shapes as the reference (``q [slots, 1, heads,
+    head_dim]``, ``k_cache/v_cache [slots, capacity, heads,
+    head_dim]``, ``lengths [slots] int32 >= 0``; rows ``0..lengths``
+    inclusive attended, everything past them masked), different cost
+    model: the reference einsum streams the ENTIRE ``capacity`` axis
+    from HBM every step, while this kernel grids over (slot,
+    head-block, kv-block) with ``lengths`` as a scalar-prefetch operand
+    so the kv-block index map CLAMPS dead blocks to the slot's last
+    live block — Pallas issues no DMA when the block index repeats, so
+    rows past ``ceil((lengths[slot]+1) / block_kv) * block_kv`` are
+    never fetched. Decode is memory-bound; bytes actually read is the
+    tokens/s lever (docs/DESIGN.md §17).
+
+    Numerics: fp32 accumulation with the same finite ``_MASK_VALUE``
+    masking as the reference; scores and the p@V product are computed
+    as broadcast-multiply-reduce on the VPU (a one-row matmul per head
+    would waste 127/128 of the MXU anyway), so bf16 operands promote
+    exactly like the reference's fp32-HIGHEST einsums and the only
+    divergence is online-softmax reassociation across kv blocks —
+    ULP-level, pinned by the kernel-vs-reference property sweep
+    (token-exact argmax; see tests/ops/test_paged_decode_attention.py
+    for the stated tolerance).
+
+    Composes with the sharded decode path via
+    :func:`sharded_paged_decode_attention` (slots over the data axes,
+    heads over the model axis). ``interpret=None`` auto-selects
+    interpret mode off-TPU (the repo's Pallas convention — tier-1 runs
+    the kernel on CPU this way).
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    if q.ndim != 4 or q.shape[1] != 1:
+        raise ValueError(
+            f"paged_decode_attention expects q [slots, 1, heads, "
+            f"head_dim], got {q.shape}."
+        )
+    if k_cache.shape != v_cache.shape or k_cache.ndim != 4:
+        raise ValueError(
+            f"k_cache/v_cache must be identical [slots, capacity, "
+            f"heads, head_dim], got {k_cache.shape} / {v_cache.shape}."
+        )
+    b, _, h, d = q.shape
+    cap = k_cache.shape[1]
+    if k_cache.shape[0] != b or k_cache.shape[2] != h or k_cache.shape[3] != d:
+        raise ValueError(
+            f"cache {k_cache.shape} does not match q {q.shape}."
+        )
+    if scale is None:
+        scale = d ** -0.5
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    block_kv, block_h = _default_decode_blocks(
+        cap, h, d, page_size=page_size, itemsize=q.dtype.itemsize,
+        block_kv=block_kv, block_h=block_h,
+    )
+    nk = cap // block_kv
+    nh = h // block_h
+    scale = float(scale)  # kernel closure constant, not a traced array
+    qs = q.reshape(b, h, d)
+    # Clamp to the last row: identical semantics to the reference mask
+    # (lengths >= capacity attends every row), and the clamped value is
+    # what the index map divides by.
+    lens = jnp.clip(lengths.astype(jnp.int32), 0, cap - 1)
+
+    def q_index_map(s, hb, kb, lens_ref):
+        return (s, hb, 0)
+
+    def kv_index_map(s, hb, kb, lens_ref):
+        # Dead kv blocks re-select the slot's LAST LIVE block: Pallas
+        # issues no DMA for a repeated block index, so their rows never
+        # leave HBM — the length-aware read.
+        return (s, jnp.minimum(kb, lens_ref[s] // block_kv), hb, 0)
+
+    def kernel(lens_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref):
+        s = pl.program_id(0)
+        kb = pl.program_id(2)
+        length = lens_ref[s]
+
+        @pl.when(kb == 0)
+        def _init():
+            m_ref[...] = jnp.full_like(m_ref, _MASK_VALUE)
+            l_ref[...] = jnp.zeros_like(l_ref)
+            acc_ref[...] = jnp.zeros_like(acc_ref)
+
+        # Block 0 is always live (lengths >= 0 attends row 0), so the
+        # accumulators never finalize empty.
+        @pl.when(kb * block_kv <= length)
+        def _block():
+            qv = q_ref[0].astype(jnp.float32)  # [block_h, d]
+            kv = k_ref[0].astype(jnp.float32)  # [block_kv, block_h, d]
+            # Per-head q.k as broadcast-multiply + lane reduce (VPU):
+            # exact fp32 products, same promotion as the reference's
+            # HIGHEST-precision einsum.
+            sc = jnp.sum(qv[None] * kv, axis=-1) * scale  # [block_kv, block_h]
+            ki = kb * block_kv + lax.broadcasted_iota(
+                jnp.int32, (block_kv, block_h), 0
+            )
+            sc = jnp.where(ki <= length, sc, _MASK_VALUE)
+            m = m_ref[...]  # [1, block_h]
+            m_new = jnp.maximum(m, sc.max(axis=0, keepdims=True))
+            p = jnp.exp(sc - m_new)
+            corr = jnp.exp(m - m_new)
+            m_ref[...] = m_new
+            l_ref[...] = l_ref[...] * corr + p.sum(axis=0, keepdims=True)
+            pv = jnp.sum(
+                p[:, :, None] * v_ref[0].astype(jnp.float32), axis=0
+            )  # [block_h, d]
+            acc_ref[...] = acc_ref[...] * corr[0][:, None] + pv
+
+        @pl.when(kb == nk - 1)
+        def _finalize():
+            o_ref[0] = (
+                acc_ref[...] / l_ref[...][0][:, None]
+            ).astype(o_ref.dtype)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(b, nh, nk),
+        in_specs=[
+            pl.BlockSpec((1, block_h, d), q_index_map),
+            pl.BlockSpec((1, block_kv, block_h, d), kv_index_map),
+            pl.BlockSpec((1, block_kv, block_h, d), kv_index_map),
+        ],
+        out_specs=pl.BlockSpec((1, block_h, d), q_index_map),
+        scratch_shapes=[
+            pltpu.VMEM((1, block_h), jnp.float32),
+            pltpu.VMEM((1, block_h), jnp.float32),
+            pltpu.VMEM((block_h, d), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, h, d), q.dtype),
+        interpret=interpret,
+    )(lens, qs, k_cache, v_cache)
+    return out.reshape(b, 1, h, d)
+
+
+def sharded_paged_decode_attention(
+    q: jax.Array,
+    k_cache: jax.Array,
+    v_cache: jax.Array,
+    lengths: jax.Array,
+    *,
+    mesh,
+    data_axes=("data",),
+    model_axis: Optional[str] = None,
+    replicated: bool = False,
+    **kernel_kwargs,
+) -> jax.Array:
+    """:func:`paged_decode_attention` wrapped for the sharded decode
+    path: slots shard over ``data_axes`` and heads over ``model_axis``
+    (exactly ``parallel.rules.decode_cache_rules`` — the cache layout
+    the decode engine already serves under), so each device runs the
+    kernel on its local (slots, heads) shard with ZERO collectives —
+    decode attention is elementwise over both sharded dimensions.
+    ``replicated=True`` is the engine's indivisible-geometry posture
+    (the cache fell back to a replicated placement): every device runs
+    the whole kernel on replicated operands, correct and
+    collective-free, redundant by construction. GSPMD cannot partition
+    an opaque pallas custom call (it would gather the full cache —
+    precisely the bytes this kernel exists not to read), which is why
+    the mesh path is an explicit shard_map rather than trust in
+    sharding propagation."""
+    from jax.sharding import PartitionSpec as P
+
+    if replicated:
+        spec = l_spec = P()
+    else:
+        spec = P(tuple(data_axes), None, model_axis, None)
+        l_spec = P(tuple(data_axes))
+    local = partial(paged_decode_attention, **kernel_kwargs)
+    # check_vma off: Pallas' interpret-mode lowering is not
+    # vma-annotated (the ring_flash workaround); correctness is pinned
+    # by the kernel-vs-reference parity sweep instead.
+    fn = _shard_map_no_vma_check(
+        local, mesh=mesh, in_specs=(spec, spec, spec, l_spec),
+        out_specs=spec,
+    )
+    return fn(q, k_cache, v_cache, lengths)
+
+
+def _shard_map_no_vma_check(local, *, mesh, in_specs, out_specs):
+    """shard_map with the varying-manual-axes checker disabled, across
+    the kwarg rename history (check_vma >= 0.4.35 > check_rep > none)."""
+    try:  # jax >= 0.4.35 moved shard_map out of experimental.
+        from jax import shard_map
+    except ImportError:  # pragma: no cover - version shim
+        from jax.experimental.shard_map import shard_map
+
+    sm_kwargs = dict(mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+    try:
+        return shard_map(local, **sm_kwargs, check_vma=False)
+    except TypeError:  # pragma: no cover - older jax
+        try:
+            return shard_map(local, **sm_kwargs, check_rep=False)
+        except TypeError:
+            return shard_map(local, **sm_kwargs)
+
+
 def _check_self_attention_shapes(q, k, v):
     """Identical q/k/v shapes are the supported contract for the SP
     kernels. Checked INSIDE the local programs (not just the shard_map
@@ -407,22 +710,14 @@ def _sharded_attention_call(
         causal=causal,
         scale=scale,
     )
-    sm_kwargs = dict(
-        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
-    )
     if check_vma:
-        fn = shard_map(local, **sm_kwargs)
+        fn = shard_map(
+            local, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
+        )
     else:
-        # The checker kwarg was renamed check_rep -> check_vma across
-        # jax versions; try newest-first, degrade to no kwarg (ancient
-        # versions have no checker to disable).
-        try:
-            fn = shard_map(local, **sm_kwargs, check_vma=False)
-        except TypeError:  # pragma: no cover - older jax
-            try:
-                fn = shard_map(local, **sm_kwargs, check_rep=False)
-            except TypeError:
-                fn = shard_map(local, **sm_kwargs)
+        fn = _shard_map_no_vma_check(
+            local, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
+        )
     return fn(q, k, v)
 
 

@@ -16,7 +16,7 @@ derived by XLA from the shardings.
   sharded over all data-like axes.
 """
 
-from typing import Any, Callable, List, Optional, Sequence
+from typing import Any, Callable, List, Optional, Sequence, Tuple
 
 import jax
 import numpy as np
@@ -141,6 +141,16 @@ class Partitioner:
         buckets; size the bucket ladder in multiples of the data-axis
         product to serve fully sharded."""
         raise NotImplementedError
+
+    def decode_cache_axes(self) -> Tuple[Tuple[str, ...], Optional[str]]:
+        """``(data_axes, model_axis)`` the decode KV cache shards over
+        — the ONE derivation both :meth:`decode_cache_sharding` and the
+        decode engine's sharded attention wrapper
+        (``ops.sharded_paged_decode_attention``) consume: if the two
+        disagreed, GSPMD would reshard/gather the cache around the
+        kernel on every decode step — token-correct output, silently
+        wrong bytes. Default (no mesh): nothing to shard over."""
+        return (), None
 
     def decode_cache_sharding(self, cache: Any) -> Any:
         """Sharding pytree for a decode engine's KV-cache state
@@ -414,16 +424,18 @@ class MeshPartitioner(Partitioner):
         # ``batch_stats/...`` paths are exactly the training prefixes.
         return self._sharding_from_rules(variables, self.rules)
 
+    def decode_cache_axes(self):
+        data_axes = tuple(self.data_axes)
+        model_axes = tuple(
+            a for a in self.mesh_axes if a not in set(data_axes)
+        )
+        return data_axes, (model_axes[0] if model_axes else None)
+
     def decode_cache_sharding(self, cache: Any) -> Any:
         from zookeeper_tpu.parallel.rules import decode_cache_rules
 
-        model_axes = tuple(
-            a for a in self.mesh_axes if a not in set(self.data_axes)
-        )
-        rules = decode_cache_rules(
-            tuple(self.data_axes),
-            model_axes[0] if model_axes else None,
-        )
+        data_axes, model_axis = self.decode_cache_axes()
+        rules = decode_cache_rules(data_axes, model_axis)
         return self._sharding_from_rules(cache, rules)
 
     def compile_forward(self, forward_fn, variables, *, batch_rows=None):

@@ -5,14 +5,20 @@ Layout (docs/DESIGN.md §15): per transformer layer, one ``k`` and one
 model's compute dtype, carried as DEVICE-RESIDENT engine state and
 donated through every prefill/decode dispatch (the update is in-place;
 the cache never round-trips the host). ``capacity`` is page-aligned
-(rounded up to a multiple of ``page_size``) so the layout is directly
-adoptable by a future paged-gather Pallas kernel; today the pages of
-one slot are contiguous — a ring of SLOTS rather than an indirection
-table of pages, because without a gather kernel page indirection buys
-no memory (every slot's worst case must be provisioned anyway) while
-costing a scatter/gather on the hot path. Page granularity still does
-real work host-side: ``pages_in_use`` is the occupancy number the
-``zk_decode_kv_pages_in_use`` gauge and ``/statusz`` report.
+(rounded up to a multiple of ``page_size``); the pages of one slot are
+contiguous — a ring of SLOTS rather than an indirection table of
+pages, because page indirection buys no memory here (every slot's
+worst case must be provisioned anyway) while costing a scatter/gather
+on the hot path. The paged decode-attention kernel (§17,
+``ops.paged_decode_attention``) consumes this layout AS IS: it walks a
+slot's contiguous pages in page-nested blocks and stops at the slot's
+length, so the length-bounded HBM read needed no layout change — and
+per-slot worst-case provisioning is what it deliberately does NOT
+change (an indirection table remains the future overcommit step). Page
+granularity also does real work host-side: ``pages_in_use`` is the
+occupancy number the ``zk_decode_kv_pages_in_use`` gauge and
+``/statusz`` report, and ``kv_cache_bytes`` feeds the
+``zk_decode_kv_bytes`` gauge + the per-slot ``/statusz`` numbers.
 
 Validity invariant (the slot-refill masking contract): a slot's cache
 row ``j`` is meaningful iff ``j < length`` for that slot's CURRENT

@@ -89,8 +89,21 @@ DecodeScheduler`.
     #: generation at capacity. Rounded up to a ``page_size`` multiple.
     kv_capacity: int = Field(-1)
     #: KV page granularity (tokens): the accounting/alignment unit for
-    #: capacity and the ``kv_pages_in_use`` occupancy numbers.
+    #: capacity and the ``kv_pages_in_use`` occupancy numbers, and the
+    #: nesting unit for the paged decode kernel's KV read blocks.
     page_size: int = Field(16)
+    #: Cache-attention flavor for the decode_step program
+    #: (docs/DESIGN.md §17): "auto" runs the length-aware Pallas paged
+    #: decode kernel on TPU and the reference einsum elsewhere
+    #: (interpret-mode Pallas on CPU is a numerics vehicle, not a
+    #: serving path — the same posture the bench takes for flash);
+    #: "pallas" forces the kernel (interpret off-TPU), "reference"
+    #: forces the oracle einsum, "module" defers to the module's own
+    #: ``decode_attention`` setting/injected callable. Unsupported
+    #: geometry (see ``ops.decode_attention_supported``) degrades
+    #: "auto"/"pallas" to the reference with a warning — the
+    #: compile_forward small-bucket posture.
+    decode_attention: str = Field("auto")
 
     # -- binding ---------------------------------------------------------
 
@@ -174,6 +187,13 @@ DecodeScheduler`.
                 "can never be that long."
             )
 
+        if str(self.decode_attention) not in (
+            "auto", "pallas", "reference", "module"
+        ):
+            raise ValueError(
+                f"decode_attention={self.decode_attention!r}: expected "
+                "'auto', 'pallas', 'reference', or 'module'."
+            )
         if partitioner is None:
             from zookeeper_tpu.parallel.partitioner import (
                 SingleDevicePartitioner,
@@ -197,6 +217,7 @@ DecodeScheduler`.
         cache = self._allocate_cache()
         mesh = partitioner.mesh
         cache_sharding = None
+        cache_replicated = mesh is not None
         if mesh is not None:
             cache_sharding = partitioner.decode_cache_sharding(cache)
             if cache_sharding is not None:
@@ -211,6 +232,7 @@ DecodeScheduler`.
                         cache,
                         cache_sharding,
                     )
+                    cache_replicated = False
                 except (ValueError, ZeroDivisionError) as e:
                     logger.warning(
                         "KV cache [slots=%d, heads=%d] does not divide "
@@ -227,6 +249,7 @@ DecodeScheduler`.
                         cache,
                     )
         object.__setattr__(self, "_cache_sharding", cache_sharding)
+        object.__setattr__(self, "_cache_replicated", cache_replicated)
         object.__setattr__(self, "_cache", self._place_cache(cache))
         object.__setattr__(self, "_cache_nbytes", kv_cache_bytes(
             int(module.num_layers),
@@ -240,7 +263,156 @@ DecodeScheduler`.
         object.__setattr__(self, "_compile_count", 0)
         object.__setattr__(self, "_warmed", False)
         object.__setattr__(self, "_recompiles_detected", 0)
+        object.__setattr__(self, "_ledger_records", {})
+        flavor, attn_fn = self._resolve_decode_attention()
+        object.__setattr__(self, "_decode_attention_flavor", flavor)
+        object.__setattr__(self, "_decode_attention_fn", attn_fn)
+        self._publish_bind_gauges()
         return self
+
+    def _resolve_decode_attention(self):
+        """Resolve the ``decode_attention`` Field into ``(flavor_tag,
+        override_fn)`` — the callable threaded into the decode_step
+        trace (None = defer to the module's own setting).
+
+        "auto" selects the paged kernel only on a real TPU backend:
+        interpret-mode Pallas on CPU is a grid-loop INTERPRETER, orders
+        of magnitude slower than the fused einsum — the same reason the
+        bench runs dense prefill off-TPU. On a mesh the kernel is
+        wrapped in ``sharded_paged_decode_attention`` (slots over the
+        data axes, heads over the model axis — or fully replicated
+        specs when the cache took the replicated fallback), because
+        GSPMD would otherwise gather the whole cache around the opaque
+        pallas call."""
+        import jax
+
+        from zookeeper_tpu import ops
+
+        module = self._module
+        choice = str(self.decode_attention)
+        if choice == "module":
+            return "module", None
+        if choice == "auto":
+            choice = (
+                "pallas" if jax.default_backend() == "tpu" else "reference"
+            )
+        if choice == "reference":
+            return "reference", ops.cached_attention
+        heads = int(module.num_heads)
+        head_dim = int(module.d_model) // heads
+        if not ops.decode_attention_supported(heads, head_dim):
+            logger.warning(
+                "decode_attention='pallas' requested but head_dim=%d is "
+                "off the kernel's supported geometry (see "
+                "ops.decode_attention_supported); decoding with the "
+                "REFERENCE einsum instead",
+                head_dim,
+            )
+            return "reference", ops.cached_attention
+        from functools import partial
+
+        kernel_kwargs = {"page_size": int(self.page_size)}
+        mesh = self._partitioner.mesh
+        if mesh is None:
+            return "pallas", partial(
+                ops.paged_decode_attention, **kernel_kwargs
+            )
+        # The SAME axis derivation decode_cache_sharding used for the
+        # cache placement: a disagreement here would make GSPMD reshard
+        # the cache around the kernel every dispatch.
+        data_axes, model_axis = self._partitioner.decode_cache_axes()
+        return "pallas", partial(
+            ops.sharded_paged_decode_attention,
+            mesh=mesh,
+            data_axes=data_axes,
+            model_axis=model_axis,
+            replicated=bool(self._cache_replicated),
+            **kernel_kwargs,
+        )
+
+    def _publish_bind_gauges(self) -> None:
+        """Bind-time decode gauges: the provisioned KV HBM
+        (``zk_decode_kv_bytes`` — computed since PR 9 but never
+        exported) and the MBU gauge registered at its -1 unknown
+        sentinel so a pre-traffic scrape renders the series."""
+        from zookeeper_tpu.observability.registry import default_registry
+
+        reg = default_registry()
+        reg.gauge(
+            "zk_decode_kv_bytes",
+            help="HBM provisioned for the decode KV cache (k+v, all "
+            "layers, full slot capacity)",
+        ).set(float(self._cache_nbytes))
+        # Handle kept on the engine: _observe_decode runs once per
+        # decode dispatch and must not pay the registry lock + lookup
+        # per token.
+        object.__setattr__(self, "_mbu_gauge", reg.gauge(
+            "zk_decode_mbu",
+            help="last decode dispatch: ledger cost-analysis bytes / "
+            "wall time / reference HBM bandwidth (-1 = bytes or "
+            "bandwidth unknown); an UPPER bound with the paged kernel "
+            "(static analysis counts full buffers, the kernel reads "
+            "length-bounded blocks)",
+            initial=-1,
+        ))
+
+    def decode_mbu_for(self, seconds: float) -> float:
+        """MBU of the decode_step program at a given dispatch wall
+        time: ledger cost-analysis bytes / ``seconds`` / reference HBM
+        bandwidth, -1 when any input is unknown (the ``ledger.mbu``
+        totality contract — never raises). The live gauge evaluates
+        this at each dispatch's own time; the bench evaluates it at
+        the run's MEDIAN dispatch time so the gated ``decode_mbu`` key
+        is not a single-sample ratio of the least-representative
+        (drain-tail) dispatch."""
+        from zookeeper_tpu.observability import ledger as _ledger
+
+        bw = getattr(self, "_hbm_bandwidth", None)
+        if bw is None:
+            from zookeeper_tpu.observability.peaks import (
+                reference_hbm_bandwidth,
+            )
+
+            bw = reference_hbm_bandwidth()[0]
+            object.__setattr__(self, "_hbm_bandwidth", bw)
+        record = self._ledger_records.get("decode_step")
+        value = _ledger.mbu(
+            getattr(record, "bytes_accessed", None), seconds, bw
+        )
+        return float(value) if value is not None else -1.0
+
+    def _observe_decode(self, seconds: float) -> None:
+        """Publish ``zk_decode_mbu`` for one completed (readback-
+        bounded) decode dispatch — the memory-bound counterpart of the
+        forward engine's ``zk_serve_mfu`` (decode_step is HBM-bound, so
+        FLOPs-based MFU is the wrong lens; docs/DESIGN.md §17). Total:
+        a gauge update never raises."""
+        if seconds <= 0:
+            return
+        value = self.decode_mbu_for(seconds)
+        # Per-engine copy FIRST: the gauge is process-global (the
+        # export path), so with two engines live the gauge holds
+        # whichever dispatched last — decode_mbu/statusz must report
+        # THIS engine's number.
+        object.__setattr__(self, "_last_decode_mbu", value)
+        self._mbu_gauge.set(value)
+
+    @property
+    def decode_attention_flavor(self) -> str:
+        """The RESOLVED decode-attention flavor this engine serves with
+        ("pallas" / "reference" / "module") — after auto-selection and
+        any unsupported-geometry degrade."""
+        self._require_bound()
+        return self._decode_attention_flavor
+
+    @property
+    def decode_mbu(self) -> float:
+        """THIS engine's last decode dispatch's memory-bandwidth
+        utilization (-1 = unknown / no dispatch yet). Deliberately the
+        per-engine copy, not the process-global ``zk_decode_mbu``
+        gauge: with two engines in one process (the bench A/B, flavor
+        tests) the gauge holds whichever engine dispatched last."""
+        return float(getattr(self, "_last_decode_mbu", -1.0))
 
     def _place_variables(self, variables: Any) -> Any:
         """One placement path shared by ``bind`` and ``swap_weights`` —
@@ -445,7 +617,7 @@ DecodeScheduler`.
             if mesh is not None
             else "1"
         )
-        default_ledger().record(
+        record = default_ledger().record(
             key.split("/")[0],
             f"{type(self._partitioner).__name__}/mesh={mesh_desc}/{key}",
             lowered=lowered,
@@ -454,6 +626,8 @@ DecodeScheduler`.
             compile_ms=(t2 - t1) * 1e3,
             attrs={"slots": int(self.slots)},
         )
+        # Keep the row (cost-analysis bytes feed the decode MBU gauge).
+        self._ledger_records[key] = record
         object.__setattr__(self, "_compile_count", self._compile_count + 1)
         return compiled
 
@@ -469,10 +643,15 @@ DecodeScheduler`.
         if during_dispatch and self._warmed:
             self._note_dispatch_compile("decode_step")
         module = self._module
+        # Static by closure: the resolved decode-attention flavor (the
+        # paged kernel, its sharded wrapper, or the reference einsum)
+        # is part of THIS compiled program's identity.
+        attn_override = getattr(self, "_decode_attention_fn", None)
 
         def decode_fn(variables, cache, tokens, lengths):
             logits, new_cache = module.apply(
-                variables, tokens, lengths, cache, method="decode_step"
+                variables, tokens, lengths, cache, method="decode_step",
+                attention_override=attn_override,
             )
             nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
             return new_cache, nxt
@@ -629,6 +808,7 @@ DecodeScheduler`.
                 {"slots": int(self.slots)} if _trace.enabled() else None
             ),
         ):
+            t0 = time.perf_counter()
             try:
                 new_cache, nxt = compiled(
                     self._variables, self._cache, tokens, lengths
@@ -638,6 +818,9 @@ DecodeScheduler`.
                 raise
             object.__setattr__(self, "_cache", new_cache)
             nxt = np.asarray(jax.device_get(nxt))
+            # Readback-bounded wall time — the only honest dispatch
+            # clock (the compiled call returns un-synced arrays).
+            self._observe_decode(time.perf_counter() - t0)
         return nxt.astype(np.int32)
 
     # -- hot swap --------------------------------------------------------

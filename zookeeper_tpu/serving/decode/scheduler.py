@@ -950,6 +950,18 @@ class DecodeScheduler:
                 "kv_pages_in_use": engine.kv_pages_in_use(active_lengths),
                 "kv_capacity_tokens": engine.capacity,
                 "kv_cache_mb": round(engine.kv_cache_nbytes / 2**20, 2),
+                # HBM accounting (docs/DESIGN.md §17): the provisioned
+                # bytes (also the zk_decode_kv_bytes gauge) and the
+                # per-slot share an operator sizes capacity with.
+                "kv_cache_bytes": int(engine.kv_cache_nbytes),
+                "kv_bytes_per_slot": int(
+                    engine.kv_cache_nbytes // max(1, int(engine.slots))
+                ),
+                "decode_attention": engine.decode_attention_flavor,
+                # Last dispatch's memory-bandwidth utilization (-1 =
+                # unknown) — the roofline lens for the memory-bound
+                # decode step.
+                "decode_mbu": round(engine.decode_mbu, 4),
                 "compiles": engine.compile_count,
                 "recompiles_detected": engine.recompiles_detected,
                 "swap_pending": self.swap_pending,

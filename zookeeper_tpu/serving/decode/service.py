@@ -10,6 +10,13 @@ loop, one JSON result line (tokens/s, TTFT percentiles, refill count,
 compile counts) through the same MetricsWriter sinks — so
 ``python examples/serve_lm.py ServeLM checkpoint=...`` is an
 end-to-end smoke of the whole decode subsystem.
+
+The decode-attention flavor threads through the engine component
+(``engine.decode_attention=auto|pallas|reference|module`` on the CLI —
+docs/DESIGN.md §17): "auto" serves with the length-aware Pallas paged
+decode kernel on TPU and the reference einsum elsewhere; the result
+line and ``/statusz`` report the RESOLVED flavor plus the
+``decode_mbu`` memory-bandwidth roofline.
 """
 
 import json
@@ -245,6 +252,12 @@ class LMServingConfig(Experiment):
             "slots": int(self.engine.slots),
             "seq_buckets": [int(s) for s in self.engine.seq_buckets],
             "kv_capacity": self.engine.capacity,
+            # The RESOLVED cache-attention flavor (docs/DESIGN.md §17):
+            # "pallas" = the length-aware paged decode kernel,
+            # "reference" = the oracle einsum (auto-selected off-TPU or
+            # degraded on unsupported geometry).
+            "decode_attention": self.engine.decode_attention_flavor,
+            "decode_mbu": round(self.engine.decode_mbu, 4),
             "compiles": self.engine.compile_count,
             "recompiles_after_warmup": (
                 self.engine.compile_count - warm_compiles
