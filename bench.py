@@ -991,6 +991,186 @@ def measure_decode_throughput(env=None):
     return out
 
 
+def measure_speculative_throughput(env=None):
+    """``ZK_BENCH_SPEC=1`` leg: spec-vs-plain A/B on the SAME teacher
+    engine (docs/DESIGN.md §18) at a pinned high-acceptance workload.
+
+    The workload is the zero-tail construction the certification tests
+    pin: the teacher's blocks past ``ZK_BENCH_SPEC_DRAFT_LAYERS`` have
+    their ``proj``/``down`` kernels zeroed (each contributes exactly
+    0.0 to the residual stream — the teacher still pays full per-layer
+    compute, XLA cannot know a kernel is zero), and the draft IS the
+    teacher's first layers. Draft and teacher therefore agree on
+    (nearly) every argmax, pinning acceptance ~1.0 — the schedule's
+    throughput ceiling, measured honestly: the reported
+    ``spec_acceptance_rate`` labels the number, and production
+    acceptance depends on how well the distilled student tracks its
+    teacher. The speedup mechanism the leg isolates is REAL on any
+    backend: one teacher verify dispatch replaces k+1 teacher decode
+    dispatches, with only k cheap draft dispatches added — it cuts
+    teacher dispatch count, which is why the win shows on the CPU
+    reference box, not just on TPU HBM bandwidth.
+
+    Both modes serve the identical prompt set through fresh scheduler
+    bindings over ONE engine (plain first, then speculative); streams
+    are asserted TOKEN-IDENTICAL between modes (greedy speculation is
+    lossless — the bench re-pins the §18 contract every run) and each
+    mode is asserted compile-free after its warmup. Emits
+    ``spec_tokens_per_sec_per_chip``,
+    ``spec_plain_tokens_per_sec_per_chip``, ``spec_speedup``,
+    ``spec_acceptance_rate`` (gated, higher-better) and ``spec_k`` /
+    workload-shape keys (informational).
+
+    Knobs: ``ZK_BENCH_SPEC_K`` (default 10 — on the CPU reference box
+    the win is dispatch-count amortization, so the default leans on a
+    wide window; the §18 cost model picks smaller k at lower
+    acceptance), ``ZK_BENCH_SPEC_LAYERS`` (teacher depth, default 6),
+    ``ZK_BENCH_SPEC_DRAFT_LAYERS`` (default 1),
+    ``ZK_BENCH_SPEC_REQUESTS``/``_SLOTS``/``_NEW_TOKENS``/``_PROMPT``
+    (default 16/4/55/16 — the budget is window-aligned, 55 = 5 full
+    k+1 windows, and generations are long relative to prefill so the
+    gated ratio measures the DECODE loop rather than the prefill cost
+    both modes share), ``_DMODEL``/``_HEADS`` (default 256/4)."""
+    import numpy as np
+
+    from zookeeper_tpu.core import configure
+    from zookeeper_tpu.models import TransformerLM
+    from zookeeper_tpu.serving.decode import (
+        DecodeEngine,
+        DecodeScheduler,
+        SpeculativeDecoding,
+    )
+
+    env = os.environ if env is None else env
+    k = int(env.get("ZK_BENCH_SPEC_K", "10"))
+    layers = int(env.get("ZK_BENCH_SPEC_LAYERS", "6"))
+    draft_layers = int(env.get("ZK_BENCH_SPEC_DRAFT_LAYERS", "1"))
+    n_requests = int(env.get("ZK_BENCH_SPEC_REQUESTS", "16"))
+    slots = int(env.get("ZK_BENCH_SPEC_SLOTS", "4"))
+    new_tokens = int(env.get("ZK_BENCH_SPEC_NEW_TOKENS", "55"))
+    max_prompt = int(env.get("ZK_BENCH_SPEC_PROMPT", "16"))
+    d_model = int(env.get("ZK_BENCH_SPEC_DMODEL", "256"))
+    num_heads = int(env.get("ZK_BENCH_SPEC_HEADS", "4"))
+    vocab = 512
+    seq_len = max(128, 2 * (max_prompt + new_tokens))
+    if not 0 < draft_layers < layers:
+        raise ValueError(
+            f"need 0 < draft_layers ({draft_layers}) < layers ({layers})."
+        )
+
+    def build(n_layers, name):
+        model = TransformerLM()
+        configure(
+            model,
+            {
+                "num_layers": n_layers,
+                "d_model": d_model,
+                "num_heads": num_heads,
+                "max_seq_len": seq_len,
+                "attention": "dense",  # short prefills, off-TPU safe
+            },
+            name=name,
+        )
+        module = model.build((seq_len,), vocab)
+        params, state = model.initialize(module, (seq_len,), seed=0)
+        return module, params, state
+
+    import jax.numpy as jnp
+
+    t_module, t_params, t_state = build(layers, "spec_bench_teacher")
+    t_params = dict(t_params)
+    for i in range(draft_layers, layers):
+        block = {**t_params[f"block{i}"]}
+        block["proj"] = {"kernel": jnp.zeros_like(block["proj"]["kernel"])}
+        block["down"] = {"kernel": jnp.zeros_like(block["down"]["kernel"])}
+        t_params[f"block{i}"] = block
+    d_module, d_params, d_state = build(draft_layers, "spec_bench_draft")
+    d_params = {key: t_params[key] for key in d_params}
+
+    engine = DecodeEngine()
+    configure(
+        engine,
+        {
+            "slots": slots,
+            "seq_buckets": (max_prompt,),
+            "kv_capacity": seq_len,
+        },
+        name="spec_bench_engine",
+    )
+    engine.bind(t_module, t_params, t_state)
+    engine.warmup()
+
+    rng = np.random.default_rng(0)
+    prompts = [
+        rng.integers(1, vocab, size=int(rng.integers(1, max_prompt + 1)))
+        .astype(np.int32)
+        for _ in range(n_requests)
+    ]
+
+    def serve(spec):
+        sched = DecodeScheduler()
+        configure(
+            sched,
+            {"max_new_tokens": new_tokens},
+            name="spec_bench_sched_"
+            + ("spec" if spec is not None else "plain"),
+        )
+        sched.bind(engine, speculative=spec)
+        warm = engine.compile_count
+        dwarm = spec.draft_engine.compile_count if spec else 0
+        t0 = time.perf_counter()
+        streams = [sched.submit(p) for p in prompts]
+        sched.drain()
+        dt = time.perf_counter() - t0
+        outputs = [s.result() for s in streams]
+        if engine.compile_count != warm or (
+            spec and spec.draft_engine.compile_count != dwarm
+        ):
+            raise RuntimeError(
+                "speculative bench leg recompiled mid-traffic; the "
+                "throughput numbers are invalid."
+            )
+        return sum(int(o.shape[0]) for o in outputs) / dt, outputs
+
+    # Plain first (its scheduler never sees the draft), then the
+    # speculative binding warms the verify widths + draft grid before
+    # ITS traffic — one engine, two modes, identical prompts.
+    plain_tps, plain_out = serve(None)
+    spec_cfg = SpeculativeDecoding()
+    configure(spec_cfg, {"enabled": True, "k": k}, name="spec_bench_spec")
+    spec_cfg.bind(engine, d_module, d_params, d_state)
+    spec_tps, spec_out = serve(spec_cfg)
+    mismatch = sum(
+        1 for a, b in zip(plain_out, spec_out) if not np.array_equal(a, b)
+    )
+    if mismatch:
+        raise RuntimeError(
+            f"speculative A/B: {mismatch}/{len(plain_out)} streams "
+            "differ between plain and speculative greedy — the "
+            "losslessness contract is broken; the speedup is "
+            "meaningless."
+        )
+    mesh = engine._partitioner.mesh
+    n_chips = int(mesh.size) if mesh is not None else 1
+    return {
+        "spec_tokens_per_sec_per_chip": round(spec_tps / n_chips, 1),
+        "spec_plain_tokens_per_sec_per_chip": round(
+            plain_tps / n_chips, 1
+        ),
+        "spec_speedup": round(spec_tps / plain_tps, 3)
+        if plain_tps > 0
+        else -1.0,
+        "spec_acceptance_rate": round(spec_cfg.acceptance_rate, 4),
+        # Workload shape (informational — config, not perf).
+        "spec_k": k,
+        "spec_teacher_layers": layers,
+        "spec_draft_layers": draft_layers,
+        "spec_requests": n_requests,
+        "spec_slots": slots,
+        "spec_new_tokens": new_tokens,
+    }
+
+
 def measure_trace_overhead(env=None):
     """``ZK_BENCH_OBS=1`` leg: the host-tracing cost on the step-time
     anchor — the observability layer's acceptance number
@@ -1907,6 +2087,21 @@ def main(argv=None):
             )
             decode_metrics = None
 
+    # Speculative-decode leg (env-gated: spec-vs-plain A/B on one
+    # engine at the pinned zero-tail high-acceptance workload): streams
+    # asserted token-identical, spec_speedup is the headline.
+    spec_metrics = None
+    if _env_flag(os.environ, "ZK_BENCH_SPEC"):
+        try:
+            spec_metrics = measure_speculative_throughput()
+        except Exception as e:  # never lose the primary metric
+            print(
+                f"speculative leg failed ({e}); omitting spec_*",
+                file=sys.stderr,
+                flush=True,
+            )
+            spec_metrics = None
+
     # Observability-overhead leg (env-gated: interleaved traced/untraced
     # step chains): host-span tracing cost on the step-time anchor —
     # the <= 2% budget docs/DESIGN.md §13 commits to.
@@ -1948,6 +2143,8 @@ def main(argv=None):
         extras.update(ckpt_metrics)
     if decode_metrics is not None:
         extras.update(decode_metrics)
+    if spec_metrics is not None:
+        extras.update(spec_metrics)
     if obs_metrics is not None:
         extras.update(obs_metrics)
     if loop_time is not None:

@@ -27,6 +27,15 @@ weights -> paged-KV continuous-batching decode) in two commands::
     # einsum elsewhere; force either for an A/B:
     python examples/serve_lm.py ServeLM engine.decode_attention=pallas
 
+    # Speculative decoding (docs/DESIGN.md §18): a distilled-student
+    # draft proposes k tokens per slot, one teacher verify dispatch
+    # scores the whole window — token-identical to plain greedy, up
+    # to k+1 tokens per teacher dispatch:
+    python examples/serve_lm.py ServeLM checkpoint=/tmp/lm_ckpt \\
+        speculative.enabled=True speculative.k=4 \\
+        speculative.draft_checkpoint=/tmp/lm_student_ckpt \\
+        speculative.draft_model.num_layers=1
+
 Every request rides the REAL serving path — bucketed prefill into a
 KV slot, slot-refill continuous batching, per-token streaming — so the
 reported numbers are the decode subsystem's, not a synthetic loop's

@@ -379,13 +379,12 @@ def test_model_summary_works_for_token_models():
 
 
 def test_model_summary_rank1_float_features_via_input_dtype_hint():
-    """The documented escape from the rank heuristic (ADVICE
-    summary.py:50): a rank-1 FLOAT-feature MLP would get an int32 dummy
-    from the rank-1 default; the ``input_dtype`` hint — sourced from
-    ``Preprocessing.input_dtype`` at the experiment call site — keys
-    the dummy off the pipeline instead. Both dtypes trace for the MLP
-    (it only flattens), so the pin here is that the hint is honored
-    verbatim rather than overridden by rank."""
+    """The ``input_dtype`` hint — sourced from
+    ``Preprocessing.input_dtype`` at the experiment call site — is
+    honored verbatim; and with NO hint the default now keys off the
+    MODEL FAMILY, not the input rank (ADVICE summary.py:50, closed):
+    an Mlp has no ``vocab_size``, so its rank-1 flat-feature input
+    traces with a float32 dummy."""
     from zookeeper_tpu.core import configure as _cfg
     from zookeeper_tpu.models import Mlp, model_summary
 
@@ -394,9 +393,30 @@ def test_model_summary_rank1_float_features_via_input_dtype_hint():
     module = m.build((16,), num_classes=3)
     s = model_summary(module, (16,), input_dtype="float32")
     assert s.total_params > 0
-    # And the token default stays int32 (rank-1 without a hint).
+    # No hint: same summary via the family-keyed float32 default.
     s2 = model_summary(module, (16,))
     assert s2.total_params == s.total_params
+
+
+def test_model_summary_default_dtype_keys_off_model_family():
+    """The family heuristic directly (ADVICE summary.py:50): a module
+    declaring ``vocab_size`` (the token-pipeline marker) gets an int32
+    dummy — ``compute_flops`` traces the forward, so a float dummy
+    would die in the embedding lookup — while a rank-1 float-feature
+    MLP traces float32 and computes FLOPs from the same default."""
+    from zookeeper_tpu.core import configure as _cfg
+    from zookeeper_tpu.models import Mlp, model_summary
+
+    _, lm_module, *_ = make_model()
+    s = model_summary(lm_module, (32,), compute_flops=True)
+    assert s.total_params > 0  # int32 dummy: embedding lookup traced
+
+    m = Mlp()
+    _cfg(m, {"hidden_units": (8,)}, name="m_family")
+    mlp_module = m.build((16,), num_classes=3)
+    s2 = model_summary(mlp_module, (16,), compute_flops=True)
+    assert s2.total_params > 0
+    assert s2.flops is None or s2.flops > 0
 
 
 @pytest.mark.slow

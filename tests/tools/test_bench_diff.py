@@ -288,3 +288,46 @@ def test_decode_kernel_keys_gate_with_registered_tolerances():
         assert ok.ok, key
         bad = compare({"metric": "x", key: 1.0 - tol * 1.5}, prev)
         assert not bad.ok and bad.regressions[0]["name"] == key
+
+
+def test_speculative_era_keys_classify():
+    """The speculative-decode A/B keys (DESIGN.md §18) gate
+    direction-aware: both throughputs and the speedup higher-better,
+    and acceptance_rate is the one ``_rate$`` where UP is good (checked
+    before the lower-better latency family); workload-shape keys are
+    config, not perf."""
+    for key in (
+        "spec_tokens_per_sec_per_chip",
+        "spec_plain_tokens_per_sec_per_chip",
+        "spec_speedup",
+        "spec_acceptance_rate",
+    ):
+        assert bench_diff.classify_metric(key) == "higher", key
+    # The generic rate family stays lower-better.
+    assert bench_diff.classify_metric("shed_rate") == "lower"
+    for key in (
+        "spec_k",
+        "spec_teacher_layers",
+        "spec_draft_layers",
+        "spec_requests",
+        "spec_slots",
+        "spec_new_tokens",
+    ):
+        assert bench_diff.classify_metric(key) is None, key
+
+
+def test_speculative_keys_gate_with_registered_tolerances():
+    from tools.bench_diff import TOLERANCES, compare
+
+    for key in (
+        "spec_tokens_per_sec_per_chip",
+        "spec_plain_tokens_per_sec_per_chip",
+        "spec_speedup",
+        "spec_acceptance_rate",
+    ):
+        tol = TOLERANCES[key]
+        prev = {"metric": "x", key: 1.0}
+        ok = compare({"metric": "x", key: 1.0 - tol * 0.9}, prev)
+        assert ok.ok, key
+        bad = compare({"metric": "x", key: 1.0 - tol * 1.5}, prev)
+        assert not bad.ok and bad.regressions[0]["name"] == key

@@ -77,6 +77,15 @@ TOLERANCES = {
     "decode_reference_tokens_per_sec_per_chip": 0.25,
     "decode_kernel_speedup": 0.35,
     "decode_mbu": 0.35,
+    # Speculative-decode era (docs/DESIGN.md §18): both throughputs are
+    # the decode leg's jitter class; the speedup is a ratio of two
+    # jittery wall-clock numbers; acceptance at the pinned zero-tail
+    # workload is ~1.0 by construction — a real drop there means the
+    # draft/teacher agreement broke, so it gates tightly.
+    "spec_tokens_per_sec_per_chip": 0.25,
+    "spec_plain_tokens_per_sec_per_chip": 0.25,
+    "spec_speedup": 0.35,
+    "spec_acceptance_rate": 0.10,
 }
 
 #: HIGHER-better metric name patterns (throughput family). MBU joins
@@ -84,6 +93,9 @@ TOLERANCES = {
 _HIGHER = re.compile(
     r"(_per_sec|_per_sec_per_chip|_per_sec_per_core|_qps|qps_per_chip"
     r"|^value$|^vs_baseline$|^mfu_|_mfu$|_mbu$|_speedup"
+    # Acceptance is the one _rate$ where UP is good (the generic _rate$
+    # family — shed rate etc. — is lower-better); checked before _LOWER.
+    r"|^spec_acceptance_rate$"
     r"|tokens_per_sec|images_per_sec|steps_overlapped)"
 )
 
@@ -109,6 +121,9 @@ _INFORMATIONAL = re.compile(
     # the refill/token tallies they determine are config, not perf.
     r"|^decode_requests$|^decode_slots$|^decode_new_tokens$"
     r"|^decode_refills$|^decode_generated_tokens$"
+    # Speculative-leg workload shape (k, model depths, traffic counts).
+    r"|^spec_k$|^spec_teacher_layers$|^spec_draft_layers$"
+    r"|^spec_requests$|^spec_slots$|^spec_new_tokens$"
     # Peak ANCHORS and model FLOP counts are measurement context, not
     # code performance: an anchor that moved (re-measured peak, fixed
     # cache pathology — BENCH_r04's 237.9 TF/s) or a FLOPs change (a

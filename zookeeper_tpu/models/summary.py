@@ -138,19 +138,24 @@ def model_summary(
     ``Preprocessing.input_dtype`` hint is the canonical source
     (``TokenPreprocessing`` -> int32, image preprocessing -> float32;
     the experiment's ``print_model_summary`` threads it through).
-    When None, a RANK heuristic fills in: float32 for multi-dim
-    (image-shaped) inputs, int32 for rank-1 shapes — rank-1 is
-    overwhelmingly a token sequence here, and a float dummy is an
-    invalid embedding index for language models. The heuristic is
-    wrong for a rank-1 float-feature model (an MLP over flat
-    features): pass ``input_dtype="float32"`` there.
+    When None, the default keys off the MODEL FAMILY, not the input
+    rank (ADVICE summary.py:50): a module that declares a
+    ``vocab_size`` — the token-pipeline marker every embedding-fronted
+    LM here carries (``TransformerLMModule``) — gets an int32 dummy (a
+    float dummy is an invalid embedding index), everything else gets
+    float32, so a rank-1 FLOAT-feature model (an MLP over flat
+    features) traces with the right dtype without needing the hint.
     """
     import jax
     import jax.numpy as jnp
     from flax import traverse_util
 
     if input_dtype is None:
-        input_dtype = jnp.int32 if len(input_shape) == 1 else jnp.float32
+        input_dtype = (
+            jnp.int32
+            if isinstance(getattr(module, "vocab_size", None), int)
+            else jnp.float32
+        )
     x = jnp.zeros((1, *input_shape), input_dtype)
     variables = jax.eval_shape(
         lambda: module.init(jax.random.key(0), x, training=False)

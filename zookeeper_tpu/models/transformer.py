@@ -208,6 +208,36 @@ class _Block(nn.Module):
         x = x + self.wproj(o.reshape(b, 1, self.d_model))
         return self._mlp(x), k_cache, v_cache
 
+    def decode_verify(self, x, k_cache, v_cache, lengths):
+        """The multi-token (speculative verify) step: ``x [b, w, d]`` is
+        the residual stream of ``w`` draft positions (position ``j`` is
+        the token at sequence index ``lengths + j``), appended to the
+        cache in ONE dispatch — all ``w`` new K/V rows land via a
+        per-slot dynamic-update-slice at ``lengths``
+        (``cache.append_kv_rows``) and every position attends
+        cache+window causally (``ops.verify_cached_attention``: row
+        ``j`` sees cache rows ``0..lengths+j``). Same submodules as
+        ``__call__``/``decode`` — one weight set, three traced programs.
+        Rollback-by-length: the caller commits only the accepted prefix
+        by advancing ``lengths`` that far; rejected rows stay masked
+        garbage (docs/DESIGN.md §18)."""
+        from zookeeper_tpu.ops import verify_cached_attention
+        from zookeeper_tpu.serving.decode.cache import append_kv_rows
+
+        b, w, _ = x.shape
+        head_dim = self.d_model // self.num_heads
+
+        h = self.ln1(x)
+        qkv = self.wqkv(h)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        to_heads = lambda t: t.reshape(b, w, self.num_heads, head_dim)
+        q, k, v = to_heads(q), to_heads(k), to_heads(v)
+        k_cache = append_kv_rows(k_cache, k, lengths)
+        v_cache = append_kv_rows(v_cache, v, lengths)
+        o = verify_cached_attention(q, k_cache, v_cache, lengths)
+        x = x + self.wproj(o.reshape(b, w, self.d_model))
+        return self._mlp(x), k_cache, v_cache
+
 
 def _auto_pin_activations(attention, pin_activations):
     """Whether the residual-stream pins apply. ``None`` (the default)
@@ -383,6 +413,51 @@ class TransformerLMModule(nn.Module):
             )
             new_cache.append({"k": kc, "v": vc})
         return self._logits(x)[:, 0], tuple(new_cache)
+
+    def decode_verify(self, tokens, lengths, cache):
+        """``w`` tokens per sequence through the cached-attention path
+        in ONE dispatch — the speculative-decode verify/append program
+        (docs/DESIGN.md §18). ``tokens [b, w] int`` are the window's
+        input tokens (token ``j`` sits at position ``lengths + j``),
+        ``cache`` the per-layer ``{"k", "v"}`` buffers. Returns
+        ``(logits [b, w, vocab], new_cache)`` with all ``w`` K/V rows
+        appended per layer (``cache.append_kv_rows``); ``logits[:, j]``
+        is the next-token distribution AFTER consuming token ``j`` —
+        the verify scores for greedy acceptance. The caller owns length
+        bookkeeping: advancing ``lengths`` by only the accepted prefix
+        is the whole rollback contract (rejected rows stay at
+        ``j >= length`` where every attention path masks them).
+        Positions past the table clamp like ``decode_step``'s — the
+        scheduler never COMMITS past ``token_limit``, so a clamped row
+        is never attended. At ``w == 1`` this computes exactly what
+        ``decode_step`` computes (same ops, ``verify_cached_attention``
+        reduces to ``cached_attention``)."""
+        if len(cache) != self.num_layers:
+            raise ValueError(
+                f"cache has {len(cache)} layers, model has "
+                f"{self.num_layers}."
+            )
+        if tokens.ndim != 2:
+            raise ValueError(
+                f"decode_verify expects [batch, w] int tokens, got "
+                f"shape {tokens.shape}."
+            )
+        w = tokens.shape[1]
+        pos_idx = jnp.clip(
+            lengths[:, None] + jnp.arange(w)[None, :],
+            0,
+            self.max_seq_len - 1,
+        )
+        x = (self.embed[tokens] + self.pos[pos_idx]).astype(self.dtype)
+        if self._pin():
+            x = constrain_batch_sharded(x)
+        new_cache = []
+        for block, layer in zip(self.blocks, cache):
+            x, kc, vc = block.decode_verify(
+                x, layer["k"], layer["v"], lengths
+            )
+            new_cache.append({"k": kc, "v": vc})
+        return self._logits(x), tuple(new_cache)
 
 
 def greedy_decode(

@@ -134,6 +134,63 @@ def cached_attention(
     ).astype(q.dtype)
 
 
+def verify_cached_attention(
+    q: jax.Array,
+    k_cache: jax.Array,
+    v_cache: jax.Array,
+    lengths: jax.Array,
+    *,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Multi-position attention over a per-sequence KV cache — the
+    speculative-decode verify counterpart of :func:`cached_attention`
+    (docs/DESIGN.md §18).
+
+    Shapes: ``q [batch, w, heads, head_dim]`` (``w`` draft positions per
+    sequence: position ``j`` is the token at sequence index
+    ``lengths + j``), ``k_cache/v_cache [batch, capacity, heads,
+    head_dim]`` (already containing all ``w`` new K/V rows at indices
+    ``lengths..lengths+w-1``), ``lengths [batch] int32`` — the number of
+    PREVIOUSLY cached tokens per sequence. Draft position ``j`` attends
+    cache rows ``0..lengths+j`` inclusive (causal within the window,
+    full prefix before it); everything past is masked. Output
+    ``[batch, w, heads, head_dim]``. At ``w == 1`` this is exactly
+    :func:`cached_attention` (same mask, same ops).
+
+    Numerics mirror :func:`cached_attention` op for op — fp32
+    HIGHEST-precision einsums, the same finite ``_MASK_VALUE``,
+    ``jax.nn.softmax`` — so each verify position's output differs from
+    the single-position decode step's at the same (sequence, position)
+    only by dot-reduction reassociation over the batched-q einsum:
+    ULP-level, and pinned TOKEN-exact (speculative greedy == plain
+    greedy) by the speculative-decode certification.
+    """
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    s = jnp.einsum(
+        "bqhd,bkhd->bhqk",
+        q,
+        k_cache,
+        preferred_element_type=jnp.float32,
+        precision=lax.Precision.HIGHEST,
+    ) * jnp.float32(scale)
+    w = q.shape[1]
+    ki = lax.broadcasted_iota(jnp.int32, (k_cache.shape[1],), 0)
+    qi = lax.broadcasted_iota(jnp.int32, (w,), 0)
+    mask = (
+        ki[None, None, None, :]
+        <= lengths[:, None, None, None] + qi[None, None, :, None]
+    )
+    s = jnp.where(mask, s, _MASK_VALUE)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum(
+        "bhqk,bkhd->bqhd",
+        p,
+        v_cache.astype(jnp.float32),
+        precision=lax.Precision.HIGHEST,
+    ).astype(q.dtype)
+
+
 def decode_attention_supported(num_heads: int, head_dim: int) -> bool:
     """Whether :func:`paged_decode_attention` serves this geometry.
 

@@ -32,7 +32,10 @@ per-step cost — many requests ride one compiled program.
   batching in :class:`DecodeScheduler` (``generate()`` streaming API,
   deadlines/shedding/crash recovery, drain-boundary weight hot-swap),
   ``zk_decode_*`` metrics, and the :class:`LMServingConfig` CLI task
-  (docs/DESIGN.md §15).
+  (docs/DESIGN.md §15) — plus :class:`SpeculativeDecoding`, the
+  draft/verify schedule that amortizes one teacher dispatch over a
+  k+1-token window, certified token-identical to plain greedy decode
+  (docs/DESIGN.md §18).
 """
 
 from zookeeper_tpu.serving.batcher import (
@@ -48,6 +51,7 @@ from zookeeper_tpu.serving.decode import (
     DecodeScheduler,
     DecodeStream,
     LMServingConfig,
+    SpeculativeDecoding,
 )
 from zookeeper_tpu.serving.engine import CheckpointWatcher, InferenceEngine
 from zookeeper_tpu.serving.metrics import ServingMetrics
@@ -67,5 +71,6 @@ __all__ = [
     "RejectedError",
     "ServingConfig",
     "ServingMetrics",
+    "SpeculativeDecoding",
     "WorkerCrashedError",
 ]

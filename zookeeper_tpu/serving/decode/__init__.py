@@ -23,10 +23,17 @@ The token-streaming half of the serving stack — the ROADMAP's
 - :class:`LMServingConfig` — the config-system citizen tying model +
   checkpoint + engine + scheduler into a CLI task
   (``examples/serve_lm.py``).
+- :class:`SpeculativeDecoding` — the draft/verify schedule
+  (docs/DESIGN.md §18): a small draft model proposes ``k`` tokens per
+  slot, one teacher ``decode_verify`` dispatch scores the whole window
+  (multi-token KV append + rollback-by-length), greedy acceptance
+  keeps the longest prefix match — certified token-identical to plain
+  greedy decode at up to ``k + 1`` tokens per teacher dispatch.
 """
 
 from zookeeper_tpu.serving.decode.cache import (
     allocate_kv_cache,
+    append_kv_rows,
     kv_cache_bytes,
     pages_in_use,
 )
@@ -37,6 +44,7 @@ from zookeeper_tpu.serving.decode.scheduler import (
     DecodeStream,
 )
 from zookeeper_tpu.serving.decode.service import LMServingConfig
+from zookeeper_tpu.serving.decode.speculative import SpeculativeDecoding
 
 __all__ = [
     "DecodeEngine",
@@ -44,7 +52,9 @@ __all__ = [
     "DecodeScheduler",
     "DecodeStream",
     "LMServingConfig",
+    "SpeculativeDecoding",
     "allocate_kv_cache",
+    "append_kv_rows",
     "kv_cache_bytes",
     "pages_in_use",
 ]

@@ -29,12 +29,31 @@ prompt length hold padding-token garbage), each decode step writes row
 (``ops.cached_attention``). Refilling a slot therefore needs NO cache
 zeroing: the new occupant's prefill overwrites rows up to its bucket
 and its length masks everything beyond.
+
+Multi-token append (docs/DESIGN.md §18): the speculative-decode verify
+program writes ``w`` rows per slot in ONE dispatch —
+:func:`append_kv_rows` is the primitive, a per-slot
+``dynamic_update_slice`` along the capacity axis at each slot's
+``length`` (the stepping stone to true page indirection, ROADMAP item
+4: the write is already expressed as "rows at an offset", not "the next
+ring position"). Rollback rides the SAME validity invariant, by
+construction: a rejected draft suffix is "un-appended" simply by not
+advancing ``length`` past the accepted prefix — the rejected rows sit
+at ``j >= length`` where every attention path masks them and every
+later append/step overwrites them before they could ever be attended.
+The paged-decode-kernel poisoned-row tests (§17) certify exactly this
+garbage-rows-beyond-length harmlessness as an equality.
 """
 
 import math
 from typing import Any, Tuple
 
-__all__ = ["allocate_kv_cache", "kv_cache_bytes", "pages_in_use"]
+__all__ = [
+    "allocate_kv_cache",
+    "append_kv_rows",
+    "kv_cache_bytes",
+    "pages_in_use",
+]
 
 
 def allocate_kv_cache(
@@ -61,6 +80,31 @@ def allocate_kv_cache(
         {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
         for _ in range(num_layers)
     )
+
+
+def append_kv_rows(cache_buf, rows, starts):
+    """Append ``w`` new KV rows per slot in one traced op: a vmapped
+    ``dynamic_update_slice`` writing ``rows [slots, w, heads, head_dim]``
+    into ``cache_buf [slots, capacity, heads, head_dim]`` at each slot's
+    ``starts [slots]`` offset along the capacity axis (docs/DESIGN.md
+    §18). The start index is clamped to ``capacity - w`` (standard DUS
+    semantics) so an idle/garbage slot's write stays in bounds; CALLERS
+    must guarantee active slots satisfy ``start + w <= capacity`` (the
+    scheduler's speculation-eligibility check) — a clamped active write
+    would land on live rows. Which of the ``w`` rows are *valid* is not
+    this function's business: validity is ``j < length``, and rollback
+    of a rejected suffix is just not advancing ``length`` (module
+    docstring)."""
+    import jax
+    import jax.numpy as jnp
+
+    w = rows.shape[1]
+    starts = jnp.clip(starts, 0, cache_buf.shape[1] - w)
+    return jax.vmap(
+        lambda buf, upd, s: jax.lax.dynamic_update_slice(
+            buf, upd, (s, 0, 0)
+        )
+    )(cache_buf, rows.astype(cache_buf.dtype), starts)
 
 
 def kv_cache_bytes(

@@ -104,6 +104,14 @@ DecodeScheduler`.
     #: "auto"/"pallas" to the reference with a warning — the
     #: compile_forward small-bucket posture.
     decode_attention: str = Field("auto")
+    #: Program-naming prefix for the ProgramLedger / recompile events
+    #: (docs/DESIGN.md §18): a speculative-decode DRAFT engine runs the
+    #: same program family as the teacher in the same process, and the
+    #: ledger/statusz must tell them apart — ``SpeculativeDecoding``
+    #: binds its draft engine with ``ledger_prefix="draft_"`` so its
+    #: programs ledger as ``draft_prefill`` / ``draft_decode_step`` /
+    #: ``draft_verify_step`` next to the teacher's.
+    ledger_prefix: str = Field("")
 
     # -- binding ---------------------------------------------------------
 
@@ -356,15 +364,16 @@ DecodeScheduler`.
             initial=-1,
         ))
 
-    def decode_mbu_for(self, seconds: float) -> float:
-        """MBU of the decode_step program at a given dispatch wall
-        time: ledger cost-analysis bytes / ``seconds`` / reference HBM
-        bandwidth, -1 when any input is unknown (the ``ledger.mbu``
-        totality contract — never raises). The live gauge evaluates
-        this at each dispatch's own time; the bench evaluates it at
-        the run's MEDIAN dispatch time so the gated ``decode_mbu`` key
-        is not a single-sample ratio of the least-representative
-        (drain-tail) dispatch."""
+    def decode_mbu_for(self, seconds: float, program: str = "decode_step") -> float:
+        """MBU of a decode-path program (default ``decode_step``; the
+        speculative hot loop passes its ``verify_step/w{N}`` key) at a
+        given dispatch wall time: ledger cost-analysis bytes /
+        ``seconds`` / reference HBM bandwidth, -1 when any input is
+        unknown (the ``ledger.mbu`` totality contract — never raises).
+        The live gauge evaluates this at each dispatch's own time; the
+        bench evaluates it at the run's MEDIAN dispatch time so the
+        gated ``decode_mbu`` key is not a single-sample ratio of the
+        least-representative (drain-tail) dispatch."""
         from zookeeper_tpu.observability import ledger as _ledger
 
         bw = getattr(self, "_hbm_bandwidth", None)
@@ -375,21 +384,28 @@ DecodeScheduler`.
 
             bw = reference_hbm_bandwidth()[0]
             object.__setattr__(self, "_hbm_bandwidth", bw)
-        record = self._ledger_records.get("decode_step")
+        record = self._ledger_records.get(
+            str(self.ledger_prefix) + program
+        )
         value = _ledger.mbu(
             getattr(record, "bytes_accessed", None), seconds, bw
         )
         return float(value) if value is not None else -1.0
 
-    def _observe_decode(self, seconds: float) -> None:
+    def _observe_decode(
+        self, seconds: float, program: str = "decode_step"
+    ) -> None:
         """Publish ``zk_decode_mbu`` for one completed (readback-
-        bounded) decode dispatch — the memory-bound counterpart of the
-        forward engine's ``zk_serve_mfu`` (decode_step is HBM-bound, so
-        FLOPs-based MFU is the wrong lens; docs/DESIGN.md §17). Total:
-        a gauge update never raises."""
+        bounded) decode-path dispatch — the memory-bound counterpart of
+        the forward engine's ``zk_serve_mfu`` (the decode loop is
+        HBM-bound, so FLOPs-based MFU is the wrong lens; docs/DESIGN.md
+        §17). Under speculation the hot program is ``verify_step``, not
+        ``decode_step`` — ``verify()`` feeds the gauge too, so the
+        roofline tracks whichever program actually serves. Total: a
+        gauge update never raises."""
         if seconds <= 0:
             return
-        value = self.decode_mbu_for(seconds)
+        value = self.decode_mbu_for(seconds, program)
         # Per-engine copy FIRST: the gauge is process-global (the
         # export path), so with two engines live the gauge holds
         # whichever dispatched last — decode_mbu/statusz must report
@@ -583,8 +599,12 @@ DecodeScheduler`.
     def _aot(self, key: str, fn, example_args, *, donate_cache_at: int):
         """AOT lower+compile ``fn`` with the engine's sharding
         discipline, timed and recorded in the process ProgramLedger
-        under ``key`` ('prefill' / 'decode_step')."""
+        under ``key`` ('prefill' / 'decode_step' / 'verify_step',
+        ``ledger_prefix``-tagged — a draft engine's programs ledger as
+        ``draft_*``)."""
         import jax
+
+        key = str(self.ledger_prefix) + key
 
         mesh = self._partitioner.mesh
         if mesh is None:
@@ -714,10 +734,67 @@ DecodeScheduler`.
         self._compiled_cache[key] = compiled
         return compiled
 
+    def _verify_compiled(self, width: int, *, during_dispatch: bool = False):
+        """The multi-token verify/append program (docs/DESIGN.md §18):
+        ``width`` tokens per slot through ``decode_verify`` in one
+        dispatch — the speculative teacher runs it at ``k + 1``, the
+        draft at its catch-up width. One compile per width, part of the
+        warmed grid (``warmup_verify``); ledgered as ``verify_step``
+        (``ledger_prefix``-tagged)."""
+        import jax
+        import jax.numpy as jnp
+
+        self._require_bound()
+        if width < 1:
+            raise ValueError(f"verify width={width} must be >= 1.")
+        if width > self._capacity:
+            raise ValueError(
+                f"verify width {width} exceeds the KV capacity "
+                f"{self._capacity}; shrink speculative.k or raise "
+                "kv_capacity."
+            )
+        key = ("verify", int(width), self._partitioner.mesh)
+        cached = self._compiled_cache.get(key)
+        if cached is not None:
+            return cached
+        if during_dispatch and self._warmed:
+            self._note_dispatch_compile(f"verify_step/w{width}")
+        module = self._module
+
+        def verify_fn(variables, cache, tokens, lengths):
+            logits, new_cache = module.apply(
+                variables, tokens, lengths, cache, method="decode_verify"
+            )
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            return new_cache, nxt
+
+        n = int(self.slots)
+        example = (
+            self._variables,
+            self._cache,
+            jax.ShapeDtypeStruct((n, int(width)), np.int32),
+            jax.ShapeDtypeStruct((n,), np.int32),
+        )
+        compiled = self._aot(
+            f"verify_step/w{width}", verify_fn, example, donate_cache_at=1
+        )
+        self._compiled_cache[key] = compiled
+        return compiled
+
+    def warmup_verify(self, width: int) -> None:
+        """Pre-compile the verify program at ``width`` (the speculative
+        bind calls this for the teacher's ``k + 1`` and the draft's
+        catch-up width BEFORE traffic — a verify compile after
+        ``warmup()`` is deliberate grid growth here, not a dispatch-path
+        recompile)."""
+        self._verify_compiled(int(width))
+
     def warmup(self) -> int:
         """Pre-compile the full program grid (every prefill bucket pair
-        + the decode step) so no stream ever waits on XLA. Returns the
-        number of cached executables."""
+        + the decode step) so no stream ever waits on XLA; a
+        speculative bind extends the grid with its verify widths via
+        :meth:`warmup_verify`. Returns the number of cached
+        executables."""
         self._require_bound()
         for pb in self._prefill_buckets:
             for sb in self._seq_buckets:
@@ -821,6 +898,59 @@ DecodeScheduler`.
             # Readback-bounded wall time — the only honest dispatch
             # clock (the compiled call returns un-synced arrays).
             self._observe_decode(time.perf_counter() - t0)
+        return nxt.astype(np.int32)
+
+    def verify(self, tokens: np.ndarray, lengths: np.ndarray) -> np.ndarray:
+        """``w`` tokens for EVERY slot in one dispatch (docs/DESIGN.md
+        §18): feed the window's input tokens per slot (token ``j`` sits
+        at position ``lengths[slot] + j``), append all ``w`` K/V rows,
+        and return the argmax next token AT EACH POSITION as a host
+        ``[slots, w] int32`` array — ``out[s, j]`` is the greedy token
+        after consuming input ``j``, the verify scores the scheduler's
+        prefix-match acceptance reads. The CALLER owns the rollback:
+        only ``lengths`` it subsequently advances count as appended;
+        rejected rows stay masked garbage. Active slots must satisfy
+        ``lengths + w <= capacity`` (the scheduler's eligibility check)
+        — inactive slots ride along clamped and ignored."""
+        import jax
+
+        self._require_bound()
+        tokens = np.asarray(tokens, np.int32)
+        lengths = np.asarray(lengths, np.int32)
+        if (
+            tokens.ndim != 2
+            or tokens.shape[0] != int(self.slots)
+            or lengths.shape != (int(self.slots),)
+        ):
+            raise ValueError(
+                f"verify expects [slots={self.slots}, w] tokens and "
+                f"[slots] lengths, got {tokens.shape} / {lengths.shape}."
+            )
+        w = int(tokens.shape[1])
+        compiled = self._verify_compiled(w, during_dispatch=True)
+        with _trace.span(
+            "verify_dispatch",
+            attrs=(
+                {"slots": int(self.slots), "width": w}
+                if _trace.enabled()
+                else None
+            ),
+        ):
+            t0 = time.perf_counter()
+            try:
+                new_cache, nxt = compiled(
+                    self._variables, self._cache, tokens, lengths
+                )
+            except BaseException:
+                self._reset_cache()  # donation consumed the buffers
+                raise
+            object.__setattr__(self, "_cache", new_cache)
+            nxt = np.asarray(jax.device_get(nxt))
+            # Readback-bounded: under speculation THIS is the hot
+            # program, so it feeds the MBU roofline gauge like decode.
+            self._observe_decode(
+                time.perf_counter() - t0, program=f"verify_step/w{w}"
+            )
         return nxt.astype(np.int32)
 
     # -- hot swap --------------------------------------------------------

@@ -23,6 +23,16 @@ The tracked quantities are the decode cost model's levers
   means the slot array, not the chip, is the bottleneck (add slots).
 - ``zk_decode_kv_pages_in_use`` — live KV pages across active slots
   (page-granular occupancy of the provisioned cache HBM).
+
+The speculative-decode family (docs/DESIGN.md §18) deliberately renders
+under its own ``zk_spec_*`` prefix (the schedule spans two engines, not
+just the decode path): ``zk_spec_draft_tokens_total`` /
+``zk_spec_accepted_tokens_total`` lifetime counters (their ratio is the
+acceptance rate — the one number that decides whether speculation
+pays), the live ``zk_spec_acceptance_rate`` gauge, and the
+``zk_spec_accept_length`` per-window histogram (how many of the ``k``
+drafts each verify accepted: a mass at 0 means the draft disagrees with
+the teacher; a mass at ``k`` means ``k`` could go higher).
 """
 
 from collections import deque
@@ -64,6 +74,18 @@ _COUNTER_NAMES = (
     "weight_swaps_total",
 )
 
+#: Speculative-decode counters: registered under the ``zk_spec_``
+#: prefix (NOT ``zk_decode_``); reported in ``totals`` after the
+#: decode family.
+_SPEC_COUNTER_NAMES = (
+    "spec_draft_tokens_total",
+    "spec_accepted_tokens_total",
+)
+
+#: Accept-length histogram buckets: counts of accepted drafts per
+#: verify window (small ints, not milliseconds).
+_SPEC_ACCEPT_BUCKETS = (0, 1, 2, 3, 4, 6, 8, 12, 16)
+
 
 @component
 class DecodeMetrics:
@@ -83,10 +105,23 @@ class DecodeMetrics:
         return {
             "registry": registry,
             "counters": {
-                name: registry.counter(
-                    _PREFIX + name, help=f"lifetime decode {name}"
-                )
-                for name in _COUNTER_NAMES
+                **{
+                    name: registry.counter(
+                        _PREFIX + name, help=f"lifetime decode {name}"
+                    )
+                    for name in _COUNTER_NAMES
+                },
+                "spec_draft_tokens_total": registry.counter(
+                    "zk_spec_draft_tokens_total",
+                    help="draft tokens proposed across all speculative "
+                    "windows (k per slot per window)",
+                ),
+                "spec_accepted_tokens_total": registry.counter(
+                    "zk_spec_accepted_tokens_total",
+                    help="draft tokens the teacher verify accepted "
+                    "(longest prefix match; ratio to proposed = "
+                    "acceptance rate)",
+                ),
             },
             "gauges": {
                 "active_slots": registry.gauge(
@@ -113,6 +148,12 @@ class DecodeMetrics:
                     "bind-time weights)",
                     initial=-1,
                 ),
+                "spec_acceptance_rate": registry.gauge(
+                    "zk_spec_acceptance_rate",
+                    help="lifetime accepted/proposed draft-token "
+                    "fraction (-1 = no speculative window yet)",
+                    initial=-1,
+                ),
             },
             "hist": {
                 "ttft_ms": registry.histogram(
@@ -130,6 +171,13 @@ class DecodeMetrics:
                     _PREFIX + "prefill_ms",
                     buckets=DEFAULT_MS_BUCKETS,
                     help="one prefill dispatch (KV write + first token)",
+                ),
+                "spec_accept_length": registry.histogram(
+                    "zk_spec_accept_length",
+                    buckets=_SPEC_ACCEPT_BUCKETS,
+                    help="accepted drafts per verify window per slot "
+                    "(0..k; mass at k means raise k, mass at 0 means "
+                    "the draft disagrees with the teacher)",
                 ),
             },
             "windows": {},
@@ -179,6 +227,34 @@ class DecodeMetrics:
         gauges["queue_depth"].set(int(queue_depth))
         gauges["kv_pages_in_use"].set(int(kv_pages))
 
+    def record_spec_window(
+        self,
+        proposed: int,
+        accepted: int,
+        accept_lengths,
+        window_ms: float,
+        delivered: int,
+    ) -> None:
+        """One speculative window committed (docs/DESIGN.md §18):
+        ``proposed``/``accepted`` draft tokens across the window's
+        slots, per-slot ``accept_lengths`` into the histogram, the
+        window wall time into the decode token series (a window IS the
+        spec path's decode dispatch unit), and ``delivered`` stream
+        tokens into the throughput total."""
+        obs = self._obs()
+        obs["counters"]["spec_draft_tokens_total"].inc(int(proposed))
+        obs["counters"]["spec_accepted_tokens_total"].inc(int(accepted))
+        obs["counters"]["tokens_total"].inc(int(delivered))
+        obs["counters"]["decode_steps_total"].inc()
+        self._observe("token_ms", float(window_ms))
+        for a in accept_lengths:
+            obs["hist"]["spec_accept_length"].observe(float(a))
+        total_p = obs["counters"]["spec_draft_tokens_total"].value
+        total_a = obs["counters"]["spec_accepted_tokens_total"].value
+        obs["gauges"]["spec_acceptance_rate"].set(
+            total_a / total_p if total_p else -1.0
+        )
+
     def record_rejected(self) -> None:
         self._obs()["counters"]["rejected_total"].inc()
 
@@ -207,7 +283,7 @@ class DecodeMetrics:
         obs = self._obs()
         return {
             name: int(obs["counters"][name].value)
-            for name in _COUNTER_NAMES
+            for name in _COUNTER_NAMES + _SPEC_COUNTER_NAMES
         }
 
     def snapshot(self) -> Dict[str, float]:
@@ -217,6 +293,11 @@ class DecodeMetrics:
         out: Dict[str, float] = {
             k: float(v) for k, v in self.totals.items()
         }
+        proposed = out.get("spec_draft_tokens_total", 0.0)
+        if proposed:
+            out["spec_acceptance_rate"] = (
+                out["spec_accepted_tokens_total"] / proposed
+            )
         for name in ("ttft_ms", "token_ms", "prefill_ms"):
             series = windows.get(name)
             if series:

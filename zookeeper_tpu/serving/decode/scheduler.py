@@ -36,7 +36,25 @@ swap is deferred, admission pauses so the slot array drains naturally
 (bounded by ``max_new_tokens``/deadlines), and the swap applies at the
 first empty-slot-array boundary — every in-flight stream finishes
 entirely on the weights it started with, every stream admitted after
-the swap runs entirely on the new ones.
+the swap runs entirely on the new ones. Under speculation the staged
+swap is of the TEACHER (the authoritative model): the draft is never
+swapped mid-flight — a stale draft only lowers acceptance, never
+correctness.
+
+With a bound :class:`SpeculativeDecoding` the decode phase runs the
+two-model schedule instead (docs/DESIGN.md §18): per iteration the
+draft proposes ``k`` tokens per active slot (one width-2 catch-up
+append + ``k - 1`` draft steps), ONE teacher ``decode_verify`` scores
+all ``k + 1`` window positions, and greedy acceptance (longest prefix
+match, plus the teacher's own token at the first mismatch) commits
+1..k+1 tokens per slot — mixed accept lengths across slots are pure
+host bookkeeping, no drain, no recompile. Rollback is by-length: a
+rejected suffix's cache rows are simply never advanced over. Slots
+within a window of their token limit fall back to plain ``decode_step``
+iterations (the capacity-truncation contract is the plain path's,
+verbatim), and every emitted token remains the teacher's argmax given
+the committed prefix — speculative greedy output is certified
+bit-identical to plain greedy decode.
 
 Threading mirrors the batcher: ``synchronous=True`` (default) is
 thread- and clock-free — the caller drives via ``drain()`` /
@@ -94,6 +112,10 @@ class DecodeStream:
         self._done = False
         self._error: Optional[BaseException] = None
         self._finish_reason: Optional[str] = None
+        # Speculative accounting (docs/DESIGN.md §18): drafts proposed /
+        # accepted for THIS stream, stamped into its RequestLog detail.
+        self._spec_proposed = 0
+        self._spec_accepted = 0
         self._t_submit = time.perf_counter()
         #: Submit-to-first-token milliseconds (None until it lands).
         self.ttft_ms: Optional[float] = None
@@ -155,8 +177,15 @@ class DecodeStream:
             self._finish_reason = reason
             self._cond.notify_all()
         # Outside the cond (first-transition-wins above guarantees
-        # exactly one terminal record per stream).
-        self._scheduler._log_terminal(self, "ok", detail=reason)
+        # exactly one terminal record per stream). Streams that rode
+        # the speculative schedule carry accepted/proposed in their
+        # terminal summary (docs/DESIGN.md §18).
+        detail = reason
+        if self._spec_proposed:
+            detail = (
+                f"{reason} spec={self._spec_accepted}/{self._spec_proposed}"
+            )
+        self._scheduler._log_terminal(self, "ok", detail=detail)
 
     def _fail(self, error: BaseException) -> bool:
         with self._cond:
@@ -243,7 +272,9 @@ class DecodeScheduler:
 
     # -- wiring ----------------------------------------------------------
 
-    def bind(self, engine, metrics=None, request_log=None) -> "DecodeScheduler":
+    def bind(
+        self, engine, metrics=None, request_log=None, speculative=None
+    ) -> "DecodeScheduler":
         if self.max_new_tokens < 1:
             raise ValueError(
                 f"max_new_tokens={self.max_new_tokens} must be >= 1 "
@@ -265,11 +296,26 @@ class DecodeScheduler:
             "_request_log",
             request_log if request_log is not None else RequestLog("decode"),
         )
+        if speculative is not None:
+            speculative._require_bound()
+            if speculative.engine is not engine:
+                raise ValueError(
+                    "speculative binding mirrors a different teacher "
+                    "engine; bind the scheduler and the speculative "
+                    "config to the SAME DecodeEngine."
+                )
+        object.__setattr__(self, "_speculative", speculative)
         n = int(engine.slots)
         object.__setattr__(self, "_queue", deque())
         object.__setattr__(self, "_slot_stream", [None] * n)
         object.__setattr__(self, "_slot_lengths", np.zeros(n, np.int64))
         object.__setattr__(self, "_slot_tokens", np.zeros(n, np.int32))
+        # Draft-cache bookkeeping (speculative schedule): valid draft
+        # KV rows per slot, plus the <=1 committed token the teacher
+        # has cached but the draft has not yet consumed (the full-
+        # acceptance catch-up — docs/DESIGN.md §18).
+        object.__setattr__(self, "_draft_lengths", np.zeros(n, np.int64))
+        object.__setattr__(self, "_draft_pending", [[] for _ in range(n)])
         object.__setattr__(self, "_lock", threading.RLock())
         # Serializes scheduler ITERATIONS (plan -> dispatch -> commit)
         # so ``_lock`` can be released across the device dispatches:
@@ -626,6 +672,15 @@ class DecodeScheduler:
                         )
             t0 = time.perf_counter()
             first = engine.prefill([s.prompt for s in group], slots)
+            spec = getattr(self, "_speculative", None)
+            if spec is not None:
+                # Seed the DRAFT cache for the same group/slots (its
+                # first-token output is discarded — the teacher's is
+                # authoritative and already delivered). One extra
+                # dispatch per admission, amortized over the stream.
+                spec.draft_engine.prefill(
+                    [s.prompt for s in group], slots
+                )
             dt_ms = (time.perf_counter() - t0) * 1e3
             with self._lock:
                 now = time.perf_counter()
@@ -636,6 +691,12 @@ class DecodeScheduler:
                     stream.ttft_ms = (now - stream._t_submit) * 1e3
                     if self._metrics is not None:
                         self._metrics.record_ttft(stream.ttft_ms)
+                    if spec is not None:
+                        # Both caches hold exactly the prompt now.
+                        self._draft_lengths[slot] = int(
+                            stream.prompt.shape[0]
+                        )
+                        self._draft_pending[slot] = []
                     self._slot_tokens[slot] = int(token)
                     self._finish_or_continue(slot, int(token))
                     delivered += 1
@@ -653,7 +714,32 @@ class DecodeScheduler:
         slot whose stream was failed mid-dispatch (``close()``, crash)
         skips delivery (its cache row write is masked garbage at
         ``j >= length`` for the next occupant, per the refill
-        invariant)."""
+        invariant).
+
+        With speculation bound, the two-model window schedule
+        (:meth:`_decode_spec`) runs instead — unless any active slot is
+        within one window of its token limit, in which case THIS
+        iteration falls back to the plain path (a clamped multi-token
+        append would land on live rows; the plain path's
+        truncate-at-exactly-token_limit contract takes over, and the
+        slot finishes within a few iterations)."""
+        spec = getattr(self, "_speculative", None)
+        if spec is not None:
+            with self._lock:
+                active = [
+                    i for i, s in enumerate(self._slot_stream)
+                    if s is not None
+                ]
+                eligible = bool(active) and all(
+                    int(self._slot_lengths[i]) + spec.window
+                    <= self._engine.token_limit
+                    for i in active
+                )
+            if not active:
+                return
+            if eligible:
+                self._decode_spec(spec)
+                return
         engine = self._engine
         with self._lock:
             snapshot = list(self._slot_stream)
@@ -662,8 +748,25 @@ class DecodeScheduler:
                 return
             tokens = self._slot_tokens.astype(np.int32)
             lengths = self._slot_lengths.astype(np.int32)
+            counts = None
+            if spec is not None:
+                dlengths = self._slot_draft_state()
+                ctokens, counts = self._draft_catchup_window(active, tokens)
         t0 = time.perf_counter()
         nxt = engine.decode(tokens, lengths)
+        if spec is not None:
+            # Keep the DRAFT cache in sync through plain iterations
+            # (the near-capacity fallback): the draft consumes the same
+            # token(s) via its width-2 catch-up append, so the
+            # gap-is-at-most-one invariant the speculative window
+            # relies on holds across any mix of plain and speculative
+            # iterations. At draft length == capacity-1 the width-2
+            # write clamps one row early and scribbles a live draft
+            # row — harmless by exhaustion: that slot's stream is at
+            # token_limit - 1 and finishes THIS iteration, so the
+            # scribbled row dies with it (the next occupant's prefill
+            # + masking make it invisible, per the refill invariant).
+            spec.draft_engine.verify(ctokens, dlengths)
         dt_ms = (time.perf_counter() - t0) * 1e3
         with self._lock:
             delivered = 0
@@ -671,12 +774,171 @@ class DecodeScheduler:
                 if self._slot_stream[slot] is not snapshot[slot]:
                     continue  # failed by close()/crash mid-dispatch
                 self._slot_lengths[slot] += 1
+                if counts is not None:
+                    self._draft_lengths[slot] = int(
+                        dlengths[slot]
+                    ) + int(counts[slot])
+                    self._draft_pending[slot] = []
                 token = int(nxt[slot])
                 self._slot_tokens[slot] = token
                 self._finish_or_continue(slot, token)
                 delivered += 1
             if self._metrics is not None:
                 self._metrics.record_decode_step(dt_ms, delivered)
+
+    def _slot_draft_state(self) -> np.ndarray:
+        """Draft cached-rows snapshot (caller holds ``_lock``)."""
+        return self._draft_lengths.astype(np.int32).copy()
+
+    def _draft_catchup_window(self, active, cur_tokens):
+        """Build the draft's width-2 catch-up/append window: per active
+        slot, the (at most one) committed token the draft has not yet
+        consumed, then the current input token. Returns ``(tokens
+        [slots, 2], counts [slots])`` — ``counts`` is how many of the
+        two are real (the rest is padding whose KV row stays garbage
+        beyond the advanced length)."""
+        n = int(self._engine.slots)
+        ctokens = np.zeros((n, 2), np.int32)
+        counts = np.zeros((n,), np.int32)
+        for i in active:
+            pending = self._draft_pending[i]
+            if pending:
+                ctokens[i, 0] = int(pending[0])
+                ctokens[i, 1] = int(cur_tokens[i])
+                counts[i] = 2
+            else:
+                ctokens[i, 0] = int(cur_tokens[i])
+                ctokens[i, 1] = int(cur_tokens[i])  # pad row, never valid
+                counts[i] = 1
+        return ctokens, counts
+
+    def _decode_spec(self, spec) -> None:
+        """One speculative window over the whole slot array
+        (docs/DESIGN.md §18): the draft proposes ``k`` tokens per slot
+        (one width-2 catch-up append + ``k - 1`` draft steps), ONE
+        teacher ``decode_verify`` scores all ``k + 1`` positions, and
+        greedy acceptance commits the longest draft/teacher prefix
+        match plus the teacher's own token at the first mismatch —
+        1..k+1 tokens per slot per iteration, mixed accept lengths
+        handled as host bookkeeping. Rollback-by-length: rejected
+        suffix rows in BOTH caches are never advanced over. Caller
+        holds ``_step_lock``; every dispatch runs outside ``_lock``
+        over a snapshot, with the same identity-checked commit as the
+        plain path."""
+        engine = self._engine
+        draft = spec.draft_engine
+        k = int(spec.k)
+        n = int(engine.slots)
+        with self._lock:
+            snapshot = list(self._slot_stream)
+            active = [i for i, s in enumerate(snapshot) if s is not None]
+            if not active:
+                return
+            cur = self._slot_tokens.astype(np.int32).copy()
+            lengths = self._slot_lengths.astype(np.int32).copy()
+            dlengths = self._slot_draft_state()
+            ctokens, counts = self._draft_catchup_window(active, cur)
+        t0 = time.perf_counter()
+        proposals = np.zeros((n, k), np.int32)
+        with _trace.span(
+            "spec_draft",
+            attrs=(
+                {"slots": len(active), "k": k}
+                if _trace.enabled()
+                else None
+            ),
+        ):
+            # 1. Catch-up + first proposal: one width-2 append brings
+            # the draft cache level with the teacher's committed prefix
+            # AND consumes the current input token; the last fed
+            # position's argmax is the first draft proposal.
+            out = draft.verify(ctokens, dlengths)
+            step_lengths = dlengths.copy()
+            for i in active:
+                proposals[i, 0] = int(out[i, int(counts[i]) - 1])
+                step_lengths[i] += int(counts[i])
+            # 2. k-1 sequential draft steps propose the rest.
+            step_tokens = proposals[:, 0].copy()
+            for t in range(1, k):
+                step_tokens = draft.decode(step_tokens, step_lengths)
+                for i in active:
+                    proposals[i, t] = int(step_tokens[i])
+                    step_lengths[i] += 1
+        # 3. ONE teacher dispatch verifies the whole window: input
+        # [current, d_1..d_k], argmax scored at every position.
+        vtokens = np.zeros((n, k + 1), np.int32)
+        for i in active:
+            vtokens[i, 0] = int(cur[i])
+            vtokens[i, 1:] = proposals[i]
+        with _trace.span(
+            "spec_verify",
+            attrs=(
+                {"slots": len(active), "window": k + 1}
+                if _trace.enabled()
+                else None
+            ),
+        ):
+            scored = engine.verify(vtokens, lengths)
+        dt_ms = (time.perf_counter() - t0) * 1e3
+        # 4. Host accept + commit (greedy = longest prefix match).
+        with self._lock:
+            delivered = 0
+            proposed_total = 0
+            accepted_total = 0
+            accept_lengths = []
+            for i in active:
+                stream = snapshot[i]
+                if self._slot_stream[i] is not stream:
+                    continue  # failed by close()/crash mid-dispatch
+                a = 0
+                while a < k and int(proposals[i, a]) == int(scored[i, a]):
+                    a += 1
+                base = int(lengths[i])
+                for j in range(a + 1):
+                    # Identical bookkeeping to the plain path, one
+                    # accepted token at a time: lengths advance over
+                    # the consumed input, then the token is delivered
+                    # and EOS/length/capacity checked — a stream that
+                    # finishes mid-window discards the rest of the
+                    # window (both caches' surplus rows stay masked
+                    # garbage per the rollback contract).
+                    self._slot_lengths[i] = base + j + 1
+                    token = int(scored[i, j])
+                    self._slot_tokens[i] = token
+                    self._finish_or_continue(i, token)
+                    delivered += 1
+                    if self._slot_stream[i] is not stream:
+                        break
+                if self._slot_stream[i] is stream:
+                    # Survived the window: the draft has consumed
+                    # [current, d_1..d_{k-1}] — on full acceptance it
+                    # still owes d_k, carried as the pending catch-up
+                    # token for the next window.
+                    self._draft_lengths[i] = base + 1 + min(a, k - 1)
+                    self._draft_pending[i] = (
+                        [int(proposals[i, k - 1])] if a == k else []
+                    )
+                stream._spec_proposed += k
+                stream._spec_accepted += a
+                proposed_total += k
+                accepted_total += a
+                accept_lengths.append(a)
+                if _trace.enabled() and stream.rid is not None:
+                    _trace.event(
+                        "spec_accept",
+                        rid=stream.rid,
+                        attrs={"proposed": k, "accepted": a},
+                    )
+            if accept_lengths:
+                spec.record_window(proposed_total, accepted_total)
+                if self._metrics is not None:
+                    self._metrics.record_spec_window(
+                        proposed_total,
+                        accepted_total,
+                        accept_lengths,
+                        dt_ms,
+                        delivered,
+                    )
 
     def _update_occupancy(self) -> None:
         if self._metrics is None:
@@ -746,6 +1008,10 @@ class DecodeScheduler:
             self._queue.clear()
             for i in range(len(self._slot_stream)):
                 self._slot_stream[i] = None
+                # Draft bookkeeping dies with the streams: the next
+                # occupant's draft prefill re-seeds it.
+                self._draft_lengths[i] = 0
+                self._draft_pending[i] = []
             object.__setattr__(self, "_worker", None)
             _trace.event(
                 "decode_worker_crash",
@@ -965,4 +1231,11 @@ class DecodeScheduler:
                 "compiles": engine.compile_count,
                 "recompiles_detected": engine.recompiles_detected,
                 "swap_pending": self.swap_pending,
+                # Speculative schedule vitals (docs/DESIGN.md §18): k,
+                # live acceptance, draft compile discipline.
+                "speculative": (
+                    self._speculative.status()
+                    if getattr(self, "_speculative", None) is not None
+                    else {"enabled": False}
+                ),
             }

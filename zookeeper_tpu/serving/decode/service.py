@@ -20,6 +20,7 @@ line and ``/statusz`` report the RESOLVED flavor plus the
 """
 
 import json
+import logging
 import time
 from typing import Any, Dict, Optional
 
@@ -33,8 +34,11 @@ from zookeeper_tpu.parallel.partitioner import (
 from zookeeper_tpu.serving.decode.engine import DecodeEngine
 from zookeeper_tpu.serving.decode.metrics import DecodeMetrics
 from zookeeper_tpu.serving.decode.scheduler import DecodeScheduler
+from zookeeper_tpu.serving.decode.speculative import SpeculativeDecoding
 from zookeeper_tpu.training.experiment import Experiment
 from zookeeper_tpu.training.metrics import CompositeMetricsWriter, MetricsWriter
+
+logger = logging.getLogger(__name__)
 
 __all__ = ["LMServingConfig"]
 
@@ -53,6 +57,14 @@ class LMServingConfig(Experiment):
     scheduler: DecodeScheduler = ComponentField(DecodeScheduler)
     metrics: DecodeMetrics = ComponentField(DecodeMetrics)
     writer: MetricsWriter = ComponentField(CompositeMetricsWriter)
+    #: Speculative decoding (docs/DESIGN.md §18): ``speculative.
+    #: enabled=True speculative.k=4 speculative.draft_checkpoint=...``
+    #: serves the draft/verify schedule — token-identical to plain
+    #: greedy decode, up to k+1 tokens per teacher dispatch. Resolved
+    #: at bind; an unavailable draft (unreadable checkpoint,
+    #: incompatible geometry) degrades LOUDLY to plain decode rather
+    #: than failing the service.
+    speculative: SpeculativeDecoding = ComponentField(SpeculativeDecoding)
 
     #: Deployment artifact: a ``save_model`` export or a full
     #: ``Checkpointer`` directory (latest step). None = fresh-init
@@ -131,7 +143,10 @@ class LMServingConfig(Experiment):
         )
         if self.warmup:
             self.engine.warmup()
-        self.scheduler.bind(self.engine, metrics=self.metrics)
+        spec = self._resolve_speculative()
+        self.scheduler.bind(
+            self.engine, metrics=self.metrics, speculative=spec
+        )
         if self.metrics_port >= 0 or self.flight_recorder_dir:
             try:
                 if self.flight_recorder_dir:
@@ -142,6 +157,72 @@ class LMServingConfig(Experiment):
                 self._teardown_service(suppress=True)
                 raise
         return self.engine, self.scheduler
+
+    def _resolve_speculative(self) -> Optional[SpeculativeDecoding]:
+        """Resolve ``speculative`` at bind (docs/DESIGN.md §18): build
+        the draft module from ``speculative.draft_model`` at the
+        teacher's seq_len/vocab, load ``draft_checkpoint`` (EMA/raw per
+        ``draft_weights``) or fresh-init when none is given (program-
+        shape smoke — acceptance will be ~chance, flagged loudly), and
+        bind the draft engine. An UNAVAILABLE draft — unreadable
+        checkpoint, incompatible geometry — degrades LOUDLY to plain
+        decode: the service stays up, the warning says why speculation
+        is off. Returns the bound binding or None."""
+        sp = self.speculative
+        if not sp.enabled:
+            return None
+        draft_module = sp.draft_model.build((self.seq_len,), self.vocab_size)
+        try:
+            if sp.draft_checkpoint:
+                import jax
+
+                from zookeeper_tpu.training.checkpoint import (
+                    load_inference_model,
+                )
+
+                abstract = jax.eval_shape(
+                    lambda: sp.draft_model.initialize(
+                        draft_module, (self.seq_len,), seed=self.seed
+                    )
+                )
+                draft_params, draft_state = load_inference_model(
+                    sp.draft_checkpoint,
+                    weights=sp.draft_weights,
+                    params_like=abstract[0],
+                    model_state_like=abstract[1],
+                )
+            else:
+                logger.warning(
+                    "speculative.enabled with no draft_checkpoint: "
+                    "serving a FRESH-INIT draft (program-shape smoke "
+                    "only — acceptance will be ~chance; point "
+                    "speculative.draft_checkpoint at the distilled "
+                    "student for real speedup)"
+                )
+                draft_params, draft_state = sp.draft_model.initialize(
+                    draft_module, (self.seq_len,), seed=self.seed
+                )
+            return sp.bind(
+                self.engine,
+                draft_module,
+                draft_params,
+                draft_state,
+                partitioner=self.partitioner,
+            )
+        except (OSError, ValueError) as e:
+            # Degrade loudly: a missing/unreadable/mismatched draft
+            # must not take the TEACHER service down — but silent
+            # plain-decode-with-spec-configured would misreport every
+            # capacity plan built on the expected speedup.
+            logger.warning(
+                "speculative decoding DISABLED — draft unavailable "
+                "(%s); serving plain greedy decode", e,
+            )
+            if self.verbose:
+                print(
+                    f"speculative decoding disabled: {e}", flush=True
+                )
+            return None
 
     def _request_log_status(self):
         """``/statusz`` + bundle section: the recent terminal-stream
@@ -258,6 +339,31 @@ class LMServingConfig(Experiment):
             # degraded on unsupported geometry).
             "decode_attention": self.engine.decode_attention_flavor,
             "decode_mbu": round(self.engine.decode_mbu, 4),
+            # Speculative schedule (docs/DESIGN.md §18): the RESOLVED
+            # state (config-enabled but draft-unavailable degrades to
+            # False here — the result line reports what actually
+            # served), k, and the live acceptance rate.
+            "speculative": (
+                getattr(self.scheduler, "_speculative", None) is not None
+            ),
+            "spec_k": (
+                int(self.scheduler._speculative.k)
+                if getattr(self.scheduler, "_speculative", None) is not None
+                else 0
+            ),
+            # Unconditional when speculation serves (-1 = no window ran
+            # yet); the snapshot merge above only carries it once a
+            # window committed — scripts parsing the README'd key must
+            # never find it absent on a speculative serve.
+            **(
+                {
+                    "spec_acceptance_rate": round(
+                        self.scheduler._speculative.acceptance_rate, 4
+                    )
+                }
+                if getattr(self.scheduler, "_speculative", None) is not None
+                else {}
+            ),
             "compiles": self.engine.compile_count,
             "recompiles_after_warmup": (
                 self.engine.compile_count - warm_compiles
