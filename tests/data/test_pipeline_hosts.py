@@ -1,0 +1,141 @@
+"""Per-host slicing determinism (docs/DESIGN.md §19 satellite): the
+multi-host input contract — every host computes the same (seed, epoch)
+permutation and reads a DISJOINT, EXHAUSTIVE slice of each global
+batch, bit-stable across mid-epoch resume, with the augmentation RNG
+keyed on (seed, index, epoch) so bytes are host-placement-invariant.
+Driven entirely through the ``host_index``/``host_count`` injection —
+no cluster needed."""
+
+import numpy as np
+import pytest
+
+from zookeeper_tpu.core import configure
+from zookeeper_tpu.data import (
+    ArraySource,
+    ImageClassificationPreprocessing,
+    batch_iterator,
+)
+
+
+def make_source(n=48):
+    rng = np.random.default_rng(0)
+    return ArraySource(
+        {
+            "image": rng.integers(0, 255, size=(n, 8, 8, 1)).astype(
+                np.uint8
+            ),
+            "label": (np.arange(n) % 10).astype(np.int32),
+        }
+    )
+
+
+def make_pre(augment=False):
+    pre = ImageClassificationPreprocessing()
+    configure(
+        pre,
+        {
+            "height": 8,
+            "width": 8,
+            "channels": 1,
+            "pad_pixels": 2 if augment else 0,
+            "random_flip": augment,
+        },
+        name="pre_hosts",
+    )
+    return pre
+
+
+def host_batches(host_index, host_count, *, seed=3, epoch=1, start_batch=0,
+                 training=False, pre=None, batch_size=8):
+    return list(
+        batch_iterator(
+            make_source(),
+            pre,
+            batch_size,
+            training=training,
+            shuffle=True,
+            seed=seed,
+            epoch=epoch,
+            host_index=host_index,
+            host_count=host_count,
+            start_batch=start_batch,
+        )
+    )
+
+
+def test_two_hosts_disjoint_and_exhaustive():
+    """The two hosts' index spaces partition every global batch: no
+    example seen twice, none dropped (within the drop_remainder
+    boundary), and together they equal the single-host global run."""
+    pre = None
+    h0 = host_batches(0, 2, pre=pre)
+    h1 = host_batches(1, 2, pre=pre)
+    full = host_batches(0, 1, pre=pre, batch_size=16)
+    assert len(h0) == len(h1) == len(full) == 3  # 48 // 16
+    for b0, b1, bf in zip(h0, h1, full):
+        i0 = set(np.asarray(b0["_index"]).tolist())
+        i1 = set(np.asarray(b1["_index"]).tolist())
+        assert not (i0 & i1)  # disjoint
+        assert i0 | i1 == set(np.asarray(bf["_index"]).tolist())
+        # Contiguous slices of the SAME global permutation, in order.
+        np.testing.assert_array_equal(
+            np.concatenate([b0["_index"], b1["_index"]]), bf["_index"]
+        )
+
+
+def test_host_slices_bitwise_match_global_run_under_augmentation():
+    """The counter-RNG contract: augmented bytes depend on (seed,
+    index, epoch) only, so host h's rows ARE the global run's rows
+    h*b..(h+1)*b — bit-for-bit, not just statistically."""
+    pre = make_pre(augment=True)
+    full = host_batches(0, 1, pre=pre, batch_size=16, training=True)
+    for h in (0, 1):
+        part = host_batches(h, 2, pre=pre, training=True)
+        for bp, bf in zip(part, full):
+            np.testing.assert_array_equal(
+                bp["input"], bf["input"][h * 8 : (h + 1) * 8]
+            )
+            np.testing.assert_array_equal(
+                bp["target"], bf["target"][h * 8 : (h + 1) * 8]
+            )
+
+
+def test_resume_is_bit_stable_per_host():
+    """start_batch=k on each host reproduces batches k.. of that host's
+    uninterrupted epoch bit-for-bit — the exact-mid-epoch-resume
+    contract, per host."""
+    pre = make_pre(augment=True)
+    for h in (0, 1):
+        uninterrupted = host_batches(h, 2, pre=pre, training=True)
+        resumed = host_batches(h, 2, pre=pre, training=True, start_batch=1)
+        assert len(resumed) == len(uninterrupted) - 1
+        for br, bu in zip(resumed, uninterrupted[1:]):
+            np.testing.assert_array_equal(br["input"], bu["input"])
+            np.testing.assert_array_equal(br["target"], bu["target"])
+
+
+def test_epoch_changes_the_shared_permutation():
+    """Both hosts see the SAME new permutation when the epoch advances
+    (the shared (seed, epoch) key) — and it differs from epoch 1's."""
+    a0 = host_batches(0, 2, epoch=1)
+    b0 = host_batches(0, 2, epoch=2)
+    b1 = host_batches(1, 2, epoch=2)
+    assert not np.array_equal(a0[0]["_index"], b0[0]["_index"])
+    full = host_batches(0, 1, batch_size=16, epoch=2)
+    np.testing.assert_array_equal(
+        np.concatenate([b0[0]["_index"], b1[0]["_index"]]),
+        full[0]["_index"],
+    )
+
+
+def test_bad_host_identity_rejected():
+    with pytest.raises(ValueError, match="host_index"):
+        host_batches(2, 2)
+    with pytest.raises(ValueError, match="host_index"):
+        host_batches(-1, 2)
+    with pytest.raises(ValueError, match="host_index"):
+        list(
+            batch_iterator(
+                make_source(), None, 8, training=False, host_count=0
+            )
+        )

@@ -1,0 +1,84 @@
+"""`initialize_distributed` hardening: public-API initialization probe
+(private `jax._src` state only as fallback), and loud config errors for
+explicit topology without a coordinator."""
+
+import pytest
+
+from zookeeper_tpu.core import configure
+from zookeeper_tpu.parallel import (
+    DistributedRuntime,
+    initialize_distributed,
+    is_distributed_initialized,
+)
+
+
+def test_explicit_topology_without_coordinator_rejected():
+    with pytest.raises(ValueError, match="coordinator_address"):
+        initialize_distributed(num_processes=4)
+    with pytest.raises(ValueError, match="coordinator_address"):
+        initialize_distributed(process_id=1)
+
+
+def test_runtime_component_surfaces_the_same_error():
+    runtime = DistributedRuntime()
+    configure(runtime, {"num_processes": 8}, name="rt_bad")
+    with pytest.raises(ValueError, match="coordinator_address"):
+        runtime.initialize()
+
+
+def test_is_initialized_prefers_public_api(monkeypatch):
+    """When jax exposes ``jax.distributed.is_initialized`` it is the
+    source of truth — the version-fragile private probe is never
+    consulted."""
+    import jax
+
+    monkeypatch.setattr(
+        jax.distributed, "is_initialized", lambda: True, raising=False
+    )
+    assert is_distributed_initialized()
+    monkeypatch.setattr(
+        jax.distributed, "is_initialized", lambda: False, raising=False
+    )
+    assert not is_distributed_initialized()
+
+
+def test_is_initialized_falls_back_to_private_probe(monkeypatch):
+    """On jax versions without the public API the private global-state
+    probe still answers."""
+    import jax
+
+    monkeypatch.delattr(
+        jax.distributed, "is_initialized", raising=False
+    )
+
+    class FakeState:
+        client = object()
+
+    monkeypatch.setattr(
+        jax._src.distributed, "global_state", FakeState(), raising=False
+    )
+    assert is_distributed_initialized()
+
+    class EmptyState:
+        client = None
+
+    monkeypatch.setattr(
+        jax._src.distributed, "global_state", EmptyState(), raising=False
+    )
+    assert not is_distributed_initialized()
+
+
+def test_already_initialized_short_circuits(monkeypatch):
+    """An initialized runtime makes initialize_distributed a no-op —
+    it must not call jax.distributed.initialize again."""
+    import jax
+
+    monkeypatch.setattr(
+        jax.distributed, "is_initialized", lambda: True, raising=False
+    )
+
+    def boom(**kwargs):  # pragma: no cover - must not run
+        raise AssertionError("initialize called despite initialized state")
+
+    monkeypatch.setattr(jax.distributed, "initialize", boom)
+    initialize_distributed()

@@ -1,0 +1,153 @@
+"""Cross-host coordination primitives (resilience/coordination.py):
+the shared-directory exchange/flag substrate the group-recovery and
+per-host-checkpoint protocols ride. Driven with N coordinator
+instances in one process — the primitive is pure filesystem, so the
+simulation IS the real code path."""
+
+import threading
+
+import pytest
+
+from zookeeper_tpu.resilience import (
+    CoordinatorLostError,
+    FaultPlan,
+    FileCoordinator,
+    NullCoordinator,
+    faults,
+)
+
+pytestmark = pytest.mark.chaos
+
+
+def make_pair(root, **kw):
+    return [
+        FileCoordinator(str(root), pid, 2, timeout_s=10.0, **kw)
+        for pid in range(2)
+    ]
+
+
+def test_exchange_allgathers_ordered_payloads(tmp_path):
+    a, b = make_pair(tmp_path)
+    out = {}
+
+    def run(coord, payload):
+        out[coord.process_index] = coord.exchange("greet", payload)
+
+    t = threading.Thread(target=run, args=(b, {"v": 1}))
+    t.start()
+    run(a, {"v": 0})
+    t.join()
+    # Ordered by process index on every host.
+    assert out[0] == [{"v": 0}, {"v": 1}]
+    assert out[1] == [{"v": 0}, {"v": 1}]
+
+
+def test_exchange_rounds_do_not_bleed(tmp_path):
+    """Round 2 of a key must never consume round 1's files."""
+    a, b = make_pair(tmp_path)
+    results = []
+
+    def peer():
+        results.append(b.exchange("k", "b1"))
+        results.append(b.exchange("k", "b2"))
+
+    t = threading.Thread(target=peer)
+    t.start()
+    assert a.exchange("k", "a1") == ["a1", "b1"]
+    assert a.exchange("k", "a2") == ["a2", "b2"]
+    t.join()
+    assert results == [["a1", "b1"], ["a2", "b2"]]
+
+
+def test_exchange_timeout_raises_lost(tmp_path):
+    a, _ = make_pair(tmp_path)
+    with pytest.raises(CoordinatorLostError, match="host\\(s\\) \\[1\\]"):
+        a.exchange("alone", 1, timeout_s=0.2)
+
+
+def test_generation_namespaces_rounds(tmp_path):
+    """A restarted attempt (new generation) cannot see the previous
+    attempt's files — same key, fresh namespace."""
+    a, b = make_pair(tmp_path)
+    t = threading.Thread(target=lambda: b.exchange("k", "old"))
+    t.start()
+    a.exchange("k", "old")
+    t.join()
+    a.generation = b.generation = 1
+    with pytest.raises(CoordinatorLostError):
+        a.exchange("k", "new", timeout_s=0.2)
+
+
+def test_flags_publish_poll_and_generation(tmp_path):
+    a, b = make_pair(tmp_path)
+    assert a.poll_flags("preempt") == []
+    b.publish_flag("preempt", {"origin": 1, "step": 4})
+    assert a.poll_flags("preempt") == [{"origin": 1, "step": 4}]
+    # Republish overwrites (idempotent per host).
+    b.publish_flag("preempt", {"origin": 1, "step": 6})
+    assert a.poll_flags("preempt") == [{"origin": 1, "step": 6}]
+    a.publish_flag("preempt", {"origin": 0, "step": 6})
+    assert len(b.poll_flags("preempt")) == 2
+    # A new generation starts flag-free.
+    a.generation = 1
+    assert a.poll_flags("preempt") == []
+
+
+def test_injected_coordinator_loss_is_deterministic(tmp_path):
+    a, b = make_pair(tmp_path)
+    with faults.injected(FaultPlan(coordinator_loss=1)):
+        with pytest.raises(CoordinatorLostError, match="injected"):
+            a.exchange("k", 1)
+        # One-shot: the next round succeeds (peer in a thread).
+        t = threading.Thread(target=lambda: b.exchange("k2", "b"))
+        t.start()
+        assert a.exchange("k2", "a") == ["a", "b"]
+        t.join()
+
+
+def test_bad_process_index_rejected(tmp_path):
+    with pytest.raises(ValueError, match="process_index"):
+        FileCoordinator(str(tmp_path), 2, 2)
+
+
+def test_null_coordinator_degenerates():
+    c = NullCoordinator()
+    assert c.process_count == 1
+    assert c.exchange("k", {"x": 1}) == [{"x": 1}]
+    assert c.poll_flags("preempt") == []
+    c.publish_flag("preempt", {"origin": 0})
+    assert c.poll_flags("preempt") == [{"origin": 0}]
+
+
+def test_new_incarnation_purges_own_stale_files(tmp_path):
+    """A REAL restart (fresh coordinator objects over the same
+    persistent root) must not consume the dead incarnation's flags or
+    exchange rounds: construction purges this host's own files, so
+    once both hosts re-construct, the root is clean."""
+    a, b = make_pair(tmp_path)
+    b.publish_flag("preempt", {"origin": 1, "step": 4})
+    t = threading.Thread(target=lambda: b.exchange("verdict", "old-b"))
+    t.start()
+    a.exchange("verdict", "old-a")
+    t.join()
+    # The job dies; a new incarnation constructs fresh coordinators.
+    a2, b2 = make_pair(tmp_path)
+    assert a2.poll_flags("preempt") == []  # no spurious re-drain
+    # The first exchange round must wait for FRESH files, not be
+    # satisfied instantly by the dead incarnation's verdicts.
+    t = threading.Thread(target=lambda: b2.exchange("verdict", "new-b"))
+    t.start()
+    assert a2.exchange("verdict", "new-a") == ["new-a", "new-b"]
+    t.join()
+
+
+def test_exchange_and_flags_carry_none_payloads(tmp_path):
+    """A JSON-null payload is a VALUE, not a missing peer: the round
+    completes and the flag polls back."""
+    a, b = make_pair(tmp_path)
+    t = threading.Thread(target=lambda: b.exchange("k", None))
+    t.start()
+    assert a.exchange("k", None) == [None, None]
+    t.join()
+    a.publish_flag("f", None)
+    assert b.poll_flags("f") == [None]

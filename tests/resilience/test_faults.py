@@ -166,3 +166,49 @@ def test_guard_install_off_main_thread_is_quiet():
     t.join(timeout=10)
     assert result.get("error") is None
     assert result.get("preempted") is True
+
+
+def test_kill_process_at_step_keys_on_process_index():
+    plan = FaultPlan(kill_process_at_step={1: 5})
+    assert not plan.kill_due(10, process_index=0)  # other host
+    assert not plan.kill_due(4, process_index=1)  # before the step
+    assert plan.kill_due(6, process_index=1)  # first boundary at/after
+    assert not plan.kill_due(7, process_index=1)  # one-shot
+    # Default process_index is 0 (single-process callers unchanged).
+    assert FaultPlan(kill_process_at_step={0: 2}).kill_due(2)
+
+
+def test_kill_at_step_still_fires_for_any_process():
+    plan = FaultPlan(kill_at_step=3)
+    assert plan.kill_due(3, process_index=7)
+
+
+def test_host_finalize_failure_targets_one_host_once():
+    plan = FaultPlan(fail_host_finalize=1)
+    assert not plan.take_host_finalize_failure(0)  # other host
+    assert plan.take_host_finalize_failure(1)
+    assert not plan.take_host_finalize_failure(1)  # one-shot
+    # Host 0 is a valid target too (None is the off sentinel).
+    assert FaultPlan(fail_host_finalize=0).take_host_finalize_failure(0)
+    assert not FaultPlan().take_host_finalize_failure(0)
+
+
+def test_coordinator_loss_consumes():
+    plan = FaultPlan(coordinator_loss=2)
+    assert plan.take_coordinator_loss()
+    assert plan.take_coordinator_loss()
+    assert not plan.take_coordinator_loss()
+    assert not FaultPlan().take_coordinator_loss()
+
+
+def test_kill_knobs_compose_earliest_fires():
+    """Both kill knobs set: whichever applicable trigger comes FIRST
+    fires (the host-keyed one must not be shadowed by kill_at_step)."""
+    plan = FaultPlan(kill_at_step=10, kill_process_at_step={1: 3})
+    assert not plan.kill_due(2, process_index=1)
+    assert plan.kill_due(3, process_index=1)  # host knob, not step 10
+    assert not plan.kill_due(10, process_index=1)  # one-shot plan-wide
+    # On a host the map does not name, only kill_at_step applies.
+    plan2 = FaultPlan(kill_at_step=10, kill_process_at_step={1: 3})
+    assert not plan2.kill_due(3, process_index=0)
+    assert plan2.kill_due(10, process_index=0)

@@ -1,0 +1,278 @@
+"""Coordinated process-group recovery (docs/DESIGN.md §19), simulated
+in one process: the experiment plays host 0 of a 2-host group over a
+``FileCoordinator`` while a test-driven stub thread plays host 1 —
+publishing drain flags, joining verdict exchanges. The protocol is
+pure filesystem, so the simulation walks the real code; the genuinely
+multi-process composition lives in test_multiprocess_chaos.py."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from zookeeper_tpu.core import configure
+from zookeeper_tpu.observability.registry import default_registry
+from zookeeper_tpu.resilience import (
+    FaultPlan,
+    FileCoordinator,
+    GroupPeerFailure,
+    Preempted,
+    faults,
+    run_with_recovery,
+)
+from zookeeper_tpu.resilience import supervisor as _supervisor
+from zookeeper_tpu.training import TrainingExperiment
+
+pytestmark = pytest.mark.chaos
+
+
+def make_experiment(extra_conf=None):
+    exp = TrainingExperiment()
+    conf = {
+        "loader.dataset": "SyntheticMnist",
+        "loader.dataset.num_train_examples": 128,
+        "loader.dataset.num_validation_examples": 0,
+        "loader.preprocessing": "ImageClassificationPreprocessing",
+        "loader.preprocessing.height": 28,
+        "loader.preprocessing.width": 28,
+        "loader.preprocessing.channels": 1,
+        "loader.host_index": 0,
+        "loader.host_count": 1,
+        "model": "Mlp",
+        "model.hidden_units": (16,),
+        "batch_size": 32,
+        "epochs": 2,
+        "validate": False,
+        "verbose": False,
+        **(extra_conf or {}),
+    }
+    configure(exp, conf, name="group_exp")
+    return exp
+
+
+def ckpt_conf(tmp_path):
+    return {
+        "checkpointer.directory": str(tmp_path / "ckpt"),
+        "checkpointer.synchronous": True,
+        "checkpointer.save_every_epochs": 0,
+        "checkpointer.save_every_steps": 0,
+    }
+
+
+class PeerStub:
+    """Host 1 of the group, driven on a thread: optionally originates a
+    drain flag, then follows the supervisor verdict protocol —
+    'recoverable' for the first ``restarts`` verdict rounds, 'ok'
+    after — exactly what a real peer supervisor exchanges."""
+
+    def __init__(self, root, restarts=1, originate_at_step=None):
+        self.coord = FileCoordinator(str(root), 1, 2, timeout_s=60.0)
+        self.restarts = restarts
+        self.originate_at_step = originate_at_step
+        self.verdicts = []
+        self.error = None
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def start(self):
+        self._thread.start()
+        return self
+
+    def join(self):
+        self._thread.join(timeout=120)
+        assert not self._thread.is_alive()
+        if self.error is not None:
+            raise self.error
+
+    def _run(self):
+        try:
+            for attempt in range(self.restarts + 1):
+                self.coord.generation = attempt
+                if attempt == 0 and self.originate_at_step is not None:
+                    self.coord.publish_flag(
+                        "preempt",
+                        {
+                            "origin": 1,
+                            "step": int(self.originate_at_step),
+                            "signal": None,
+                        },
+                    )
+                outcome = "recoverable" if attempt < self.restarts else "ok"
+                self.verdicts.append(
+                    self.coord.exchange(
+                        "supervisor_verdict",
+                        {"outcome": outcome, "cause": None, "origin": None},
+                    )
+                )
+        except BaseException as e:  # surfaced by join()
+            self.error = e
+
+
+def final_params(exp):
+    import jax
+
+    return [
+        np.asarray(leaf) for leaf in jax.tree.leaves(exp.final_state.params)
+    ]
+
+
+def test_peer_originated_drain_and_bit_identical_resume(tmp_path):
+    """A PEER host's preemption flag drains THIS host at the agreed
+    boundary (one synchronous save + Preempted), the group supervisor
+    restarts in sync with the peer's verdicts, and the resumed run's
+    final params are bit-identical to an uninterrupted run's."""
+    oracle = make_experiment()
+    oracle.run()
+    want = final_params(oracle)
+
+    exp = make_experiment(ckpt_conf(tmp_path))
+    coord = FileCoordinator(str(tmp_path / "coord"), 0, 2, timeout_s=60.0)
+    stub = PeerStub(
+        tmp_path / "coord", restarts=1, originate_at_step=0
+    ).start()
+    result = run_with_recovery(
+        exp,
+        coordinator=coord,
+        max_restarts=2,
+        backoff_s=0.0,
+        sleep=lambda s: None,
+    )
+    stub.join()
+    assert result.restarts == 1
+    # The drain exited at flag.step 0 + the margin (4 at unroll=1).
+    assert isinstance(result.causes[0], Preempted)
+    assert result.causes[0].step == 4
+    assert result.causes[0].saved
+    got = final_params(exp)
+    for w, g in zip(want, got):
+        np.testing.assert_array_equal(w, g)
+    # The wiring is removed after the supervised run.
+    assert exp.group_coordinator is None
+
+
+def test_local_kill_publishes_flag_with_origin_and_metrics(tmp_path):
+    """kill_process_at_step keyed to THIS host's process index: the
+    flag carries origin 0, the guard records it, the group restart
+    metric/gauge move, and the flight recorder is notified with the
+    triggering host's identity."""
+    exp = make_experiment(ckpt_conf(tmp_path))
+    coord = FileCoordinator(str(tmp_path / "coord"), 0, 2, timeout_s=60.0)
+    stub = PeerStub(tmp_path / "coord", restarts=1).start()
+    notifications = []
+    orig_notify = _supervisor._recorder.notify
+    _supervisor._recorder.notify = lambda kind, **kw: notifications.append(
+        (kind, kw)
+    )
+    counter = default_registry().counter(
+        "zk_group_restarts_total",
+        help="coordinated whole-process-group restarts",
+    )
+    before = counter.value
+    try:
+        with faults.injected(FaultPlan(kill_process_at_step={0: 2})):
+            result = run_with_recovery(
+                exp,
+                coordinator=coord,
+                max_restarts=2,
+                backoff_s=0.0,
+                sleep=lambda s: None,
+            )
+    finally:
+        _supervisor._recorder.notify = orig_notify
+    stub.join()
+    assert result.restarts == 1
+    # Flag at boundary 2 + margin 4 => agreed exit at step 6.
+    assert result.causes[0].step == 6
+    assert counter.value == before + 1
+    assert (
+        default_registry()
+        .gauge("zk_group_restore_ms")
+        .value
+        > 0
+    )
+    group_events = [kw for kind, kw in notifications if kind == "group_restart"]
+    assert group_events and group_events[0]["attrs"]["origin"] == 0
+    assert group_events[0]["attrs"]["cause"] == "Preempted"
+
+
+def test_kill_process_at_step_other_host_does_not_fire_locally():
+    """The multi-host kill map is keyed on the process index: a plan
+    naming host 1 must not preempt host 0 (no coordinator wired, so
+    nothing relays it either)."""
+    exp = make_experiment()
+    with faults.injected(FaultPlan(kill_process_at_step={1: 1})):
+        exp.run()  # completes: the fault targets another host
+
+
+def test_peer_hard_failure_stops_group(tmp_path):
+    """A peer whose verdict says 'stop' (unrecoverable exit) must stop
+    THIS host's supervisor too — re-forming half a process group would
+    wedge the survivors in a collective."""
+    exp = make_experiment(ckpt_conf(tmp_path))
+    coord = FileCoordinator(str(tmp_path / "coord"), 0, 2, timeout_s=60.0)
+
+    class HardFailPeer(PeerStub):
+        def _run(self):
+            try:
+                self.coord.generation = 0
+                self.coord.publish_flag(
+                    "preempt", {"origin": 1, "step": 0, "signal": None}
+                )
+                self.coord.exchange(
+                    "supervisor_verdict",
+                    {"outcome": "stop", "cause": "RuntimeError", "origin": 1},
+                )
+            except BaseException as e:
+                self.error = e
+
+    stub = HardFailPeer(tmp_path / "coord").start()
+    with pytest.raises(Preempted):
+        # This host's own exit was a (recoverable) Preempted; the peer's
+        # stop verdict makes it propagate instead of restarting.
+        run_with_recovery(
+            exp,
+            coordinator=coord,
+            max_restarts=2,
+            backoff_s=0.0,
+            sleep=lambda s: None,
+        )
+    stub.join()
+
+
+def test_verdict_coordinator_loss_raises_group_peer_failure(tmp_path):
+    """Losing the coordinator during the restart verdict cannot be
+    recovered locally: restarting without agreement could re-form a
+    partial group."""
+    exp = make_experiment(ckpt_conf(tmp_path))
+    coord = FileCoordinator(str(tmp_path / "coord"), 0, 2, timeout_s=60.0)
+    # The peer only ORIGINATES the drain; it never exchanges, so the
+    # experiment's verdict exchange is the one (deterministic) consumer
+    # of the injected one-shot loss — FaultPlan is process-local, and a
+    # stub exchange on another thread would race it away.
+    peer = FileCoordinator(str(tmp_path / "coord"), 1, 2)
+    peer.publish_flag("preempt", {"origin": 1, "step": 0, "signal": None})
+    # The loss fires inside the supervisor's verdict exchange (the
+    # boundary drain polls flags without exchanging).
+    with faults.injected(FaultPlan(coordinator_loss=1)):
+        with pytest.raises(GroupPeerFailure):
+            run_with_recovery(
+                exp,
+                coordinator=coord,
+                max_restarts=1,
+                backoff_s=0.0,
+                sleep=lambda s: None,
+                group_timeout_s=5.0,
+            )
+
+
+def test_single_process_coordinator_is_inert(tmp_path):
+    """A coordinator spanning ONE process must leave the supervised run
+    byte-identical to the plain path (the degrade contract)."""
+    from zookeeper_tpu.resilience import NullCoordinator
+
+    oracle = make_experiment()
+    oracle.run()
+    exp = make_experiment()
+    result = run_with_recovery(exp, coordinator=NullCoordinator())
+    assert result.restarts == 0
+    for w, g in zip(final_params(oracle), final_params(exp)):
+        np.testing.assert_array_equal(w, g)

@@ -1,0 +1,75 @@
+"""The multi-process chaos leg (docs/DESIGN.md §19): two REAL OS
+processes form a jax CPU cluster (gloo collectives) and walk the
+multi-host fault-tolerance contracts end to end —
+
+- per-host sharded checkpointing: a committed step round-trips
+  bit-exactly (a leaf genuinely sharded across the process boundary
+  included), and a ``fail_host_finalize`` step is never restored by ANY
+  process (commit record absent ⇒ invisible);
+- coordinated group recovery: ``kill_process_at_step`` on host 1
+  mid-epoch under ``unroll > 1`` drains and saves every host at one
+  agreed boundary, both supervisors restart together, restore agrees on
+  the step, and the final params are BIT-IDENTICAL to an uninterrupted
+  run.
+
+The cluster spins up once (module-scoped — it costs tens of seconds,
+hence slow-marked; CI runs this file in its own step) via the same
+``zookeeper_tpu.testing`` worker ``__graft_entry__.dryrun_multiprocess``
+drives, so the test and the dryrun cannot drift.
+"""
+
+import pytest
+
+from zookeeper_tpu.testing import spawn_group_chaos_cluster
+
+pytestmark = [pytest.mark.slow, pytest.mark.chaos]
+
+NUM_PROCESSES = 2
+
+
+@pytest.fixture(scope="module")
+def cluster_results(tmp_path_factory):
+    workdir = str(tmp_path_factory.mktemp("group_chaos"))
+    results = spawn_group_chaos_cluster(workdir, NUM_PROCESSES)
+    assert len(results) == NUM_PROCESSES
+    for r in results:
+        assert r["ok"], r
+    return results
+
+
+def test_sharded_commit_round_trip(cluster_results):
+    """A step every host finalized gets a commit record and restores
+    exactly on both hosts — including the leaf sharded ACROSS the
+    process boundary (each host wrote and read only its half)."""
+    for r in cluster_results:
+        assert r["sharded_latest_committed"] == 1
+        assert r["restored_step"] == 1
+        assert r["restored_shards_exact"]
+        assert r["w_cross_process"]
+
+
+def test_torn_host_finalize_invisible_to_every_process(cluster_results):
+    """The acceptance-criteria leg: a step whose finalize died on ONE
+    host has no commit record, so NO process ever restores it — both
+    hosts walk back to the previous committed step."""
+    for r in cluster_results:
+        assert not r["torn_step_saved"]
+        assert r["latest_after_torn"] == 1
+        assert r["restored_step"] == 1
+
+
+def test_group_recovery_bit_identical(cluster_results):
+    """kill_process_at_step={1: 3} mid-epoch under unroll=2: the kill
+    on host 1 propagates through the group drain, both hosts save the
+    agreed boundary, restart together, restore the same step, and
+    finish with params bit-identical to the uninterrupted oracle —
+    on every host."""
+    digests = set()
+    for r in cluster_results:
+        assert r["restarts"] == 1
+        assert r["bit_identical"]
+        digests.add(r["oracle_digest"])
+        digests.add(r["chaos_digest"])
+    # One byte stream across both runs AND both hosts.
+    assert len(digests) == 1
+    assert cluster_results[0]["group_restore_ms"] is not None

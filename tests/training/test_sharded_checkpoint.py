@@ -1,0 +1,383 @@
+"""Per-host sharded checkpointing (docs/DESIGN.md §19), driven by TWO
+Checkpointer instances with injected ``process_index``/``process_count``
+sharing one directory — the protocol (finalize markers, commit record,
+restore agreement, retention) is pure filesystem + numpy, so the
+simulated pair walks the real code byte-for-byte; the genuinely
+cross-process leg lives in tests/resilience/test_multiprocess_chaos.py.
+"""
+
+import json
+import os
+import shutil
+import threading
+
+import numpy as np
+import pytest
+
+from zookeeper_tpu.core import configure
+from zookeeper_tpu.resilience import FaultPlan, faults
+from zookeeper_tpu.training import Checkpointer, TrainState
+
+pytestmark = pytest.mark.chaos
+
+
+def tiny_state(value: float, step: int):
+    import jax.numpy as jnp
+    import optax
+
+    state = TrainState.create(
+        apply_fn=lambda *a, **k: None,
+        params={
+            "w": jnp.full((4, 2), value, jnp.float32),
+            "b": jnp.asarray(value, jnp.bfloat16),
+        },
+        model_state={},
+        tx=optax.sgd(0.1),
+    )
+    return state.replace(step=jnp.asarray(step))
+
+
+def host_pair(tmp_path, **extra):
+    """Two Checkpointers impersonating hosts 0/1 of one group."""
+    cks = []
+    for pid in range(2):
+        ck = Checkpointer()
+        configure(
+            ck,
+            {
+                "directory": str(tmp_path / "ckpt"),
+                "sharded_per_host": True,
+                "synchronous": True,
+                "save_every_epochs": 0,
+                "process_index": pid,
+                "process_count": 2,
+                "host_commit_timeout_s": 2.0,
+                **extra,
+            },
+            name=f"ck_host{pid}",
+        )
+        cks.append(ck)
+    return cks
+
+
+def group_save(cks, state, step):
+    """Save on both hosts: host 1 first so host 0's commit wait finds
+    the marker immediately (the real group saves concurrently)."""
+    ok1 = cks[1].save(state, step=step)
+    ok0 = cks[0].save(state, step=step)
+    return ok0, ok1
+
+
+def group_restore(cks, target_factory):
+    """Concurrent restore on both hosts (the agreement exchanges
+    rendezvous); returns {pid: restored_state}."""
+    out = {}
+
+    def run(pid):
+        out[pid] = cks[pid].restore_state(target_factory())
+
+    t = threading.Thread(target=run, args=(1,))
+    t.start()
+    run(0)
+    t.join()
+    return out
+
+
+def assert_state(restored, value, step):
+    import jax.numpy as jnp
+
+    assert int(np.asarray(restored.step)) == step
+    np.testing.assert_array_equal(
+        np.asarray(restored.params["w"]),
+        np.full((4, 2), value, np.float32),
+    )
+    b = np.asarray(restored.params["b"])
+    assert b.dtype == jnp.bfloat16  # raw-bytes storage: dtype survives
+    assert float(b) == value
+
+
+# -- commit protocol ------------------------------------------------------
+
+
+def test_commit_and_round_trip_both_hosts(tmp_path):
+    cks = host_pair(tmp_path)
+    ok0, ok1 = group_save(cks, tiny_state(3.0, 7), 7)
+    assert ok0 and ok1
+    root = tmp_path / "ckpt" / "7.zkhost"
+    assert (root / "host_00000" / "data.npz").is_file()
+    assert (root / "host_00001" / "data.npz").is_file()
+    commit = json.loads((root / "COMMIT.json").read_text())
+    assert commit["step"] == 7 and commit["process_count"] == 2
+    assert cks[0].latest_step() == 7 and cks[1].latest_step() == 7
+    out = group_restore(cks, lambda: tiny_state(0.0, 0))
+    for pid in (0, 1):
+        assert_state(out[pid], 3.0, 7)
+
+
+def test_torn_host_finalize_is_invisible_to_every_host(tmp_path):
+    """fail_host_finalize: host 1 dies between shard write and rename —
+    no marker, no commit record, the step never existed; both hosts
+    restore the previous committed step (the acceptance-criteria
+    invariant)."""
+    cks = host_pair(tmp_path)
+    assert all(group_save(cks, tiny_state(1.0, 1), 1))
+    with faults.injected(FaultPlan(fail_host_finalize=1)):
+        assert not cks[1].save(tiny_state(2.0, 2), step=2)
+        assert not cks[0].save(tiny_state(2.0, 2), step=2)  # commit wait
+    step_root = tmp_path / "ckpt" / "2.zkhost"
+    assert not (step_root / "COMMIT.json").exists()
+    assert not (step_root / "host_00001").exists()  # torn tmp only
+    assert cks[0].latest_step() == 1 and cks[1].latest_step() == 1
+    out = group_restore(cks, lambda: tiny_state(0.0, 0))
+    for pid in (0, 1):
+        assert_state(out[pid], 1.0, 1)
+
+
+def test_gc_race_per_host_walk_falls_through(tmp_path, caplog):
+    """The PR 6 GC-race leg, per-host flavor: a step whose commit
+    record exists but whose host data was GC'd between listing and
+    open falls through with a warning on BOTH hosts and the earlier
+    committed step restores."""
+    import logging
+
+    cks = host_pair(tmp_path)
+    assert all(group_save(cks, tiny_state(1.0, 1), 1))
+    assert all(group_save(cks, tiny_state(2.0, 2), 2))
+    # GC tears step 2's host data AFTER commit (the commit record
+    # survives the race — exactly the torn-after-commit shape).
+    shutil.rmtree(tmp_path / "ckpt" / "2.zkhost" / "host_00001")
+    with caplog.at_level(logging.WARNING):
+        out = group_restore(cks, lambda: tiny_state(0.0, 0))
+    for pid in (0, 1):
+        assert_state(out[pid], 1.0, 1)
+    assert any(
+        "falling back to an earlier step" in r.getMessage()
+        or "torn on a peer host" in r.getMessage()
+        for r in caplog.records
+    )
+
+
+def test_peer_torn_step_skipped_by_healthy_host(tmp_path, caplog):
+    """A step restorable HERE but torn on the peer is skipped on every
+    host — the group must agree on one step."""
+    import logging
+
+    cks = host_pair(tmp_path)
+    assert all(group_save(cks, tiny_state(1.0, 1), 1))
+    assert all(group_save(cks, tiny_state(2.0, 2), 2))
+    # Tear ONLY host 1's half of step 2; host 0's half stays valid —
+    # but validation covers every recorded host dir, so both skip.
+    os.unlink(tmp_path / "ckpt" / "2.zkhost" / "host_00001" / "data.npz")
+    with caplog.at_level(logging.WARNING):
+        out = group_restore(cks, lambda: tiny_state(0.0, 0))
+    for pid in (0, 1):
+        assert_state(out[pid], 1.0, 1)
+
+
+def test_retention_prunes_committed_steps(tmp_path):
+    cks = host_pair(tmp_path, max_to_keep=2)
+    for step in (1, 2, 3):
+        assert all(group_save(cks, tiny_state(float(step), step), step))
+    names = sorted(
+        n for n in os.listdir(tmp_path / "ckpt") if n.endswith(".zkhost")
+    )
+    assert names == ["2.zkhost", "3.zkhost"]
+
+
+def test_durable_tier_promotion_and_fallback(tmp_path):
+    """Committed steps promote (whole step dir, commit included) on the
+    progress cadence; a host that lost the ENTIRE local tier still
+    restores from the durable copy — and the group agrees on it."""
+    cks = host_pair(tmp_path, durable_every_steps=2)
+    assert all(group_save(cks, tiny_state(1.0, 1), 1))  # first promotes
+    assert all(group_save(cks, tiny_state(2.0, 2), 2))  # < 2 steps: no
+    assert all(group_save(cks, tiny_state(3.0, 3), 3))  # promotes
+    droot = tmp_path / "ckpt" / "durable"
+    assert sorted(
+        n for n in os.listdir(droot) if n.endswith(".zkhost")
+    ) == ["1.zkhost", "3.zkhost"]
+    assert json.loads(
+        (droot / "3.zkhost" / "COMMIT.json").read_text()
+    )["step"] == 3
+    # Lose the whole local tier (both sharded steps).
+    for name in ("1.zkhost", "2.zkhost", "3.zkhost"):
+        shutil.rmtree(tmp_path / "ckpt" / name)
+    out = group_restore(cks, lambda: tiny_state(0.0, 0))
+    for pid in (0, 1):
+        assert_state(out[pid], 3.0, 3)
+
+
+def test_async_mode_sharded_save_lands_commit(tmp_path):
+    cks = host_pair(tmp_path, mode="async")
+    state = tiny_state(5.0, 4)
+    assert cks[1].save(state, step=4)  # accepted by the writer
+    cks[1].wait()
+    assert cks[0].save(state, step=4)
+    cks[0].wait()
+    assert (tmp_path / "ckpt" / "4.zkhost" / "COMMIT.json").is_file()
+    out = group_restore(cks, lambda: tiny_state(0.0, 0))
+    for pid in (0, 1):
+        assert_state(out[pid], 5.0, 4)
+    for ck in cks:
+        ck.close()
+
+
+def test_coordinator_loss_degrades_to_local_walk(tmp_path, caplog):
+    """A coordinator lost mid-agreement degrades the walk to a loud
+    local decision instead of hanging or crashing."""
+    import logging
+
+    cks = host_pair(tmp_path)
+    assert all(group_save(cks, tiny_state(1.0, 9), 9))
+    with caplog.at_level(logging.WARNING):
+        with faults.injected(FaultPlan(coordinator_loss=1)):
+            restored = cks[0].restore_state(tiny_state(0.0, 0))
+    assert_state(restored, 1.0, 9)
+    assert any(
+        "restore agreement" in r.message for r in caplog.records
+    )
+
+
+# -- degrade + compatibility ---------------------------------------------
+
+
+def test_process_count_one_degrades_to_orbax_layout(tmp_path):
+    """sharded_per_host at process_count==1 keeps the EXISTING on-disk
+    layout byte-for-byte: bare orbax step dirs, no .zkhost anywhere,
+    and restore_state reads it unchanged."""
+    ck = Checkpointer()
+    configure(
+        ck,
+        {
+            "directory": str(tmp_path / "ckpt"),
+            "sharded_per_host": True,
+            "synchronous": True,
+            "save_every_epochs": 0,
+            "process_index": 0,
+            "process_count": 1,
+        },
+        name="ck_single",
+    )
+    assert ck.save(tiny_state(2.0, 3), step=3)
+    names = os.listdir(tmp_path / "ckpt")
+    assert "3" in names
+    assert not any(n.endswith(".zkhost") for n in names)
+    assert_state(ck.restore_state(tiny_state(0.0, 0)), 2.0, 3)
+    ck.close()
+
+
+def test_old_orbax_checkpoints_walked_alongside_sharded(tmp_path):
+    """A directory holding BOTH layouts (a run that enabled the mode
+    mid-history) restores the newest step regardless of layout."""
+    single = Checkpointer()
+    configure(
+        single,
+        {
+            "directory": str(tmp_path / "ckpt"),
+            "synchronous": True,
+            "save_every_epochs": 0,
+        },
+        name="ck_old",
+    )
+    assert single.save(tiny_state(1.0, 1), step=1)
+    single.close()
+    cks = host_pair(tmp_path)
+    assert all(group_save(cks, tiny_state(2.0, 2), 2))
+    assert cks[0].latest_step() == 2
+    out = group_restore(cks, lambda: tiny_state(0.0, 0))
+    for pid in (0, 1):
+        assert_state(out[pid], 2.0, 2)
+    # Tear the sharded step entirely: the walk falls back to the OLD
+    # orbax checkpoint (still readable through the same Checkpointer).
+    shutil.rmtree(tmp_path / "ckpt" / "2.zkhost")
+    out = group_restore(cks, lambda: tiny_state(0.0, 0))
+    for pid in (0, 1):
+        assert_state(out[pid], 1.0, 1)
+
+
+def test_single_process_can_read_group_checkpoint(tmp_path):
+    """Post-mortem inspection: one process (count==1) restores a
+    2-host group's checkpoint by reading every host's shard files."""
+    cks = host_pair(tmp_path)
+    assert all(group_save(cks, tiny_state(6.0, 5), 5))
+    reader = Checkpointer()
+    configure(
+        reader,
+        {
+            "directory": str(tmp_path / "ckpt"),
+            "sharded_per_host": True,
+            "synchronous": True,
+            "save_every_epochs": 0,
+            "process_index": 0,
+            "process_count": 1,
+        },
+        name="ck_reader",
+    )
+    assert_state(reader.restore_state(tiny_state(0.0, 0)), 6.0, 5)
+
+
+def test_sharded_rejects_keep_best_metric(tmp_path):
+    ck = Checkpointer()
+    configure(
+        ck,
+        {
+            "directory": str(tmp_path / "ckpt"),
+            "sharded_per_host": True,
+            "keep_best_metric": "accuracy",
+        },
+        name="ck_bad",
+    )
+    with pytest.raises(ValueError, match="sharded_per_host is incompat"):
+        ck._validate_mode()
+
+
+def test_structure_mismatch_raises_clear_error(tmp_path):
+    """A differently-shaped target fails the walk with the structure
+    message, not a silent partial restore."""
+    import jax.numpy as jnp
+    import optax
+
+    cks = host_pair(tmp_path)
+    assert all(group_save(cks, tiny_state(1.0, 1), 1))
+
+    def wrong_target():
+        state = TrainState.create(
+            apply_fn=lambda *a, **k: None,
+            params={"w": jnp.zeros((8, 2), jnp.float32)},
+            model_state={},
+            tx=optax.sgd(0.1),
+        )
+        return state.replace(step=jnp.asarray(0))
+
+    errors = {}
+
+    def run(pid):
+        try:
+            cks[pid].restore_state(wrong_target())
+        except ValueError as e:
+            errors[pid] = str(e)
+
+    t = threading.Thread(target=run, args=(1,))
+    t.start()
+    run(0)
+    t.join()
+    assert "None of the 1 retained" in errors[0]
+    assert "None of the 1 retained" in errors[1]
+
+
+def test_stale_uncommitted_host_dir_rewritten_not_sealed(tmp_path):
+    """A host dir left by a previous incarnation's UNCOMMITTED save of
+    the same step must be rewritten, not sealed under a fresh commit —
+    mixing shard bytes from two runs would be a silent frankenstate."""
+    cks = host_pair(tmp_path)
+    with faults.injected(FaultPlan(fail_host_finalize=1)):
+        # Old incarnation: host 0 finalized step 2, host 1 died, no
+        # commit — step 2 is (correctly) invisible.
+        assert not cks[1].save(tiny_state(1.0, 2), step=2)
+        assert not cks[0].save(tiny_state(1.0, 2), step=2)
+    # New incarnation reaches step 2 again with DIFFERENT bytes.
+    cks2 = host_pair(tmp_path)
+    assert all(group_save(cks2, tiny_state(9.0, 2), 2))
+    out = group_restore(cks2, lambda: tiny_state(0.0, 0))
+    for pid in (0, 1):
+        assert_state(out[pid], 9.0, 2)  # host 0's half rewritten too

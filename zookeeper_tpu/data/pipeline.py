@@ -76,6 +76,16 @@ def batch_iterator(
     mid-epoch-resume hook (a step-granular checkpoint restores at
     ``step % steps_per_epoch == k``).
     """
+    if host_count < 1 or not 0 <= host_index < host_count:
+        # A mis-wired host identity (a stale process_id env, a bad
+        # test injection) would silently read the WRONG slice — or no
+        # slice at all — of every global batch; per-host disjointness
+        # is the multi-host determinism contract, so fail loudly.
+        raise ValueError(
+            f"host_index={host_index} outside [0, host_count="
+            f"{host_count}): every host must own exactly one slice of "
+            "the global batch."
+        )
     n = len(source)
     global_batch = batch_size * host_count
     # Multi-host pods MUST drop the final partial global batch: a batch

@@ -27,6 +27,7 @@ from zookeeper_tpu.parallel.sequence import SequenceParallelPartitioner
 from zookeeper_tpu.parallel.distributed import (
     DistributedRuntime,
     initialize_distributed,
+    is_distributed_initialized,
 )
 from zookeeper_tpu.parallel.sharding import (
     activation_sharding_scope,
@@ -47,6 +48,7 @@ __all__ = [
     "SingleDevicePartitioner",
     "conv_model_tp_rules",
     "initialize_distributed",
+    "is_distributed_initialized",
     "match_partition_rules",
     "transformer_tp_rules",
 ]

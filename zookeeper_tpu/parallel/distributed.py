@@ -18,6 +18,59 @@ from typing import Optional
 from zookeeper_tpu.core import Field, component
 
 
+def is_distributed_initialized() -> bool:
+    """Whether the JAX distributed runtime is already up.
+
+    Prefers the PUBLIC ``jax.distributed.is_initialized()`` (added in
+    recent jax); falls back to probing the private
+    ``jax._src.distributed.global_state`` only when the public API is
+    absent — the private module layout is version-fragile and must not
+    be the first thing this code reaches for."""
+    import jax
+
+    probe = getattr(jax.distributed, "is_initialized", None)
+    if probe is not None:
+        try:
+            return bool(probe())
+        except Exception:  # pragma: no cover - defensive, API churn
+            pass
+    state = getattr(
+        getattr(jax, "_src", None), "distributed", None
+    )
+    state = getattr(state, "global_state", None)
+    return state is not None and getattr(state, "client", None) is not None
+
+
+def _enable_cpu_collectives() -> None:
+    """Select the gloo collectives implementation for the CPU backend
+    before it is instantiated: without it, current jax rejects every
+    cross-process computation on CPU clusters ("Multiprocess
+    computations aren't implemented on the CPU backend") — the local
+    N-process dryrun/chaos legs and any gloo-backed CPU cluster need
+    it. Only applies when the CPU platform was explicitly requested
+    (``JAX_PLATFORMS=cpu`` / config), and quietly no-ops on jax
+    versions without the option."""
+    import os
+
+    import jax
+
+    platforms = (
+        str(getattr(jax.config, "jax_platforms", None) or "")
+        or os.environ.get("JAX_PLATFORMS", "")
+    )
+    if "cpu" not in platforms.lower():
+        return
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:  # pragma: no cover - option absent/renamed
+        import logging
+
+        logging.getLogger(__name__).debug(
+            "jax_cpu_collectives_implementation unavailable; CPU "
+            "cross-process collectives may be unsupported"
+        )
+
+
 def initialize_distributed(
     coordinator_address: Optional[str] = None,
     num_processes: Optional[int] = None,
@@ -28,12 +81,31 @@ def initialize_distributed(
     With no arguments, relies on the TPU environment's auto-detection
     (GCE metadata / megascale env), which is the normal path on Cloud TPU
     pods. No-op when already initialized or when running single-process.
+
+    ``num_processes``/``process_id`` describe a MANUALLY-specified
+    cluster and are meaningless without the coordinator every process
+    rendezvouses at — passing them alone would silently fall into
+    auto-detection with the explicit topology ignored, so that is a
+    loud config error instead.
     """
     import jax
 
-    state = getattr(jax._src.distributed, "global_state", None)
-    if state is not None and getattr(state, "client", None) is not None:
+    if (
+        num_processes is not None or process_id is not None
+    ) and coordinator_address is None:
+        raise ValueError(
+            "num_processes/process_id were given without a "
+            "coordinator_address: an explicit cluster topology needs "
+            "the coordinator every process rendezvouses at (e.g. "
+            "runtime.coordinator_address=10.0.0.1:8476). On TPU pods, "
+            "pass NONE of the three and let auto-detection run."
+        )
+    if is_distributed_initialized():
         return  # Already initialized.
+    if coordinator_address is not None:
+        # Only when actually forming a cluster: gloo with NO
+        # distributed client breaks single-process CPU backend init.
+        _enable_cpu_collectives()
     kwargs = {}
     if coordinator_address is not None:
         kwargs["coordinator_address"] = coordinator_address

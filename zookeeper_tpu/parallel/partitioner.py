@@ -59,6 +59,18 @@ class Partitioner:
     def mesh(self) -> Optional[Mesh]:
         return None
 
+    def process_span(self) -> int:
+        """How many DISTINCT JAX processes this partitioner's mesh
+        spans (1 = meshless or single-host). The resilience stack keys
+        on it: per-host sharded checkpointing and group recovery only
+        engage when state/collectives actually cross a process
+        boundary, and the multi-process dryrun asserts its mesh spans
+        the whole group."""
+        mesh = self.mesh
+        if mesh is None:
+            return 1
+        return len({d.process_index for d in mesh.devices.flat})
+
     def prepare_model(self, model: Any) -> None:
         """Hook called before ``model.build()`` (the experiment does it
         in ``build_state``): a partitioner that owns part of the MODEL
@@ -335,6 +347,21 @@ class MeshPartitioner(Partitioner):
 
     def shard_state(self, state: Any) -> Any:
         sharding = self.state_sharding(state)
+        if self.process_span() > 1:
+            # Cross-process mesh: device_put of a host-local value onto
+            # a non-addressable sharding asserts value equality via a
+            # collective broadcast — unsupported on CPU clusters and
+            # wasted work on pods. Every process initialized the SAME
+            # state (same seed — the determinism contract), so each
+            # assembles the global array from its own local copy
+            # instead, shard by addressable shard.
+            def place(x, s):
+                arr = np.asarray(x)
+                return jax.make_array_from_callback(
+                    arr.shape, s, lambda idx: arr[idx]
+                )
+
+            return jax.tree.map(place, state, sharding)
         return jax.tree.map(
             lambda x, s: jax.device_put(x, s),
             state,

@@ -15,8 +15,13 @@ one of those a *recoverable, tested* event instead of a lost run:
   restore latency.
 - :class:`FaultPlan` (``faults.py``): deterministic, process-local
   fault injection (kill at step N, corrupt a checkpoint, fail a save,
-  NaN a step, crash the serving worker) driving the chaos test suite —
-  every recovery leg is walked bit-exactly in tier-1, not just claimed.
+  NaN a step, crash the serving worker, kill/ tear a specific HOST of
+  a process group) driving the chaos test suite — every recovery leg
+  is walked bit-exactly in tier-1, not just claimed.
+- :class:`FileCoordinator` (``coordination.py``): the shared-directory
+  flag/exchange primitive the multi-host protocols ride — group
+  preemption drains, supervisor restart verdicts, and the per-host
+  checkpoint restore agreement (docs/DESIGN.md §19).
 
 Crash-consistent restore (fallback to the newest VALID retained step)
 and retrying saves live in ``training.checkpoint.Checkpointer``;
@@ -26,6 +31,12 @@ non-finite-loss policies in ``training.step.make_train_step``
 model tying them together.
 """
 
+from zookeeper_tpu.resilience.coordination import (
+    CoordinatorLostError,
+    FileCoordinator,
+    HostCoordinator,
+    NullCoordinator,
+)
 from zookeeper_tpu.resilience.faults import (
     FaultPlan,
     InjectedFault,
@@ -36,15 +47,21 @@ from zookeeper_tpu.resilience.faults import (
 from zookeeper_tpu.resilience.guard import PreemptionGuard
 from zookeeper_tpu.resilience.supervisor import (
     RECOVERABLE,
+    GroupPeerFailure,
     RecoveryResult,
     measure_recovery_restore_ms,
     run_with_recovery,
 )
 
 __all__ = [
+    "CoordinatorLostError",
     "FaultPlan",
+    "FileCoordinator",
+    "GroupPeerFailure",
+    "HostCoordinator",
     "InjectedFault",
     "NonFiniteLossError",
+    "NullCoordinator",
     "Preempted",
     "PreemptionGuard",
     "RECOVERABLE",

@@ -1,0 +1,297 @@
+"""Cross-host coordination for the multi-process resilience stack.
+
+The group-recovery and per-host-checkpoint protocols (docs/DESIGN.md
+§19) need two tiny primitives that work BETWEEN processes of one
+training job, without assuming the jax collective runtime is healthy
+(it is exactly the thing that may be mid-failure):
+
+- **flag publish/poll** — a non-blocking "host i wants to stop" signal
+  every host can see at its next step/slab boundary (the coordinated
+  preemption drain), and
+- **exchange** — a small-value allgather with a deadline (restore-step
+  agreement, supervisor restart verdicts, stop-step rendezvous).
+
+:class:`FileCoordinator` implements both over a SHARED DIRECTORY
+(the same shared storage the checkpoint root already lives on for the
+per-host commit protocol): every write is temp-file → atomic rename,
+every read tolerates missing/partial peers, and every round is
+namespaced by ``(generation, key, sequence)`` so a restarted group
+attempt can never consume a previous attempt's stale files. No
+collectives, no sockets, no extra deps — a host that died simply never
+produces its file and the peers time out with
+:class:`CoordinatorLostError` instead of hanging in a collective.
+
+:class:`NullCoordinator` is the single-process no-op every API
+degrades to, so wiring a coordinator unconditionally costs nothing at
+``process_count == 1``.
+
+Determinism contract: both primitives carry only small JSON payloads
+keyed by LOGICAL coordinates (step numbers, attempt indices, process
+ids) — never wall-clock — so a chaos test driving two processes under
+one :class:`~zookeeper_tpu.resilience.faults.FaultPlan` replays the
+same protocol rounds every run. The plan's ``coordinator_loss`` knob
+makes the next ``exchange`` raise :class:`CoordinatorLostError`
+deterministically, which is how the coordinator-loss recovery legs are
+walked in tests.
+"""
+
+import json
+import logging
+import os
+import re
+import tempfile
+import time
+from typing import Any, Dict, List, Optional
+
+logger = logging.getLogger(__name__)
+
+__all__ = [
+    "CoordinatorLostError",
+    "FileCoordinator",
+    "HostCoordinator",
+    "NullCoordinator",
+]
+
+
+class CoordinatorLostError(RuntimeError):
+    """A cross-host round did not complete: a peer never produced its
+    half before the deadline (host death, coordinator loss, partitioned
+    shared storage) or the loss was injected
+    (``FaultPlan.coordinator_loss``). Callers on a RARE path (restore
+    agreement) degrade to a loud local decision; callers on a
+    MUST-AGREE path (supervisor restart verdicts) propagate — restarting
+    half a process group would wedge the survivors in a collective."""
+
+
+def _safe_key(key: str) -> str:
+    """Filesystem-safe exchange key (keys carry step numbers / tiers)."""
+    return re.sub(r"[^A-Za-z0-9_.-]", "_", str(key))
+
+
+def _atomic_write_json(path: str, payload: Any) -> None:
+    """temp-file → fsync → atomic rename: a reader either sees the whole
+    document or no file — the same finalize discipline the checkpoint
+    protocol uses, so a crash mid-publish never leaves a torn round."""
+    d = os.path.dirname(path)
+    os.makedirs(d, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=d, prefix=".tmp-")
+    try:
+        with os.fdopen(fd, "w") as f:
+            json.dump(payload, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def _read_json(path: str) -> Optional[Any]:
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        # Vanished or (impossible post-rename, but belt) torn: absent.
+        return None
+
+
+class HostCoordinator:
+    """The coordination interface the resilience stack programs against.
+
+    ``process_index`` / ``process_count`` identify this host in the
+    group; ``generation`` namespaces every round (the group supervisor
+    sets it to the restart attempt, so attempt N's files can never
+    satisfy attempt N+1's rounds).
+    """
+
+    process_index: int = 0
+    process_count: int = 1
+    generation: int = 0
+
+    def exchange(
+        self, key: str, payload: Any, timeout_s: Optional[float] = None
+    ) -> List[Any]:
+        """Allgather one small JSON payload per host for round ``key``;
+        returns the payloads ordered by process index. Raises
+        :class:`CoordinatorLostError` on deadline."""
+        raise NotImplementedError
+
+    def publish_flag(self, kind: str, payload: Any) -> None:
+        """Make ``payload`` visible to every host under ``kind``
+        (idempotent per host — republish overwrites)."""
+        raise NotImplementedError
+
+    def poll_flags(self, kind: str) -> List[Any]:
+        """Non-blocking read of every host's published ``kind`` flag
+        (ordered by process index; hosts that published nothing are
+        simply absent)."""
+        raise NotImplementedError
+
+
+class NullCoordinator(HostCoordinator):
+    """Single-process degenerate coordinator: exchanges return the
+    caller's own payload, flags are a process-local dict. Lets callers
+    wire coordination unconditionally."""
+
+    def __init__(self) -> None:
+        self.process_index = 0
+        self.process_count = 1
+        self.generation = 0
+        self._flags: Dict[str, Any] = {}
+
+    def exchange(self, key, payload, timeout_s=None):
+        return [payload]
+
+    def publish_flag(self, kind, payload):
+        self._flags[str(kind)] = payload
+
+    def poll_flags(self, kind):
+        flag = self._flags.get(str(kind))
+        return [] if flag is None else [flag]
+
+
+class FileCoordinator(HostCoordinator):
+    """Shared-directory coordinator (see module docstring).
+
+    Layout under ``root``::
+
+        xchg/g<generation>/<key>/r<sequence>/host_<pid>.json
+        flags/g<generation>/<kind>/host_<pid>.json
+
+    The per-``key`` sequence counter is process-local and advances once
+    per ``exchange`` call: the protocols above are symmetric (every
+    host walks the same rounds in the same order), so counters align
+    across hosts by construction — the generation namespace catches the
+    one asymmetric case, an IN-PROCESS group restart.
+
+    A REAL restart (the whole job killed and respawned over the same
+    persistent root) resets both generation and the sequence counters,
+    so construction PURGES this host's own files from the root: once
+    every host of the new incarnation has constructed its coordinator —
+    which happens before any flag poll or exchange, behind the
+    ``jax.distributed.initialize`` rendezvous — no stale flag can
+    spuriously drain the resumed group and no stale exchange file can
+    satisfy a new round. (A dead incarnation's peers never write again,
+    so self-purge is safe by construction.)
+    """
+
+    def __init__(
+        self,
+        root: str,
+        process_index: int,
+        process_count: int,
+        *,
+        timeout_s: float = 120.0,
+        poll_interval_s: float = 0.01,
+    ) -> None:
+        if not 0 <= int(process_index) < int(process_count):
+            raise ValueError(
+                f"process_index={process_index} outside "
+                f"[0, {process_count})."
+            )
+        self.root = os.path.abspath(os.path.expanduser(root))
+        self.process_index = int(process_index)
+        self.process_count = int(process_count)
+        self.generation = 0
+        self.timeout_s = float(timeout_s)
+        self.poll_interval_s = float(poll_interval_s)
+        self._seq: Dict[str, int] = {}
+        self._purge_own_files()
+
+    def _purge_own_files(self) -> None:
+        """Remove every file THIS host wrote in a previous OS
+        incarnation (see class docstring). Own files only — peers of a
+        live group are never touched."""
+        mine = f"host_{self.process_index:05d}.json"
+        if not os.path.isdir(self.root):
+            return
+        for dirpath, _, filenames in os.walk(self.root):
+            if mine in filenames:
+                try:
+                    os.unlink(os.path.join(dirpath, mine))
+                except OSError:
+                    pass  # racing GC / already gone
+
+    # -- exchange ---------------------------------------------------------
+
+    def _round_dir(self, key: str, seq: int) -> str:
+        return os.path.join(
+            self.root,
+            "xchg",
+            f"g{int(self.generation)}",
+            _safe_key(key),
+            f"r{seq:06d}",
+        )
+
+    def exchange(self, key, payload, timeout_s=None):
+        from zookeeper_tpu.resilience import faults
+
+        plan = faults.active()
+        if plan is not None and plan.take_coordinator_loss():
+            raise CoordinatorLostError(
+                f"injected coordinator loss during exchange {key!r}"
+            )
+        seq = self._seq[key] = self._seq.get(key, 0) + 1
+        d = self._round_dir(key, seq)
+        # Envelope so a JSON-null PAYLOAD is distinguishable from a
+        # missing/torn file (exchange(key, None) must still complete).
+        _atomic_write_json(
+            os.path.join(d, f"host_{self.process_index:05d}.json"),
+            {"v": payload},
+        )
+        deadline = time.monotonic() + (
+            self.timeout_s if timeout_s is None else float(timeout_s)
+        )
+        paths = [
+            os.path.join(d, f"host_{pid:05d}.json")
+            for pid in range(self.process_count)
+        ]
+        while True:
+            docs = [_read_json(p) if os.path.exists(p) else None for p in paths]
+            if all(isinstance(doc, dict) and "v" in doc for doc in docs):
+                return [doc["v"] for doc in docs]
+            if time.monotonic() >= deadline:
+                missing = [
+                    pid for pid, doc in enumerate(docs) if doc is None
+                ]
+                raise CoordinatorLostError(
+                    f"exchange {key!r} (round {seq}, generation "
+                    f"{self.generation}) timed out waiting for host(s) "
+                    f"{missing} of {self.process_count}"
+                )
+            time.sleep(self.poll_interval_s)
+
+    # -- flags ------------------------------------------------------------
+
+    def _flag_dir(self, kind: str) -> str:
+        return os.path.join(
+            self.root, "flags", f"g{int(self.generation)}", _safe_key(kind)
+        )
+
+    def publish_flag(self, kind, payload):
+        _atomic_write_json(
+            os.path.join(
+                self._flag_dir(kind), f"host_{self.process_index:05d}.json"
+            ),
+            {"v": payload},
+        )
+
+    def poll_flags(self, kind):
+        d = self._flag_dir(kind)
+        try:
+            names = sorted(
+                n
+                for n in os.listdir(d)
+                if n.startswith("host_") and n.endswith(".json")
+            )
+        except OSError:
+            return []
+        docs = [_read_json(os.path.join(d, n)) for n in names]
+        return [
+            doc["v"]
+            for doc in docs
+            if isinstance(doc, dict) and "v" in doc
+        ]

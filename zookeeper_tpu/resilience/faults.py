@@ -34,7 +34,7 @@ import contextlib
 import os
 import threading
 from dataclasses import dataclass, field
-from typing import Iterator, Optional
+from typing import Dict, Iterator, Optional
 
 from zookeeper_tpu.observability import recorder as _recorder
 from zookeeper_tpu.observability import trace as _trace
@@ -130,6 +130,27 @@ class FaultPlan:
       silently abandoned — no retry, no error to the training thread —
       modeling the process being killed while the background writer was
       mid-save. Restore must land on the previous finalized step.
+
+    Multi-host knobs (docs/DESIGN.md §19) — keyed on LOGICAL host
+    coordinates (the jax process index), so an N-process chaos leg
+    installs the SAME plan in every process and each host fires only
+    its own faults:
+
+    - ``kill_process_at_step``: ``{process_index: step}`` — request
+      preemption on exactly that host at the first safe boundary whose
+      step counter is ``>= step`` (one-shot per plan, the multi-host
+      twin of ``kill_at_step``). Under group recovery the flag
+      propagates to a coordinated whole-group save-and-restart.
+    - ``fail_host_finalize``: the FIRST per-host sharded-checkpoint
+      finalize on this process index is dropped (the host dies between
+      writing its shards and the atomic rename): the torn temp dir
+      stays, the host marker never appears, and process 0 therefore
+      never writes the step's commit record — the step is invisible to
+      EVERY host's restore walk. One-shot, NOT retried (a dead host
+      does not retry).
+    - ``coordinator_loss``: the next N cross-host ``exchange`` rounds
+      raise ``CoordinatorLostError`` (the coordinator / shared storage
+      partitioned away mid-protocol).
     """
 
     kill_at_step: Optional[int] = None
@@ -140,6 +161,9 @@ class FaultPlan:
     decode_worker_crash: int = 0
     fail_async_finalize: int = 0
     kill_during_async_write: Optional[int] = None
+    kill_process_at_step: Optional[Dict[int, int]] = None
+    fail_host_finalize: Optional[int] = None
+    coordinator_loss: int = 0
 
     _lock: threading.Lock = field(
         default_factory=threading.Lock, repr=False, compare=False
@@ -147,21 +171,62 @@ class FaultPlan:
     _killed: bool = field(default=False, repr=False, compare=False)
     _corrupted: bool = field(default=False, repr=False, compare=False)
     _async_killed: bool = field(default=False, repr=False, compare=False)
+    _host_finalize_failed: bool = field(
+        default=False, repr=False, compare=False
+    )
 
     # -- trigger points (called by the production hooks) -----------------
 
-    def kill_due(self, step: int) -> bool:
+    def kill_due(self, step: int, process_index: int = 0) -> bool:
         """One-shot: True at the first query with ``step >=
-        kill_at_step``. Queried at safe boundaries (slab/step ends), so
-        with ``unroll > 1`` the kill lands at the end of the slab
-        containing the step — the same quantization step-cadence
-        checkpoints already have."""
-        if self.kill_at_step is None:
+        kill_at_step`` (any host), or ``step >=
+        kill_process_at_step[process_index]`` (exactly that host).
+        Queried at safe boundaries (slab/step ends), so with
+        ``unroll > 1`` the kill lands at the end of the slab containing
+        the step — the same quantization step-cadence checkpoints
+        already have."""
+        candidates = [self.kill_at_step]
+        if self.kill_process_at_step is not None:
+            candidates.append(
+                self.kill_process_at_step.get(int(process_index))
+            )
+        candidates = [c for c in candidates if c is not None]
+        if not candidates:
             return False
+        # Whichever applicable trigger comes first fires; the one-shot
+        # stays plan-wide (one kill per plan, like every other knob).
+        due_at = min(candidates)
         with self._lock:
-            if not self._killed and int(step) >= self.kill_at_step:
+            if not self._killed and int(step) >= int(due_at):
                 self._killed = True
                 _injection_event("kill_at_step", step=int(step))
+                return True
+        return False
+
+    def take_host_finalize_failure(self, process_index: int) -> bool:
+        """Consume the injected per-host finalize death when it targets
+        ``process_index`` (False otherwise / when already fired). The
+        caller DROPS the finalize — no marker, no retry — modeling the
+        host dying between shard write and atomic rename."""
+        if self.fail_host_finalize is None:
+            return False
+        with self._lock:
+            if (
+                not self._host_finalize_failed
+                and int(process_index) == int(self.fail_host_finalize)
+            ):
+                self._host_finalize_failed = True
+                _injection_event("fail_host_finalize")
+                return True
+        return False
+
+    def take_coordinator_loss(self) -> bool:
+        """Consume one injected cross-host coordinator loss (False when
+        exhausted)."""
+        with self._lock:
+            if self.coordinator_loss > 0:
+                self.coordinator_loss -= 1
+                _injection_event("coordinator_loss")
                 return True
         return False
 

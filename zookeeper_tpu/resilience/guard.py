@@ -53,6 +53,7 @@ class PreemptionGuard:
                 "prev": {},
                 "installed": False,
                 "signal": None,
+                "origin": None,
             }
             object.__setattr__(self, "_guard_state", st)
         return st
@@ -67,15 +68,29 @@ class PreemptionGuard:
         programmatic/injected preemption)."""
         return self._state()["signal"]
 
-    def request_preemption(self, signum: Optional[int] = None) -> None:
-        """Trip the flag programmatically (fault injection, tests, or
-        an external watcher thread polling a cloud preemption notice)."""
+    @property
+    def preemption_origin(self) -> Optional[int]:
+        """The PROCESS INDEX whose signal/fault originated a group
+        preemption (None for a local/single-process one) — carried into
+        the group supervisor's flight-recorder manifest so a pod-wide
+        drain names the host that started it."""
+        return self._state()["origin"]
+
+    def request_preemption(
+        self, signum: Optional[int] = None, origin: Optional[int] = None
+    ) -> None:
+        """Trip the flag programmatically (fault injection, tests, an
+        external watcher thread polling a cloud preemption notice, or
+        the group-boundary exchange relaying a PEER host's preemption —
+        ``origin`` then names that host)."""
         st = self._state()
         st["signal"] = signum
+        if origin is not None:
+            st["origin"] = int(origin)
         st["flag"].set()
         # Async-signal-safe enough: one deque append, no locks taken.
         _trace.event(
-            "preemption_requested", attrs={"signal": signum}
+            "preemption_requested", attrs={"signal": signum, "origin": origin}
         )
 
     def _signals(self) -> Sequence[int]:
@@ -90,6 +105,7 @@ class PreemptionGuard:
         st = self._state()
         st["flag"].clear()
         st["signal"] = None
+        st["origin"] = None
         if not self.enabled or st["installed"]:
             return self
 

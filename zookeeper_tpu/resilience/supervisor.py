@@ -22,13 +22,25 @@ import logging
 import signal as _signal
 import time
 from dataclasses import dataclass, field
-from typing import Any, Callable, Dict, List, Tuple, Type
+from typing import Any, Callable, Dict, List, Optional, Tuple, Type
 
 from zookeeper_tpu.observability import recorder as _recorder
 from zookeeper_tpu.observability import trace as _trace
+from zookeeper_tpu.observability.registry import default_registry
+from zookeeper_tpu.resilience.coordination import (
+    CoordinatorLostError,
+    HostCoordinator,
+)
 from zookeeper_tpu.resilience.faults import NonFiniteLossError, Preempted
 
 logger = logging.getLogger(__name__)
+
+
+class GroupPeerFailure(RuntimeError):
+    """A peer host of the process group exited unrecoverably (or the
+    coordinator was lost mid-verdict): the group cannot restart as a
+    whole, so THIS host's supervisor stops too instead of re-forming a
+    partial cluster that would wedge in its first collective."""
 
 #: Exit statuses a restart can actually fix: the state to resume from is
 #: on disk and the cause is transient (preemption) or policy-halted
@@ -75,6 +87,8 @@ def run_with_recovery(
     max_backoff_s: float = 60.0,
     recover_on: Tuple[Type[BaseException], ...] = RECOVERABLE,
     sleep: Callable[[float], None] = time.sleep,
+    coordinator: Optional[HostCoordinator] = None,
+    group_timeout_s: float = 120.0,
 ) -> RecoveryResult:
     """Run ``experiment.run()`` under a restart budget.
 
@@ -90,6 +104,18 @@ def run_with_recovery(
     ``TrainingExperiment`` does). The same experiment OBJECT is reused
     so its configured component tree (checkpoint directory above all)
     carries over.
+
+    **Process-group mode** (docs/DESIGN.md §19): pass a
+    ``coordinator`` spanning ``process_count > 1`` hosts — every host
+    of the job runs THIS function with its own coordinator instance
+    over the same shared root. The coordinator is wired into the
+    experiment's boundary check, so any host's SIGTERM / injected kill
+    drains and saves ALL hosts at one agreed step; after every exit the
+    hosts exchange a restart VERDICT (deadline ``group_timeout_s``) and
+    back off the same schedule, so the group re-forms together —
+    a bit-identical resume pinned by the multi-process chaos leg. A
+    peer that exited unrecoverably (or a lost coordinator) raises
+    :class:`GroupPeerFailure` instead of re-forming a partial cluster.
     """
     if max_restarts < 0:
         raise ValueError(f"max_restarts={max_restarts} must be >= 0.")
@@ -98,83 +124,244 @@ def run_with_recovery(
             f"backoff_s={backoff_s} must be >= 0 and "
             f"backoff_factor={backoff_factor} >= 1."
         )
+    group = (
+        coordinator is not None
+        and getattr(coordinator, "process_count", 1) > 1
+    )
+    if group:
+        experiment.group_coordinator = coordinator
     causes: List[BaseException] = []
     restore_ms: List[float] = []
     save_wait_ms: List[float] = []
-    for attempt in range(max_restarts + 1):
-        t_start = time.perf_counter()
-        try:
-            history = experiment.run()
-        except recover_on as e:
-            _record_save_wait_ms(experiment, e, save_wait_ms)
-            if (
-                isinstance(e, Preempted)
-                and e.signum == _signal.SIGINT
-            ):
-                # Ctrl-C is the OPERATOR stopping the job: restarting
-                # would make the run effectively uninterruptible. The
-                # clean-save-and-exit already happened; just stop.
-                logger.warning(
-                    "SIGINT preemption (operator stop) — not restarting: %s",
-                    e,
+    try:
+        for attempt in range(max_restarts + 1):
+            if group:
+                # Namespace this attempt's flags/exchanges: attempt N's
+                # drain files can never satisfy attempt N+1's polls.
+                coordinator.generation = attempt
+            t_start = time.perf_counter()
+            if not group:
+                try:
+                    history = experiment.run()
+                except recover_on as e:
+                    _record_save_wait_ms(experiment, e, save_wait_ms)
+                    if (
+                        isinstance(e, Preempted)
+                        and e.signum == _signal.SIGINT
+                    ):
+                        # Ctrl-C is the OPERATOR stopping the job:
+                        # restarting would make the run effectively
+                        # uninterruptible. The clean-save-and-exit
+                        # already happened; just stop.
+                        logger.warning(
+                            "SIGINT preemption (operator stop) — not "
+                            "restarting: %s",
+                            e,
+                        )
+                        raise
+                    causes.append(e)
+                    _record_restore_ms(
+                        experiment, attempt, t_start, restore_ms
+                    )
+                    if attempt >= max_restarts:
+                        logger.warning(
+                            "restart budget exhausted (%d restart(s)); "
+                            "last recoverable exit propagates: %s",
+                            max_restarts,
+                            e,
+                        )
+                        raise
+                    delay = min(
+                        max_backoff_s, backoff_s * (backoff_factor**attempt)
+                    )
+                    logger.warning(
+                        "recoverable exit (%s); restart %d/%d after "
+                        "%.1fs backoff",
+                        e,
+                        attempt + 1,
+                        max_restarts,
+                        delay,
+                    )
+                    _trace.event(
+                        "supervisor_restart",
+                        attrs={
+                            "attempt": attempt + 1,
+                            "cause": type(e).__name__,
+                            "backoff_s": delay,
+                        },
+                    )
+                    # One flight-recorder bundle per recovery
+                    # (docs/DESIGN.md §16): the state the run died in —
+                    # trace ring, metrics, ledger — captured before the
+                    # restart overwrites it. One global read when no
+                    # recorder is installed.
+                    _recorder.notify(
+                        "supervisor_restart",
+                        step=getattr(e, "step", None),
+                        attrs={
+                            "attempt": attempt + 1,
+                            "cause": type(e).__name__,
+                        },
+                    )
+                    if delay > 0:
+                        sleep(delay)
+                    continue
+                _record_restore_ms(experiment, attempt, t_start, restore_ms)
+                if attempt > 0:
+                    _trace.event(
+                        "supervisor_recovered", attrs={"restarts": attempt}
+                    )
+                return RecoveryResult(
+                    history=history,
+                    restarts=attempt,
+                    causes=causes,
+                    restore_ms=restore_ms,
+                    save_wait_ms=save_wait_ms,
                 )
-                raise
-            causes.append(e)
+
+            # -- group attempt --------------------------------------------
+            history, cause, outcome = None, None, "ok"
+            try:
+                history = experiment.run()
+            except recover_on as e:
+                cause = e
+                _record_save_wait_ms(experiment, e, save_wait_ms)
+                if isinstance(e, Preempted) and e.signum == _signal.SIGINT:
+                    # Same operator-stop policy as the single-process
+                    # path — and the 'stop' verdict stops the PEERS too.
+                    logger.warning(
+                        "SIGINT preemption (operator stop) — not "
+                        "restarting the group: %s",
+                        e,
+                    )
+                    outcome = "stop"
+                else:
+                    outcome = "recoverable"
+            except BaseException as e:
+                # A hard failure must still publish its verdict: peers
+                # are waiting in the exchange and would otherwise burn
+                # the whole deadline before learning the group is dead.
+                cause = e
+                outcome = "stop"
+            n_restore = len(restore_ms)
             _record_restore_ms(experiment, attempt, t_start, restore_ms)
+            if len(restore_ms) > n_restore:
+                default_registry().gauge(
+                    "zk_group_restore_ms",
+                    help="latest group restart -> first post-resume "
+                    "train step, ms",
+                ).set(restore_ms[-1])
+            origin = getattr(
+                getattr(experiment, "guard", None), "preemption_origin", None
+            )
+            try:
+                verdicts = coordinator.exchange(
+                    "supervisor_verdict",
+                    {
+                        "outcome": outcome,
+                        "cause": type(cause).__name__ if cause else None,
+                        "origin": origin,
+                    },
+                    timeout_s=group_timeout_s,
+                )
+            except CoordinatorLostError as ce:
+                logger.error(
+                    "group restart verdict lost (%s); not re-forming a "
+                    "partial process group",
+                    ce,
+                )
+                if cause is not None:
+                    raise GroupPeerFailure(str(ce)) from cause
+                raise GroupPeerFailure(str(ce)) from ce
+            outcomes = [v.get("outcome") for v in verdicts]
+            if "stop" in outcomes:
+                if cause is not None:
+                    raise cause
+                raise GroupPeerFailure(
+                    "peer host(s) exited unrecoverably "
+                    f"(verdicts: {outcomes}); this host's run succeeded "
+                    "but the group cannot re-form"
+                )
+            if "recoverable" not in outcomes:
+                if attempt > 0:
+                    _trace.event(
+                        "supervisor_recovered", attrs={"restarts": attempt}
+                    )
+                return RecoveryResult(
+                    history=history,
+                    restarts=attempt,
+                    causes=causes,
+                    restore_ms=restore_ms,
+                    save_wait_ms=save_wait_ms,
+                )
+            if cause is not None:
+                causes.append(cause)
             if attempt >= max_restarts:
                 logger.warning(
-                    "restart budget exhausted (%d restart(s)); last "
-                    "recoverable exit propagates: %s",
+                    "group restart budget exhausted (%d restart(s)); "
+                    "last recoverable exit propagates",
                     max_restarts,
-                    e,
                 )
-                raise
-            delay = min(
-                max_backoff_s, backoff_s * (backoff_factor**attempt)
+                if cause is not None:
+                    raise cause
+                raise GroupPeerFailure(
+                    "group restart budget exhausted while peers still "
+                    "want to restart"
+                )
+            delay = min(max_backoff_s, backoff_s * (backoff_factor**attempt))
+            origin_pid = next(
+                (
+                    v.get("origin")
+                    for v in verdicts
+                    if v.get("origin") is not None
+                ),
+                None,
+            )
+            cause_name = next(
+                (v.get("cause") for v in verdicts if v.get("cause")), None
             )
             logger.warning(
-                "recoverable exit (%s); restart %d/%d after %.1fs backoff",
-                e,
+                "group recoverable exit (origin host %s, cause %s); "
+                "synchronized restart %d/%d after %.1fs backoff",
+                origin_pid,
+                cause_name,
                 attempt + 1,
                 max_restarts,
                 delay,
             )
             _trace.event(
-                "supervisor_restart",
+                "group_restart",
                 attrs={
                     "attempt": attempt + 1,
-                    "cause": type(e).__name__,
+                    "cause": cause_name,
+                    "origin": origin_pid,
                     "backoff_s": delay,
                 },
             )
-            # One flight-recorder bundle per recovery (docs/DESIGN.md
-            # §16): the state the run died in — trace ring, metrics,
-            # ledger — captured before the restart overwrites it. One
-            # global read when no recorder is installed.
+            default_registry().counter(
+                "zk_group_restarts_total",
+                help="coordinated whole-process-group restarts",
+            ).inc()
+            # Flight-recorder bundle per GROUP recovery, with the
+            # triggering host's identity in the manifest: a pod-wide
+            # drain names the host that started it (docs/DESIGN.md
+            # §16/§19).
             _recorder.notify(
-                "supervisor_restart",
-                step=getattr(e, "step", None),
+                "group_restart",
+                step=getattr(cause, "step", None),
                 attrs={
                     "attempt": attempt + 1,
-                    "cause": type(e).__name__,
+                    "cause": cause_name,
+                    "origin": origin_pid,
+                    "process_index": coordinator.process_index,
                 },
             )
             if delay > 0:
                 sleep(delay)
-            continue
-        _record_restore_ms(experiment, attempt, t_start, restore_ms)
-        if attempt > 0:
-            _trace.event(
-                "supervisor_recovered", attrs={"restarts": attempt}
-            )
-        return RecoveryResult(
-            history=history,
-            restarts=attempt,
-            causes=causes,
-            restore_ms=restore_ms,
-            save_wait_ms=save_wait_ms,
-        )
-    raise AssertionError("unreachable")  # pragma: no cover
+        raise AssertionError("unreachable")  # pragma: no cover
+    finally:
+        if group:
+            experiment.group_coordinator = None
 
 
 def _record_save_wait_ms(

@@ -113,3 +113,294 @@ def randomize_bn_variables(
         return out
 
     return walk_params(dict(params)), walk_stats(dict(batch_stats))
+
+
+def run_group_chaos_worker(
+    process_id: int,
+    num_processes: int,
+    coordinator_address: str,
+    out_path: str,
+    workdir: str,
+) -> None:
+    """One host of the multi-process fault-tolerance chaos leg
+    (docs/DESIGN.md §19). Spawned as a real OS process by
+    ``__graft_entry__.dryrun_multiprocess`` and
+    ``tests/resilience/test_multiprocess_chaos.py`` — N of these form a
+    jax cluster and walk, with REAL process boundaries:
+
+    1. the per-host sharded checkpoint protocol: a committed step
+       round-trips bit-exactly (a genuinely cross-process-sharded leaf
+       included), and a ``fail_host_finalize`` step — one host dies
+       between shard write and finalize — is never restored by ANY
+       host (commit record absent => invisible);
+    2. coordinated group recovery: ``kill_process_at_step`` on host 1
+       mid-epoch under ``unroll > 1`` drains and saves EVERY host at
+       one agreed boundary, the group supervisors restart together,
+       restore agrees on the step, and the final params are
+       BIT-IDENTICAL to an uninterrupted run of the same config.
+
+    Writes one JSON result document; the parent asserts on it.
+    """
+    import hashlib
+    import json
+    import os
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from zookeeper_tpu.parallel import initialize_distributed
+
+    initialize_distributed(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    assert jax.process_index() == process_id
+    assert jax.process_count() == num_processes
+
+    import optax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+    from zookeeper_tpu.core import configure
+    from zookeeper_tpu.resilience import (
+        FaultPlan,
+        FileCoordinator,
+        faults,
+        run_with_recovery,
+    )
+    from zookeeper_tpu.training import (
+        Checkpointer,
+        TrainingExperiment,
+        TrainState,
+    )
+
+    results = {"process_id": process_id, "ok": False}
+
+    # -- leg 1: per-host sharded checkpoint protocol ----------------------
+    mesh = Mesh(np.asarray(jax.devices()), ("data",))
+    n_global = len(jax.devices())
+
+    def tiny_state(value: float, step: int) -> TrainState:
+        # One leaf genuinely sharded ACROSS the process boundary (each
+        # host saves only its half, assembled from process-local rows
+        # like the data pipeline's global batches) + host-local leaves.
+        full = (
+            np.arange(n_global * 4, dtype=np.float32).reshape(n_global, 4)
+            * value
+        )
+        rows = n_global // num_processes
+        w = jax.make_array_from_process_local_data(
+            NamedSharding(mesh, PartitionSpec("data", None)),
+            full[process_id * rows : (process_id + 1) * rows],
+        )
+        state = TrainState.create(
+            apply_fn=lambda *a, **k: None,
+            params={"w": w, "b": jnp.full((3,), value, jnp.float32)},
+            model_state={},
+            tx=optax.sgd(0.1),
+        )
+        return state.replace(step=jnp.asarray(step))
+
+    ck = Checkpointer()
+    configure(
+        ck,
+        {
+            "directory": os.path.join(workdir, "ckpt_proto"),
+            "sharded_per_host": True,
+            "synchronous": True,
+            "save_every_epochs": 0,
+            "host_commit_timeout_s": 10.0,
+        },
+        name="ck_proto",
+    )
+    assert ck.save(tiny_state(1.0, 1), step=1)
+    # Non-zero hosts return once THEIR half is durable; the commit
+    # record is process 0's job and lands within its save call — poll
+    # briefly so the assertion doesn't race it.
+    import time as _time
+
+    deadline = _time.monotonic() + 30
+    while ck.latest_step() != 1 and _time.monotonic() < deadline:
+        _time.sleep(0.05)
+    results["sharded_latest_committed"] = ck.latest_step()
+    with faults.injected(FaultPlan(fail_host_finalize=1)):
+        torn_saved = ck.save(tiny_state(2.0, 2), step=2)
+    # Host 1 dropped its finalize; host 0 timed out waiting — the step
+    # has no commit record, so it must be invisible to EVERY host.
+    results["torn_step_saved"] = bool(torn_saved)
+    results["latest_after_torn"] = ck.latest_step()
+    restored = ck.restore_state(tiny_state(0.0, 0))
+    results["restored_step"] = int(jax.device_get(restored.step))
+    shard_ok = True
+    for shard in restored.params["w"].addressable_shards:
+        want = (
+            np.arange(n_global * 4, dtype=np.float32).reshape(n_global, 4)
+        )[shard.index]
+        shard_ok &= np.array_equal(np.asarray(shard.data), want)
+    results["restored_shards_exact"] = bool(shard_ok)
+    results["w_cross_process"] = not restored.params[
+        "w"
+    ].is_fully_addressable
+
+    # -- leg 2: coordinated group recovery, bit-identical resume ---------
+    def build_experiment(ckpt_dir):
+        exp = TrainingExperiment()
+        conf = {
+            "loader.dataset": "SyntheticMnist",
+            "loader.dataset.num_train_examples": 64,
+            "loader.dataset.num_validation_examples": 0,
+            "loader.preprocessing": "ImageClassificationPreprocessing",
+            "loader.preprocessing.height": 28,
+            "loader.preprocessing.width": 28,
+            "loader.preprocessing.channels": 1,
+            "model": "Mlp",
+            "model.hidden_units": (8,),
+            "partitioner": "DataParallelPartitioner",
+            "batch_size": 16,
+            # 4 steps/epoch x 4 epochs: the injected kill at step 3
+            # drains the group at the deterministic stop boundary
+            # (origin boundary 4 + the drain margin 8 = step 12), and
+            # the restored group still has a real epoch to retrain —
+            # the resume path is exercised, not just the restart.
+            "epochs": 4,
+            "unroll": 2,
+            "validate": False,
+            "verbose": False,
+        }
+        if ckpt_dir is not None:
+            conf.update(
+                {
+                    "checkpointer.directory": ckpt_dir,
+                    "checkpointer.sharded_per_host": True,
+                    "checkpointer.synchronous": True,
+                    "checkpointer.save_every_epochs": 0,
+                    "checkpointer.host_commit_timeout_s": 30.0,
+                }
+            )
+        configure(exp, conf, name=f"exp_{os.path.basename(str(ckpt_dir))}")
+        return exp
+
+    def params_digest(state) -> str:
+        h = hashlib.sha256()
+        for leaf in jax.tree.leaves(state.params):
+            h.update(np.asarray(leaf.addressable_shards[0].data).tobytes())
+        return h.hexdigest()
+
+    oracle = build_experiment(None)
+    assert oracle.partitioner.process_span() == num_processes
+    oracle.run()
+    oracle_digest = params_digest(oracle.final_state)
+    results["oracle_digest"] = oracle_digest
+
+    chaos = build_experiment(os.path.join(workdir, "ckpt_chaos"))
+    coordinator = FileCoordinator(
+        os.path.join(workdir, "group_coord"),
+        process_id,
+        num_processes,
+        timeout_s=120.0,
+    )
+    with faults.injected(FaultPlan(kill_process_at_step={1: 3})):
+        recovery = run_with_recovery(
+            chaos,
+            coordinator=coordinator,
+            max_restarts=2,
+            backoff_s=0.0,
+            sleep=lambda s: None,
+        )
+    results["restarts"] = int(recovery.restarts)
+    results["chaos_digest"] = params_digest(chaos.final_state)
+    results["bit_identical"] = results["chaos_digest"] == oracle_digest
+    results["group_restore_ms"] = (
+        recovery.restore_ms[-1] if recovery.restore_ms else None
+    )
+    results["ok"] = True
+    with open(out_path, "w") as f:
+        json.dump(results, f)
+
+
+def spawn_group_chaos_cluster(workdir: str, num_processes: int = 2):
+    """Spawn ``num_processes`` OS processes running
+    :func:`run_group_chaos_worker` as one jax cluster; wait for them
+    and return the per-process result dicts. Raises with the worker's
+    log tail when any process fails — shared by the pytest leg and
+    ``__graft_entry__.dryrun_multiprocess`` so the two cannot drift."""
+    import json
+    import os
+    import socket
+    import subprocess
+    import sys
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        coordinator = f"127.0.0.1:{s.getsockname()[1]}"
+    repo_root = os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))
+    )
+    procs, out_paths = [], []
+    for pid in range(num_processes):
+        out = os.path.join(workdir, f"out_{pid}.json")
+        out_paths.append(out)
+        env = dict(os.environ)
+        env.update(
+            {
+                "JAX_PLATFORMS": "cpu",
+                "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+                "PYTHONPATH": repo_root
+                + (
+                    os.pathsep + os.environ["PYTHONPATH"]
+                    if os.environ.get("PYTHONPATH")
+                    else ""
+                ),
+                "TPU_SKIP_MDS_QUERY": "1",
+            }
+        )
+        code = (
+            "import sys; from zookeeper_tpu.testing import "
+            "run_group_chaos_worker; run_group_chaos_worker("
+            "int(sys.argv[1]), int(sys.argv[2]), sys.argv[3], "
+            "sys.argv[4], sys.argv[5])"
+        )
+        # Log to files, not pipes: a full pipe buffer on one worker
+        # while the other waits in a collective would deadlock.
+        log_path = os.path.join(workdir, f"log_{pid}.txt")
+        with open(log_path, "wb") as log_f:
+            procs.append(
+                (
+                    subprocess.Popen(
+                        [
+                            sys.executable,
+                            "-c",
+                            code,
+                            str(pid),
+                            str(num_processes),
+                            coordinator,
+                            out,
+                            workdir,
+                        ],
+                        env=env,
+                        stdout=log_f,
+                        stderr=subprocess.STDOUT,
+                    ),
+                    log_path,
+                )
+            )
+    try:
+        for p, _ in procs:
+            p.wait(timeout=600)
+    finally:
+        for p, _ in procs:
+            if p.poll() is None:
+                p.kill()
+    for p, log_path in procs:
+        with open(log_path, errors="replace") as f:
+            log = f.read()
+        if p.returncode != 0:
+            raise RuntimeError(
+                f"group chaos worker failed (rc={p.returncode}):\n"
+                + log[-4000:]
+            )
+    results = []
+    for path in out_paths:
+        with open(path) as f:
+            results.append(json.load(f))
+    return results

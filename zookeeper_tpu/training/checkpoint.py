@@ -28,19 +28,95 @@ whenever at least that many steps of progress have passed since the
 last promotion, with its own — typically unbounded — retention.
 ``restore_state`` walks both tiers newest-first, so a wiped local tier
 still resumes from the newest durable step.
+
+Per-host sharded mode (``sharded_per_host=True``, docs/DESIGN.md §19):
+on a multi-process run each process writes ONLY its addressable shards
+— raw bytes + an index manifest, through the same temp-dir →
+atomic-rename finalize discipline — into ``<dir>/<step>.zkhost/
+host_<pid>/``; the rename is the per-host finalize marker, and process
+0 writes the step-level ``COMMIT.json`` record only after EVERY host's
+marker is present. A step without a commit record does not exist to
+restore (a host that died between shard write and finalize makes the
+whole group save invisible — torn multi-host checkpoints cannot be
+half-restored by construction). ``restore_state`` extends the
+newest-first walk to "newest step finalized by every host" and, on a
+multi-process run, agrees on the restore step across hosts via the
+shared-directory coordinator — a step any host finds torn is skipped
+by all, and a host that lost its local tier pulls the group down to
+the newest durable step every host can read. At ``process_count == 1``
+the mode degrades to the EXISTING orbax protocol (same on-disk layout,
+old checkpoints restore unchanged), and a single process can still
+read a sharded checkpoint written by a group of the same topology.
 """
 
+import json
 import logging
 import os
 import random
+import shutil
 import threading
 import time
 from typing import Any, List, Optional, Tuple
 
 from zookeeper_tpu.core import Field, component
 from zookeeper_tpu.observability import trace as _trace
+from zookeeper_tpu.observability.registry import default_registry
 
 logger = logging.getLogger(__name__)
+
+#: Payload marker for a per-host shard tree extracted on the training
+#: thread (the sharded mode's analogue of ``host_snapshot`` output) —
+#: ``_write_state`` routes it to the per-host protocol.
+_HOST_SHARD_KIND = "zkhost-shards-v1"
+
+#: Suffix of a per-host sharded step directory (``<step>.zkhost``) —
+#: NOT a bare step number, so orbax's ``all_steps()`` and
+#: ``finalized_steps()`` never list it and the two layouts coexist in
+#: one directory.
+_HOST_STEP_SUFFIX = ".zkhost"
+
+#: Walk order among tiers holding the SAME step: sharded-local first
+#: (this host reads only its own shard files), then the orbax local
+#: tier, then the two durable fallbacks.
+_TIER_PRIORITY = {"hosts": 3, "local": 2, "hosts-durable": 1, "durable": 0}
+
+
+def _normalize_index(index, shape) -> List[List[int]]:
+    """A shard's global index (tuple of slices) as concrete
+    ``[[start, stop], ...]`` bounds — the JSON-stable key the manifest
+    stores and restore matches on."""
+    out = []
+    for sl, dim in zip(index, shape):
+        start = 0 if sl.start is None else int(sl.start)
+        stop = int(dim) if sl.stop is None else int(sl.stop)
+        out.append([start, stop])
+    return out
+
+
+def _index_token(norm_index) -> Tuple[Tuple[int, int], ...]:
+    return tuple((int(a), int(b)) for a, b in norm_index)
+
+
+def _sharded_step_dirs(root: str) -> List[Tuple[int, str]]:
+    """COMMITTED per-host sharded steps under ``root``, newest first,
+    as ``(step, step_dir)``. Uncommitted step dirs (crash before every
+    host finalized) are invisible — the crash-consistency argument in
+    one line."""
+    try:
+        names = os.listdir(root)
+    except OSError:
+        return []
+    out = []
+    for name in names:
+        if not name.endswith(_HOST_STEP_SUFFIX):
+            continue
+        stem = name[: -len(_HOST_STEP_SUFFIX)]
+        if not stem.isdigit():
+            continue
+        path = os.path.join(root, name)
+        if os.path.isfile(os.path.join(path, "COMMIT.json")):
+            out.append((int(stem), path))
+    return sorted(out, reverse=True)
 
 
 def _state_pytree(state) -> dict:
@@ -137,6 +213,29 @@ class Checkpointer:
     #: Durable-tier retention (0 = keep everything — the archival
     #: default).
     durable_max_to_keep: int = Field(0)
+    #: Per-host sharded checkpointing (docs/DESIGN.md §19): on a
+    #: multi-process run each process writes only its addressable
+    #: shards (temp-dir → atomic-rename per-host finalize), and process
+    #: 0 writes the step's commit record only after EVERY host
+    #: finalized — a step any host failed to finalize is invisible to
+    #: restore on every host. Requires the checkpoint directory to be
+    #: shared storage every host can read/write (GCS/NFS — the same
+    #: requirement the commit record itself has). At ``process_count ==
+    #: 1`` this degrades to the existing single-writer orbax protocol:
+    #: same on-disk layout, old checkpoints restore unchanged.
+    sharded_per_host: bool = Field(False)
+    #: This host's identity in the group (-1 = ``jax.process_index()``
+    #: / ``jax.process_count()``); injectable so tests drive the
+    #: per-host protocol without a real cluster, like the DataLoader's
+    #: ``host_index``/``host_count``.
+    process_index: int = Field(-1)
+    process_count: int = Field(-1)
+    #: How long process 0 waits for every host's finalize marker before
+    #: giving up on the step's commit record (the step then simply
+    #: never becomes restorable — the previous committed step is the
+    #: resume point). Also the deadline of cross-host restore-agreement
+    #: rounds.
+    host_commit_timeout_s: float = Field(60.0)
 
     @property
     def enabled(self) -> bool:
@@ -252,6 +351,20 @@ class Checkpointer:
                 "durable_every_steps/durable_max_to_keep must be >= 0 "
                 "(0 disables the durable tier / keeps everything)."
             )
+        if self.host_commit_timeout_s <= 0:
+            raise ValueError(
+                f"host_commit_timeout_s={self.host_commit_timeout_s} "
+                "must be > 0."
+            )
+        if self.sharded_per_host and self.keep_best_metric:
+            # Best-ranking lives in the orbax manager's metadata; the
+            # per-host commit protocol carries none — a silently
+            # unranked "best" retention would keep the wrong steps.
+            raise ValueError(
+                "sharded_per_host is incompatible with keep_best_metric:"
+                " the per-host commit protocol keeps by recency, not "
+                "rank. Use one or the other."
+            )
         if self.queue_policy == "supersede" and self.keep_best_metric:
             # "Newest wins" and "best wins" contradict: a queued RANKED
             # snapshot (possibly the best model so far) replaced by a
@@ -328,6 +441,15 @@ class Checkpointer:
             raise faults.InjectedFault(
                 f"injected save IO failure at step {step}"
             )
+        if (
+            isinstance(tree, dict)
+            and tree.get("kind") == _HOST_SHARD_KIND
+        ):
+            # Per-host shard payload (sharded_per_host on a >1-process
+            # group): the whole protocol — host finalize, group commit,
+            # durable promotion, retention — replaces the orbax
+            # manager path for this save.
+            return self._write_host_sharded(tree, step)
         with self._io_lock():
             mgr = self._manager()
             if step in mgr.all_steps():
@@ -367,6 +489,425 @@ class Checkpointer:
         ``_io_lock``."""
         last = self._durable_manager().latest_step()
         return last is None or step - int(last) >= self.durable_every_steps
+
+    # -- per-host sharded protocol (docs/DESIGN.md §19) -------------------
+
+    def _host_identity(self) -> Tuple[int, int]:
+        """``(process_index, process_count)`` — injected Fields when
+        set, else the live jax runtime's (the DataLoader convention)."""
+        pid, count = self.process_index, self.process_count
+        if pid < 0 or count < 0:
+            import jax
+
+            pid = jax.process_index() if pid < 0 else pid
+            count = jax.process_count() if count < 0 else count
+        return int(pid), int(count)
+
+    @property
+    def _sharded_active(self) -> bool:
+        """Whether SAVES take the per-host protocol: opted in AND the
+        group actually has more than one process (the single-process
+        degrade keeps the existing orbax layout byte-for-byte)."""
+        return (
+            self.enabled
+            and self.sharded_per_host
+            and self._host_identity()[1] > 1
+        )
+
+    def set_coordinator(self, coordinator: Any) -> "Checkpointer":
+        """Inject the cross-host coordinator restore agreement rides
+        (tests, or a supervisor sharing one coordinator across the
+        whole resilience stack). Default: a ``FileCoordinator`` under
+        ``<directory>/.zkcoord`` — the checkpoint root is already the
+        shared storage the protocol requires."""
+        object.__setattr__(self, "_coord", coordinator)
+        return self
+
+    def _coordinator(self):
+        coord = getattr(self, "_coord", None)
+        if coord is None and self._sharded_active:
+            from zookeeper_tpu.resilience.coordination import (
+                FileCoordinator,
+            )
+
+            pid, count = self._host_identity()
+            coord = FileCoordinator(
+                os.path.join(
+                    os.path.abspath(os.path.expanduser(self.directory)),
+                    ".zkcoord",
+                ),
+                pid,
+                count,
+                # Restore-agreement rounds must outlast a peer still
+                # waiting out its own commit deadline, so the floor is
+                # well above host_commit_timeout_s.
+                timeout_s=max(60.0, 4 * self.host_commit_timeout_s),
+            )
+            object.__setattr__(self, "_coord", coord)
+        return coord
+
+    def _extract_host_shards(self, tree: Any) -> dict:
+        """This host's addressable shards of ``tree`` as raw host
+        bytes + an index manifest — the per-host payload both save
+        modes write (the sharded twin of ``host_snapshot``: plain
+        numpy, survives donation of the device buffers). Raw-bytes
+        storage sidesteps npz's builtin-dtype limits, so bf16 states
+        round-trip bit-identically."""
+        import jax
+        import numpy as np
+
+        flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+        # Phase 1: hint every local shard's device→host copy so the
+        # transfers overlap (the host_snapshot discipline).
+        for _, leaf in flat:
+            for shard in getattr(leaf, "addressable_shards", ()):
+                copy_async = getattr(shard.data, "copy_to_host_async", None)
+                if copy_async is not None:
+                    try:
+                        copy_async()
+                    except Exception:
+                        pass
+        arrays, manifest = {}, {}
+        n = 0
+        for path, leaf in flat:
+            pstr = jax.tree_util.keystr(path)
+            shards = []
+            if isinstance(leaf, jax.Array):
+                seen = set()
+                for shard in leaf.addressable_shards:
+                    nidx = _normalize_index(shard.index, leaf.shape)
+                    token = _index_token(nidx)
+                    if token in seen:
+                        continue  # replicated across local devices
+                    seen.add(token)
+                    shards.append((nidx, np.asarray(shard.data)))
+                gshape, gdtype = leaf.shape, leaf.dtype
+            else:
+                arr = np.asarray(leaf)
+                shards.append(([[0, d] for d in arr.shape], arr))
+                gshape, gdtype = arr.shape, arr.dtype
+            for nidx, data in shards:
+                akey = f"a{n}"
+                n += 1
+                arrays[akey] = np.frombuffer(data.tobytes(), np.uint8)
+                manifest[akey] = {
+                    "path": pstr,
+                    "index": nidx,
+                    "shape": [int(d) for d in gshape],
+                    "shard_shape": [int(d) for d in data.shape],
+                    "dtype": str(np.dtype(gdtype)),
+                }
+        return {
+            "kind": _HOST_SHARD_KIND,
+            "arrays": arrays,
+            "manifest": manifest,
+        }
+
+    def _write_host_sharded(self, payload: dict, step: int) -> bool:
+        """One attempt of the per-host protocol: finalize THIS host's
+        shard dir (temp → rename), then — process 0 only — wait for
+        every host's marker and write the step's commit record."""
+        pid, count = self._host_identity()
+        root = os.path.abspath(os.path.expanduser(self.directory))
+        step_root = os.path.join(root, f"{int(step)}{_HOST_STEP_SUFFIX}")
+        if not self._finalize_host_dir(step_root, step, pid, payload):
+            return False
+        if pid != 0:
+            return True  # this host's half is durable; 0 commits
+        if not self._commit_sharded_step(step_root, step, count):
+            return False
+        self._maybe_promote_sharded_durable(step, step_root)
+        self._prune_sharded(root)
+        return True
+
+    def _finalize_host_dir(
+        self, step_root: str, step: int, pid: int, payload: dict
+    ) -> bool:
+        """Write this host's shards into a temp dir, fsync, then
+        atomically rename — the rename IS the per-host finalize marker.
+        Idempotent per (step, host)."""
+        import numpy as np
+
+        from zookeeper_tpu.resilience import faults
+
+        host_dir = os.path.join(step_root, f"host_{pid:05d}")
+        if os.path.isdir(host_dir):
+            if os.path.isfile(os.path.join(step_root, "COMMIT.json")):
+                return True  # step fully committed: idempotent re-save
+            # An UNCOMMITTED host dir is a stale half of a previous
+            # incarnation's torn save of this step; sealing those old
+            # bytes under a fresh commit would mix checkpoint versions
+            # silently. Rewrite with THIS save's payload instead.
+            shutil.rmtree(host_dir, ignore_errors=True)
+        nonce = int(getattr(self, "_host_nonce", 0)) + 1
+        object.__setattr__(self, "_host_nonce", nonce)
+        tmp = os.path.join(step_root, f".tmp-host_{pid:05d}-{nonce}")
+        os.makedirs(tmp, exist_ok=True)
+        data_path = os.path.join(tmp, "data.npz")
+        np.savez(data_path, **payload["arrays"])
+        with open(data_path, "rb") as f:
+            os.fsync(f.fileno())
+        from zookeeper_tpu.resilience.coordination import _atomic_write_json
+
+        _atomic_write_json(
+            os.path.join(tmp, "manifest.json"), payload["manifest"]
+        )
+        plan = faults.active()
+        if plan is not None and plan.take_host_finalize_failure(pid):
+            # The host died between shard write and finalize: the torn
+            # temp dir stays, the marker never appears, process 0 never
+            # commits — the whole group save is invisible. A dead host
+            # does not retry, so this DROPS (returns False) loudly
+            # instead of raising into the retry loop.
+            logger.error(
+                "per-host finalize of step %d on host %d dropped "
+                "(injected host death): marker absent, the step's "
+                "commit record will not land and restore walks back",
+                step,
+                pid,
+            )
+            return False
+        os.replace(tmp, host_dir)
+        default_registry().gauge(
+            "zk_ckpt_host_finalized",
+            help="newest step this host finalized its sharded "
+            "checkpoint half for",
+            labels={"pid": str(pid)},
+        ).set(int(step))
+        _trace.event(
+            "ckpt_host_finalized", step=int(step), attrs={"pid": pid}
+        )
+        return True
+
+    def _commit_sharded_step(
+        self, step_root: str, step: int, count: int
+    ) -> bool:
+        """Process 0: the step exists once EVERY host's finalize marker
+        is present — only then write ``COMMIT.json`` (atomically). A
+        missing host inside the deadline means the step never becomes
+        restorable; the previous committed step is the resume point."""
+        from zookeeper_tpu.resilience.coordination import _atomic_write_json
+
+        deadline = time.monotonic() + self.host_commit_timeout_s
+        while True:
+            try:
+                hosts = sorted(
+                    n
+                    for n in os.listdir(step_root)
+                    if n.startswith("host_")
+                )
+            except OSError:
+                hosts = []
+            if len(hosts) >= count:
+                break
+            if time.monotonic() >= deadline:
+                logger.error(
+                    "sharded checkpoint of step %d: only %d/%d host(s) "
+                    "finalized within %.1fs; commit record NOT written "
+                    "— the step stays invisible to restore on every "
+                    "host",
+                    step,
+                    len(hosts),
+                    count,
+                    self.host_commit_timeout_s,
+                )
+                _trace.event(
+                    "ckpt_group_commit_abandoned",
+                    step=int(step),
+                    attrs={"hosts": len(hosts), "expected": count},
+                )
+                return False
+            time.sleep(0.01)
+        _atomic_write_json(
+            os.path.join(step_root, "COMMIT.json"),
+            {
+                "step": int(step),
+                "process_count": int(count),
+                "hosts": hosts,
+            },
+        )
+        _trace.event(
+            "ckpt_group_committed", step=int(step), attrs={"hosts": count}
+        )
+        return True
+
+    def _maybe_promote_sharded_durable(
+        self, step: int, step_root: str
+    ) -> None:
+        """Durable promotion for committed sharded steps (process 0):
+        the same progress-based cadence as the orbax tier, implemented
+        as a whole-step-dir copy (commit record included) finalized by
+        rename."""
+        if not self._durable_enabled:
+            return
+        droot = self._durable_path()
+        existing = _sharded_step_dirs(droot)
+        last = existing[0][0] if existing else None
+        if last is not None and step - last < self.durable_every_steps:
+            return
+        dst = os.path.join(droot, f"{int(step)}{_HOST_STEP_SUFFIX}")
+        if os.path.isdir(dst):
+            return
+        os.makedirs(droot, exist_ok=True)
+        nonce = int(getattr(self, "_host_nonce", 0)) + 1
+        object.__setattr__(self, "_host_nonce", nonce)
+        tmp = os.path.join(droot, f".tmp-{int(step)}-{nonce}")
+        try:
+            shutil.copytree(step_root, tmp)
+            os.replace(tmp, dst)
+        except OSError as e:
+            logger.warning(
+                "durable promotion of sharded step %d failed (%s); the "
+                "local tier still holds it",
+                step,
+                e,
+            )
+            shutil.rmtree(tmp, ignore_errors=True)
+            return
+        if self.durable_max_to_keep > 0:
+            for old_step, old_dir in _sharded_step_dirs(droot)[
+                self.durable_max_to_keep:
+            ]:
+                shutil.rmtree(old_dir, ignore_errors=True)
+
+    def _prune_sharded(self, root: str) -> None:
+        """Retention GC for committed sharded steps (process 0): keep
+        the newest ``max_to_keep``, like the orbax manager does for the
+        bare-step layout."""
+        if self.max_to_keep <= 0:
+            return
+        for old_step, old_dir in _sharded_step_dirs(root)[
+            self.max_to_keep:
+        ]:
+            shutil.rmtree(old_dir, ignore_errors=True)
+
+    def _validate_sharded_step(self, step: int, root: str) -> bool:
+        """Cheap local validation of one committed sharded step: the
+        commit record AND every recorded host's shard files must be
+        present (retention GC or a lost tier tears steps AFTER commit;
+        the walk must see that before the group agrees to restore)."""
+        step_root = os.path.join(root, f"{int(step)}{_HOST_STEP_SUFFIX}")
+        commit = None
+        try:
+            with open(os.path.join(step_root, "COMMIT.json")) as f:
+                commit = json.load(f)
+        except (OSError, ValueError):
+            return False
+        for host in commit.get("hosts", []):
+            host_dir = os.path.join(step_root, host)
+            if not (
+                os.path.isfile(os.path.join(host_dir, "data.npz"))
+                and os.path.isfile(os.path.join(host_dir, "manifest.json"))
+            ):
+                return False
+        return True
+
+    def _restore_host_sharded(self, step: int, state: Any, root: str):
+        """Restore one committed sharded step against ``state``'s
+        structure: each target leaf is assembled shard-by-shard via
+        ``jax.make_array_from_callback``, looking every requested
+        global index up in the host manifests (own host first — on a
+        matching topology that is the only read). Raises on any
+        missing shard, shape/dtype mismatch, or torn file —
+        ``restore_state`` decides the fallback."""
+        import jax
+        import numpy as np
+
+        step_root = os.path.join(root, f"{int(step)}{_HOST_STEP_SUFFIX}")
+        try:
+            hosts = sorted(
+                n
+                for n in os.listdir(step_root)
+                if n.startswith("host_")
+                and os.path.isdir(os.path.join(step_root, n))
+            )
+        except OSError as e:
+            raise CheckpointUnreadableError(
+                f"sharded step {step} vanished under the walk: {e}"
+            ) from e
+        if not hosts:
+            raise CheckpointUnreadableError(
+                f"sharded step {step} has a commit record but no host "
+                "shard dirs (GC'd after commit?)"
+            )
+        pid, _ = self._host_identity()
+        own = f"host_{pid:05d}"
+        order = ([own] if own in hosts else []) + [
+            h for h in hosts if h != own
+        ]
+        tables: dict = {}
+
+        def host_table(h):
+            if h not in tables:
+                host_dir = os.path.join(step_root, h)
+                with open(os.path.join(host_dir, "manifest.json")) as f:
+                    manifest = json.load(f)
+                npz = np.load(os.path.join(host_dir, "data.npz"))
+                table = {}
+                for akey, meta in manifest.items():
+                    table[
+                        (meta["path"], _index_token(meta["index"]))
+                    ] = (akey, meta)
+                tables[h] = (table, npz)
+            return tables[h]
+
+        def lookup(pstr, token, shape, dtype):
+            for h in order:
+                table, npz = host_table(h)
+                hit = table.get((pstr, token))
+                if hit is None:
+                    continue
+                akey, meta = hit
+                if tuple(meta["shape"]) != tuple(shape) or meta[
+                    "dtype"
+                ] != str(np.dtype(dtype)):
+                    raise ValueError(
+                        f"sharded step {step}: leaf {pstr} saved as "
+                        f"{meta['dtype']}{tuple(meta['shape'])}, target "
+                        f"expects {np.dtype(dtype)}{tuple(shape)} — "
+                        "model/checkpoint structure mismatch"
+                    )
+                return np.frombuffer(
+                    npz[akey].tobytes(), dtype=np.dtype(meta["dtype"])
+                ).reshape(meta["shard_shape"])
+            raise CheckpointUnreadableError(
+                f"sharded step {step}: no host saved shard "
+                f"{pstr}{list(token)} — restore topology must match the"
+                " saving group's (same mesh/process layout), or the "
+                "host data was GC'd"
+            )
+
+        try:
+            target = _state_pytree(state)
+            flat, treedef = jax.tree_util.tree_flatten_with_path(target)
+            out = []
+            for path, leaf in flat:
+                pstr = jax.tree_util.keystr(path)
+                if isinstance(leaf, jax.Array):
+                    shape, sharding = leaf.shape, leaf.sharding
+
+                    def cb(idx, p=pstr, s=shape, dt=leaf.dtype):
+                        return lookup(
+                            p, _index_token(_normalize_index(idx, s)), s, dt
+                        )
+
+                    out.append(
+                        jax.make_array_from_callback(shape, sharding, cb)
+                    )
+                else:
+                    arr = np.asarray(leaf)
+                    full = _index_token([[0, d] for d in arr.shape])
+                    out.append(lookup(pstr, full, arr.shape, arr.dtype))
+            return jax.tree_util.tree_unflatten(treedef, out)
+        finally:
+            # NpzFile handles hold file descriptors (and on fuse mounts
+            # pin the files against the retention GC): close them even
+            # when a lookup raises and the walk falls back.
+            for _, npz in tables.values():
+                try:
+                    npz.close()
+                except Exception:
+                    pass
 
     def _attempt_async_write(
         self, step: int, host_tree: Any, metrics: Optional[dict]
@@ -432,6 +973,19 @@ class Checkpointer:
                 )
             metrics = {k: float(v) for k, v in metrics.items()}
         step = int(jax.device_get(state.step)) if step is None else int(step)
+        if self._sharded_active:
+            # The extraction IS the donation-safe snapshot (plain host
+            # bytes of this host's shards): both modes share it, and the
+            # async writer hands the payload to the same protocol.
+            with _trace.span("ckpt_snapshot", step=step):
+                payload = self._extract_host_shards(_state_pytree(state))
+            if self.mode == "async" and not sync:
+                return self._writer().submit(step, payload, metrics)
+            with _trace.span("ckpt_sync_save", step=step):
+                return self._run_with_save_retries(
+                    step,
+                    lambda: self._write_state(payload, step, metrics),
+                )
         if self.mode == "async" and not sync:
             from zookeeper_tpu.training.step import host_snapshot
 
@@ -459,15 +1013,47 @@ class Checkpointer:
             return 0.0
         return writer.drain(supersede=supersede)
 
+    def _orbax_tier_present(self) -> bool:
+        """Whether any bare-step orbax checkpoint exists in either
+        tier root — the gate that keeps a pure-sharded run from ever
+        instantiating orbax managers (old mixed-layout directories
+        still read both)."""
+        roots = [os.path.abspath(os.path.expanduser(self.directory))]
+        if self._durable_enabled:
+            roots.append(self._durable_path())
+        for root in roots:
+            try:
+                names = os.listdir(root)
+            except OSError:
+                continue
+            if any(
+                n.isdigit() and os.path.isdir(os.path.join(root, n))
+                for n in names
+            ):
+                return True
+        return False
+
     def latest_step(self) -> Optional[int]:
-        """Newest step across BOTH retention tiers (an async write that
-        already finalized counts; one still in flight does not)."""
+        """Newest step across every retention tier — the orbax tiers
+        plus COMMITTED per-host sharded steps (an async write that
+        already finalized counts; one still in flight, or a sharded
+        step missing any host's marker, does not)."""
         if not self.enabled:
             return None
-        with self._io_lock():
-            steps = [self._manager().latest_step()]
-            if self._durable_enabled:
-                steps.append(self._durable_manager().latest_step())
+        steps: List[Optional[int]] = []
+        if not self.sharded_per_host or self._orbax_tier_present():
+            with self._io_lock():
+                steps.append(self._manager().latest_step())
+                if self._durable_enabled:
+                    steps.append(self._durable_manager().latest_step())
+        root = os.path.abspath(os.path.expanduser(self.directory))
+        sharded = _sharded_step_dirs(root)
+        if sharded:
+            steps.append(sharded[0][0])
+        if self._durable_enabled:
+            dsharded = _sharded_step_dirs(self._durable_path())
+            if dsharded:
+                steps.append(dsharded[0][0])
         steps = [s for s in steps if s is not None]
         return max(steps) if steps else None
 
@@ -498,21 +1084,66 @@ class Checkpointer:
             return True
 
     def _tier_entries(self) -> List[Tuple[int, str]]:
-        """Every restorable ``(step, tier)`` across both retention
-        tiers, newest-first; a step present in both tiers is walked
-        local-first (same bytes, cheaper storage class in production)
-        with the durable copy still behind it as fallback."""
-        with self._io_lock():
-            entries = [
-                (int(s), "local") for s in self._manager().all_steps()
-            ]
-            if self._durable_enabled:
+        """Every restorable ``(step, tier)`` across all retention
+        tiers, newest-first: the orbax tiers ("local"/"durable") plus
+        COMMITTED per-host sharded steps ("hosts"/"hosts-durable"). A
+        step present in several tiers is walked cheapest-storage-first
+        with the rest behind it as fallback."""
+        entries: List[Tuple[int, str]] = []
+        if not self.sharded_per_host or self._orbax_tier_present():
+            with self._io_lock():
                 entries += [
-                    (int(s), "durable")
-                    for s in self._durable_manager().all_steps()
+                    (int(s), "local") for s in self._manager().all_steps()
                 ]
-        entries.sort(key=lambda e: (e[0], e[1] == "local"), reverse=True)
+                if self._durable_enabled:
+                    entries += [
+                        (int(s), "durable")
+                        for s in self._durable_manager().all_steps()
+                    ]
+        root = os.path.abspath(os.path.expanduser(self.directory))
+        entries += [(s, "hosts") for s, _ in _sharded_step_dirs(root)]
+        if self._durable_enabled:
+            entries += [
+                (s, "hosts-durable")
+                for s, _ in _sharded_step_dirs(self._durable_path())
+            ]
+        entries.sort(
+            key=lambda e: (e[0], _TIER_PRIORITY.get(e[1], -1)),
+            reverse=True,
+        )
         return entries
+
+    def _tier_root(self, tier: str) -> str:
+        return (
+            self._durable_path()
+            if tier in ("durable", "hosts-durable")
+            else os.path.abspath(os.path.expanduser(self.directory))
+        )
+
+    def _validate_entry(self, step: int, tier: str) -> bool:
+        """Cheap, local, collective-free validation of one walk entry —
+        the half the group exchanges BEFORE anyone attempts a restore,
+        so no host enters a (possibly collective) restore its peers
+        will sit out."""
+        if tier in ("hosts", "hosts-durable"):
+            return self._validate_sharded_step(step, self._tier_root(tier))
+        return self._step_finalized(step, self._tier_root(tier))
+
+    def _attempt_entry_restore(self, step: int, tier: str, state: Any):
+        """One restore attempt; returns ``(restored_or_None,
+        error_or_None)`` — ``restore_state`` owns the fallback."""
+        try:
+            with _trace.span("restore_step", step=step):
+                if tier in ("hosts", "hosts-durable"):
+                    return (
+                        self._restore_host_sharded(
+                            step, state, self._tier_root(tier)
+                        ),
+                        None,
+                    )
+                return self._restore_step(step, state, tier), None
+        except Exception as e:
+            return None, e
 
     def restore_state(self, state: Any) -> Any:
         """Restore the NEWEST VALID checkpoint into (a copy of)
@@ -525,25 +1156,100 @@ class Checkpointer:
         retention GC racing this walk) is SKIPPED with a warning and
         the next-newest retained step restores instead — a corrupt
         latest checkpoint costs the work since the previous save, never
-        the whole run. The walk covers both retention tiers (local
-        first at equal steps, then the every-M durable promotions).
+        the whole run. The walk covers every retention tier (the orbax
+        local/durable tiers plus committed per-host sharded steps).
+
+        On a multi-process sharded run the walk is AGREED across hosts
+        (docs/DESIGN.md §19): hosts first exchange their candidate
+        lists (a host that lost its local tier pulls the union toward
+        durable steps every host can read), then for each candidate
+        exchange a cheap validation verdict BEFORE anyone restores — a
+        step any host finds torn is skipped by all — and a restore
+        confirmation after, so every process resumes from the SAME
+        step. If the coordinator itself is lost mid-agreement the walk
+        degrades to this host's local decision with a loud warning.
+
         Only when EVERY retained step fails does restore raise
         (silently restarting from scratch would be worse than the
         crash): the likely cause then is a model/config mismatch, not
         corruption, and the error says so."""
         if not self.enabled or not self.restore:
             return state
+        from zookeeper_tpu.resilience.coordination import (
+            CoordinatorLostError,
+        )
+
         entries = self._tier_entries()
+        coord = self._coordinator() if self._sharded_active else None
+        group = coord is not None and coord.process_count > 1
+        if group:
+            try:
+                proposals = coord.exchange(
+                    "restore_candidates",
+                    [[int(s), t] for s, t in entries],
+                )
+                merged = {
+                    (int(s), str(t))
+                    for plist in proposals
+                    for s, t in plist
+                }
+                entries = sorted(
+                    merged,
+                    key=lambda e: (e[0], _TIER_PRIORITY.get(e[1], -1)),
+                    reverse=True,
+                )
+            except CoordinatorLostError as e:
+                logger.warning(
+                    "cross-host restore agreement unavailable (%s); "
+                    "falling back to this host's local walk — a step "
+                    "another host finds torn may desync the group",
+                    e,
+                )
+                group = False
         if not entries:
             return state
         last_err: Optional[Exception] = None
         for i, (step, tier) in enumerate(entries):
-            root = (
-                self._durable_path()
-                if tier == "durable"
-                else os.path.abspath(os.path.expanduser(self.directory))
-            )
-            if not self._step_finalized(step, root):
+            valid = self._validate_entry(step, tier)
+            if group:
+                try:
+                    valids = coord.exchange(
+                        f"restore_try_{step}_{tier}", bool(valid)
+                    )
+                except CoordinatorLostError as e:
+                    logger.warning(
+                        "restore agreement lost mid-walk (%s); "
+                        "continuing with this host's local walk",
+                        e,
+                    )
+                    group, valids = False, [valid]
+                if not all(valids):
+                    if valid:
+                        logger.warning(
+                            "%s checkpoint step %d is restorable here "
+                            "but torn on a peer host; skipped on EVERY "
+                            "host for group agreement",
+                            tier,
+                            step,
+                        )
+                    else:
+                        logger.warning(
+                            "%s checkpoint step %d is not finalized "
+                            "(crash mid-save, or host data GC'd since "
+                            "listing?); falling back to an earlier step",
+                            tier,
+                            step,
+                        )
+                    _trace.event(
+                        "restore_skip",
+                        step=step,
+                        attrs={
+                            "tier": tier,
+                            "reason": "peer_torn" if valid else "unfinalized",
+                        },
+                    )
+                    continue
+            if not valid:
                 _trace.event(
                     "restore_skip",
                     step=step,
@@ -551,16 +1257,47 @@ class Checkpointer:
                 )
                 logger.warning(
                     "%s checkpoint step %d is not finalized (crash "
-                    "mid-save?); falling back to an earlier step",
+                    "mid-save, or host data GC'd since listing?); "
+                    "falling back to an earlier step",
                     tier,
                     step,
                 )
                 continue
-            try:
-                with _trace.span("restore_step", step=step):
-                    restored = self._restore_step(step, state, tier)
-            except Exception as e:
-                last_err = e
+            restored, err = self._attempt_entry_restore(step, tier, state)
+            ok = err is None
+            if group:
+                try:
+                    oks = coord.exchange(
+                        f"restore_ok_{step}_{tier}", ok
+                    )
+                except CoordinatorLostError as e:
+                    logger.warning(
+                        "restore confirmation lost (%s); continuing "
+                        "with this host's local walk",
+                        e,
+                    )
+                    group, oks = False, [ok]
+                if not all(oks):
+                    if ok:
+                        logger.warning(
+                            "a peer host failed to read %s step %d; "
+                            "skipped on every host for group agreement",
+                            tier,
+                            step,
+                        )
+                    else:
+                        last_err = err
+                    _trace.event(
+                        "restore_skip",
+                        step=step,
+                        attrs={
+                            "tier": tier,
+                            "reason": "unreadable" if not ok else "peer_unreadable",
+                        },
+                    )
+                    continue
+            if not ok:
+                last_err = err
                 _trace.event(
                     "restore_skip",
                     step=step,
@@ -571,7 +1308,7 @@ class Checkpointer:
                     "falling back to an earlier retained step",
                     tier,
                     step,
-                    e,
+                    err,
                 )
                 continue
             if i > 0:

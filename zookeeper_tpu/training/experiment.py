@@ -211,6 +211,15 @@ class TrainingExperiment(Experiment):
     #: the next metrics readback boundary so a supervisor restores
     #: from checkpoint).
     nan_policy: str = Field("ignore")
+    #: Group-mode drain margin in STEPS (docs/DESIGN.md §19): the gap
+    #: between a preemption flag's publish boundary and the agreed
+    #: whole-group exit. Must exceed the worst cross-host boundary
+    #: skew PLUS the shared storage's flag-visibility lag; 0 = auto
+    #: (4 x unroll — right for strongly-consistent storage like local
+    #: disk/GCS). Raise it on storage with cached directory listings
+    #: (NFS attribute caching) where a flag may take longer to become
+    #: visible to peers.
+    group_drain_margin_steps: int = Field(0)
     #: Rematerialization policy ("none"/"dots"/"full"/"quant"): trade
     #: backward recompute for activation HBM (see make_train_step —
     #: "quant" saves only the tagged binarized activations; measured
@@ -666,17 +675,113 @@ class TrainingExperiment(Experiment):
                 timer["sync_step"] = int(global_step)
                 timer["sync_dirty"] = False
 
+    def _group_process_index(self) -> int:
+        """This host's index for logical fault keying: the group
+        coordinator's when one is wired, else the live jax runtime's."""
+        coord = getattr(self, "group_coordinator", None)
+        if coord is not None:
+            return int(coord.process_index)
+        import jax
+
+        return int(jax.process_index())
+
+    def _group_drain_margin(self) -> int:
+        """Steps between a drain flag's publish boundary and the
+        agreed group exit. Must exceed the worst cross-host boundary
+        skew (one slab, enforced by the group boundary's device sync)
+        plus the storage's flag-visibility lag, so NO host can already
+        be past the exit when the flag becomes visible — the
+        no-deadlock argument of docs/DESIGN.md §19. Configurable via
+        ``group_drain_margin_steps`` for slow-visibility storage."""
+        if self.group_drain_margin_steps > 0:
+            return int(self.group_drain_margin_steps)
+        return 4 * max(1, int(self.unroll))
+
+    def _group_stop_due(self, global_step: int) -> bool:
+        """Group-mode boundary protocol (docs/DESIGN.md §19): a host
+        whose guard tripped PUBLISHES a stop flag (only if no drain is
+        already in progress) instead of exiting; every host sees the
+        flag at a later boundary — publish-before-dispatch ordering
+        plus the per-boundary device sync guarantee any host past the
+        flag's step sees it — and the whole group exits at the first
+        boundary at or past ``flag.step + margin``. One common grid,
+        one deterministic stop step: all hosts save the SAME state and
+        the per-host commit record can land. Non-blocking by design: a
+        host never waits here (a peer mid-collective could be waiting
+        on OUR next dispatch); it keeps training to the agreed
+        boundary. Returns True when THIS boundary is the group exit."""
+        coord = self.group_coordinator
+        pid = int(coord.process_index)
+        flags = coord.poll_flags("preempt")
+        if (
+            self.guard.preempted
+            and not flags
+            and getattr(self, "_group_flag_step", None) is None
+        ):
+            # This host originates the drain (SIGTERM / injected kill
+            # here, and no drain already in progress).
+            coord.publish_flag(
+                "preempt",
+                {
+                    "origin": pid,
+                    "step": int(global_step),
+                    "signal": self.guard.received_signal,
+                },
+            )
+            self._group_flag_step = int(global_step)
+            self.guard.request_preemption(
+                signum=self.guard.received_signal, origin=pid
+            )
+            flags = coord.poll_flags("preempt")
+        if not flags:
+            return False
+        if self.guard.preemption_origin is None:
+            # Join the drain (and record who started it for the
+            # supervisor's flight-recorder manifest).
+            first = min(flags, key=lambda f: int(f["origin"]))
+            self.guard.request_preemption(
+                signum=self.guard.received_signal or first.get("signal"),
+                origin=int(first["origin"]),
+            )
+        stop_step = (
+            max(int(f["step"]) for f in flags) + self._group_drain_margin()
+        )
+        return int(global_step) >= stop_step
+
     def _boundary_check(self, state, global_step: int) -> None:
         """Preemption check at a safe boundary (a step/slab end, where
         ``state`` is a valid exact-resume point). An active FaultPlan's
-        ``kill_at_step`` trips the same flag a real SIGTERM does, so the
-        injected and production paths are one path. On preemption: one
-        SYNCHRONOUS save of exactly this state, then the distinguished
-        ``Preempted`` exit (teardown still runs via run()'s finally)."""
+        ``kill_at_step`` / ``kill_process_at_step`` trips the same flag
+        a real SIGTERM does, so the injected and production paths are
+        one path. On preemption: one SYNCHRONOUS save of exactly this
+        state, then the distinguished ``Preempted`` exit (teardown
+        still runs via run()'s finally). With a group coordinator
+        wired (``run_with_recovery(coordinator=...)``), the flag is
+        first EXCHANGED across hosts so the whole process group drains
+        and saves the same boundary together."""
         plan = _faults.active()
-        if plan is not None and plan.kill_due(global_step):
+        if plan is not None and plan.kill_due(
+            global_step,
+            self._group_process_index()
+            if (
+                plan.kill_process_at_step is not None
+                or getattr(self, "group_coordinator", None) is not None
+            )
+            else 0,
+        ):
             self.guard.request_preemption()
-        if not self.guard.preempted:
+        coord = getattr(self, "group_coordinator", None)
+        if coord is not None and coord.process_count > 1:
+            import jax
+
+            # Bound cross-host boundary skew to ONE slab (the drain-
+            # margin no-deadlock argument, docs/DESIGN.md §19): this
+            # host passes the boundary only once every peer has
+            # dispatched the slab that produced this state.
+            jax.block_until_ready(state.step)
+            if not self._group_stop_due(global_step):
+                return
+        elif not self.guard.preempted:
             return
         # The guard owns the drain-then-sync-save policy (async mode
         # first lands or supersedes the in-flight background write);
@@ -901,6 +1006,11 @@ class TrainingExperiment(Experiment):
                 "rankable metrics (best-ranking pins every save to a "
                 "metric). Use one or the other."
             )
+        if self.group_drain_margin_steps < 0:
+            raise ValueError(
+                f"group_drain_margin_steps={self.group_drain_margin_steps}"
+                " must be >= 0 (0 = auto: 4 x unroll)."
+            )
         if self.validate_every < 1:
             # Fail fast rather than guess: 0 commonly means "disable" in
             # every-N conventions, but validate=False is the explicit
@@ -943,6 +1053,13 @@ class TrainingExperiment(Experiment):
             # EADDRINUSE) is still torn down by the finally below.
             self._setup_observability()
             self.runtime.initialize()  # Multi-host bootstrap; no-op single host.
+            if self.checkpointer.enabled and self.checkpointer.sharded_per_host:
+                # Construct (and stale-purge) the restore-agreement
+                # coordinator NOW, behind the cluster-formation
+                # rendezvous — not lazily at first restore, where a
+                # slow peer's stale files could still be visible
+                # (coordination.FileCoordinator docstring).
+                self.checkpointer._coordinator()
             partitioner = self.partitioner
             partitioner.setup()
             state = partitioner.shard_state(self.build_state())
@@ -999,6 +1116,9 @@ class TrainingExperiment(Experiment):
             )
             # Per-run restore-latency probe (read by run_with_recovery).
             self.first_step_at = None
+            # Per-run group-drain flag marker (the group boundary
+            # protocol publishes at most one stop flag per run).
+            self._group_flag_step = None
             # Step-time watchdog + live-MFU timer state (docs §14).
             self._obs_reset_timers()
             # Per-run preemption-save wait probe (ms spent draining the
