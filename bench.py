@@ -991,6 +991,154 @@ def measure_decode_throughput(env=None):
     return out
 
 
+def measure_prefix_reuse(env=None):
+    """``ZK_BENCH_PREFIX=1`` leg: warm-vs-cold shared-prefix TTFT A/B
+    on the paged-KV engine (docs/DESIGN.md §20).
+
+    The workload is the millions-of-users traffic shape the prefix
+    cache exists for: every request shares one long system prompt and
+    differs only in a short tail. Requests are served ONE AT A TIME
+    (TTFT then IS the prefill cost — no queue-wait term), twice over:
+
+    - **cold** — the prefix cache is invalidated before every
+      admission, so each request pays the full prefill;
+    - **warm** — one seeding request populates the cache, then every
+      admission shares the resident prefix pages and the warm-extend
+      program computes only the tail (CoW at the divergence page).
+
+    Streams are asserted TOKEN-IDENTICAL between the passes (the bench
+    re-pins the §20 parity contract on every run) and compile-free
+    after warmup. Emits ``prefix_cold_ttft_p50_ms`` /
+    ``prefix_warm_ttft_p50_ms`` / ``prefix_ttft_speedup`` (cold/warm —
+    the headline; the CPU reference is the conservative floor, the
+    saved prefill FLOPs only grow with model size) plus ``kv_pool_fill``
+    and the informational workload shape.
+
+    Knobs: ``ZK_BENCH_PREFIX_REQUESTS`` (default 12),
+    ``ZK_BENCH_PREFIX_SHARED`` (shared prefix tokens, default 224 —
+    long enough that the saved prefill compute dominates the fixed
+    per-dispatch host cost on the CPU reference),
+    ``ZK_BENCH_PREFIX_TAIL`` (unique tail tokens, default 8),
+    ``ZK_BENCH_DECODE_LAYERS``/``_DMODEL``/``_HEADS`` (model geometry,
+    shared with the decode leg)."""
+    import numpy as np
+
+    from zookeeper_tpu.core import configure
+    from zookeeper_tpu.models import TransformerLM
+    from zookeeper_tpu.serving.decode import (
+        DecodeEngine,
+        DecodeMetrics,
+        DecodeScheduler,
+    )
+
+    env = os.environ if env is None else env
+    n_requests = int(env.get("ZK_BENCH_PREFIX_REQUESTS", "12"))
+    shared_len = int(env.get("ZK_BENCH_PREFIX_SHARED", "224"))
+    tail_len = int(env.get("ZK_BENCH_PREFIX_TAIL", "8"))
+    num_layers = int(env.get("ZK_BENCH_DECODE_LAYERS", "4"))
+    d_model = int(env.get("ZK_BENCH_DECODE_DMODEL", "256"))
+    num_heads = int(env.get("ZK_BENCH_DECODE_HEADS", "4"))
+    vocab = 512
+    prompt_len = shared_len + tail_len
+    seq_len = max(128, 2 * prompt_len)
+
+    model = TransformerLM()
+    configure(
+        model,
+        {
+            "num_layers": num_layers,
+            "d_model": d_model,
+            "num_heads": num_heads,
+            "max_seq_len": seq_len,
+            "attention": "dense",
+        },
+        name="prefix_bench_model",
+    )
+    module = model.build((seq_len,), vocab)
+    params, model_state = model.initialize(module, (seq_len,), seed=0)
+    engine = DecodeEngine()
+    configure(
+        engine,
+        {
+            "slots": 2,
+            # Small bucket for the warm tail, big one for cold prefill:
+            # the TTFT gap between them IS the measured effect.
+            "seq_buckets": (
+                tuple(sorted({16, prompt_len}))
+            ),
+            "kv_capacity": seq_len,
+            "kv_layout": "paged",
+        },
+        name="prefix_bench_engine",
+    )
+    engine.bind(module, params, model_state)
+    engine.warmup()
+    warm_compiles = engine.compile_count
+    metrics = DecodeMetrics()
+    configure(metrics, {}, name="prefix_bench_metrics")
+    scheduler = DecodeScheduler()
+    configure(
+        scheduler, {"max_new_tokens": 4}, name="prefix_bench_sched"
+    )
+    scheduler.bind(engine, metrics=metrics)
+
+    rng = np.random.default_rng(0)
+    shared = rng.integers(1, vocab, size=shared_len).astype(np.int32)
+    prompts = [
+        np.concatenate(
+            [shared, rng.integers(1, vocab, size=tail_len).astype(np.int32)]
+        )
+        for _ in range(n_requests)
+    ]
+
+    def serve_one_at_a_time(invalidate_each):
+        ttfts, outs = [], []
+        for p in prompts:
+            if invalidate_each:
+                engine.invalidate_prefix_cache()
+            stream = scheduler.submit(p)
+            outs.append(stream.result())
+            ttfts.append(stream.ttft_ms)
+        return np.asarray(ttfts), outs
+
+    cold_ttft, cold_out = serve_one_at_a_time(invalidate_each=True)
+    # Seed the cache once, then measure the warm steady state.
+    engine.invalidate_prefix_cache()
+    scheduler.generate(prompts[0], max_new_tokens=1)
+    warm_ttft, warm_out = serve_one_at_a_time(invalidate_each=False)
+    mismatch = sum(
+        1 for a, b in zip(cold_out, warm_out) if not np.array_equal(a, b)
+    )
+    if mismatch:
+        raise RuntimeError(
+            f"prefix leg: {mismatch}/{n_requests} streams differ between "
+            "the cold and warm passes — the §20 parity contract is "
+            "broken; the TTFT comparison is meaningless."
+        )
+    if engine.compile_count != warm_compiles:
+        raise RuntimeError(
+            f"prefix leg recompiled mid-traffic ({warm_compiles} -> "
+            f"{engine.compile_count}); the TTFT numbers are invalid."
+        )
+    pool = engine.page_pool
+    cold_p50 = float(np.percentile(cold_ttft, 50))
+    warm_p50 = float(np.percentile(warm_ttft, 50))
+    return {
+        "prefix_cold_ttft_p50_ms": round(cold_p50, 3),
+        "prefix_warm_ttft_p50_ms": round(warm_p50, 3),
+        "prefix_ttft_speedup": round(cold_p50 / warm_p50, 3)
+        if warm_p50 > 0
+        else -1.0,
+        "kv_pool_fill": round(pool.used_pages / pool.num_pages, 4),
+        # Informational workload shape + cache effectiveness.
+        "prefix_hit_rate": round(pool.prefix_hit_rate, 4),
+        "prefix_cow_pages": pool.cow_pages,
+        "prefix_requests": n_requests,
+        "prefix_shared_tokens": shared_len,
+        "prefix_tail_tokens": tail_len,
+    }
+
+
 def measure_speculative_throughput(env=None):
     """``ZK_BENCH_SPEC=1`` leg: spec-vs-plain A/B on the SAME teacher
     engine (docs/DESIGN.md §18) at a pinned high-acceptance workload.
@@ -2087,6 +2235,21 @@ def main(argv=None):
             )
             decode_metrics = None
 
+    # Shared-prefix reuse leg (env-gated: warm-vs-cold TTFT A/B on the
+    # paged-KV engine at the shared-system-prompt workload): streams
+    # asserted token-identical, prefix_ttft_speedup is the headline.
+    prefix_metrics = None
+    if _env_flag(os.environ, "ZK_BENCH_PREFIX"):
+        try:
+            prefix_metrics = measure_prefix_reuse()
+        except Exception as e:  # never lose the primary metric
+            print(
+                f"prefix leg failed ({e}); omitting prefix_*",
+                file=sys.stderr,
+                flush=True,
+            )
+            prefix_metrics = None
+
     # Speculative-decode leg (env-gated: spec-vs-plain A/B on one
     # engine at the pinned zero-tail high-acceptance workload): streams
     # asserted token-identical, spec_speedup is the headline.
@@ -2143,6 +2306,8 @@ def main(argv=None):
         extras.update(ckpt_metrics)
     if decode_metrics is not None:
         extras.update(decode_metrics)
+    if prefix_metrics is not None:
+        extras.update(prefix_metrics)
     if spec_metrics is not None:
         extras.update(spec_metrics)
     if obs_metrics is not None:

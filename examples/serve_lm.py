@@ -36,6 +36,14 @@ weights -> paged-KV continuous-batching decode) in two commands::
         speculative.draft_checkpoint=/tmp/lm_student_ckpt \\
         speculative.draft_model.num_layers=1
 
+    # True paged KV (docs/DESIGN.md §20): shared page pool + per-slot
+    # page tables — pooled capacity, warm-prefix reuse through the
+    # radix prefix cache (CoW at the divergence point), optional int8
+    # rows; the result line gains kv_layout / kv_pool_fill /
+    # prefix_cache_hit_rate:
+    python examples/serve_lm.py ServeLM engine.kv_layout=paged \\
+        engine.kv_quant=int8   # int8 optional; fp stays token-exact
+
 Every request rides the REAL serving path — bucketed prefill into a
 KV slot, slot-refill continuous batching, per-token streaming — so the
 reported numbers are the decode subsystem's, not a synthetic loop's

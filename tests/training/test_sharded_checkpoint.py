@@ -381,3 +381,125 @@ def test_stale_uncommitted_host_dir_rewritten_not_sealed(tmp_path):
     out = group_restore(cks2, lambda: tiny_state(0.0, 0))
     for pid in (0, 1):
         assert_state(out[pid], 9.0, 2)  # host 0's half rewritten too
+
+
+# -- serving-side discovery + restore of committed sharded steps -----------
+# (docs/DESIGN.md §20 satellite: the CheckpointWatcher's primitives —
+# finalized_steps + load_inference_model — must see .zkhost steps, or
+# a server tracking a multi-host run silently never swaps.)
+
+
+def test_finalized_steps_lists_committed_sharded_steps(tmp_path):
+    from zookeeper_tpu.training.checkpoint import finalized_steps
+
+    cks = host_pair(tmp_path)
+    root = str(tmp_path / "ckpt")
+    assert finalized_steps(root) == []
+    assert all(group_save(cks, tiny_state(1.0, 3), 3))
+    assert finalized_steps(root) == [3]
+    # A torn group save (host 1's finalize dropped => no commit
+    # record) must stay invisible.
+    with faults.injected(FaultPlan(fail_host_finalize=1)):
+        assert not cks[1].save(tiny_state(2.0, 4), step=4)
+        assert not cks[0].save(tiny_state(2.0, 4), step=4)
+    assert finalized_steps(root) == [3]
+    assert all(group_save(cks, tiny_state(3.0, 5), 5))
+    assert finalized_steps(root) == [3, 5]
+
+
+def test_load_inference_model_reads_sharded_step(tmp_path, caplog):
+    import logging
+
+    from zookeeper_tpu.training.checkpoint import load_inference_model
+
+    cks = host_pair(tmp_path)
+    assert all(group_save(cks, tiny_state(4.0, 7), 7))
+    with caplog.at_level(logging.WARNING):
+        params, model_state = load_inference_model(str(tmp_path / "ckpt"))
+    # The multi-host layout warns LOUDLY (whole state on one host).
+    assert any("MULTI-HOST" in r.message for r in caplog.records)
+    np.testing.assert_allclose(np.asarray(params["w"]), 4.0)
+    # bf16 leaves round-trip bit-exactly through the raw-bytes shards.
+    assert str(params["b"].dtype) == "bfloat16"
+    assert float(np.asarray(params["b"], np.float32)) == 4.0
+    # Explicit step addressing (the hot-swap watcher's mode).
+    p2, _ = load_inference_model(str(tmp_path / "ckpt"), step=7)
+    np.testing.assert_allclose(np.asarray(p2["w"]), 4.0)
+
+
+def test_load_inference_model_prefers_newest_across_layouts(tmp_path):
+    """Orbax bare-step and .zkhost steps coexisting in one directory:
+    the loader serves the NEWEST step regardless of layout."""
+    from zookeeper_tpu.training.checkpoint import (
+        finalized_steps,
+        load_inference_model,
+    )
+
+    single = Checkpointer()
+    configure(
+        single,
+        {
+            "directory": str(tmp_path / "ckpt"),
+            "synchronous": True,
+            "save_every_epochs": 0,
+        },
+        name="ck_single_layout",
+    )
+    assert single.save(tiny_state(1.0, 1), step=1)
+    cks = host_pair(tmp_path)
+    assert all(group_save(cks, tiny_state(2.0, 2), 2))
+    assert finalized_steps(str(tmp_path / "ckpt")) == [1, 2]
+    params, _ = load_inference_model(str(tmp_path / "ckpt"))
+    np.testing.assert_allclose(np.asarray(params["w"]), 2.0)  # step 2
+    # And the older orbax step stays addressable.
+    p1, _ = load_inference_model(str(tmp_path / "ckpt"), step=1)
+    np.testing.assert_allclose(np.asarray(p1["w"]), 1.0)
+
+
+def test_checkpoint_watcher_swaps_from_sharded_step(tmp_path):
+    """End to end: a CheckpointWatcher polling a directory where a
+    multi-host training run lands .zkhost steps must discover and
+    apply them — the SERVING gap this satellite closes."""
+    from zookeeper_tpu.serving.engine import CheckpointWatcher
+
+    cks = host_pair(tmp_path)
+    assert all(group_save(cks, tiny_state(5.0, 11), 11))
+    seen = {}
+
+    class FakeEngine:
+        def swap_weights(self, params, model_state):
+            seen["w"] = np.asarray(params["w"]).copy()
+
+    watcher = CheckpointWatcher(
+        FakeEngine(),
+        str(tmp_path / "ckpt"),
+        weights="raw",
+        poll_interval_s=60.0,
+    )
+    step = watcher.poll_once()
+    assert step == 11
+    np.testing.assert_allclose(seen["w"], 5.0)
+
+
+def test_load_inference_model_skips_stateful_opt_state(tmp_path):
+    """A sharded step saved under a STATEFUL optimizer (adam: opt_state
+    keystr paths carry tuple/attr segments like "['opt_state'][0]
+    .count") must still serve: the loader filters non-inference
+    subtrees BEFORE enforcing nested-dict path purity."""
+    import jax.numpy as jnp
+    import optax
+
+    from zookeeper_tpu.training import TrainState
+    from zookeeper_tpu.training.checkpoint import load_inference_model
+
+    state = TrainState.create(
+        apply_fn=lambda *a, **k: None,
+        params={"w": jnp.full((4, 2), 8.0, jnp.float32)},
+        model_state={},
+        tx=optax.adam(1e-3),
+    ).replace(step=jnp.asarray(2))
+    cks = host_pair(tmp_path)
+    assert cks[1].save(state, step=2)
+    assert cks[0].save(state, step=2)
+    params, _ = load_inference_model(str(tmp_path / "ckpt"))
+    np.testing.assert_allclose(np.asarray(params["w"]), 8.0)

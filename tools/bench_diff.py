@@ -86,6 +86,13 @@ TOLERANCES = {
     "spec_plain_tokens_per_sec_per_chip": 0.25,
     "spec_speedup": 0.35,
     "spec_acceptance_rate": 0.10,
+    # Paged-KV prefix-reuse era (docs/DESIGN.md §20): both TTFT medians
+    # are single-dispatch prefill wall times on a shared host (the
+    # decode TTFT jitter class); the speedup is their ratio and
+    # scatters accordingly.
+    "prefix_cold_ttft_p50_ms": 0.40,
+    "prefix_warm_ttft_p50_ms": 0.40,
+    "prefix_ttft_speedup": 0.35,
 }
 
 #: HIGHER-better metric name patterns (throughput family). MBU joins
@@ -124,6 +131,12 @@ _INFORMATIONAL = re.compile(
     # Speculative-leg workload shape (k, model depths, traffic counts).
     r"|^spec_k$|^spec_teacher_layers$|^spec_draft_layers$"
     r"|^spec_requests$|^spec_slots$|^spec_new_tokens$"
+    # Prefix-reuse-leg workload shape + cache-effectiveness context:
+    # hit rate and CoW count are DETERMINED by the synthetic workload
+    # (every request shares one prefix), and pool fill is a capacity
+    # statement, not a speed — none of them is a perf direction.
+    r"|^prefix_requests$|^prefix_shared_tokens$|^prefix_tail_tokens$"
+    r"|^prefix_hit_rate$|^prefix_cow_pages$|^kv_pool_fill$"
     # Peak ANCHORS and model FLOP counts are measurement context, not
     # code performance: an anchor that moved (re-measured peak, fixed
     # cache pathology — BENCH_r04's 237.9 TF/s) or a FLOPs change (a

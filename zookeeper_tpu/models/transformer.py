@@ -65,6 +65,64 @@ def _resolve_attention(attention):
     )
 
 
+def _resolve_paged_attention(paged_attention):
+    """``"reference"`` / ``"pallas"`` / any ``callable(q, k_pool,
+    v_pool, page_table, lengths, *, k_scale=None, v_scale=None)`` — the
+    page-pool analogue of :func:`_resolve_decode_attention`
+    (docs/DESIGN.md §20). ``"reference"`` is the
+    :func:`~zookeeper_tpu.ops.pool_decode_attention` gather+einsum
+    oracle; ``"pallas"`` the page-table scalar-prefetch kernel; the
+    callable form is how the decode engine injects the mesh-composed
+    sharded wrapper."""
+    from zookeeper_tpu.ops import (
+        pool_decode_attention,
+        pool_paged_decode_attention,
+    )
+
+    if callable(paged_attention):
+        return paged_attention
+    if paged_attention == "reference":
+        return pool_decode_attention
+    if paged_attention == "pallas":
+        return pool_paged_decode_attention
+    raise ValueError(
+        f"paged attention={paged_attention!r}: expected 'reference', "
+        "'pallas', or a callable(q, k_pool, v_pool, page_table, "
+        "lengths)."
+    )
+
+
+def _pool_write_rows(layer, rows, pages, offsets):
+    """Scatter ``rows [b(, w), heads, head_dim]`` into a page-pool
+    layer dict at ``(pages, offsets)`` (same leading shape; entries
+    with ``page == num_pages`` drop — the OOB sentinel covering
+    inactive slots, unallocated table entries, and padding rows).
+    Quantizes inline when the layer carries scale arrays (int8 pools —
+    see ``ops.quantizers.quantize_kv_rows``). Returns the updated
+    layer dict."""
+    out = dict(layer)
+    for name, scale_name in (("k", "k_scale"), ("v", "v_scale")):
+        buf = layer[name]
+        vals = rows[name]
+        if scale_name in layer:
+            from zookeeper_tpu.ops import quantize_kv_rows
+
+            q, s = quantize_kv_rows(vals)
+            out[name] = buf.at[pages, offsets].set(q, mode="drop")
+            out[scale_name] = layer[scale_name].at[pages, offsets].set(
+                s, mode="drop"
+            )
+        else:
+            out[name] = buf.at[pages, offsets].set(
+                vals.astype(buf.dtype), mode="drop"
+            )
+    return out
+
+
+def _pool_scales(layer):
+    return layer.get("k_scale"), layer.get("v_scale")
+
+
 def _resolve_decode_attention(decode_attention):
     """``"reference"`` / ``"pallas"`` / any ``callable(q, k_cache,
     v_cache, lengths)`` — the decode-path analogue of
@@ -237,6 +295,100 @@ class _Block(nn.Module):
         o = verify_cached_attention(q, k_cache, v_cache, lengths)
         x = x + self.wproj(o.reshape(b, w, self.d_model))
         return self._mlp(x), k_cache, v_cache
+
+    def decode_paged(
+        self, x, layer, page_table, lengths, attention_override=None
+    ):
+        """The page-pool twin of :meth:`decode` (docs/DESIGN.md §20):
+        ``layer`` is a pool dict (``k``/``v`` ``[num_pages, page_size,
+        heads, head_dim]``, plus scale arrays for int8 pools) shared by
+        EVERY slot; the new position's K/V row lands at ``(page_table[
+        slot, lengths // page_size], lengths % page_size)`` — the
+        indirected write — and the attention reads through the table
+        (``ops.pool_decode_attention`` or the injected kernel). A slot
+        whose write target is unallocated (``-1`` table entry, or an
+        inactive slot past its pages) drops the write via the OOB page
+        sentinel — the paged analogue of the §15 clamp, and like it
+        only ever taken by slots whose output is discarded."""
+        b = x.shape[0]
+        head_dim = self.d_model // self.num_heads
+        num_pages, ps = layer["k"].shape[0], layer["k"].shape[1]
+
+        h = self.ln1(x)
+        qkv = self.wqkv(h)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        to_heads = lambda t: t.reshape(b, 1, self.num_heads, head_dim)
+        q, k, v = to_heads(q), to_heads(k), to_heads(v)
+        row = jnp.clip(lengths // ps, 0, page_table.shape[1] - 1)
+        page = jnp.take_along_axis(page_table, row[:, None], axis=1)[:, 0]
+        page = jnp.where(
+            (page < 0) | (lengths >= page_table.shape[1] * ps),
+            num_pages,
+            page,
+        )
+        off = lengths % ps
+        layer = _pool_write_rows(
+            layer, {"k": k[:, 0], "v": v[:, 0]}, page, off
+        )
+        attn = (
+            attention_override
+            if attention_override is not None
+            else _resolve_paged_attention(self.decode_attention)
+        )
+        k_scale, v_scale = _pool_scales(layer)
+        o = attn(
+            q, layer["k"], layer["v"], page_table, lengths,
+            k_scale=k_scale, v_scale=v_scale,
+        )
+        x = x + self.wproj(o.reshape(b, 1, self.d_model))
+        return self._mlp(x), layer
+
+    def decode_verify_paged(
+        self, x, layer, page_table, lengths, valid=None,
+        attention_override=None,
+    ):
+        """The page-pool twin of :meth:`decode_verify`: all ``w``
+        window rows scatter through the page table in one dispatch
+        (position ``lengths + j`` → its table-resolved page/offset, so
+        a window crossing a page boundary just lands in two pages), and
+        every position attends cache+window through
+        ``ops.pool_verify_attention``. ``valid [b]`` bounds how many
+        window rows are REAL per slot (the warm-prefix extend program's
+        padding rows write nowhere — OOB sentinel); None = all ``w``
+        (the speculative verify, whose eligibility check already
+        guarantees the pages exist). Rollback stays by-length."""
+        b, w, _ = x.shape
+        head_dim = self.d_model // self.num_heads
+        num_pages, ps = layer["k"].shape[0], layer["k"].shape[1]
+
+        h = self.ln1(x)
+        qkv = self.wqkv(h)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        to_heads = lambda t: t.reshape(b, w, self.num_heads, head_dim)
+        q, k, v = to_heads(q), to_heads(k), to_heads(v)
+        pos = lengths[:, None] + jnp.arange(w)[None, :]
+        row = jnp.clip(pos // ps, 0, page_table.shape[1] - 1)
+        page = jnp.take_along_axis(page_table, row, axis=1)
+        dead = (page < 0) | (pos >= page_table.shape[1] * ps)
+        if valid is not None:
+            dead = dead | (jnp.arange(w)[None, :] >= valid[:, None])
+        page = jnp.where(dead, num_pages, page)
+        off = pos % ps
+        layer = _pool_write_rows(layer, {"k": k, "v": v}, page, off)
+        k_scale, v_scale = _pool_scales(layer)
+        from zookeeper_tpu.ops import pool_verify_attention
+
+        attn = (
+            attention_override
+            if attention_override is not None
+            else pool_verify_attention
+        )
+        o = attn(
+            q, layer["k"], layer["v"], page_table, lengths,
+            k_scale=k_scale, v_scale=v_scale,
+        )
+        x = x + self.wproj(o.reshape(b, w, self.d_model))
+        return self._mlp(x), layer
 
 
 def _auto_pin_activations(attention, pin_activations):
@@ -457,6 +609,79 @@ class TransformerLMModule(nn.Module):
                 x, layer["k"], layer["v"], lengths
             )
             new_cache.append({"k": kc, "v": vc})
+        return self._logits(x), tuple(new_cache)
+
+    def decode_step_paged(
+        self, tokens, lengths, cache, page_table, attention_override=None
+    ):
+        """:meth:`decode_step` over a SHARED page pool (docs/DESIGN.md
+        §20): ``cache`` is a per-layer tuple of pool dicts (``k``/``v``
+        ``[num_pages, page_size, heads, head_dim]``, plus
+        ``k_scale``/``v_scale`` for int8 pools), ``page_table [b,
+        max_pages] int32`` resolves each sequence's logical pages.
+        Same contract otherwise — the caller owns lengths, the new K/V
+        row is written (through the table) before attending, and
+        ``attention_override`` is the engine's paged-flavor seam
+        (``callable(q, k_pool, v_pool, page_table, lengths, *,
+        k_scale=None, v_scale=None)``)."""
+        if len(cache) != self.num_layers:
+            raise ValueError(
+                f"cache has {len(cache)} layers, model has "
+                f"{self.num_layers}."
+            )
+        pos_idx = jnp.clip(lengths, 0, self.max_seq_len - 1)
+        x = (self.embed[tokens] + self.pos[pos_idx]).astype(self.dtype)
+        x = x[:, None, :]
+        if self._pin():
+            x = constrain_batch_sharded(x)
+        new_cache = []
+        for block, layer in zip(self.blocks, cache):
+            x, new_layer = block.decode_paged(
+                x, layer, page_table, lengths,
+                attention_override=attention_override,
+            )
+            new_cache.append(new_layer)
+        return self._logits(x)[:, 0], tuple(new_cache)
+
+    def decode_verify_paged(
+        self, tokens, lengths, cache, page_table, valid=None,
+        attention_override=None,
+    ):
+        """:meth:`decode_verify` over a shared page pool: ``w`` window
+        tokens per sequence scatter through the page table in one
+        dispatch (windows cross page boundaries freely) and every
+        position's logits come back for acceptance scoring — ALSO the
+        warm-prefix extend program (docs/DESIGN.md §20): a prompt whose
+        prefix is cache-resident enters here with the SUFFIX as the
+        window (``valid [b]`` = true suffix lengths; padding rows write
+        nowhere), each suffix position attending the shared prefix
+        pages it never recomputed — which is the entire TTFT win."""
+        if len(cache) != self.num_layers:
+            raise ValueError(
+                f"cache has {len(cache)} layers, model has "
+                f"{self.num_layers}."
+            )
+        if tokens.ndim != 2:
+            raise ValueError(
+                f"decode_verify_paged expects [batch, w] int tokens, "
+                f"got shape {tokens.shape}."
+            )
+        w = tokens.shape[1]
+        pos_idx = jnp.clip(
+            lengths[:, None] + jnp.arange(w)[None, :],
+            0,
+            self.max_seq_len - 1,
+        )
+        x = (self.embed[tokens] + self.pos[pos_idx]).astype(self.dtype)
+        if self._pin():
+            x = constrain_batch_sharded(x)
+        new_cache = []
+        for block, layer in zip(self.blocks, cache):
+            x, new_layer = block.decode_verify_paged(
+                x, layer, page_table, lengths, valid=valid,
+                attention_override=attention_override,
+            )
+            new_cache.append(new_layer)
         return self._logits(x), tuple(new_cache)
 
 

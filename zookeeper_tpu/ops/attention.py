@@ -476,6 +476,331 @@ def sharded_paged_decode_attention(
     return fn(q, k_cache, v_cache, lengths)
 
 
+def _gathered_pool_view(pool, page_table, scale=None):
+    """A slot-contiguous view of a shared page pool: gather each slot's
+    pages by ``page_table`` and flatten the (pages, page_size) axes back
+    into the familiar ``[slots, capacity_view, heads, head_dim]`` cache
+    layout, dequantizing int8 pools inline (``scale [num_pages,
+    page_size, heads]`` — see ``ops.quantizers.quantize_kv_rows``).
+    Rows in unallocated table entries (clipped to page 0) and garbage
+    rows beyond a slot's length are harmless by the validity invariant:
+    every pool-attention consumer masks ``j > lengths`` to the finite
+    ``_MASK_VALUE``, whose softmax weight underflows to exactly 0.0 —
+    the same argument the slot-layout refill contract makes."""
+    idx = jnp.clip(page_table, 0, pool.shape[0] - 1)
+    g = pool[idx]  # [slots, max_pages, page_size, heads, head_dim]
+    if scale is not None:
+        g = g.astype(jnp.float32) * scale[idx][..., None]
+    b, m, ps, h, d = g.shape
+    return g.reshape(b, m * ps, h, d)
+
+
+def pool_decode_attention(
+    q: jax.Array,
+    k_pool: jax.Array,
+    v_pool: jax.Array,
+    page_table: jax.Array,
+    lengths: jax.Array,
+    *,
+    k_scale: Optional[jax.Array] = None,
+    v_scale: Optional[jax.Array] = None,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Single-position decode attention over a SHARED page pool — the
+    page-indirected counterpart of :func:`cached_attention`
+    (docs/DESIGN.md §20).
+
+    Shapes: ``q [slots, 1, heads, head_dim]``, ``k_pool/v_pool
+    [num_pages, page_size, heads, head_dim]`` (the device-resident
+    pools every slot's pages live in), ``page_table [slots, max_pages]
+    int32`` (each slot's logical page ``p`` lives at pool index
+    ``page_table[slot, p]``; unallocated entries may be negative —
+    they are clipped for the gather and masked by ``lengths``),
+    ``lengths [slots]`` as in :func:`cached_attention`. Optional
+    ``k_scale/v_scale [num_pages, page_size, heads]`` dequantize int8
+    pools inline.
+
+    Numerics: the gathered view holds BIT-identical rows to the
+    slot-contiguous cache at every live index (same values, written
+    once), and the math below IS :func:`cached_attention` op for op —
+    so fp paged decode is bit-identical to slots-mode decode, and the
+    token-parity certification composes transitively through the
+    full-context oracle. int8 pools add one exactly-representable
+    ``int8 × fp32 scale`` multiply before the same einsums
+    (documented-ULP, argmax-pinned by the §20 sweep).
+    """
+    kc = _gathered_pool_view(k_pool, page_table, k_scale)
+    vc = _gathered_pool_view(v_pool, page_table, v_scale)
+    return cached_attention(q, kc, vc, lengths, scale=scale)
+
+
+def pool_verify_attention(
+    q: jax.Array,
+    k_pool: jax.Array,
+    v_pool: jax.Array,
+    page_table: jax.Array,
+    lengths: jax.Array,
+    *,
+    k_scale: Optional[jax.Array] = None,
+    v_scale: Optional[jax.Array] = None,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Multi-position (speculative verify / warm-prefix extend)
+    attention over a shared page pool — the page-indirected counterpart
+    of :func:`verify_cached_attention`: window position ``j`` attends
+    pool rows ``0..lengths+j`` through the slot's page table. Same
+    shapes/contract as the slot-layout verify with the pool operands of
+    :func:`pool_decode_attention`; at ``w == 1`` it computes exactly
+    what :func:`pool_decode_attention` computes."""
+    kc = _gathered_pool_view(k_pool, page_table, k_scale)
+    vc = _gathered_pool_view(v_pool, page_table, v_scale)
+    return verify_cached_attention(q, kc, vc, lengths, scale=scale)
+
+
+def pool_paged_decode_attention(
+    q: jax.Array,
+    k_pool: jax.Array,
+    v_pool: jax.Array,
+    page_table: jax.Array,
+    lengths: jax.Array,
+    *,
+    k_scale: Optional[jax.Array] = None,
+    v_scale: Optional[jax.Array] = None,
+    scale: Optional[float] = None,
+    block_h: Optional[int] = None,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Pallas TPU decode attention reading a SHARED page pool through
+    per-slot page tables — :func:`paged_decode_attention` with its
+    scalar-prefetch index map extended from "clamped contiguous block"
+    to "page-table entry" (docs/DESIGN.md §20).
+
+    Same contract as :func:`pool_decode_attention`; different cost
+    model: the grid is (slot, head-block, logical-page) with BOTH
+    ``lengths`` and ``page_table`` as scalar-prefetch operands, so the
+    KV index map resolves each logical page to its pool index at DMA
+    time — dead pages re-select the slot's last live page (no DMA for
+    a repeated index, the §17 length-bounded-read property, now
+    composed with indirection). The KV block is exactly one page: a
+    larger block cannot be contiguous in a pool whose pages are
+    allocator-scattered. int8 pools ride the same grid with the scale
+    pages as a fourth/fifth operand, dequantized in VMEM — resident
+    HBM bytes halve, and the read bound stays page-granular.
+
+    Numerics: fp32 online-softmax accumulation with the reference's
+    finite mask value — same contract (documented-ULP vs the pool
+    reference, argmax token-exact) as the §17 kernel.
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    if q.ndim != 4 or q.shape[1] != 1:
+        raise ValueError(
+            f"pool_paged_decode_attention expects q [slots, 1, heads, "
+            f"head_dim], got {q.shape}."
+        )
+    if k_pool.shape != v_pool.shape or k_pool.ndim != 4:
+        raise ValueError(
+            f"k_pool/v_pool must be identical [num_pages, page_size, "
+            f"heads, head_dim], got {k_pool.shape} / {v_pool.shape}."
+        )
+    b, _, h, d = q.shape
+    num_pages, ps = k_pool.shape[0], k_pool.shape[1]
+    if k_pool.shape[2] != h or k_pool.shape[3] != d:
+        raise ValueError(f"pool {k_pool.shape} does not match q {q.shape}.")
+    if page_table.ndim != 2 or page_table.shape[0] != b:
+        raise ValueError(
+            f"page_table must be [slots={b}, max_pages], got "
+            f"{page_table.shape}."
+        )
+    if (k_scale is None) != (v_scale is None):
+        raise ValueError("k_scale and v_scale must be given together.")
+    nm = page_table.shape[1]
+    if scale is None:
+        scale = d ** -0.5
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    # Head-block policy: the §17 VMEM discipline with the KV block
+    # pinned to one page (indirection forbids larger contiguous reads).
+    _, block_h = _default_decode_blocks(
+        ps, h, d, page_size=ps, itemsize=q.dtype.itemsize,
+        block_kv=ps, block_h=block_h,
+    )
+    nh = h // block_h
+    scale = float(scale)
+    qs = q.reshape(b, h, d)
+    cap_view = nm * ps
+    lens = jnp.clip(lengths.astype(jnp.int32), 0, cap_view - 1)
+    table = jnp.clip(page_table.astype(jnp.int32), 0, num_pages - 1)
+
+    def q_index_map(s, hb, kb, lens_ref, table_ref):
+        return (s, hb, 0)
+
+    def kv_index_map(s, hb, kb, lens_ref, table_ref):
+        # The indirection step: a logical page resolves through the
+        # slot's table row; dead pages re-select the LAST LIVE page's
+        # pool index, so a repeated index means no DMA and rows past
+        # the length never leave HBM.
+        live = jnp.minimum(kb, lens_ref[s] // ps)
+        return (table_ref[s, live], 0, hb, 0)
+
+    def scale_index_map(s, hb, kb, lens_ref, table_ref):
+        live = jnp.minimum(kb, lens_ref[s] // ps)
+        return (table_ref[s, live], 0, hb)
+
+    quantized = k_scale is not None
+
+    def kernel(lens_ref, table_ref, q_ref, k_ref, v_ref, *rest):
+        if quantized:
+            ks_ref, vs_ref, o_ref, m_ref, l_ref, acc_ref = rest
+        else:
+            o_ref, m_ref, l_ref, acc_ref = rest
+            ks_ref = vs_ref = None
+        s = pl.program_id(0)
+        kb = pl.program_id(2)
+        length = lens_ref[s]
+
+        @pl.when(kb == 0)
+        def _init():
+            m_ref[...] = jnp.full_like(m_ref, _MASK_VALUE)
+            l_ref[...] = jnp.zeros_like(l_ref)
+            acc_ref[...] = jnp.zeros_like(acc_ref)
+
+        @pl.when(kb * ps <= length)
+        def _block():
+            qv = q_ref[0].astype(jnp.float32)  # [block_h, d]
+            kv = k_ref[0].astype(jnp.float32)  # [ps, block_h, d]
+            if quantized:
+                kv = kv * ks_ref[0][:, :, None]
+            sc = jnp.sum(qv[None] * kv, axis=-1) * scale  # [ps, block_h]
+            ki = kb * ps + lax.broadcasted_iota(
+                jnp.int32, (ps, block_h), 0
+            )
+            sc = jnp.where(ki <= length, sc, _MASK_VALUE)
+            m = m_ref[...]  # [1, block_h]
+            m_new = jnp.maximum(m, sc.max(axis=0, keepdims=True))
+            p = jnp.exp(sc - m_new)
+            corr = jnp.exp(m - m_new)
+            m_ref[...] = m_new
+            l_ref[...] = l_ref[...] * corr + p.sum(axis=0, keepdims=True)
+            vv = v_ref[0].astype(jnp.float32)
+            if quantized:
+                vv = vv * vs_ref[0][:, :, None]
+            pv = jnp.sum(p[:, :, None] * vv, axis=0)  # [block_h, d]
+            acc_ref[...] = acc_ref[...] * corr[0][:, None] + pv
+
+        @pl.when(kb == nm - 1)
+        def _finalize():
+            o_ref[0] = (
+                acc_ref[...] / l_ref[...][0][:, None]
+            ).astype(o_ref.dtype)
+
+    in_specs = [
+        pl.BlockSpec((1, block_h, d), q_index_map),
+        pl.BlockSpec((1, ps, block_h, d), kv_index_map),
+        pl.BlockSpec((1, ps, block_h, d), kv_index_map),
+    ]
+    operands = [qs, k_pool, v_pool]
+    if quantized:
+        in_specs += [
+            pl.BlockSpec((1, ps, block_h), scale_index_map),
+            pl.BlockSpec((1, ps, block_h), scale_index_map),
+        ]
+        operands += [
+            k_scale.astype(jnp.float32),
+            v_scale.astype(jnp.float32),
+        ]
+    out_dtype = q.dtype
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, nh, nm),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, block_h, d), q_index_map),
+        scratch_shapes=[
+            pltpu.VMEM((1, block_h), jnp.float32),
+            pltpu.VMEM((1, block_h), jnp.float32),
+            pltpu.VMEM((block_h, d), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, h, d), out_dtype),
+        interpret=interpret,
+    )(lens, table, *operands)
+    return out.reshape(b, 1, h, d)
+
+
+def sharded_pool_paged_decode_attention(
+    q: jax.Array,
+    k_pool: jax.Array,
+    v_pool: jax.Array,
+    page_table: jax.Array,
+    lengths: jax.Array,
+    *,
+    mesh,
+    data_axes=("data",),
+    model_axis: Optional[str] = None,
+    replicated: bool = False,
+    k_scale: Optional[jax.Array] = None,
+    v_scale: Optional[jax.Array] = None,
+    **kernel_kwargs,
+) -> jax.Array:
+    """:func:`pool_paged_decode_attention` wrapped for the sharded
+    decode path. The POOL differs from the slot-contiguous cache in one
+    sharding-relevant way: any slot may reference any page, so pages
+    CANNOT shard over the data axes — the pools (and their scale
+    arrays) shard over ``model_axis`` on the heads dimension only,
+    while q/lengths/page_table shard over ``data_axes`` like batch rows
+    (``parallel.rules.page_pool_rules``). Each device then runs the
+    kernel over its slot shard against its head shard of every page —
+    still ZERO collectives. ``replicated=True`` is the indivisible-
+    geometry fallback, as in §17. Explicit shard_map for the same
+    reason as :func:`sharded_paged_decode_attention`: GSPMD cannot
+    partition an opaque pallas call."""
+    from jax.sharding import PartitionSpec as P
+
+    if replicated:
+        q_spec = pool_spec = t_spec = l_spec = s_spec = P()
+    else:
+        q_spec = P(tuple(data_axes), None, model_axis, None)
+        pool_spec = P(None, None, model_axis, None)
+        s_spec = P(None, None, model_axis)
+        t_spec = P(tuple(data_axes), None)
+        l_spec = P(tuple(data_axes))
+    if (k_scale is None) != (v_scale is None):
+        raise ValueError("k_scale and v_scale must be given together.")
+    if k_scale is None:
+
+        def local(q_, k_, v_, t_, l_):
+            return pool_paged_decode_attention(
+                q_, k_, v_, t_, l_, **kernel_kwargs
+            )
+
+        fn = _shard_map_no_vma_check(
+            local,
+            mesh=mesh,
+            in_specs=(q_spec, pool_spec, pool_spec, t_spec, l_spec),
+            out_specs=q_spec,
+        )
+        return fn(q, k_pool, v_pool, page_table, lengths)
+
+    def local_q(q_, k_, v_, t_, l_, ks_, vs_):
+        return pool_paged_decode_attention(
+            q_, k_, v_, t_, l_, k_scale=ks_, v_scale=vs_, **kernel_kwargs
+        )
+
+    fn = _shard_map_no_vma_check(
+        local_q,
+        mesh=mesh,
+        in_specs=(
+            q_spec, pool_spec, pool_spec, t_spec, l_spec, s_spec, s_spec
+        ),
+        out_specs=q_spec,
+    )
+    return fn(q, k_pool, v_pool, page_table, lengths, k_scale, v_scale)
+
+
 def _shard_map_no_vma_check(local, *, mesh, in_specs, out_specs):
     """shard_map with the varying-manual-axes checker disabled, across
     the kwarg rename history (check_vma >= 0.4.35 > check_rep > none)."""

@@ -234,6 +234,50 @@ def _dorefa_bwd(k_bit, x, g):
 dorefa.defvjp(_dorefa_fwd, _dorefa_bwd)
 
 
+# -- int8 KV-cache quantization ---------------------------------------------
+
+#: Symmetric int8 quantization range for KV rows. 127 (not 128): the
+#: symmetric grid [-127, 127] keeps dequantization a single multiply
+#: with no zero-point, and the one lost code is noise next to the
+#: 1/254 relative step.
+KV_INT8_QMAX = 127.0
+
+
+def quantize_kv_rows(x: Array):
+    """Quantize KV rows to int8 with per-(row, head) scales — the
+    page-pool cache's storage codec (docs/DESIGN.md §20).
+
+    ``x [..., heads, head_dim]`` float; returns ``(q int8 [...], scale
+    float32 [..., heads])`` with ``q = round(x / scale)`` on the
+    symmetric grid and ``scale = max|x| / 127`` over each row's
+    ``head_dim`` lane (per row AND head, never across rows: a KV page
+    fills incrementally, and a coarser per-page scalar would re-scale —
+    i.e. silently corrupt — rows already written when a later row's
+    magnitude moved the scale). Scales are stored page-shaped alongside
+    the pools, so "per-page scale arrays" is the storage layout while
+    the row×head is the quantization granule. All-zero rows get scale 1
+    (exact zeros round-trip). Half-away-from-zero rounding, clipped to
+    the grid."""
+    xf = jnp.asarray(x, jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=-1)
+    scale = jnp.where(amax > 0, amax / KV_INT8_QMAX, 1.0)
+    q = jnp.clip(
+        jnp.round(xf / scale[..., None]),
+        -KV_INT8_QMAX,
+        KV_INT8_QMAX,
+    ).astype(jnp.int8)
+    return q, scale.astype(jnp.float32)
+
+
+def dequantize_kv_rows(q: Array, scale: Array) -> Array:
+    """Inverse of :func:`quantize_kv_rows`: ``q int8 [..., heads,
+    head_dim]`` × ``scale [..., heads]`` → float32 rows. The attention
+    read path applies this inline (the dequantized rows never
+    materialize in HBM — they exist only as the einsum/kernel
+    operand)."""
+    return q.astype(jnp.float32) * jnp.asarray(scale, jnp.float32)[..., None]
+
+
 # -- registry ---------------------------------------------------------------
 
 QUANTIZERS: Dict[str, Callable] = {

@@ -177,6 +177,18 @@ class Partitioner:
         posture ``compile_forward``'s small buckets take."""
         return None
 
+    def page_pool_sharding(self, pool: Any) -> Any:
+        """Sharding pytree for a decode engine's SHARED page-pool state
+        (``kv_layout="paged"``, docs/DESIGN.md §20): per-layer
+        ``k``/``v`` pools ``[num_pages, page_size, heads, head_dim]``
+        (+ int8 scale arrays). Pages replicate over the data axes (any
+        slot references any page), heads shard over the model axis via
+        :func:`zookeeper_tpu.parallel.rules.page_pool_rules`; the
+        engine applies the same divisibility check + replicated
+        fallback as :meth:`decode_cache_sharding`. None = default
+        placement."""
+        return None
+
 
 @component
 class SingleDevicePartitioner(Partitioner):
@@ -464,6 +476,13 @@ class MeshPartitioner(Partitioner):
         data_axes, model_axis = self.decode_cache_axes()
         rules = decode_cache_rules(data_axes, model_axis)
         return self._sharding_from_rules(cache, rules)
+
+    def page_pool_sharding(self, pool: Any) -> Any:
+        from zookeeper_tpu.parallel.rules import page_pool_rules
+
+        data_axes, model_axis = self.decode_cache_axes()
+        rules = page_pool_rules(data_axes, model_axis)
+        return self._sharding_from_rules(pool, rules)
 
     def compile_forward(self, forward_fn, variables, *, batch_rows=None):
         vars_sh = self.variables_sharding(variables)

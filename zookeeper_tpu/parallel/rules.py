@@ -90,6 +90,31 @@ def decode_cache_rules(
     ]
 
 
+def page_pool_rules(
+    data_axes: Sequence[str] = ("data",),
+    model_axis: str = None,
+) -> List[PartitionRule]:
+    """Partition rules for a decode engine's SHARED page-pool state
+    tree (``serving.decode.pages``, docs/DESIGN.md §20): the per-layer
+    ``k``/``v`` pools are ``[num_pages, page_size, heads, head_dim]``
+    and — unlike the slot-contiguous cache — the PAGES dimension cannot
+    shard over the data axes: any slot may reference any page through
+    its page table, so a data-sharded pool would need a cross-device
+    gather per read. HEADS shard over ``model_axis`` exactly as in
+    :func:`decode_cache_rules` (co-sharded with the column-parallel qkv
+    kernel, zero resharding collectives); the int8 scale arrays
+    ``[num_pages, page_size, heads]`` co-shard their heads dimension.
+    ``data_axes`` is accepted for signature parity (the q/lengths/table
+    OPERANDS shard over it — see
+    ``ops.sharded_pool_paged_decode_attention``) but the pool state
+    itself replicates over it."""
+    P = PartitionSpec
+    return [
+        (r"(^|/)(k|v)$", P(None, None, model_axis, None)),
+        (r"(^|/)(k_scale|v_scale)$", P(None, None, model_axis)),
+    ]
+
+
 def auto_fsdp_rules(
     params: Any,
     axis_size: int,

@@ -23,6 +23,14 @@ The token-streaming half of the serving stack — the ROADMAP's
 - :class:`LMServingConfig` — the config-system citizen tying model +
   checkpoint + engine + scheduler into a CLI task
   (``examples/serve_lm.py``).
+- :mod:`~zookeeper_tpu.serving.decode.pages` — TRUE paged KV
+  (docs/DESIGN.md §20, ``engine.kv_layout="paged"``): a SHARED device
+  page pool with per-slot page tables as runtime operands
+  (:class:`PagePool` — free-list/refcount allocator), a radix prefix
+  cache over prompt prefixes with copy-on-write at the divergence
+  point (:class:`RadixPrefixCache` — warm-prefix admissions skip
+  prefill for shared pages), and optional int8 KV quantization with
+  per-row scales dequantized inside the attention read.
 - :class:`SpeculativeDecoding` — the draft/verify schedule
   (docs/DESIGN.md §18): a small draft model proposes ``k`` tokens per
   slot, one teacher ``decode_verify`` dispatch scores the whole window
@@ -38,6 +46,12 @@ from zookeeper_tpu.serving.decode.cache import (
     pages_in_use,
 )
 from zookeeper_tpu.serving.decode.engine import DecodeEngine
+from zookeeper_tpu.serving.decode.pages import (
+    PagePool,
+    RadixPrefixCache,
+    allocate_page_pool,
+    page_pool_bytes,
+)
 from zookeeper_tpu.serving.decode.metrics import DecodeMetrics
 from zookeeper_tpu.serving.decode.scheduler import (
     DecodeScheduler,
@@ -52,9 +66,13 @@ __all__ = [
     "DecodeScheduler",
     "DecodeStream",
     "LMServingConfig",
+    "PagePool",
+    "RadixPrefixCache",
     "SpeculativeDecoding",
     "allocate_kv_cache",
+    "allocate_page_pool",
     "append_kv_rows",
     "kv_cache_bytes",
+    "page_pool_bytes",
     "pages_in_use",
 ]

@@ -7,14 +7,17 @@ donated through every prefill/decode dispatch (the update is in-place;
 the cache never round-trips the host). ``capacity`` is page-aligned
 (rounded up to a multiple of ``page_size``); the pages of one slot are
 contiguous — a ring of SLOTS rather than an indirection table of
-pages, because page indirection buys no memory here (every slot's
-worst case must be provisioned anyway) while costing a scatter/gather
-on the hot path. The paged decode-attention kernel (§17,
-``ops.paged_decode_attention``) consumes this layout AS IS: it walks a
-slot's contiguous pages in page-nested blocks and stops at the slot's
-length, so the length-bounded HBM read needed no layout change — and
-per-slot worst-case provisioning is what it deliberately does NOT
-change (an indirection table remains the future overcommit step). Page
+pages: zero indirection on the hot path, at the cost of per-slot
+worst-case provisioning. The indirection step now EXISTS as the
+sibling layout (``pages.py`` / ``DecodeEngine.kv_layout="paged"``,
+docs/DESIGN.md §20 — shared page pool, page tables as runtime
+operands, prefix reuse with copy-on-write, int8 quantization); THIS
+module remains the default and the right choice when slots × capacity
+fits HBM and prompts share nothing (§20's decision rule). The paged
+decode-attention kernel (§17, ``ops.paged_decode_attention``)
+consumes this layout AS IS: it walks a slot's contiguous pages in
+page-nested blocks and stops at the slot's length, so the
+length-bounded HBM read needed no layout change. Page
 granularity also does real work host-side: ``pages_in_use`` is the
 occupancy number the ``zk_decode_kv_pages_in_use`` gauge and
 ``/statusz`` report, and ``kv_cache_bytes`` feeds the
@@ -34,9 +37,9 @@ Multi-token append (docs/DESIGN.md §18): the speculative-decode verify
 program writes ``w`` rows per slot in ONE dispatch —
 :func:`append_kv_rows` is the primitive, a per-slot
 ``dynamic_update_slice`` along the capacity axis at each slot's
-``length`` (the stepping stone to true page indirection, ROADMAP item
-4: the write is already expressed as "rows at an offset", not "the next
-ring position"). Rollback rides the SAME validity invariant, by
+``length`` (the write expressed as "rows at an offset", not "the next
+ring position" — exactly the shape the §20 paged layout's
+table-resolved scatter generalizes). Rollback rides the SAME validity invariant, by
 construction: a rejected draft suffix is "un-appended" simply by not
 advancing ``length`` past the accepted prefix — the rejected rows sit
 at ``j >= length`` where every attention path masks them and every

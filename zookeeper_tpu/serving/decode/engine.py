@@ -34,11 +34,20 @@ weights are never donated and are read through ONE reference per
 dispatch — ``swap_weights`` is therefore atomic per dispatch exactly
 like the forward engine's (the per-SEQUENCE weight-version contract
 lives a level up, in ``DecodeScheduler.request_swap``).
+
+Two eras extend the grid without changing the discipline: the
+speculative ``verify_step`` family (docs/DESIGN.md §18, one compile
+per window width), and ``kv_layout="paged"`` (§20) — the same program
+shapes re-expressed over a SHARED page pool with per-slot page tables
+as runtime operands, plus the warm-prefix ``prefill_extend`` family
+(suffix-only admission over cache-resident prefix pages) and the
+one-page ``copy_page`` CoW primitive. Every member is AOT-warmed and
+ledgered; ``compile_count`` still pins at zero growth under traffic.
 """
 
 import logging
 import time
-from typing import Any, Sequence
+from typing import Any, Optional, Sequence
 
 import numpy as np
 
@@ -48,6 +57,11 @@ from zookeeper_tpu.serving.decode.cache import (
     allocate_kv_cache,
     kv_cache_bytes,
     pages_in_use,
+)
+from zookeeper_tpu.serving.decode.pages import (
+    PagePool,
+    allocate_page_pool,
+    page_pool_bytes,
 )
 
 logger = logging.getLogger(__name__)
@@ -112,6 +126,35 @@ DecodeScheduler`.
     #: programs ledger as ``draft_prefill`` / ``draft_decode_step`` /
     #: ``draft_verify_step`` next to the teacher's.
     ledger_prefix: str = Field("")
+    #: KV storage layout (docs/DESIGN.md §20): "slots" (the §15
+    #: per-slot contiguous buffers — worst-case provisioned, zero
+    #: indirection on the hot path; the certified default) or "paged"
+    #: (a SHARED device page pool + per-slot page tables as runtime
+    #: operands: capacity is pooled across slots, warm prompt prefixes
+    #: share pages through the radix prefix cache with copy-on-write,
+    #: and admission sheds on pool exhaustion instead of slot count
+    #: alone). Token-parity discipline is identical in both layouts.
+    kv_layout: str = Field("slots")
+    #: Total pool pages per layer (paged layout only). -1 sizes the
+    #: pool to ``slots × capacity/page_size`` — worst-case parity with
+    #: the slot layout, useful for certification; production sets it
+    #: SMALLER than worst case (that is the entire point of pooling:
+    #: resident tokens are bounded by actual lengths, not slot count ×
+    #: capacity) with admission shedding as the backstop.
+    pool_pages: int = Field(-1)
+    #: KV quantization for the paged pool: "none" (rows in the model
+    #: compute dtype) or "int8" (rows stored int8 with page-shaped
+    #: per-(row, head) float32 scales, dequantized inside the attention
+    #: read — double the resident tokens per HBM byte, documented-ULP
+    #: numerics; docs/DESIGN.md §20).
+    kv_quant: str = Field("none")
+    #: Radix prefix cache over prompt prefixes (paged layout only):
+    #: warm-prefix admissions skip prefill for cache-resident pages
+    #: (the warm-extend program computes only the suffix) with
+    #: copy-on-write at the divergence point and LRU eviction under
+    #: pool pressure. Off = every admission prefills cold (the pool
+    #: still pools capacity).
+    prefix_cache: bool = Field(True)
 
     # -- binding ---------------------------------------------------------
 
@@ -202,6 +245,51 @@ DecodeScheduler`.
                 f"decode_attention={self.decode_attention!r}: expected "
                 "'auto', 'pallas', 'reference', or 'module'."
             )
+        if str(self.kv_layout) not in ("slots", "paged"):
+            raise ValueError(
+                f"kv_layout={self.kv_layout!r}: expected 'slots' or "
+                "'paged'."
+            )
+        if str(self.kv_quant) not in ("none", "int8"):
+            raise ValueError(
+                f"kv_quant={self.kv_quant!r}: expected 'none' or 'int8'."
+            )
+        paged = str(self.kv_layout) == "paged"
+        if not paged and str(self.kv_quant) != "none":
+            raise ValueError(
+                "kv_quant='int8' requires kv_layout='paged' (the slot "
+                "layout stores rows in the compute dtype; quantization "
+                "lives with the page pool — docs/DESIGN.md §20)."
+            )
+        max_pages = capacity // int(self.page_size)
+        if paged:
+            for method in ("decode_step_paged", "decode_verify_paged"):
+                if not hasattr(module, method):
+                    raise ValueError(
+                        f"kv_layout='paged' needs a module with a "
+                        f"{method!r} apply method (the page-pool decode "
+                        "seam — see TransformerLMModule); got "
+                        f"{type(module).__name__}."
+                    )
+            if self.pool_pages == -1:
+                num_pages = int(self.slots) * max_pages
+            elif self.pool_pages > 0:
+                num_pages = int(self.pool_pages)
+            else:
+                raise ValueError(
+                    f"pool_pages={self.pool_pages}: expected a positive "
+                    "page count or -1 (worst-case parity with the slot "
+                    "layout)."
+                )
+            if num_pages < max_pages:
+                raise ValueError(
+                    f"pool_pages={num_pages} below capacity/page_size="
+                    f"{max_pages}: one full-capacity sequence could "
+                    "never be served; raise pool_pages or shrink "
+                    "kv_capacity."
+                )
+        else:
+            num_pages = 0
         if partitioner is None:
             from zookeeper_tpu.parallel.partitioner import (
                 SingleDevicePartitioner,
@@ -215,6 +303,24 @@ DecodeScheduler`.
         object.__setattr__(self, "_prefill_buckets", prefill_buckets)
         object.__setattr__(self, "_capacity", capacity)
         object.__setattr__(self, "_position_cap", position_cap)
+        object.__setattr__(self, "_paged", paged)
+        object.__setattr__(self, "_num_pages", num_pages)
+        object.__setattr__(self, "_max_pages", max_pages)
+        # Host-side page allocator + table + radix prefix cache
+        # (docs/DESIGN.md §20). The device pool tree rides _cache.
+        object.__setattr__(
+            self,
+            "_pool",
+            PagePool(
+                num_pages=num_pages,
+                page_size=int(self.page_size),
+                slots=int(self.slots),
+                max_pages_per_slot=max_pages,
+                prefix_cache=bool(self.prefix_cache),
+            )
+            if paged
+            else None,
+        )
 
         variables = {"params": params, **dict(model_state or {})}
         object.__setattr__(
@@ -227,7 +333,11 @@ DecodeScheduler`.
         cache_sharding = None
         cache_replicated = mesh is not None
         if mesh is not None:
-            cache_sharding = partitioner.decode_cache_sharding(cache)
+            cache_sharding = (
+                partitioner.page_pool_sharding(cache)
+                if paged
+                else partitioner.decode_cache_sharding(cache)
+            )
             if cache_sharding is not None:
                 # Divisibility: slots over the data axes, heads over the
                 # model axis. When the shapes cannot split, fall back to
@@ -259,14 +369,26 @@ DecodeScheduler`.
         object.__setattr__(self, "_cache_sharding", cache_sharding)
         object.__setattr__(self, "_cache_replicated", cache_replicated)
         object.__setattr__(self, "_cache", self._place_cache(cache))
-        object.__setattr__(self, "_cache_nbytes", kv_cache_bytes(
-            int(module.num_layers),
-            int(self.slots),
-            capacity,
-            int(module.num_heads),
-            head_dim,
-            np.dtype(module.dtype).itemsize,
-        ))
+        if paged:
+            nbytes = page_pool_bytes(
+                int(module.num_layers),
+                num_pages,
+                int(self.page_size),
+                int(module.num_heads),
+                head_dim,
+                np.dtype(module.dtype).itemsize,
+                quant=str(self.kv_quant),
+            )
+        else:
+            nbytes = kv_cache_bytes(
+                int(module.num_layers),
+                int(self.slots),
+                capacity,
+                int(module.num_heads),
+                head_dim,
+                np.dtype(module.dtype).itemsize,
+            )
+        object.__setattr__(self, "_cache_nbytes", nbytes)
         object.__setattr__(self, "_compiled_cache", {})
         object.__setattr__(self, "_compile_count", 0)
         object.__setattr__(self, "_warmed", False)
@@ -297,6 +419,7 @@ DecodeScheduler`.
         from zookeeper_tpu import ops
 
         module = self._module
+        paged = bool(getattr(self, "_paged", False))
         choice = str(self.decode_attention)
         if choice == "module":
             return "module", None
@@ -304,8 +427,11 @@ DecodeScheduler`.
             choice = (
                 "pallas" if jax.default_backend() == "tpu" else "reference"
             )
+        reference = (
+            ops.pool_decode_attention if paged else ops.cached_attention
+        )
         if choice == "reference":
-            return "reference", ops.cached_attention
+            return "reference", reference
         heads = int(module.num_heads)
         head_dim = int(module.d_model) // heads
         if not ops.decode_attention_supported(heads, head_dim):
@@ -316,26 +442,35 @@ DecodeScheduler`.
                 "REFERENCE einsum instead",
                 head_dim,
             )
-            return "reference", ops.cached_attention
+            return "reference", reference
         from functools import partial
 
-        kernel_kwargs = {"page_size": int(self.page_size)}
         mesh = self._partitioner.mesh
         if mesh is None:
+            if paged:
+                # Page size / block policy come from the pool shapes.
+                return "pallas", ops.pool_paged_decode_attention
             return "pallas", partial(
-                ops.paged_decode_attention, **kernel_kwargs
+                ops.paged_decode_attention, page_size=int(self.page_size)
             )
-        # The SAME axis derivation decode_cache_sharding used for the
-        # cache placement: a disagreement here would make GSPMD reshard
-        # the cache around the kernel every dispatch.
+        # The SAME axis derivation the cache placement used: a
+        # disagreement here would make GSPMD reshard the cache around
+        # the kernel every dispatch.
         data_axes, model_axis = self._partitioner.decode_cache_axes()
-        return "pallas", partial(
-            ops.sharded_paged_decode_attention,
+        sharded_kwargs = dict(
             mesh=mesh,
             data_axes=data_axes,
             model_axis=model_axis,
             replicated=bool(self._cache_replicated),
-            **kernel_kwargs,
+        )
+        if paged:
+            return "pallas", partial(
+                ops.sharded_pool_paged_decode_attention, **sharded_kwargs
+            )
+        return "pallas", partial(
+            ops.sharded_paged_decode_attention,
+            page_size=int(self.page_size),
+            **sharded_kwargs,
         )
 
     def _publish_bind_gauges(self) -> None:
@@ -450,14 +585,27 @@ DecodeScheduler`.
     def _allocate_cache(self):
         """The ONE cache-geometry call (``bind`` and ``_reset_cache``
         must allocate identical trees — a layout change made in one
-        place would serve post-crash resubmits from a diverged cache)."""
+        place would serve post-crash resubmits from a diverged cache).
+        Layout-dispatched: the slot-contiguous buffers or the shared
+        page pool (docs/DESIGN.md §20)."""
         module = self._module
+        head_dim = int(module.d_model) // int(module.num_heads)
+        if getattr(self, "_paged", False):
+            return allocate_page_pool(
+                int(module.num_layers),
+                self._num_pages,
+                int(self.page_size),
+                int(module.num_heads),
+                head_dim,
+                module.dtype,
+                quant=str(self.kv_quant),
+            )
         return allocate_kv_cache(
             int(module.num_layers),
             int(self.slots),
             self._capacity,
             int(module.num_heads),
-            int(module.d_model) // int(module.num_heads),
+            head_dim,
             module.dtype,
         )
 
@@ -481,10 +629,16 @@ DecodeScheduler`.
         dispatch would die on deleted arrays, breaking the scheduler's
         resubmit-after-restart contract. A zeroed cache is consistent:
         a crash fails every in-flight stream, so no slot's previous
-        contents are live."""
+        contents are live. In the paged layout the HOST allocator is
+        reset with the device pool (refcounts zeroed, every page free,
+        prefix trie dropped — its nodes indexed bytes that no longer
+        exist): the chaos suite pins zero leaked pages across this
+        path."""
         object.__setattr__(
             self, "_cache", self._place_cache(self._allocate_cache())
         )
+        if getattr(self, "_pool", None) is not None:
+            self._pool.reset()
 
     # -- geometry --------------------------------------------------------
 
@@ -518,9 +672,95 @@ DecodeScheduler`.
         return self._cache_nbytes
 
     def kv_pages_in_use(self, lengths) -> int:
-        """Occupancy accounting for the gauge/statusz (``lengths`` are
-        the ACTIVE slots' token counts)."""
+        """Occupancy accounting for the gauge/statusz. The paged layout
+        reports the REAL allocator count (pages the free list has
+        handed out — prefix-cache-retained pages included, because they
+        genuinely occupy pool HBM); the slot layout keeps the §15
+        host-side estimate ``Σ ceil(len/page)`` over the ACTIVE slots'
+        ``lengths``."""
+        if getattr(self, "_paged", False):
+            return int(self._pool.used_pages)
         return pages_in_use(lengths, int(self.page_size))
+
+    # -- page lifecycle (the scheduler-facing paged surface) -------------
+    #
+    # Every method is callable in BOTH layouts so the scheduler never
+    # branches on kv_layout: the slot layout answers with the trivial
+    # (always-cold, always-fits, nothing-to-release) degenerate.
+
+    @property
+    def paged(self) -> bool:
+        self._require_bound()
+        return bool(self._paged)
+
+    @property
+    def page_pool(self):
+        """The host-side :class:`~zookeeper_tpu.serving.decode.pages.\
+PagePool` (None in the slot layout)."""
+        self._require_bound()
+        return self._pool
+
+    def admit_slot(
+        self, slot: int, prompt, *, copy: bool = True
+    ) -> Optional[dict]:
+        """Admission-time page allocation for ``slot``'s ``prompt``
+        (docs/DESIGN.md §20): prefix-cache lookup, page-table row
+        build, and (``copy=True``) copy-on-write execution for a
+        mid-page divergence. Returns the plan (``{"shared_tokens":
+        int}``, plus the pending ``"cow": (src, dst)`` when
+        ``copy=False`` — the scheduler's split: host bookkeeping under
+        its lock, the device copy outside via :meth:`copy_page`) or
+        None when the pool is exhausted (nothing allocated — the
+        caller requeues or sheds). Slot layout: always the trivial
+        cold plan."""
+        if not getattr(self, "_paged", False):
+            return {"shared_tokens": 0, "cow": None}
+        plan = self._pool.assign_prompt(int(slot), prompt)
+        if plan is None:
+            return None
+        if copy:
+            cow = plan.pop("cow")
+            if cow is not None:
+                self.copy_page(*cow)
+            plan["cow"] = None
+        return plan
+
+    def ensure_rows(self, slot: int, rows: int) -> bool:
+        """Pre-dispatch guarantee that ``slot``'s pages cover ``rows``
+        total KV rows (decode needs ``length + 1``; a verify window
+        ``length + w``). False = pool exhausted after prefix-cache
+        eviction. Slot layout: trivially True (capacity is
+        pre-provisioned)."""
+        if not getattr(self, "_paged", False):
+            return True
+        return self._pool.ensure_rows(int(slot), int(rows))
+
+    def release_slot(self, slot: int) -> None:
+        """Stream finished/failed: drop the slot's page references
+        (prefix-cache-shared pages stay resident for warm hits)."""
+        if getattr(self, "_paged", False):
+            self._pool.release_slot(int(slot))
+
+    def insert_prefix(self, slot: int, prompt) -> int:
+        """Cache the admitted prompt's pages for future warm hits
+        (called after the prefill/extend dispatch landed them)."""
+        if not getattr(self, "_paged", False):
+            return 0
+        return self._pool.insert_prefix(int(slot), prompt)
+
+    def invalidate_prefix_cache(self) -> int:
+        """Drop every cached prefix page (weight hot-swap: cached K/V
+        belongs to the OLD weights). Returns nodes dropped."""
+        if not getattr(self, "_paged", False):
+            return 0
+        return self._pool.invalidate_prefix()
+
+    def pool_status(self) -> Optional[dict]:
+        """The ``/statusz`` ``kv_pool`` sub-section (None in the slot
+        layout)."""
+        if not getattr(self, "_paged", False):
+            return None
+        return self._pool.status()
 
     @property
     def compile_count(self) -> int:
@@ -596,12 +836,24 @@ DecodeScheduler`.
             return None
         return NamedSharding(mesh, PartitionSpec())
 
-    def _aot(self, key: str, fn, example_args, *, donate_cache_at: int):
+    def _aot(
+        self,
+        key: str,
+        fn,
+        example_args,
+        *,
+        donate_cache_at: int,
+        with_variables: bool = True,
+        cache_only_output: bool = False,
+    ):
         """AOT lower+compile ``fn`` with the engine's sharding
         discipline, timed and recorded in the process ProgramLedger
-        under ``key`` ('prefill' / 'decode_step' / 'verify_step',
-        ``ledger_prefix``-tagged — a draft engine's programs ledger as
-        ``draft_*``)."""
+        under ``key`` ('prefill' / 'decode_step' / 'verify_step' /
+        'copy_page', ``ledger_prefix``-tagged — a draft engine's
+        programs ledger as ``draft_*``). ``with_variables=False`` is
+        the variables-free program shape (``copy_page``: cache first);
+        ``cache_only_output=True`` marks programs returning ONLY the
+        donated cache tree instead of ``(cache, out)``."""
         import jax
 
         key = str(self.ledger_prefix) + key
@@ -611,14 +863,25 @@ DecodeScheduler`.
             jitted = jax.jit(fn, donate_argnums=(donate_cache_at,))
         else:
             repl = self._replicated()
-            vars_sh = self._partitioner.variables_sharding(self._variables)
-            if vars_sh is None:
-                vars_sh = jax.tree.map(lambda _: repl, self._variables)
             cache_sh = self._cache_sharding
-            in_shardings = [vars_sh, cache_sh] + [
-                repl for _ in example_args[2:]
-            ]
-            out_shardings = (cache_sh, repl)
+            in_shardings = []
+            for i in range(len(example_args)):
+                if with_variables and i == 0:
+                    vars_sh = self._partitioner.variables_sharding(
+                        self._variables
+                    )
+                    if vars_sh is None:
+                        vars_sh = jax.tree.map(
+                            lambda _: repl, self._variables
+                        )
+                    in_shardings.append(vars_sh)
+                elif i == donate_cache_at:
+                    in_shardings.append(cache_sh)
+                else:
+                    in_shardings.append(repl)
+            out_shardings = (
+                cache_sh if cache_only_output else (cache_sh, repl)
+            )
             jitted = jax.jit(
                 fn,
                 in_shardings=tuple(in_shardings),
@@ -667,22 +930,42 @@ DecodeScheduler`.
         # paged kernel, its sharded wrapper, or the reference einsum)
         # is part of THIS compiled program's identity.
         attn_override = getattr(self, "_decode_attention_fn", None)
-
-        def decode_fn(variables, cache, tokens, lengths):
-            logits, new_cache = module.apply(
-                variables, tokens, lengths, cache, method="decode_step",
-                attention_override=attn_override,
-            )
-            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-            return new_cache, nxt
-
         n = int(self.slots)
-        example = (
-            self._variables,
-            self._cache,
-            jax.ShapeDtypeStruct((n,), np.int32),
-            jax.ShapeDtypeStruct((n,), np.int32),
-        )
+        if self._paged:
+
+            def decode_fn(variables, cache, tokens, lengths, table):
+                logits, new_cache = module.apply(
+                    variables, tokens, lengths, cache, table,
+                    method="decode_step_paged",
+                    attention_override=attn_override,
+                )
+                nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                return new_cache, nxt
+
+            example = (
+                self._variables,
+                self._cache,
+                jax.ShapeDtypeStruct((n,), np.int32),
+                jax.ShapeDtypeStruct((n,), np.int32),
+                jax.ShapeDtypeStruct((n, self._max_pages), np.int32),
+            )
+        else:
+
+            def decode_fn(variables, cache, tokens, lengths):
+                logits, new_cache = module.apply(
+                    variables, tokens, lengths, cache,
+                    method="decode_step",
+                    attention_override=attn_override,
+                )
+                nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                return new_cache, nxt
+
+            example = (
+                self._variables,
+                self._cache,
+                jax.ShapeDtypeStruct((n,), np.int32),
+                jax.ShapeDtypeStruct((n,), np.int32),
+            )
         compiled = self._aot(
             "decode_step", decode_fn, example, donate_cache_at=1
         )
@@ -703,31 +986,77 @@ DecodeScheduler`.
         if during_dispatch and self._warmed:
             self._note_dispatch_compile(f"prefill/b{pb}s{sb}")
         module = self._module
+        if self._paged:
+            ps = int(self.page_size)
+            num_pages = int(self._num_pages)
 
-        def prefill_fn(variables, cache, tokens, lengths, slot_ids):
-            last_logits, kv = module.apply(
-                variables, tokens, lengths, method="prefill"
+            def prefill_fn(variables, cache, tokens, lengths, slot_rows):
+                from zookeeper_tpu.models.transformer import (
+                    _pool_write_rows,
+                )
+
+                last_logits, kv = module.apply(
+                    variables, tokens, lengths, method="prefill"
+                )
+                # Scatter each prompt row through its slot's page-table
+                # row: position j lands at (slot_rows[:, j // ps],
+                # j % ps). Rows past the true length, unallocated table
+                # entries, and a partial group's padding rows (all
+                # -1 rows) take the OOB page sentinel and write
+                # nowhere — the paged twin of the slot-id drop.
+                j = jnp.arange(sb)
+                row = jnp.clip(j // ps, 0, slot_rows.shape[1] - 1)
+                pages = slot_rows[:, row]  # [pb, sb]
+                dead = (j[None, :] >= lengths[:, None]) | (pages < 0)
+                pages = jnp.where(dead, num_pages, pages)
+                offs = jnp.broadcast_to(j % ps, pages.shape)
+                new_cache = []
+                for layer, (k, v) in zip(cache, kv):
+                    new_cache.append(
+                        _pool_write_rows(
+                            layer, {"k": k, "v": v}, pages, offs
+                        )
+                    )
+                first = jnp.argmax(last_logits, axis=-1).astype(jnp.int32)
+                return tuple(new_cache), first
+
+            example = (
+                self._variables,
+                self._cache,
+                jax.ShapeDtypeStruct((pb, sb), np.int32),
+                jax.ShapeDtypeStruct((pb,), np.int32),
+                jax.ShapeDtypeStruct((pb, self._max_pages), np.int32),
             )
-            new_cache = []
-            for layer, (k, v) in zip(cache, kv):
-                # Scatter the group's K/V heads into its slots' first
-                # sb rows. mode="drop": the PADDING rows of a partial
-                # group carry slot id == slots (out of bounds) and must
-                # write nowhere.
-                new_cache.append({
-                    "k": layer["k"].at[slot_ids, :sb].set(k, mode="drop"),
-                    "v": layer["v"].at[slot_ids, :sb].set(v, mode="drop"),
-                })
-            first = jnp.argmax(last_logits, axis=-1).astype(jnp.int32)
-            return tuple(new_cache), first
+        else:
 
-        example = (
-            self._variables,
-            self._cache,
-            jax.ShapeDtypeStruct((pb, sb), np.int32),
-            jax.ShapeDtypeStruct((pb,), np.int32),
-            jax.ShapeDtypeStruct((pb,), np.int32),
-        )
+            def prefill_fn(variables, cache, tokens, lengths, slot_ids):
+                last_logits, kv = module.apply(
+                    variables, tokens, lengths, method="prefill"
+                )
+                new_cache = []
+                for layer, (k, v) in zip(cache, kv):
+                    # Scatter the group's K/V heads into its slots'
+                    # first sb rows. mode="drop": the PADDING rows of a
+                    # partial group carry slot id == slots (out of
+                    # bounds) and must write nowhere.
+                    new_cache.append({
+                        "k": layer["k"].at[slot_ids, :sb].set(
+                            k, mode="drop"
+                        ),
+                        "v": layer["v"].at[slot_ids, :sb].set(
+                            v, mode="drop"
+                        ),
+                    })
+                first = jnp.argmax(last_logits, axis=-1).astype(jnp.int32)
+                return tuple(new_cache), first
+
+            example = (
+                self._variables,
+                self._cache,
+                jax.ShapeDtypeStruct((pb, sb), np.int32),
+                jax.ShapeDtypeStruct((pb,), np.int32),
+                jax.ShapeDtypeStruct((pb,), np.int32),
+            )
         compiled = self._aot(
             f"prefill/b{pb}s{sb}", prefill_fn, example, donate_cache_at=1
         )
@@ -760,23 +1089,135 @@ DecodeScheduler`.
         if during_dispatch and self._warmed:
             self._note_dispatch_compile(f"verify_step/w{width}")
         module = self._module
-
-        def verify_fn(variables, cache, tokens, lengths):
-            logits, new_cache = module.apply(
-                variables, tokens, lengths, cache, method="decode_verify"
-            )
-            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-            return new_cache, nxt
-
         n = int(self.slots)
+        if self._paged:
+
+            def verify_fn(variables, cache, tokens, lengths, table):
+                logits, new_cache = module.apply(
+                    variables, tokens, lengths, cache, table,
+                    method="decode_verify_paged",
+                )
+                nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                return new_cache, nxt
+
+            example = (
+                self._variables,
+                self._cache,
+                jax.ShapeDtypeStruct((n, int(width)), np.int32),
+                jax.ShapeDtypeStruct((n,), np.int32),
+                jax.ShapeDtypeStruct((n, self._max_pages), np.int32),
+            )
+        else:
+
+            def verify_fn(variables, cache, tokens, lengths):
+                logits, new_cache = module.apply(
+                    variables, tokens, lengths, cache,
+                    method="decode_verify",
+                )
+                nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                return new_cache, nxt
+
+            example = (
+                self._variables,
+                self._cache,
+                jax.ShapeDtypeStruct((n, int(width)), np.int32),
+                jax.ShapeDtypeStruct((n,), np.int32),
+            )
+        compiled = self._aot(
+            f"verify_step/w{width}", verify_fn, example, donate_cache_at=1
+        )
+        self._compiled_cache[key] = compiled
+        return compiled
+
+    def _extend_compiled(
+        self, pb: int, w: int, *, during_dispatch: bool = False
+    ):
+        """The WARM-prefix prefill program (paged layout + prefix
+        cache, docs/DESIGN.md §20): a group whose prompts share
+        cache-resident prefixes enters ``decode_verify_paged`` with
+        each prompt's SUFFIX as the window — the shared pages are read
+        through the page table, never recomputed, and the emitted first
+        token comes from each row's true-last window position. One
+        compile per (prefill bucket, width bucket), part of the warmed
+        grid; ledgered ``prefill_extend``."""
+        import jax
+        import jax.numpy as jnp
+
+        self._require_bound()
+        key = ("extend", int(pb), int(w), self._partitioner.mesh)
+        cached = self._compiled_cache.get(key)
+        if cached is not None:
+            return cached
+        if during_dispatch and self._warmed:
+            self._note_dispatch_compile(f"prefill_extend/b{pb}w{w}")
+        module = self._module
+
+        def extend_fn(
+            variables, cache, tokens, lengths, slot_rows, valid, out_idx
+        ):
+            logits, new_cache = module.apply(
+                variables, tokens, lengths, cache, slot_rows,
+                method="decode_verify_paged", valid=valid,
+            )
+            last = jnp.take_along_axis(
+                logits,
+                jnp.clip(out_idx, 0, int(w) - 1)[:, None, None],
+                axis=1,
+            )[:, 0]
+            first = jnp.argmax(last, axis=-1).astype(jnp.int32)
+            return new_cache, first
+
         example = (
             self._variables,
             self._cache,
-            jax.ShapeDtypeStruct((n, int(width)), np.int32),
-            jax.ShapeDtypeStruct((n,), np.int32),
+            jax.ShapeDtypeStruct((int(pb), int(w)), np.int32),
+            jax.ShapeDtypeStruct((int(pb),), np.int32),
+            jax.ShapeDtypeStruct((int(pb), self._max_pages), np.int32),
+            jax.ShapeDtypeStruct((int(pb),), np.int32),
+            jax.ShapeDtypeStruct((int(pb),), np.int32),
         )
         compiled = self._aot(
-            f"verify_step/w{width}", verify_fn, example, donate_cache_at=1
+            f"prefill_extend/b{pb}w{w}", extend_fn, example,
+            donate_cache_at=1,
+        )
+        self._compiled_cache[key] = compiled
+        return compiled
+
+    def _copy_page_compiled(self, *, during_dispatch: bool = False):
+        """The copy-on-write program (docs/DESIGN.md §20): copy ONE
+        pool page (every per-layer k/v row + scale page) from ``src``
+        to ``dst`` on device. Runs once per divergence-mid-page
+        admission — rare and tiny, so one page per dispatch keeps it a
+        single warmed shape."""
+        import jax
+
+        self._require_bound()
+        key = ("copy_page", self._partitioner.mesh)
+        cached = self._compiled_cache.get(key)
+        if cached is not None:
+            return cached
+        if during_dispatch and self._warmed:
+            self._note_dispatch_compile("copy_page")
+
+        def copy_fn(cache, src, dst):
+            out = []
+            for layer in cache:
+                out.append(
+                    {
+                        name: buf.at[dst].set(buf[src])
+                        for name, buf in layer.items()
+                    }
+                )
+            return tuple(out)
+
+        example = (
+            self._cache,
+            jax.ShapeDtypeStruct((), np.int32),
+            jax.ShapeDtypeStruct((), np.int32),
+        )
+        compiled = self._aot(
+            "copy_page", copy_fn, example, donate_cache_at=0,
+            with_variables=False, cache_only_output=True,
         )
         self._compiled_cache[key] = compiled
         return compiled
@@ -791,15 +1232,22 @@ DecodeScheduler`.
 
     def warmup(self) -> int:
         """Pre-compile the full program grid (every prefill bucket pair
-        + the decode step) so no stream ever waits on XLA; a
-        speculative bind extends the grid with its verify widths via
-        :meth:`warmup_verify`. Returns the number of cached
-        executables."""
+        + the decode step; the paged layout adds the warm-extend grid
+        when the prefix cache is on, and the copy-on-write page copy)
+        so no stream ever waits on XLA; a speculative bind extends the
+        grid with its verify widths via :meth:`warmup_verify`. Returns
+        the number of cached executables."""
         self._require_bound()
         for pb in self._prefill_buckets:
             for sb in self._seq_buckets:
                 self._prefill_compiled(pb, sb)
         self._decode_compiled()
+        if self._paged:
+            self._copy_page_compiled()
+            if self.prefix_cache:
+                for pb in self._prefill_buckets:
+                    for sb in self._seq_buckets:
+                        self._extend_compiled(pb, sb)
         object.__setattr__(self, "_warmed", True)
         return len(self._compiled_cache)
 
@@ -831,11 +1279,19 @@ DecodeScheduler`.
         sb = self.seq_bucket_for(max(lens))
         tokens = np.zeros((pb, sb), np.int32)
         lengths = np.ones((pb,), np.int32)  # pad rows: len 1, dropped
-        ids = np.full((pb,), int(self.slots), np.int32)  # OOB => dropped
-        for i, (p, s) in enumerate(zip(prompts, slot_ids)):
+        for i, (p, _) in enumerate(zip(prompts, slot_ids)):
             tokens[i, : lens[i]] = np.asarray(p, np.int32)
             lengths[i] = lens[i]
-            ids[i] = int(s)
+        if self._paged:
+            # Page-table rows instead of slot ids: padding rows stay
+            # all -1 (every write drops via the OOB page sentinel).
+            ids = np.full((pb, self._max_pages), -1, np.int32)
+            for i, s in enumerate(slot_ids):
+                ids[i] = self._pool.table[int(s)]
+        else:
+            ids = np.full((pb,), int(self.slots), np.int32)  # OOB drop
+            for i, s in enumerate(slot_ids):
+                ids[i] = int(s)
         compiled = self._prefill_compiled(pb, sb, during_dispatch=True)
         with _trace.span(
             "prefill_dispatch",
@@ -859,6 +1315,93 @@ DecodeScheduler`.
             first = np.asarray(jax.device_get(first))
         return first[:n].astype(np.int32)
 
+    def prefill_warm(
+        self,
+        prompts: Sequence[np.ndarray],
+        slot_ids: Sequence[int],
+        shared_lens: Sequence[int],
+    ):
+        """Warm-prefix admission (paged layout, docs/DESIGN.md §20):
+        each prompt's first ``shared_lens[i]`` tokens are already
+        resident in cache-shared pages, so only the SUFFIX rides the
+        device — through the ``prefill_extend`` program at the smallest
+        width bucket holding the longest suffix. Emits each request's
+        first token exactly like :meth:`prefill`; the TTFT collapse for
+        warm prefixes is this method's whole reason to exist."""
+        import jax
+
+        self._require_bound()
+        if not self._paged:
+            raise RuntimeError(
+                "prefill_warm is a paged-layout dispatch; slots-mode "
+                "admissions always run the cold prefill."
+            )
+        n = len(prompts)
+        if n == 0:
+            return np.zeros((0,), np.int32)
+        suffixes = [
+            int(np.shape(p)[0]) - int(sh)
+            for p, sh in zip(prompts, shared_lens)
+        ]
+        if min(suffixes) < 1:
+            raise ValueError(
+                "warm prefill needs >= 1 suffix token per prompt (the "
+                "prefix match is capped at len - 1 so the first "
+                "emission's logits exist)."
+            )
+        pb = self.prefill_bucket_for(n)
+        w = self.seq_bucket_for(max(suffixes))
+        tokens = np.zeros((pb, w), np.int32)
+        lengths = np.zeros((pb,), np.int32)
+        valid = np.zeros((pb,), np.int32)  # pad rows: 0 valid, dropped
+        out_idx = np.zeros((pb,), np.int32)
+        rows = np.full((pb, self._max_pages), -1, np.int32)
+        for i, (p, s, sh) in enumerate(zip(prompts, slot_ids, shared_lens)):
+            p = np.asarray(p, np.int32)
+            suf = p[int(sh):]
+            tokens[i, : suf.shape[0]] = suf
+            lengths[i] = int(sh)
+            valid[i] = suf.shape[0]
+            out_idx[i] = suf.shape[0] - 1
+            rows[i] = self._pool.table[int(s)]
+        compiled = self._extend_compiled(pb, w, during_dispatch=True)
+        with _trace.span(
+            "prefill_warm_dispatch",
+            attrs=(
+                {"requests": n, "bucket": pb, "width": w}
+                if _trace.enabled()
+                else None
+            ),
+        ):
+            try:
+                new_cache, first = compiled(
+                    self._variables, self._cache, tokens, lengths, rows,
+                    valid, out_idx,
+                )
+            except BaseException:
+                self._reset_cache()  # donation consumed the buffers
+                raise
+            object.__setattr__(self, "_cache", new_cache)
+            first = np.asarray(jax.device_get(first))
+        return first[:n].astype(np.int32)
+
+    def copy_page(self, src: int, dst: int) -> None:
+        """Execute one copy-on-write page copy on device (the
+        ``assign_prompt`` plan's ``cow`` entry) BEFORE the dispatch
+        that writes into ``dst``."""
+        self._require_bound()
+        compiled = self._copy_page_compiled(during_dispatch=True)
+        try:
+            new_cache = compiled(
+                self._cache,
+                np.int32(int(src)),
+                np.int32(int(dst)),
+            )
+        except BaseException:
+            self._reset_cache()  # donation consumed the buffers
+            raise
+        object.__setattr__(self, "_cache", new_cache)
+
     def decode(self, tokens: np.ndarray, lengths: np.ndarray) -> np.ndarray:
         """One token for EVERY slot: feed the current input token per
         slot (each sits at position ``lengths[slot]``), write its K/V,
@@ -879,6 +1422,9 @@ DecodeScheduler`.
                 f"arrays, got {tokens.shape} / {lengths.shape}."
             )
         compiled = self._decode_compiled(during_dispatch=True)
+        args = (tokens, lengths)
+        if self._paged:
+            args = (tokens, lengths, np.ascontiguousarray(self._pool.table))
         with _trace.span(
             "decode_dispatch",
             attrs=(
@@ -888,7 +1434,7 @@ DecodeScheduler`.
             t0 = time.perf_counter()
             try:
                 new_cache, nxt = compiled(
-                    self._variables, self._cache, tokens, lengths
+                    self._variables, self._cache, *args
                 )
             except BaseException:
                 self._reset_cache()  # donation consumed the buffers
@@ -928,6 +1474,9 @@ DecodeScheduler`.
             )
         w = int(tokens.shape[1])
         compiled = self._verify_compiled(w, during_dispatch=True)
+        args = (tokens, lengths)
+        if self._paged:
+            args = (tokens, lengths, np.ascontiguousarray(self._pool.table))
         with _trace.span(
             "verify_dispatch",
             attrs=(
@@ -939,7 +1488,7 @@ DecodeScheduler`.
             t0 = time.perf_counter()
             try:
                 new_cache, nxt = compiled(
-                    self._variables, self._cache, tokens, lengths
+                    self._variables, self._cache, *args
                 )
             except BaseException:
                 self._reset_cache()  # donation consumed the buffers

@@ -154,6 +154,24 @@ class DecodeMetrics:
                     "fraction (-1 = no speculative window yet)",
                     initial=-1,
                 ),
+                # Paged-KV family (docs/DESIGN.md §20): REAL pool
+                # allocator counts, not the host-side length estimate —
+                # deliberately outside the zk_decode_ prefix like the
+                # zk_spec_ family (the pool is engine state the
+                # prefix cache and every slot share).
+                "kv_pool_free_pages": registry.gauge(
+                    "zk_kv_pool_free_pages",
+                    help="free pages in the shared KV page pool (-1 = "
+                    "slot layout, no pool)",
+                    initial=-1,
+                ),
+                "prefix_cache_hit_rate": registry.gauge(
+                    "zk_prefix_cache_hit_rate",
+                    help="lifetime prompt-token fraction served from "
+                    "prefix-cache-shared pages (-1 = no lookup yet or "
+                    "prefix cache off)",
+                    initial=-1,
+                ),
             },
             "hist": {
                 "ttft_ms": registry.histogram(
@@ -226,6 +244,15 @@ class DecodeMetrics:
         gauges["slot_occupancy"].set(active / slots if slots else 0.0)
         gauges["queue_depth"].set(int(queue_depth))
         gauges["kv_pages_in_use"].set(int(kv_pages))
+
+    def record_pool(self, free_pages: int, hit_rate: float) -> None:
+        """Paged-KV pool vitals (docs/DESIGN.md §20): the allocator's
+        real free-page count and the prefix cache's lifetime
+        token-level hit rate, refreshed each scheduler iteration with
+        the occupancy gauges."""
+        gauges = self._obs()["gauges"]
+        gauges["kv_pool_free_pages"].set(int(free_pages))
+        gauges["prefix_cache_hit_rate"].set(float(hit_rate))
 
     def record_spec_window(
         self,

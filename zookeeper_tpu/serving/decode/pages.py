@@ -1,0 +1,584 @@
+"""True paged KV: the shared device page pool, its host-side
+allocator, and the radix prefix cache (docs/DESIGN.md §20).
+
+The §15 slot layout provisions every slot's WORST case —
+``slots × capacity`` rows of KV HBM — because one slot's rows must be
+contiguous. This module is the deferred indirection step (ROADMAP item
+4): KV rows live in per-layer POOLS of fixed-size pages
+(``[num_pages, page_size, heads, head_dim]``), any slot's logical page
+``p`` resolves through a ``[slots, max_pages] int32`` PAGE TABLE
+carried as a runtime operand, and three host-side structures make the
+pool a serving system rather than a bag of bytes:
+
+- :class:`PagePool` — the allocator: a free-list + per-page refcounts
+  over the pool indices, plus the authoritative page table. Admission
+  allocates pages for a prompt, each decode/verify dispatch is
+  preceded by an ``ensure_rows`` covering its writes, release unrefs —
+  a page frees when its LAST reference (active slots + the prefix
+  cache) drops. Capacity is pooled: the pool serves any mix of
+  lengths summing to ``num_pages × page_size`` resident tokens,
+  instead of ``slots`` independent worst cases.
+- :class:`RadixPrefixCache` — a radix trie over prompt token prefixes
+  at page-chunk granularity. A warm lookup returns the shared pages of
+  the longest cached prefix; the requester REFERENCES them instead of
+  recomputing prefill for those tokens (TTFT collapses for the
+  shared-system-prompt traffic shape). Sharing is copy-on-write at the
+  divergence point: the page containing the first divergent position
+  is device-copied to a fresh page before the new occupant writes into
+  it (full pages strictly before the divergence are never written —
+  the validity invariant means writes only land at ``j >= length`` —
+  so they share by reference forever). Refcount-0 nodes evict LRU
+  under pool pressure.
+- int8 quantization hooks — the pool tree optionally stores int8 rows
+  plus page-shaped ``[num_pages, page_size, heads]`` float32 scale
+  arrays (``ops.quantizers.quantize_kv_rows``), dequantized inside the
+  attention read: double the resident tokens per HBM byte.
+
+Validity composes with §15 unchanged: a slot's row ``j`` is meaningful
+iff ``j < length``, wherever the page table put it. A freshly-allocated
+page may hold a PREVIOUS tenant's rows — the poisoned-free-page
+equality tests certify that garbage beyond ``length`` (now: garbage in
+recycled pages) cannot perturb output, bit for bit. The prefix cache's
+validity argument is determinism: prefill of the same token prefix
+under the same weights writes the same bytes, so a cached page IS the
+page a cold prefill would have produced — which is why a weight
+hot-swap must invalidate the cache (exactly once), and why cached
+pages never outlive a swap.
+
+Everything here is HOST state. The device half (the pool tree itself)
+is allocated by :func:`allocate_page_pool` and owned/donated by the
+``DecodeEngine`` exactly like the slot-layout cache.
+"""
+
+import math
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = [
+    "PagePool",
+    "RadixPrefixCache",
+    "allocate_page_pool",
+    "page_pool_bytes",
+]
+
+
+def allocate_page_pool(
+    num_layers: int,
+    num_pages: int,
+    page_size: int,
+    num_heads: int,
+    head_dim: int,
+    dtype: Any,
+    quant: str = "none",
+) -> Tuple[dict, ...]:
+    """Zero-initialized page-pool pytree: a per-layer tuple of
+    ``{"k", "v"}`` pools ``[num_pages, page_size, heads, head_dim]``,
+    plus ``{"k_scale", "v_scale"}`` ``[num_pages, page_size, heads]``
+    float32 when ``quant="int8"`` (rows stored int8). The engine places
+    it under the partitioner's page-pool sharding and donates it
+    through every dispatch, exactly like the slot-layout cache."""
+    import jax.numpy as jnp
+
+    if num_pages < 1 or page_size < 1:
+        raise ValueError(
+            f"page pool needs num_pages >= 1 and page_size >= 1, got "
+            f"num_pages={num_pages}, page_size={page_size}."
+        )
+    if quant not in ("none", "int8"):
+        raise ValueError(f"quant={quant!r}: expected 'none' or 'int8'.")
+    shape = (num_pages, page_size, num_heads, head_dim)
+    row_dtype = jnp.int8 if quant == "int8" else dtype
+    layers = []
+    for _ in range(num_layers):
+        layer = {
+            "k": jnp.zeros(shape, row_dtype),
+            "v": jnp.zeros(shape, row_dtype),
+        }
+        if quant == "int8":
+            # Scale 1.0 everywhere: a zeroed int8 page dequantizes to
+            # exact zeros, matching the fp pool's initial state.
+            layer["k_scale"] = jnp.ones(shape[:3], jnp.float32)
+            layer["v_scale"] = jnp.ones(shape[:3], jnp.float32)
+        layers.append(layer)
+    return tuple(layers)
+
+
+def page_pool_bytes(
+    num_layers: int,
+    num_pages: int,
+    page_size: int,
+    num_heads: int,
+    head_dim: int,
+    itemsize: int,
+    quant: str = "none",
+) -> int:
+    """Total HBM the pool occupies (k + v rows, all layers, plus the
+    scale arrays when quantized) — the §20 capacity-planning number."""
+    rows = 2 * num_layers * num_pages * page_size * num_heads
+    total = rows * head_dim * (1 if quant == "int8" else itemsize)
+    if quant == "int8":
+        total += rows * 4  # float32 scale per (row, head)
+    return total
+
+
+class _TrieNode:
+    __slots__ = ("chunk", "page", "children", "parent", "last_used")
+
+    def __init__(self, chunk: Tuple[int, ...], page: int, parent):
+        self.chunk = chunk
+        self.page = int(page)
+        self.children: Dict[Tuple[int, ...], "_TrieNode"] = {}
+        self.parent = parent
+        self.last_used = 0
+
+
+def _common_prefix(a: Sequence[int], b: Sequence[int]) -> int:
+    n = min(len(a), len(b))
+    for i in range(n):
+        if a[i] != b[i]:
+            return i
+    return n
+
+
+class RadixPrefixCache:
+    """Radix trie over prompt token prefixes, page-chunk keyed.
+
+    Internal nodes hold one FULL ``page_size`` token chunk each (the
+    page covering those positions); a leaf may hold a PARTIAL tail
+    chunk. Lookup walks exact full-chunk matches, then takes the
+    longest common prefix against any child's chunk for the partial
+    tail — a partial hit shares that child's page, which the caller
+    must copy-on-write before its first write lands in it.
+
+    The cache holds its OWN reference on every node's page (via the
+    ``ref``/``unref`` callables, wired to the :class:`PagePool`
+    refcounts), so cached pages survive their inserting slot's release;
+    :meth:`evict_lru` drops least-recently-used childless nodes whose
+    page the cache alone still references (``refcount == 1`` — the only
+    evictions that actually free pool pages). :meth:`clear` is the
+    hot-swap invalidation: cached pages hold K/V of the OLD weights and
+    must never serve a warm hit under the new ones.
+    """
+
+    def __init__(self, page_size: int, ref, unref, evictable) -> None:
+        if page_size < 1:
+            raise ValueError(f"page_size={page_size} must be >= 1.")
+        self.page_size = int(page_size)
+        self._ref = ref
+        self._unref = unref
+        self._evictable = evictable  # page -> bool (refcount == 1)
+        self._root = _TrieNode((), -1, None)
+        self._clock = 0
+        #: Token-level accounting behind ``zk_prefix_cache_hit_rate``.
+        self.lookup_tokens = 0
+        self.hit_tokens = 0
+        self.lookups = 0
+        self.hits = 0
+        self.evicted_pages = 0
+        self.invalidations = 0
+
+    def _touch(self, node: _TrieNode) -> None:
+        self._clock += 1
+        node.last_used = self._clock
+
+    @property
+    def nodes(self) -> int:
+        count = 0
+        stack = [self._root]
+        while stack:
+            n = stack.pop()
+            stack.extend(n.children.values())
+            count += len(n.children)
+        return count
+
+    @property
+    def hit_rate(self) -> float:
+        """Lifetime shared-token fraction (-1 before any lookup)."""
+        if not self.lookup_tokens:
+            return -1.0
+        return self.hit_tokens / self.lookup_tokens
+
+    def lookup(self, tokens: Sequence[int]) -> Tuple[int, List[int]]:
+        """Longest cached prefix of ``tokens``: returns ``(t, pages)``
+        where the first ``t`` tokens are covered by the ``ceil(t /
+        page_size)`` cached ``pages`` (the last partial when ``t`` is
+        off a page boundary — the caller's CoW case). The caller caps
+        ``t`` (never the whole prompt — at least the final token is
+        always recomputed so the first-emission logits exist) and takes
+        its own references on the pages it adopts."""
+        ps = self.page_size
+        tokens = [int(x) for x in tokens]
+        self.lookups += 1
+        self.lookup_tokens += len(tokens)
+        node = self._root
+        pages: List[int] = []
+        t = 0
+        while t + ps <= len(tokens):
+            child = node.children.get(tuple(tokens[t:t + ps]))
+            if child is None:
+                break
+            pages.append(child.page)
+            t += ps
+            node = child
+            self._touch(node)
+        rest = tokens[t:]
+        if rest:
+            best, bestq = None, 0
+            for child in node.children.values():
+                q = _common_prefix(child.chunk, rest)
+                if q > bestq:
+                    best, bestq = child, q
+            if best is not None:
+                pages.append(best.page)
+                t += bestq
+                self._touch(best)
+        if t:
+            self.hits += 1
+            self.hit_tokens += t
+        return t, pages
+
+    def insert(self, tokens: Sequence[int], pages: Sequence[int]) -> int:
+        """Cache ``tokens``' pages (``pages[i]`` covers positions
+        ``[i*page_size, (i+1)*page_size)``; the last may be partial).
+        Existing nodes keep their ORIGINAL page — by determinism the
+        bytes are identical, and swapping would orphan other sharers'
+        view of the trie. Returns how many NEW nodes (= new cache page
+        references) were created."""
+        ps = self.page_size
+        tokens = [int(x) for x in tokens]
+        node = self._root
+        created = 0
+        n_full = len(tokens) // ps
+        for i in range(n_full):
+            chunk = tuple(tokens[i * ps:(i + 1) * ps])
+            child = node.children.get(chunk)
+            if child is None:
+                child = _TrieNode(chunk, pages[i], node)
+                node.children[chunk] = child
+                self._ref(child.page)
+                created += 1
+            node = child
+            self._touch(node)
+        tail = tuple(tokens[n_full * ps:])
+        if tail and len(pages) > n_full:
+            child = node.children.get(tail)
+            if child is None:
+                child = _TrieNode(tail, pages[n_full], node)
+                node.children[tail] = child
+                self._ref(child.page)
+                created += 1
+            self._touch(child)
+        return created
+
+    def evict_lru(self, want_pages: int) -> int:
+        """Free pool pages by dropping LRU childless nodes whose page
+        only the cache still references. Returns pages actually freed
+        (may be < ``want_pages`` when everything left is shared with an
+        active slot or is an interior node). One DFS collects the whole
+        evictable-leaf layer and frees it in LRU order; the outer loop
+        rescans only when evictions exposed NEW leaves (parents of
+        fully-evicted subtrees) — so the cost is one walk per trie
+        LAYER consumed, not one per page (this runs under the
+        scheduler lock)."""
+        freed = 0
+        while freed < want_pages:
+            leaves = []
+            stack = [self._root]
+            while stack:
+                n = stack.pop()
+                for child in n.children.values():
+                    if child.children:
+                        stack.append(child)
+                    elif self._evictable(child.page):
+                        leaves.append(child)
+            if not leaves:
+                return freed
+            leaves.sort(key=lambda n: n.last_used)
+            for victim in leaves:
+                if freed >= want_pages:
+                    return freed
+                del victim.parent.children[victim.chunk]
+                self._unref(victim.page)
+                self.evicted_pages += 1
+                freed += 1
+        return freed
+
+    def clear(self) -> int:
+        """Drop every cached node + reference (the hot-swap
+        invalidation). Returns nodes dropped."""
+        dropped = 0
+        stack = list(self._root.children.values())
+        while stack:
+            n = stack.pop()
+            stack.extend(n.children.values())
+            self._unref(n.page)
+            dropped += 1
+        self._root = _TrieNode((), -1, None)
+        # Counted unconditionally: "how many times was the cache
+        # invalidated" is the hot-swap-discipline number the chaos
+        # tests pin (exactly once per applied swap), not "how many
+        # invalidations found nodes to drop".
+        self.invalidations += 1
+        return dropped
+
+
+class PagePool:
+    """Host-side page allocator + page table for one decode engine's
+    shared device pool (see module docstring).
+
+    The DEVICE pool tree is owned by the engine; this object owns the
+    indices: the free list, per-page refcounts, the authoritative
+    ``[slots, max_pages]`` table the dispatches carry as a runtime
+    operand, and (optionally) the radix prefix cache whose nodes hold
+    their own page references. NOT thread-safe by itself — the
+    scheduler calls every mutator under its own lock, the same
+    discipline as its slot arrays.
+    """
+
+    def __init__(
+        self,
+        *,
+        num_pages: int,
+        page_size: int,
+        slots: int,
+        max_pages_per_slot: int,
+        prefix_cache: bool = True,
+    ) -> None:
+        if num_pages < max_pages_per_slot:
+            raise ValueError(
+                f"num_pages={num_pages} below max_pages_per_slot="
+                f"{max_pages_per_slot}: one full-capacity sequence "
+                "could never be served."
+            )
+        self.num_pages = int(num_pages)
+        self.page_size = int(page_size)
+        self.slots = int(slots)
+        self.max_pages_per_slot = int(max_pages_per_slot)
+        #: The runtime page-table operand: -1 = unallocated (dispatches
+        #: clip it; masked by ``lengths`` per the validity invariant).
+        self.table = np.full(
+            (self.slots, self.max_pages_per_slot), -1, np.int32
+        )
+        self.counts = np.zeros(self.slots, np.int32)
+        self.refcount = np.zeros(self.num_pages, np.int32)
+        self._free: List[int] = list(range(self.num_pages - 1, -1, -1))
+        self.cow_pages = 0
+        self.exhausted_events = 0
+        self.prefix: Optional[RadixPrefixCache] = (
+            RadixPrefixCache(
+                self.page_size,
+                ref=self._ref,
+                unref=self._unref,
+                evictable=lambda p: int(self.refcount[p]) == 1,
+            )
+            if prefix_cache
+            else None
+        )
+
+    # -- refcounting -----------------------------------------------------
+
+    def _ref(self, page: int) -> None:
+        self.refcount[page] += 1
+
+    def _unref(self, page: int) -> None:
+        self.refcount[page] -= 1
+        if self.refcount[page] < 0:
+            raise AssertionError(f"page {page} refcount went negative.")
+        if self.refcount[page] == 0:
+            self._free.append(int(page))
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_pages(self) -> int:
+        return self.num_pages - len(self._free)
+
+    def pages_for(self, tokens: int) -> int:
+        return max(0, math.ceil(int(tokens) / self.page_size))
+
+    def _alloc(self, n: int) -> Optional[List[int]]:
+        """Pop ``n`` fresh pages, evicting prefix-cache LRU nodes under
+        pressure; None (nothing mutated beyond evictions) when the pool
+        is genuinely exhausted."""
+        if len(self._free) < n and self.prefix is not None:
+            self.prefix.evict_lru(n - len(self._free))
+        if len(self._free) < n:
+            self.exhausted_events += 1
+            return None
+        out = [self._free.pop() for _ in range(n)]
+        for p in out:
+            self.refcount[p] += 1
+        return out
+
+    # -- slot lifecycle --------------------------------------------------
+
+    def assign_prompt(self, slot: int, prompt) -> Optional[dict]:
+        """Admission: build ``slot``'s page-table row for ``prompt``
+        (1-D int tokens), sharing the longest cached prefix when the
+        prefix cache is on. Returns a plan dict —
+
+        - ``shared_tokens``: prompt tokens whose KV is already resident
+          (prefill is skipped for them; the engine's warm-extend
+          program computes only the suffix),
+        - ``cow``: ``(src_page, dst_page)`` when the divergence point
+          lands mid-page — the engine must device-copy ``src`` into
+          ``dst`` BEFORE the suffix dispatch writes into it,
+
+        or None when the pool cannot serve the prompt (caller sheds /
+        requeues; nothing was allocated)."""
+        if self.counts[slot]:
+            raise AssertionError(
+                f"slot {slot} still holds pages at admission; release "
+                "first."
+            )
+        prompt = [int(x) for x in np.asarray(prompt).tolist()]
+        length = len(prompt)
+        shared_tokens = 0
+        shared_pages: List[int] = []
+        if self.prefix is not None:
+            t, pages = self.prefix.lookup(prompt)
+            # Never match the WHOLE prompt: the final token is always
+            # recomputed so the warm dispatch produces the first
+            # emission's logits (and the accounting stays honest).
+            t = min(t, length - 1)
+            shared_tokens = t
+            shared_pages = pages[: self.pages_for(t)]
+        n_full_shared = shared_tokens // self.page_size
+        partial = shared_tokens % self.page_size != 0
+        total_pages = self.pages_for(length)
+        fresh_needed = total_pages - n_full_shared
+        fresh = self._alloc(fresh_needed)
+        if fresh is None:
+            return None
+        row = list(shared_pages[:n_full_shared]) + fresh
+        for p in shared_pages[:n_full_shared]:
+            self._ref(p)
+        cow = None
+        if partial:
+            # Divergence mid-page: the suffix writes into this page at
+            # offset shared_tokens % page_size, so the shared bytes are
+            # copied to the first fresh page (device copy, engine-run).
+            cow = (int(shared_pages[n_full_shared]), int(fresh[0]))
+            self.cow_pages += 1
+        self.table[slot, :len(row)] = row
+        self.counts[slot] = len(row)
+        return {"shared_tokens": shared_tokens, "cow": cow}
+
+    def ensure_rows(self, slot: int, rows: int) -> bool:
+        """Grow ``slot``'s row to cover ``rows`` total KV rows (the
+        pre-dispatch guarantee: decode needs ``length + 1``, a verify
+        window ``length + w``). False = pool exhausted after eviction;
+        nothing was allocated."""
+        needed = self.pages_for(rows)
+        if needed > self.max_pages_per_slot:
+            raise ValueError(
+                f"slot {slot} needs {needed} pages for {rows} rows, "
+                f"table holds {self.max_pages_per_slot}."
+            )
+        have = int(self.counts[slot])
+        if needed <= have:
+            return True
+        fresh = self._alloc(needed - have)
+        if fresh is None:
+            return False
+        self.table[slot, have:needed] = fresh
+        self.counts[slot] = needed
+        return True
+
+    def release_slot(self, slot: int) -> None:
+        """Drop the slot's references (stream finished/failed). Pages
+        the prefix cache also references stay resident for warm hits;
+        everything else returns to the free list."""
+        n = int(self.counts[slot])
+        for i in range(n):
+            self._unref(int(self.table[slot, i]))
+        self.table[slot, :n] = -1
+        self.counts[slot] = 0
+
+    def insert_prefix(self, slot: int, prompt) -> int:
+        """Cache the slot's prompt pages for future warm hits (called
+        after the prefill/extend dispatch landed their contents)."""
+        if self.prefix is None:
+            return 0
+        prompt = np.asarray(prompt)
+        n = self.pages_for(int(prompt.shape[0]))
+        return self.prefix.insert(
+            prompt.tolist(), [int(p) for p in self.table[slot, :n]]
+        )
+
+    def invalidate_prefix(self) -> int:
+        """Hot-swap invalidation: cached pages hold OLD-weight K/V."""
+        if self.prefix is None:
+            return 0
+        return self.prefix.clear()
+
+    def reset(self) -> None:
+        """Return to the freshly-constructed allocation state (the
+        engine's ``_reset_cache`` pairing, docs/DESIGN.md §20): table
+        cleared, refcounts zeroed, every page free, the prefix trie
+        dropped — the device pool it indexed was just reallocated
+        zeroed, so every cached node points at bytes that no longer
+        exist. Lifetime counters (CoW, evictions, hit accounting)
+        survive; the trie's drop counts as an invalidation."""
+        self.table.fill(-1)
+        self.counts.fill(0)
+        self.refcount.fill(0)
+        self._free = list(range(self.num_pages - 1, -1, -1))
+        if self.prefix is not None:
+            old = self.prefix
+            fresh = RadixPrefixCache(
+                self.page_size,
+                ref=self._ref,
+                unref=self._unref,
+                evictable=lambda p: int(self.refcount[p]) == 1,
+            )
+            fresh.lookup_tokens = old.lookup_tokens
+            fresh.hit_tokens = old.hit_tokens
+            fresh.lookups = old.lookups
+            fresh.hits = old.hits
+            fresh.evicted_pages = old.evicted_pages
+            fresh.invalidations = old.invalidations + 1
+            self.prefix = fresh
+
+    # -- accounting ------------------------------------------------------
+
+    @property
+    def prefix_hit_rate(self) -> float:
+        if self.prefix is None:
+            return -1.0
+        return self.prefix.hit_rate
+
+    def leak_check(self) -> int:
+        """Pages absent from the free list that nothing references
+        (must be 0 — the chaos tests pin it: a crash path that forgot
+        a release would strand pages here forever)."""
+        return (
+            self.num_pages
+            - len(self._free)
+            - int(np.sum(self.refcount > 0))
+        )
+
+    def status(self) -> dict:
+        """The ``/statusz`` ``kv_pool`` sub-section."""
+        out = {
+            "num_pages": self.num_pages,
+            "page_size": self.page_size,
+            "used_pages": self.used_pages,
+            "free_pages": self.free_pages,
+            "fill": round(self.used_pages / self.num_pages, 4),
+            "cow_pages": self.cow_pages,
+            "exhausted_events": self.exhausted_events,
+        }
+        if self.prefix is not None:
+            out.update(
+                prefix_nodes=self.prefix.nodes,
+                prefix_lookups=self.prefix.lookups,
+                prefix_hits=self.prefix.hits,
+                prefix_hit_rate=round(self.prefix.hit_rate, 4),
+                prefix_evicted_pages=self.prefix.evicted_pages,
+                prefix_invalidations=self.prefix.invalidations,
+            )
+        return out

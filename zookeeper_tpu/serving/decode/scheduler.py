@@ -545,11 +545,17 @@ class DecodeScheduler:
             return  # in-flight sequences keep their weight version
         params, model_state, step = pending
         self._engine.swap_weights(params, model_state)
+        # Paged layout: cached prefix pages hold K/V computed under the
+        # OLD weights — a warm hit after the swap would splice stale
+        # state into a new-weights stream. Invalidated here, EXACTLY
+        # once per applied swap (the staged-swap boundary is the only
+        # place weights change under a bound scheduler).
+        dropped = self._engine.invalidate_prefix_cache()
         object.__setattr__(self, "_swap_pending", None)
         _trace.event(
             "decode_weight_swap",
             step=step,
-            attrs={"deferred": True},
+            attrs={"deferred": True, "prefix_nodes_dropped": dropped},
         )
         if self._metrics is not None:
             self._metrics.record_weight_swap(step)
@@ -569,6 +575,10 @@ class DecodeScheduler:
 
     def _free_slot(self, slot: int) -> None:
         self._slot_stream[slot] = None
+        # Paged layout: drop the slot's page references (prefix-cache-
+        # shared pages stay resident); slot layout: no-op. Every slot
+        # retirement path funnels here so pages can never leak.
+        self._engine.release_slot(slot)
 
     def _finish_or_continue(self, slot: int, token: int) -> None:
         """Deliver ``token`` to the slot's stream and retire the slot
@@ -670,14 +680,105 @@ class DecodeScheduler:
                             rid=stream.rid,
                             attrs={"slot": slot},
                         )
+            # Page allocation per admitted stream (docs/DESIGN.md §20;
+            # slot layout: trivial cold plans). The POOL bookkeeping
+            # runs under _lock (close()/crash release pages under the
+            # same lock — the PagePool is lock-guarded scheduler
+            # state); only the rare one-page CoW copy dispatches
+            # outside, like the prefill itself. A pool-exhausted
+            # stream is put back at the QUEUE HEAD (its slot
+            # reservation undone) — it admits as soon as finishing
+            # streams release pages; if the pool cannot serve it even
+            # with every slot idle and the prefix cache evicted, it is
+            # shed with RejectedError (it could never run).
+            plans = []
+            admitted: List[DecodeStream] = []
+            admitted_slots: List[int] = []
+            with self._lock:
+                overflow = []
+                for stream, slot in zip(group, slots):
+                    if self._slot_stream[slot] is not stream:
+                        continue  # failed by close()/crash already
+                    plan = engine.admit_slot(
+                        slot, stream.prompt, copy=False
+                    )
+                    if plan is None:
+                        overflow.append((stream, slot))
+                    else:
+                        plans.append(plan)
+                        admitted.append(stream)
+                        admitted_slots.append(slot)
+                others_active = any(
+                    s is not None
+                    and i not in [sl for _, sl in overflow]
+                    for i, s in enumerate(self._slot_stream)
+                ) or bool(admitted)
+                for stream, slot in reversed(overflow):
+                    self._slot_stream[slot] = None
+                    if others_active:
+                        # Pages free as streams finish: requeue.
+                        self._queue.appendleft(stream)
+                    else:
+                        # Nothing in flight and the pool still cannot
+                        # hold this prompt: unservable.
+                        if self._metrics is not None:
+                            self._metrics.record_rejected()
+                        stream._fail(RejectedError(
+                            "KV page pool exhausted with no active "
+                            "streams to wait for: the prompt needs "
+                            "more pages than pool_pages can ever free "
+                            "— raise engine.pool_pages or shorten the "
+                            "prompt."
+                        ))
+            if not admitted:
+                if overflow:
+                    return
+                continue
+            group, slots = admitted, admitted_slots
+            # CoW copies outside the lock (device work). A page whose
+            # stream was failed mid-loop just writes bytes into a
+            # released page — unreferenced, overwritten or masked by
+            # any future tenant (the validity invariant).
+            for plan in plans:
+                cow = plan.pop("cow", None)
+                if cow is not None:
+                    engine.copy_page(*cow)
+            cold = [
+                i for i, p in enumerate(plans)
+                if not p.get("shared_tokens")
+            ]
+            warm = [
+                i for i, p in enumerate(plans) if p.get("shared_tokens")
+            ]
             t0 = time.perf_counter()
-            first = engine.prefill([s.prompt for s in group], slots)
+            first = np.zeros(len(group), np.int32)
+            if cold:
+                out = engine.prefill(
+                    [group[i].prompt for i in cold],
+                    [slots[i] for i in cold],
+                )
+                for i, tok in zip(cold, out):
+                    first[i] = tok
+            if warm:
+                # Warm-prefix admission: only the suffixes ride the
+                # device (the shared pages are already resident) —
+                # the TTFT collapse the prefix cache exists for.
+                out = engine.prefill_warm(
+                    [group[i].prompt for i in warm],
+                    [slots[i] for i in warm],
+                    [int(plans[i]["shared_tokens"]) for i in warm],
+                )
+                for i, tok in zip(warm, out):
+                    first[i] = tok
             spec = getattr(self, "_speculative", None)
             if spec is not None:
                 # Seed the DRAFT cache for the same group/slots (its
                 # first-token output is discarded — the teacher's is
                 # authoritative and already delivered). One extra
                 # dispatch per admission, amortized over the stream.
+                # Always the cold prefill: the draft keeps its own
+                # slot-layout cache (never prefix-shared — pooling a
+                # private, correctness-irrelevant cache buys nothing).
                 spec.draft_engine.prefill(
                     [s.prompt for s in group], slots
                 )
@@ -697,6 +798,9 @@ class DecodeScheduler:
                             stream.prompt.shape[0]
                         )
                         self._draft_pending[slot] = []
+                    # Cache the prompt's pages for future warm hits
+                    # while the slot still references them.
+                    engine.insert_prefix(slot, stream.prompt)
                     self._slot_tokens[slot] = int(token)
                     self._finish_or_continue(slot, int(token))
                     delivered += 1
@@ -742,6 +846,7 @@ class DecodeScheduler:
                 return
         engine = self._engine
         with self._lock:
+            self._ensure_active_rows(1)
             snapshot = list(self._slot_stream)
             active = [i for i, s in enumerate(snapshot) if s is not None]
             if not active:
@@ -785,6 +890,32 @@ class DecodeScheduler:
                 delivered += 1
             if self._metrics is not None:
                 self._metrics.record_decode_step(dt_ms, delivered)
+
+    def _ensure_active_rows(self, extra: int) -> None:
+        """Pre-dispatch page guarantee (paged layout; slot layout:
+        no-op): every active slot must hold pages covering ``length +
+        extra`` rows before the next decode (``extra=1``) or verify
+        window (``extra=w``) writes them. A slot the pool cannot grow
+        — even after prefix-cache eviction — fails its stream with
+        :class:`RejectedError` (partial tokens stay readable; the
+        resubmit lands once other streams release pages). Caller holds
+        ``_lock``."""
+        for slot, stream in enumerate(self._slot_stream):
+            if stream is None:
+                continue
+            if self._engine.ensure_rows(
+                slot, int(self._slot_lengths[slot]) + int(extra)
+            ):
+                continue
+            if self._metrics is not None:
+                self._metrics.record_rejected()
+            stream._fail(RejectedError(
+                "KV page pool exhausted mid-generation: no free page "
+                "for this stream's next token even after prefix-cache "
+                "eviction (partial output in tokens_so_far; raise "
+                "engine.pool_pages or lower concurrency and resubmit)."
+            ))
+            self._free_slot(slot)
 
     def _slot_draft_state(self) -> np.ndarray:
         """Draft cached-rows snapshot (caller holds ``_lock``)."""
@@ -830,6 +961,11 @@ class DecodeScheduler:
         k = int(spec.k)
         n = int(engine.slots)
         with self._lock:
+            # Teacher verify appends the whole window's rows (the
+            # accepted prefix advances over them; rejected rows stay
+            # masked garbage in allocated pages — rollback never
+            # deallocates mid-stream).
+            self._ensure_active_rows(spec.window)
             snapshot = list(self._slot_stream)
             active = [i for i, s in enumerate(snapshot) if s is not None]
             if not active:
@@ -954,6 +1090,13 @@ class DecodeScheduler:
             len(self._queue),
             self._engine.kv_pages_in_use(active_lengths),
         )
+        pool = self._engine.page_pool
+        if pool is not None:
+            # Real allocator counts (docs/DESIGN.md §20), not the
+            # host-side length estimate the slot layout reports.
+            self._metrics.record_pool(
+                pool.free_pages, pool.prefix_hit_rate
+            )
 
     def _step_once(self) -> bool:
         """One scheduler iteration: swap boundary, deadline sweeps,
@@ -1008,6 +1151,12 @@ class DecodeScheduler:
             self._queue.clear()
             for i in range(len(self._slot_stream)):
                 self._slot_stream[i] = None
+                # Paged layout: drop the failed streams' page
+                # references (a dispatch-failure crash already reset
+                # the pool wholesale inside the engine — releasing an
+                # empty row is a no-op, so both crash shapes leave
+                # zero leaked pages, which the chaos suite pins).
+                self._engine.release_slot(i)
                 # Draft bookkeeping dies with the streams: the next
                 # occupant's draft prefill re-seeds it.
                 self._draft_lengths[i] = 0
@@ -1184,7 +1333,7 @@ class DecodeScheduler:
             for i, stream in enumerate(self._slot_stream):
                 if stream is not None:
                     stream._fail(err)
-                    self._slot_stream[i] = None
+                    self._free_slot(i)
         self._stop.clear()
 
     # -- introspection ---------------------------------------------------
@@ -1224,6 +1373,15 @@ class DecodeScheduler:
                     engine.kv_cache_nbytes // max(1, int(engine.slots))
                 ),
                 "decode_attention": engine.decode_attention_flavor,
+                # Paged-KV vitals (docs/DESIGN.md §20): layout, pool
+                # fill, prefix-cache hits, CoW count — absent pool
+                # section means the slot layout.
+                "kv_layout": str(engine.kv_layout),
+                **(
+                    {"kv_pool": engine.pool_status()}
+                    if engine.paged
+                    else {}
+                ),
                 # Last dispatch's memory-bandwidth utilization (-1 =
                 # unknown) — the roofline lens for the memory-bound
                 # decode step.

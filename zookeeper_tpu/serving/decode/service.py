@@ -339,6 +339,24 @@ class LMServingConfig(Experiment):
             # degraded on unsupported geometry).
             "decode_attention": self.engine.decode_attention_flavor,
             "decode_mbu": round(self.engine.decode_mbu, 4),
+            # Paged-KV vitals (docs/DESIGN.md §20): the layout that
+            # actually served, pool fill and prefix-cache hit rate
+            # (both -1/absent under the slot layout).
+            "kv_layout": str(self.engine.kv_layout),
+            **(
+                {
+                    "kv_pool_fill": round(
+                        self.engine.page_pool.used_pages
+                        / self.engine.page_pool.num_pages,
+                        4,
+                    ),
+                    "prefix_cache_hit_rate": round(
+                        self.engine.page_pool.prefix_hit_rate, 4
+                    ),
+                }
+                if self.engine.paged
+                else {}
+            ),
             # Speculative schedule (docs/DESIGN.md §18): the RESOLVED
             # state (config-enabled but draft-unavailable degrades to
             # False here — the result line reports what actually

@@ -50,6 +50,7 @@ read a sharded checkpoint written by a group of the same topology.
 """
 
 import json
+import re
 import logging
 import os
 import random
@@ -1559,7 +1560,11 @@ def finalized_steps(path: str) -> List[int]:
     appear: an in-flight or crashed async write lives under a tmp name
     (not a bare step number) until its atomic finalize rename, and any
     bare-numbered dir is additionally vetted through orbax's finalize
-    checker. Empty when ``path`` is missing or holds no steps."""
+    checker. COMMITTED per-host sharded steps (``<step>.zkhost`` with a
+    ``COMMIT.json`` — docs/DESIGN.md §19) are listed too: a server
+    tracking a multi-host training run would otherwise silently never
+    see a new step, which is exactly the SERVING gap the §19 protocol
+    left open. Empty when ``path`` is missing or holds no steps."""
     path = os.path.abspath(os.path.expanduser(path))
     if not os.path.isdir(path):
         return []
@@ -1577,7 +1582,10 @@ def finalized_steps(path: str) -> List[int]:
             except Exception:
                 continue  # vanished mid-scan (retention GC race): skip
         steps.append(int(name))
-    return sorted(steps)
+    # Commit record = finalized (the rename-then-commit protocol makes
+    # the COMMIT.json check the whole crash-consistency argument).
+    steps.extend(step for step, _ in _sharded_step_dirs(path))
+    return sorted(set(steps))
 
 
 def _checkpoint_manager_item_dir(
@@ -1611,6 +1619,151 @@ def _checkpoint_manager_item_dir(
     return default if os.path.isdir(default) else step_dir
 
 
+_KEYSTR_SEGMENT_RE = re.compile(r"\['([^']*)'\]")
+
+
+def _zkhost_step_dir(path: str, step: Optional[int]) -> Optional[str]:
+    """The committed ``<step>.zkhost`` dir to serve from, or None when
+    the orbax layout should handle this load: an explicit ``step``
+    resolves to whichever layout holds it (bare-step dirs win when both
+    do — same bytes, cheaper restore); no ``step`` picks the NEWEST
+    finalized step across BOTH layouts."""
+    sharded = {s: d for s, d in _sharded_step_dirs(path)}
+    if not sharded:
+        return None
+    if step is not None:
+        step = int(step)
+        if os.path.isdir(os.path.join(path, str(step))):
+            return None  # orbax layout holds it
+        return sharded.get(step)
+    bare = [int(n) for n in os.listdir(path) if n.isdigit()]
+    newest_sharded = max(sharded)
+    if bare and max(bare) >= newest_sharded:
+        return None
+    return sharded[newest_sharded]
+
+
+def _restore_zkhost_tree(step_root: str) -> dict:
+    """Reassemble the inference-relevant subtrees (``params`` /
+    ``ema_params`` / ``model_state``) of one COMMITTED per-host sharded
+    step (docs/DESIGN.md §19 layout) into full host numpy arrays — the
+    serving-side reader of the multi-host checkpoint protocol. A
+    single serving process stitches every host's shards back together
+    by each shard's recorded global index; a genuinely multi-host
+    layout warns LOUDLY (the whole state must fit this one host's
+    memory — consolidate via ``save_model`` for very large runs).
+    Raises :class:`CheckpointUnreadableError` on torn/missing shards.
+    """
+    import numpy as np
+
+    try:
+        with open(os.path.join(step_root, "COMMIT.json")) as f:
+            commit = json.load(f)
+    except (OSError, ValueError) as e:
+        raise CheckpointUnreadableError(
+            f"sharded step at {step_root!r} has no readable commit "
+            f"record: {e}"
+        ) from e
+    hosts = sorted(commit.get("hosts", []))
+    if not hosts:
+        raise CheckpointUnreadableError(
+            f"sharded step at {step_root!r}: commit record lists no "
+            "hosts."
+        )
+    if len(hosts) > 1:
+        logger.warning(
+            "loading a MULTI-HOST sharded checkpoint (%d hosts) at %s "
+            "into one serving process: every host's shards are "
+            "reassembled here, so the full state must fit this host's "
+            "memory — for very large runs consolidate with save_model "
+            "and serve the export instead",
+            len(hosts),
+            step_root,
+        )
+    # Dedup shards across hosts by (path, global-index): replicated
+    # leaves were saved by every host with identical bytes.
+    shards: dict = {}
+    npzs = []
+    try:
+        for host in hosts:
+            host_dir = os.path.join(step_root, host)
+            try:
+                with open(os.path.join(host_dir, "manifest.json")) as f:
+                    manifest = json.load(f)
+                npz = np.load(os.path.join(host_dir, "data.npz"))
+            except (OSError, ValueError) as e:
+                raise CheckpointUnreadableError(
+                    f"sharded step at {step_root!r}: host dir {host} "
+                    f"unreadable ({e}) — torn after commit (GC race / "
+                    "lost tier)?"
+                ) from e
+            npzs.append(npz)
+            for akey, meta in manifest.items():
+                token = (meta["path"], _index_token(meta["index"]))
+                if token not in shards:
+                    shards[token] = (meta, npz, akey)
+        # Group by leaf path and stitch.
+        by_leaf: dict = {}
+        for (pstr, token), (meta, npz, akey) in shards.items():
+            by_leaf.setdefault(pstr, []).append((meta, npz, akey))
+        tree: dict = {}
+        for pstr, entries in by_leaf.items():
+            # Subtree filter FIRST: opt_state paths routinely contain
+            # tuple/attr segments ("['opt_state'][0].count" — any
+            # stateful optax optimizer) and are not inference weights;
+            # the nested-dict purity requirement applies only to the
+            # subtrees actually reassembled.
+            if not any(
+                pstr.startswith(f"['{k}']")
+                for k in ("params", "ema_params", "model_state")
+            ):
+                continue
+            segs = _KEYSTR_SEGMENT_RE.findall(pstr)
+            if "".join(f"['{s}']" for s in segs) != pstr:
+                raise CheckpointUnreadableError(
+                    f"sharded step at {step_root!r}: leaf path {pstr!r} "
+                    "is not a pure nested-dict path — cannot "
+                    "reassemble it for inference."
+                )
+            meta0 = entries[0][0]
+            shape = tuple(meta0["shape"])
+            dtype = np.dtype(meta0["dtype"])
+            arr = np.zeros(shape, dtype)
+            covered = 0
+            for meta, npz, akey in entries:
+                data = np.frombuffer(
+                    npz[akey].tobytes(), dtype=np.dtype(meta["dtype"])
+                ).reshape(meta["shard_shape"])
+                region = tuple(
+                    slice(a, b) for a, b in meta["index"]
+                )
+                arr[region] = data
+                covered += int(np.prod(meta["shard_shape"]))
+            if covered < int(np.prod(shape)):
+                raise CheckpointUnreadableError(
+                    f"sharded step at {step_root!r}: leaf {pstr} covers "
+                    f"{covered} of {int(np.prod(shape))} elements — a "
+                    "host's shards are missing (restore topology "
+                    "narrower than the saving group's?)."
+                )
+            node = tree
+            for s in segs[:-1]:
+                node = node.setdefault(s, {})
+            node[segs[-1]] = arr
+        if "params" not in tree:
+            raise CheckpointUnreadableError(
+                f"sharded step at {step_root!r} holds no 'params' "
+                "shards — not a TrainState checkpoint."
+            )
+        return tree
+    finally:
+        for npz in npzs:
+            try:
+                npz.close()
+            except Exception:
+                pass
+
+
 def load_inference_model(
     path: str,
     *,
@@ -1640,29 +1793,40 @@ def load_inference_model(
     import orbax.checkpoint as ocp
 
     path = os.path.abspath(os.path.expanduser(path))
-    item_dir = _checkpoint_manager_item_dir(path, step=step)
-    # Target-free restore is deliberate (it is what makes ONE loader
-    # serve both artifact layouts without knowing the exporting run's
-    # optimizer tree); orbax warns "generally UNSAFE" on every such
-    # call, but the structure IS validated below against the *_like
-    # trees — silence just that warning.
-    import logging
+    zkhost_dir = (
+        _zkhost_step_dir(path, step) if os.path.isdir(path) else None
+    )
+    if zkhost_dir is not None:
+        # Committed per-host sharded step (docs/DESIGN.md §19): the
+        # serving-side reader reassembles the shard manifests — the
+        # CheckpointWatcher's addressing mode lands here when a
+        # multi-host training run is being tracked.
+        restored = _restore_zkhost_tree(zkhost_dir)
+    else:
+        item_dir = _checkpoint_manager_item_dir(path, step=step)
+        # Target-free restore is deliberate (it is what makes ONE
+        # loader serve both artifact layouts without knowing the
+        # exporting run's optimizer tree); orbax warns "generally
+        # UNSAFE" on every such call, but the structure IS validated
+        # below against the *_like trees — silence just that warning.
+        import logging
 
-    absl_logger = logging.getLogger("absl")
-    prev_level = absl_logger.level
-    absl_logger.setLevel(logging.ERROR)
-    try:
-        with ocp.StandardCheckpointer() as ckptr:
-            try:
-                restored = ckptr.restore(item_dir or path)
-            except Exception as e:
-                raise CheckpointUnreadableError(
-                    f"No restorable checkpoint at {path!r} (expected a "
-                    "save_model export or a Checkpointer directory). "
-                    f"Original orbax error: {e}"
-                ) from e
-    finally:
-        absl_logger.setLevel(prev_level)
+        absl_logger = logging.getLogger("absl")
+        prev_level = absl_logger.level
+        absl_logger.setLevel(logging.ERROR)
+        try:
+            with ocp.StandardCheckpointer() as ckptr:
+                try:
+                    restored = ckptr.restore(item_dir or path)
+                except Exception as e:
+                    raise CheckpointUnreadableError(
+                        f"No restorable checkpoint at {path!r} "
+                        "(expected a save_model export or a "
+                        "Checkpointer directory). "
+                        f"Original orbax error: {e}"
+                    ) from e
+        finally:
+            absl_logger.setLevel(prev_level)
     if not isinstance(restored, dict) or "params" not in restored:
         raise ValueError(
             f"Checkpoint at {path!r} has no 'params' tree — not a "
