@@ -1520,6 +1520,147 @@ def measure_trace_overhead(env=None):
     }
 
 
+def measure_binary_throughput(env=None):
+    """``ZK_BENCH_BINARY=1`` leg: Pallas-kernel-vs-reference A/B on the
+    pinned packed popcount deployment forward (docs/DESIGN.md §21).
+
+    Builds the ``ZK_BENCH_BINARY_MODEL`` (default QuickNetLarge — the
+    north-star family) with ``binary_compute="xnor_popcount"`` and
+    ``packed_weights=True`` (the LCE-converter deployment artifact: sign
+    words + folded per-channel scales), then times the SAME packed
+    forward twice — ``binary_flavor="pallas"`` (the fused §21 kernels)
+    vs ``binary_flavor="reference"`` (the unfused popcount composition)
+    — on identical params and inputs. Logits are asserted BIT-IDENTICAL
+    between the passes (the bench re-pins the §21 exact-integer
+    contract on every run) and both jits are asserted compile-free
+    after warmup, so the speedup compares two certified-equal programs.
+
+    Off-TPU the kernels run in interpret mode (a numerics vehicle, not
+    a perf claim — the speedup is only meaningful on TPU, where the
+    driver runs this leg; interpret-mode numbers still pin the A/B
+    harness itself). Emits ``binary_kernel_images_per_sec_per_chip`` /
+    ``binary_reference_images_per_sec_per_chip`` /
+    ``binary_kernel_speedup`` (kernel/reference — the headline) plus
+    ``binary_mfu_vs_measured_int8_peak`` (kernel-pass XLA-counted
+    FLOPs over the measured int8 MXU ceiling — the honest denominator
+    for binary compute, which the MXU never exceeds; -1 when cost
+    analysis is unavailable) and the informational workload shape.
+
+    Knobs: ``ZK_BENCH_BINARY_BATCH`` (default 8),
+    ``ZK_BENCH_BINARY_IMAGE`` (square image side, default 64),
+    ``ZK_BENCH_BINARY_ITERS`` (timed iterations, default 10),
+    ``ZK_BENCH_BINARY_MODEL`` (default QuickNetLarge)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from zookeeper_tpu import models as zoo
+    from zookeeper_tpu.core import configure
+    from zookeeper_tpu.models import Model
+    from zookeeper_tpu.ops.packed import pack_quantconv_params
+
+    env = os.environ if env is None else env
+    batch_size = int(env.get("ZK_BENCH_BINARY_BATCH", "8"))
+    image = int(env.get("ZK_BENCH_BINARY_IMAGE", "64"))
+    iters = int(env.get("ZK_BENCH_BINARY_ITERS", "10"))
+    model_name = env.get("ZK_BENCH_BINARY_MODEL", "QuickNetLarge")
+    model_cls = getattr(zoo, model_name, None)
+    if not (isinstance(model_cls, type) and issubclass(model_cls, Model)):
+        raise ValueError(
+            f"ZK_BENCH_BINARY_MODEL={model_name!r} is not in the zoo."
+        )
+    required = {"binary_compute", "packed_weights", "binary_flavor"}
+    missing = required - set(model_cls.__component_fields__)
+    if missing:
+        raise ValueError(
+            f"ZK_BENCH_BINARY_MODEL={model_name!r} has no packed binary "
+            f"deployment path (missing {sorted(missing)})."
+        )
+    on_tpu = jax.default_backend() == "tpu"
+
+    def build(packed, flavor):
+        model = model_cls()
+        configure(
+            model,
+            {
+                "binary_compute": "xnor_popcount",
+                "packed_weights": packed,
+                # Interpret mode is the off-TPU numerics vehicle only;
+                # on TPU the compiled Mosaic kernels run.
+                "pallas_interpret": not on_tpu,
+                "binary_flavor": flavor,
+            },
+            name="binary_bench_model",
+        )
+        return model.build((image, image, 3), num_classes=1000)
+
+    x = jnp.asarray(
+        np.random.default_rng(0).normal(
+            size=(batch_size, image, image, 3)
+        ),
+        jnp.float32,
+    )
+    # Train-float params -> packed deployment params, exactly the
+    # LCE-converter path the zoo round-trip test certifies.
+    float_module = build(packed=False, flavor="reference")
+    variables = float_module.init(jax.random.PRNGKey(0), x, training=False)
+    packed_vars = {
+        **variables,
+        "params": pack_quantconv_params(variables["params"]),
+    }
+
+    def timed_forward(flavor):
+        module = build(packed=True, flavor=flavor)
+        fwd = jax.jit(
+            lambda v, xb: module.apply(v, xb, training=False)
+        )
+        y = jax.block_until_ready(fwd(packed_vars, x))  # warmup compile
+        start = time.perf_counter()
+        for _ in range(iters):
+            y = jax.block_until_ready(fwd(packed_vars, x))
+        elapsed = (time.perf_counter() - start) / iters
+        if fwd._cache_size() != 1:
+            raise RuntimeError(
+                f"binary leg ({flavor}) recompiled mid-loop "
+                f"(cache size {fwd._cache_size()}); the timing is invalid."
+            )
+        flops = cost_flops(fwd.lower(packed_vars, x).compile())
+        return np.asarray(y), elapsed, flops
+
+    y_kernel, t_kernel, kernel_flops = timed_forward("pallas")
+    y_reference, t_reference, _ = timed_forward("reference")
+    if not np.array_equal(y_kernel, y_reference):
+        raise RuntimeError(
+            "binary leg: kernel and reference logits differ — the §21 "
+            "bit-identity contract is broken; the A/B is meaningless."
+        )
+    n_chips = 1  # single-device forward: jit places it on one chip
+    int8_peak, int8_source = resolve_int8_peak(env)
+    mfu_int8 = (
+        round(kernel_flops / t_kernel / int8_peak, 4)
+        if kernel_flops is not None
+        else -1.0
+    )
+    return {
+        "binary_kernel_images_per_sec_per_chip": round(
+            batch_size / t_kernel / n_chips, 1
+        ),
+        "binary_reference_images_per_sec_per_chip": round(
+            batch_size / t_reference / n_chips, 1
+        ),
+        "binary_kernel_speedup": round(t_reference / t_kernel, 3)
+        if t_kernel > 0
+        else -1.0,
+        "binary_mfu_vs_measured_int8_peak": mfu_int8,
+        "binary_int8_peak_source": int8_source,
+        # Informational workload shape + execution vehicle.
+        "binary_model": model_name,
+        "binary_batch": batch_size,
+        "binary_image": image,
+        "binary_kernel_flavor": "pallas" if on_tpu else "pallas_interpret",
+    }
+
+
 # The LM perf leg's pinned workload: the configuration behind
 # BASELINE.md's 187k tokens/s claim (TransformerLM 4L/d512/h8, flash
 # attention, s=8192, b=4, vocab 1024, bf16) — pinned so the number is
@@ -2280,6 +2421,22 @@ def main(argv=None):
             )
             obs_metrics = None
 
+    # Binary-kernel A/B leg (env-gated: a second full model compile x2
+    # plus the packed-param conversion): fused §21 Pallas kernels vs
+    # the unfused popcount reference on the pinned packed deployment
+    # forward, logits asserted bit-identical between the passes.
+    binary_metrics = None
+    if _env_flag(os.environ, "ZK_BENCH_BINARY"):
+        try:
+            binary_metrics = measure_binary_throughput()
+        except Exception as e:  # never lose the primary metric
+            print(
+                f"binary kernel leg failed ({e}); omitting binary_*",
+                file=sys.stderr,
+                flush=True,
+            )
+            binary_metrics = None
+
     extras = {
         "model": model_name,
         "batch_size": batch_size,
@@ -2312,6 +2469,8 @@ def main(argv=None):
         extras.update(spec_metrics)
     if obs_metrics is not None:
         extras.update(obs_metrics)
+    if binary_metrics is not None:
+        extras.update(binary_metrics)
     if loop_time is not None:
         extras["unroll"] = unroll
         extras["loop_time_ms"] = round(loop_time * 1e3, 2)

@@ -361,16 +361,17 @@ def test_pack_quantconv_params_round_trip_quicknet():
     from zookeeper_tpu.core import configure
     from zookeeper_tpu.models import QuickNet
 
-    def build(packed):
+    def build(packed, bc="xnor", flavor="auto"):
         model = QuickNet()
         configure(
             model,
             {
                 "blocks_per_section": (1, 1),
                 "section_features": (32, 64),
-                "binary_compute": "xnor",
+                "binary_compute": bc,
                 "packed_weights": packed,
                 "pallas_interpret": True,
+                "binary_flavor": flavor,
             },
             name="model",
         )
@@ -388,6 +389,19 @@ def test_pack_quantconv_params_round_trip_quicknet():
     packed_vars = {**variables, "params": packed_params}
     y_packed = packed_module.apply(packed_vars, x, training=False)
     np.testing.assert_array_equal(np.asarray(y_float), np.asarray(y_packed))
+    # §21 flavor seam on the popcount deployment: the fused Pallas
+    # kernels (interpret mode as the numerics vehicle) must produce
+    # IDENTICAL logits to the reference composition on the same packed
+    # params — the zoo-level certification of the kernel bit-identity.
+    y_pc_ref = build(True, bc="xnor_popcount", flavor="reference").apply(
+        packed_vars, x, training=False
+    )
+    y_pc_pallas = build(True, bc="xnor_popcount", flavor="pallas").apply(
+        packed_vars, x, training=False
+    )
+    np.testing.assert_array_equal(
+        np.asarray(y_pc_ref), np.asarray(y_pc_pallas)
+    )
     # Structure matches what the packed module would declare.
     ref = jax.eval_shape(
         lambda: packed_module.init(jax.random.PRNGKey(0), x, training=False)

@@ -331,3 +331,42 @@ def test_speculative_keys_gate_with_registered_tolerances():
         assert ok.ok, key
         bad = compare({"metric": "x", key: 1.0 - tol * 1.5}, prev)
         assert not bad.ok and bad.regressions[0]["name"] == key
+
+
+def test_binary_kernel_era_keys_classify():
+    """The §21 binary-kernel A/B keys gate direction-aware: both
+    throughputs, the speedup and the int8-anchored MFU higher-better;
+    the workload shape and the flavor/source tags are config, not
+    perf."""
+    for key in (
+        "binary_kernel_images_per_sec_per_chip",
+        "binary_reference_images_per_sec_per_chip",
+        "binary_kernel_speedup",
+        "binary_mfu_vs_measured_int8_peak",
+    ):
+        assert bench_diff.classify_metric(key) == "higher", key
+    for key in (
+        "binary_model",
+        "binary_batch",
+        "binary_image",
+        "binary_kernel_flavor",
+        "binary_int8_peak_source",
+    ):
+        assert bench_diff.classify_metric(key) is None, key
+
+
+def test_binary_kernel_keys_gate_with_registered_tolerances():
+    from tools.bench_diff import TOLERANCES, compare
+
+    for key in (
+        "binary_kernel_images_per_sec_per_chip",
+        "binary_reference_images_per_sec_per_chip",
+        "binary_kernel_speedup",
+        "binary_mfu_vs_measured_int8_peak",
+    ):
+        tol = TOLERANCES[key]
+        prev = {"metric": "x", key: 1.0}
+        ok = compare({"metric": "x", key: 1.0 - tol * 0.9}, prev)
+        assert ok.ok, key
+        bad = compare({"metric": "x", key: 1.0 - tol * 1.5}, prev)
+        assert not bad.ok and bad.regressions[0]["name"] == key

@@ -93,13 +93,22 @@ TOLERANCES = {
     "prefix_cold_ttft_p50_ms": 0.40,
     "prefix_warm_ttft_p50_ms": 0.40,
     "prefix_ttft_speedup": 0.35,
+    # Binary-kernel era (docs/DESIGN.md §21): the A/B throughputs are
+    # single-device forward wall clocks (decode-leg jitter class); the
+    # speedup is a ratio of two jittery numbers; the int8-anchored MFU
+    # divides a per-iter wall time into cost-analysis FLOPs, so host
+    # scheduling noise passes straight through.
+    "binary_kernel_images_per_sec_per_chip": 0.25,
+    "binary_reference_images_per_sec_per_chip": 0.25,
+    "binary_kernel_speedup": 0.35,
+    "binary_mfu_vs_measured_int8_peak": 0.30,
 }
 
 #: HIGHER-better metric name patterns (throughput family). MBU joins
 #: MFU: both are utilization-of-roofline ratios where down = regressed.
 _HIGHER = re.compile(
     r"(_per_sec|_per_sec_per_chip|_per_sec_per_core|_qps|qps_per_chip"
-    r"|^value$|^vs_baseline$|^mfu_|_mfu$|_mbu$|_speedup"
+    r"|^value$|^vs_baseline$|^mfu_|^binary_mfu_|_mfu$|_mbu$|_speedup"
     # Acceptance is the one _rate$ where UP is good (the generic _rate$
     # family — shed rate etc. — is lower-better); checked before _LOWER.
     r"|^spec_acceptance_rate$"
@@ -137,6 +146,8 @@ _INFORMATIONAL = re.compile(
     # statement, not a speed — none of them is a perf direction.
     r"|^prefix_requests$|^prefix_shared_tokens$|^prefix_tail_tokens$"
     r"|^prefix_hit_rate$|^prefix_cow_pages$|^kv_pool_fill$"
+    # Binary-kernel-leg workload shape (model, batch, image side).
+    r"|^binary_model$|^binary_batch$|^binary_image$"
     # Peak ANCHORS and model FLOP counts are measurement context, not
     # code performance: an anchor that moved (re-measured peak, fixed
     # cache pathology — BENCH_r04's 237.9 TF/s) or a FLOPs change (a

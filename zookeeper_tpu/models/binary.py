@@ -110,6 +110,8 @@ class _BinaryNetModule(nn.Module):
     #: see _BinaryAlexNetModule.fold_bn), so conv-packed + fold raises.
     fold_bn: bool = False
     pallas_interpret: bool = False
+    #: §21 kernel flavor for the binary layers (see QuantConv).
+    binary_flavor: str = "auto"
 
     @nn.compact
     def __call__(self, x, training: bool = False):
@@ -124,6 +126,7 @@ class _BinaryNetModule(nn.Module):
                 binary_compute="mxu" if i == 0 else self.binary_compute,
                 packed_weights=False if i == 0 else self.packed_weights,
                 pallas_interpret=self.pallas_interpret,
+                binary_flavor=self.binary_flavor,
             )(x)
             if i % 2 == 1:
                 x = nn.max_pool(x, (2, 2), strides=(2, 2))
@@ -150,6 +153,7 @@ class _BinaryNetModule(nn.Module):
                 binary_compute=dense_bc,
                 packed_weights=dense_packed,
                 pallas_interpret=self.pallas_interpret,
+                binary_flavor=self.binary_flavor,
             )(x)
             x = _post_conv_bn(x, training, self.dtype, dense_fold)
         x = nn.Dense(self.num_classes, dtype=self.dtype)(x)
@@ -176,6 +180,8 @@ class BinaryNet(Model):
     fold_bn: bool = Field(False)
     #: Run Pallas kernels interpreted (CPU tests).
     pallas_interpret: bool = Field(False)
+    #: §21 kernel flavor for the binary layers (see QuantConv).
+    binary_flavor: str = Field("auto")
 
     def build(self, input_shape, num_classes: int) -> nn.Module:
         return _BinaryNetModule(
@@ -189,6 +195,7 @@ class BinaryNet(Model):
             dense_packed_weights=getattr(self, "dense_packed_weights", None),
             fold_bn=self.fold_bn,
             pallas_interpret=self.pallas_interpret,
+            binary_flavor=self.binary_flavor,
         )
 
 
@@ -464,6 +471,9 @@ class _QuickNetModule(nn.Module):
     #: BN cannot fold).
     fold_bn: bool = False
     pallas_interpret: bool = False
+    #: §21 kernel flavor for the binary convs ("auto"/"pallas"/
+    #: "reference"; numerics-identical — see QuantConv.binary_flavor).
+    binary_flavor: str = "auto"
 
     def _section_opt(self, value, s: int):
         if isinstance(value, (tuple, list)):
@@ -519,6 +529,7 @@ class _QuickNetModule(nn.Module):
                     pack_residuals=self.pack_residuals,
                     use_bias=fold_here,  # Carries the folded BN shift.
                     pallas_interpret=self.pallas_interpret,
+                    binary_flavor=self.binary_flavor,
                 )(x)
                 y = _post_conv_bn(y, training, d, fold_here)
                 x = x + y  # Residual around every binary conv.
@@ -545,6 +556,8 @@ class QuickNet(Model):
     #: (pair with ops.packed.pack_quantconv_params fold_bn=True).
     fold_bn: bool = Field(False)
     pallas_interpret: bool = Field(False)
+    #: §21 kernel flavor for the binary convs (see QuantConv).
+    binary_flavor: str = Field("auto")
 
     def build(self, input_shape, num_classes: int) -> nn.Module:
         n_sections = len(tuple(self.blocks_per_section))
@@ -570,6 +583,7 @@ class QuickNet(Model):
             pack_residuals=self.pack_residuals,
             fold_bn=self.fold_bn,
             pallas_interpret=self.pallas_interpret,
+            binary_flavor=self.binary_flavor,
         )
 
 

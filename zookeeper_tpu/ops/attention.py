@@ -37,6 +37,14 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from zookeeper_tpu.ops.blocks import (  # noqa: F401  (re-exports)
+    _FLASH_VMEM_BUDGET,
+    _decode_vmem_estimate,
+    _default_decode_blocks,
+    _default_flash_blocks,
+    _flash_bwd_vmem_estimate,
+)
+
 # Large-negative mask value: finite (so a fully-masked row's exp()
 # underflows to 0 instead of producing -inf - -inf = nan in the online
 # rescale), far below any real fp32 score.
@@ -206,74 +214,9 @@ def decode_attention_supported(num_heads: int, head_dim: int) -> bool:
     return num_heads >= 1 and head_dim >= 8 and head_dim % 8 == 0
 
 
-def _decode_vmem_estimate(block_kv, block_h, head_dim, itemsize):
-    """Rough bytes one decode-kernel grid step keeps resident: the
-    double-buffered K and V tiles at the operand dtype plus the fp32
-    broadcast intermediates (scores and the p*v product both
-    materialize ``[block_kv, block_h, head_dim]``) and the per-head
-    accumulators."""
-    tiles = 2 * 2 * block_kv * block_h * head_dim * itemsize
-    intermediates = 2 * block_kv * block_h * head_dim * 4
-    accumulators = (block_h * head_dim + 2 * block_h) * 4
-    return tiles + intermediates + accumulators
-
-
-def _default_decode_blocks(
-    capacity, num_heads, head_dim, page_size=1, itemsize=4,
-    block_kv=None, block_h=None,
-):
-    """Auto block policy for the decode kernel — the
-    ``_default_flash_blocks`` discipline applied to the KV-read axis:
-    the LARGEST aligned candidate that divides ``capacity``, nests with
-    the KV page size (equal, multiple, or divisor — so a block never
-    straddles a page boundary and the per-slot read bound stays
-    page-granular), and fits the VMEM budget. Large blocks amortize the
-    sequential grid iteration; small blocks tighten the length-bounded
-    read (expected overshoot is block/2 rows per slot) — 256 caps the
-    candidates because decode is memory-bound and past that the read
-    overshoot costs more HBM than the grid overhead saves. Falls back
-    to ``page_size`` (capacity is page-aligned by the engine) and
-    finally to a single ``capacity`` block — which, for a capacity no
-    candidate divides at ``page_size=1``, is taken WITHOUT a VMEM check
-    (there is no smaller legal block to demote to): such geometries are
-    unreachable through the engine (page-aligned capacity, nesting
-    page_size), and a direct op caller with a huge indivisible capacity
-    should pass ``block_kv`` explicitly. Explicit ``block_kv`` /
-    ``block_h`` pass through unchecked except for divisibility."""
-    if block_h is None:
-        block_h = num_heads
-        while block_h > 1 and _decode_vmem_estimate(
-            8, block_h, head_dim, itemsize
-        ) > _FLASH_VMEM_BUDGET:
-            block_h = block_h // 2
-    if num_heads % block_h != 0:
-        raise ValueError(
-            f"block_h={block_h} does not divide num_heads={num_heads}."
-        )
-    if block_kv is None:
-        block_kv = capacity
-        for cand in (256, 128, 64, 32, 16, 8):
-            if capacity % cand:
-                continue
-            if cand % page_size and page_size % cand:
-                continue  # block/page must nest (page-granular reads)
-            if _decode_vmem_estimate(
-                cand, block_h, head_dim, itemsize
-            ) > _FLASH_VMEM_BUDGET:
-                continue
-            block_kv = cand
-            break
-        if block_kv == capacity and page_size > 1 and capacity % page_size == 0:
-            if capacity > page_size and _decode_vmem_estimate(
-                capacity, block_h, head_dim, itemsize
-            ) > _FLASH_VMEM_BUDGET:
-                block_kv = page_size
-    if capacity % block_kv != 0:
-        raise ValueError(
-            f"block_kv={block_kv} does not divide the KV capacity "
-            f"{capacity}."
-        )
-    return int(block_kv), int(block_h)
+# _decode_vmem_estimate / _default_decode_blocks moved to ops/blocks.py
+# (shared with the flash, residual, and §21 binary policies); imported at
+# the top of this module so historical import sites keep working.
 
 
 def paged_decode_attention(
@@ -1167,67 +1110,10 @@ def flash_attention(
     )
 
 
-#: VMEM the auto flash-block policy budgets for one backward grid step
-#: (bytes). The backward kernels are the binding residency: three
-#: (block_q, block_k) fp32 intermediates (scores, P, dS) plus the
-#: double-buffered (block, head_dim) input tiles and fp32 accumulators.
-#: 64 MiB keeps the measured sweep winner (block 1024 at head_dim 64,
-#: ~16 MiB) comfortably in and demotes only extreme head dims on
-#: v5e-class parts (128 MiB physical VMEM/core; older generations are
-#: ~16 MiB — pass explicit blocks or a smaller budget there).
-_FLASH_VMEM_BUDGET = 64 * 1024 * 1024
-
-
-def _flash_bwd_vmem_estimate(block_q, block_k, head_dim, itemsize):
-    """Rough bytes one backward grid step keeps resident in VMEM: the
-    three fp32 (bq, bk) intermediates + six (block, d) input tiles at
-    the operand dtype, double-buffered by the Mosaic pipeline, + two
-    fp32 (block, d) accumulators."""
-    blk = max(block_q, block_k)
-    intermediates = 3 * block_q * block_k * 4
-    tiles = 2 * 6 * blk * head_dim * itemsize
-    accumulators = 2 * blk * head_dim * 4
-    return intermediates + tiles + accumulators
-
-
-def _default_flash_blocks(s, block_q, block_k, head_dim=None, itemsize=4):
-    """Auto block size: the LARGEST aligned candidate whose padding
-    waste stays under 1/8 of the sequence AND whose backward working
-    set fits the VMEM budget. Large blocks amortize the sequential
-    grid iteration (the sweep winner at every measured power-of-two
-    length — sweep_r07/flash_bwd_timing.py: 22.7 -> 5.26 ms/step at
-    s=8192 going 128 -> 1024), but a big block on an awkward length
-    would round the padded sequence up to the block multiple (s=1100
-    at block 1024 pads to 2048 — 86% wasted rows), so awkward lengths
-    fall back toward 128; and at head dims well above 64 the backward's
-    (block, d) tiles grow until a 1024 block exceeds VMEM — a loud
-    Mosaic compile failure if selected, so ``head_dim``-aware candidates
-    demote to the largest block that fits (``_flash_bwd_vmem_estimate``
-    against ``_FLASH_VMEM_BUDGET``). ``head_dim=None`` skips the VMEM
-    filter (padding-only policy, the pre-head_dim behavior); explicit
-    ``block_q``/``block_k`` always pass through untouched. Sequences at
-    or below a block are a single tile (clamped 16-aligned by
-    ``_flash_dims``)."""
-    if block_q is None or block_k is None:
-        auto = 128
-        for blk in (1024, 512, 256, 128):
-            pad = -(-s // blk) * blk - s
-            if pad * 8 > s:
-                continue
-            if (
-                head_dim is not None
-                and blk > 128
-                and _flash_bwd_vmem_estimate(blk, blk, head_dim, itemsize)
-                > _FLASH_VMEM_BUDGET
-            ):
-                continue
-            auto = blk
-            break
-        if block_q is None:
-            block_q = auto
-        if block_k is None:
-            block_k = auto
-    return block_q, block_k
+# _FLASH_VMEM_BUDGET / _flash_bwd_vmem_estimate / _default_flash_blocks
+# moved to ops/blocks.py (shared with the decode, residual, and §21 binary
+# policies); imported at the top of this module so historical import
+# sites (bench.py, the block-policy unit tests) keep working.
 
 
 def _flash_dims(s, block_q, block_k):

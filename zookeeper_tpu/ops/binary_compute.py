@@ -44,6 +44,16 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from zookeeper_tpu.ops.blocks import (  # noqa: F401  (re-exports)
+    _RESID_BLOCK_BYTES,
+    _default_binary_conv_block_n,
+    _default_binary_gemm_blocks,
+    _default_pack_rows_block,
+    _divisor_at_most,
+    _resid_blocks,
+    _round_up,
+)
+
 Array = jax.Array
 
 _MXU_WORDS = 16  # K-words per grid step in packed kernels (512 binary K).
@@ -82,8 +92,9 @@ def unpack_bits(packed: Array, k: int, axis: int = -1) -> Array:
     return jnp.moveaxis(values, -1, axis)
 
 
-def _round_up(x: int, m: int) -> int:
-    return (x + m - 1) // m * m
+# _round_up and the block policies live in ops/blocks.py (shared with
+# the flash/decode/pool kernels — docs/DESIGN.md §21); imported at the
+# top of this module so historical import sites keep working.
 
 
 # -- batch-packed 1-bit residual kernels (Pallas) ---------------------------
@@ -111,8 +122,7 @@ def _round_up(x: int, m: int) -> int:
 # (tiny at training batch sizes; correctness-only for small test
 # batches).
 
-#: VMEM budget per block (input side) for the residual kernels.
-_RESID_BLOCK_BYTES = 2 * 1024 * 1024
+# _RESID_BLOCK_BYTES (the per-block VMEM budget) moved to ops/blocks.py.
 
 
 def _resid_interpret(interpret) -> bool:
@@ -147,20 +157,7 @@ def _to_4d_shape(shape):
     )
 
 
-def _divisor_at_most(n: int, cap: int) -> int:
-    for d in range(max(1, min(cap, n)), 0, -1):
-        if n % d == 0:
-            return d
-    return 1
-
-
-def _resid_blocks(h: int, w: int, c: int, itemsize: int):
-    """(bh, bw): spatial block dims dividing (h, w) with the 32-deep
-    input block inside the VMEM budget."""
-    per_row = 32 * c * itemsize
-    bw = _divisor_at_most(w, max(1, _RESID_BLOCK_BYTES // per_row))
-    bh = _divisor_at_most(h, max(1, _RESID_BLOCK_BYTES // (per_row * bw)))
-    return bh, bw
+# _divisor_at_most / _resid_blocks moved to ops/blocks.py.
 
 
 def _pack_resid_kernel(x_ref, out_ref, *, mask_mode: bool):
@@ -450,6 +447,235 @@ def xnor_matmul(
     return out.astype(jnp.float32)
 
 
+# -- fused binary kernels + flavor seam (docs/DESIGN.md §21) ----------------
+#
+# The paths above compose three XLA-visible stages around the popcount
+# GEMM: a 32x-intermediate sign+pack of the activations (pack_bits), the
+# kernel launch, and a separate fp32 scale pass over the int32 output.
+# The §21 kernels collapse the pipeline: a Pallas sign+pack producer
+# writes wire-format words straight from the float activations (one read
+# of the source, one 1/32-size write), and the GEMM applies the
+# k_true-correction AND the per-output-channel scale in its epilogue, so
+# the int32 accumulator never round-trips through HBM. Selection happens
+# behind the existing numerics contract via the same flavor seam as
+# DecodeEngine.decode_attention: "auto" resolves to the fused kernels on
+# TPU and the reference composition off-TPU; interpret mode is a
+# numerics vehicle only (the CI certification path), never a perf claim.
+
+#: Binary compute flavors (layer field ``binary_flavor``): "auto" picks
+#: the fused Pallas path on TPU and the reference composition off-TPU;
+#: explicit values force one side (the A/B lever for the bench leg and
+#: the bit-identity certification).
+BINARY_FLAVORS = ("auto", "pallas", "reference")
+
+
+def resolve_binary_flavor(flavor: str) -> str:
+    """Resolve a binary-compute flavor to "pallas" or "reference".
+
+    Mirrors ``DecodeEngine.decode_attention``'s seam: "auto" is
+    backend-keyed (fused kernels on TPU, reference composition
+    elsewhere), explicit flavors pass through, anything else raises
+    loudly — a typo must not silently change which kernels serve."""
+    if flavor not in BINARY_FLAVORS:
+        raise ValueError(
+            f"binary_flavor must be one of {BINARY_FLAVORS}, got "
+            f"{flavor!r}."
+        )
+    if flavor != "auto":
+        return flavor
+    return "pallas" if jax.default_backend() == "tpu" else "reference"
+
+
+def _warn_pallas_fallback(what: str) -> None:
+    """Explicit ``flavor="pallas"`` on a path with no fused kernel
+    degrades to the reference composition with a warning — the decode
+    seam's unsupported-geometry discipline, made audible because the
+    caller asked for a specific flavor by name ("auto" degrades
+    silently; it never promised the fused path)."""
+    import warnings
+
+    warnings.warn(
+        f"binary_flavor='pallas' requested but {what} has no fused "
+        "Pallas path; running the reference composition (numerics are "
+        "identical).",
+        stacklevel=3,
+    )
+
+
+def _pack_rows_kernel(x_ref, out_ref):
+    """Fused sign+pack of one [bm, kw*32] float block into [bm, kw]
+    int32 wire-format words (little-endian bit b of word t is
+    ``x[:, 32t+b] >= 0`` — exactly :func:`pack_bits`).
+
+    Bit b of every word is gathered by a stride-32 lane slice, so the
+    kernel is 32 unrolled compare/shift/or VPU steps over [bm, kw]
+    tiles — the ``_pack_resid_kernel`` idiom rotated onto the trailing
+    axis, with no in-kernel reshape (splitting the lane dim into
+    [kw, 32] would force a Mosaic relayout). Traffic: one read of the
+    float source, one 1/32-size write — this is what removes the 32x
+    [..., 32]-shaped HBM intermediates of the XLA pack_bits lowering
+    (the round-6 lesson at the top of this file, now applied to the
+    GEMM operand path)."""
+    acc = jnp.zeros(out_ref.shape, jnp.int32)
+    for b in range(32):
+        # fp32 compare: Mosaic has no bf16 vector cmpf on this target.
+        chunk = x_ref[:, b::32].astype(jnp.float32)
+        acc = acc | ((chunk >= 0).astype(jnp.int32) << b)
+    out_ref[:] = acc
+
+
+def pack_rows_packed(x: Array, *, interpret=None, block_m: int = None) -> Array:
+    """Pallas sign+pack: [M, K] floats -> [M, K//32] int32 pack_bits
+    words — the fused quantizer producer for the §21 GEMM consumers
+    (``ste_sign``'s sign is the packed bit; the quantizer's scale rides
+    the weight-side epilogue, so the ±1 floats never round-trip HBM).
+
+    Bit-identical to ``pack_bits(x, axis=-1)`` by construction
+    (including NaN -> bit 0 and ±0 -> bit 1: both lower to the same
+    ``>= 0`` compare). K must be a multiple of 32; rows pad to the
+    block multiple and slice away (garbage rows are computed but
+    unread)."""
+    m, k = x.shape
+    if k % 32 != 0:
+        raise ValueError(f"Packed axis must be a multiple of 32, got {k}.")
+    kw = k // 32
+    itemsize = jnp.dtype(x.dtype).itemsize
+    if block_m is None:
+        block_m = _default_pack_rows_block(k, itemsize)
+    block_m = min(block_m, _round_up(m, 32))
+    mp = _round_up(m, block_m)
+    if mp != m:
+        x = jnp.pad(x, ((0, mp - m), (0, 0)))
+    out = pl.pallas_call(
+        _pack_rows_kernel,
+        out_shape=jax.ShapeDtypeStruct((mp, kw), jnp.int32),
+        grid=(mp // block_m,),
+        in_specs=[
+            pl.BlockSpec((block_m, k), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((block_m, kw), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+        interpret=_resid_interpret(interpret),
+    )(x)
+    return out[:m]
+
+
+def _xnor_scaled_kernel(a_ref, b_ref, s_ref, out_ref, acc_ref, *,
+                        k_true: int):
+    """One (m, n, k) grid step of the fused-epilogue binary GEMM: the
+    ``_xnor_kernel`` accumulation into int32 VMEM scratch, with the
+    ``k_true``-correction AND the per-output-channel fp32 scale applied
+    in the epilogue on the last K step — the int32 accumulator never
+    leaves VMEM and no separate XLA scale pass runs over the output.
+
+    Numerics (the §17-style documented-ULP statement, bound ZERO): the
+    mismatch count is an exact integer, ``k_true - 2*acc`` stays exact
+    in int32, the cast to fp32 is exact for any |dot| <= 2^24 (binary K
+    never approaches it), and the single fp32 multiply by the scale is
+    the SAME operation in the SAME order as the reference epilogue
+    ``acc.astype(float32) * scale`` — so the fused output is
+    bit-identical, not merely close."""
+    k = pl.program_id(2)
+    x = jnp.bitwise_xor(a_ref[:][:, :, None], b_ref[:][:, None, :])
+    mismatches = jnp.sum(_popcount32(x), axis=0)  # [bm, bn] int32
+
+    @pl.when(k == 0)
+    def _():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    acc_ref[:] += mismatches
+
+    @pl.when(k == pl.num_programs(2) - 1)
+    def _():
+        acc = acc_ref[:]
+        dots = k_true - (acc + acc)  # multiply-free, exact int32
+        out_ref[:] = dots.astype(jnp.float32) * s_ref[:]
+
+
+@partial(
+    jax.jit,
+    static_argnames=("k_true", "block_m", "block_n", "block_kw", "interpret"),
+)
+def xnor_matmul_packed_scaled(
+    a_packed: Array,
+    b_packed: Array,
+    scale: Array,
+    *,
+    k_true: int,
+    block_m: int = None,
+    block_n: int = None,
+    block_kw: int = None,
+    interpret: bool = False,
+) -> Array:
+    """Fused-epilogue binary GEMM: ``sign(A) @ sign(B) * scale`` in one
+    kernel, fp32 out.
+
+    Same operand contract as :func:`xnor_matmul_packed` (``a_packed``
+    [M, Kw], ``b_packed`` [Kw, N], K-words packed, equal-bit K padding
+    cancels) plus a per-output-channel ``scale`` [N] fp32. Blocks
+    default to the shared :mod:`ops.blocks` policy; output is
+    bit-identical to ``xnor_matmul_packed(...).astype(float32) *
+    scale`` (see the kernel docstring for why the bound is zero)."""
+    m, kw = a_packed.shape
+    kw2, n = b_packed.shape
+    if kw != kw2:
+        raise ValueError(f"Packed K mismatch: {kw} vs {kw2}.")
+    if scale.shape != (n,):
+        raise ValueError(
+            f"scale must be [{n}] (per output channel), got {scale.shape}."
+        )
+    auto_m, auto_n, auto_kw = _default_binary_gemm_blocks(m, n, kw)
+    block_m = auto_m if block_m is None else block_m
+    block_n = auto_n if block_n is None else block_n
+    block_kw = auto_kw if block_kw is None else block_kw
+    if not interpret:
+        # Mosaic lane/sublane legality — same rules as xnor_matmul_packed.
+        block_m = _round_up(block_m, 128)
+        block_n = _round_up(block_n, 128)
+        block_kw = _round_up(block_kw, 8)
+    block_m = min(block_m, _round_up(m, 8))
+    block_n = min(block_n, _round_up(n, 128))
+    block_kw = min(block_kw, kw)
+    mp = _round_up(m, block_m)
+    np_ = _round_up(n, block_n)
+    kwp = _round_up(kw, block_kw)
+    a_pad = jnp.pad(a_packed.T, ((0, kwp - kw), (0, mp - m)))
+    b_pad = jnp.pad(b_packed, ((0, kwp - kw), (0, np_ - n)))
+    s_pad = jnp.pad(
+        scale.astype(jnp.float32).reshape(1, n), ((0, 0), (0, np_ - n))
+    )
+
+    out = pl.pallas_call(
+        partial(_xnor_scaled_kernel, k_true=k_true),
+        out_shape=jax.ShapeDtypeStruct((mp, np_), jnp.float32),
+        grid=(mp // block_m, np_ // block_n, kwp // block_kw),
+        in_specs=[
+            pl.BlockSpec(
+                (block_kw, block_m),
+                lambda i, j, k: (k, i),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (block_kw, block_n),
+                lambda i, j, k: (k, j),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, block_n),
+                lambda i, j, k: (0, j),
+                memory_space=pltpu.VMEM,
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (block_m, block_n), lambda i, j, k: (i, j), memory_space=pltpu.VMEM
+        ),
+        scratch_shapes=[pltpu.VMEM((block_m, block_n), jnp.int32)],
+        interpret=interpret,
+    )(a_pad, b_pad, s_pad)
+    return out[:m, :n]
+
+
 # -- Packed-weight MXU Pallas GEMM (weights packed, MXU contraction) --------
 
 
@@ -670,6 +896,125 @@ def _spatial_pad(
     return x, ho, wo
 
 
+def _conv_gemm_kernel(x_ref, w_ref, s_ref, out_ref, acc_ref, *,
+                      kw: int, sw: int, wo: int, ciw: int, k_true: int):
+    """One (b, ho, n, kh) grid step of the §21 conv-as-gemm kernel.
+
+    im2col happens in the INDEX MAP, not as a materialized patch tensor:
+    the grid's innermost dim walks the kernel rows (dy), and the
+    activation BlockSpec picks padded input row ``i*sh + dy`` directly
+    (a block of size 1 makes the block index an element offset — the
+    §17/§20 indexing trick). Inside the step the kw taps are unrolled
+    static strided slices of the resident row, so one [Wp, ciw] word
+    row feeds all horizontal taps and each packed weight block streams
+    from HBM exactly once per (output row, channel block) — kh reads
+    total, vs the kh*kw patch-matrix copies of an XLA im2col.
+
+    Mismatches accumulate in int32 VMEM scratch across the dy steps;
+    the last step applies the ``k_true``-correction and per-channel
+    scale epilogue (same zero-ULP argument as
+    :func:`_xnor_scaled_kernel`)."""
+    dy = pl.program_id(3)
+    xrow = x_ref[0, 0]  # [Wp, ciw] packed activation row (+1-padded)
+    w = w_ref[0]  # [kw*ciw, bn] packed weights for kernel row dy
+
+    @pl.when(dy == 0)
+    def _():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    for dx in range(kw):
+        xs = xrow[dx : dx + (wo - 1) * sw + 1 : sw]  # [wo, ciw]
+        ws = w[dx * ciw : (dx + 1) * ciw]  # [ciw, bn]
+        x = jnp.bitwise_xor(xs[:, :, None], ws[None, :, :])
+        acc_ref[:] += jnp.sum(_popcount32(x), axis=1)  # [wo, bn]
+
+    @pl.when(dy == pl.num_programs(3) - 1)
+    def _():
+        acc = acc_ref[:]
+        dots = k_true - (acc + acc)  # multiply-free, exact int32
+        out_ref[0, 0] = dots.astype(jnp.float32) * s_ref[:]
+
+
+def _conv_gemm_popcount(
+    x: Array,
+    packed: Array,
+    scale: Array,
+    strides: Tuple[int, int],
+    padding: str,
+    *,
+    ci: int,
+    interpret: bool,
+    block_n: int = None,
+) -> Array:
+    """Fused-flavor popcount conv: Pallas sign+pack of the padded input
+    (channels packed once, reused by every tap that reads the pixel —
+    the patch-free counterpart of the reference path's per-tap
+    ``pack_bits`` calls), then the conv-as-gemm kernel.
+
+    Bit-identical to the reference ``_packed_conv_forward`` schedules:
+    identical padding semantics (ONE-padded SAME, the documented
+    popcount deviation), identical ``k_true = kh*kw*ci`` (the +1
+    channel padding matches ``pack_conv_kernel``'s +1 pad bits — zero
+    mismatches), and the same int32 -> fp32 -> one-multiply epilogue."""
+    kh, kw, ciw, co = packed.shape
+    xp, ho, wo = _spatial_pad(x, kh, kw, strides, padding, 1.0)
+    sh, sw = strides
+    b, hp, wp, _ = xp.shape
+    ci_pad = ciw * 32
+    if ci_pad != ci:
+        xp = jnp.pad(
+            xp, ((0, 0), (0, 0), (0, 0), (0, ci_pad - ci)),
+            constant_values=1.0,
+        )
+    # Trailing-dim reshapes are layout-trivial (no relayout copy).
+    xq = pack_rows_packed(
+        xp.reshape(-1, ci_pad), interpret=interpret
+    ).reshape(b, hp, wp, ciw)
+    wq = packed.reshape(kh, kw * ciw, co)  # tap-major K, row-sliced by dy
+    if block_n is None:
+        block_n = _default_binary_conv_block_n(wo, ciw, co)
+    np_ = _round_up(co, block_n)
+    if np_ != co:
+        wq = jnp.pad(wq, ((0, 0), (0, 0), (0, np_ - co)))
+    s_pad = jnp.pad(
+        scale.astype(jnp.float32).reshape(1, co), ((0, 0), (0, np_ - co))
+    )
+
+    out = pl.pallas_call(
+        partial(
+            _conv_gemm_kernel,
+            kw=kw, sw=sw, wo=wo, ciw=ciw, k_true=kh * kw * ci,
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, ho, wo, np_), jnp.float32),
+        grid=(b, ho, np_ // block_n, kh),
+        in_specs=[
+            pl.BlockSpec(
+                (1, 1, wp, ciw),
+                lambda bi, i, j, dy: (bi, i * sh + dy, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, kw * ciw, block_n),
+                lambda bi, i, j, dy: (dy, 0, j),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, block_n),
+                lambda bi, i, j, dy: (0, j),
+                memory_space=pltpu.VMEM,
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, wo, block_n),
+            lambda bi, i, j, dy: (bi, i, 0, j),
+            memory_space=pltpu.VMEM,
+        ),
+        scratch_shapes=[pltpu.VMEM((wo, block_n), jnp.int32)],
+        interpret=_resid_interpret(interpret),
+    )(xq, wq, s_pad)
+    return out[..., :co]
+
+
 #: Auto tap-fusion threshold: fuse when the tap-major patch matrix
 #: ([M, kh*kw*ci_pad] int8-equivalent) stays under this many bytes.
 #: Covers the whole latency-critical small-batch inference regime (the
@@ -689,6 +1034,7 @@ def _packed_conv_forward(
     use_popcount: bool,
     interpret: bool,
     fuse_taps: bool = None,
+    flavor: str = "auto",
 ) -> Array:
     """Conv against pre-packed weights, as tap GEMMs on a Pallas kernel.
 
@@ -717,7 +1063,21 @@ def _packed_conv_forward(
     popcount kernel — spatial padding must then be +-1, so SAME uses
     ONE-padding (the LCE-style fast semantics; documented, and exact for
     VALID).
+
+    ``flavor`` (§21): "pallas" (or "auto" on TPU) routes the popcount
+    path to the fused conv-as-gemm kernel (:func:`_conv_gemm_popcount`,
+    bit-identical); the MXU path has no fused flavor yet, so an
+    explicit "pallas" there warns and degrades to this composition.
     """
+    resolved = resolve_binary_flavor(flavor)
+    if use_popcount and resolved == "pallas":
+        return _conv_gemm_popcount(
+            x, packed, scale, tuple(strides), padding,
+            ci=ci, interpret=interpret,
+        )
+    if flavor == "pallas" and not use_popcount:
+        _warn_pallas_fallback("the packed-weight MXU conv "
+                              "(use_popcount=False)")
     kh, kw, ciw, co = packed.shape
     b, _, _, _ = x.shape
     pad_value = 1.0 if use_popcount else 0.0
@@ -816,7 +1176,7 @@ def _reference_conv(x, k, strides, padding, use_popcount):
     return _float_conv(x, k, strides, padding)
 
 
-@partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5))
+@partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5, 6))
 def xnor_conv(
     x: Array,
     q_kernel: Array,
@@ -824,6 +1184,7 @@ def xnor_conv(
     padding: str,
     use_popcount: bool = False,
     interpret: bool = False,
+    flavor: str = "auto",
 ) -> Array:
     """NHWC binary conv through the Pallas packed kernels.
 
@@ -843,19 +1204,22 @@ def xnor_conv(
     return _packed_conv_forward(
         x, packed, scale, strides, padding,
         ci=ci, use_popcount=use_popcount, interpret=interpret,
+        flavor=flavor,
     )
 
 
-def _xnor_conv_fwd(x, q_kernel, strides, padding, use_popcount, interpret):
+def _xnor_conv_fwd(x, q_kernel, strides, padding, use_popcount, interpret,
+                   flavor):
     packed, scale = pack_conv_kernel(q_kernel)
     y = _packed_conv_forward(
         x, packed, scale, strides, padding,
         ci=x.shape[-1], use_popcount=use_popcount, interpret=interpret,
+        flavor=flavor,
     )
     return y, (x, q_kernel)
 
 
-def _xnor_conv_bwd(strides, padding, use_popcount, interpret, res, g):
+def _xnor_conv_bwd(strides, padding, use_popcount, interpret, flavor, res, g):
     x, q_kernel = res
     _, vjp = jax.vjp(
         lambda xx, kk: _reference_conv(xx, kk, strides, padding, use_popcount),
@@ -868,25 +1232,28 @@ def _xnor_conv_bwd(strides, padding, use_popcount, interpret, res, g):
 xnor_conv.defvjp(_xnor_conv_fwd, _xnor_conv_bwd)
 
 
-@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def _packed_conv_infer_vjp(x, packed, scale, strides, padding, use_popcount,
-                           interpret):
+                           interpret, flavor):
     return _packed_conv_forward(
         x, packed, scale, strides, padding,
         ci=x.shape[-1], use_popcount=use_popcount, interpret=interpret,
+        flavor=flavor,
     )
 
 
 def _packed_infer_fwd(x, packed, scale, strides, padding, use_popcount,
-                      interpret):
+                      interpret, flavor):
     y = _packed_conv_forward(
         x, packed, scale, strides, padding,
         ci=x.shape[-1], use_popcount=use_popcount, interpret=interpret,
+        flavor=flavor,
     )
     return y, None
 
 
-def _packed_infer_bwd(strides, padding, use_popcount, interpret, res, g):
+def _packed_infer_bwd(strides, padding, use_popcount, interpret, flavor,
+                      res, g):
     raise ValueError(
         "packed_conv_infer is inference-only: packed weights carry no "
         "latent parameters to train. Differentiate the float model "
@@ -907,6 +1274,7 @@ def packed_conv_infer(
     *,
     use_popcount: bool = False,
     interpret: bool = False,
+    flavor: str = "auto",
 ) -> Array:
     """Inference conv from PRE-PACKED weights (32x less weight HBM).
 
@@ -914,10 +1282,11 @@ def packed_conv_infer(
     INFERENCE-ONLY: differentiating through it raises (a silent zero
     gradient would let a packed model "train" to nothing); quantized
     training uses :func:`xnor_conv`, which packs latent weights on the
-    fly.
+    fly. ``flavor`` selects the §21 fused kernels (see
+    :func:`resolve_binary_flavor`).
     """
     return _packed_conv_infer_vjp(
-        x, packed, scale, strides, padding, use_popcount, interpret
+        x, packed, scale, strides, padding, use_popcount, interpret, flavor
     )
 
 
@@ -947,9 +1316,10 @@ def _flatten_leading(x: Array) -> Tuple[Array, Tuple[int, ...]]:
 
 def _packed_dense_forward(
     x: Array, packed: Array, scale: Array, *, k_true: int,
-    use_popcount: bool, interpret: bool,
+    use_popcount: bool, interpret: bool, flavor: str = "auto",
 ) -> Array:
     x2, lead = _flatten_leading(x)
+    resolved = resolve_binary_flavor(flavor)
     if use_popcount:
         # Both operands packed: K pads with +1s on BOTH sides (matching
         # bits, zero mismatches — exact; requires +-1 inputs, validated
@@ -959,11 +1329,23 @@ def _packed_dense_forward(
             x2 = jnp.pad(
                 x2, ((0, 0), (0, k_pad - k_true)), constant_values=1.0
             )
+        if resolved == "pallas":
+            # §21 fused path: Pallas sign+pack producer + fused-epilogue
+            # GEMM — bit-identical to the composition below (zero-ULP
+            # epilogue argument in _xnor_scaled_kernel).
+            ap = pack_rows_packed(x2, interpret=interpret)
+            y = xnor_matmul_packed_scaled(
+                ap, packed, scale, k_true=k_true, interpret=interpret
+            )
+            return y.reshape(*lead, -1)
         acc = xnor_matmul_packed(
             pack_bits(x2, axis=-1), packed, k_true=k_true,
             interpret=interpret,
         )
     else:
+        if flavor == "pallas":
+            _warn_pallas_fallback("the packed-weight MXU dense "
+                                  "(use_popcount=False)")
         # Weights-only packed: A pads K with ZEROS (contribute nothing
         # against any weight bit — exact for {-1, 0, +1} inputs).
         acc = packed_weight_matmul(x2, packed, interpret=interpret)
@@ -976,30 +1358,33 @@ def _float_dense(x, k):
     return jnp.dot(x, k.astype(dtype))
 
 
-@partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+@partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
 def xnor_dense(x: Array, q_kernel: Array, use_popcount: bool = False,
-               interpret: bool = False) -> Array:
+               interpret: bool = False, flavor: str = "auto") -> Array:
     """Binary dense layer [..., K] @ [K, N] through the Pallas packed
     kernels, packing the latent-quantized kernel on the fly (the
     training-compatible path; STE composes via the float-matmul VJP on
-    the saved quantized operands, exactly like :func:`xnor_conv`)."""
+    the saved quantized operands, exactly like :func:`xnor_conv`). The
+    "pallas" flavor fuses the input-side sign+pack and the scale
+    epilogue into the GEMM (§21) — the training-path forward reads sign
+    words directly instead of round-tripping ±1 floats through HBM."""
     packed, scale = pack_dense_kernel(q_kernel)
     return _packed_dense_forward(
         x, packed, scale, k_true=q_kernel.shape[0],
-        use_popcount=use_popcount, interpret=interpret,
+        use_popcount=use_popcount, interpret=interpret, flavor=flavor,
     )
 
 
-def _xnor_dense_fwd(x, q_kernel, use_popcount, interpret):
+def _xnor_dense_fwd(x, q_kernel, use_popcount, interpret, flavor):
     packed, scale = pack_dense_kernel(q_kernel)
     y = _packed_dense_forward(
         x, packed, scale, k_true=q_kernel.shape[0],
-        use_popcount=use_popcount, interpret=interpret,
+        use_popcount=use_popcount, interpret=interpret, flavor=flavor,
     )
     return y, (x, q_kernel)
 
 
-def _xnor_dense_bwd(use_popcount, interpret, res, g):
+def _xnor_dense_bwd(use_popcount, interpret, flavor, res, g):
     x, q_kernel = res
     _, vjp = jax.vjp(_float_dense, x, q_kernel)
     dx, dk = vjp(g.astype(x.dtype))
@@ -1009,27 +1394,27 @@ def _xnor_dense_bwd(use_popcount, interpret, res, g):
 xnor_dense.defvjp(_xnor_dense_fwd, _xnor_dense_bwd)
 
 
-@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def _packed_dense_infer_vjp(x, packed, scale, k_true, use_popcount,
-                            interpret):
+                            interpret, flavor):
     return _packed_dense_forward(
         x, packed, scale, k_true=k_true, use_popcount=use_popcount,
-        interpret=interpret,
+        interpret=interpret, flavor=flavor,
     )
 
 
 def _packed_dense_infer_fwd(x, packed, scale, k_true, use_popcount,
-                            interpret):
+                            interpret, flavor):
     return (
         _packed_dense_forward(
             x, packed, scale, k_true=k_true, use_popcount=use_popcount,
-            interpret=interpret,
+            interpret=interpret, flavor=flavor,
         ),
         None,
     )
 
 
-def _packed_dense_infer_bwd(k_true, use_popcount, interpret, res, g):
+def _packed_dense_infer_bwd(k_true, use_popcount, interpret, flavor, res, g):
     raise ValueError(
         "packed_dense_infer is inference-only: packed weights carry no "
         "latent parameters to train. Differentiate the float model "
@@ -1050,11 +1435,14 @@ def packed_dense_infer(
     *,
     use_popcount: bool = False,
     interpret: bool = False,
+    flavor: str = "auto",
 ) -> Array:
     """Inference dense from PRE-PACKED weights (32x less weight HBM) —
-    the dense deployment path; differentiating through it raises."""
+    the dense deployment path; differentiating through it raises.
+    ``flavor`` selects the §21 fused kernels (see
+    :func:`resolve_binary_flavor`)."""
     return _packed_dense_infer_vjp(
-        x, packed, scale, k_true, use_popcount, interpret
+        x, packed, scale, k_true, use_popcount, interpret, flavor
     )
 
 

@@ -1,0 +1,284 @@
+"""Shared VMEM-aware auto block policies for the Pallas kernels.
+
+Every kernel family in the repo sizes its grid blocks the same way: pick
+the LARGEST aligned candidate whose working set fits a VMEM budget and
+whose padding waste stays bounded, then let explicit caller overrides
+pass through untouched. Until docs/DESIGN.md §21 that discipline lived
+in three private copies — ``_default_flash_blocks`` (flash attention,
+also reused by the pool kernels), ``_default_decode_blocks`` (paged
+decode), and ``_resid_blocks`` (1-bit residual pack/unpack). This module
+is the single home for all of them plus the binary xnor-popcount GEMM /
+conv-as-gemm policies they share with §21. The moved functions are
+byte-for-byte the attention.py / binary_compute.py versions (behavior is
+pinned by the pre-existing block-policy unit tests); attention.py and
+binary_compute.py re-export them so historical import sites keep
+working.
+
+Pure shape arithmetic only: nothing here imports jax, so the policies
+are usable from tests and tools without pulling in a backend.
+"""
+
+__all__ = [
+    "_FLASH_VMEM_BUDGET",
+    "_RESID_BLOCK_BYTES",
+    "_BINARY_GEMM_VMEM_BUDGET",
+    "_BINARY_CONV_VMEM_BUDGET",
+    "_BINARY_PACK_BLOCK_BYTES",
+    "_round_up",
+    "_divisor_at_most",
+    "_flash_bwd_vmem_estimate",
+    "_default_flash_blocks",
+    "_decode_vmem_estimate",
+    "_default_decode_blocks",
+    "_resid_blocks",
+    "_binary_gemm_vmem_estimate",
+    "_default_binary_gemm_blocks",
+    "_default_binary_conv_block_n",
+    "_default_pack_rows_block",
+]
+
+
+def _round_up(x: int, m: int) -> int:
+    return (x + m - 1) // m * m
+
+
+def _divisor_at_most(n: int, cap: int) -> int:
+    for d in range(max(1, min(cap, n)), 0, -1):
+        if n % d == 0:
+            return d
+    return 1
+
+
+# -- flash attention (forward/backward + pool kernels) ----------------------
+
+#: VMEM the auto flash-block policy budgets for one backward grid step
+#: (bytes). The backward kernels are the binding residency: three
+#: (block_q, block_k) fp32 intermediates (scores, P, dS) plus the
+#: double-buffered (block, head_dim) input tiles and fp32 accumulators.
+#: 64 MiB keeps the measured sweep winner (block 1024 at head_dim 64,
+#: ~16 MiB) comfortably in and demotes only extreme head dims on
+#: v5e-class parts (128 MiB physical VMEM/core; older generations are
+#: ~16 MiB — pass explicit blocks or a smaller budget there).
+_FLASH_VMEM_BUDGET = 64 * 1024 * 1024
+
+
+def _flash_bwd_vmem_estimate(block_q, block_k, head_dim, itemsize):
+    """Rough bytes one backward grid step keeps resident in VMEM: the
+    three fp32 (bq, bk) intermediates + six (block, d) input tiles at
+    the operand dtype, double-buffered by the Mosaic pipeline, + two
+    fp32 (block, d) accumulators."""
+    blk = max(block_q, block_k)
+    intermediates = 3 * block_q * block_k * 4
+    tiles = 2 * 6 * blk * head_dim * itemsize
+    accumulators = 2 * blk * head_dim * 4
+    return intermediates + tiles + accumulators
+
+
+def _default_flash_blocks(s, block_q, block_k, head_dim=None, itemsize=4):
+    """Auto block size: the LARGEST aligned candidate whose padding
+    waste stays under 1/8 of the sequence AND whose backward working
+    set fits the VMEM budget. Large blocks amortize the sequential
+    grid iteration (the sweep winner at every measured power-of-two
+    length — sweep_r07/flash_bwd_timing.py: 22.7 -> 5.26 ms/step at
+    s=8192 going 128 -> 1024), but a big block on an awkward length
+    would round the padded sequence up to the block multiple (s=1100
+    at block 1024 pads to 2048 — 86% wasted rows), so awkward lengths
+    fall back toward 128; and at head dims well above 64 the backward's
+    (block, d) tiles grow until a 1024 block exceeds VMEM — a loud
+    Mosaic compile failure if selected, so ``head_dim``-aware candidates
+    demote to the largest block that fits (``_flash_bwd_vmem_estimate``
+    against ``_FLASH_VMEM_BUDGET``). ``head_dim=None`` skips the VMEM
+    filter (padding-only policy, the pre-head_dim behavior); explicit
+    ``block_q``/``block_k`` always pass through untouched. Sequences at
+    or below a block are a single tile (clamped 16-aligned by
+    ``_flash_dims``)."""
+    if block_q is None or block_k is None:
+        auto = 128
+        for blk in (1024, 512, 256, 128):
+            pad = -(-s // blk) * blk - s
+            if pad * 8 > s:
+                continue
+            if (
+                head_dim is not None
+                and blk > 128
+                and _flash_bwd_vmem_estimate(blk, blk, head_dim, itemsize)
+                > _FLASH_VMEM_BUDGET
+            ):
+                continue
+            auto = blk
+            break
+        if block_q is None:
+            block_q = auto
+        if block_k is None:
+            block_k = auto
+    return block_q, block_k
+
+
+# -- paged decode attention -------------------------------------------------
+
+
+def _decode_vmem_estimate(block_kv, block_h, head_dim, itemsize):
+    """Rough bytes one decode-kernel grid step keeps resident: the
+    double-buffered K and V tiles at the operand dtype plus the fp32
+    broadcast intermediates (scores and the p*v product both
+    materialize ``[block_kv, block_h, head_dim]``) and the per-head
+    accumulators."""
+    tiles = 2 * 2 * block_kv * block_h * head_dim * itemsize
+    intermediates = 2 * block_kv * block_h * head_dim * 4
+    accumulators = (block_h * head_dim + 2 * block_h) * 4
+    return tiles + intermediates + accumulators
+
+
+def _default_decode_blocks(
+    capacity, num_heads, head_dim, page_size=1, itemsize=4,
+    block_kv=None, block_h=None,
+):
+    """Auto block policy for the decode kernel — the
+    ``_default_flash_blocks`` discipline applied to the KV-read axis:
+    the LARGEST aligned candidate that divides ``capacity``, nests with
+    the KV page size (equal, multiple, or divisor — so a block never
+    straddles a page boundary and the per-slot read bound stays
+    page-granular), and fits the VMEM budget. Large blocks amortize the
+    sequential grid iteration; small blocks tighten the length-bounded
+    read (expected overshoot is block/2 rows per slot) — 256 caps the
+    candidates because decode is memory-bound and past that the read
+    overshoot costs more HBM than the grid overhead saves. Falls back
+    to ``page_size`` (capacity is page-aligned by the engine) and
+    finally to a single ``capacity`` block — which, for a capacity no
+    candidate divides at ``page_size=1``, is taken WITHOUT a VMEM check
+    (there is no smaller legal block to demote to): such geometries are
+    unreachable through the engine (page-aligned capacity, nesting
+    page_size), and a direct op caller with a huge indivisible capacity
+    should pass ``block_kv`` explicitly. Explicit ``block_kv`` /
+    ``block_h`` pass through unchecked except for divisibility."""
+    if block_h is None:
+        block_h = num_heads
+        while block_h > 1 and _decode_vmem_estimate(
+            8, block_h, head_dim, itemsize
+        ) > _FLASH_VMEM_BUDGET:
+            block_h = block_h // 2
+    if num_heads % block_h != 0:
+        raise ValueError(
+            f"block_h={block_h} does not divide num_heads={num_heads}."
+        )
+    if block_kv is None:
+        block_kv = capacity
+        for cand in (256, 128, 64, 32, 16, 8):
+            if capacity % cand:
+                continue
+            if cand % page_size and page_size % cand:
+                continue  # block/page must nest (page-granular reads)
+            if _decode_vmem_estimate(
+                cand, block_h, head_dim, itemsize
+            ) > _FLASH_VMEM_BUDGET:
+                continue
+            block_kv = cand
+            break
+        if block_kv == capacity and page_size > 1 and capacity % page_size == 0:
+            if capacity > page_size and _decode_vmem_estimate(
+                capacity, block_h, head_dim, itemsize
+            ) > _FLASH_VMEM_BUDGET:
+                block_kv = page_size
+    if capacity % block_kv != 0:
+        raise ValueError(
+            f"block_kv={block_kv} does not divide the KV capacity "
+            f"{capacity}."
+        )
+    return int(block_kv), int(block_h)
+
+
+# -- 1-bit residual pack/unpack ---------------------------------------------
+
+#: VMEM budget per block (input side) for the residual kernels.
+_RESID_BLOCK_BYTES = 2 * 1024 * 1024
+
+
+def _resid_blocks(h: int, w: int, c: int, itemsize: int):
+    """(bh, bw): spatial block dims dividing (h, w) with the 32-deep
+    input block inside the VMEM budget."""
+    per_row = 32 * c * itemsize
+    bw = _divisor_at_most(w, max(1, _RESID_BLOCK_BYTES // per_row))
+    bh = _divisor_at_most(h, max(1, _RESID_BLOCK_BYTES // (per_row * bw)))
+    return bh, bw
+
+
+# -- binary xnor-popcount kernels (docs/DESIGN.md §21) ----------------------
+
+#: VMEM budget for one fused xnor GEMM grid step. The binding residency
+#: is the [block_kw, block_m, block_n] int32 xor intermediate (the VPU
+#: popcount reduces it immediately, but Mosaic materializes the
+#: broadcast); 8 MiB keeps the default 16x128x128 step (~1 MiB) and a
+#: 512x128 block comfortably in while leaving headroom for the
+#: double-buffered word tiles on 16 MiB-class parts.
+_BINARY_GEMM_VMEM_BUDGET = 8 * 1024 * 1024
+
+#: VMEM budget for the conv-as-gemm xor intermediate
+#: ([wo, ciw, block_n] int32 per kw tap). Tighter than the GEMM budget
+#: because the full output row stays resident in scratch as well.
+_BINARY_CONV_VMEM_BUDGET = 4 * 1024 * 1024
+
+#: Input-side VMEM budget per sign+pack block (same figure as the
+#: residual kernels — both are streaming 1-bit compressors).
+_BINARY_PACK_BLOCK_BYTES = _RESID_BLOCK_BYTES
+
+
+def _binary_gemm_vmem_estimate(block_m, block_n, block_kw):
+    """Rough bytes one fused xnor-GEMM grid step keeps resident: the
+    int32 xor broadcast, the double-buffered packed word tiles, the
+    int32 mismatch accumulator, and the fp32 output block."""
+    intermediate = block_kw * block_m * block_n * 4
+    tiles = 2 * block_kw * (block_m + block_n) * 4
+    accumulators = 2 * block_m * block_n * 4
+    return intermediate + tiles + accumulators
+
+
+def _default_binary_gemm_blocks(m, n, kw):
+    """Auto blocks for the fused xnor-popcount GEMM: start from the
+    Mosaic-legal floor (128x128 output block, ``_MXU_WORDS``-deep word
+    axis) and promote each output dim to the largest candidate whose
+    padding waste stays under 1/8 of the axis and whose working set
+    fits the budget — the ``_default_flash_blocks`` discipline on a
+    two-dim output grid. The word axis is never promoted past 16: K is
+    the streamed (innermost, revisiting-output) grid dim, so deeper
+    blocks only grow the xor intermediate without saving HBM reads."""
+    block_kw = 16 if kw >= 16 else 8
+    block_m, block_n = 128, 128
+    for blk in (512, 256):
+        if (-(-m // blk) * blk - m) * 8 > max(m, 1):
+            continue
+        if _binary_gemm_vmem_estimate(blk, block_n, block_kw) \
+                > _BINARY_GEMM_VMEM_BUDGET:
+            continue
+        block_m = blk
+        break
+    for blk in (512, 256):
+        if (-(-n // blk) * blk - n) * 8 > max(n, 1):
+            continue
+        if _binary_gemm_vmem_estimate(block_m, blk, block_kw) \
+                > _BINARY_GEMM_VMEM_BUDGET:
+            continue
+        block_n = blk
+        break
+    return block_m, block_n, block_kw
+
+
+def _default_binary_conv_block_n(wo, ciw, co):
+    """Output-channel block for the conv-as-gemm kernel: the largest
+    multiple of 128 (capped at 512 / the padded channel count) whose
+    per-tap xor intermediate ``[wo, ciw, block_n]`` fits the conv
+    budget, demoted by halving — never below the 128-lane floor."""
+    bn = min(512, _round_up(co, 128))
+    while bn > 128 and wo * ciw * bn * 4 > _BINARY_CONV_VMEM_BUDGET:
+        bn //= 2
+    return bn
+
+
+def _default_pack_rows_block(k, itemsize=4):
+    """Row block for the fused sign+pack kernel: the input block is
+    ``[block_m, k]`` (full packed axis per step), so rows are sized to
+    the pack budget and floored/aligned to 32 — a multiple of every
+    dtype's sublane tile (fp32 8, bf16 16, int8 32), capped at 256
+    because the kernel is bandwidth-bound past one VPU-saturating
+    block."""
+    rows = _BINARY_PACK_BLOCK_BYTES // max(1, k * itemsize)
+    return max(32, min(256, rows // 32 * 32))

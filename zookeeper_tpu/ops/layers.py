@@ -281,6 +281,11 @@ class QuantDense(nn.Module):
     binary_compute: str = "mxu"
     packed_weights: bool = False
     pallas_interpret: bool = False
+    #: §21 kernel flavor for the xnor paths: "auto" (fused Pallas
+    #: kernels on TPU, reference composition off-TPU), "pallas", or
+    #: "reference" — numerics-identical either way (the bench A/B and
+    #: certification lever).
+    binary_flavor: str = "auto"
     kernel_init: Callable = nn.initializers.glorot_normal()
     bias_init: Callable = nn.initializers.zeros_init()
 
@@ -289,8 +294,11 @@ class QuantDense(nn.Module):
         from zookeeper_tpu.ops.binary_compute import (
             int8_dense,
             packed_dense_infer,
+            resolve_binary_flavor,
             xnor_dense,
         )
+
+        resolve_binary_flavor(self.binary_flavor)  # loud typo check
 
         # See QuantConv: pin the batch dim to the data axes under a
         # partitioner's activation scope (no-op otherwise).
@@ -328,6 +336,7 @@ class QuantDense(nn.Module):
                 x, packed, kscale, ki,
                 use_popcount=self.binary_compute == "xnor_popcount",
                 interpret=self.pallas_interpret,
+                flavor=self.binary_flavor,
             ).astype(self.dtype)
         else:
             kernel = self.param(
@@ -351,6 +360,7 @@ class QuantDense(nn.Module):
                     x, kernel,
                     self.binary_compute == "xnor_popcount",
                     self.pallas_interpret,
+                    self.binary_flavor,
                 ).astype(self.dtype)
             else:
                 y = jnp.dot(x.astype(self.dtype), kernel.astype(self.dtype))
@@ -414,6 +424,11 @@ class QuantConv(nn.Module):
     pack_residuals: bool = False
     #: Run Pallas kernels in interpreter mode (CPU tests).
     pallas_interpret: bool = False
+    #: §21 kernel flavor for the xnor paths: "auto" (fused Pallas
+    #: kernels on TPU, reference composition off-TPU), "pallas", or
+    #: "reference" — numerics-identical either way (the bench A/B and
+    #: certification lever).
+    binary_flavor: str = "auto"
     kernel_init: Callable = nn.initializers.glorot_normal()
     bias_init: Callable = nn.initializers.zeros_init()
 
@@ -422,8 +437,11 @@ class QuantConv(nn.Module):
         from zookeeper_tpu.ops.binary_compute import (
             int8_conv,
             packed_conv_infer,
+            resolve_binary_flavor,
             xnor_conv,
         )
+
+        resolve_binary_flavor(self.binary_flavor)  # loud typo check
 
         # Under a partitioner's activation scope: pin the batch dim to the
         # data axes (both here and on the cotangent — the constraint
@@ -501,6 +519,7 @@ class QuantConv(nn.Module):
                 x, packed, kscale, tuple(self.strides), self.padding,
                 use_popcount=self.binary_compute == "xnor_popcount",
                 interpret=self.pallas_interpret,
+                flavor=self.binary_flavor,
             ).astype(self.dtype)
         else:
             kernel = self.param(
@@ -529,6 +548,7 @@ class QuantConv(nn.Module):
                     x, kernel, tuple(self.strides), self.padding,
                     self.binary_compute == "xnor_popcount",
                     self.pallas_interpret,
+                    self.binary_flavor,
                 ).astype(self.dtype)
             else:
                 from zookeeper_tpu.ops.binary_compute import conv_dim_numbers
@@ -1075,6 +1095,7 @@ class QuantSeparableConv(nn.Module):
     pointwise_compute: str = "mxu"
     packed_weights: bool = False
     pallas_interpret: bool = False
+    binary_flavor: str = "auto"
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
@@ -1099,4 +1120,5 @@ class QuantSeparableConv(nn.Module):
             binary_compute=self.pointwise_compute,
             packed_weights=self.packed_weights,
             pallas_interpret=self.pallas_interpret,
+            binary_flavor=self.binary_flavor,
         )(x)

@@ -62,6 +62,20 @@ logger = logging.getLogger(__name__)
 Array = Any
 
 
+def _tree_has_packed_kernels(tree: Any) -> bool:
+    """Walk a (possibly frozen) params mapping for ``kernel_packed``
+    leaves — the marker the packed layers store their bit-packed conv/
+    dense kernels under (ops/layers.py). Structural, not numeric: any
+    packed layer makes the deployment a packed one."""
+    items = getattr(tree, "items", None)
+    if items is None:
+        return False
+    for key, value in items():
+        if key == "kernel_packed" or _tree_has_packed_kernels(value):
+            return True
+    return False
+
+
 @component
 class InferenceEngine:
     """Compiled, bucketed forward passes over a bound model.
@@ -146,7 +160,20 @@ class InferenceEngine:
         object.__setattr__(self, "_recompiles_detected", 0)
         object.__setattr__(self, "_flops_by_key", {})
         object.__setattr__(self, "_last_dispatch_flops", None)
+        # Packed-deployment detection (docs/DESIGN.md §21): a params tree
+        # carrying bit-packed kernels serves binary compute, so its
+        # dispatches are additionally rated against the measured int8
+        # roofline (zk_serve_mfu_int8).
+        object.__setattr__(
+            self, "_packed_deployment", _tree_has_packed_kernels(params)
+        )
         return self
+
+    @property
+    def packed_deployment(self) -> bool:
+        """True when the bound params tree carries bit-packed kernels
+        (``kernel_packed`` leaves) — the §21 binary deployment path."""
+        return bool(getattr(self, "_packed_deployment", False))
 
     def _place_variables(self, variables: Any) -> Any:
         """Device placement under the bound partitioner's rules — the
@@ -517,6 +544,32 @@ class InferenceEngine:
             "bf16 peak (-1 = cost analysis unavailable)",
             initial=-1,
         ).set(value if value is not None else -1)
+        # §21 companion gauge: packed (binary) deployments are rated
+        # against the measured int8 roofline — the honest peak for a
+        # compute path whose promise is int-throughput, not bf16 FLOPs.
+        # ALWAYS rendered (the scrape smoke asserts presence on every
+        # service); real values only for packed deployments, -1 keeps
+        # the §14 mfu() totality contract everywhere else.
+        peak8 = getattr(self, "_mfu_peak_int8", None)
+        if peak8 is None:
+            from zookeeper_tpu.observability.peaks import (
+                reference_int8_peak_flops,
+            )
+
+            peak8 = reference_int8_peak_flops()[0]
+            object.__setattr__(self, "_mfu_peak_int8", peak8)
+        value8 = (
+            _ledger.mfu(flops, seconds, peak8)
+            if self.packed_deployment
+            else None
+        )
+        reg.gauge(
+            "zk_serve_mfu_int8",
+            help="last packed-deployment dispatch: ledger FLOPs / wall "
+            "time / measured int8 peak (-1 = not a packed deployment or "
+            "cost analysis unavailable)",
+            initial=-1,
+        ).set(value8 if value8 is not None else -1)
 
     # -- serving ---------------------------------------------------------
 

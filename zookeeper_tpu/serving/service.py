@@ -273,6 +273,9 @@ class ServingConfig(Experiment):
             # is stalling on XLA (the recompile watchdog fired).
             "recompiles_detected": self.engine.recompiles_detected,
             "queue_rows": self.batcher.queue_rows,
+            # §21: packed (bit-packed binary) deployments additionally
+            # publish zk_serve_mfu_int8 against the int8 roofline.
+            "packed_deployment": self.engine.packed_deployment,
             "watcher_alive": (
                 watcher.alive if watcher is not None else None
             ),
