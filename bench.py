@@ -1319,6 +1319,209 @@ def measure_speculative_throughput(env=None):
     }
 
 
+def measure_disagg_throughput(env=None):
+    """``ZK_BENCH_DISAGG=1`` leg: disaggregated-vs-single-mesh A/B on
+    the SAME weights and prompt set (docs/DESIGN.md §22).
+
+    Baseline first: a single-mesh paged DecodeEngine serves the full
+    workload (prefill and decode interleaved on one role — every
+    prefill dispatch lands between active streams' decode dispatches).
+    Then the disaggregated stack — prefill lanes on one role engine,
+    decode slots on another, each completed prefill's KV pages moved
+    across by PageTransfer — serves the identical prompts. Streams are
+    asserted TOKEN-IDENTICAL between the topologies (the bench re-pins
+    the §22 certification on every run) and BOTH legs are asserted
+    compile-free after warmup on every engine involved.
+
+    On the 1-device CPU reference box the roles overlap on the same
+    device, so the gated throughput measures the protocol's overhead
+    floor (transfer cost with nothing bought back); on a multi-slice
+    host the prefill role stops stealing the decode role's dispatch
+    slots and the TTFT tail is the headline. Emits
+    ``disagg_tokens_per_sec_per_chip`` / ``disagg_ttft_p50_ms`` /
+    ``disagg_ttft_p99_ms`` and the single-mesh counterparts
+    (``disagg_baseline_*``), ``transfer_ms_p50`` (per-handoff median
+    wall cost) plus informational workload-shape / transfer-volume
+    keys.
+
+    Knobs: ``ZK_BENCH_DISAGG_REQUESTS`` (default 32),
+    ``ZK_BENCH_DISAGG_SLOTS`` (decode role, default 8),
+    ``ZK_BENCH_DISAGG_LANES`` (prefill role, default 4),
+    ``ZK_BENCH_DISAGG_NEW_TOKENS`` (default 32),
+    ``ZK_BENCH_DISAGG_PROMPT`` (default 32),
+    ``ZK_BENCH_DISAGG_HOST_BOUNCE=1`` (force the portable host path),
+    ``ZK_BENCH_DECODE_LAYERS``/``_DMODEL``/``_HEADS`` (model geometry,
+    shared with the decode leg)."""
+    import numpy as np
+
+    from zookeeper_tpu.core import configure
+    from zookeeper_tpu.models import TransformerLM
+    from zookeeper_tpu.serving import DisaggScheduler, PageTransfer
+    from zookeeper_tpu.serving.decode import (
+        DecodeEngine,
+        DecodeMetrics,
+        DecodeScheduler,
+    )
+
+    env = os.environ if env is None else env
+    n_requests = int(env.get("ZK_BENCH_DISAGG_REQUESTS", "32"))
+    slots = int(env.get("ZK_BENCH_DISAGG_SLOTS", "8"))
+    lanes = int(env.get("ZK_BENCH_DISAGG_LANES", "4"))
+    new_tokens = int(env.get("ZK_BENCH_DISAGG_NEW_TOKENS", "32"))
+    max_prompt = int(env.get("ZK_BENCH_DISAGG_PROMPT", "32"))
+    host_bounce = _env_flag(env, "ZK_BENCH_DISAGG_HOST_BOUNCE")
+    num_layers = int(env.get("ZK_BENCH_DECODE_LAYERS", "4"))
+    d_model = int(env.get("ZK_BENCH_DECODE_DMODEL", "256"))
+    num_heads = int(env.get("ZK_BENCH_DECODE_HEADS", "4"))
+    vocab = 512
+    seq_len = max(128, 2 * (max_prompt + new_tokens))
+
+    model = TransformerLM()
+    configure(
+        model,
+        {
+            "num_layers": num_layers,
+            "d_model": d_model,
+            "num_heads": num_heads,
+            "max_seq_len": seq_len,
+            "attention": "dense",  # short prefills, off-TPU safe
+        },
+        name="disagg_bench_model",
+    )
+    module = model.build((seq_len,), vocab)
+    params, model_state = model.initialize(module, (seq_len,), seed=0)
+
+    def role(name, n_slots, **conf):
+        engine = DecodeEngine()
+        configure(
+            engine,
+            {
+                "slots": n_slots,
+                "seq_buckets": (max_prompt,),
+                "kv_capacity": seq_len,
+                "kv_layout": "paged",
+                **conf,
+            },
+            name=f"disagg_bench_{name}",
+        )
+        engine.bind(module, params, model_state)
+        engine.warmup()
+        return engine
+
+    rng = np.random.default_rng(0)
+    prompts = [
+        rng.integers(1, vocab, size=int(rng.integers(1, max_prompt + 1)))
+        .astype(np.int32)
+        for _ in range(n_requests)
+    ]
+
+    def serve(scheduler):
+        t0 = time.perf_counter()
+        streams = [scheduler.submit(p) for p in prompts]
+        scheduler.drain()
+        dt = time.perf_counter() - t0
+        outputs = [s.result() for s in streams]
+        return outputs, sum(int(o.shape[0]) for o in outputs), dt
+
+    # -- baseline: everything on one role -------------------------------
+    single = role("single", slots)
+    warm_single = single.compile_count
+    base_metrics = DecodeMetrics()
+    configure(base_metrics, {}, name="disagg_bench_base_metrics")
+    base_sched = DecodeScheduler()
+    configure(
+        base_sched,
+        {"max_new_tokens": new_tokens},
+        name="disagg_bench_base_sched",
+    )
+    base_sched.bind(single, metrics=base_metrics)
+    base_out, base_tokens, base_dt = serve(base_sched)
+    base_snap = base_metrics.snapshot()
+    if single.compile_count != warm_single:
+        raise RuntimeError(
+            "disagg baseline recompiled mid-traffic "
+            f"({warm_single} -> {single.compile_count}); the A/B is "
+            "invalid."
+        )
+    mesh = single._partitioner.mesh
+    n_chips = int(mesh.size) if mesh is not None else 1
+    # Release the baseline's KV + weights before the two role engines
+    # bind (three live caches would inflate the footprint of a leg
+    # whose point is the topology, not the memory).
+    base_sched.close()
+    single = None
+
+    # -- disaggregated: prefill role + decode role + page handoff -------
+    # Prefill batches as wide as the lane count allows (a bucket can
+    # never admit more sequences than there are lanes).
+    pre_buckets = tuple(b for b in (1, 2, 4) if b <= lanes) or (1,)
+    pre = role("prefill", lanes, prefill_buckets=pre_buckets)
+    dec = role("decode", slots, prefill_buckets=(1,), prefix_cache=False)
+    pre.warmup_transfer()
+    dec.warmup_transfer()
+    warm_pre, warm_dec = pre.compile_count, dec.compile_count
+    transfer = PageTransfer()
+    configure(
+        transfer, {"host_bounce": host_bounce}, name="disagg_bench_transfer"
+    )
+    dis_metrics = DecodeMetrics()
+    configure(dis_metrics, {}, name="disagg_bench_metrics")
+    transfer.bind(pre, dec, metrics=dis_metrics)
+    sched = DisaggScheduler()
+    configure(
+        sched, {"max_new_tokens": new_tokens}, name="disagg_bench_sched"
+    )
+    sched.bind(pre, dec, transfer, metrics=dis_metrics)
+    dis_out, dis_tokens, dis_dt = serve(sched)
+    dis_snap = dis_metrics.snapshot()
+    if pre.compile_count != warm_pre or dec.compile_count != warm_dec:
+        raise RuntimeError(
+            "disagg leg recompiled mid-traffic (prefill "
+            f"{warm_pre} -> {pre.compile_count}, decode "
+            f"{warm_dec} -> {dec.compile_count}); the A/B is invalid."
+        )
+    mismatch = sum(
+        1 for a, b in zip(base_out, dis_out) if not np.array_equal(a, b)
+    )
+    if mismatch:
+        raise RuntimeError(
+            f"disagg A/B: {mismatch}/{len(base_out)} streams differ "
+            "between the single-mesh and disaggregated topologies — "
+            "the §22 token-identity contract is broken; the "
+            "throughput comparison is meaningless."
+        )
+    ts = transfer.status()
+    return {
+        # Gated (direction-aware in tools/bench_diff.py).
+        "disagg_tokens_per_sec_per_chip": round(
+            dis_tokens / dis_dt / n_chips, 1
+        ),
+        "disagg_baseline_tokens_per_sec_per_chip": round(
+            base_tokens / base_dt / n_chips, 1
+        ),
+        "disagg_ttft_p50_ms": round(dis_snap.get("ttft_p50_ms", -1.0), 3),
+        "disagg_ttft_p99_ms": round(dis_snap.get("ttft_p99_ms", -1.0), 3),
+        "disagg_baseline_ttft_p50_ms": round(
+            base_snap.get("ttft_p50_ms", -1.0), 3
+        ),
+        "disagg_baseline_ttft_p99_ms": round(
+            base_snap.get("ttft_p99_ms", -1.0), 3
+        ),
+        "transfer_ms_p50": round(ts["transfer_ms_p50"], 3),
+        # Workload shape + transfer volume (informational — config and
+        # workload-determined tallies, not perf directions).
+        "disagg_requests": n_requests,
+        "disagg_slots": slots,
+        "disagg_lanes": lanes,
+        "disagg_new_tokens": new_tokens,
+        "disagg_transfer_handoffs": int(ts["handoffs_total"]),
+        "disagg_transfer_pages": int(ts["pages_total"]),
+        "disagg_transfer_bytes": int(ts["bytes_total"]),
+        "disagg_host_bounces": int(ts["host_bounces"]),
+        "disagg_generated_tokens": dis_tokens,
+    }
+
+
 def measure_trace_overhead(env=None):
     """``ZK_BENCH_OBS=1`` leg: the host-tracing cost on the step-time
     anchor — the observability layer's acceptance number
@@ -2406,6 +2609,23 @@ def main(argv=None):
             )
             spec_metrics = None
 
+    # Disaggregated-serving leg (env-gated: the same prompt set through
+    # the single-mesh baseline and the prefill/decode split with KV
+    # page handoff): streams asserted token-identical between the
+    # topologies, both legs compile-free; transfer_ms_p50 prices the
+    # handoff.
+    disagg_metrics = None
+    if _env_flag(os.environ, "ZK_BENCH_DISAGG"):
+        try:
+            disagg_metrics = measure_disagg_throughput()
+        except Exception as e:  # never lose the primary metric
+            print(
+                f"disagg leg failed ({e}); omitting disagg_*",
+                file=sys.stderr,
+                flush=True,
+            )
+            disagg_metrics = None
+
     # Observability-overhead leg (env-gated: interleaved traced/untraced
     # step chains): host-span tracing cost on the step-time anchor —
     # the <= 2% budget docs/DESIGN.md §13 commits to.
@@ -2467,6 +2687,8 @@ def main(argv=None):
         extras.update(prefix_metrics)
     if spec_metrics is not None:
         extras.update(spec_metrics)
+    if disagg_metrics is not None:
+        extras.update(disagg_metrics)
     if obs_metrics is not None:
         extras.update(obs_metrics)
     if binary_metrics is not None:

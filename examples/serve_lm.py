@@ -51,7 +51,7 @@ reported numbers are the decode subsystem's, not a synthetic loop's
 """
 
 from zookeeper_tpu import cli, task
-from zookeeper_tpu.serving import LMServingConfig
+from zookeeper_tpu.serving import DisaggServingConfig, LMServingConfig
 
 
 @task
@@ -60,5 +60,25 @@ class ServeLM(LMServingConfig):
     (synthetic deterministic prompt stream; see LMServingConfig)."""
 
 
+@task
+class ServeLMDisagg(DisaggServingConfig):
+    """Disaggregated prefill/decode serving (docs/DESIGN.md §22): the
+    same request stream through a prefill role and a decode role on
+    separate mesh slices, KV pages streamed between them. Also
+    reachable as ``ServeLM --disagg``."""
+
+
 if __name__ == "__main__":
+    import sys
+
+    if "--disagg" in sys.argv:
+        # ``ServeLM --disagg`` serves the disaggregated topology: swap
+        # the task in place so every other key=value applies unchanged
+        # (engine.* stays the decode role; prefill_engine.* /
+        # transfer.* / partitioner.*_devices are the disagg knobs).
+        sys.argv.remove("--disagg")
+        if "ServeLM" in sys.argv:
+            sys.argv[sys.argv.index("ServeLM")] = "ServeLMDisagg"
+        elif "ServeLMDisagg" not in sys.argv:
+            sys.argv.insert(1, "ServeLMDisagg")
     cli()

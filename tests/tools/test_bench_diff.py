@@ -370,3 +370,58 @@ def test_binary_kernel_keys_gate_with_registered_tolerances():
         assert ok.ok, key
         bad = compare({"metric": "x", key: 1.0 - tol * 1.5}, prev)
         assert not bad.ok and bad.regressions[0]["name"] == key
+
+
+def test_disagg_era_keys_classify():
+    """The §22 disaggregated-serving A/B keys gate direction-aware:
+    both topologies' throughputs higher-better, the TTFT tails and the
+    per-handoff transfer median lower-better (``transfer_ms_p50``
+    names its unit before the percentile — the explicit _LOWER entry);
+    role sizes and transfer-volume tallies are config/workload, not
+    perf."""
+    for key in (
+        "disagg_tokens_per_sec_per_chip",
+        "disagg_baseline_tokens_per_sec_per_chip",
+    ):
+        assert bench_diff.classify_metric(key) == "higher", key
+    for key in (
+        "disagg_ttft_p50_ms",
+        "disagg_ttft_p99_ms",
+        "disagg_baseline_ttft_p50_ms",
+        "disagg_baseline_ttft_p99_ms",
+        "transfer_ms_p50",
+    ):
+        assert bench_diff.classify_metric(key) == "lower", key
+    for key in (
+        "disagg_requests",
+        "disagg_slots",
+        "disagg_lanes",
+        "disagg_new_tokens",
+        "disagg_transfer_handoffs",
+        "disagg_transfer_pages",
+        "disagg_transfer_bytes",
+        "disagg_host_bounces",
+        "disagg_generated_tokens",
+    ):
+        assert bench_diff.classify_metric(key) is None, key
+
+
+def test_disagg_keys_gate_with_registered_tolerances():
+    from tools.bench_diff import TOLERANCES, compare
+
+    for key, direction in (
+        ("disagg_tokens_per_sec_per_chip", "higher"),
+        ("disagg_baseline_tokens_per_sec_per_chip", "higher"),
+        ("disagg_ttft_p50_ms", "lower"),
+        ("disagg_ttft_p99_ms", "lower"),
+        ("disagg_baseline_ttft_p50_ms", "lower"),
+        ("disagg_baseline_ttft_p99_ms", "lower"),
+        ("transfer_ms_p50", "lower"),
+    ):
+        tol = TOLERANCES[key]
+        sign = -1.0 if direction == "higher" else 1.0
+        prev = {"metric": "x", key: 1.0}
+        ok = compare({"metric": "x", key: 1.0 + sign * tol * 0.9}, prev)
+        assert ok.ok, key
+        bad = compare({"metric": "x", key: 1.0 + sign * tol * 1.5}, prev)
+        assert not bad.ok and bad.regressions[0]["name"] == key

@@ -88,6 +88,7 @@ class RequestLog:
         slot: Optional[int] = None,
         weights_step: Optional[int] = None,
         detail: Optional[str] = None,
+        role: Optional[str] = None,
     ) -> Dict[str, Any]:
         """Record one TERMINAL request (exactly once per request — the
         handles' first-transition-wins completion guarantees callers
@@ -111,6 +112,14 @@ class RequestLog:
             record["weights_step"] = int(weights_step)
         if detail is not None:
             record["detail"] = str(detail)
+        if role is not None:
+            # Which serving ROLE completed the dispatch (disaggregated
+            # topologies: "prefill" / "transfer" / "decode"; single-mesh
+            # schedulers record "decode"). A small CLOSED vocabulary by
+            # construction — same posture as the PR 10 label-cardinality
+            # guard, though this is a record field, never a metric
+            # label.
+            record["role"] = str(role)
         # Counters under the lock; the append itself is deque-atomic.
         with self._lock:
             self._total += 1

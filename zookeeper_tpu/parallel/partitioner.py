@@ -228,12 +228,18 @@ def _device_mesh(
     axis_sizes: Sequence[int],
     axis_names: Sequence[str],
     num_devices: int = -1,
+    devices: Optional[Sequence[Any]] = None,
 ) -> Mesh:
     """Build a mesh over the first ``num_devices`` devices (-1 = all).
     ``-1`` in ``axis_sizes`` infers that axis from the device count (like
-    reshape)."""
-    all_devices = jax.devices()
-    if num_devices > 0:
+    reshape). An explicit ``devices`` list overrides both — the
+    role-aware seam (docs/DESIGN.md §22): a disaggregated topology
+    carves the host's devices into disjoint prefill/decode slices, so
+    "first N" cannot express the second role's slice."""
+    all_devices = (
+        list(devices) if devices is not None else jax.devices()
+    )
+    if devices is None and num_devices > 0:
         if num_devices > len(all_devices):
             raise ValueError(
                 f"Requested {num_devices} devices, have {len(all_devices)}."
@@ -305,6 +311,21 @@ class MeshPartitioner(Partitioner):
         object.__setattr__(self, "_rules_override", list(rules))
         return self
 
+    def with_devices(self, devices: Sequence[Any]) -> "MeshPartitioner":
+        """Pin the mesh to an EXPLICIT device list (programmatic, like
+        ``with_rules`` — device objects are not CLI-expressible):
+        the role-aware seam a :class:`~zookeeper_tpu.serving.disagg.\
+partition.DisaggPartitioner` uses to put its prefill and decode roles
+        on disjoint device slices. Must be called before the mesh is
+        built. Returns self for chaining."""
+        if self._mesh is not None:
+            raise RuntimeError(
+                "with_devices after the mesh was built; pin devices "
+                "before the first setup()/mesh access."
+            )
+        object.__setattr__(self, "_devices_override", list(devices))
+        return self
+
     @property
     def rules(self) -> List[PartitionRule]:
         return getattr(self, "_rules_override", self._rules)
@@ -318,6 +339,7 @@ class MeshPartitioner(Partitioner):
                     tuple(self.mesh_shape),
                     tuple(self.mesh_axes),
                     self.num_devices,
+                    devices=getattr(self, "_devices_override", None),
                 ),
             )
 

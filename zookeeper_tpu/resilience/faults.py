@@ -151,6 +151,20 @@ class FaultPlan:
     - ``coordinator_loss``: the next N cross-host ``exchange`` rounds
       raise ``CoordinatorLostError`` (the coordinator / shared storage
       partitioned away mid-protocol).
+
+    Disaggregated-serving knobs (docs/DESIGN.md §22) — keyed on the
+    N-th page handoff, the deterministic coordinate of the
+    prefill→decode seam:
+
+    - ``prefill_role_crash_at``: the N-th decode-slot admission of a
+      parked prefill finds the PREFILL role dead mid-handoff (1 = the
+      first). The victim and every parked handoff fail clean, the
+      prefill pool releases every page (``leak_check() == 0``), and
+      active DECODE streams keep decoding — the decode role survived.
+    - ``fail_page_transfer``: the next N page transfers fail at the
+      move itself (a transient link fault, not a role death): the
+      victim stream fails clean, both pools unwind their half of the
+      handoff, everything else proceeds.
     """
 
     kill_at_step: Optional[int] = None
@@ -159,6 +173,8 @@ class FaultPlan:
     nan_at_step: Optional[int] = None
     serving_worker_crash: int = 0
     decode_worker_crash: int = 0
+    prefill_role_crash_at: Optional[int] = None
+    fail_page_transfer: int = 0
     fail_async_finalize: int = 0
     kill_during_async_write: Optional[int] = None
     kill_process_at_step: Optional[Dict[int, int]] = None
@@ -172,6 +188,10 @@ class FaultPlan:
     _corrupted: bool = field(default=False, repr=False, compare=False)
     _async_killed: bool = field(default=False, repr=False, compare=False)
     _host_finalize_failed: bool = field(
+        default=False, repr=False, compare=False
+    )
+    _handoffs_seen: int = field(default=0, repr=False, compare=False)
+    _prefill_role_crashed: bool = field(
         default=False, repr=False, compare=False
     )
 
@@ -254,6 +274,33 @@ class FaultPlan:
             if self.decode_worker_crash > 0:
                 self.decode_worker_crash -= 1
                 _injection_event("decode_worker_crash")
+                return True
+        return False
+
+    def take_prefill_role_crash(self) -> bool:
+        """One-shot, handoff-keyed: True when THIS decode-slot
+        admission (the N-th page handoff, counting from 1) should find
+        the prefill role dead mid-handoff."""
+        if self.prefill_role_crash_at is None:
+            return False
+        with self._lock:
+            self._handoffs_seen += 1
+            if (
+                not self._prefill_role_crashed
+                and self._handoffs_seen >= int(self.prefill_role_crash_at)
+            ):
+                self._prefill_role_crashed = True
+                _injection_event("prefill_role_crash_at")
+                return True
+        return False
+
+    def take_fail_page_transfer(self) -> bool:
+        """Consume one injected page-transfer failure (False when
+        exhausted)."""
+        with self._lock:
+            if self.fail_page_transfer > 0:
+                self.fail_page_transfer -= 1
+                _injection_event("fail_page_transfer")
                 return True
         return False
 

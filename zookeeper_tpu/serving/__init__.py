@@ -36,6 +36,12 @@ per-step cost — many requests ride one compiled program.
   draft/verify schedule that amortizes one teacher dispatch over a
   k+1-token window, certified token-identical to plain greedy decode
   (docs/DESIGN.md §18).
+- ``zookeeper_tpu.serving.disagg``: disaggregated prefill/decode
+  serving — one checkpoint bound into a prefill role and a decode role
+  on two mesh slices (:class:`DisaggPartitioner`), completed prefills
+  streaming their KV pool pages across via :class:`PageTransfer` under
+  the :class:`DisaggScheduler`'s atomic refcount custody; certified
+  token-identical to the single-mesh engine (docs/DESIGN.md §22).
 """
 
 from zookeeper_tpu.serving.batcher import (
@@ -53,6 +59,13 @@ from zookeeper_tpu.serving.decode import (
     LMServingConfig,
     SpeculativeDecoding,
 )
+from zookeeper_tpu.serving.disagg import (
+    DisaggPartitioner,
+    DisaggScheduler,
+    DisaggServingConfig,
+    PageTransfer,
+    PageTransferError,
+)
 from zookeeper_tpu.serving.engine import CheckpointWatcher, InferenceEngine
 from zookeeper_tpu.serving.metrics import ServingMetrics
 from zookeeper_tpu.serving.service import ServingConfig
@@ -64,7 +77,12 @@ __all__ = [
     "DecodeMetrics",
     "DecodeScheduler",
     "DecodeStream",
+    "DisaggPartitioner",
+    "DisaggScheduler",
+    "DisaggServingConfig",
     "InferenceEngine",
+    "PageTransfer",
+    "PageTransferError",
     "LMServingConfig",
     "MicroBatcher",
     "PendingResult",

@@ -842,9 +842,10 @@ PagePool` (None in the slot layout)."""
         fn,
         example_args,
         *,
-        donate_cache_at: int,
+        donate_cache_at: Optional[int],
         with_variables: bool = True,
         cache_only_output: bool = False,
+        cache_like_at: tuple = (),
     ):
         """AOT lower+compile ``fn`` with the engine's sharding
         discipline, timed and recorded in the process ProgramLedger
@@ -853,14 +854,19 @@ PagePool` (None in the slot layout)."""
         programs ledger as ``draft_*``). ``with_variables=False`` is
         the variables-free program shape (``copy_page``: cache first);
         ``cache_only_output=True`` marks programs returning ONLY the
-        donated cache tree instead of ``(cache, out)``."""
+        (cache-sharded) cache-shaped tree instead of ``(cache, out)``.
+        ``donate_cache_at=None`` compiles a READ-ONLY program (the
+        page-gather export must leave the pool intact);
+        ``cache_like_at`` names extra arg positions carrying
+        cache-sharded trees (a scatter's incoming page block)."""
         import jax
 
         key = str(self.ledger_prefix) + key
 
+        donate = () if donate_cache_at is None else (donate_cache_at,)
         mesh = self._partitioner.mesh
         if mesh is None:
-            jitted = jax.jit(fn, donate_argnums=(donate_cache_at,))
+            jitted = jax.jit(fn, donate_argnums=donate)
         else:
             repl = self._replicated()
             cache_sh = self._cache_sharding
@@ -875,7 +881,11 @@ PagePool` (None in the slot layout)."""
                             lambda _: repl, self._variables
                         )
                     in_shardings.append(vars_sh)
-                elif i == donate_cache_at:
+                elif i == donate_cache_at or i in cache_like_at:
+                    # NamedSharding is shape-agnostic along unsharded
+                    # dims, so the pool's per-leaf shardings apply to a
+                    # same-structure page BLOCK (leading dim W, not
+                    # num_pages) verbatim.
                     in_shardings.append(cache_sh)
                 else:
                     in_shardings.append(repl)
@@ -886,7 +896,7 @@ PagePool` (None in the slot layout)."""
                 fn,
                 in_shardings=tuple(in_shardings),
                 out_shardings=out_shardings,
-                donate_argnums=(donate_cache_at,),
+                donate_argnums=donate,
             )
         t0 = time.perf_counter()
         lowered = jitted.lower(*example_args)
@@ -1221,6 +1231,167 @@ PagePool` (None in the slot layout)."""
         )
         self._compiled_cache[key] = compiled
         return compiled
+
+    def _gather_pages_compiled(self, *, during_dispatch: bool = False):
+        """The page-EXPORT program (disaggregated handoff, docs/
+        DESIGN.md §22): gather ``transfer_width`` pool pages (every
+        per-layer k/v row + scale page) into a contiguous page block —
+        the unit a :class:`~zookeeper_tpu.serving.disagg.transfer.\
+PageTransfer` moves between mesh slices. READ-ONLY: the source pool
+        is NOT donated (the prefill role keeps serving, and a
+        prefix-cache-shared page may be mid-read by another lane)."""
+        import jax
+
+        self._require_bound()
+        key = ("gather_pages", self._partitioner.mesh)
+        cached = self._compiled_cache.get(key)
+        if cached is not None:
+            return cached
+        if during_dispatch and self._warmed:
+            self._note_dispatch_compile("gather_pages")
+
+        def gather_fn(cache, ids):
+            out = []
+            for layer in cache:
+                out.append(
+                    {name: buf[ids] for name, buf in layer.items()}
+                )
+            return tuple(out)
+
+        example = (
+            self._cache,
+            jax.ShapeDtypeStruct((self.transfer_width,), np.int32),
+        )
+        compiled = self._aot(
+            "gather_pages", gather_fn, example, donate_cache_at=None,
+            with_variables=False, cache_only_output=True,
+            cache_like_at=(0,),
+        )
+        self._compiled_cache[key] = compiled
+        return compiled
+
+    def _scatter_pages_compiled(self, *, during_dispatch: bool = False):
+        """The page-IMPORT program (docs/DESIGN.md §22): scatter a
+        transferred page block into this engine's pool at the adopted
+        page ids. Padding ids carry the OOB page sentinel
+        (``num_pages``) and write nowhere (``mode="drop"`` — the paged
+        prefill's idiom); the pool is donated like every other
+        cache-writing dispatch."""
+        import jax
+        import jax.numpy as jnp
+
+        self._require_bound()
+        key = ("scatter_pages", self._partitioner.mesh)
+        cached = self._compiled_cache.get(key)
+        if cached is not None:
+            return cached
+        if during_dispatch and self._warmed:
+            self._note_dispatch_compile("scatter_pages")
+        num_pages = int(self._num_pages)
+
+        def scatter_fn(cache, block, ids):
+            ids = jnp.where(ids < 0, num_pages, ids)
+            out = []
+            for layer, blk in zip(cache, block):
+                out.append(
+                    {
+                        name: buf.at[ids].set(blk[name], mode="drop")
+                        for name, buf in layer.items()
+                    }
+                )
+            return tuple(out)
+
+        block_example = tuple(
+            {
+                name: jax.ShapeDtypeStruct(
+                    (self.transfer_width,) + tuple(np.shape(buf)[1:]),
+                    buf.dtype,
+                )
+                for name, buf in layer.items()
+            }
+            for layer in self._cache
+        )
+        example = (
+            self._cache,
+            block_example,
+            jax.ShapeDtypeStruct((self.transfer_width,), np.int32),
+        )
+        compiled = self._aot(
+            "scatter_pages", scatter_fn, example, donate_cache_at=0,
+            with_variables=False, cache_only_output=True,
+            cache_like_at=(1,),
+        )
+        self._compiled_cache[key] = compiled
+        return compiled
+
+    @property
+    def transfer_width(self) -> int:
+        """Fixed page count of one transfer block: the pages a
+        max-seq-bucket prompt writes — every handoff rides this ONE
+        compiled shape (shorter prompts pad; docs/DESIGN.md §22)."""
+        self._require_bound()
+        if not self._paged:
+            raise RuntimeError(
+                "page transfer is a paged-layout program; "
+                "kv_layout='slots' has no page pool to export."
+            )
+        return self._pool.pages_for(max(self._seq_buckets))
+
+    def warmup_transfer(self) -> None:
+        """Pre-compile the page export/import programs BEFORE handoff
+        traffic (the disaggregated bind calls this for both roles — a
+        transfer compile after ``warmup()`` is deliberate grid growth,
+        not a dispatch-path recompile)."""
+        self._gather_pages_compiled()
+        self._scatter_pages_compiled()
+
+    def export_pages(self, page_ids: Sequence[int]):
+        """Gather ``page_ids``'s pool pages into a transfer block (the
+        device-side handoff unit). Padding lanes gather page 0 —
+        harmless garbage the import side's OOB sentinel drops. The pool
+        is untouched (read-only program); returns the block tree."""
+        self._require_bound()
+        w = self.transfer_width
+        n = len(page_ids)
+        if not 0 < n <= w:
+            raise ValueError(
+                f"export_pages moves 1..{w} pages per block, got {n}."
+            )
+        ids = np.zeros((w,), np.int32)
+        ids[:n] = [int(p) for p in page_ids]
+        compiled = self._gather_pages_compiled(during_dispatch=True)
+        with _trace.span(
+            "export_pages_dispatch",
+            attrs={"pages": n} if _trace.enabled() else None,
+        ):
+            return compiled(self._cache, ids)
+
+    def import_pages(self, block, page_ids: Sequence[int]) -> None:
+        """Scatter a transferred ``block`` into this pool at the
+        adopted ``page_ids`` (the destination half of the handoff —
+        pages come from :meth:`~zookeeper_tpu.serving.decode.pages.\
+PagePool.adopt_slot`). ``block`` must already be placed on this
+        engine's devices; the caller (``PageTransfer``) owns the move."""
+        self._require_bound()
+        w = self.transfer_width
+        n = len(page_ids)
+        if not 0 < n <= w:
+            raise ValueError(
+                f"import_pages lands 1..{w} pages per block, got {n}."
+            )
+        ids = np.full((w,), int(self._num_pages), np.int32)  # OOB drop
+        ids[:n] = [int(p) for p in page_ids]
+        compiled = self._scatter_pages_compiled(during_dispatch=True)
+        with _trace.span(
+            "import_pages_dispatch",
+            attrs={"pages": n} if _trace.enabled() else None,
+        ):
+            try:
+                new_cache = compiled(self._cache, block, ids)
+            except BaseException:
+                self._reset_cache()  # donation consumed the buffers
+                raise
+            object.__setattr__(self, "_cache", new_cache)
 
     def warmup_verify(self, width: int) -> None:
         """Pre-compile the verify program at ``width`` (the speculative

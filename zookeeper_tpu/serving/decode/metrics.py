@@ -82,6 +82,14 @@ _SPEC_COUNTER_NAMES = (
     "spec_accepted_tokens_total",
 )
 
+#: Disaggregated page-handoff counters (``zk_transfer_`` prefix);
+#: reported in ``totals`` after the spec family.
+_TRANSFER_COUNTER_NAMES = (
+    "transfer_handoffs_total",
+    "transfer_pages_total",
+    "transfer_bytes",
+)
+
 #: Accept-length histogram buckets: counts of accepted drafts per
 #: verify window (small ints, not milliseconds).
 _SPEC_ACCEPT_BUCKETS = (0, 1, 2, 3, 4, 6, 8, 12, 16)
@@ -121,6 +129,27 @@ class DecodeMetrics:
                     help="draft tokens the teacher verify accepted "
                     "(longest prefix match; ratio to proposed = "
                     "acceptance rate)",
+                ),
+                # Disaggregated page-handoff family (docs/DESIGN.md
+                # §22): its own zk_transfer_ prefix like zk_spec_ —
+                # the transfer spans two engines/roles, not just the
+                # decode path. Registered unconditionally (zero-valued
+                # under single-mesh serving) so the scrape surface is
+                # stable across topologies.
+                "transfer_pages_total": registry.counter(
+                    "zk_transfer_pages_total",
+                    help="KV pages moved prefill->decode across all "
+                    "handoffs",
+                ),
+                "transfer_bytes": registry.counter(
+                    "zk_transfer_bytes",
+                    help="KV bytes moved prefill->decode (real page "
+                    "bytes, padding lanes excluded)",
+                ),
+                "transfer_handoffs_total": registry.counter(
+                    "zk_transfer_handoffs_total",
+                    help="completed page handoffs (one per stream "
+                    "admitted into a decode slot)",
                 ),
             },
             "gauges": {
@@ -174,6 +203,13 @@ class DecodeMetrics:
                 ),
             },
             "hist": {
+                "transfer_ms": registry.histogram(
+                    "zk_transfer_ms",
+                    buckets=DEFAULT_MS_BUCKETS,
+                    help="one page handoff: export gather + "
+                    "device-to-device (or host-bounce) move + import "
+                    "scatter",
+                ),
                 "ttft_ms": registry.histogram(
                     _PREFIX + "ttft_ms",
                     buckets=DEFAULT_MS_BUCKETS,
@@ -282,6 +318,18 @@ class DecodeMetrics:
             total_a / total_p if total_p else -1.0
         )
 
+    def record_transfer(
+        self, pages: int, nbytes: int, transfer_ms: float
+    ) -> None:
+        """One completed page handoff (docs/DESIGN.md §22): ``pages``
+        real pages / ``nbytes`` real bytes moved prefill->decode, wall
+        time into the ``zk_transfer_ms`` histogram + window."""
+        obs = self._obs()
+        obs["counters"]["transfer_handoffs_total"].inc()
+        obs["counters"]["transfer_pages_total"].inc(int(pages))
+        obs["counters"]["transfer_bytes"].inc(int(nbytes))
+        self._observe("transfer_ms", float(transfer_ms))
+
     def record_rejected(self) -> None:
         self._obs()["counters"]["rejected_total"].inc()
 
@@ -310,7 +358,11 @@ class DecodeMetrics:
         obs = self._obs()
         return {
             name: int(obs["counters"][name].value)
-            for name in _COUNTER_NAMES + _SPEC_COUNTER_NAMES
+            for name in (
+                _COUNTER_NAMES
+                + _SPEC_COUNTER_NAMES
+                + _TRANSFER_COUNTER_NAMES
+            )
         }
 
     def snapshot(self) -> Dict[str, float]:
@@ -325,7 +377,7 @@ class DecodeMetrics:
             out["spec_acceptance_rate"] = (
                 out["spec_accepted_tokens_total"] / proposed
             )
-        for name in ("ttft_ms", "token_ms", "prefill_ms"):
+        for name in ("ttft_ms", "token_ms", "prefill_ms", "transfer_ms"):
             series = windows.get(name)
             if series:
                 arr = np.asarray(series)

@@ -467,6 +467,33 @@ class PagePool:
         self.counts[slot] = len(row)
         return {"shared_tokens": shared_tokens, "cow": cow}
 
+    def adopt_slot(self, slot: int, n_pages: int) -> Optional[List[int]]:
+        """Disaggregated handoff, destination side (docs/DESIGN.md
+        §22): allocate ``n_pages`` FRESH pages and install them as
+        ``slot``'s table row — no prefix lookup, no sharing; the page
+        CONTENTS arrive by transfer from another engine's pool.
+        Returns the page list (the transfer's scatter targets), or
+        None when the pool cannot serve it (nothing mutated beyond
+        evictions — caller requeues or sheds). Unwind a failed
+        transfer with :meth:`release_slot`."""
+        if self.counts[slot]:
+            raise AssertionError(
+                f"slot {slot} still holds pages at adoption; release "
+                "first."
+            )
+        n_pages = int(n_pages)
+        if n_pages < 1 or n_pages > self.max_pages_per_slot:
+            raise ValueError(
+                f"adopt_slot needs 1..{self.max_pages_per_slot} pages, "
+                f"got {n_pages}."
+            )
+        fresh = self._alloc(n_pages)
+        if fresh is None:
+            return None
+        self.table[slot, :n_pages] = fresh
+        self.counts[slot] = n_pages
+        return fresh
+
     def ensure_rows(self, slot: int, rows: int) -> bool:
         """Grow ``slot``'s row to cover ``rows`` total KV rows (the
         pre-dispatch guarantee: decode needs ``length + 1``, a verify

@@ -125,6 +125,12 @@ class DecodeStream:
         self.rid = rid
         self._t_dispatch_ns: Optional[int] = None
         self._slot: Optional[int] = None
+        # Which serving role last dispatched this stream ("" until the
+        # first dispatch): single-mesh scheduling stamps "decode"; the
+        # disaggregated scheduler advances it prefill -> transfer ->
+        # decode, and the terminal RequestLog summary records where
+        # the stream ended (docs/DESIGN.md §22).
+        self._role: str = ""
         # Completion races between the worker (finish), a crash handler
         # (fail) and the caller's deadline expiry: first wins.
         self._cond = threading.Condition()
@@ -372,6 +378,7 @@ class DecodeScheduler:
                 else None
             ),
             detail=detail,
+            role=stream._role or None,
         )
 
     # -- submission ------------------------------------------------------
@@ -672,6 +679,7 @@ class DecodeScheduler:
                     # dispatch), rid-tagged so the exporter links the
                     # submit event to this slot's prefill.
                     stream._slot = slot
+                    stream._role = "decode"
                     if stream._t_dispatch_ns is None:
                         stream._t_dispatch_ns = t0_ns
                     if _trace.enabled() and stream.rid is not None:

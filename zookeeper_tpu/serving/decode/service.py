@@ -111,29 +111,7 @@ class LMServingConfig(Experiment):
                 f"{self.max_prompt} and new_tokens={self.new_tokens} "
                 ">= 1."
             )
-        module = self.model.build((self.seq_len,), self.vocab_size)
-        if self.checkpoint:
-            import jax
-
-            from zookeeper_tpu.training.checkpoint import (
-                load_inference_model,
-            )
-
-            abstract = jax.eval_shape(
-                lambda: self.model.initialize(
-                    module, (self.seq_len,), seed=self.seed
-                )
-            )
-            params, model_state = load_inference_model(
-                self.checkpoint,
-                weights=self.weights,
-                params_like=abstract[0],
-                model_state_like=abstract[1],
-            )
-        else:
-            params, model_state = self.model.initialize(
-                module, (self.seq_len,), seed=self.seed
-            )
+        module, params, model_state = self._build_module_and_weights()
         self.partitioner.setup()
         self.engine.bind(
             module,
@@ -157,6 +135,35 @@ class LMServingConfig(Experiment):
                 self._teardown_service(suppress=True)
                 raise
         return self.engine, self.scheduler
+
+    def _build_module_and_weights(self):
+        """Build the module and resolve its weights (checkpoint load or
+        fresh init) — shared by this config and the disaggregated one,
+        which binds the SAME weights into two role engines."""
+        module = self.model.build((self.seq_len,), self.vocab_size)
+        if self.checkpoint:
+            import jax
+
+            from zookeeper_tpu.training.checkpoint import (
+                load_inference_model,
+            )
+
+            abstract = jax.eval_shape(
+                lambda: self.model.initialize(
+                    module, (self.seq_len,), seed=self.seed
+                )
+            )
+            params, model_state = load_inference_model(
+                self.checkpoint,
+                weights=self.weights,
+                params_like=abstract[0],
+                model_state_like=abstract[1],
+            )
+        else:
+            params, model_state = self.model.initialize(
+                module, (self.seq_len,), seed=self.seed
+            )
+        return module, params, model_state
 
     def _resolve_speculative(self) -> Optional[SpeculativeDecoding]:
         """Resolve ``speculative`` at bind (docs/DESIGN.md §18): build
@@ -230,6 +237,14 @@ class LMServingConfig(Experiment):
         log = self.scheduler.request_log
         return log.as_status() if log is not None else {}
 
+    def _status_providers(self):
+        """Named ``/statusz`` (+ flight-recorder bundle) sections. The
+        disaggregated config extends this with per-role sections."""
+        return {
+            "decode": self.scheduler.status,
+            "requests": self._request_log_status,
+        }
+
     def _start_flight_recorder(self):
         from zookeeper_tpu.observability import recorder as _recorder
         from zookeeper_tpu.observability.registry import default_registry
@@ -237,10 +252,7 @@ class LMServingConfig(Experiment):
         rec = _recorder.arm(
             self.flight_recorder_dir,
             registries=[default_registry(), self.metrics.registry],
-            status_providers={
-                "decode": self.scheduler.status,
-                "requests": self._request_log_status,
-            },
+            status_providers=self._status_providers(),
             request_logs={"decode": self.scheduler.request_log},
             min_interval_s=self.flight_recorder_interval_s,
         )
@@ -270,10 +282,7 @@ class LMServingConfig(Experiment):
         server = ObservabilityServer(
             [default_registry(), self.metrics.registry],
             port=self.metrics_port,
-            status_providers={
-                "decode": self.scheduler.status,
-                "requests": self._request_log_status,
-            },
+            status_providers=self._status_providers(),
         )
         server.start()
         object.__setattr__(self, "obs_server", server)
@@ -382,6 +391,14 @@ class LMServingConfig(Experiment):
                 if getattr(self.scheduler, "_speculative", None) is not None
                 else {}
             ),
+            # Serving-role topology (docs/DESIGN.md §22): single-mesh
+            # serves everything on the decode role with nothing to
+            # transfer; the disaggregated config overrides all three
+            # via result_extra. The keys are UNCONDITIONAL so scripts
+            # parsing the result line never branch on topology.
+            "role": "decode",
+            "transfer_pages": 0,
+            "transfer_ms_p50": -1.0,
             "compiles": self.engine.compile_count,
             "recompiles_after_warmup": (
                 self.engine.compile_count - warm_compiles

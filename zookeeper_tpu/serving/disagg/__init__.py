@@ -1,0 +1,47 @@
+"""Disaggregated prefill/decode serving (docs/DESIGN.md §22).
+
+The decode subsystem's two programs have opposite resource shapes —
+prefill is compute-bound and batches wide, the decode step is
+memory-bound and latency-critical — so co-locating them makes each
+other's tail: a long prefill stalls every active stream's next token.
+This package splits them across MESH SLICES of one host, with the KV
+page as the handoff unit:
+
+- :class:`DisaggPartitioner` — role-aware topology: prefill and decode
+  :class:`~zookeeper_tpu.parallel.partitioner.MeshPartitioner` slices
+  over disjoint device lists (overlapping single-host fallback for the
+  1-device CPU case).
+- :class:`PageTransfer` — moves a completed prefill's pool pages into
+  the decode pool: compiled gather -> ``jax.device_put`` onto the
+  destination shardings (transfer-guarded host bounce as the portable
+  fallback) -> compiled OOB-drop scatter. ``zk_transfer_*`` metrics.
+- :class:`DisaggScheduler` — the split PrefillQueue/DecodeQueue loop
+  over the inherited :class:`~zookeeper_tpu.serving.decode.scheduler.
+  DecodeScheduler` machinery: admit into prefill lanes, deliver the
+  first token (TTFT) at prefill, park until a decode slot frees, adopt
+  + transfer + continue through the unchanged decode loop. Atomic
+  refcount handoff — both pools ``leak_check() == 0`` at every
+  instant, chaos-pinned.
+- :class:`DisaggServingConfig` — the config citizen: one checkpoint,
+  two role engines, ``examples/serve_lm.py --disagg``.
+
+Greedy disagg output is certified token-identical to the single-mesh
+``DecodeEngine`` — through slot refill, paged + int8 KV, and
+speculative decoding (tests/serving/test_disagg.py).
+"""
+
+from zookeeper_tpu.serving.disagg.partition import DisaggPartitioner
+from zookeeper_tpu.serving.disagg.scheduler import DisaggScheduler
+from zookeeper_tpu.serving.disagg.service import DisaggServingConfig
+from zookeeper_tpu.serving.disagg.transfer import (
+    PageTransfer,
+    PageTransferError,
+)
+
+__all__ = [
+    "DisaggPartitioner",
+    "DisaggScheduler",
+    "DisaggServingConfig",
+    "PageTransfer",
+    "PageTransferError",
+]
