@@ -1522,6 +1522,199 @@ def measure_disagg_throughput(env=None):
     }
 
 
+def measure_fleet_throughput(env=None):
+    """``ZK_BENCH_FLEET=1`` leg: prefix-affinity-vs-round-robin A/B
+    over a REAL fleet — a :class:`FleetRouter` fronting N worker
+    PROCESSES (each a paged-KV ``LMServingConfig`` spawned by
+    ``zookeeper_tpu.testing.spawn_fleet_workers``), docs/DESIGN.md §23.
+
+    The workload is multi-turn: S sessions x T turns, each turn's
+    prompt extending the last (the history-grows shape). The affinity
+    pass routes with session pinning (turn 2+ re-enters its replica's
+    radix cache and prefills only the un-cached suffix); the
+    round-robin pass — FRESH workers, same seed — sprays the same
+    token-identical stream across replicas, so turn-2 history re-
+    prefills cold on whichever box it lands on. Streams are asserted
+    TOKEN-IDENTICAL between the passes (routing is a latency policy,
+    never a correctness input), and every affinity turn-2+ must report
+    worker-side warm ``shared_tokens`` — a silent cold fleet would
+    gate, not just dip.
+
+    Headline: ``fleet_warm_ttft_p50_ms`` (affinity turn-2+) vs
+    ``fleet_rr_ttft_p50_ms`` (round-robin turn-2+) and their ratio
+    ``fleet_affinity_ttft_speedup`` — the §20 warm-prefill win scaled
+    FLEET-wide, which pure load balancing destroys. TTFTs are the
+    workers' own scheduler-measured numbers, so the comparison is the
+    prefill path, not HTTP plumbing.
+
+    Knobs: ``ZK_BENCH_FLEET_REPLICAS`` (default 2),
+    ``ZK_BENCH_FLEET_SESSIONS`` (default 3 — odd, so round-robin
+    turn-2 genuinely lands cold with 2 replicas),
+    ``ZK_BENCH_FLEET_TURNS`` (default 3), ``ZK_BENCH_FLEET_SHARED``
+    (turn-1 prompt tokens, default 192 — long enough history that
+    re-prefilling it cold dominates TTFT), ``ZK_BENCH_FLEET_TAIL``
+    (new tokens per later turn, default 8),
+    ``ZK_BENCH_FLEET_NEW_TOKENS`` (generation budget, default 8),
+    ``ZK_BENCH_FLEET_LAYERS``/``_DMODEL``/``_HEADS`` (worker model
+    geometry, defaults 4/256/4 — the decode leg's class)."""
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    from zookeeper_tpu.serving import FleetRouter, ReplicaHandle
+    from zookeeper_tpu.testing import (
+        spawn_fleet_workers,
+        stop_fleet_workers,
+    )
+
+    env = os.environ if env is None else env
+    n_replicas = int(env.get("ZK_BENCH_FLEET_REPLICAS", "2"))
+    n_sessions = int(env.get("ZK_BENCH_FLEET_SESSIONS", "3"))
+    turns = int(env.get("ZK_BENCH_FLEET_TURNS", "3"))
+    shared = int(env.get("ZK_BENCH_FLEET_SHARED", "192"))
+    tail = int(env.get("ZK_BENCH_FLEET_TAIL", "8"))
+    new_tokens = int(env.get("ZK_BENCH_FLEET_NEW_TOKENS", "8"))
+    num_layers = int(env.get("ZK_BENCH_FLEET_LAYERS", "4"))
+    d_model = int(env.get("ZK_BENCH_FLEET_DMODEL", "256"))
+    num_heads = int(env.get("ZK_BENCH_FLEET_HEADS", "4"))
+    if turns < 2:
+        raise RuntimeError(
+            f"ZK_BENCH_FLEET_TURNS={turns}: the leg measures turn-2+ "
+            "warm TTFT, so it needs at least 2 turns."
+        )
+    page_size = 16
+    vocab = 512
+    max_prompt = shared + (turns - 1) * tail
+    seq_len = max(256, 2 * (max_prompt + new_tokens))
+    # (16, max_prompt): warm turn-2+ suffixes (tail + partial chunk)
+    # ride the small bucket; cold full-history prefills pay the big
+    # one — exactly the asymmetry affinity routing protects.
+    conf = {
+        "model.num_layers": num_layers,
+        "model.d_model": d_model,
+        "model.num_heads": num_heads,
+        "model.max_seq_len": seq_len,
+        "model.attention": "dense",
+        "seq_len": seq_len,
+        "vocab_size": vocab,
+        "seed": 0,
+        "engine.kv_layout": "paged",
+        "engine.page_size": page_size,
+        "engine.slots": 4,
+        "engine.seq_buckets": (16, max_prompt),
+        "engine.prefill_buckets": (1,),
+        "requests": 0,
+        "verbose": False,
+    }
+    rng = np.random.default_rng(11)
+    session_ids = [f"s{i}" for i in range(n_sessions)]
+    prompts = {}
+    for sid in session_ids:
+        base = rng.integers(1, vocab, size=shared).tolist()
+        turn_prompts = [list(base)]
+        for _ in range(turns - 1):
+            base = base + rng.integers(1, vocab, size=tail).tolist()
+            turn_prompts.append(list(base))
+        prompts[sid] = turn_prompts
+
+    def run_pass(policy):
+        workdir = tempfile.mkdtemp(prefix=f"zk_fleet_bench_{policy}_")
+        workers = spawn_fleet_workers(
+            workdir, num_workers=n_replicas, config=conf
+        )
+        router = None
+        try:
+            router = FleetRouter(
+                [ReplicaHandle.from_worker(w) for w in workers],
+                page_size=page_size,
+                policy=policy,
+            )
+            outputs = {}
+            ttft_by_turn = {t: [] for t in range(turns)}
+            shared_by_turn = {t: [] for t in range(turns)}
+            generated = 0
+            t0 = time.perf_counter()
+            # Turn-major: every session's turn t lands before any
+            # turn t+1, the arrival order a live fleet would see.
+            for turn in range(turns):
+                for sid in session_ids:
+                    resp = router.submit(
+                        prompts[sid][turn],
+                        # Round-robin is the no-affinity baseline:
+                        # no pinning, pure rotation.
+                        session=sid if policy == "affinity" else None,
+                        max_new_tokens=new_tokens,
+                    )
+                    outputs[(sid, turn)] = resp.tokens.tolist()
+                    ttft_by_turn[turn].append(float(resp.ttft_ms))
+                    shared_by_turn[turn].append(resp.shared_tokens)
+                    generated += int(resp.tokens.shape[0])
+            dt = time.perf_counter() - t0
+            route_snap = router.metrics.snapshot()
+            return outputs, ttft_by_turn, shared_by_turn, generated, \
+                dt, route_snap
+        finally:
+            if router is not None:
+                router.close()
+            stop_fleet_workers(workers)
+            shutil.rmtree(workdir, ignore_errors=True)
+
+    aff_out, aff_ttft, aff_shared, aff_tokens, aff_dt, route_snap = (
+        run_pass("affinity")
+    )
+    rr_out, rr_ttft, rr_shared, rr_tokens, rr_dt, _ = run_pass(
+        "round_robin"
+    )
+    if aff_out != rr_out:
+        diff = sum(1 for k in aff_out if aff_out[k] != rr_out[k])
+        raise RuntimeError(
+            f"fleet A/B: {diff}/{len(aff_out)} streams differ between "
+            "affinity and round-robin routing — the §23 token-identity "
+            "contract is broken; the TTFT comparison is meaningless."
+        )
+    warm = [s for t in range(1, turns) for s in aff_shared[t]]
+    if not all(s > 0 for s in warm):
+        raise RuntimeError(
+            "fleet affinity pass has COLD turn-2+ requests "
+            f"(shared_tokens per turn>=2: {warm}) — session pinning "
+            "or the radix warm path is broken; the warm TTFT below "
+            "would be a lie."
+        )
+    warm_ttfts = [x for t in range(1, turns) for x in aff_ttft[t]]
+    rr_ttfts = [x for t in range(1, turns) for x in rr_ttft[t]]
+    warm_p50 = float(np.percentile(warm_ttfts, 50))
+    rr_p50 = float(np.percentile(rr_ttfts, 50))
+    hits = sum(1 for s in warm if s > 0)
+    return {
+        # Gated (direction-aware in tools/bench_diff.py).
+        "fleet_tokens_per_sec": round(aff_tokens / aff_dt, 1),
+        "fleet_rr_tokens_per_sec": round(rr_tokens / rr_dt, 1),
+        "fleet_warm_ttft_p50_ms": round(warm_p50, 3),
+        "fleet_rr_ttft_p50_ms": round(rr_p50, 3),
+        "fleet_cold_ttft_p50_ms": round(
+            float(np.percentile(aff_ttft[0], 50)), 3
+        ),
+        "fleet_affinity_ttft_speedup": round(
+            rr_p50 / warm_p50 if warm_p50 > 0 else -1.0, 2
+        ),
+        "fleet_route_ms_p50": round(
+            route_snap.get("fleet_route_ms_p50", -1.0), 4
+        ),
+        # Workload shape + affinity effectiveness (informational: the
+        # synthetic workload DETERMINES the hit rate — 1.0 or bust,
+        # and "bust" already raised above).
+        "fleet_replicas": n_replicas,
+        "fleet_sessions": n_sessions,
+        "fleet_turns": turns,
+        "fleet_shared_tokens": shared,
+        "fleet_tail_tokens": tail,
+        "fleet_new_tokens": new_tokens,
+        "fleet_affinity_hit_rate": round(hits / max(1, len(warm)), 3),
+        "fleet_generated_tokens": aff_tokens,
+    }
+
+
 def measure_trace_overhead(env=None):
     """``ZK_BENCH_OBS=1`` leg: the host-tracing cost on the step-time
     anchor — the observability layer's acceptance number
@@ -2626,6 +2819,22 @@ def main(argv=None):
             )
             disagg_metrics = None
 
+    # Fleet-serving leg (env-gated: spawns 2 x n_replicas REAL worker
+    # processes across the two passes): prefix-affinity routing vs
+    # round-robin on a token-identical multi-turn stream — the §20
+    # warm-prefill TTFT win preserved (or destroyed) fleet-wide.
+    fleet_metrics = None
+    if _env_flag(os.environ, "ZK_BENCH_FLEET"):
+        try:
+            fleet_metrics = measure_fleet_throughput()
+        except Exception as e:  # never lose the primary metric
+            print(
+                f"fleet leg failed ({e}); omitting fleet_*",
+                file=sys.stderr,
+                flush=True,
+            )
+            fleet_metrics = None
+
     # Observability-overhead leg (env-gated: interleaved traced/untraced
     # step chains): host-span tracing cost on the step-time anchor —
     # the <= 2% budget docs/DESIGN.md §13 commits to.
@@ -2689,6 +2898,8 @@ def main(argv=None):
         extras.update(spec_metrics)
     if disagg_metrics is not None:
         extras.update(disagg_metrics)
+    if fleet_metrics is not None:
+        extras.update(fleet_metrics)
     if obs_metrics is not None:
         extras.update(obs_metrics)
     if binary_metrics is not None:

@@ -289,3 +289,32 @@ def test_train_then_serve_lm_end_to_end(tmp_path):
     assert result["requests"] == 4
     assert result["generated_tokens"] == 4 * 6
     assert result["weights"] == "auto"
+
+
+def test_serve_fleet_cli_smoke():
+    """The fleet topology from its CLI (docs/DESIGN.md §23): ServeFleet
+    spawns a real worker process, pins the session, and the turn-2
+    request reports worker-side warm ``shared_tokens`` — the
+    prefix-affinity contract visible from one JSON line."""
+    import json
+
+    out = run_example(
+        "serve_fleet.py", "ServeFleet",
+        "replicas=1", "sessions=1", "turns=2",
+        "num_layers=1", "d_model=32", "num_heads=4",
+        "shared_tokens=24", "tail_tokens=8", "new_tokens=4",
+        "page_size=8", "slots=2", "verbose=False",
+        timeout=420,
+    )
+    result = json.loads(out.strip().splitlines()[-1])
+    assert result["policy"] == "affinity"
+    assert result["requests"] == 2
+    assert result["routed_total"] == 2
+    # Turn 2 re-entered the pinned replica's radix cache: an affinity
+    # hit with every turn-1 full page warm on the worker side.
+    assert result["affinity_hits"] == 1
+    assert result["warm_shared_tokens"] == [24]
+    assert result["healthy_replicas"] == 1
+    assert result["rerouted"] == 0
+    assert result["generated_tokens"] == 2 * 4
+    assert result["tokens_per_sec"] > 0

@@ -111,6 +111,22 @@ def test_healthz(server_and_registry):
     assert status == 200 and body == "ok\n"
 
 
+def test_healthz_is_constant_and_lock_free(server_and_registry):
+    """The liveness contract the fleet router's health probes rely on
+    (docs/DESIGN.md §23): ``/healthz`` answers with the SAME constant
+    body even while the metrics registry lock is held by a stalled
+    writer — a probe must distinguish "process dead" from "registry
+    busy", so it must never touch the lock that ``/metrics`` rendering
+    takes."""
+    server, registry = server_and_registry
+    with registry._lock:  # a stalled registry writer
+        status, _, body = _get(f"{server.url}/healthz")
+        assert status == 200 and body == "ok\n"
+    # Constant across scrapes; "/" is the same endpoint.
+    assert _get(f"{server.url}/healthz")[2] == body
+    assert _get(f"{server.url}/")[2] == body
+
+
 def test_stop_is_idempotent():
     server = ObservabilityServer([MetricsRegistry()], port=0).start()
     url = server.url

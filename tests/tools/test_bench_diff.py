@@ -425,3 +425,60 @@ def test_disagg_keys_gate_with_registered_tolerances():
         assert ok.ok, key
         bad = compare({"metric": "x", key: 1.0 + sign * tol * 1.5}, prev)
         assert not bad.ok and bad.regressions[0]["name"] == key
+
+
+def test_fleet_era_keys_classify():
+    """The §23 fleet-serving A/B keys gate direction-aware: both
+    passes' aggregate tokens/s and the affinity speedup higher-better,
+    the TTFT medians and the routing-decision latency lower-better
+    (``fleet_route_ms_p50`` names its unit before the percentile —
+    the explicit _LOWER entry, like ``transfer_ms_p50``); replica/
+    session/turn counts, token budgets and the workload-determined
+    hit rate are config, not perf (hit rate in particular ends in
+    ``_rate`` — informational must win over the lower-better
+    suffix)."""
+    for key in (
+        "fleet_tokens_per_sec",
+        "fleet_rr_tokens_per_sec",
+        "fleet_affinity_ttft_speedup",
+    ):
+        assert bench_diff.classify_metric(key) == "higher", key
+    for key in (
+        "fleet_warm_ttft_p50_ms",
+        "fleet_rr_ttft_p50_ms",
+        "fleet_cold_ttft_p50_ms",
+        "fleet_route_ms_p50",
+    ):
+        assert bench_diff.classify_metric(key) == "lower", key
+    for key in (
+        "fleet_replicas",
+        "fleet_sessions",
+        "fleet_turns",
+        "fleet_shared_tokens",
+        "fleet_tail_tokens",
+        "fleet_new_tokens",
+        "fleet_affinity_hit_rate",
+        "fleet_generated_tokens",
+    ):
+        assert bench_diff.classify_metric(key) is None, key
+
+
+def test_fleet_keys_gate_with_registered_tolerances():
+    from tools.bench_diff import TOLERANCES, compare
+
+    for key, direction in (
+        ("fleet_tokens_per_sec", "higher"),
+        ("fleet_rr_tokens_per_sec", "higher"),
+        ("fleet_affinity_ttft_speedup", "higher"),
+        ("fleet_warm_ttft_p50_ms", "lower"),
+        ("fleet_rr_ttft_p50_ms", "lower"),
+        ("fleet_cold_ttft_p50_ms", "lower"),
+        ("fleet_route_ms_p50", "lower"),
+    ):
+        tol = TOLERANCES[key]
+        sign = -1.0 if direction == "higher" else 1.0
+        prev = {"metric": "x", key: 1.0}
+        ok = compare({"metric": "x", key: 1.0 + sign * tol * 0.9}, prev)
+        assert ok.ok, key
+        bad = compare({"metric": "x", key: 1.0 + sign * tol * 1.5}, prev)
+        assert not bad.ok and bad.regressions[0]["name"] == key

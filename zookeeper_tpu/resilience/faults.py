@@ -165,6 +165,21 @@ class FaultPlan:
       move itself (a transient link fault, not a role death): the
       victim stream fails clean, both pools unwind their half of the
       handoff, everything else proceeds.
+
+    Fleet-serving knobs (docs/DESIGN.md §23) — keyed on the N-th
+    ROUTED request, the deterministic coordinate of the router →
+    replica seam:
+
+    - ``fleet_replica_kill_at``: the replica chosen for the N-th
+      routed request (1 = the first) is SIGKILLed before the request
+      is forwarded — the in-flight request fails clean with
+      ``WorkerCrashedError``, the replica goes unhealthy, and its
+      pinned sessions re-route cold to a survivor on their next turn.
+    - ``fleet_router_restart_at``: after the N-th routed request
+      completes, the HARNESS (test/bench driver — the router cannot
+      restart itself, exactly like ``kill_process_at_step``'s group
+      supervisor) tears the router down and rebuilds it from its
+      persisted ``state_path``; session pins must survive the rebuild.
     """
 
     kill_at_step: Optional[int] = None
@@ -175,6 +190,8 @@ class FaultPlan:
     decode_worker_crash: int = 0
     prefill_role_crash_at: Optional[int] = None
     fail_page_transfer: int = 0
+    fleet_replica_kill_at: Optional[int] = None
+    fleet_router_restart_at: Optional[int] = None
     fail_async_finalize: int = 0
     kill_during_async_write: Optional[int] = None
     kill_process_at_step: Optional[Dict[int, int]] = None
@@ -192,6 +209,14 @@ class FaultPlan:
     )
     _handoffs_seen: int = field(default=0, repr=False, compare=False)
     _prefill_role_crashed: bool = field(
+        default=False, repr=False, compare=False
+    )
+    _fleet_kill_seen: int = field(default=0, repr=False, compare=False)
+    _fleet_replica_killed: bool = field(
+        default=False, repr=False, compare=False
+    )
+    _fleet_restart_seen: int = field(default=0, repr=False, compare=False)
+    _fleet_router_restarted: bool = field(
         default=False, repr=False, compare=False
     )
 
@@ -291,6 +316,43 @@ class FaultPlan:
             ):
                 self._prefill_role_crashed = True
                 _injection_event("prefill_role_crash_at")
+                return True
+        return False
+
+    def take_fleet_replica_kill(self) -> bool:
+        """One-shot, routed-request-keyed: True when THIS routed
+        request (the N-th, counting from 1) should find its chosen
+        replica SIGKILLed before the forward — the router's
+        replica-death chaos coordinate (docs/DESIGN.md §23)."""
+        if self.fleet_replica_kill_at is None:
+            return False
+        with self._lock:
+            self._fleet_kill_seen += 1
+            if (
+                not self._fleet_replica_killed
+                and self._fleet_kill_seen
+                >= int(self.fleet_replica_kill_at)
+            ):
+                self._fleet_replica_killed = True
+                _injection_event("fleet_replica_kill_at")
+                return True
+        return False
+
+    def take_fleet_router_restart(self) -> bool:
+        """One-shot, routed-request-keyed: True after the N-th routed
+        request when the HARNESS should tear the router down and
+        rebuild it from its persisted state (docs/DESIGN.md §23)."""
+        if self.fleet_router_restart_at is None:
+            return False
+        with self._lock:
+            self._fleet_restart_seen += 1
+            if (
+                not self._fleet_router_restarted
+                and self._fleet_restart_seen
+                >= int(self.fleet_router_restart_at)
+            ):
+                self._fleet_router_restarted = True
+                _injection_event("fleet_router_restart_at")
                 return True
         return False
 

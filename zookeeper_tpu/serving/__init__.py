@@ -42,6 +42,14 @@ per-step cost — many requests ride one compiled program.
   streaming their KV pool pages across via :class:`PageTransfer` under
   the :class:`DisaggScheduler`'s atomic refcount custody; certified
   token-identical to the single-mesh engine (docs/DESIGN.md §22).
+- ``zookeeper_tpu.serving.fleet``: fleet serving — a
+  :class:`FleetRouter` over N replica processes with prefix-affinity
+  scheduling (one pageless
+  :class:`~zookeeper_tpu.serving.decode.prefix_key.PrefixIndex` per
+  replica, sharing the radix cache's EXACT chunk keying), session KV
+  pinning, load fallback from live ``/metrics``, health-probed
+  replicas with clean :class:`WorkerCrashedError` failure + cold
+  re-route, and cross-process rid propagation (docs/DESIGN.md §23).
 """
 
 from zookeeper_tpu.serving.batcher import (
@@ -67,6 +75,13 @@ from zookeeper_tpu.serving.disagg import (
     PageTransferError,
 )
 from zookeeper_tpu.serving.engine import CheckpointWatcher, InferenceEngine
+from zookeeper_tpu.serving.fleet import (
+    FleetMetrics,
+    FleetResponse,
+    FleetRouter,
+    FleetUnavailableError,
+    ReplicaHandle,
+)
 from zookeeper_tpu.serving.metrics import ServingMetrics
 from zookeeper_tpu.serving.service import ServingConfig
 
@@ -80,6 +95,10 @@ __all__ = [
     "DisaggPartitioner",
     "DisaggScheduler",
     "DisaggServingConfig",
+    "FleetMetrics",
+    "FleetResponse",
+    "FleetRouter",
+    "FleetUnavailableError",
     "InferenceEngine",
     "PageTransfer",
     "PageTransferError",
@@ -87,6 +106,7 @@ __all__ = [
     "MicroBatcher",
     "PendingResult",
     "RejectedError",
+    "ReplicaHandle",
     "ServingConfig",
     "ServingMetrics",
     "SpeculativeDecoding",

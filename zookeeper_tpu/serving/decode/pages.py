@@ -55,6 +55,8 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from zookeeper_tpu.serving.decode.prefix_key import walk_insert, walk_match
+
 __all__ = [
     "PagePool",
     "RadixPrefixCache",
@@ -133,14 +135,6 @@ class _TrieNode:
         self.last_used = 0
 
 
-def _common_prefix(a: Sequence[int], b: Sequence[int]) -> int:
-    n = min(len(a), len(b))
-    for i in range(n):
-        if a[i] != b[i]:
-            return i
-    return n
-
-
 class RadixPrefixCache:
     """Radix trie over prompt token prefixes, page-chunk keyed.
 
@@ -206,33 +200,18 @@ class RadixPrefixCache:
         off a page boundary — the caller's CoW case). The caller caps
         ``t`` (never the whole prompt — at least the final token is
         always recomputed so the first-emission logits exist) and takes
-        its own references on the pages it adopts."""
-        ps = self.page_size
+        its own references on the pages it adopts. The walk itself is
+        the shared ``prefix_key.walk_match`` — the fleet router's
+        per-replica :class:`~zookeeper_tpu.serving.decode.prefix_key.\
+PrefixIndex` predicts THIS method's match length with the same code."""
         tokens = [int(x) for x in tokens]
         self.lookups += 1
         self.lookup_tokens += len(tokens)
-        node = self._root
+        t, visited = walk_match(self._root, tokens, self.page_size)
         pages: List[int] = []
-        t = 0
-        while t + ps <= len(tokens):
-            child = node.children.get(tuple(tokens[t:t + ps]))
-            if child is None:
-                break
-            pages.append(child.page)
-            t += ps
-            node = child
+        for node in visited:
+            pages.append(node.page)
             self._touch(node)
-        rest = tokens[t:]
-        if rest:
-            best, bestq = None, 0
-            for child in node.children.values():
-                q = _common_prefix(child.chunk, rest)
-                if q > bestq:
-                    best, bestq = child, q
-            if best is not None:
-                pages.append(best.page)
-                t += bestq
-                self._touch(best)
         if t:
             self.hits += 1
             self.hit_tokens += t
@@ -247,28 +226,21 @@ class RadixPrefixCache:
         references) were created."""
         ps = self.page_size
         tokens = [int(x) for x in tokens]
-        node = self._root
         created = 0
-        n_full = len(tokens) // ps
-        for i in range(n_full):
-            chunk = tuple(tokens[i * ps:(i + 1) * ps])
-            child = node.children.get(chunk)
-            if child is None:
-                child = _TrieNode(chunk, pages[i], node)
-                node.children[chunk] = child
-                self._ref(child.page)
+        visited = walk_insert(
+            self._root,
+            tokens,
+            ps,
+            lambda chunk, i, parent: _TrieNode(chunk, pages[i], parent),
+            # A partial tail is cached only when a page actually covers
+            # those positions.
+            tail=len(pages) > len(tokens) // ps,
+        )
+        for node, was_created in visited:
+            if was_created:
+                self._ref(node.page)
                 created += 1
-            node = child
             self._touch(node)
-        tail = tuple(tokens[n_full * ps:])
-        if tail and len(pages) > n_full:
-            child = node.children.get(tail)
-            if child is None:
-                child = _TrieNode(tail, pages[n_full], node)
-                node.children[tail] = child
-                self._ref(child.page)
-                created += 1
-            self._touch(child)
         return created
 
     def evict_lru(self, want_pages: int) -> int:

@@ -1,0 +1,191 @@
+"""Shared page-chunk prefix keying (docs/DESIGN.md §20, §23).
+
+The :class:`~zookeeper_tpu.serving.decode.pages.RadixPrefixCache` keys
+its trie on FULL ``page_size`` token chunks (one node = one page) with
+a longest-common-prefix partial tail, and the fleet router
+(docs/DESIGN.md §23) must predict that trie's match length WITHOUT
+holding any pages: a router that chunks or walks differently routes
+requests to replicas that are not actually warm, silently destroying
+the §20 TTFT win. This module is the single source of truth both sides
+consume:
+
+- :func:`common_prefix` / :func:`walk_match` / :func:`walk_insert` —
+  the chunking + match/insert walks, shared verbatim by the cache's
+  ``lookup``/``insert`` and the router's :class:`PrefixIndex`, so the
+  two CANNOT drift (the parity test in ``tests/serving/test_fleet.py``
+  pins predicted == actual on top).
+- :class:`PrefixIndex` — the pageless mirror of the trie: the router
+  keeps one per replica, ``observe()``-ing every prompt it routes
+  there and ``match()``-ing candidate prompts to predict how many
+  tokens that replica's REAL cache would serve warm.
+
+Any node object with ``.chunk`` (a token tuple) and ``.children``
+(a ``{chunk_tuple: node}`` dict) can ride the walks — the cache's
+page-holding nodes and the index's bare nodes both qualify.
+"""
+
+from typing import Any, Callable, List, Sequence, Tuple
+
+__all__ = [
+    "PrefixIndex",
+    "common_prefix",
+    "walk_insert",
+    "walk_match",
+]
+
+
+def common_prefix(a: Sequence[int], b: Sequence[int]) -> int:
+    """Length of the longest common prefix of two token sequences."""
+    n = min(len(a), len(b))
+    for i in range(n):
+        if a[i] != b[i]:
+            return i
+    return n
+
+
+def walk_match(root: Any, tokens: Sequence[int], page_size: int):
+    """The ONE match walk (cache lookup == router prediction): exact
+    full-``page_size``-chunk descents from ``root``, then the longest
+    common prefix against any child's chunk for the partial tail.
+    Returns ``(t, visited)`` — the first ``t`` tokens are covered by
+    the ``visited`` nodes in walk order (the last may cover ``t``
+    only partially — the cache's CoW case)."""
+    ps = int(page_size)
+    node = root
+    visited: List[Any] = []
+    t = 0
+    n = len(tokens)
+    while t + ps <= n:
+        child = node.children.get(tuple(tokens[t:t + ps]))
+        if child is None:
+            break
+        visited.append(child)
+        t += ps
+        node = child
+    rest = tokens[t:]
+    if rest:
+        best, bestq = None, 0
+        for child in node.children.values():
+            q = common_prefix(child.chunk, rest)
+            if q > bestq:
+                best, bestq = child, q
+        if best is not None:
+            visited.append(best)
+            t += bestq
+    return t, visited
+
+
+def walk_insert(
+    root: Any,
+    tokens: Sequence[int],
+    page_size: int,
+    make_node: Callable[[Tuple[int, ...], int, Any], Any],
+    *,
+    tail: bool = True,
+):
+    """The ONE insert walk: descend/create one node per FULL chunk
+    (``make_node(chunk, chunk_index, parent)`` builds missing ones),
+    plus the partial tail chunk when ``tail`` is set (the cache skips
+    it when it has no page covering those positions). Returns
+    ``[(node, created), ...]`` in walk order."""
+    ps = int(page_size)
+    tokens = [int(x) for x in tokens]
+    node = root
+    out: List[Tuple[Any, bool]] = []
+    n_full = len(tokens) // ps
+    for i in range(n_full):
+        chunk = tuple(tokens[i * ps:(i + 1) * ps])
+        child = node.children.get(chunk)
+        created = child is None
+        if created:
+            child = make_node(chunk, i, node)
+            node.children[chunk] = child
+        out.append((child, created))
+        node = child
+    rest = tuple(tokens[n_full * ps:])
+    if rest and tail:
+        child = node.children.get(rest)
+        created = child is None
+        if created:
+            child = make_node(rest, n_full, node)
+            node.children[rest] = child
+        out.append((child, created))
+    return out
+
+
+class _IndexNode:
+    __slots__ = ("chunk", "children")
+
+    def __init__(self, chunk: Tuple[int, ...]) -> None:
+        self.chunk = chunk
+        self.children = {}
+
+
+class PrefixIndex:
+    """Pageless mirror of the radix prefix-cache trie.
+
+    The fleet router keeps one per replica: every prompt it routes
+    there is ``observe()``-d (the replica's cache will insert exactly
+    that prompt's pages after prefill), and ``match()`` walks the SAME
+    chunking/keying the real cache uses, so the returned length is the
+    router's best prediction of the replica's actual warm match.
+
+    Predictions are optimistic by construction — the real cache evicts
+    under pool pressure and invalidates on weight swaps while the
+    index does not — which only costs a colder-than-predicted route,
+    never a wrong answer. ``max_nodes`` bounds router memory: past it
+    the index resets to empty (counted in ``resets``) and rewarms from
+    subsequent traffic, mirroring a cache that evicted everything.
+    """
+
+    def __init__(self, page_size: int, max_nodes: int = 65536) -> None:
+        if page_size < 1:
+            raise ValueError(f"page_size={page_size} must be >= 1.")
+        if max_nodes < 1:
+            raise ValueError(f"max_nodes={max_nodes} must be >= 1.")
+        self.page_size = int(page_size)
+        self.max_nodes = int(max_nodes)
+        self._root = _IndexNode(())
+        self.nodes = 0
+        self.resets = 0
+
+    def observe(self, tokens: Sequence[int]) -> int:
+        """Record a prompt routed to this replica (full chunks + the
+        partial tail — exactly what the cache's ``insert_prefix``
+        caches after prefill). Returns new nodes created."""
+        created = sum(
+            1
+            for _, was_created in walk_insert(
+                self._root,
+                tokens,
+                self.page_size,
+                lambda chunk, i, parent: _IndexNode(chunk),
+            )
+            if was_created
+        )
+        self.nodes += created
+        if self.nodes > self.max_nodes:
+            self.clear()
+            self.resets += 1
+        return created
+
+    def match(self, tokens: Sequence[int]) -> int:
+        """Predicted warm match length for ``tokens`` — the ``t`` the
+        replica's real ``RadixPrefixCache.lookup`` would return."""
+        tokens = [int(x) for x in tokens]
+        t, _ = walk_match(self._root, tokens, self.page_size)
+        return t
+
+    def predict(self, tokens: Sequence[int]) -> int:
+        """Predicted SHARED tokens at admission: the match, capped at
+        ``len(tokens) - 1`` exactly like ``PagePool.assign_prompt``
+        (the final token is always recomputed so the first-emission
+        logits exist)."""
+        n = len(tokens)
+        if n == 0:
+            return 0
+        return min(self.match(tokens), n - 1)
+
+    def clear(self) -> None:
+        self._root = _IndexNode(())
+        self.nodes = 0

@@ -119,6 +119,10 @@ class DecodeStream:
         self._t_submit = time.perf_counter()
         #: Submit-to-first-token milliseconds (None until it lands).
         self.ttft_ms: Optional[float] = None
+        # Prompt tokens served from the radix prefix cache at admission
+        # (stamped by _admit from the page-pool plan; stays 0 for cold
+        # admissions and the slot layout).
+        self._shared_tokens = 0
         #: Request id minted at submit (docs/DESIGN.md §16); its trace
         #: records render as one Perfetto flow and its terminal summary
         #: lands in the scheduler's RequestLog.
@@ -150,6 +154,14 @@ class DecodeStream:
         """"eos" / "length" (max_new_tokens) / "capacity" (KV or
         positional limit) — None while streaming or on failure."""
         return self._finish_reason
+
+    @property
+    def shared_tokens(self) -> int:
+        """Prompt tokens whose KV came warm from the radix prefix
+        cache at admission (0 = cold admission or slot layout) — the
+        per-request observability hook behind the fleet router's
+        affinity certification (docs/DESIGN.md §23)."""
+        return self._shared_tokens
 
     @property
     def tokens_so_far(self) -> np.ndarray:
@@ -399,13 +411,17 @@ class DecodeScheduler:
         max_new_tokens: Optional[int] = None,
         deadline_ms: Optional[float] = None,
         eos_token: Optional[int] = None,
+        rid: Optional[int] = None,
     ) -> DecodeStream:
         """Enqueue one prompt (1-D int tokens); returns a
         :class:`DecodeStream`. ``deadline_ms=None`` falls back to the
         component default (0 = none) while an EXPLICIT ``0`` is
         already-expired (the deterministic clock-free chaos idiom).
         Raises :class:`RejectedError` without enqueueing past the shed
-        threshold."""
+        threshold. ``rid`` adopts an EXTERNALLY-minted request id —
+        the fleet router propagates its own so one request is
+        traceable router → worker across process boundaries
+        (docs/DESIGN.md §23); None mints locally as before."""
         self._require_bound()
         prompt = np.asarray(prompt, np.int32)
         if prompt.ndim != 1 or prompt.shape[0] < 1:
@@ -442,8 +458,9 @@ class DecodeScheduler:
             int(self.eos_token) if int(self.eos_token) >= 0 else None
         )
         # Minted before admission control, so shed streams are
-        # traceable and RequestLog-recorded too (docs/DESIGN.md §16).
-        rid = next_rid()
+        # traceable and RequestLog-recorded too (docs/DESIGN.md §16);
+        # a router-minted rid is adopted instead (docs/DESIGN.md §23).
+        rid = next_rid() if rid is None else int(rid)
         stream = DecodeStream(
             self,
             prompt,
@@ -713,6 +730,9 @@ class DecodeScheduler:
                     if plan is None:
                         overflow.append((stream, slot))
                     else:
+                        stream._shared_tokens = int(
+                            plan.get("shared_tokens") or 0
+                        )
                         plans.append(plan)
                         admitted.append(stream)
                         admitted_slots.append(slot)

@@ -1,0 +1,767 @@
+"""Fleet serving: a prefix-affinity router over N decode replicas
+(docs/DESIGN.md §23).
+
+One process serves one mesh; the north star's millions-of-users
+traffic needs N replicas behind a front door. This module is that
+front door: a :class:`FleetRouter` over worker processes (each a
+:class:`~zookeeper_tpu.serving.decode.service.LMServingConfig` behind
+a small HTTP seam — ``zookeeper_tpu.testing.spawn_fleet_workers``
+spawns real ones on CPU) that turns the §20 radix prefix cache from a
+per-box optimization into a fleet-wide one:
+
+- **Prefix-affinity scheduling** — the router keeps one pageless
+  :class:`~zookeeper_tpu.serving.decode.prefix_key.PrefixIndex` per
+  replica (the EXACT chunking/keying the replica's real
+  ``RadixPrefixCache`` trie uses — shared code, not a reimplementation)
+  and routes each prompt to the replica predicted to hold the most of
+  it warm, falling back by load (router-side in-flight count, worker
+  queue depth and ``zk_kv_pool_free_pages`` scraped from each
+  replica's live ``/metrics``) when nobody is warm.
+- **Session continuity** — a multi-turn conversation pins to its
+  replica (``session=`` on submit), so turn-2+ re-enters that
+  replica's radix cache and rides the §20 warm-prefill path instead of
+  re-prefilling its whole history on a cold box. Pins persist to
+  ``state_path`` (atomic write) so a restarted router keeps sessions
+  warm.
+- **Health + failure semantics** — ``/healthz``-probed replicas; a
+  dead worker's in-flight requests fail clean with
+  :class:`~zookeeper_tpu.serving.batcher.WorkerCrashedError` (the §10
+  posture), its prefix index drops (a restarted worker is cold), and
+  its sessions re-route cold to a survivor on their next turn.
+  ``FaultPlan.fleet_replica_kill_at`` / ``fleet_router_restart_at``
+  are the deterministic chaos coordinates.
+- **Cross-process observability** — the router mints the rid
+  (:func:`~zookeeper_tpu.observability.requests.next_rid`) and the
+  worker's scheduler ADOPTS it (``submit(rid=...)``), so one request
+  is traceable end-to-end: the router's ``RequestLog("fleet")`` and
+  ``fleet_route`` flow events on one side, the worker's RequestLog /
+  trace on the other, joined on the rid. :class:`FleetMetrics` renders
+  the ``zk_fleet_*`` family and :meth:`FleetRouter.status` is the
+  ``/statusz`` fleet section.
+
+The router is transport-agnostic: the default transport POSTs JSON to
+each worker's ``/generate`` endpoint, and tests inject in-process
+transports to pin routing policy without spawning processes — the
+multi-process certification lives in ``tests/serving/test_fleet.py``.
+"""
+
+import json
+import logging
+import os
+import signal
+import threading
+import time
+import urllib.error
+import urllib.request
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from zookeeper_tpu.observability import trace as _trace
+from zookeeper_tpu.observability.registry import (
+    DEFAULT_MS_BUCKETS,
+    MetricsRegistry,
+)
+from zookeeper_tpu.observability.requests import RequestLog, next_rid
+from zookeeper_tpu.serving.batcher import WorkerCrashedError
+from zookeeper_tpu.serving.decode.prefix_key import PrefixIndex
+
+logger = logging.getLogger(__name__)
+
+__all__ = [
+    "FleetMetrics",
+    "FleetResponse",
+    "FleetRouter",
+    "FleetUnavailableError",
+    "ReplicaHandle",
+]
+
+
+class FleetUnavailableError(RuntimeError):
+    """No healthy replica is left to route to (every worker dead or
+    none configured) — the fleet-level analogue of a dead worker."""
+
+
+class FleetResponse:
+    """One routed generation: the worker's reply plus the routing
+    decision that produced it (the per-request affinity audit trail)."""
+
+    __slots__ = (
+        "rid",
+        "worker_id",
+        "tokens",
+        "ttft_ms",
+        "shared_tokens",
+        "finish_reason",
+        "affinity_hit",
+        "rerouted",
+        "predicted_shared",
+    )
+
+    def __init__(
+        self,
+        *,
+        rid: int,
+        worker_id: str,
+        tokens: np.ndarray,
+        ttft_ms: Optional[float],
+        shared_tokens: int,
+        finish_reason: Optional[str],
+        affinity_hit: bool,
+        rerouted: bool,
+        predicted_shared: int,
+    ) -> None:
+        self.rid = rid
+        self.worker_id = worker_id
+        self.tokens = tokens
+        self.ttft_ms = ttft_ms
+        self.shared_tokens = shared_tokens
+        self.finish_reason = finish_reason
+        self.affinity_hit = affinity_hit
+        self.rerouted = rerouted
+        self.predicted_shared = predicted_shared
+
+
+class ReplicaHandle:
+    """One worker the router fronts: its endpoints, liveness, the
+    router-side load estimate, and its pageless prefix index."""
+
+    def __init__(
+        self,
+        worker_id: str,
+        generate_url: str,
+        obs_url: Optional[str] = None,
+        pid: Optional[int] = None,
+    ) -> None:
+        self.worker_id = str(worker_id)
+        self.generate_url = generate_url
+        self.obs_url = obs_url
+        self.pid = pid
+        self.healthy = True
+        #: Router-side in-flight request count (the load term no
+        #: scrape can race).
+        self.outstanding = 0
+        self.routed_total = 0
+        self.index: Optional[PrefixIndex] = None  # attached by router
+        # Last /metrics scrape: (monotonic ts, queue_depth, free_pages).
+        self._scrape: Optional[tuple] = None
+
+    @classmethod
+    def from_worker(cls, worker: Dict[str, Any]) -> "ReplicaHandle":
+        """Build from a ``spawn_fleet_workers`` ready document."""
+        return cls(
+            worker["worker_id"],
+            "http://127.0.0.1:%d/generate" % worker["generate_port"],
+            obs_url="http://127.0.0.1:%d" % worker["metrics_port"],
+            pid=worker.get("pid"),
+        )
+
+
+def _http_transport(
+    replica: ReplicaHandle, payload: Dict[str, Any], timeout_s: float
+) -> Dict[str, Any]:
+    """Default transport: POST JSON to the worker's ``/generate``."""
+    req = urllib.request.Request(
+        replica.generate_url,
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+        return json.loads(resp.read().decode())
+
+
+def _http_health(replica: ReplicaHandle, timeout_s: float) -> bool:
+    """Default health probe: the cheap ``/healthz`` liveness endpoint
+    (constant body, no registry lock — the router pays nothing like
+    the full ``/metrics`` exposition cost per probe)."""
+    if replica.obs_url is None:
+        return replica.healthy
+    try:
+        with urllib.request.urlopen(
+            replica.obs_url + "/healthz", timeout=timeout_s
+        ) as resp:
+            return resp.status == 200
+    except (urllib.error.URLError, OSError):
+        return False
+
+
+def _default_kill(replica: ReplicaHandle) -> None:
+    """Chaos hook: SIGKILL the replica's OS process (the §23
+    replica-death injection — a real process death, not a simulation)."""
+    if replica.pid is None:
+        raise RuntimeError(
+            f"replica {replica.worker_id} has no pid to kill; inject a "
+            "kill_replica hook for in-process transports."
+        )
+    os.kill(int(replica.pid), signal.SIGKILL)
+
+
+class FleetMetrics:
+    """The ``zk_fleet_*`` family on its own registry (attach it to an
+    :class:`~zookeeper_tpu.observability.export.ObservabilityServer`
+    next to the default registry, like ``DecodeMetrics.registry``):
+    per-replica routed / affinity-hit counters + health gauges,
+    fleet-wide re-route / crash counters, a routing-decision latency
+    histogram, and session/replica-count gauges."""
+
+    def __init__(self) -> None:
+        self.registry = MetricsRegistry()
+        self._routed: Dict[str, Any] = {}
+        self._affinity: Dict[str, Any] = {}
+        self._healthy: Dict[str, Any] = {}
+        self._rerouted = self.registry.counter(
+            "zk_fleet_rerouted_total",
+            help="sessions re-routed cold off a dead replica",
+        )
+        self._crashes = self.registry.counter(
+            "zk_fleet_worker_crashes_total",
+            help="requests failed by a replica death mid-flight",
+        )
+        self._replicas = self.registry.gauge(
+            "zk_fleet_replicas", help="configured replicas"
+        )
+        self._sessions = self.registry.gauge(
+            "zk_fleet_sessions", help="live session pins"
+        )
+        self._route_ms = self.registry.histogram(
+            "zk_fleet_route_ms",
+            buckets=DEFAULT_MS_BUCKETS,
+            help="routing-decision latency (choose + index update)",
+        )
+        # Exact-percentile window next to the fixed-bucket histogram
+        # (the DecodeMetrics posture).
+        self._route_samples: List[float] = []
+
+    def _per_replica(self, table, name, help_, worker_id, cls="counter"):
+        inst = table.get(worker_id)
+        if inst is None:
+            factory = (
+                self.registry.counter
+                if cls == "counter"
+                else self.registry.gauge
+            )
+            inst = factory(name, help=help_, labels={"replica": worker_id})
+            table[worker_id] = inst
+        return inst
+
+    def record_routed(
+        self, worker_id: str, *, affinity_hit: bool, route_ms: float
+    ) -> None:
+        self._per_replica(
+            self._routed,
+            "zk_fleet_routed_total",
+            "requests routed to this replica",
+            worker_id,
+        ).inc()
+        if affinity_hit:
+            self._per_replica(
+                self._affinity,
+                "zk_fleet_affinity_hits_total",
+                "requests routed by warm-prefix affinity or session pin",
+                worker_id,
+            ).inc()
+        self._route_ms.observe(float(route_ms))
+        self._route_samples.append(float(route_ms))
+        if len(self._route_samples) > 4096:
+            del self._route_samples[:2048]
+
+    def record_rerouted(self) -> None:
+        self._rerouted.inc()
+
+    def record_worker_crash(self) -> None:
+        self._crashes.inc()
+
+    def record_health(self, worker_id: str, healthy: bool) -> None:
+        self._per_replica(
+            self._healthy,
+            "zk_fleet_replica_healthy",
+            "1 = replica passed its last health probe",
+            worker_id,
+            cls="gauge",
+        ).set(1.0 if healthy else 0.0)
+
+    def set_replicas(self, n: int) -> None:
+        self._replicas.set(float(n))
+
+    def set_sessions(self, n: int) -> None:
+        self._sessions.set(float(n))
+
+    def snapshot(self) -> Dict[str, float]:
+        out: Dict[str, float] = {
+            "fleet_rerouted_total": self._rerouted.value,
+            "fleet_worker_crashes_total": self._crashes.value,
+        }
+        for wid, inst in self._routed.items():
+            out[f"fleet_routed_total_{wid}"] = inst.value
+        for wid, inst in self._affinity.items():
+            out[f"fleet_affinity_hits_total_{wid}"] = inst.value
+        if self._route_samples:
+            out["fleet_route_ms_p50"] = float(
+                np.percentile(self._route_samples, 50)
+            )
+            out["fleet_route_ms_p99"] = float(
+                np.percentile(self._route_samples, 99)
+            )
+        return out
+
+
+class FleetRouter:
+    """The front door (see module docstring). Thread-safe: routing
+    state mutates under one lock; worker POSTs run outside it (the
+    scheduler's dispatch-outside-the-lock discipline), so concurrent
+    submitters only serialize on the DECISION, never on generation."""
+
+    def __init__(
+        self,
+        replicas: Sequence[ReplicaHandle],
+        *,
+        page_size: int,
+        policy: str = "affinity",
+        state_path: Optional[str] = None,
+        request_timeout_s: float = 120.0,
+        health_timeout_s: float = 2.0,
+        scrape_ttl_s: float = 1.0,
+        metrics: Optional[FleetMetrics] = None,
+        transport: Optional[Callable[..., Dict[str, Any]]] = None,
+        health_probe: Optional[Callable[..., bool]] = None,
+        kill_replica: Optional[Callable[[ReplicaHandle], None]] = None,
+    ) -> None:
+        if policy not in ("affinity", "round_robin"):
+            raise ValueError(
+                f"policy={policy!r}: expected 'affinity' or 'round_robin'."
+            )
+        if not replicas:
+            raise ValueError("FleetRouter needs at least one replica.")
+        ids = [r.worker_id for r in replicas]
+        if len(set(ids)) != len(ids):
+            raise ValueError(f"duplicate replica worker_ids: {ids}")
+        self.replicas: List[ReplicaHandle] = list(replicas)
+        self.page_size = int(page_size)
+        self.policy = policy
+        self.state_path = state_path
+        self.request_timeout_s = float(request_timeout_s)
+        self.health_timeout_s = float(health_timeout_s)
+        self.scrape_ttl_s = float(scrape_ttl_s)
+        self.metrics = metrics if metrics is not None else FleetMetrics()
+        self._transport = transport or _http_transport
+        self._health_probe = health_probe or _http_health
+        self._kill_replica_hook = kill_replica or _default_kill
+        self.request_log = RequestLog("fleet")
+        self._lock = threading.RLock()
+        self._by_id = {r.worker_id: r for r in self.replicas}
+        for r in self.replicas:
+            r.index = PrefixIndex(self.page_size)
+        #: session -> worker_id pins (the continuity contract).
+        self._sessions: Dict[str, str] = {}
+        self._rr_next = 0
+        self.routed_total = 0
+        self.affinity_hits_total = 0
+        self.rerouted_total = 0
+        self._obs_server = None
+        self._health_thread: Optional[threading.Thread] = None
+        self._health_stop = threading.Event()
+        self.metrics.set_replicas(len(self.replicas))
+        for r in self.replicas:
+            self.metrics.record_health(r.worker_id, r.healthy)
+        if state_path and os.path.exists(state_path):
+            self._load_state()
+
+    # -- session-pin persistence (router restart recovery) ---------------
+
+    def _load_state(self) -> None:
+        """Adopt the previous router's session pins (restart recovery:
+        pinned sessions stay on their WARM replica; the prefix indexes
+        rebuild lazily from subsequent traffic — until they rewarm,
+        unpinned traffic routes by load, which is correct, just cold)."""
+        try:
+            with open(self.state_path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError) as e:
+            logger.warning(
+                "fleet state %s unreadable (%s); starting with no "
+                "session pins", self.state_path, e,
+            )
+            return
+        restored = {
+            str(sid): str(wid)
+            for sid, wid in doc.get("sessions", {}).items()
+            if str(wid) in self._by_id
+        }
+        self._sessions.update(restored)
+        self.metrics.set_sessions(len(self._sessions))
+        if restored:
+            logger.info(
+                "fleet router restored %d session pin(s) from %s",
+                len(restored), self.state_path,
+            )
+
+    def _save_state(self) -> None:
+        """Atomic write (tmp + rename) so a router killed mid-save
+        leaves the previous pins readable, never a torn file."""
+        if not self.state_path:
+            return
+        tmp = self.state_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"sessions": dict(self._sessions)}, f)
+        os.replace(tmp, self.state_path)
+
+    # -- health ----------------------------------------------------------
+
+    def check_health(self) -> Dict[str, bool]:
+        """Probe every replica's ``/healthz`` once; a replica that
+        fails the probe goes unhealthy (its sessions re-route on their
+        next turn). Returns ``{worker_id: healthy}``. Call it
+        explicitly (deterministic tests) or from the background thread
+        (:meth:`start_health_checks`)."""
+        out = {}
+        for r in self.replicas:
+            ok = bool(self._health_probe(r, self.health_timeout_s))
+            with self._lock:
+                if r.healthy and not ok:
+                    self._mark_dead(r)
+                elif ok and not r.healthy:
+                    # A replica that comes BACK (restarted worker) is
+                    # cold: serve it again, predict nothing warm.
+                    r.healthy = True
+                    r.index.clear()
+                    self.metrics.record_health(r.worker_id, True)
+                    logger.info(
+                        "fleet replica %s healthy again (cold)",
+                        r.worker_id,
+                    )
+            out[r.worker_id] = ok
+        return out
+
+    def start_health_checks(self, interval_s: float = 1.0) -> None:
+        """Run :meth:`check_health` on a daemon thread every
+        ``interval_s`` seconds until :meth:`close`."""
+        if self._health_thread is not None:
+            return
+        self._health_stop.clear()
+
+        def loop():
+            while not self._health_stop.wait(interval_s):
+                try:
+                    self.check_health()
+                except Exception:  # probes must never kill the thread
+                    logger.exception("fleet health check failed")
+
+        t = threading.Thread(
+            target=loop, name="zk-fleet-health", daemon=True
+        )
+        t.start()
+        self._health_thread = t
+
+    def _mark_dead(self, replica: ReplicaHandle) -> None:
+        """Caller holds the lock. The replica's index drops (its
+        process — and with it every cached page — is gone; a restarted
+        one is cold) and its health gauge goes to 0. Session pins are
+        NOT dropped here: each re-pins to a survivor on its next turn
+        (counted as a re-route), so the metric reflects re-routes that
+        actually happened."""
+        replica.healthy = False
+        replica.index.clear()
+        self.metrics.record_health(replica.worker_id, False)
+        logger.warning("fleet replica %s marked dead", replica.worker_id)
+
+    # -- load fallback ---------------------------------------------------
+
+    def _scrape_load(self, replica: ReplicaHandle):
+        """Worker-side load terms from its live ``/metrics`` registry
+        (``zk_decode_queue_depth``, ``zk_kv_pool_free_pages``), cached
+        for ``scrape_ttl_s`` so a routing burst costs one scrape, not
+        one per request. Returns ``(queue_depth, free_pages)`` —
+        ``(0.0, 0.0)`` when the replica exposes no endpoint or the
+        scrape fails (the router-side ``outstanding`` count still
+        differentiates load)."""
+        now = time.monotonic()
+        cached = replica._scrape
+        if cached is not None and now - cached[0] < self.scrape_ttl_s:
+            return cached[1], cached[2]
+        queue_depth, free_pages = 0.0, 0.0
+        if replica.obs_url is not None:
+            try:
+                with urllib.request.urlopen(
+                    replica.obs_url + "/metrics",
+                    timeout=self.health_timeout_s,
+                ) as resp:
+                    body = resp.read().decode()
+                for line in body.splitlines():
+                    if line.startswith("zk_decode_queue_depth "):
+                        queue_depth = float(line.split()[-1])
+                    elif line.startswith("zk_kv_pool_free_pages "):
+                        free_pages = float(line.split()[-1])
+            except (urllib.error.URLError, OSError, ValueError):
+                pass
+        replica._scrape = (now, queue_depth, free_pages)
+        return queue_depth, free_pages
+
+    def _load_key(self, replica: ReplicaHandle):
+        """Sort key for the load fallback: fewest in-flight + queued
+        requests first; ties break toward the most free KV pages (the
+        replica with headroom absorbs the next long prompt)."""
+        queue_depth, free_pages = self._scrape_load(replica)
+        return (replica.outstanding + queue_depth, -free_pages)
+
+    # -- routing ---------------------------------------------------------
+
+    def _route(self, tokens, session: Optional[str]):
+        """The routing decision (caller holds no lock; takes it).
+        Returns ``(replica, affinity_hit, rerouted, predicted)``."""
+        with self._lock:
+            healthy = [r for r in self.replicas if r.healthy]
+            if not healthy:
+                raise FleetUnavailableError(
+                    f"no healthy replica left out of "
+                    f"{len(self.replicas)} — every worker is dead."
+                )
+            chosen: Optional[ReplicaHandle] = None
+            affinity_hit = False
+            rerouted = False
+            predicted = 0
+            if session is not None and session in self._sessions:
+                pinned = self._by_id.get(self._sessions[session])
+                if pinned is not None and pinned.healthy:
+                    # Session continuity: the pin IS the affinity —
+                    # turn-2+ re-enters this replica's radix cache.
+                    chosen = pinned
+                    affinity_hit = True
+                    predicted = pinned.index.predict(tokens)
+                else:
+                    # The pinned replica died: this turn re-routes
+                    # COLD to a survivor and re-pins there.
+                    rerouted = True
+                    self.rerouted_total += 1
+                    self.metrics.record_rerouted()
+            if chosen is None:
+                if self.policy == "round_robin":
+                    chosen = healthy[self._rr_next % len(healthy)]
+                    self._rr_next += 1
+                else:
+                    scored = [
+                        (r.index.predict(tokens), r) for r in healthy
+                    ]
+                    best = max(p for p, _ in scored)
+                    if best > 0:
+                        # Warm-prefix affinity: the replica predicted
+                        # to hold the most of this prompt resident.
+                        chosen = max(
+                            scored,
+                            key=lambda pr: (
+                                pr[0],
+                                # Ties route by load, cheapest first.
+                                tuple(-x for x in self._load_key(pr[1])),
+                            ),
+                        )[1]
+                        affinity_hit = True
+                        predicted = best
+                    else:
+                        # Nobody is warm: pure load fallback.
+                        chosen = min(healthy, key=self._load_key)
+            if session is not None:
+                if self._sessions.get(session) != chosen.worker_id:
+                    self._sessions[session] = chosen.worker_id
+                    self._save_state()
+                self.metrics.set_sessions(len(self._sessions))
+            # Predict the replica's FUTURE warm state: the worker
+            # inserts this prompt's pages into its radix cache after
+            # prefill, so the index observes exactly that.
+            chosen.index.observe(tokens)
+            chosen.routed_total += 1
+            self.routed_total += 1
+            if affinity_hit:
+                self.affinity_hits_total += 1
+            return chosen, affinity_hit, rerouted, predicted
+
+    def submit(
+        self,
+        tokens: Any,
+        *,
+        session: Optional[str] = None,
+        max_new_tokens: int = 16,
+        rid: Optional[int] = None,
+    ) -> FleetResponse:
+        """Route one prompt and block for its generation. ``session``
+        pins multi-turn conversations to one replica; ``rid`` defaults
+        to a freshly-minted router id the WORKER adopts (one id across
+        both processes). Raises :class:`WorkerCrashedError` when the
+        chosen replica dies mid-request (the caller may resubmit — the
+        dead replica is already unhealthy, so the retry re-routes) and
+        :class:`FleetUnavailableError` when nobody is left."""
+        from zookeeper_tpu.resilience import faults
+
+        tokens = np.asarray(tokens, np.int32)
+        if tokens.ndim != 1 or tokens.shape[0] < 1:
+            raise ValueError(
+                f"prompt must be a non-empty 1-D int token array, got "
+                f"shape {tokens.shape}."
+            )
+        rid = next_rid() if rid is None else int(rid)
+        t_submit_ns = time.perf_counter_ns()
+        t0 = time.perf_counter()
+        token_list = [int(x) for x in tokens.tolist()]
+        chosen, affinity_hit, rerouted, predicted = self._route(
+            token_list, session
+        )
+        route_ms = (time.perf_counter() - t0) * 1e3
+        self.metrics.record_routed(
+            chosen.worker_id, affinity_hit=affinity_hit, route_ms=route_ms
+        )
+        if _trace.enabled():
+            _trace.event(
+                "fleet_route",
+                rid=rid,
+                attrs={
+                    "replica": chosen.worker_id,
+                    "affinity_hit": affinity_hit,
+                    "rerouted": rerouted,
+                    "predicted_shared": predicted,
+                    "session": session or "",
+                },
+            )
+        plan = faults.active()
+        if plan is not None and plan.take_fleet_replica_kill():
+            # Chaos coordinate (docs/DESIGN.md §23): the chosen replica
+            # dies NOW — the forward below finds a dead worker, exactly
+            # the mid-request death the contract covers.
+            self._kill_replica_hook(chosen)
+        with self._lock:
+            chosen.outstanding += 1
+        try:
+            payload = {
+                "tokens": token_list,
+                "max_new_tokens": int(max_new_tokens),
+                "rid": rid,
+                "session": session,
+            }
+            body = self._transport(
+                chosen, payload, self.request_timeout_s
+            )
+        except (urllib.error.URLError, OSError, ConnectionError) as e:
+            with self._lock:
+                if chosen.healthy:
+                    self._mark_dead(chosen)
+            self.metrics.record_worker_crash()
+            self.request_log.append(
+                rid,
+                "crashed",
+                enqueue_ns=t_submit_ns,
+                complete_ns=time.perf_counter_ns(),
+                detail=f"WorkerCrashedError replica={chosen.worker_id}",
+                role="router",
+            )
+            raise WorkerCrashedError(
+                f"fleet replica {chosen.worker_id} died mid-request "
+                f"(rid={rid}): {e}; the replica is unhealthy — "
+                "resubmit to re-route to a survivor."
+            ) from e
+        finally:
+            with self._lock:
+                chosen.outstanding -= 1
+        if "error" in body:
+            self.request_log.append(
+                rid,
+                "error",
+                enqueue_ns=t_submit_ns,
+                complete_ns=time.perf_counter_ns(),
+                detail=f"{body.get('type', 'error')} "
+                f"replica={chosen.worker_id}",
+                role="router",
+            )
+            raise RuntimeError(
+                f"fleet replica {chosen.worker_id} failed rid={rid}: "
+                f"{body.get('type', 'error')}: {body['error']}"
+            )
+        out = np.asarray(body["tokens"], np.int32)
+        self.request_log.append(
+            rid,
+            "ok",
+            enqueue_ns=t_submit_ns,
+            complete_ns=time.perf_counter_ns(),
+            tokens=int(out.shape[0]),
+            detail=(
+                f"replica={chosen.worker_id} "
+                f"shared={int(body.get('shared_tokens', 0))} "
+                f"predicted={predicted}"
+            ),
+            role="router",
+        )
+        return FleetResponse(
+            rid=rid,
+            worker_id=chosen.worker_id,
+            tokens=out,
+            ttft_ms=body.get("ttft_ms"),
+            shared_tokens=int(body.get("shared_tokens", 0)),
+            finish_reason=body.get("finish_reason"),
+            affinity_hit=affinity_hit,
+            rerouted=rerouted,
+            predicted_shared=predicted,
+        )
+
+    # -- observability ---------------------------------------------------
+
+    def status(self) -> Dict[str, Any]:
+        """The ``/statusz`` fleet section: policy, per-replica health/
+        load/affinity state, session pins, routing totals."""
+        with self._lock:
+            return {
+                "policy": self.policy,
+                "replicas": [
+                    {
+                        "worker_id": r.worker_id,
+                        "healthy": r.healthy,
+                        "outstanding": r.outstanding,
+                        "routed_total": r.routed_total,
+                        "index_nodes": r.index.nodes if r.index else 0,
+                        "generate_url": r.generate_url,
+                    }
+                    for r in self.replicas
+                ],
+                "healthy_replicas": sum(
+                    1 for r in self.replicas if r.healthy
+                ),
+                "sessions": len(self._sessions),
+                "routed_total": self.routed_total,
+                "affinity_hits_total": self.affinity_hits_total,
+                "rerouted_total": self.rerouted_total,
+                "state_path": self.state_path,
+            }
+
+    def session_pin(self, session: str) -> Optional[str]:
+        """The replica ``session`` is pinned to (None = unpinned)."""
+        with self._lock:
+            return self._sessions.get(str(session))
+
+    def start_observability(self, port: int = 0):
+        """Serve the router's own ``/metrics`` (``zk_fleet_*``) +
+        ``/statusz`` (fleet + requests sections) + ``/healthz``."""
+        from zookeeper_tpu.observability import ObservabilityServer
+        from zookeeper_tpu.observability.registry import default_registry
+
+        server = ObservabilityServer(
+            [default_registry(), self.metrics.registry],
+            port=port,
+            status_providers={
+                "fleet": self.status,
+                "requests": self.request_log.as_status,
+            },
+        )
+        server.start()
+        self._obs_server = server
+        return server
+
+    @property
+    def obs_server(self):
+        return self._obs_server
+
+    def close(self) -> None:
+        """Stop the health thread and the observability endpoint (the
+        workers are NOT stopped — their lifecycle belongs to whoever
+        spawned them)."""
+        self._health_stop.set()
+        if self._health_thread is not None:
+            self._health_thread.join(timeout=5)
+            self._health_thread = None
+        if self._obs_server is not None:
+            self._obs_server.stop()
+            self._obs_server = None

@@ -318,6 +318,281 @@ def run_group_chaos_worker(
         json.dump(results, f)
 
 
+def run_fleet_worker(
+    worker_id: str,
+    ready_path: str,
+    workdir: str,
+    config_json: str = "{}",
+) -> None:
+    """One replica of the fleet-serving topology (docs/DESIGN.md §23).
+    Spawned as a real OS process by :func:`spawn_fleet_workers`: builds
+    a paged-KV ``LMServingConfig`` (radix prefix cache ON — the warm
+    path the router's affinity protects), serves ``POST /generate``
+    over stdlib HTTP (JSON ``{tokens, max_new_tokens, rid, session}``
+    in, ``{rid, tokens, ttft_ms, shared_tokens, ...}`` out — the
+    scheduler ADOPTS the router-minted rid), and exposes the usual
+    live ``/metrics`` + ``/statusz`` + ``/healthz`` on an ephemeral
+    ObservabilityServer port. Writes a ready document (worker_id, pid,
+    generate_port, metrics_port) atomically once serving.
+    """
+    import json
+    import os
+    import threading
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from zookeeper_tpu.core import configure
+    from zookeeper_tpu.serving import LMServingConfig
+
+    overrides = json.loads(config_json)
+    conf = {
+        "model.num_layers": 2,
+        "model.d_model": 64,
+        "model.num_heads": 4,
+        "model.max_seq_len": 128,
+        "model.attention": "dense",
+        "seq_len": 128,
+        "vocab_size": 61,
+        "seed": 0,
+        "engine.kv_layout": "paged",
+        "engine.page_size": 16,
+        "engine.slots": 4,
+        "engine.seq_buckets": (16, 128),
+        "engine.prefill_buckets": (1,),
+        "requests": 0,
+        "verbose": False,
+        "metrics_port": 0,
+    }
+    conf.update(overrides)
+    svc = LMServingConfig()
+    configure(svc, conf, name=f"fleet_worker_{worker_id}")
+    engine, scheduler = svc.build_service()
+    # One generation at a time per replica: the router's load terms
+    # (outstanding + queue depth) stay meaningful and the CPU test
+    # topology stays deterministic.
+    gen_lock = threading.Lock()
+    stop = threading.Event()
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *args):  # silence per-request stderr
+            pass
+
+        def _send(self, code, doc):
+            body = json.dumps(doc).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_POST(self):
+            if self.path == "/shutdown":
+                self._send(200, {"ok": True})
+                stop.set()
+                return
+            if self.path != "/generate":
+                self._send(404, {"error": f"no route {self.path}"})
+                return
+            try:
+                n = int(self.headers.get("Content-Length", "0"))
+                req = json.loads(self.rfile.read(n).decode())
+                with gen_lock:
+                    stream = scheduler.submit(
+                        np.asarray(req["tokens"], np.int32),
+                        max_new_tokens=int(
+                            req.get("max_new_tokens") or 16
+                        ),
+                        rid=req.get("rid"),
+                    )
+                    out = stream.result(timeout=300.0)
+                self._send(
+                    200,
+                    {
+                        "rid": stream.rid,
+                        "worker_id": worker_id,
+                        "tokens": [int(x) for x in out.tolist()],
+                        "ttft_ms": stream.ttft_ms,
+                        "shared_tokens": int(stream.shared_tokens),
+                        "finish_reason": stream.finish_reason,
+                    },
+                )
+            except Exception as e:  # surfaced to the router as 400
+                self._send(
+                    400, {"error": str(e), "type": type(e).__name__}
+                )
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    serve_thread = threading.Thread(
+        target=httpd.serve_forever, daemon=True
+    )
+    serve_thread.start()
+    doc = {
+        "worker_id": worker_id,
+        "pid": os.getpid(),
+        "generate_port": httpd.server_address[1],
+        "metrics_port": svc.obs_server.port,
+    }
+    tmp = ready_path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f)
+    os.replace(tmp, ready_path)
+    try:
+        stop.wait()
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        svc._teardown_service(suppress=True)
+
+
+def spawn_fleet_workers(
+    workdir: str,
+    num_workers: int = 2,
+    config: dict = None,
+    timeout_s: float = 300.0,
+):
+    """Spawn ``num_workers`` real OS processes running
+    :func:`run_fleet_worker` and wait for every ready file; returns
+    the ready documents (feed them to
+    ``zookeeper_tpu.serving.fleet.ReplicaHandle.from_worker``). Raises
+    with the worker's log tail when any process dies before ready —
+    shared by ``tests/serving/test_fleet.py``, the CI scrape smoke and
+    the ``ZK_BENCH_FLEET`` bench leg so the three cannot drift."""
+    import json
+    import os
+    import subprocess
+    import sys
+    import time
+
+    repo_root = os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))
+    )
+    config_json = json.dumps(config or {})
+    procs = []
+    for w in range(num_workers):
+        worker_id = f"w{w}"
+        ready = os.path.join(workdir, f"ready_{worker_id}.json")
+        env = dict(os.environ)
+        env.update(
+            {
+                "JAX_PLATFORMS": "cpu",
+                "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+                "PYTHONPATH": repo_root
+                + (
+                    os.pathsep + os.environ["PYTHONPATH"]
+                    if os.environ.get("PYTHONPATH")
+                    else ""
+                ),
+                "TPU_SKIP_MDS_QUERY": "1",
+            }
+        )
+        code = (
+            "import sys; from zookeeper_tpu.testing import "
+            "run_fleet_worker; run_fleet_worker("
+            "sys.argv[1], sys.argv[2], sys.argv[3], sys.argv[4])"
+        )
+        # Log to files, not pipes: a full pipe buffer would stall the
+        # worker's HTTP loop (the group-chaos lesson).
+        log_path = os.path.join(workdir, f"fleet_log_{worker_id}.txt")
+        log_f = open(log_path, "wb")
+        p = subprocess.Popen(
+            [
+                sys.executable,
+                "-c",
+                code,
+                worker_id,
+                ready,
+                workdir,
+                config_json,
+            ],
+            env=env,
+            stdout=log_f,
+            stderr=subprocess.STDOUT,
+        )
+        log_f.close()
+        procs.append((p, worker_id, ready, log_path))
+    workers = []
+    deadline = time.monotonic() + timeout_s
+    try:
+        for p, worker_id, ready, log_path in procs:
+            while True:
+                if os.path.exists(ready):
+                    with open(ready) as f:
+                        workers.append(json.load(f))
+                    break
+                if p.poll() is not None:
+                    with open(log_path, errors="replace") as f:
+                        log = f.read()
+                    raise RuntimeError(
+                        f"fleet worker {worker_id} died before ready "
+                        f"(rc={p.returncode}):\n" + log[-4000:]
+                    )
+                if time.monotonic() > deadline:
+                    raise RuntimeError(
+                        f"fleet worker {worker_id} not ready within "
+                        f"{timeout_s:.0f}s; log: {log_path}"
+                    )
+                time.sleep(0.1)
+    except BaseException:
+        for p, *_ in procs:
+            if p.poll() is None:
+                p.kill()
+        raise
+    return workers
+
+
+def stop_fleet_workers(workers, timeout_s: float = 30.0) -> None:
+    """Graceful teardown for :func:`spawn_fleet_workers` output: POST
+    ``/shutdown`` to every live worker, then SIGKILL stragglers.
+    Already-dead workers (chaos legs kill them) are skipped silently.
+    """
+    import os
+    import signal
+    import time
+    import urllib.error
+    import urllib.request
+
+    for w in workers:
+        try:
+            urllib.request.urlopen(
+                urllib.request.Request(
+                    "http://127.0.0.1:%d/shutdown" % w["generate_port"],
+                    data=b"{}",
+                ),
+                timeout=5,
+            )
+        except (urllib.error.URLError, OSError):
+            pass
+    deadline = time.monotonic() + timeout_s
+    for w in workers:
+        pid = w.get("pid")
+        if pid is None:
+            continue
+        # Reap (we are the parent): WNOHANG-poll until exit, then
+        # SIGKILL stragglers. Chaos-killed workers are zombies until
+        # this waitpid — reaping here keeps repeated spawns clean.
+        while True:
+            try:
+                done, _ = os.waitpid(pid, os.WNOHANG)
+            except ChildProcessError:
+                break  # already reaped / not ours
+            if done == pid:
+                break
+            if time.monotonic() > deadline:
+                try:
+                    os.kill(pid, signal.SIGKILL)
+                except OSError:
+                    pass
+                try:
+                    os.waitpid(pid, 0)
+                except ChildProcessError:
+                    pass
+                break
+            time.sleep(0.1)
+
+
 def spawn_group_chaos_cluster(workdir: str, num_processes: int = 2):
     """Spawn ``num_processes`` OS processes running
     :func:`run_group_chaos_worker` as one jax cluster; wait for them
