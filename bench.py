@@ -1715,6 +1715,164 @@ def measure_fleet_throughput(env=None):
     }
 
 
+def measure_trace_slo(env=None):
+    """``ZK_BENCH_TRACE=1`` leg: overload-guardrails A/B under a
+    pinned trace-driven burst — docs/DESIGN.md §24's acceptance
+    numbers.
+
+    One seed-keyed ``poisson_burst`` trace (every request carrying a
+    deadline) is replayed open-loop against TWO fresh sync decode
+    stacks built from the same config: pass A with the
+    :class:`OverloadGuard` off (the baseline — doomed requests ride
+    the queue until ``DeadlineExpiredError`` fires, wasting queue
+    residency and mid-decode work), pass B with predicted-miss
+    admission on (doomed requests shed at submit). Both passes get an
+    identical no-deadline warmup block first, so pass B's EWMA
+    estimator is warmed the way a live service's would be and neither
+    pass pays compile time inside the measurement.
+
+    Headline (gated, direction-aware in tools/bench_diff.py):
+
+    - ``trace_goodput_tokens_per_sec`` — guardrails-on goodput
+      (ok-request tokens / wall). Shedding the doomed tail must not
+      cost throughput of the admitted body.
+    - ``trace_admitted_ttft_p99_ms`` — p99 TTFT over ADMITTED (ok)
+      requests with guardrails on; the §24 acceptance bound is <= the
+      baseline's (``trace_baseline_admitted_ttft_p99_ms``,
+      informational), because the queue no longer carries corpses.
+    - ``trace_shed_precision`` — of the requests pass B shed, the
+      fraction that pass A actually failed (deadline-expired): sheds
+      should hit the doomed, not the viable.
+
+    Knobs: ``ZK_BENCH_TRACE_SEED`` (default 23),
+    ``ZK_BENCH_TRACE_DEADLINE_MS`` (default 300),
+    ``ZK_BENCH_TRACE_BURST_RPS`` (default 900),
+    ``ZK_BENCH_TRACE_NEW_TOKENS`` (max output budget, default 12),
+    ``ZK_BENCH_TRACE_WARMUP`` (warmup requests, default 6)."""
+    import numpy as np
+
+    from zookeeper_tpu.core import configure
+    from zookeeper_tpu.loadgen import poisson_burst, replay
+    from zookeeper_tpu.serving import LMServingConfig
+
+    env = os.environ if env is None else env
+    seed = int(env.get("ZK_BENCH_TRACE_SEED", "23"))
+    deadline_ms = float(env.get("ZK_BENCH_TRACE_DEADLINE_MS", "300"))
+    burst_rps = float(env.get("ZK_BENCH_TRACE_BURST_RPS", "900"))
+    new_tokens = int(env.get("ZK_BENCH_TRACE_NEW_TOKENS", "12"))
+    warmup = int(env.get("ZK_BENCH_TRACE_WARMUP", "6"))
+
+    vocab = 61
+    conf = {
+        "model.num_layers": 2,
+        "model.d_model": 64,
+        "model.num_heads": 4,
+        "model.max_seq_len": 128,
+        "model.attention": "dense",
+        "seq_len": 128,
+        "vocab_size": vocab,
+        "seed": 0,
+        "engine.kv_layout": "paged",
+        "engine.page_size": 16,
+        "engine.slots": 4,
+        "engine.seq_buckets": (32, 128),
+        "engine.prefill_buckets": (1,),
+        "requests": 0,
+        "verbose": False,
+        "metrics_port": -1,
+    }
+    trace = poisson_burst(
+        seed,
+        base_rate_rps=40.0,
+        burst_rate_rps=burst_rps,
+        base_s=0.3,
+        burst_s=0.3,
+        cooldown_s=0.15,
+        vocab=vocab,
+        prompt_len=4,
+        max_prompt_len=24,
+        new_tokens=4,
+        max_new_tokens=new_tokens,
+        deadline_ms=deadline_ms,
+    )
+    warm_rng = np.random.default_rng(7)
+    warm_prompts = [
+        warm_rng.integers(1, vocab, size=8).astype(np.int32)
+        for _ in range(warmup)
+    ]
+
+    def run_pass(guard_on):
+        svc = LMServingConfig()
+        c = dict(conf)
+        if guard_on:
+            c["guard.enabled"] = True
+            c["guard.min_samples"] = 4
+        configure(
+            svc, c, name="trace_slo_" + ("on" if guard_on else "off")
+        )
+        _, scheduler = svc.build_service()
+        try:
+            # Identical warmup both passes: compiles out of the clock,
+            # and (pass B) the EWMA estimator fed like a live service.
+            for p in warm_prompts:
+                scheduler.submit(p, max_new_tokens=4).result(
+                    timeout=300.0
+                )
+            return replay(trace, scheduler)
+        finally:
+            svc._teardown_service(suppress=True)
+
+    base = run_pass(False)
+    guarded = run_pass(True)
+
+    def admitted_ttft_p99(report):
+        ttfts = [
+            o.ttft_ms
+            for o in report.results
+            if o.outcome == "ok" and o.ttft_ms is not None
+        ]
+        return float(np.percentile(ttfts, 99)) if ttfts else -1.0
+
+    # Shed precision: B's sheds scored against what ACTUALLY failed in
+    # the unguarded baseline (deadline-expired or statically shed).
+    missed_base = {
+        o.index for o in base.results if o.outcome != "ok"
+    }
+    shed = {o.index for o in guarded.results if o.outcome == "shed"}
+    precision = (
+        len(shed & missed_base) / len(shed) if shed else 1.0
+    )
+    return {
+        # Gated (direction-aware in tools/bench_diff.py).
+        "trace_goodput_tokens_per_sec": round(
+            guarded.goodput_tokens_per_sec, 1
+        ),
+        "trace_admitted_ttft_p99_ms": round(
+            admitted_ttft_p99(guarded), 3
+        ),
+        "trace_shed_precision": round(precision, 3),
+        # Baseline pass (informational: context for the gated B side).
+        "trace_baseline_goodput_tokens_per_sec": round(
+            base.goodput_tokens_per_sec, 1
+        ),
+        "trace_baseline_admitted_ttft_p99_ms": round(
+            admitted_ttft_p99(base), 3
+        ),
+        "trace_baseline_deadline_expired": base.outcomes.get(
+            "deadline_expired", 0
+        ),
+        "trace_baseline_ok": base.outcomes.get("ok", 0),
+        # Workload shape + outcome tallies (informational).
+        "trace_requests": len(trace.requests),
+        "trace_deadline_ms": deadline_ms,
+        "trace_shed_total": len(shed),
+        "trace_ok_total": guarded.outcomes.get("ok", 0),
+        "trace_deadline_expired": guarded.outcomes.get(
+            "deadline_expired", 0
+        ),
+    }
+
+
 def measure_trace_overhead(env=None):
     """``ZK_BENCH_OBS=1`` leg: the host-tracing cost on the step-time
     anchor — the observability layer's acceptance number
@@ -2835,6 +2993,22 @@ def main(argv=None):
             )
             fleet_metrics = None
 
+    # Trace-SLO leg (env-gated: two fresh sync decode stacks replay a
+    # pinned deadline-carrying burst): overload guardrails on vs off —
+    # goodput held, admitted-tail TTFT improved, sheds precise
+    # (docs/DESIGN.md §24).
+    trace_metrics = None
+    if _env_flag(os.environ, "ZK_BENCH_TRACE"):
+        try:
+            trace_metrics = measure_trace_slo()
+        except Exception as e:  # never lose the primary metric
+            print(
+                f"trace SLO leg failed ({e}); omitting trace_*",
+                file=sys.stderr,
+                flush=True,
+            )
+            trace_metrics = None
+
     # Observability-overhead leg (env-gated: interleaved traced/untraced
     # step chains): host-span tracing cost on the step-time anchor —
     # the <= 2% budget docs/DESIGN.md §13 commits to.
@@ -2900,6 +3074,8 @@ def main(argv=None):
         extras.update(disagg_metrics)
     if fleet_metrics is not None:
         extras.update(fleet_metrics)
+    if trace_metrics is not None:
+        extras.update(trace_metrics)
     if obs_metrics is not None:
         extras.update(obs_metrics)
     if binary_metrics is not None:

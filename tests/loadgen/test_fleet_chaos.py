@@ -1,0 +1,261 @@
+"""Chaos-composed trace replay over a REAL multi-process fleet
+(docs/DESIGN.md §24 acceptance): a session-mix trace drives a
+2-replica fleet while a FaultPlan SIGKILLs one replica mid-trace —
+every request reaches a terminal outcome, retried requests are
+token-identical to the single-replica oracle, the killed replica's
+breaker opens, and no worker leaks a single KV page. A second leg
+injects a GRAY failure (delay_forward_ms: alive, healthy, slow) and
+certifies the breaker's open → half-open probe → closed cycle over
+live HTTP routing."""
+
+import json
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from zookeeper_tpu.loadgen import replay, session_mix
+from zookeeper_tpu.resilience import FaultPlan
+from zookeeper_tpu.serving import CircuitBreaker, FleetRouter
+from zookeeper_tpu.serving.fleet import ReplicaHandle
+
+from tests.serving.test_fleet import FLEET_CONF, NEW_TOKENS
+
+pytestmark = [pytest.mark.serving, pytest.mark.slow, pytest.mark.chaos]
+
+
+def fleet_trace():
+    """2 sessions x 2 growing turns, sized for FLEET_CONF geometry
+    (vocab 61, prompts <= 16 tokens, fixed NEW_TOKENS budget so the
+    oracle comparison is exact)."""
+    return session_mix(
+        17,
+        sessions=2,
+        turns=2,
+        shared_prefix_len=8,
+        turn_tokens=4,
+        vocab=FLEET_CONF["vocab_size"],
+        new_tokens=NEW_TOKENS,
+        max_new_tokens=NEW_TOKENS,
+    )
+
+
+def oracle_for(trace):
+    """Single-replica in-process oracle: every trace prompt through one
+    paged-KV service — what the fleet must reproduce wherever (and
+    however many times) each request lands."""
+    from zookeeper_tpu.core import configure
+    from zookeeper_tpu.serving import LMServingConfig
+
+    svc = LMServingConfig()
+    conf = dict(FLEET_CONF)
+    conf["metrics_port"] = -1
+    configure(svc, conf, name="trace_oracle")
+    _, scheduler = svc.build_service()
+    try:
+        return {
+            r.index: scheduler.submit(
+                np.asarray(r.prompt, np.int32),
+                max_new_tokens=r.max_new_tokens,
+            ).result(timeout=300.0).tolist()
+            for r in trace.requests
+        }
+    finally:
+        svc._teardown_service(suppress=True)
+
+
+def spawn(tmp_path, config, n=2):
+    from zookeeper_tpu.testing import spawn_fleet_workers
+
+    return spawn_fleet_workers(str(tmp_path), num_workers=n, config=config)
+
+
+def statusz(worker):
+    url = "http://127.0.0.1:%d/statusz" % worker["metrics_port"]
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return json.loads(r.read().decode())
+
+
+def leaked_values(doc):
+    """Every ``leaked`` count anywhere in a /statusz document — the
+    PagePool status exposes one per pool (KV + draft)."""
+    found = []
+
+    def walk(node):
+        if isinstance(node, dict):
+            for k, v in node.items():
+                if k == "leaked":
+                    found.append(v)
+                else:
+                    walk(v)
+        elif isinstance(node, list):
+            for v in node:
+                walk(v)
+
+    walk(doc)
+    return found
+
+
+class RouterTarget:
+    """Callable replay target wrapping the router so the test can keep
+    each response's exact tokens (the report itself only keeps
+    counts)."""
+
+    def __init__(self, router):
+        self.router = router
+        self.tokens = {}
+        self.rids = {}
+
+    def __call__(self, req):
+        resp = self.router.submit(
+            np.asarray(req.prompt, np.int32),
+            session=req.session,
+            max_new_tokens=req.max_new_tokens,
+        )
+        self.tokens[req.index] = resp.tokens.tolist()
+        self.rids[req.index] = resp.rid
+        return int(resp.tokens.shape[0]), resp.ttft_ms
+
+
+def test_trace_replay_replica_kill_retries_token_identical(tmp_path):
+    """The §24 pinned certification: mid-trace SIGKILL of a replica,
+    rid-preserving retries land every request on the survivor with
+    oracle-identical tokens, the dead replica's breaker opens, and
+    both workers' page pools stay leak-free."""
+    from zookeeper_tpu.testing import stop_fleet_workers
+
+    trace = fleet_trace()
+    want = oracle_for(trace)
+    workers = spawn(tmp_path, FLEET_CONF)
+    router = None
+    try:
+        router = FleetRouter(
+            [ReplicaHandle.from_worker(w) for w in workers],
+            page_size=FLEET_CONF["engine.page_size"],
+            max_retries=2,
+            retry_backoff_s=0.05,
+            breaker_failures=1,
+            breaker_cooldown_s=30.0,  # stays open for the whole replay
+        )
+        target = RouterTarget(router)
+        report = replay(
+            trace,
+            target,
+            fault_plan=FaultPlan(fleet_replica_kill_at=3),
+            concurrency=2,
+        )
+        # Every request reached a terminal outcome — and with retries
+        # on, that outcome is ok for ALL of them despite the kill.
+        assert report.total == len(trace.requests)
+        assert report.outcomes == {"ok": len(trace.requests)}
+        # Token identity, including the retried requests: the retry
+        # re-ran the SAME rid cold on the survivor and greedy decode
+        # reproduced the oracle exactly.
+        assert target.tokens == want
+        assert router.retries_total >= 1
+        assert (
+            router.metrics.snapshot()["fleet_retries_total"]
+            == router.retries_total
+        )
+        # The retried rids are traceable in the router's RequestLog.
+        retried_rids = [
+            rid
+            for rid in target.rids.values()
+            if "retried=" in (
+                (router.request_log.find(rid) or {}).get("detail") or ""
+            )
+        ]
+        assert len(retried_rids) >= 1
+        # Exactly one replica died; its breaker tripped open and the
+        # survivor's stayed closed.
+        dead = [r for r in router.replicas if not r.healthy]
+        live = [r for r in router.replicas if r.healthy]
+        assert len(dead) == 1 and len(live) == 1
+        assert dead[0].breaker.state == CircuitBreaker.OPEN
+        assert live[0].breaker.state == CircuitBreaker.CLOSED
+        # Zero page leaks on the survivor (the dead worker is gone —
+        # its pages died with the process, which is the point of
+        # process-level isolation).
+        survivor = next(
+            w
+            for w in workers
+            if w["worker_id"] == live[0].worker_id
+        )
+        leaks = leaked_values(statusz(survivor))
+        assert leaks, "no PagePool leak counters found in /statusz"
+        assert all(v == 0 for v in leaks)
+    finally:
+        if router is not None:
+            router.close()
+        stop_fleet_workers(workers)
+
+
+def test_gray_failure_breaker_cycle_over_live_fleet(tmp_path):
+    """delay_forward_ms chaos: w0 stalls ONE generate by 600ms while
+    staying alive and healthy — only the latency-watching breaker can
+    see it. The breaker opens, routing avoids w0, the cooldown's
+    half-open probe (the gray stall is one-shot, so the probe is fast)
+    closes it, and every response is token-identical throughout."""
+    from zookeeper_tpu.testing import stop_fleet_workers
+
+    config = dict(FLEET_CONF)
+    config["faults"] = {"delay_forward_ms": {"w0": 600}}
+    workers = spawn(tmp_path, config)
+    router = None
+    try:
+        router = FleetRouter(
+            [ReplicaHandle.from_worker(w) for w in workers],
+            page_size=FLEET_CONF["engine.page_size"],
+            policy="round_robin",
+            breaker_latency_ms=400.0,
+            breaker_latency_window=1,
+            breaker_cooldown_s=0.5,
+            breaker_jitter_frac=0.0,
+        )
+        prompt = np.arange(1, 11, dtype=np.int32)
+
+        def submit():
+            return router.submit(prompt, max_new_tokens=NEW_TOKENS)
+
+        reference = None
+        # Route until w0 has served its (stalled) first request.
+        for _ in range(4):
+            resp = submit()
+            if reference is None:
+                reference = resp.tokens.tolist()
+            assert resp.tokens.tolist() == reference
+            if router._by_id["w0"].breaker.state == CircuitBreaker.OPEN:
+                break
+        b0 = router._by_id["w0"].breaker
+        assert b0.state == CircuitBreaker.OPEN
+        assert b0.opened_total == 1
+        # THE gray-failure point: liveness still says w0 is fine.
+        assert router._by_id["w0"].healthy
+        router.check_health()
+        assert router._by_id["w0"].healthy
+        # While open, everything routes to w1.
+        for _ in range(2):
+            resp = submit()
+            assert resp.worker_id == "w1"
+            assert resp.tokens.tolist() == reference
+        # Cooldown elapses; the next submit claims the half-open probe
+        # on w0, which is fast now (the stall was one-shot) → CLOSED.
+        deadline = time.monotonic() + 10.0
+        probed = None
+        while time.monotonic() < deadline:
+            resp = submit()
+            assert resp.tokens.tolist() == reference
+            if resp.worker_id == "w0":
+                probed = resp
+                break
+        assert probed is not None, "w0 never probed after cooldown"
+        assert b0.state == CircuitBreaker.CLOSED
+        assert b0.probes_total == 1
+        assert router.status()["replicas"][0]["breaker"]["state"] == (
+            "closed"
+        )
+    finally:
+        if router is not None:
+            router.close()
+        stop_fleet_workers(workers)

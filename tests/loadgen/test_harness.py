@@ -1,0 +1,239 @@
+"""Replay harness contracts (docs/DESIGN.md §24): outcome
+classification over the serving exception taxonomy, report
+aggregation, SLO violations firing the flight recorder, FaultPlan
+install/clear, retry parsing from a target RequestLog."""
+
+import numpy as np
+import pytest
+
+from zookeeper_tpu.loadgen import poisson_burst, replay, session_mix
+from zookeeper_tpu.loadgen.harness import _classify
+from zookeeper_tpu.resilience import FaultPlan, faults
+from zookeeper_tpu.serving import (
+    DeadlineExpiredError,
+    FleetUnavailableError,
+    PredictedMissError,
+    RejectedError,
+    WorkerCrashedError,
+)
+
+
+def tiny_trace(**kw):
+    kw.setdefault("base_rate_rps", 200)
+    kw.setdefault("burst_rate_rps", 400)
+    kw.setdefault("base_s", 0.1)
+    kw.setdefault("burst_s", 0.1)
+    kw.setdefault("cooldown_s", 0.1)
+    return poisson_burst(1, **kw)
+
+
+def test_classification_covers_the_taxonomy():
+    assert _classify(None) == "ok"
+    assert _classify(RejectedError("q full")) == "shed"
+    assert _classify(PredictedMissError("miss")) == "shed"
+    assert _classify(DeadlineExpiredError("late")) == "deadline_expired"
+    assert _classify(WorkerCrashedError("gone")) == "crashed"
+    assert _classify(FleetUnavailableError("none")) == "unavailable"
+    assert _classify(RuntimeError("?")) == "error"
+
+
+def test_replay_callable_all_ok_report_shape():
+    trace = tiny_trace()
+
+    def target(req):
+        return req.max_new_tokens, 1.5
+
+    report = replay(trace, target, concurrency=4)
+    assert report.total == len(trace.requests)
+    assert report.outcomes == {"ok": len(trace.requests)}
+    assert report.ok_tokens == sum(
+        r.max_new_tokens for r in trace.requests
+    )
+    assert report.goodput_tokens_per_sec > 0
+    assert set(report.per_phase) == {"base", "burst", "cooldown"}
+    for phase, stats in report.per_phase.items():
+        assert stats["ok"] == stats["requests"] > 0
+        assert {"p50", "p95", "p99"} <= set(stats["latency_ms"])
+        assert stats["ttft_ms"]["p50"] == 1.5
+    d = report.as_dict()
+    assert d["requests"] == report.total
+    assert d["violations"] == 0
+    # Every result is terminal and in trace order.
+    assert [o.index for o in report.results] == [
+        r.index for r in trace.requests
+    ]
+
+
+def test_replay_classifies_errors_per_request():
+    trace = tiny_trace()
+    errors = {
+        0: RejectedError("shed"),
+        1: DeadlineExpiredError("late"),
+        2: WorkerCrashedError("crash"),
+        3: RuntimeError("other"),
+    }
+
+    def target(req):
+        if req.index in errors:
+            raise errors[req.index]
+        return 4, None
+
+    report = replay(trace, target, concurrency=2)
+    n = len(trace.requests)
+    assert report.outcomes == {
+        "ok": n - 4,
+        "shed": 1,
+        "deadline_expired": 1,
+        "crashed": 1,
+        "error": 1,
+    }
+    by_index = {o.index: o for o in report.results}
+    assert by_index[0].outcome == "shed"
+    assert by_index[0].error == "RejectedError"
+    assert by_index[0].tokens == 0
+    assert by_index[2].outcome == "crashed"
+    # Shed/failed requests never contribute to goodput.
+    assert report.ok_tokens == 4 * (n - 4)
+
+
+def test_slo_violations_fire_the_flight_recorder(monkeypatch):
+    from zookeeper_tpu.observability import recorder as _recorder
+
+    seen = []
+    monkeypatch.setattr(
+        _recorder,
+        "notify",
+        lambda kind, step=None, attrs=None: seen.append((kind, attrs)),
+    )
+    trace = tiny_trace()
+    slow = {trace.requests[0].index, trace.requests[1].index}
+
+    def target(req):
+        return 4, 500.0 if req.index in slow else 0.5
+
+    report = replay(trace, target, slo_ttft_ms=100.0)
+    assert len(report.violations) == 2
+    assert {v["index"] for v in report.violations} == slow
+    assert all(kind == "slo_violation" for kind, _ in seen)
+    assert len(seen) == 2
+    assert all("ttft_ms=500.0" in a["breached"][0] for _, a in seen)
+
+
+def test_fault_plan_installed_for_replay_and_always_cleared():
+    plan = FaultPlan(delay_forward_ms={"w9": 1})
+    observed = []
+
+    def target(req):
+        observed.append(faults.active() is plan)
+        return 1, None
+
+    replay(tiny_trace(base_s=0.02, burst_s=0.0, cooldown_s=0.0),
+           target, fault_plan=plan)
+    assert observed and all(observed)
+    assert faults.active() is None
+
+    def boom(req):
+        raise KeyboardInterrupt  # even a hard per-request abort is
+        # contained as a terminal outcome, and the plan still clears
+
+    report = replay(
+        tiny_trace(base_s=0.02, burst_s=0.0, cooldown_s=0.0),
+        boom,
+        fault_plan=plan,
+    )
+    assert set(report.outcomes) == {"error"}
+    assert faults.active() is None
+
+
+class FakePending:
+    def __init__(self, rid, rows):
+        self.rid = rid
+        self._rows = rows
+
+    def result(self, timeout=None):
+        return np.zeros((self._rows, 1), np.float32)
+
+
+class FakeLog:
+    def __init__(self):
+        self.details = {}
+
+    def find(self, rid):
+        if rid not in self.details:
+            return None
+        return {"rid": rid, "detail": self.details[rid]}
+
+
+class FakeBatcherTarget:
+    """submit+flush duck type (open-loop path) whose RequestLog
+    carries router-style ``retried=N`` details."""
+
+    def __init__(self):
+        self.request_log = FakeLog()
+        self._next_rid = 100
+
+    def submit(self, x, deadline_ms=None):
+        rid = self._next_rid
+        self._next_rid += 1
+        if rid % 2 == 0:
+            self.request_log.details[rid] = (
+                f"ok replica=w1 retried={rid % 3}"
+            )
+        return FakePending(rid, int(np.asarray(x).shape[0]))
+
+    def flush(self):
+        pass
+
+
+def test_retried_parsed_from_target_request_log():
+    trace = tiny_trace(base_s=0.05, burst_s=0.0, cooldown_s=0.0)
+    target = FakeBatcherTarget()
+    report = replay(trace, target)  # auto -> open_loop via submit+flush
+    assert report.outcomes == {"ok": len(trace.requests)}
+    want = sum(
+        rid % 3
+        for rid in range(100, 100 + len(trace.requests))
+        if rid % 2 == 0
+    )
+    assert report.retried_total == want
+    by_rid = {o.rid: o for o in report.results}
+    assert by_rid[102].retried == 102 % 3
+    assert by_rid[101].retried == 0  # no log entry: parsed as 0
+
+
+def test_open_loop_admission_error_is_terminal_at_submit():
+    class SheddingTarget(FakeBatcherTarget):
+        def submit(self, x, deadline_ms=None):
+            if self._next_rid >= 103:
+                raise RejectedError("queue full")
+            return super().submit(x, deadline_ms=deadline_ms)
+
+    trace = tiny_trace(base_s=0.05, burst_s=0.0, cooldown_s=0.0)
+    assert len(trace.requests) > 4
+    report = replay(trace, SheddingTarget())
+    assert report.outcomes["ok"] == 3
+    assert report.outcomes["shed"] == len(trace.requests) - 3
+    shed = [o for o in report.results if o.outcome == "shed"]
+    assert all(o.rid is None and o.tokens == 0 for o in shed)
+
+
+def test_mode_and_concurrency_validation():
+    with pytest.raises(ValueError, match="mode"):
+        replay(tiny_trace(), lambda r: (1, None), mode="bogus")
+    with pytest.raises(ValueError, match="concurrency"):
+        replay(tiny_trace(), lambda r: (1, None), concurrency=0)
+
+
+def test_time_scale_paces_arrivals():
+    import time
+
+    trace = session_mix(3, sessions=2, turns=2, rate_rps=40.0)
+    t0 = time.perf_counter()
+    replay(trace, lambda r: (1, None), time_scale=1.0, concurrency=8)
+    elapsed_ms = (time.perf_counter() - t0) * 1e3
+    # Paced replay takes at least the trace's span (minus the first
+    # arrival); unpaced (default) is near-instant in comparison.
+    assert elapsed_ms >= trace.duration_ms * 0.5
+    t0 = time.perf_counter()
+    replay(trace, lambda r: (1, None), concurrency=8)
+    assert (time.perf_counter() - t0) * 1e3 < trace.duration_ms * 0.5

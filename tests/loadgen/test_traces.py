@@ -1,0 +1,190 @@
+"""Trace generator contracts (docs/DESIGN.md §24): seed determinism,
+phase structure, heavy-tail bounds, session prefix growth, round-trip
+serialization, RequestLog replay."""
+
+import dataclasses
+
+import pytest
+
+from zookeeper_tpu.loadgen import (
+    Trace,
+    diurnal_ramp,
+    from_request_log,
+    poisson_burst,
+    session_mix,
+)
+
+
+def as_dicts(trace):
+    return [dataclasses.asdict(r) for r in trace.requests]
+
+
+# -- determinism ------------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "gen",
+    [
+        lambda seed: poisson_burst(seed),
+        lambda seed: diurnal_ramp(seed),
+        lambda seed: session_mix(seed),
+    ],
+    ids=["poisson_burst", "diurnal_ramp", "session_mix"],
+)
+def test_same_seed_same_trace(gen):
+    """The §24 determinism contract: same seed, byte-identical trace."""
+    assert as_dicts(gen(7)) == as_dicts(gen(7))
+    assert as_dicts(gen(7)) != as_dicts(gen(8))
+
+
+def test_knob_independence_of_field_streams():
+    """Changing the OUTPUT-length knob must not perturb arrival times
+    or prompt content — each field draws its own counter stream."""
+    a = poisson_burst(3, new_tokens=2, max_new_tokens=8)
+    b = poisson_burst(3, new_tokens=4, max_new_tokens=32)
+    assert [r.at_ms for r in a.requests] == [r.at_ms for r in b.requests]
+    assert [r.prompt for r in a.requests] == [r.prompt for r in b.requests]
+    assert [r.max_new_tokens for r in a.requests] != [
+        r.max_new_tokens for r in b.requests
+    ]
+
+
+# -- structure --------------------------------------------------------------
+
+
+def test_poisson_burst_phases_and_rates():
+    t = poisson_burst(
+        11, base_rate_rps=20, burst_rate_rps=400, base_s=1, burst_s=1,
+        cooldown_s=1,
+    )
+    assert t.phases() == ["base", "burst", "cooldown"]
+    counts = t.stats()["phases"]
+    # A 20x rate step must show up as a hugely denser burst phase.
+    assert counts["burst"] > 5 * counts["base"]
+    assert counts["burst"] > 5 * counts["cooldown"]
+    # Arrivals are sorted, non-negative, and inside the 3s window.
+    at = [r.at_ms for r in t.requests]
+    assert at == sorted(at)
+    assert all(0 <= x < 3_000 for x in at)
+    # Indices are dense and stable.
+    assert [r.index for r in t.requests] == list(range(len(t.requests)))
+
+
+def test_heavy_tail_bounds_and_token_range():
+    t = poisson_burst(
+        5,
+        prompt_len=3,
+        max_prompt_len=10,
+        new_tokens=2,
+        max_new_tokens=9,
+        vocab=17,
+        burst_rate_rps=500,
+    )
+    lens = [len(r.prompt) for r in t.requests]
+    outs = [r.max_new_tokens for r in t.requests]
+    assert all(3 <= n <= 10 for n in lens)
+    assert all(2 <= n <= 9 for n in outs)
+    # Heavy tail: mostly at the floor, but the tail is actually drawn.
+    assert min(lens) == 3 and max(lens) > 3
+    # Token 0 is reserved (pad/eos): generated prompts never use it.
+    assert all(
+        1 <= tok < 17 for r in t.requests for tok in r.prompt
+    )
+
+
+def test_deadline_propagates():
+    t = poisson_burst(1, deadline_ms=250.0)
+    assert all(r.deadline_ms == 250.0 for r in t.requests)
+    assert all(
+        r.deadline_ms is None for r in poisson_burst(1).requests
+    )
+
+
+def test_diurnal_ramp_phases_and_thinning():
+    t = diurnal_ramp(9, peak_rate_rps=200, trough_frac=0.05, duration_s=2)
+    assert set(t.phases()) == {"ramp_up", "ramp_down"}
+    # Thinning really thins: far fewer accepted than peak-rate draws.
+    assert 0 < len(t.requests) < 200 * 2
+    at = [r.at_ms for r in t.requests]
+    assert at == sorted(at)
+
+
+def test_session_mix_prefix_growth():
+    """Turn k's prompt EXTENDS turn k-1's for every session (the radix
+    cache shape), all sessions share the common prefix, and turns
+    interleave round-robin rather than session-at-a-time."""
+    t = session_mix(
+        13, sessions=3, turns=3, shared_prefix_len=6, turn_tokens=2
+    )
+    by_session = {}
+    for r in t.requests:
+        by_session.setdefault(r.session, []).append(r)
+    assert set(by_session) == {"s0", "s1", "s2"}
+    shared = t.requests[0].prompt[:6]
+    for sid, reqs in by_session.items():
+        assert [r.phase for r in reqs] == ["turn0", "turn1", "turn2"]
+        for prev, cur in zip(reqs, reqs[1:]):
+            assert cur.prompt[: len(prev.prompt)] == prev.prompt
+            assert len(cur.prompt) == len(prev.prompt) + 2
+        assert reqs[0].prompt[:6] == shared
+    # Interleaved: the first `sessions` arrivals are all DIFFERENT
+    # sessions (turn 0 round-robin), not one session's whole history.
+    assert len({r.session for r in t.requests[:3]}) == 3
+
+
+def test_stats_shape():
+    t = session_mix(2, sessions=4, turns=2)
+    st = t.stats()
+    assert st["requests"] == 8
+    assert st["sessions"] == 4
+    assert st["phases"] == {"turn0": 4, "turn1": 4}
+    assert st["mean_prompt_tokens"] > 0
+    assert Trace(name="empty", seed=0, requests=[]).stats() == {
+        "requests": 0
+    }
+
+
+def test_generator_validation():
+    with pytest.raises(ValueError, match="rates"):
+        poisson_burst(0, base_rate_rps=0)
+    with pytest.raises(ValueError, match="trough_frac"):
+        diurnal_ramp(0, trough_frac=1.5)
+    with pytest.raises(ValueError, match="sessions"):
+        session_mix(0, sessions=0)
+
+
+# -- serialization ----------------------------------------------------------
+
+
+def test_save_load_round_trip(tmp_path):
+    t = session_mix(21, sessions=2, turns=2, deadline_ms=100.0)
+    path = str(tmp_path / "trace.json")
+    t.save(path)
+    back = Trace.load(path)
+    assert back.name == t.name and back.seed == t.seed
+    assert as_dicts(back) == as_dicts(t)
+
+
+def test_from_request_log_offsets_and_sizes():
+    base = 5_000_000_000
+    records = [
+        {"rid": 1, "enqueue_ns": base, "rows": 4, "tokens": 6},
+        {"rid": 2, "enqueue_ns": base + 250_000_000, "rows": 8,
+         "tokens": 3},
+        {"rid": 3, "enqueue_ns": None},  # never enqueued: dropped
+        {"rid": 4, "enqueue_ns": base + 100_000_000, "rows": 0},
+    ]
+    t = from_request_log(records, seed=5, vocab=32)
+    assert len(t.requests) == 3
+    # Sorted by enqueue time, offsets relative to the FIRST record.
+    assert [r.at_ms for r in t.requests] == [0.0, 100.0, 250.0]
+    assert len(t.requests[0].prompt) == 4
+    assert len(t.requests[2].prompt) == 8
+    assert t.requests[0].max_new_tokens == 6
+    assert len(t.requests[1].prompt) >= 2  # rows missing: synthesized
+    assert all(r.phase == "replay" for r in t.requests)
+    # Deterministic like every generator.
+    assert as_dicts(from_request_log(records, seed=5, vocab=32)) == (
+        as_dicts(t)
+    )
+    assert from_request_log([], seed=1).requests == []

@@ -1,0 +1,698 @@
+"""Overload guardrails certification (docs/DESIGN.md §24).
+
+Four layers, cheapest first:
+
+1. **CircuitBreaker state machine** — threshold trips, the single
+   half-open probe claim, jitter bounds + determinism, latency
+   (gray-failure) trips, concurrent failure races.
+2. **OverloadGuard estimator** — EWMA math, warmup admits-all, the
+   empty-queue invariant (PR 4), headroom, brown-out hysteresis.
+3. **Service integration** — MicroBatcher + DecodeScheduler shed with
+   :class:`PredictedMissError` at submit, RequestLog records the
+   predictive shed, brown-out applies only at the drain boundary and
+   caps newly admitted streams.
+4. **Router integration** (stub transports) — rid-preserving retry
+   before first token, breaker open→half-open→closed over live
+   routing, the scrape-cache invalidation regression, and the
+   ``delay_forward_ms`` FaultPlan knob's one-shot contract.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from zookeeper_tpu.core import configure
+from zookeeper_tpu.observability.export import render_prometheus
+from zookeeper_tpu.resilience import FaultPlan
+from zookeeper_tpu.serving import (
+    BrownOut,
+    CircuitBreaker,
+    MicroBatcher,
+    OverloadGuard,
+    PredictedMissError,
+    RejectedError,
+)
+from zookeeper_tpu.serving.decode import DecodeScheduler
+
+from tests.serving.test_decode_engine import build_lm, make_engine
+from tests.serving.test_fleet import make_router
+
+pytestmark = pytest.mark.serving
+
+
+# -- layer 1: the breaker state machine -------------------------------------
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 100.0
+
+    def __call__(self):
+        return self.t
+
+
+def make_breaker(**kw):
+    clock = FakeClock()
+    kw.setdefault("key", "w0")
+    kw.setdefault("failure_threshold", 3)
+    kw.setdefault("cooldown_s", 5.0)
+    kw.setdefault("jitter_frac", 0.5)
+    return CircuitBreaker(clock=clock, **kw), clock
+
+
+def test_breaker_rejects_bad_config():
+    with pytest.raises(ValueError, match="failure_threshold"):
+        CircuitBreaker(failure_threshold=-1)
+    with pytest.raises(ValueError, match="latency_window"):
+        CircuitBreaker(latency_window=0)
+    with pytest.raises(ValueError, match="cooldown_s"):
+        CircuitBreaker(cooldown_s=0)
+
+
+def test_breaker_opens_at_failure_threshold_only():
+    b, _ = make_breaker()
+    b.record_failure()
+    b.record_failure()
+    assert b.state == CircuitBreaker.CLOSED
+    b.record_failure()
+    assert b.state == CircuitBreaker.OPEN
+    assert b.opened_total == 1
+
+
+def test_breaker_success_resets_failure_streak():
+    b, _ = make_breaker()
+    b.record_failure()
+    b.record_failure()
+    b.record_success()
+    b.record_failure()
+    b.record_failure()
+    # 2+2 failures with a success between: streak never reached 3.
+    assert b.state == CircuitBreaker.CLOSED
+
+
+def test_breaker_zero_threshold_never_trips_on_failures():
+    b, _ = make_breaker(failure_threshold=0)
+    for _ in range(20):
+        b.record_failure()
+    assert b.state == CircuitBreaker.CLOSED
+
+
+def test_open_breaker_is_unroutable_until_cooldown():
+    b, clock = make_breaker()
+    for _ in range(3):
+        b.record_failure()
+    assert not b.routable()
+    assert not b.try_probe()  # not due yet
+    clock.t = b.open_until + 0.001
+    assert b.routable()
+
+
+def test_half_open_single_probe_claim():
+    """Exactly ONE caller wins the probe; everyone else keeps waiting
+    until the probe resolves."""
+    b, clock = make_breaker()
+    for _ in range(3):
+        b.record_failure()
+    clock.t = b.open_until + 0.001
+    assert b.try_probe()
+    assert b.state == CircuitBreaker.HALF_OPEN
+    assert not b.try_probe()  # the probe is already in flight
+    assert not b.routable()
+    assert b.probes_total == 1
+
+
+def test_probe_success_closes_probe_failure_reopens():
+    b, clock = make_breaker()
+    for _ in range(3):
+        b.record_failure()
+    clock.t = b.open_until + 0.001
+    assert b.try_probe()
+    b.record_failure()  # probe failed
+    assert b.state == CircuitBreaker.OPEN
+    assert b.opened_total == 2
+    clock.t = b.open_until + 0.001
+    assert b.try_probe()
+    b.record_success()
+    assert b.state == CircuitBreaker.CLOSED
+
+
+def test_jitter_bounds_and_determinism():
+    """Cooldown delay lands in [cooldown, cooldown*(1+jitter)] and is a
+    pure function of (seed, key, open count) — two breakers with the
+    same coordinates open to identical offsets; different keys differ."""
+    delays_a, delays_b, delays_c = [], [], []
+    for delays, key, seed in (
+        (delays_a, "w0", 3),
+        (delays_b, "w0", 3),
+        (delays_c, "w1", 3),
+    ):
+        b, clock = make_breaker(key=key, seed=seed)
+        for _ in range(4):  # four opens: threshold then probe failures
+            if b.state == CircuitBreaker.CLOSED:
+                for _ in range(3):
+                    b.record_failure()
+            else:
+                clock.t = b.open_until + 0.001
+                assert b.try_probe()
+                b.record_failure()
+            delays.append(b.open_until - clock.t)
+    for d in delays_a:
+        assert 5.0 <= d <= 5.0 * 1.5
+    assert delays_a == delays_b  # same coordinates, same jitter
+    assert delays_a != delays_c  # per-replica decorrelation
+    assert len(set(delays_a)) == len(delays_a)  # fresh draw per open
+
+
+def test_zero_jitter_is_exact_cooldown():
+    b, clock = make_breaker(jitter_frac=0.0, cooldown_s=2.0)
+    for _ in range(3):
+        b.record_failure()
+    assert b.open_until - clock.t == pytest.approx(2.0)
+
+
+def test_latency_trip_is_the_gray_failure_path():
+    """A replica answering successfully but slowly trips after
+    latency_window consecutive slow responses — the case a liveness
+    probe cannot see. A fast response resets the slow streak."""
+    b, _ = make_breaker(latency_threshold_ms=50.0, latency_window=3)
+    b.record_success(200.0)
+    b.record_success(200.0)
+    b.record_success(1.0)  # fast: streak resets
+    b.record_success(200.0)
+    b.record_success(200.0)
+    assert b.state == CircuitBreaker.CLOSED
+    b.record_success(200.0)
+    assert b.state == CircuitBreaker.OPEN
+
+
+def test_latency_disabled_by_default():
+    b, _ = make_breaker()
+    for _ in range(10):
+        b.record_success(10_000.0)
+    assert b.state == CircuitBreaker.CLOSED
+
+
+def test_concurrent_failures_trip_exactly_once():
+    """A thundering herd of failures must produce ONE open (one jitter
+    draw, one log line), not one per racing thread."""
+    b, _ = make_breaker(failure_threshold=1)
+    barrier = threading.Barrier(8)
+
+    def slam():
+        barrier.wait()
+        for _ in range(50):
+            b.record_failure()
+
+    threads = [threading.Thread(target=slam) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert b.state == CircuitBreaker.OPEN
+    assert b.opened_total == 1
+
+
+def test_concurrent_probe_claim_single_winner():
+    b, clock = make_breaker()
+    for _ in range(3):
+        b.record_failure()
+    clock.t = b.open_until + 0.001
+    wins = []
+    barrier = threading.Barrier(8)
+
+    def race():
+        barrier.wait()
+        if b.try_probe():
+            wins.append(1)
+
+    threads = [threading.Thread(target=race) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(wins) == 1
+    assert b.probes_total == 1
+
+
+def test_reset_restores_closed_with_clean_streaks():
+    b, _ = make_breaker()
+    for _ in range(3):
+        b.record_failure()
+    b.reset()
+    assert b.state == CircuitBreaker.CLOSED
+    b.record_failure()
+    b.record_failure()
+    assert b.state == CircuitBreaker.CLOSED  # streak restarted from 0
+
+
+# -- layer 2: the OverloadGuard estimator -----------------------------------
+
+
+def make_guard(**conf):
+    conf.setdefault("enabled", True)
+    g = OverloadGuard()
+    configure(g, conf, name="guard")
+    return g.bind()
+
+
+def test_guard_rejects_bad_config():
+    with pytest.raises(ValueError, match="alpha"):
+        make_guard(alpha=0.0)
+    with pytest.raises(ValueError, match="min_samples"):
+        make_guard(min_samples=0)
+    with pytest.raises(ValueError, match="headroom"):
+        make_guard(headroom=0.0)
+
+
+def test_guard_warmup_admits_everything():
+    """Below min_samples the estimator has no opinion — even an absurd
+    queue with a 0.1ms deadline admits."""
+    g = make_guard(min_samples=4)
+    for _ in range(3):
+        g.observe_service(1000.0, 1)
+        ok, predicted = g.admit(
+            queued_units=10_000, request_units=100, deadline_ms=0.1
+        )
+        assert ok and predicted is None
+    g.observe_service(1000.0, 1)  # 4th sample: warmed up
+    ok, predicted = g.admit(
+        queued_units=10_000, request_units=100, deadline_ms=0.1
+    )
+    assert not ok and predicted is not None
+
+
+def test_guard_never_sheds_into_empty_queue():
+    """The PR 4 invariant verbatim: an empty queue always admits one
+    request, however hopeless the estimate says it is."""
+    g = make_guard(min_samples=1)
+    g.observe_service(10_000.0, 1)
+    ok, _ = g.admit(queued_units=0, request_units=50, deadline_ms=0.1)
+    assert ok
+
+
+def test_guard_no_deadline_nothing_to_miss():
+    g = make_guard(min_samples=1)
+    g.observe_service(10_000.0, 1)
+    ok, _ = g.admit(queued_units=100, request_units=50, deadline_ms=None)
+    assert ok
+
+
+def test_guard_ewma_and_prediction_math():
+    """predicted = max(queued*service, wait) + request*service, with
+    both estimators following the standard EWMA recurrence."""
+    g = make_guard(alpha=0.5, min_samples=1)
+    g.observe_service(40.0, 4)  # 10 ms/unit seeds the EWMA
+    g.observe_service(40.0, 2)  # 20 ms/unit -> ewma 15
+    assert g.predicted_ms(4, 2) == pytest.approx(4 * 15 + 2 * 15)
+    # The observed-wait floor catches what queue*service misses.
+    g.observe_wait(500.0)
+    assert g.predicted_ms(4, 2) == pytest.approx(500.0 + 2 * 15)
+    # Shed decision honors headroom.
+    ok, _ = g.admit(queued_units=4, request_units=2, deadline_ms=520.0)
+    assert not ok  # 530 > 520
+    g2 = make_guard(alpha=0.5, min_samples=1, headroom=1.5)
+    g2.observe_service(10.0, 1)
+    g2.observe_wait(500.0)
+    ok, _ = g2.admit(queued_units=4, request_units=2, deadline_ms=520.0)
+    assert ok  # 520 * 1.5 tolerance
+
+
+def test_guard_counters_and_status():
+    g = make_guard(min_samples=1)
+    g.observe_service(100.0, 1)
+    g.admit(queued_units=5, request_units=1, deadline_ms=10.0)   # shed
+    g.admit(queued_units=0, request_units=1, deadline_ms=10.0)   # admit
+    st = g.status()
+    assert st["predicted_miss_total"] == 1
+    assert st["admitted_total"] == 1
+    assert st["warmed_up"]
+    snap = g.snapshot()
+    assert snap["guard_predicted_miss_total"] == 1.0
+    text = render_prometheus([g.registry])
+    assert "zk_guard_predicted_miss_total 1" in text
+    assert "zk_guard_service_ewma_ms" in text
+
+
+def test_brownout_hysteresis():
+    bo = BrownOut(engage_after=3, release_after=2)
+    for _ in range(2):
+        bo.note(shed=True)
+    assert not bo.engaged
+    bo.note(shed=False)  # streak broken
+    for _ in range(3):
+        bo.note(shed=True)
+    assert bo.engaged
+    bo.note(shed=False)
+    assert bo.engaged  # needs release_after in a row
+    bo.note(shed=False)
+    assert not bo.engaged
+    assert bo.engaged_total == 1
+    with pytest.raises(ValueError, match="engage_after"):
+        BrownOut(engage_after=0, release_after=1)
+
+
+def test_guard_brownout_pressure_wiring():
+    g = make_guard(min_samples=1, brownout_after=2, brownout_release=1)
+    g.observe_service(10_000.0, 1)
+    assert not g.brownout_engaged
+    for _ in range(2):
+        g.admit(queued_units=50, request_units=8, deadline_ms=1.0)
+    assert g.brownout_engaged
+    g.admit(queued_units=0, request_units=8, deadline_ms=1.0)
+    assert not g.brownout_engaged
+
+
+# -- layer 3: service integration -------------------------------------------
+
+
+class TinyEngine:
+    """The minimal surface MicroBatcher needs: doubles its input."""
+
+    max_batch = 8
+
+    def bucket_for(self, rows):
+        return self.max_batch
+
+    def infer(self, x):
+        return np.asarray(x) * 2
+
+
+def test_batcher_predicted_miss_shed():
+    """A warmed guard sheds a doomed submit with PredictedMissError
+    (a RejectedError subclass) and records the predictive shed in the
+    RequestLog detail — while an empty queue still admits."""
+    guard = make_guard(min_samples=1)
+    guard.observe_service(5_000.0, 1)  # 5s per row: everything misses
+    b = MicroBatcher()
+    configure(b, dict(synchronous=True), name="batcher")
+    b.bind(TinyEngine(), guard=guard)
+    first = b.submit(np.ones((2, 3)), deadline_ms=50.0)  # empty queue
+    with pytest.raises(PredictedMissError):
+        b.submit(np.ones((2, 3)), deadline_ms=50.0)
+    with pytest.raises(RejectedError):  # the subclass contract
+        b.submit(np.ones((2, 3)), deadline_ms=50.0)
+    rec = b.request_log.tail(1)[0]
+    assert rec["outcome"] == "shed"
+    assert "PredictedMissError" in rec["detail"]
+    assert "predicted_ms=" in rec["detail"]
+    # No deadline: nothing to miss, rides the queue normally.
+    ok = b.submit(np.ones((2, 3)))
+    b.flush()
+    np.testing.assert_array_equal(first.result(), np.ones((2, 3)) * 2)
+    np.testing.assert_array_equal(ok.result(), np.ones((2, 3)) * 2)
+
+
+def test_batcher_feeds_guard_from_completions():
+    guard = make_guard(min_samples=1)
+    b = MicroBatcher()
+    configure(b, dict(synchronous=True), name="batcher")
+    b.bind(TinyEngine(), guard=guard)
+    r = b.submit(np.ones((2, 3)))
+    b.flush()
+    r.result()
+    assert guard.samples >= 1
+    assert guard.status()["service_ewma_ms"] is not None
+
+
+@pytest.fixture(scope="module")
+def lm():
+    return build_lm()
+
+
+@pytest.fixture(scope="module")
+def warm_engine(lm):
+    module, params, state, _ = lm
+    engine = make_engine(module, params, state, slots=3)
+    engine.warmup()
+    return engine
+
+
+def make_guarded_sched(engine, guard, **conf):
+    s = DecodeScheduler()
+    configure(s, dict(conf), name="sched")
+    s.bind(engine, guard=guard)
+    return s
+
+
+def test_scheduler_predicted_miss_shed(warm_engine):
+    guard = make_guard(min_samples=1)
+    guard.observe_service(5_000.0, 1)  # 5s per token
+    sched = make_guarded_sched(warm_engine, guard)
+    p = np.arange(1, 5, dtype=np.int32)
+    first = sched.submit(p, max_new_tokens=2, deadline_ms=50.0)
+    with pytest.raises(PredictedMissError):
+        sched.submit(p, max_new_tokens=2, deadline_ms=50.0)
+    rec = sched.request_log.tail(1)[0]
+    assert rec["outcome"] == "shed"
+    assert "PredictedMissError" in rec["detail"]
+    assert first.result().shape[0] == 2  # the admitted one still runs
+    st = sched.status()
+    assert st["guardrails"]["guard"]["predicted_miss_total"] == 1
+
+
+def test_scheduler_feeds_guard_and_reports_status(warm_engine):
+    guard = make_guard(min_samples=1)
+    sched = make_guarded_sched(warm_engine, guard)
+    sched.generate(np.arange(1, 6, dtype=np.int32), max_new_tokens=3)
+    assert guard.samples >= 1
+    st = sched.status()["guardrails"]
+    assert st["guard"]["warmed_up"]
+    assert st["brownout_active"] is False
+
+
+def test_brownout_caps_new_admissions_at_drain_boundary(warm_engine):
+    """Engage brown-out under pressure, verify (a) the transition
+    applies only when the slot array is empty, (b) newly admitted
+    streams get the capped budget, (c) release restores full budgets."""
+    guard = make_guard(
+        min_samples=1,
+        brownout_after=1,
+        brownout_release=2,
+        brownout_max_new_tokens=2,
+    )
+    guard.observe_service(5_000.0, 1)
+    sched = make_guarded_sched(warm_engine, guard)
+    p = np.arange(1, 5, dtype=np.int32)
+    # One stream admitted into a slot, one riding the queue.
+    inflight = sched.submit(p, max_new_tokens=8)
+    sched._step_once()  # admits inflight into a slot
+    queued = sched.submit(p, max_new_tokens=8)
+    # The predicted-miss shed engages the CONTROLLER (not yet applied).
+    with pytest.raises(PredictedMissError):
+        sched.submit(p, max_new_tokens=8, deadline_ms=1.0)
+    assert guard.brownout_engaged
+    # Slots are occupied: the boundary must NOT flip mid-flight, and
+    # the queued stream is still admitted with its FULL budget.
+    sched._step_once()
+    assert not sched.status()["guardrails"]["brownout_active"]
+    assert inflight.result().shape[0] == 8
+    assert queued.result().shape[0] == 8
+    sched._step_once()  # an idle step observes the drained slot array
+    assert sched.status()["guardrails"]["brownout_active"]
+    # New admissions are capped.
+    capped = sched.submit(p, max_new_tokens=8)
+    assert capped.result().shape[0] == 2
+    assert "zk_guard_brownout_active 1" in render_prometheus(
+        [guard.registry]
+    )
+    # Recovery: sustained non-shed admissions release the controller
+    # (the capped submit above was the first of the release streak);
+    # the boundary follows at the next drained step.
+    guard.admit(queued_units=0, request_units=1, deadline_ms=None)
+    assert not guard.brownout_engaged
+    sched._step_once()
+    assert not sched.status()["guardrails"]["brownout_active"]
+    full = sched.submit(p, max_new_tokens=8)
+    assert full.result().shape[0] == 8
+
+
+# -- layer 4: router integration --------------------------------------------
+
+
+def test_router_retry_reroutes_rid_preserving():
+    """A transport failure before first token retries onto the
+    survivor under the SAME rid, records retried=N in the RequestLog,
+    and counts zk_fleet_retries_total."""
+    router, stub = make_router(2, max_retries=2, retry_backoff_s=0.0)
+    try:
+        r_ok = router.submit([1, 2, 3])
+        stub.dead.add(r_ok.worker_id)  # the load-preferred replica dies
+        r = router.submit([1, 2, 3], rid=777)
+        assert r.rid == 777
+        assert r.worker_id != r_ok.worker_id
+        np.testing.assert_array_equal(r.tokens, [1, 2, 3, 7])
+        rec = router.request_log.find(777)
+        assert rec["outcome"] == "ok"
+        assert "retried=1" in rec["detail"]
+        assert router.retries_total == 1
+        assert router.metrics.snapshot()["fleet_retries_total"] == 1.0
+        assert "zk_fleet_retries_total 1" in render_prometheus(
+            [router.metrics.registry]
+        )
+    finally:
+        router.close()
+
+
+def test_router_retry_exhaustion_still_fails_clean():
+    from zookeeper_tpu.serving import WorkerCrashedError
+
+    router, stub = make_router(2, max_retries=1, retry_backoff_s=0.0)
+    try:
+        stub.dead.update({"w0", "w1"})
+        with pytest.raises(WorkerCrashedError, match="retried=1"):
+            router.submit([1, 2, 3], rid=42)
+        rec = router.request_log.find(42)
+        assert rec["outcome"] == "crashed"
+        assert "retried=1" in rec["detail"]
+    finally:
+        router.close()
+
+
+def test_router_no_retries_by_default():
+    from zookeeper_tpu.serving import WorkerCrashedError
+
+    router, stub = make_router(2)
+    try:
+        stub.dead.update({"w0", "w1"})
+        with pytest.raises(WorkerCrashedError):
+            router.submit([1, 2, 3])
+        assert router.retries_total == 0
+    finally:
+        router.close()
+
+
+def test_router_breaker_gray_failure_cycle():
+    """A slow-but-alive replica trips its breaker via the latency
+    threshold, is excluded from routing while open, serves exactly one
+    half-open probe after the cooldown, and closes on the probe's
+    success — the full open→half-open→closed cycle over live routing,
+    with the state gauge tracking every transition."""
+    clock = FakeClock()
+    router, stub = make_router(
+        2,
+        policy="round_robin",
+        breaker_latency_ms=0.000001,  # every real call counts as slow
+        breaker_latency_window=1,
+        breaker_cooldown_s=5.0,
+        breaker_jitter_frac=0.0,
+        breaker_clock=clock,
+    )
+    try:
+        # Only w0 is "gray": w1's latency trip is disabled so the slow
+        # stub transport (every real call exceeds the 1ns threshold)
+        # trips exactly one replica.
+        router.replicas[1].breaker.latency_threshold_ms = 0.0
+        r = router.submit([1, 2, 3])  # w0: slow success -> breaker opens
+        assert r.worker_id == "w0"
+        b0 = router.replicas[0].breaker
+        assert b0.state == CircuitBreaker.OPEN
+        # While open, round-robin skips w0 entirely — though w0 is
+        # perfectly "healthy" by the liveness probe's lights.
+        assert {router.submit([4, 5, 6]).worker_id for _ in range(3)} == {
+            "w1"
+        }
+        render = render_prometheus([router.metrics.registry])
+        assert 'zk_fleet_breaker_state{replica="w0"} 1' in render
+        # Cooldown elapses: the next submit claims THE half-open probe
+        # on w0, and the probe's success closes the breaker (a probe
+        # resolves on success/failure alone — its latency seeds the
+        # next closed-state window instead of instantly re-tripping).
+        clock.t = b0.open_until + 0.001
+        probe = router.submit([7, 8, 9])
+        assert probe.worker_id == "w0"
+        assert b0.state == CircuitBreaker.CLOSED
+        assert 'zk_fleet_breaker_state{replica="w0"} 0' in (
+            render_prometheus([router.metrics.registry])
+        )
+        status = router.status()["replicas"][0]["breaker"]
+        assert status["state"] == "closed"
+        assert status["opened_total"] == 1
+        assert status["probes_total"] == 1
+        # The gray condition persists: the very next w0 response trips
+        # the breaker again.
+        while router.submit([1, 2, 3]).worker_id != "w0":
+            pass
+        assert b0.state == CircuitBreaker.OPEN
+        assert b0.opened_total == 2
+    finally:
+        router.close()
+
+
+def test_router_open_breaker_reroutes_pinned_session():
+    clock = FakeClock()
+    router, stub = make_router(
+        2,
+        breaker_failures=1,
+        breaker_cooldown_s=5.0,
+        breaker_jitter_frac=0.0,
+        breaker_clock=clock,
+        max_retries=1,
+        retry_backoff_s=0.0,
+    )
+    try:
+        r1 = router.submit([1, 2, 3, 4], session="sA")
+        pinned = r1.worker_id
+        stub.dead.add(pinned)
+        # Transport fails -> breaker opens + replica marked dead; the
+        # retry re-pins the session on the survivor.
+        r2 = router.submit([1, 2, 3, 4, 5], session="sA")
+        assert r2.worker_id != pinned
+        assert router.session_pin("sA") == r2.worker_id
+        assert (
+            router._by_id[pinned].breaker.state == CircuitBreaker.OPEN
+        )
+    finally:
+        router.close()
+
+
+def test_router_all_breakers_open_is_unavailable():
+    from zookeeper_tpu.serving import FleetUnavailableError
+
+    clock = FakeClock()
+    router, stub = make_router(
+        2,
+        breaker_latency_ms=0.000001,
+        breaker_latency_window=1,
+        breaker_cooldown_s=1000.0,
+        breaker_jitter_frac=0.0,
+        breaker_clock=clock,
+        policy="round_robin",
+    )
+    try:
+        router.submit([1, 2, 3])  # opens w0
+        router.submit([1, 2, 3])  # opens w1
+        with pytest.raises(FleetUnavailableError, match="open circuit"):
+            router.submit([1, 2, 3])
+    finally:
+        router.close()
+
+
+def test_scrape_cache_invalidated_on_health_transitions():
+    """The satellite regression: a dead replica's cached load scrape
+    must not survive the health transition (stale flattering numbers
+    would rank the corpse), and a revived replica starts cold."""
+    import time as _time
+
+    router, stub = make_router(2)
+    try:
+        r0 = router.replicas[0]
+        r0._scrape = (_time.monotonic(), 0.0, 99.0)  # flattering cache
+        with router._lock:
+            router._mark_dead(r0)
+        assert r0._scrape is None  # death invalidates
+        r0._scrape = (_time.monotonic(), 0.0, 99.0)  # pre-revival junk
+        router.check_health()  # stub says w0 is alive again
+        assert r0.healthy
+        assert r0._scrape is None  # revival invalidates too
+        assert r0.breaker.state == CircuitBreaker.CLOSED
+    finally:
+        router.close()
+
+
+def test_fault_plan_delay_forward_one_shot():
+    plan = FaultPlan(delay_forward_ms={"w0": 25})
+    assert plan.take_delay_forward("w1") == 0  # not targeted
+    assert plan.take_delay_forward("w0") == 25
+    assert plan.take_delay_forward("w0") == 0  # one-shot: fired
+    assert FaultPlan().take_delay_forward("w0") == 0  # default never fires

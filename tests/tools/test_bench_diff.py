@@ -482,3 +482,51 @@ def test_fleet_keys_gate_with_registered_tolerances():
         assert ok.ok, key
         bad = compare({"metric": "x", key: 1.0 + sign * tol * 1.5}, prev)
         assert not bad.ok and bad.regressions[0]["name"] == key
+
+
+def test_trace_slo_era_keys_classify():
+    """The §24 guardrails A/B keys gate direction-aware: goodput and
+    shed precision higher-better (precision has no suffix family —
+    the explicit _HIGHER entry), the admitted p99 TTFT lower-better;
+    the baseline pass exists to be WORSE under overload, so every
+    ``trace_baseline_*`` key is informational along with the pinned
+    workload shape and outcome tallies."""
+    for key in (
+        "trace_goodput_tokens_per_sec",
+        "trace_shed_precision",
+    ):
+        assert bench_diff.classify_metric(key) == "higher", key
+    assert bench_diff.classify_metric(
+        "trace_admitted_ttft_p99_ms"
+    ) == "lower"
+    for key in (
+        "trace_baseline_goodput_tokens_per_sec",
+        "trace_baseline_admitted_ttft_p99_ms",
+        "trace_baseline_deadline_expired",
+        "trace_baseline_ok",
+        "trace_requests",
+        "trace_deadline_ms",
+        "trace_shed_total",
+        "trace_ok_total",
+        "trace_deadline_expired",
+    ):
+        assert bench_diff.classify_metric(key) is None, key
+
+
+def test_trace_slo_keys_gate_with_registered_tolerances():
+    from tools.bench_diff import TOLERANCES, compare
+
+    for key, direction in (
+        ("trace_goodput_tokens_per_sec", "higher"),
+        ("trace_shed_precision", "higher"),
+        ("trace_admitted_ttft_p99_ms", "lower"),
+    ):
+        tol = TOLERANCES[key]
+        sign = -1.0 if direction == "higher" else 1.0
+        prev = {"metric": "x", key: 1.0}
+        ok = compare({"metric": "x", key: 1.0 + sign * tol * 0.9}, prev)
+        assert ok.ok, key
+        # 1.2x tolerance keeps the bad value positive even for the
+        # loose precision tolerance (a sign flip reads as drift).
+        bad = compare({"metric": "x", key: 1.0 + sign * tol * 1.2}, prev)
+        assert not bad.ok and bad.regressions[0]["name"] == key

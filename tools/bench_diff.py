@@ -127,6 +127,15 @@ TOLERANCES = {
     "fleet_cold_ttft_p50_ms": 0.40,
     "fleet_affinity_ttft_speedup": 0.35,
     "fleet_route_ms_p50": 0.50,
+    # Trace-SLO guardrails era (docs/DESIGN.md §24): goodput is an
+    # open-loop wall-clock ratio over a threaded replay (the decode
+    # leg's jitter class, plus scheduler-thread scatter); the admitted
+    # p99 TTFT is a tail over a burst cohort whose membership itself
+    # shifts with admission timing; shed precision divides two small
+    # timing-dependent counts, so it scatters the most.
+    "trace_goodput_tokens_per_sec": 0.35,
+    "trace_admitted_ttft_p99_ms": 0.60,
+    "trace_shed_precision": 0.75,
 }
 
 #: HIGHER-better metric name patterns (throughput family). MBU joins
@@ -137,6 +146,9 @@ _HIGHER = re.compile(
     # Acceptance is the one _rate$ where UP is good (the generic _rate$
     # family — shed rate etc. — is lower-better); checked before _LOWER.
     r"|^spec_acceptance_rate$"
+    # §24 shed precision: UP means sheds hit the doomed, not the
+    # viable — no suffix family matches it, so it is named explicitly.
+    r"|^trace_shed_precision$"
     r"|tokens_per_sec|images_per_sec|steps_overlapped)"
 )
 
@@ -195,6 +207,13 @@ _INFORMATIONAL = re.compile(
     r"|^fleet_replicas$|^fleet_sessions$|^fleet_turns$"
     r"|^fleet_shared_tokens$|^fleet_tail_tokens$|^fleet_new_tokens$"
     r"|^fleet_affinity_hit_rate$|^fleet_generated_tokens$"
+    # Trace-SLO-leg baseline + workload shape: the guardrails-OFF pass
+    # exists to contextualize the gated guardrails-on numbers (its
+    # whole point is to be worse under overload), and request/outcome
+    # tallies are determined by the pinned trace — none is a perf
+    # direction of the code under test.
+    r"|^trace_baseline_|^trace_requests$|^trace_deadline_ms$"
+    r"|^trace_shed_total$|^trace_ok_total$|^trace_deadline_expired$"
     # Peak ANCHORS and model FLOP counts are measurement context, not
     # code performance: an anchor that moved (re-measured peak, fixed
     # cache pathology — BENCH_r04's 237.9 TF/s) or a FLOPs change (a

@@ -1,0 +1,45 @@
+"""Trace-driven load generation + replay harness (docs/DESIGN.md §24).
+
+The scenario-diversity engine the overload guardrails are judged
+against: ``traces.py`` generates deterministic seed-keyed arrival
+traces (Poisson bursts, diurnal ramps, heavy-tailed prompt/output
+lengths, shared-prefix multi-turn session mixes) and converts recorded
+``RequestLog`` JSON back into replayable traces; ``harness.py`` replays
+a trace against any serving target (a :class:`MicroBatcher` stack, an
+``LMServingConfig`` decode scheduler, a :class:`FleetRouter` over real
+worker processes), optionally composed with a ``FaultPlan`` chaos leg,
+and emits a structured :class:`SLOReport` — per-phase latency/TTFT
+percentiles, goodput, terminal outcome counts, SLO violations.
+
+Determinism contract: every sampled quantity in a trace derives from
+``AugRng(seed, request_index, FIELD_STREAM)`` — the splitmix64 counter
+discipline the data pipeline uses. No wall-clock reads happen during
+generation; two calls with the same seed produce byte-identical traces
+on any host.
+"""
+
+from zookeeper_tpu.loadgen.harness import (
+    ReplayOutcome,
+    SLOReport,
+    replay,
+)
+from zookeeper_tpu.loadgen.traces import (
+    Trace,
+    TraceRequest,
+    diurnal_ramp,
+    from_request_log,
+    poisson_burst,
+    session_mix,
+)
+
+__all__ = [
+    "ReplayOutcome",
+    "SLOReport",
+    "Trace",
+    "TraceRequest",
+    "diurnal_ramp",
+    "from_request_log",
+    "poisson_burst",
+    "replay",
+    "session_mix",
+]

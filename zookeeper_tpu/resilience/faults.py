@@ -180,6 +180,15 @@ class FaultPlan:
       restart itself, exactly like ``kill_process_at_step``'s group
       supervisor) tears the router down and rebuilds it from its
       persisted ``state_path``; session pins must survive the rebuild.
+    - ``delay_forward_ms``: ``{worker_id: ms}`` — GRAY failure: the
+      named replica's next forward path stalls for ``ms`` instead of
+      dying (one-shot per worker key, coordinate-keyed like
+      ``kill_process_at_step``). The worker stays alive and its
+      ``/healthz`` keeps passing — this is exactly the case that
+      distinguishes the router's latency-tripped circuit breaker
+      (docs/DESIGN.md §24) from the liveness probe, which can never
+      see a replica that answers probes instantly while poisoning
+      every real request.
     """
 
     kill_at_step: Optional[int] = None
@@ -192,6 +201,7 @@ class FaultPlan:
     fail_page_transfer: int = 0
     fleet_replica_kill_at: Optional[int] = None
     fleet_router_restart_at: Optional[int] = None
+    delay_forward_ms: Optional[Dict[str, int]] = None
     fail_async_finalize: int = 0
     kill_during_async_write: Optional[int] = None
     kill_process_at_step: Optional[Dict[int, int]] = None
@@ -218,6 +228,9 @@ class FaultPlan:
     _fleet_restart_seen: int = field(default=0, repr=False, compare=False)
     _fleet_router_restarted: bool = field(
         default=False, repr=False, compare=False
+    )
+    _delay_forward_fired: Dict[str, bool] = field(
+        default_factory=dict, repr=False, compare=False
     )
 
     # -- trigger points (called by the production hooks) -----------------
@@ -355,6 +368,24 @@ class FaultPlan:
                 _injection_event("fleet_router_restart_at")
                 return True
         return False
+
+    def take_delay_forward(self, worker_id: str) -> int:
+        """One-shot per worker key: the injected forward-path stall in
+        ms for ``worker_id`` (0 = not targeted / already fired). The
+        caller SLEEPS for the returned duration inside its forward
+        path — latency, not death: liveness probing stays green while
+        the request-path latency the circuit breaker watches spikes."""
+        if not self.delay_forward_ms:
+            return 0
+        ms = self.delay_forward_ms.get(str(worker_id))
+        if ms is None:
+            return 0
+        with self._lock:
+            if self._delay_forward_fired.get(str(worker_id)):
+                return 0
+            self._delay_forward_fired[str(worker_id)] = True
+        _injection_event("delay_forward_ms")
+        return int(ms)
 
     def take_fail_page_transfer(self) -> bool:
         """Consume one injected page-transfer failure (False when

@@ -50,6 +50,17 @@ per-step cost — many requests ride one compiled program.
   pinning, load fallback from live ``/metrics``, health-probed
   replicas with clean :class:`WorkerCrashedError` failure + cold
   re-route, and cross-process rid propagation (docs/DESIGN.md §23).
+- ``zookeeper_tpu.serving.guardrails``: overload defenses —
+  :class:`OverloadGuard` predicted-miss admission (EWMA queue-wait +
+  per-token service estimate vs each request's deadline ⇒
+  :class:`PredictedMissError` at submit instead of serving a request
+  late), per-replica :class:`CircuitBreaker` in the fleet router
+  (closed→open→half-open jittered probe→closed; slow-but-alive
+  replicas excluded from routing), bounded rid-preserving retry of
+  requests that fail before first token, and :class:`BrownOut`
+  degraded mode applied only at the drained-slot-array boundary
+  (docs/DESIGN.md §24). Judged by the ``zookeeper_tpu.loadgen``
+  trace-replay harness.
 """
 
 from zookeeper_tpu.serving.batcher import (
@@ -82,11 +93,19 @@ from zookeeper_tpu.serving.fleet import (
     FleetUnavailableError,
     ReplicaHandle,
 )
+from zookeeper_tpu.serving.guardrails import (
+    BrownOut,
+    CircuitBreaker,
+    OverloadGuard,
+    PredictedMissError,
+)
 from zookeeper_tpu.serving.metrics import ServingMetrics
 from zookeeper_tpu.serving.service import ServingConfig
 
 __all__ = [
+    "BrownOut",
     "CheckpointWatcher",
+    "CircuitBreaker",
     "DeadlineExpiredError",
     "DecodeEngine",
     "DecodeMetrics",
@@ -104,7 +123,9 @@ __all__ = [
     "PageTransferError",
     "LMServingConfig",
     "MicroBatcher",
+    "OverloadGuard",
     "PendingResult",
+    "PredictedMissError",
     "RejectedError",
     "ReplicaHandle",
     "ServingConfig",

@@ -263,7 +263,9 @@ class MicroBatcher:
 
     # -- wiring ----------------------------------------------------------
 
-    def bind(self, engine, metrics=None, request_log=None) -> "MicroBatcher":
+    def bind(
+        self, engine, metrics=None, request_log=None, guard=None
+    ) -> "MicroBatcher":
         if self.max_queue_rows < 1:
             raise ValueError(
                 f"max_queue_rows={self.max_queue_rows} must be >= 1."
@@ -280,6 +282,9 @@ class MicroBatcher:
             )
         object.__setattr__(self, "_engine", engine)
         object.__setattr__(self, "_metrics", metrics)
+        # Optional OverloadGuard (docs/DESIGN.md §24): predictive
+        # admission on TOP of the static shed_above_rows threshold.
+        object.__setattr__(self, "_guard", guard)
         # Per-service terminal-request ring (docs/DESIGN.md §16): one
         # compact summary per request that reached an outcome, exposed
         # at /statusz and dumped into flight-recorder bundles.
@@ -345,6 +350,25 @@ class MicroBatcher:
             )
         if self._metrics is not None and req._error is None:
             self._metrics.record_request(latency_ms, req._rows)
+        guard = getattr(self, "_guard", None)
+        if (
+            guard is not None
+            and guard.enabled
+            and req._error is None
+            and req._t_dispatch_ns is not None
+        ):
+            # Feed the admission estimator from OBSERVED outcomes:
+            # service = dispatch→complete per row, wait = submit→
+            # dispatch. Only successes — a crashed/expired request's
+            # timings would teach the EWMA the failure mode, not the
+            # service rate.
+            now_ns = time.perf_counter_ns()
+            guard.observe_service(
+                (now_ns - req._t_dispatch_ns) / 1e6, max(1, req._rows)
+            )
+            guard.observe_wait(
+                (req._t_dispatch_ns - req._t_submit * 1e9) / 1e6
+            )
 
     def _record_deadline_expired(self) -> None:
         _trace.event("request_deadline_expired")
@@ -406,6 +430,61 @@ class MicroBatcher:
                 "request shed (service overloaded, retry with backoff)."
             )
 
+    def _guard_check(
+        self, n: int, rid: int, deadline_at: Optional[float]
+    ) -> None:
+        """Predicted-miss admission (docs/DESIGN.md §24): shed when the
+        guard's EWMA-based completion estimate says this request cannot
+        meet its deadline given the CURRENT queue. Runs after the static
+        row-count check; same empty-queue invariant (the guard never
+        sheds when nothing is queued ahead). Caller holds the lock in
+        async mode."""
+        guard = getattr(self, "_guard", None)
+        if guard is None or not guard.enabled:
+            return
+        # Deferred: guardrails imports RejectedError from this module.
+        from zookeeper_tpu.serving.guardrails import PredictedMissError
+        deadline_ms = (
+            (deadline_at - time.perf_counter()) * 1e3
+            if deadline_at is not None
+            else None
+        )
+        ok, predicted = guard.admit(
+            queued_units=self._queue_rows,
+            request_units=n,
+            deadline_ms=deadline_ms,
+        )
+        if ok:
+            return
+        if self._metrics is not None:
+            self._metrics.record_rejected()
+        if _trace.enabled():
+            _trace.event(
+                "request_shed",
+                rid=rid,
+                attrs={
+                    "rows": n,
+                    "queue_rows": self._queue_rows,
+                    "reason": "predicted_miss",
+                    "predicted_ms": round(predicted, 3),
+                },
+            )
+        now_ns = time.perf_counter_ns()
+        self._request_log.append(
+            rid,
+            "shed",
+            enqueue_ns=now_ns,
+            complete_ns=now_ns,
+            rows=n,
+            weights_step=self._weights_step(),
+            detail=f"PredictedMissError predicted_ms={predicted:.1f}",
+        )
+        raise PredictedMissError(
+            f"predicted completion in {predicted:.1f}ms exceeds the "
+            f"{deadline_ms:.1f}ms deadline with {self._queue_rows} rows "
+            "queued — shed at admission rather than served late."
+        )
+
     def submit(
         self, x: Array, *, deadline_ms: Optional[float] = None
     ) -> PendingResult:
@@ -433,6 +512,7 @@ class MicroBatcher:
         rid = next_rid()
         if self.synchronous:
             self._shed_check(n, rid)
+            self._guard_check(n, rid, deadline_at)
             if self._queue and self._queue_rows + n > self.max_queue_rows:
                 self.flush()  # backpressure: drain the backlog inline
             req = PendingResult(
@@ -455,6 +535,7 @@ class MicroBatcher:
         )
         with self._cv:
             self._shed_check(n, rid)
+            self._guard_check(n, rid, deadline_at)
             while (
                 self._queue
                 and self._queue_rows + n > self.max_queue_rows

@@ -570,6 +570,10 @@ class PagePool:
             "fill": round(self.used_pages / self.num_pages, 4),
             "cow_pages": self.cow_pages,
             "exhausted_events": self.exhausted_events,
+            # Stranded pages (must be 0): exposed here so the chaos
+            # certification can assert leak-freedom over /statusz on a
+            # live worker process, not just in-process.
+            "leaked": self.leak_check(),
         }
         if self.prefix is not None:
             out.update(

@@ -291,7 +291,12 @@ class DecodeScheduler:
     # -- wiring ----------------------------------------------------------
 
     def bind(
-        self, engine, metrics=None, request_log=None, speculative=None
+        self,
+        engine,
+        metrics=None,
+        request_log=None,
+        speculative=None,
+        guard=None,
     ) -> "DecodeScheduler":
         if self.max_new_tokens < 1:
             raise ValueError(
@@ -323,6 +328,12 @@ class DecodeScheduler:
                     "config to the SAME DecodeEngine."
                 )
         object.__setattr__(self, "_speculative", speculative)
+        # Optional OverloadGuard (docs/DESIGN.md §24): predicted-miss
+        # admission + brown-out. _brownout_active is the scheduler's
+        # APPLIED state — it only tracks guard.brownout_engaged at the
+        # drain boundary (_maybe_apply_brownout), never mid-batch.
+        object.__setattr__(self, "_guard", guard)
+        object.__setattr__(self, "_brownout_active", False)
         n = int(engine.slots)
         object.__setattr__(self, "_queue", deque())
         object.__setattr__(self, "_slot_stream", [None] * n)
@@ -376,12 +387,13 @@ class DecodeScheduler:
                 rid=stream.rid,
                 attrs={"outcome": outcome, "detail": detail},
             )
+        complete_ns = time.perf_counter_ns()
         log.append(
             stream.rid,
             outcome,
             enqueue_ns=int(stream._t_submit * 1e9),
             dispatch_ns=stream._t_dispatch_ns,
-            complete_ns=time.perf_counter_ns(),
+            complete_ns=complete_ns,
             tokens=len(stream._tokens),
             slot=stream._slot,
             weights_step=(
@@ -392,6 +404,25 @@ class DecodeScheduler:
             detail=detail,
             role=stream._role or None,
         )
+        guard = getattr(self, "_guard", None)
+        if (
+            guard is not None
+            and guard.enabled
+            and outcome == "ok"
+            and stream._t_dispatch_ns is not None
+        ):
+            # Feed the admission estimator from observed successes:
+            # service = dispatch→complete per generated token, wait =
+            # submit→dispatch. Failures are excluded — their timings
+            # describe the failure mode, not the service rate.
+            dispatch_ns = stream._t_dispatch_ns
+            guard.observe_service(
+                (complete_ns - dispatch_ns) / 1e6,
+                max(1, len(stream._tokens)),
+            )
+            guard.observe_wait(
+                (dispatch_ns - stream._t_submit * 1e9) / 1e6
+            )
 
     # -- submission ------------------------------------------------------
 
@@ -490,6 +521,7 @@ class DecodeScheduler:
                     f"{self.shed_above} — request shed (service "
                     "overloaded, retry with backoff)."
                 )
+            self._guard_check(stream, new)
             backpressure = len(self._queue) + 1 > self.max_queue
             if not backpressure:
                 self._queue.append(stream)
@@ -528,6 +560,55 @@ class DecodeScheduler:
             with self._cv:
                 self._cv.notify_all()
         return stream
+
+    def _guard_check(self, stream: DecodeStream, new: int) -> None:
+        """Predicted-miss admission (docs/DESIGN.md §24): shed at
+        submit when the guard's EWMA completion estimate says this
+        stream cannot meet its deadline behind the CURRENT queue.
+        Queued work is measured in tokens-still-owed (each queued
+        stream's max_new budget), the unit the per-token service EWMA
+        speaks. Caller holds the lock; same empty-queue invariant as
+        the static check."""
+        guard = getattr(self, "_guard", None)
+        if guard is None or not guard.enabled:
+            return
+        from zookeeper_tpu.serving.guardrails import PredictedMissError
+
+        deadline_ms = (
+            (stream._deadline_at - time.perf_counter()) * 1e3
+            if stream._deadline_at is not None
+            else None
+        )
+        queued_tokens = sum(s._max_new for s in self._queue)
+        ok, predicted = guard.admit(
+            queued_units=queued_tokens,
+            request_units=new,
+            deadline_ms=deadline_ms,
+        )
+        if ok:
+            return
+        if self._metrics is not None:
+            self._metrics.record_rejected()
+        if _trace.enabled():
+            _trace.event(
+                "decode_request_shed",
+                rid=stream.rid,
+                attrs={
+                    "queue_depth": len(self._queue),
+                    "reason": "predicted_miss",
+                    "predicted_ms": round(predicted, 3),
+                },
+            )
+        self._log_terminal(
+            stream,
+            "shed",
+            detail=f"PredictedMissError predicted_ms={predicted:.1f}",
+        )
+        raise PredictedMissError(
+            f"predicted completion in {predicted:.1f}ms exceeds the "
+            f"{deadline_ms:.1f}ms deadline with {queued_tokens} tokens "
+            "queued ahead — shed at admission rather than served late."
+        )
 
     def generate(self, prompt: Any, **kwargs) -> np.ndarray:
         """Submit + block for the full generation — the one-call API
@@ -588,6 +669,45 @@ class DecodeScheduler:
             "recompile)",
             f" to training step {step}" if step is not None else "",
         )
+
+    def _maybe_apply_brownout(self) -> None:
+        """Track the guard's brown-out intent at the SAME safe boundary
+        as a staged weight swap: the state flips only when the slot
+        array is empty, so no in-flight stream ever sees its token
+        budget rewritten or its speculation config change mid-sequence
+        (docs/DESIGN.md §24). Loudly logged both ways; auto-recovering
+        — the guard disengages on its own once admissions stop
+        predicting misses. Caller holds ``_lock``."""
+        guard = getattr(self, "_guard", None)
+        if guard is None or not guard.enabled:
+            return
+        want = bool(guard.brownout_engaged)
+        if want == self._brownout_active:
+            return
+        if any(s is not None for s in self._slot_stream):
+            return  # in-flight sequences finish under the old posture
+        object.__setattr__(self, "_brownout_active", want)
+        guard.record_brownout_applied(want)
+        _trace.event(
+            "decode_brownout",
+            attrs={
+                "engaged": want,
+                "max_new_tokens_cap": int(guard.brownout_max_new_tokens),
+            },
+        )
+        if want:
+            logger.warning(
+                "BROWN-OUT ENGAGED: decode degrading — max_new_tokens "
+                "capped at %d, speculation disabled for newly admitted "
+                "streams (sustained predicted-miss pressure; "
+                "auto-recovers when admissions stop shedding).",
+                int(guard.brownout_max_new_tokens),
+            )
+        else:
+            logger.warning(
+                "brown-out released: decode back to full token budgets "
+                "and speculation."
+            )
 
     # -- the scheduling loop ---------------------------------------------
 
@@ -683,6 +803,15 @@ class DecodeScheduler:
                         if stream._expire() and self._metrics is not None:
                             self._metrics.record_deadline_expired()
                         continue
+                    if self._brownout_active:
+                        # Brown-out: every stream admitted while
+                        # engaged gets a capped token budget. Applied
+                        # at ADMISSION only — in-flight budgets are
+                        # never rewritten (docs/DESIGN.md §24).
+                        stream._max_new = min(
+                            stream._max_new,
+                            int(self._guard.brownout_max_new_tokens),
+                        )
                     group.append(stream)
                     slots.append(free[len(group) - 1])
                 if not group:
@@ -862,10 +991,19 @@ class DecodeScheduler:
                     i for i, s in enumerate(self._slot_stream)
                     if s is not None
                 ]
-                eligible = bool(active) and all(
-                    int(self._slot_lengths[i]) + spec.window
-                    <= self._engine.token_limit
-                    for i in active
+                eligible = (
+                    bool(active)
+                    # Brown-out skips the speculative window but keeps
+                    # ``spec`` bound below: the plain path's width-2
+                    # draft catch-up still runs, so the draft KV cache
+                    # stays in sync and speculation resumes cleanly
+                    # when the brown-out releases (docs/DESIGN.md §24).
+                    and not self._brownout_active
+                    and all(
+                        int(self._slot_lengths[i]) + spec.window
+                        <= self._engine.token_limit
+                        for i in active
+                    )
                 )
             if not active:
                 return
@@ -1147,12 +1285,14 @@ class DecodeScheduler:
                         "(FaultPlan.decode_worker_crash)"
                     )
                 self._maybe_apply_swap()
+                self._maybe_apply_brownout()
                 self._expire_queued()
                 self._expire_active()
             self._admit()
             self._decode()
             with self._lock:
                 self._maybe_apply_swap()  # slot array may have drained
+                self._maybe_apply_brownout()
                 self._update_occupancy()
         # Wake backpressured submitters and drain()/iterator waiters:
         # queue room and stream progress both change per iteration.
@@ -1424,4 +1564,17 @@ class DecodeScheduler:
                     if getattr(self, "_speculative", None) is not None
                     else {"enabled": False}
                 ),
+                # Overload guardrails (docs/DESIGN.md §24): admission
+                # estimator state + the scheduler's APPLIED brown-out
+                # posture (may lag the guard's intent by one drain).
+                "guardrails": {
+                    "guard": (
+                        self._guard.status()
+                        if getattr(self, "_guard", None) is not None
+                        else {"enabled": False}
+                    ),
+                    "brownout_active": bool(
+                        getattr(self, "_brownout_active", False)
+                    ),
+                },
             }

@@ -35,6 +35,7 @@ from zookeeper_tpu.serving.decode.engine import DecodeEngine
 from zookeeper_tpu.serving.decode.metrics import DecodeMetrics
 from zookeeper_tpu.serving.decode.scheduler import DecodeScheduler
 from zookeeper_tpu.serving.decode.speculative import SpeculativeDecoding
+from zookeeper_tpu.serving.guardrails import OverloadGuard
 from zookeeper_tpu.training.experiment import Experiment
 from zookeeper_tpu.training.metrics import CompositeMetricsWriter, MetricsWriter
 
@@ -65,6 +66,15 @@ class LMServingConfig(Experiment):
     #: incompatible geometry) degrades LOUDLY to plain decode rather
     #: than failing the service.
     speculative: SpeculativeDecoding = ComponentField(SpeculativeDecoding)
+    #: Overload guardrails (docs/DESIGN.md §24): ``guard.enabled=True``
+    #: turns on predicted-miss admission (EWMA queue-wait + per-token
+    #: service estimate vs each request's deadline ⇒ shed at submit
+    #: with :class:`PredictedMissError`) and, with ``guard.
+    #: brownout_after>0``, the brown-out degraded mode (capped
+    #: ``max_new_tokens`` + speculation off, applied only at the
+    #: drained-slot-array boundary). Off by default — zero behavior
+    #: change unless asked for.
+    guard: OverloadGuard = ComponentField(OverloadGuard)
 
     #: Deployment artifact: a ``save_model`` export or a full
     #: ``Checkpointer`` directory (latest step). None = fresh-init
@@ -122,8 +132,12 @@ class LMServingConfig(Experiment):
         if self.warmup:
             self.engine.warmup()
         spec = self._resolve_speculative()
+        self.guard.bind()
         self.scheduler.bind(
-            self.engine, metrics=self.metrics, speculative=spec
+            self.engine,
+            metrics=self.metrics,
+            speculative=spec,
+            guard=self.guard if self.guard.enabled else None,
         )
         if self.metrics_port >= 0 or self.flight_recorder_dir:
             try:
@@ -243,6 +257,7 @@ class LMServingConfig(Experiment):
         return {
             "decode": self.scheduler.status,
             "requests": self._request_log_status,
+            "guardrails": self.guard.status,
         }
 
     def _start_flight_recorder(self):
@@ -251,7 +266,11 @@ class LMServingConfig(Experiment):
 
         rec = _recorder.arm(
             self.flight_recorder_dir,
-            registries=[default_registry(), self.metrics.registry],
+            registries=[
+                default_registry(),
+                self.metrics.registry,
+                self.guard.registry,
+            ],
             status_providers=self._status_providers(),
             request_logs={"decode": self.scheduler.request_log},
             min_interval_s=self.flight_recorder_interval_s,
@@ -280,7 +299,11 @@ class LMServingConfig(Experiment):
         from zookeeper_tpu.observability.registry import default_registry
 
         server = ObservabilityServer(
-            [default_registry(), self.metrics.registry],
+            [
+                default_registry(),
+                self.metrics.registry,
+                self.guard.registry,
+            ],
             port=self.metrics_port,
             status_providers=self._status_providers(),
         )

@@ -761,7 +761,7 @@ class CheckpointWatcher:
             # A CONFIGURATION bug (weights="ema" on an EMA-less run,
             # structure validation): silently retrying would pin
             # serving to stale weights while hiding it. Stop loudly.
-            self._stop.set()
+            self._record_fatal_stop()
             raise
         except Exception as e:
             logger.warning(
@@ -776,7 +776,7 @@ class CheckpointWatcher:
         except ValueError:
             # Shape/structure mismatch against the compiled buckets:
             # configuration bug, never weather. Stop loudly.
-            self._stop.set()
+            self._record_fatal_stop()
             raise
         swap_ms = (time.perf_counter() - t0) * 1e3
         self._current_step = newest
@@ -796,6 +796,16 @@ class CheckpointWatcher:
         )
         return newest
 
+    def _record_fatal_stop(self) -> None:
+        """Kill the watcher over a configuration error. The metric
+        lands BEFORE the stop flag flips ``alive``: anyone who observes
+        the watcher dead must already see ``watcher_stopped`` counted —
+        the staleness gauge must be distinguishable from "up to date"
+        the moment it matters."""
+        if self._metrics is not None:
+            self._metrics.record_watcher_stopped()
+        self._stop.set()
+
     def start(self) -> "CheckpointWatcher":
         if self._thread is not None and self._thread.is_alive():
             return self
@@ -809,11 +819,12 @@ class CheckpointWatcher:
                     logger.error(
                         "checkpoint watcher stopped: %s", e
                     )
-                    self._stop.set()
-                    if self._metrics is not None:
-                        # The staleness gauge must be distinguishable
-                        # from "up to date": a dead watcher counts.
-                        self._metrics.record_watcher_stopped()
+                    # Fatal paths inside poll_once already counted
+                    # watcher_stopped; anything else dies here and
+                    # counts now, metric-before-flag for the same
+                    # observability ordering.
+                    if not self._stop.is_set():
+                        self._record_fatal_stop()
                     return
                 self._stop.wait(self._poll_interval_s)
 

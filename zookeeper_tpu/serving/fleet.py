@@ -65,6 +65,7 @@ from zookeeper_tpu.observability.registry import (
 from zookeeper_tpu.observability.requests import RequestLog, next_rid
 from zookeeper_tpu.serving.batcher import WorkerCrashedError
 from zookeeper_tpu.serving.decode.prefix_key import PrefixIndex
+from zookeeper_tpu.serving.guardrails import CircuitBreaker
 
 logger = logging.getLogger(__name__)
 
@@ -143,7 +144,12 @@ class ReplicaHandle:
         self.outstanding = 0
         self.routed_total = 0
         self.index: Optional[PrefixIndex] = None  # attached by router
+        #: Per-replica circuit breaker (attached by router; None until
+        #: then — docs/DESIGN.md §24).
+        self.breaker: Optional[CircuitBreaker] = None
         # Last /metrics scrape: (monotonic ts, queue_depth, free_pages).
+        # Invalidated on every health-state TRANSITION so routing never
+        # prefers a corpse (or a cold revival) on cached numbers.
         self._scrape: Optional[tuple] = None
 
     @classmethod
@@ -209,6 +215,7 @@ class FleetMetrics:
         self._routed: Dict[str, Any] = {}
         self._affinity: Dict[str, Any] = {}
         self._healthy: Dict[str, Any] = {}
+        self._breaker: Dict[str, Any] = {}
         self._rerouted = self.registry.counter(
             "zk_fleet_rerouted_total",
             help="sessions re-routed cold off a dead replica",
@@ -216,6 +223,11 @@ class FleetMetrics:
         self._crashes = self.registry.counter(
             "zk_fleet_worker_crashes_total",
             help="requests failed by a replica death mid-flight",
+        )
+        self._retries = self.registry.counter(
+            "zk_fleet_retries_total",
+            help="rid-preserving re-routes of requests that failed "
+            "before their first token",
         )
         self._replicas = self.registry.gauge(
             "zk_fleet_replicas", help="configured replicas"
@@ -271,6 +283,19 @@ class FleetMetrics:
     def record_worker_crash(self) -> None:
         self._crashes.inc()
 
+    def record_retry(self) -> None:
+        self._retries.inc()
+
+    def record_breaker_state(self, worker_id: str, code: float) -> None:
+        """Per-replica breaker gauge: 0 closed, 0.5 half-open, 1 open."""
+        self._per_replica(
+            self._breaker,
+            "zk_fleet_breaker_state",
+            "circuit breaker state (0 closed, 0.5 half-open, 1 open)",
+            worker_id,
+            cls="gauge",
+        ).set(float(code))
+
     def record_health(self, worker_id: str, healthy: bool) -> None:
         self._per_replica(
             self._healthy,
@@ -290,6 +315,7 @@ class FleetMetrics:
         out: Dict[str, float] = {
             "fleet_rerouted_total": self._rerouted.value,
             "fleet_worker_crashes_total": self._crashes.value,
+            "fleet_retries_total": self._retries.value,
         }
         for wid, inst in self._routed.items():
             out[f"fleet_routed_total_{wid}"] = inst.value
@@ -325,6 +351,16 @@ class FleetRouter:
         transport: Optional[Callable[..., Dict[str, Any]]] = None,
         health_probe: Optional[Callable[..., bool]] = None,
         kill_replica: Optional[Callable[[ReplicaHandle], None]] = None,
+        max_retries: int = 0,
+        retry_backoff_s: float = 0.05,
+        breaker_failures: int = 3,
+        breaker_latency_ms: float = 0.0,
+        breaker_latency_window: int = 3,
+        breaker_cooldown_s: float = 5.0,
+        breaker_jitter_frac: float = 0.5,
+        breaker_seed: int = 0,
+        breaker_clock: Optional[Callable[[], float]] = None,
+        sleep: Optional[Callable[[float], None]] = None,
     ) -> None:
         if policy not in ("affinity", "round_robin"):
             raise ValueError(
@@ -346,17 +382,39 @@ class FleetRouter:
         self._transport = transport or _http_transport
         self._health_probe = health_probe or _http_health
         self._kill_replica_hook = kill_replica or _default_kill
+        if max_retries < 0 or retry_backoff_s < 0:
+            raise ValueError(
+                f"max_retries={max_retries} and retry_backoff_s="
+                f"{retry_backoff_s} must be >= 0."
+            )
+        self.max_retries = int(max_retries)
+        self.retry_backoff_s = float(retry_backoff_s)
+        self._sleep = sleep or time.sleep
         self.request_log = RequestLog("fleet")
         self._lock = threading.RLock()
         self._by_id = {r.worker_id: r for r in self.replicas}
         for r in self.replicas:
             r.index = PrefixIndex(self.page_size)
+            # One breaker per replica (docs/DESIGN.md §24);
+            # breaker_failures=0 + breaker_latency_ms=0 leaves it
+            # permanently closed (trip conditions disabled).
+            r.breaker = CircuitBreaker(
+                key=r.worker_id,
+                failure_threshold=breaker_failures,
+                latency_threshold_ms=breaker_latency_ms,
+                latency_window=breaker_latency_window,
+                cooldown_s=breaker_cooldown_s,
+                jitter_frac=breaker_jitter_frac,
+                seed=breaker_seed,
+                clock=breaker_clock,
+            )
         #: session -> worker_id pins (the continuity contract).
         self._sessions: Dict[str, str] = {}
         self._rr_next = 0
         self.routed_total = 0
         self.affinity_hits_total = 0
         self.rerouted_total = 0
+        self.retries_total = 0
         self._obs_server = None
         self._health_thread: Optional[threading.Thread] = None
         self._health_stop = threading.Event()
@@ -421,9 +479,18 @@ class FleetRouter:
                     self._mark_dead(r)
                 elif ok and not r.healthy:
                     # A replica that comes BACK (restarted worker) is
-                    # cold: serve it again, predict nothing warm.
+                    # cold: serve it again, predict nothing warm. The
+                    # pre-death scrape snapshot and breaker history die
+                    # with the old process — a revival must not be
+                    # load-ranked (or tripped) on the corpse's numbers.
                     r.healthy = True
                     r.index.clear()
+                    r._scrape = None
+                    if r.breaker is not None:
+                        r.breaker.reset()
+                        self.metrics.record_breaker_state(
+                            r.worker_id, r.breaker.state_code()
+                        )
                     self.metrics.record_health(r.worker_id, True)
                     logger.info(
                         "fleet replica %s healthy again (cold)",
@@ -461,6 +528,11 @@ class FleetRouter:
         actually happened."""
         replica.healthy = False
         replica.index.clear()
+        # Drop the cached load scrape WITH the health transition: the
+        # TTL would otherwise keep serving the corpse's (often
+        # flattering: it stopped queueing when it died) queue-depth
+        # snapshot to the load fallback for up to scrape_ttl_s.
+        replica._scrape = None
         self.metrics.record_health(replica.worker_id, False)
         logger.warning("fleet replica %s marked dead", replica.worker_id)
 
@@ -521,25 +593,63 @@ class FleetRouter:
             predicted = 0
             if session is not None and session in self._sessions:
                 pinned = self._by_id.get(self._sessions[session])
-                if pinned is not None and pinned.healthy:
+                pin_ok = (
+                    pinned is not None
+                    and pinned.healthy
+                    and (
+                        pinned.breaker is None
+                        or pinned.breaker.state
+                        == CircuitBreaker.CLOSED
+                        # An open-but-due pinned replica may serve its
+                        # own probe: session continuity IS the cheapest
+                        # probe traffic we have.
+                        or pinned.breaker.try_probe()
+                    )
+                )
+                if pin_ok:
                     # Session continuity: the pin IS the affinity —
                     # turn-2+ re-enters this replica's radix cache.
                     chosen = pinned
                     affinity_hit = True
                     predicted = pinned.index.predict(tokens)
                 else:
-                    # The pinned replica died: this turn re-routes
-                    # COLD to a survivor and re-pins there.
+                    # The pinned replica died (or its breaker opened):
+                    # this turn re-routes COLD to a survivor and
+                    # re-pins there.
                     rerouted = True
                     self.rerouted_total += 1
                     self.metrics.record_rerouted()
             if chosen is None:
+                # Half-open probes take absolute priority: exactly one
+                # request per cooldown tests a tripped replica, so it
+                # must not starve behind closed-breaker candidates.
+                chosen = next(
+                    (
+                        r
+                        for r in healthy
+                        if r.breaker is not None and r.breaker.try_probe()
+                    ),
+                    None,
+                )
+            if chosen is None:
+                candidates = [
+                    r
+                    for r in healthy
+                    if r.breaker is None
+                    or r.breaker.state == CircuitBreaker.CLOSED
+                ]
+                if not candidates:
+                    raise FleetUnavailableError(
+                        f"all {len(healthy)} healthy replicas have open "
+                        "circuit breakers — backing off until a "
+                        "half-open probe succeeds."
+                    )
                 if self.policy == "round_robin":
-                    chosen = healthy[self._rr_next % len(healthy)]
+                    chosen = candidates[self._rr_next % len(candidates)]
                     self._rr_next += 1
                 else:
                     scored = [
-                        (r.index.predict(tokens), r) for r in healthy
+                        (r.index.predict(tokens), r) for r in candidates
                     ]
                     best = max(p for p, _ in scored)
                     if best > 0:
@@ -557,7 +667,7 @@ class FleetRouter:
                         predicted = best
                     else:
                         # Nobody is warm: pure load fallback.
-                        chosen = min(healthy, key=self._load_key)
+                        chosen = min(candidates, key=self._load_key)
             if session is not None:
                 if self._sessions.get(session) != chosen.worker_id:
                     self._sessions[session] = chosen.worker_id
@@ -571,6 +681,11 @@ class FleetRouter:
             self.routed_total += 1
             if affinity_hit:
                 self.affinity_hits_total += 1
+            for r in self.replicas:
+                if r.breaker is not None:
+                    self.metrics.record_breaker_state(
+                        r.worker_id, r.breaker.state_code()
+                    )
             return chosen, affinity_hit, rerouted, predicted
 
     def submit(
@@ -598,66 +713,125 @@ class FleetRouter:
             )
         rid = next_rid() if rid is None else int(rid)
         t_submit_ns = time.perf_counter_ns()
-        t0 = time.perf_counter()
         token_list = [int(x) for x in tokens.tolist()]
-        chosen, affinity_hit, rerouted, predicted = self._route(
-            token_list, session
-        )
-        route_ms = (time.perf_counter() - t0) * 1e3
-        self.metrics.record_routed(
-            chosen.worker_id, affinity_hit=affinity_hit, route_ms=route_ms
-        )
-        if _trace.enabled():
-            _trace.event(
-                "fleet_route",
-                rid=rid,
-                attrs={
-                    "replica": chosen.worker_id,
-                    "affinity_hit": affinity_hit,
-                    "rerouted": rerouted,
-                    "predicted_shared": predicted,
-                    "session": session or "",
-                },
+        retries = 0
+        while True:
+            t0 = time.perf_counter()
+            chosen, affinity_hit, rerouted, predicted = self._route(
+                token_list, session
             )
-        plan = faults.active()
-        if plan is not None and plan.take_fleet_replica_kill():
-            # Chaos coordinate (docs/DESIGN.md §23): the chosen replica
-            # dies NOW — the forward below finds a dead worker, exactly
-            # the mid-request death the contract covers.
-            self._kill_replica_hook(chosen)
-        with self._lock:
-            chosen.outstanding += 1
-        try:
-            payload = {
-                "tokens": token_list,
-                "max_new_tokens": int(max_new_tokens),
-                "rid": rid,
-                "session": session,
-            }
-            body = self._transport(
-                chosen, payload, self.request_timeout_s
+            route_ms = (time.perf_counter() - t0) * 1e3
+            self.metrics.record_routed(
+                chosen.worker_id,
+                affinity_hit=affinity_hit,
+                route_ms=route_ms,
             )
-        except (urllib.error.URLError, OSError, ConnectionError) as e:
+            if _trace.enabled():
+                _trace.event(
+                    "fleet_route",
+                    rid=rid,
+                    attrs={
+                        "replica": chosen.worker_id,
+                        "affinity_hit": affinity_hit,
+                        "rerouted": rerouted,
+                        "predicted_shared": predicted,
+                        "session": session or "",
+                        "attempt": retries,
+                    },
+                )
+            plan = faults.active()
+            if plan is not None and plan.take_fleet_replica_kill():
+                # Chaos coordinate (docs/DESIGN.md §23): the chosen
+                # replica dies NOW — the forward below finds a dead
+                # worker, exactly the mid-request death the contract
+                # covers.
+                self._kill_replica_hook(chosen)
             with self._lock:
-                if chosen.healthy:
-                    self._mark_dead(chosen)
-            self.metrics.record_worker_crash()
-            self.request_log.append(
-                rid,
-                "crashed",
-                enqueue_ns=t_submit_ns,
-                complete_ns=time.perf_counter_ns(),
-                detail=f"WorkerCrashedError replica={chosen.worker_id}",
-                role="router",
-            )
-            raise WorkerCrashedError(
-                f"fleet replica {chosen.worker_id} died mid-request "
-                f"(rid={rid}): {e}; the replica is unhealthy — "
-                "resubmit to re-route to a survivor."
-            ) from e
-        finally:
+                chosen.outstanding += 1
+            t_fwd = time.perf_counter()
+            try:
+                payload = {
+                    "tokens": token_list,
+                    "max_new_tokens": int(max_new_tokens),
+                    "rid": rid,
+                    "session": session,
+                }
+                body = self._transport(
+                    chosen, payload, self.request_timeout_s
+                )
+            except (urllib.error.URLError, OSError, ConnectionError) as e:
+                with self._lock:
+                    if chosen.breaker is not None:
+                        chosen.breaker.record_failure()
+                        self.metrics.record_breaker_state(
+                            chosen.worker_id,
+                            chosen.breaker.state_code(),
+                        )
+                    if chosen.healthy:
+                        self._mark_dead(chosen)
+                self.metrics.record_worker_crash()
+                if retries < self.max_retries:
+                    # Rid-preserving re-route. Safe at-most-once: this
+                    # transport is blocking and non-streaming, so a
+                    # connection-level failure means ZERO tokens
+                    # reached the caller — nothing was delivered that
+                    # a second attempt could duplicate.
+                    retries += 1
+                    self.retries_total += 1
+                    self.metrics.record_retry()
+                    if _trace.enabled():
+                        _trace.event(
+                            "fleet_retry",
+                            rid=rid,
+                            attrs={
+                                "failed_replica": chosen.worker_id,
+                                "attempt": retries,
+                            },
+                        )
+                    logger.warning(
+                        "fleet rid=%d attempt %d failed on %s — "
+                        "retrying (%d/%d)",
+                        rid,
+                        retries,
+                        chosen.worker_id,
+                        retries,
+                        self.max_retries,
+                    )
+                    self._sleep(
+                        self.retry_backoff_s * (2 ** (retries - 1))
+                    )
+                    continue
+                detail = f"WorkerCrashedError replica={chosen.worker_id}"
+                if retries:
+                    detail += f" retried={retries}"
+                self.request_log.append(
+                    rid,
+                    "crashed",
+                    enqueue_ns=t_submit_ns,
+                    complete_ns=time.perf_counter_ns(),
+                    detail=detail,
+                    role="router",
+                )
+                raise WorkerCrashedError(
+                    f"fleet replica {chosen.worker_id} died mid-request "
+                    f"(rid={rid}, retried={retries}): {e}; the replica "
+                    "is unhealthy — resubmit to re-route to a survivor."
+                ) from e
+            finally:
+                with self._lock:
+                    chosen.outstanding -= 1
+            fwd_ms = (time.perf_counter() - t_fwd) * 1e3
             with self._lock:
-                chosen.outstanding -= 1
+                if chosen.breaker is not None:
+                    # Worker-side error bodies also count as success
+                    # here: the replica answered promptly — its
+                    # failure is deterministic (bad request), not a
+                    # replica-health signal.
+                    chosen.breaker.record_success(fwd_ms)
+                    self.metrics.record_breaker_state(
+                        chosen.worker_id, chosen.breaker.state_code()
+                    )
+            break
         if "error" in body:
             self.request_log.append(
                 rid,
@@ -683,6 +857,7 @@ class FleetRouter:
                 f"replica={chosen.worker_id} "
                 f"shared={int(body.get('shared_tokens', 0))} "
                 f"predicted={predicted}"
+                + (f" retried={retries}" if retries else "")
             ),
             role="router",
         )
@@ -714,6 +889,11 @@ class FleetRouter:
                         "routed_total": r.routed_total,
                         "index_nodes": r.index.nodes if r.index else 0,
                         "generate_url": r.generate_url,
+                        "breaker": (
+                            r.breaker.status()
+                            if r.breaker is not None
+                            else None
+                        ),
                     }
                     for r in self.replicas
                 ],
@@ -724,6 +904,9 @@ class FleetRouter:
                 "routed_total": self.routed_total,
                 "affinity_hits_total": self.affinity_hits_total,
                 "rerouted_total": self.rerouted_total,
+                "retries_total": self.retries_total,
+                "max_retries": self.max_retries,
+                "retry_backoff_s": self.retry_backoff_s,
                 "state_path": self.state_path,
             }
 

@@ -1,0 +1,634 @@
+"""Runtime overload defenses (docs/DESIGN.md §24).
+
+The observability stack measures overload and the fleet router routes
+around dead replicas; this module is what *defends* the system while
+that is happening — the runtime half of the §24 guardrails story (the
+judging half is ``zookeeper_tpu.loadgen``):
+
+- :class:`OverloadGuard` — predicted-miss admission. Two EWMA
+  estimators (queue wait observed enqueue→dispatch; service time per
+  generated unit observed dispatch→complete) predict a submit's
+  completion time against its deadline; a request predicted to miss is
+  shed AT SUBMIT with :class:`PredictedMissError` instead of occupying
+  queue + device time only to expire anyway. The PR 4 invariant holds
+  verbatim: an empty queue always admits one request, and a request
+  with no deadline has nothing to miss.
+- :class:`CircuitBreaker` — the per-replica state machine the
+  :class:`~zookeeper_tpu.serving.fleet.FleetRouter` wraps around each
+  worker: ``closed`` → ``open`` on a consecutive-failure or
+  consecutive-slow-success threshold → ``half_open`` after a jittered
+  cooldown (exactly ONE probe request rides through) → ``closed`` on
+  probe success / back to ``open`` on probe failure. The latency trip
+  is the case the existing ``/healthz`` liveness probe cannot see: a
+  gray-failed replica answers probes instantly while poisoning every
+  real request routed to it.
+- :class:`BrownOut` — sustained-pressure degradation: after
+  ``engage_after`` consecutive predicted-miss sheds the service caps
+  ``max_new_tokens`` and disables speculation for newly admitted
+  streams; the state TRANSITION applies only at the PR 9 drain
+  boundary (empty slot array — the same boundary weight hot-swaps wait
+  for) so in-flight sequences are untouched. Loudly logged both ways;
+  auto-recovers after ``release_after`` consecutive admits.
+
+Every knob is deterministic and clock-injectable: breaker cooldown
+jitter draws from the splitmix64 counter RNG
+(:class:`~zookeeper_tpu.data.augrng.AugRng`) keyed on ``(seed, replica
+key, open count)`` — never ``random`` / wall entropy — so two runs of
+the same chaos plan open and probe at identical offsets.
+"""
+
+import logging
+import threading
+import time
+import zlib
+from typing import Callable, Dict, Optional, Tuple
+
+from zookeeper_tpu.core import Field, component
+from zookeeper_tpu.data.augrng import AugRng
+from zookeeper_tpu.observability.registry import (
+    DEFAULT_MS_BUCKETS,
+    MetricsRegistry,
+)
+from zookeeper_tpu.serving.batcher import RejectedError
+
+logger = logging.getLogger(__name__)
+
+__all__ = [
+    "BrownOut",
+    "CircuitBreaker",
+    "OverloadGuard",
+    "PredictedMissError",
+]
+
+
+class PredictedMissError(RejectedError):
+    """Predicted-miss admission shed: the EWMA cost model predicts this
+    request would expire before completion, so it was shed AT SUBMIT
+    instead of wasting queue + device time. A :class:`RejectedError`
+    subclass, so existing shed handling (``outcome_of`` → ``"shed"``,
+    client backoff) applies unchanged; the distinct type lets callers
+    and the RequestLog ``detail`` tell predictive sheds from static
+    ``shed_above`` ones."""
+
+
+class BrownOut:
+    """Consecutive-pressure hysteresis: ``engage_after`` predicted-miss
+    sheds in a row engage; ``release_after`` admits in a row release.
+    Pure bookkeeping — the OWNING service applies the actual
+    degradation (cap ``max_new_tokens``, skip speculation) at its own
+    safe boundary. Thread-safe."""
+
+    def __init__(self, engage_after: int, release_after: int) -> None:
+        if engage_after < 1 or release_after < 1:
+            raise ValueError(
+                f"engage_after={engage_after} and release_after="
+                f"{release_after} must be >= 1."
+            )
+        self.engage_after = int(engage_after)
+        self.release_after = int(release_after)
+        self.engaged = False
+        self.engaged_total = 0
+        self._shed_streak = 0
+        self._ok_streak = 0
+        self._lock = threading.Lock()
+
+    def note(self, shed: bool) -> None:
+        """Record one admission decision (True = predicted-miss shed)."""
+        with self._lock:
+            if shed:
+                self._shed_streak += 1
+                self._ok_streak = 0
+                if (
+                    not self.engaged
+                    and self._shed_streak >= self.engage_after
+                ):
+                    self.engaged = True
+                    self.engaged_total += 1
+            else:
+                self._ok_streak += 1
+                self._shed_streak = 0
+                if self.engaged and self._ok_streak >= self.release_after:
+                    self.engaged = False
+
+    def status(self) -> Dict[str, object]:
+        with self._lock:
+            return {
+                "engaged": self.engaged,
+                "engaged_total": self.engaged_total,
+                "shed_streak": self._shed_streak,
+                "ok_streak": self._ok_streak,
+                "engage_after": self.engage_after,
+                "release_after": self.release_after,
+            }
+
+
+@component
+class OverloadGuard:
+    """Predicted-miss admission (see module docstring).
+
+    The math, per submit with ``queued_units`` of work ahead of it,
+    ``request_units`` of its own, and ``deadline_ms`` remaining::
+
+        predicted_ms = max(queued_units * service_ewma, wait_ewma)
+                       + request_units * service_ewma
+        shed iff queued_units > 0 and predicted_ms > deadline_ms * headroom
+
+    ``service_ewma`` is the EWMA of observed per-unit service time
+    (dispatch→complete over delivered units); ``wait_ewma`` is the EWMA
+    of observed whole-request queue waits (enqueue→dispatch) and acts
+    as a floor — when real waits exceed the queue×service model (batch
+    coalescing gaps, dispatch stalls), the floor catches what the
+    product term misses. Units are the caller's: generated tokens for
+    the decode scheduler, rows for the MicroBatcher — the estimator
+    only ever divides and multiplies consistently.
+
+    Fail-open by construction: below ``min_samples`` observations the
+    guard admits everything (a cold estimator must not shed), an empty
+    queue always admits (the PR 4 invariant — there is no wait to
+    predict), and a request without a deadline has nothing to miss.
+    """
+
+    #: Master switch — a disabled guard admits everything and records
+    #: nothing (services treat ``guard=None`` and ``enabled=False``
+    #: identically).
+    enabled: bool = Field(False)
+    #: EWMA smoothing factor for both estimators (1.0 = last sample
+    #: only).
+    alpha: float = Field(0.25)
+    #: Completed-request observations required before the guard may
+    #: shed (warmup admits all — a cold estimator is a guess).
+    min_samples: int = Field(4)
+    #: Shed when ``predicted > headroom * deadline``; > 1.0 sheds
+    #: later (tolerates estimator optimism), < 1.0 sheds earlier.
+    headroom: float = Field(1.0)
+    #: Consecutive predicted-miss sheds that engage brown-out
+    #: (0 = brown-out off).
+    brownout_after: int = Field(0)
+    #: Consecutive admits that release an engaged brown-out.
+    brownout_release: int = Field(16)
+    #: ``max_new_tokens`` cap applied to newly admitted streams while
+    #: browned out.
+    brownout_max_new_tokens: int = Field(8)
+
+    # -- wiring ----------------------------------------------------------
+
+    def bind(self) -> "OverloadGuard":
+        if not 0.0 < self.alpha <= 1.0:
+            raise ValueError(f"alpha={self.alpha} must be in (0, 1].")
+        if self.min_samples < 1:
+            raise ValueError(
+                f"min_samples={self.min_samples} must be >= 1."
+            )
+        if self.headroom <= 0.0:
+            raise ValueError(f"headroom={self.headroom} must be > 0.")
+        if self.brownout_max_new_tokens < 1:
+            raise ValueError(
+                f"brownout_max_new_tokens={self.brownout_max_new_tokens} "
+                "must be >= 1."
+            )
+        object.__setattr__(self, "_lock", threading.Lock())
+        object.__setattr__(self, "_service_ewma", None)
+        object.__setattr__(self, "_wait_ewma", None)
+        object.__setattr__(self, "_samples", 0)
+        object.__setattr__(
+            self,
+            "_brownout",
+            BrownOut(self.brownout_after, self.brownout_release)
+            if self.brownout_after > 0
+            else None,
+        )
+        return self
+
+    def _require_bound(self) -> None:
+        if getattr(self, "_lock", None) is None:
+            raise RuntimeError(
+                "OverloadGuard is not bound: call guard.bind() before "
+                "use."
+            )
+
+    # -- metrics (zk_guard_* family, own registry — the DecodeMetrics
+    # posture: attach it to an ObservabilityServer next to the default
+    # registry) -----------------------------------------------------------
+
+    def _obs(self) -> dict:
+        from zookeeper_tpu.serving.metrics import _get_or_build_obs
+
+        return _get_or_build_obs(self, self._build_obs)
+
+    def _build_obs(self) -> dict:
+        registry = MetricsRegistry()
+        return {
+            "registry": registry,
+            "counters": {
+                "predicted_miss": registry.counter(
+                    "zk_guard_predicted_miss_total",
+                    help="submits shed by predicted-miss admission",
+                ),
+                "admitted": registry.counter(
+                    "zk_guard_admitted_total",
+                    help="submits the guard admitted",
+                ),
+                "brownouts": registry.counter(
+                    "zk_guard_brownouts_total",
+                    help="brown-out engagements applied at the drain "
+                    "boundary",
+                ),
+            },
+            "gauges": {
+                "service_ewma_ms": registry.gauge(
+                    "zk_guard_service_ewma_ms",
+                    help="EWMA per-unit service time estimate",
+                ),
+                "wait_ewma_ms": registry.gauge(
+                    "zk_guard_wait_ewma_ms",
+                    help="EWMA whole-request queue wait estimate",
+                ),
+                "brownout_active": registry.gauge(
+                    "zk_guard_brownout_active",
+                    help="1 = brown-out degradation applied (cap + no "
+                    "speculation for new admissions)",
+                ),
+            },
+            "hist": {
+                "predicted_ms": registry.histogram(
+                    "zk_guard_predicted_ms",
+                    buckets=DEFAULT_MS_BUCKETS,
+                    help="predicted completion time at admission",
+                ),
+            },
+            "windows": {},
+        }
+
+    @property
+    def registry(self) -> MetricsRegistry:
+        return self._obs()["registry"]
+
+    # -- estimators ------------------------------------------------------
+
+    def observe_service(self, service_ms: float, units: int) -> None:
+        """Feed one completed request's dispatch→complete time over the
+        units it delivered (tokens / rows)."""
+        self._require_bound()
+        per_unit = float(service_ms) / max(1, int(units))
+        with self._lock:
+            cur = self._service_ewma
+            object.__setattr__(
+                self,
+                "_service_ewma",
+                per_unit
+                if cur is None
+                else cur + self.alpha * (per_unit - cur),
+            )
+            object.__setattr__(self, "_samples", self._samples + 1)
+            self._obs()["gauges"]["service_ewma_ms"].set(
+                self._service_ewma
+            )
+
+    def observe_wait(self, wait_ms: float) -> None:
+        """Feed one completed request's enqueue→dispatch queue wait."""
+        self._require_bound()
+        with self._lock:
+            cur = self._wait_ewma
+            object.__setattr__(
+                self,
+                "_wait_ewma",
+                float(wait_ms)
+                if cur is None
+                else cur + self.alpha * (float(wait_ms) - cur),
+            )
+            self._obs()["gauges"]["wait_ewma_ms"].set(self._wait_ewma)
+
+    @property
+    def samples(self) -> int:
+        return getattr(self, "_samples", 0)
+
+    def predicted_ms(
+        self, queued_units: float, request_units: float
+    ) -> Optional[float]:
+        """The model's completion-time prediction (None while warming
+        up — below ``min_samples`` the guard has no opinion)."""
+        self._require_bound()
+        with self._lock:
+            if self._samples < self.min_samples:
+                return None
+            service = self._service_ewma or 0.0
+            wait = self._wait_ewma or 0.0
+        queue_ms = max(float(queued_units) * service, wait)
+        return queue_ms + float(request_units) * service
+
+    # -- admission -------------------------------------------------------
+
+    def admit(
+        self,
+        *,
+        queued_units: float,
+        request_units: float,
+        deadline_ms: Optional[float],
+    ) -> Tuple[bool, Optional[float]]:
+        """One admission decision: ``(admitted, predicted_ms)``.
+
+        Records the decision (counters + brown-out pressure); the
+        CALLER raises :class:`PredictedMissError` on False so it can
+        stamp its own RequestLog summary / trace event first (the shed
+        has no request handle — same shape as the static shed path).
+        """
+        self._require_bound()
+        predicted = self.predicted_ms(queued_units, request_units)
+        obs = self._obs()
+        if predicted is not None:
+            obs["hist"]["predicted_ms"].observe(predicted)
+        shed = (
+            predicted is not None
+            # The PR 4 invariant: an empty queue always admits one
+            # request — the guard predicts WAITING cost, and there is
+            # none.
+            and queued_units > 0
+            # No deadline, nothing to miss.
+            and deadline_ms is not None
+            and predicted > float(deadline_ms) * self.headroom
+        )
+        obs["counters"]["predicted_miss" if shed else "admitted"].inc()
+        brownout = getattr(self, "_brownout", None)
+        if brownout is not None:
+            brownout.note(shed)
+        return (not shed), predicted
+
+    # -- brown-out seam (the owning scheduler polls + applies) -----------
+
+    @property
+    def brownout_engaged(self) -> bool:
+        """Whether pressure WANTS brown-out (the controller's state).
+        The owning scheduler stages this into its actual degradation at
+        the drain boundary — the two can briefly disagree while slots
+        are occupied."""
+        brownout = getattr(self, "_brownout", None)
+        return brownout is not None and brownout.engaged
+
+    def record_brownout_applied(self, active: bool) -> None:
+        """The owning scheduler APPLIED a brown-out transition at its
+        drain boundary: update the gauge (and count engagements)."""
+        obs = self._obs()
+        obs["gauges"]["brownout_active"].set(1.0 if active else 0.0)
+        if active:
+            obs["counters"]["brownouts"].inc()
+
+    # -- introspection ---------------------------------------------------
+
+    def status(self) -> Dict[str, object]:
+        """The ``/statusz`` guardrails section."""
+        if getattr(self, "_lock", None) is None:
+            return {"enabled": False, "bound": False}
+        with self._lock:
+            service = self._service_ewma
+            wait = self._wait_ewma
+            samples = self._samples
+        obs = self._obs()
+        brownout = getattr(self, "_brownout", None)
+        return {
+            "enabled": bool(self.enabled),
+            "samples": samples,
+            "warmed_up": samples >= self.min_samples,
+            "service_ewma_ms": (
+                round(service, 4) if service is not None else None
+            ),
+            "wait_ewma_ms": round(wait, 4) if wait is not None else None,
+            "headroom": self.headroom,
+            "predicted_miss_total": int(
+                obs["counters"]["predicted_miss"].value
+            ),
+            "admitted_total": int(obs["counters"]["admitted"].value),
+            "brownout": (
+                brownout.status()
+                if brownout is not None
+                else {"engaged": False, "configured": False}
+            ),
+        }
+
+    def snapshot(self) -> Dict[str, float]:
+        obs = self._obs()
+        out = {
+            "guard_predicted_miss_total": float(
+                obs["counters"]["predicted_miss"].value
+            ),
+            "guard_admitted_total": float(
+                obs["counters"]["admitted"].value
+            ),
+            "guard_brownouts_total": float(
+                obs["counters"]["brownouts"].value
+            ),
+        }
+        if getattr(self, "_service_ewma", None) is not None:
+            out["guard_service_ewma_ms"] = float(self._service_ewma)
+        if getattr(self, "_wait_ewma", None) is not None:
+            out["guard_wait_ewma_ms"] = float(self._wait_ewma)
+        return out
+
+
+class CircuitBreaker:
+    """Per-replica circuit breaker (see module docstring for the state
+    machine). Plain class, one per :class:`ReplicaHandle`; the router
+    drives it under its own lock but every method is independently
+    thread-safe (probe claiming must be race-free even if a future
+    transport records from its own thread).
+
+    Trip conditions (either, measured on CONSECUTIVE results):
+
+    - ``failure_threshold`` transport failures in a row (0 disables);
+    - ``latency_window`` successes in a row slower than
+      ``latency_threshold_ms`` (0.0 disables) — the gray-failure case
+      a liveness probe cannot see.
+
+    ``clock`` is injectable (defaults to ``time.monotonic``) and the
+    cooldown jitter is a splitmix64 draw keyed on ``(seed, crc32(key),
+    open count)``: in ``[cooldown_s, cooldown_s * (1 + jitter_frac)]``,
+    deterministic per open, different across opens and replicas — the
+    fleet's breakers never re-probe in lockstep after a correlated
+    trip."""
+
+    CLOSED = "closed"
+    OPEN = "open"
+    HALF_OPEN = "half_open"
+
+    def __init__(
+        self,
+        *,
+        key: str = "",
+        failure_threshold: int = 3,
+        latency_threshold_ms: float = 0.0,
+        latency_window: int = 3,
+        cooldown_s: float = 5.0,
+        jitter_frac: float = 0.5,
+        seed: int = 0,
+        clock: Optional[Callable[[], float]] = None,
+    ) -> None:
+        if failure_threshold < 0 or latency_window < 1:
+            raise ValueError(
+                f"failure_threshold={failure_threshold} must be >= 0 "
+                f"(0 disables) and latency_window={latency_window} "
+                ">= 1."
+            )
+        if cooldown_s <= 0 or jitter_frac < 0:
+            raise ValueError(
+                f"cooldown_s={cooldown_s} must be > 0 and jitter_frac="
+                f"{jitter_frac} >= 0."
+            )
+        self.key = str(key)
+        self.failure_threshold = int(failure_threshold)
+        self.latency_threshold_ms = float(latency_threshold_ms)
+        self.latency_window = int(latency_window)
+        self.cooldown_s = float(cooldown_s)
+        self.jitter_frac = float(jitter_frac)
+        self.seed = int(seed)
+        self._clock = clock or time.monotonic
+        self._lock = threading.Lock()
+        self._state = self.CLOSED
+        self._fail_streak = 0
+        self._slow_streak = 0
+        self._open_until = 0.0
+        self.opened_total = 0
+        self.probes_total = 0
+
+    # -- reads -----------------------------------------------------------
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return self._state
+
+    def state_code(self) -> float:
+        """Gauge encoding: 0 closed, 0.5 half-open, 1 open."""
+        with self._lock:
+            return {self.CLOSED: 0.0, self.HALF_OPEN: 0.5}.get(
+                self._state, 1.0
+            )
+
+    @property
+    def open_until(self) -> float:
+        """When the next half-open probe becomes due (clock units;
+        meaningful only while open)."""
+        with self._lock:
+            return self._open_until
+
+    def routable(self) -> bool:
+        """Whether a request may be routed here right now: closed, or
+        open with the probe due (claim it with :meth:`try_probe`).
+        Half-open means the single probe is already in flight — no
+        second request rides along."""
+        with self._lock:
+            if self._state == self.CLOSED:
+                return True
+            if self._state == self.OPEN:
+                return self._clock() >= self._open_until
+            return False
+
+    def try_probe(self) -> bool:
+        """Claim THE half-open probe: True exactly once per cooldown
+        expiry (open + due → half_open); every other caller gets False.
+        The winner's next record_success/record_failure resolves the
+        probe."""
+        with self._lock:
+            if (
+                self._state == self.OPEN
+                and self._clock() >= self._open_until
+            ):
+                self._state = self.HALF_OPEN
+                self.probes_total += 1
+                logger.info(
+                    "circuit breaker %s half-open: probe in flight",
+                    self.key or "<anon>",
+                )
+                return True
+            return False
+
+    # -- transitions -----------------------------------------------------
+
+    def _trip(self, reason: str) -> None:
+        """Caller holds the lock."""
+        self._state = self.OPEN
+        self.opened_total += 1
+        # Deterministic jitter: splitmix64 keyed by (seed, replica,
+        # open count) — no `random`, no wall entropy.
+        rng = AugRng(
+            self.seed, zlib.crc32(self.key.encode()), self.opened_total
+        )
+        delay = self.cooldown_s * (
+            1.0 + rng.uniform(0.0, self.jitter_frac)
+            if self.jitter_frac > 0
+            else 1.0
+        )
+        self._open_until = self._clock() + delay
+        self._fail_streak = 0
+        self._slow_streak = 0
+        logger.warning(
+            "circuit breaker %s OPEN (%s); next probe in %.3fs",
+            self.key or "<anon>", reason, delay,
+        )
+
+    def record_success(self, latency_ms: Optional[float] = None) -> None:
+        """A request to this replica completed. While closed, a
+        too-slow success still counts toward the latency trip; the
+        half-open probe's success closes the breaker."""
+        with self._lock:
+            if self._state == self.HALF_OPEN:
+                self._state = self.CLOSED
+                self._fail_streak = 0
+                self._slow_streak = 0
+                logger.info(
+                    "circuit breaker %s CLOSED (probe succeeded)",
+                    self.key or "<anon>",
+                )
+                return
+            if self._state != self.CLOSED:
+                return  # late result from before the trip
+            self._fail_streak = 0
+            if (
+                self.latency_threshold_ms > 0
+                and latency_ms is not None
+                and float(latency_ms) > self.latency_threshold_ms
+            ):
+                self._slow_streak += 1
+                if self._slow_streak >= self.latency_window:
+                    self._trip(
+                        f"{self._slow_streak} consecutive responses "
+                        f"over {self.latency_threshold_ms:.0f}ms"
+                    )
+            else:
+                self._slow_streak = 0
+
+    def record_failure(self) -> None:
+        """A request to this replica failed at the transport. The
+        half-open probe's failure re-opens with a fresh jittered
+        cooldown."""
+        with self._lock:
+            if self._state == self.HALF_OPEN:
+                self._trip("probe failed")
+                return
+            if self._state != self.CLOSED:
+                return
+            self._fail_streak += 1
+            if (
+                self.failure_threshold > 0
+                and self._fail_streak >= self.failure_threshold
+            ):
+                self._trip(f"{self._fail_streak} consecutive failures")
+
+    def reset(self) -> None:
+        """Back to closed with clean streaks — the router calls this
+        when a dead replica passes its health probe again (a restarted
+        worker deserves a fresh breaker, not the corpse's history)."""
+        with self._lock:
+            self._state = self.CLOSED
+            self._fail_streak = 0
+            self._slow_streak = 0
+
+    def status(self) -> Dict[str, object]:
+        with self._lock:
+            return {
+                "state": self._state,
+                "opened_total": self.opened_total,
+                "probes_total": self.probes_total,
+                "fail_streak": self._fail_streak,
+                "slow_streak": self._slow_streak,
+                "failure_threshold": self.failure_threshold,
+                "latency_threshold_ms": self.latency_threshold_ms,
+            }

@@ -338,6 +338,7 @@ def run_fleet_worker(
     import json
     import os
     import threading
+    import time
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
     import jax
@@ -345,9 +346,18 @@ def run_fleet_worker(
     jax.config.update("jax_platforms", "cpu")
 
     from zookeeper_tpu.core import configure
+    from zookeeper_tpu.resilience import faults
     from zookeeper_tpu.serving import LMServingConfig
 
     overrides = json.loads(config_json)
+    # Chaos seam: a "faults" key in the worker config installs a
+    # FaultPlan IN THIS PROCESS (plans are process-local — the router's
+    # plan cannot reach across the OS boundary). Every worker receives
+    # the same plan and fires only its own coordinate keys, the
+    # kill_process_at_step discipline.
+    fault_conf = overrides.pop("faults", None)
+    if fault_conf:
+        faults.install(faults.FaultPlan(**fault_conf))
     conf = {
         "model.num_layers": 2,
         "model.d_model": 64,
@@ -399,6 +409,15 @@ def run_fleet_worker(
             try:
                 n = int(self.headers.get("Content-Length", "0"))
                 req = json.loads(self.rfile.read(n).decode())
+                plan = faults.active()
+                if plan is not None:
+                    # Gray-failure injection (docs/DESIGN.md §24):
+                    # stall the forward path, stay alive. /healthz on
+                    # the ObservabilityServer keeps answering — only a
+                    # latency-watching breaker can see this.
+                    delay = plan.take_delay_forward(worker_id)
+                    if delay:
+                        time.sleep(delay / 1e3)
                 with gen_lock:
                     stream = scheduler.submit(
                         np.asarray(req["tokens"], np.int32),
