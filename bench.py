@@ -1873,6 +1873,243 @@ def measure_trace_slo(env=None):
     }
 
 
+def measure_chunked_interference(env=None):
+    """``ZK_BENCH_CHUNKED=1`` leg: chunked-prefill A/B under long-prompt
+    interference — docs/DESIGN.md §25's acceptance number.
+
+    One pinned ``poisson_burst`` trace (no deadlines — every request
+    runs to completion) gets a few of its mid-trace requests rewritten
+    into LONG prompts (near the top sequence bucket, far above the
+    short-prompt body). The trace is submitted open-loop against TWO
+    fresh sync decode stacks built from the same paged config: pass A
+    with ``engine.prefill_chunk_tokens`` set (the token-budget planner
+    interleaves prefill chunks between decode iterations), pass B
+    monolithic (each long prefill is one dispatch that stalls every
+    active decode slot for its full duration). Both passes replay the
+    identical request sequence and must produce token-identical
+    streams — the A/B moves WHEN prefill compute runs, never what it
+    computes — with zero post-warmup compiles on either side.
+
+    Inter-token latency is measured client-side: each stream's token
+    emissions are timestamped at delivery, and the gap population
+    (consecutive emissions within one stream, TTFT excluded) is
+    aggregated across all streams. The long prefills land while other
+    slots are mid-decode, so the monolithic pass's gap tail IS the
+    prefill stall; chunking bounds it at one chunk's dispatch.
+
+    Headline (gated, direction-aware in tools/bench_diff.py):
+
+    - ``chunked_itl_p99_ms`` — p99 inter-token gap with chunking on.
+      The §25 acceptance bound is <= 0.5x the monolithic pass's
+      (``chunked_baseline_itl_p99_ms``, informational).
+    - ``chunked_itl_improvement`` — baseline p99 / chunked p99
+      (higher is better; the CI gate asserts >= 2.0).
+    - ``chunked_ttft_p99_ms`` — p99 TTFT with chunking on: the cost
+      side of the tradeoff (chunked prefill finishes a long prompt
+      LATER than one monolithic dispatch would — §25 bounds the
+      regression rather than pretending there isn't one).
+
+    The shape matters: chunking trades EXTRA dispatches for BOUNDED
+    stalls, so it only pays when one monolithic prefill costs far more
+    than one dispatch — the long-context regime it exists for. The
+    defaults put the leg there honestly (2048-token window, ~1900-token
+    long prompts: one monolithic prefill is ~15-70x a chunk dispatch on
+    CPU); shrink ``ZK_BENCH_CHUNKED_LONG`` below the dispatch-overhead
+    floor and chunking rightly loses.
+
+    Knobs: ``ZK_BENCH_CHUNKED_SEED`` (default 29),
+    ``ZK_BENCH_CHUNKED_CHUNK`` (chunk size, default 256),
+    ``ZK_BENCH_CHUNKED_LONG`` (long-prompt length, default 1900),
+    ``ZK_BENCH_CHUNKED_LONGS`` (long arrivals, default 3),
+    ``ZK_BENCH_CHUNKED_LAYERS``/``_DMODEL``/``_HEADS`` (model shape,
+    defaults 4/128/4)."""
+    import numpy as np
+
+    from zookeeper_tpu.core import configure
+    from zookeeper_tpu.loadgen import poisson_burst
+    from zookeeper_tpu.serving import LMServingConfig
+
+    env = os.environ if env is None else env
+    seed = int(env.get("ZK_BENCH_CHUNKED_SEED", "29"))
+    chunk = int(env.get("ZK_BENCH_CHUNKED_CHUNK", "256"))
+    long_len = int(env.get("ZK_BENCH_CHUNKED_LONG", "1900"))
+    n_long = int(env.get("ZK_BENCH_CHUNKED_LONGS", "3"))
+    num_layers = int(env.get("ZK_BENCH_CHUNKED_LAYERS", "4"))
+    d_model = int(env.get("ZK_BENCH_CHUNKED_DMODEL", "128"))
+    num_heads = int(env.get("ZK_BENCH_CHUNKED_HEADS", "4"))
+
+    vocab = 61
+    conf = {
+        "model.num_layers": num_layers,
+        "model.d_model": d_model,
+        "model.num_heads": num_heads,
+        "model.max_seq_len": 2048,
+        "model.attention": "dense",
+        "seq_len": 2048,
+        "vocab_size": vocab,
+        "seed": 0,
+        "engine.kv_layout": "paged",
+        "engine.page_size": 16,
+        "engine.slots": 4,
+        "engine.seq_buckets": (256, 2048),
+        "engine.prefill_buckets": (1, 2, 4),
+        "requests": 0,
+        "verbose": False,
+        "metrics_port": -1,
+    }
+    # The pinned workload: a short-prompt body (decode traffic) with
+    # n_long LONG prompts spread through the middle — each arrives
+    # while other slots are mid-decode, which is the interference
+    # under test.
+    trace = poisson_burst(
+        seed,
+        base_rate_rps=40.0,
+        burst_rate_rps=120.0,
+        base_s=0.3,
+        burst_s=0.2,
+        cooldown_s=0.1,
+        vocab=vocab,
+        prompt_len=4,
+        max_prompt_len=24,
+        new_tokens=6,
+        max_new_tokens=16,
+        deadline_ms=None,
+    )
+    reqs = trace.requests
+    long_rng = np.random.default_rng(seed + 1)
+    long_at = sorted(
+        {
+            max(1, int(len(reqs) * frac))
+            for frac in np.linspace(0.3, 0.8, max(1, n_long))
+        }
+    )
+    for idx in long_at:
+        reqs[idx].prompt = long_rng.integers(
+            1, vocab, size=long_len
+        ).astype(np.int32)
+        reqs[idx].max_new_tokens = 4
+    warm_rng = np.random.default_rng(7)
+    warm_prompts = [
+        warm_rng.integers(1, vocab, size=8).astype(np.int32)
+        for _ in range(4)
+    ]
+    # One long warm prompt: the monolithic pass's top-bucket prefill
+    # program and BOTH passes' top-bucket decode program compile here,
+    # outside the measurement.
+    warm_prompts.append(
+        warm_rng.integers(1, vocab, size=long_len).astype(np.int32)
+    )
+
+    def run_pass(chunk_tokens):
+        svc = LMServingConfig()
+        c = dict(conf)
+        c["engine.prefill_chunk_tokens"] = int(chunk_tokens)
+        configure(
+            svc,
+            c,
+            name="chunked_itl_"
+            + ("on" if chunk_tokens else "off"),
+        )
+        engine, scheduler = svc.build_service()
+        try:
+            for p in warm_prompts:
+                scheduler.submit(p, max_new_tokens=4).result(
+                    timeout=600.0
+                )
+            warm_compiles = engine.compile_count
+            emits = [[] for _ in reqs]
+
+            def tap(stream, sink):
+                orig = stream._deliver
+
+                def wrapped(token):
+                    sink.append((time.perf_counter(), int(token)))
+                    orig(token)
+
+                stream._deliver = wrapped
+
+            # Open-loop: submit the whole trace in arrival order, then
+            # resolve — arrival ORDER (not wall-clock spacing) is what
+            # puts the long prefills mid-decode, exactly like
+            # loadgen.replay's deterministic time_scale=0 mode.
+            t0 = time.perf_counter()
+            streams = []
+            for i, r in enumerate(reqs):
+                s = scheduler.submit(
+                    r.prompt, max_new_tokens=r.max_new_tokens
+                )
+                tap(s, emits[i])
+                streams.append(s)
+            outs = [s.result(timeout=600.0) for s in streams]
+            wall = time.perf_counter() - t0
+            if engine.compile_count != warm_compiles:
+                raise RuntimeError(
+                    f"post-warmup compiles: {warm_compiles} -> "
+                    f"{engine.compile_count} "
+                    f"(chunk_tokens={chunk_tokens})"
+                )
+            gaps = [
+                (b[0] - a[0]) * 1e3
+                for sink in emits
+                for a, b in zip(sink, sink[1:])
+            ]
+            ttfts = [
+                s.ttft_ms for s in streams if s.ttft_ms is not None
+            ]
+            return {
+                "tokens": [tuple(int(t) for t in o) for o in outs],
+                "gaps": gaps,
+                "ttfts": ttfts,
+                "wall": wall,
+            }
+        finally:
+            svc._teardown_service(suppress=True)
+
+    chunked = run_pass(chunk)
+    base = run_pass(0)
+
+    # Token identity: chunking moves prefill compute, never changes it.
+    for i, (a, b) in enumerate(zip(chunked["tokens"], base["tokens"])):
+        if a != b:
+            raise AssertionError(
+                f"request {i}: chunked {a} != monolithic {b}"
+            )
+    total_tokens = sum(len(t) for t in chunked["tokens"])
+
+    def p99(values):
+        return (
+            float(np.percentile(np.asarray(values, np.float64), 99))
+            if values
+            else -1.0
+        )
+
+    chunked_p99 = p99(chunked["gaps"])
+    base_p99 = p99(base["gaps"])
+    improvement = base_p99 / chunked_p99 if chunked_p99 > 0 else -1.0
+    return {
+        # Gated (direction-aware in tools/bench_diff.py).
+        "chunked_itl_p99_ms": round(chunked_p99, 3),
+        "chunked_itl_improvement": round(improvement, 3),
+        "chunked_ttft_p99_ms": round(p99(chunked["ttfts"]), 3),
+        # Baseline pass (informational: context for the gated A side).
+        "chunked_baseline_itl_p99_ms": round(base_p99, 3),
+        "chunked_baseline_ttft_p99_ms": round(p99(base["ttfts"]), 3),
+        "chunked_baseline_goodput_tokens_per_sec": round(
+            total_tokens / max(base["wall"], 1e-9), 1
+        ),
+        # Workload shape + goodput (informational: token identity makes
+        # the two passes' goodput the same WORK — only pacing differs).
+        "chunked_goodput_tokens_per_sec": round(
+            total_tokens / max(chunked["wall"], 1e-9), 1
+        ),
+        "chunked_chunk_tokens": chunk,
+        "chunked_long_prompt_len": long_len,
+        "chunked_long_arrivals": len(long_at),
+        "chunked_requests": len(reqs),
+        "chunked_generated_tokens": total_tokens,
+    }
+
+
 def measure_trace_overhead(env=None):
     """``ZK_BENCH_OBS=1`` leg: the host-tracing cost on the step-time
     anchor — the observability layer's acceptance number
@@ -3009,6 +3246,22 @@ def main(argv=None):
             )
             trace_metrics = None
 
+    # Chunked-prefill leg (env-gated: two fresh sync decode stacks
+    # replay a pinned long-prompt-interference trace): chunked vs
+    # monolithic prefill — token-identical streams, decode ITL tail
+    # halved or better (docs/DESIGN.md §25).
+    chunked_metrics = None
+    if _env_flag(os.environ, "ZK_BENCH_CHUNKED"):
+        try:
+            chunked_metrics = measure_chunked_interference()
+        except Exception as e:  # never lose the primary metric
+            print(
+                f"chunked prefill leg failed ({e}); omitting chunked_*",
+                file=sys.stderr,
+                flush=True,
+            )
+            chunked_metrics = None
+
     # Observability-overhead leg (env-gated: interleaved traced/untraced
     # step chains): host-span tracing cost on the step-time anchor —
     # the <= 2% budget docs/DESIGN.md §13 commits to.
@@ -3076,6 +3329,8 @@ def main(argv=None):
         extras.update(fleet_metrics)
     if trace_metrics is not None:
         extras.update(trace_metrics)
+    if chunked_metrics is not None:
+        extras.update(chunked_metrics)
     if obs_metrics is not None:
         extras.update(obs_metrics)
     if binary_metrics is not None:

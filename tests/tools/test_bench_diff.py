@@ -530,3 +530,49 @@ def test_trace_slo_keys_gate_with_registered_tolerances():
         # loose precision tolerance (a sign flip reads as drift).
         bad = compare({"metric": "x", key: 1.0 + sign * tol * 1.2}, prev)
         assert not bad.ok and bad.regressions[0]["name"] == key
+
+
+def test_chunked_era_keys_classify():
+    """The §25 chunked-prefill A/B keys gate direction-aware: the ITL
+    improvement ratio and goodput higher-better (the ratio has no
+    suffix family — the explicit _HIGHER entry), the chunked ITL/TTFT
+    tails lower-better; the monolithic baseline pass exists to STALL,
+    so every ``chunked_baseline_*`` key is informational along with
+    the pinned workload shape (chunk size, long-prompt length/count,
+    request and token tallies)."""
+    for key in (
+        "chunked_itl_improvement",
+        "chunked_goodput_tokens_per_sec",
+    ):
+        assert bench_diff.classify_metric(key) == "higher", key
+    for key in ("chunked_itl_p99_ms", "chunked_ttft_p99_ms"):
+        assert bench_diff.classify_metric(key) == "lower", key
+    for key in (
+        "chunked_baseline_itl_p99_ms",
+        "chunked_baseline_ttft_p99_ms",
+        "chunked_baseline_goodput_tokens_per_sec",
+        "chunked_chunk_tokens",
+        "chunked_long_prompt_len",
+        "chunked_long_arrivals",
+        "chunked_requests",
+        "chunked_generated_tokens",
+    ):
+        assert bench_diff.classify_metric(key) is None, key
+
+
+def test_chunked_keys_gate_with_registered_tolerances():
+    from tools.bench_diff import TOLERANCES, compare
+
+    for key, direction in (
+        ("chunked_itl_improvement", "higher"),
+        ("chunked_goodput_tokens_per_sec", "higher"),
+        ("chunked_itl_p99_ms", "lower"),
+        ("chunked_ttft_p99_ms", "lower"),
+    ):
+        tol = TOLERANCES[key]
+        sign = -1.0 if direction == "higher" else 1.0
+        prev = {"metric": "x", key: 1.0}
+        ok = compare({"metric": "x", key: 1.0 + sign * tol * 0.9}, prev)
+        assert ok.ok, key
+        bad = compare({"metric": "x", key: 1.0 + sign * tol * 1.2}, prev)
+        assert not bad.ok and bad.regressions[0]["name"] == key

@@ -307,6 +307,15 @@ def replay(
             "ttft_ms": _percentiles(
                 [o.ttft_ms for o in ok if o.ttft_ms is not None]
             ),
+            # Per-request mean inter-token gap ((latency - TTFT) /
+            # (tokens - 1)) — the interference tail chunked prefill
+            # exists to flatten (docs/DESIGN.md §25). Single-token
+            # streams have no gap and are excluded.
+            "itl_ms": _percentiles([
+                (o.latency_ms - o.ttft_ms) / (o.tokens - 1)
+                for o in ok
+                if o.ttft_ms is not None and o.tokens > 1
+            ]),
         }
     return SLOReport(
         trace=trace.name,

@@ -155,6 +155,15 @@ DecodeScheduler`.
     #: pool pressure. Off = every admission prefills cold (the pool
     #: still pools capacity).
     prefix_cache: bool = Field(True)
+    #: Chunked prefill (docs/DESIGN.md §25; paged layout only, like
+    #: ``kv_quant``): > 0 splits every admitted prompt into chunks of
+    #: at most this many tokens, each a :meth:`prefill_chunk` dispatch
+    #: the scheduler interleaves with decode steps under its token
+    #: budget — a long prompt stops freezing in-flight streams for its
+    #: whole prefill. 0 (default) keeps the monolithic prefill. Must
+    #: not exceed the largest seq bucket (chunks ride the warmed
+    #: ``prefill_extend`` width grid — zero new compiles).
+    prefill_chunk_tokens: int = Field(0)
 
     # -- binding ---------------------------------------------------------
 
@@ -261,6 +270,36 @@ DecodeScheduler`.
                 "layout stores rows in the compute dtype; quantization "
                 "lives with the page pool — docs/DESIGN.md §20)."
             )
+        if int(self.prefill_chunk_tokens) < 0:
+            raise ValueError(
+                f"prefill_chunk_tokens={self.prefill_chunk_tokens}: "
+                "expected 0 (monolithic prefill) or a positive chunk "
+                "size in tokens."
+            )
+        if int(self.prefill_chunk_tokens) > 0:
+            # The same loud paged-only seam as kv_quant: the chunk
+            # program appends through the page table at arbitrary row
+            # offsets — the slot layout has no such program, and a
+            # silent fall-back to monolithic prefill would misreport
+            # every ITL plan built on chunking (docs/DESIGN.md §25).
+            if not paged:
+                raise ValueError(
+                    "prefill_chunk_tokens requires kv_layout='paged' "
+                    "(chunks append through the page table via the "
+                    "prefill_extend program family; the slot layout "
+                    "always prefills monolithically — docs/DESIGN.md "
+                    "§25). Set engine.kv_layout='paged' or "
+                    "prefill_chunk_tokens=0."
+                )
+            if int(self.prefill_chunk_tokens) > max(seq_buckets):
+                raise ValueError(
+                    f"prefill_chunk_tokens={self.prefill_chunk_tokens} "
+                    f"exceeds the largest seq bucket {max(seq_buckets)}"
+                    ": chunks ride the warmed prefill_extend width "
+                    "grid, so a chunk wider than every bucket would "
+                    "compile on the dispatch path; shrink the chunk or "
+                    "widen seq_buckets."
+                )
         max_pages = capacity // int(self.page_size)
         if paged:
             for method in ("decode_step_paged", "decode_verify_paged"):
@@ -1415,7 +1454,12 @@ PagePool.adopt_slot`). ``block`` must already be placed on this
         self._decode_compiled()
         if self._paged:
             self._copy_page_compiled()
-            if self.prefix_cache:
+            # The extend grid serves BOTH warm-prefix admissions and
+            # chunked prefill (docs/DESIGN.md §25) — chunk dispatches
+            # bucket their width into the same (pb, sb) pairs, so a
+            # chunked engine with the prefix cache off still needs the
+            # full grid warmed.
+            if self.prefix_cache or int(self.prefill_chunk_tokens) > 0:
                 for pb in self._prefill_buckets:
                     for sb in self._seq_buckets:
                         self._extend_compiled(pb, sb)
@@ -1555,6 +1599,81 @@ PagePool.adopt_slot`). ``block`` must already be placed on this
             object.__setattr__(self, "_cache", new_cache)
             first = np.asarray(jax.device_get(first))
         return first[:n].astype(np.int32)
+
+    def prefill_chunk(
+        self,
+        chunks: Sequence[np.ndarray],
+        slot_ids: Sequence[int],
+        offsets: Sequence[int],
+    ):
+        """Chunked-prefill append (paged layout, docs/DESIGN.md §25):
+        write each lane's ``chunks[i]`` KV rows at positions
+        ``offsets[i]..offsets[i] + len(chunks[i]) - 1`` of its slot,
+        through the slot's page-table row. This is the warm-extend
+        program with the CURSOR as the resident prefix: ``lengths`` is
+        the offset (rows below it are already committed — earlier
+        chunks or prefix-cache pages), ``valid`` masks the padding
+        past each chunk, and the returned per-lane token is the argmax
+        at each chunk's LAST position — meaningful only on a lane's
+        FINAL chunk (where that position is the prompt's last token:
+        the first emission), discarded by the scheduler otherwise.
+        Token identity with monolithic prefill is the §20 warm-extend
+        certification applied per chunk: every row is written exactly
+        once with full causal context over the committed prefix. Rides
+        the warmed ``prefill_extend`` (bucket, width) grid — zero new
+        compiles for any chunk within the seq buckets."""
+        import jax
+
+        self._require_bound()
+        if not self._paged:
+            raise RuntimeError(
+                "prefill_chunk is a paged-layout dispatch; slots-mode "
+                "admissions always run the monolithic prefill."
+            )
+        n = len(chunks)
+        if n == 0:
+            return np.zeros((0,), np.int32)
+        lens = [int(np.shape(c)[0]) for c in chunks]
+        if min(lens) < 1:
+            raise ValueError(
+                "prefill_chunk needs >= 1 token per lane (zero-token "
+                "chunks must be skipped by the planner)."
+            )
+        pb = self.prefill_bucket_for(n)
+        w = self.seq_bucket_for(max(lens))
+        tokens = np.zeros((pb, w), np.int32)
+        lengths = np.zeros((pb,), np.int32)
+        valid = np.zeros((pb,), np.int32)  # pad rows: 0 valid, dropped
+        out_idx = np.zeros((pb,), np.int32)
+        rows = np.full((pb, self._max_pages), -1, np.int32)
+        for i, (c, s, off) in enumerate(zip(chunks, slot_ids, offsets)):
+            c = np.asarray(c, np.int32)
+            tokens[i, : lens[i]] = c
+            lengths[i] = int(off)
+            valid[i] = lens[i]
+            out_idx[i] = lens[i] - 1
+            rows[i] = self._pool.table[int(s)]
+        compiled = self._extend_compiled(pb, w, during_dispatch=True)
+        with _trace.span(
+            "prefill_chunk_dispatch",
+            attrs=(
+                {"lanes": n, "bucket": pb, "width": w,
+                 "tokens": int(sum(lens))}
+                if _trace.enabled()
+                else None
+            ),
+        ):
+            try:
+                new_cache, last = compiled(
+                    self._variables, self._cache, tokens, lengths, rows,
+                    valid, out_idx,
+                )
+            except BaseException:
+                self._reset_cache()  # donation consumed the buffers
+                raise
+            object.__setattr__(self, "_cache", new_cache)
+            last = np.asarray(jax.device_get(last))
+        return last[:n].astype(np.int32)
 
     def copy_page(self, src: int, dst: int) -> None:
         """Execute one copy-on-write page copy on device (the

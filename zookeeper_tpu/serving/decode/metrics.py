@@ -74,6 +74,12 @@ _COUNTER_NAMES = (
     "weight_swaps_total",
 )
 
+#: Chunked-prefill counters (docs/DESIGN.md §25): registered under the
+#: ``zk_prefill_`` prefix (the chunk schedule is an admission-side
+#: concern, like ``zk_prefix_`` is the cache's); reported in ``totals``
+#: after the decode family.
+_CHUNK_COUNTER_NAMES = ("prefill_chunks_total",)
+
 #: Speculative-decode counters: registered under the ``zk_spec_``
 #: prefix (NOT ``zk_decode_``); reported in ``totals`` after the
 #: decode family.
@@ -150,6 +156,15 @@ class DecodeMetrics:
                     "zk_transfer_handoffs_total",
                     help="completed page handoffs (one per stream "
                     "admitted into a decode slot)",
+                ),
+                # Chunked-prefill family (docs/DESIGN.md §25):
+                # registered unconditionally (zero-valued under
+                # monolithic prefill) so the scrape surface is stable
+                # across configs, like zk_transfer_.
+                "prefill_chunks_total": registry.counter(
+                    "zk_prefill_chunks_total",
+                    help="prefill chunk lanes dispatched (one per slot "
+                    "per chunk; a monolithic prefill counts zero)",
                 ),
             },
             "gauges": {
@@ -233,6 +248,23 @@ class DecodeMetrics:
                     "(0..k; mass at k means raise k, mass at 0 means "
                     "the draft disagrees with the teacher)",
                 ),
+                "itl_ms": registry.histogram(
+                    _PREFIX + "itl_ms",
+                    buckets=DEFAULT_MS_BUCKETS,
+                    help="inter-token latency: wall time between "
+                    "consecutive delivered tokens of one stream — the "
+                    "tail a decode-blocking monolithic prefill spikes "
+                    "and chunked prefill flattens (docs/DESIGN.md §25)",
+                ),
+                "prefill_stall_ms": registry.histogram(
+                    "zk_prefill_stall_ms",
+                    buckets=DEFAULT_MS_BUCKETS,
+                    help="per-request admission-to-first-token wall "
+                    "time under chunked prefill: the decode-"
+                    "interleaving wait a monolithic prefill trades "
+                    "for blocked streams (the TTFT-vs-ITL tradeoff's "
+                    "other half)",
+                ),
             },
             "windows": {},
         }
@@ -271,6 +303,34 @@ class DecodeMetrics:
     def record_first_tokens(self, n: int) -> None:
         """Prefill-emitted tokens count toward the stream total too."""
         self._obs()["counters"]["tokens_total"].inc(int(n))
+
+    def record_itl(self, gap_ms: float) -> None:
+        """One inter-token gap: wall time between a stream's previous
+        delivered token and this one (docs/DESIGN.md §25) — the
+        per-stream latency a decode-blocking prefill inflates."""
+        self._observe("itl_ms", gap_ms)
+
+    def record_prefill_chunks(
+        self, chunks: int, dispatch_ms: float
+    ) -> None:
+        """One chunked-prefill dispatch served ``chunks`` lanes
+        (docs/DESIGN.md §25): each lane is one slot's chunk; the
+        dispatch wall time joins the prefill series (a chunk dispatch
+        IS a prefill dispatch, just a bounded one)."""
+        obs = self._obs()
+        obs["counters"]["prefill_chunks_total"].inc(int(chunks))
+        obs["counters"]["prefills_total"].inc()
+        self._observe("prefill_ms", dispatch_ms)
+
+    def record_prefill_finish(self, requests: int, stall_ms) -> None:
+        """``requests`` streams' FINAL chunks landed: they are admitted
+        requests now (the monolithic path counts these inside
+        ``record_prefill``); each one's admission-to-first-token wall
+        time feeds the stall series."""
+        obs = self._obs()
+        obs["counters"]["requests_total"].inc(int(requests))
+        for ms in stall_ms:
+            self._observe("prefill_stall_ms", float(ms))
 
     def record_occupancy(
         self, active: int, slots: int, queue_depth: int, kv_pages: int
@@ -360,6 +420,7 @@ class DecodeMetrics:
             name: int(obs["counters"][name].value)
             for name in (
                 _COUNTER_NAMES
+                + _CHUNK_COUNTER_NAMES
                 + _SPEC_COUNTER_NAMES
                 + _TRANSFER_COUNTER_NAMES
             )
@@ -377,7 +438,14 @@ class DecodeMetrics:
             out["spec_acceptance_rate"] = (
                 out["spec_accepted_tokens_total"] / proposed
             )
-        for name in ("ttft_ms", "token_ms", "prefill_ms", "transfer_ms"):
+        for name in (
+            "ttft_ms",
+            "token_ms",
+            "prefill_ms",
+            "transfer_ms",
+            "itl_ms",
+            "prefill_stall_ms",
+        ):
             series = windows.get(name)
             if series:
                 arr = np.asarray(series)

@@ -287,6 +287,17 @@ class DecodeScheduler:
     #: the caller drives via drain()/result(). False = one
     #: ``zk-decode-scheduler`` daemon thread runs the loop.
     synchronous: bool = Field(True)
+    #: Per-iteration token budget for the chunked-prefill planner
+    #: (docs/DESIGN.md §25; active only when the engine's
+    #: ``prefill_chunk_tokens`` is on): each iteration spends the
+    #: budget FIRST on every active decode slot (one token each; a
+    #: speculative window counts k + 1), then on pending prefill
+    #: chunks — decode never waits behind a prompt. 0 (default) sizes
+    #: it automatically to ``slots × window + prefill_chunk_tokens``
+    #: (full decode occupancy plus one whole chunk per iteration). A
+    #: smaller explicit budget squeezes prefill harder under decode
+    #: load, down to a 1-token/iteration progress floor.
+    token_budget: int = Field(0)
 
     # -- wiring ----------------------------------------------------------
 
@@ -310,6 +321,11 @@ class DecodeScheduler:
             )
         if self.max_queue < 1:
             raise ValueError(f"max_queue={self.max_queue} must be >= 1.")
+        if self.token_budget < 0:
+            raise ValueError(
+                f"token_budget={self.token_budget} must be >= 0 "
+                "(0 sizes the chunked-prefill budget automatically)."
+            )
         engine._require_bound()
         object.__setattr__(self, "_engine", engine)
         object.__setattr__(self, "_metrics", metrics)
@@ -345,6 +361,18 @@ class DecodeScheduler:
         # acceptance catch-up — docs/DESIGN.md §18).
         object.__setattr__(self, "_draft_lengths", np.zeros(n, np.int64))
         object.__setattr__(self, "_draft_pending", [[] for _ in range(n)])
+        # Chunked prefill (docs/DESIGN.md §25): slot -> {"pos": next
+        # uncommitted prompt offset, "admit_t": perf_counter at
+        # admission} while a prompt is mid-prefill. A slot in
+        # _chunk_state owns pages + a stream but must NOT decode —
+        # its KV prefix is still being appended chunk by chunk.
+        chunked = bool(engine.paged) and int(engine.prefill_chunk_tokens) > 0
+        object.__setattr__(self, "_chunked", chunked)
+        object.__setattr__(self, "_chunk_state", {})
+        # Wall-clock of each slot's most recent token delivery, for
+        # the inter-token-latency histogram; 0 = no token emitted yet
+        # for the current occupant.
+        object.__setattr__(self, "_slot_last_emit", np.zeros(n, np.float64))
         object.__setattr__(self, "_lock", threading.RLock())
         # Serializes scheduler ITERATIONS (plan -> dispatch -> commit)
         # so ``_lock`` can be released across the device dispatches:
@@ -567,8 +595,12 @@ class DecodeScheduler:
         stream cannot meet its deadline behind the CURRENT queue.
         Queued work is measured in tokens-still-owed (each queued
         stream's max_new budget), the unit the per-token service EWMA
-        speaks. Caller holds the lock; same empty-queue invariant as
-        the static check."""
+        speaks. With chunked prefill on, each stream additionally
+        owes its REMAINING prefill chunks — one budget unit per chunk
+        dispatch, work the iteration planner schedules exactly like a
+        decode token (docs/DESIGN.md §25). Monolithic prefill keeps
+        the historical prefill-is-free posture. Caller holds the
+        lock; same empty-queue invariant as the static check."""
         guard = getattr(self, "_guard", None)
         if guard is None or not guard.enabled:
             return
@@ -579,10 +611,22 @@ class DecodeScheduler:
             if stream._deadline_at is not None
             else None
         )
-        queued_tokens = sum(s._max_new for s in self._queue)
+        queued_tokens = sum(
+            s._max_new + self._chunk_units(int(s.prompt.shape[0]))
+            for s in self._queue
+        )
+        if getattr(self, "_chunked", False):
+            # Mid-prefill slots still owe their uncommitted chunks.
+            for slot, st in self._chunk_state.items():
+                s = self._slot_stream[slot]
+                if s is None:
+                    continue
+                queued_tokens += self._chunk_units(
+                    int(s.prompt.shape[0]) - int(st["pos"])
+                )
         ok, predicted = guard.admit(
             queued_units=queued_tokens,
-            request_units=new,
+            request_units=new + self._chunk_units(int(stream.prompt.shape[0])),
             deadline_ms=deadline_ms,
         )
         if ok:
@@ -609,6 +653,18 @@ class DecodeScheduler:
             f"{deadline_ms:.1f}ms deadline with {queued_tokens} tokens "
             "queued ahead — shed at admission rather than served late."
         )
+
+    def _chunk_units(self, prompt_tokens: int) -> int:
+        """Remaining-prefill work in admission-budget units: the
+        number of chunk dispatches still owed for ``prompt_tokens``
+        uncommitted prompt tokens (ceil-divide by the chunk size).
+        0 when chunking is off — monolithic prefill keeps the
+        historical prefill-is-free estimator posture so existing
+        deployments see identical admission decisions."""
+        if not getattr(self, "_chunked", False) or prompt_tokens <= 0:
+            return 0
+        cap = int(self._engine.prefill_chunk_tokens)
+        return -(-int(prompt_tokens) // cap)
 
     def generate(self, prompt: Any, **kwargs) -> np.ndarray:
         """Submit + block for the full generation — the one-call API
@@ -719,6 +775,7 @@ class DecodeScheduler:
 
     def _free_slot(self, slot: int) -> None:
         self._slot_stream[slot] = None
+        self._chunk_state.pop(slot, None)
         # Paged layout: drop the slot's page references (prefix-cache-
         # shared pages stay resident); slot layout: no-op. Every slot
         # retirement path funnels here so pages can never leak.
@@ -728,6 +785,15 @@ class DecodeScheduler:
         """Deliver ``token`` to the slot's stream and retire the slot
         when the stream is complete. Caller holds the lock."""
         stream = self._slot_stream[slot]
+        now = time.perf_counter()
+        last = float(self._slot_last_emit[slot])
+        if last > 0.0 and self._metrics is not None:
+            # Inter-token gap as the CLIENT sees it: previous delivery
+            # to this one. Speculative windows deliver their accepted
+            # run back-to-back (near-zero gaps) — accurate, the tokens
+            # really do arrive together.
+            self._metrics.record_itl((now - last) * 1e3)
+        self._slot_last_emit[slot] = now
         stream._deliver(token)
         reason = None
         if stream._eos is not None and token == stream._eos:
@@ -820,6 +886,7 @@ class DecodeScheduler:
                 for stream, slot in zip(group, slots):
                     self._slot_stream[slot] = stream
                     self._slot_lengths[slot] = int(stream.prompt.shape[0])
+                    self._slot_last_emit[slot] = 0.0
                     # Dispatch attribution BEFORE the device work (a
                     # crash mid-prefill still shows the stream reached
                     # dispatch), rid-tagged so the exporter links the
@@ -900,6 +967,30 @@ class DecodeScheduler:
                 cow = plan.pop("cow", None)
                 if cow is not None:
                     engine.copy_page(*cow)
+            if getattr(self, "_chunked", False):
+                # Chunked admission (docs/DESIGN.md §25): pages are
+                # allocated and any warm prefix is already committed
+                # (CoW done above), but NO prefill dispatches here —
+                # the token-budget planner (_prefill_chunks) appends
+                # the prompt chunk by chunk, interleaved with decode
+                # iterations, and TTFT is stamped on the FINAL chunk.
+                # Warm hits start their cursor past the cached prefix,
+                # so fully-warm prompts cost a single 1-token chunk.
+                with self._lock:
+                    now = time.perf_counter()
+                    for stream, slot, plan in zip(group, slots, plans):
+                        if self._slot_stream[slot] is not stream:
+                            continue  # failed by close()/crash already
+                        shared = int(plan.get("shared_tokens") or 0)
+                        # While mid-prefill, _slot_lengths tracks the
+                        # COMMITTED prefix (the chunk cursor), not the
+                        # final prompt length.
+                        self._slot_lengths[slot] = shared
+                        self._chunk_state[slot] = {
+                            "pos": shared,
+                            "admit_t": now,
+                        }
+                continue
             cold = [
                 i for i, p in enumerate(plans)
                 if not p.get("shared_tokens")
@@ -968,9 +1059,16 @@ class DecodeScheduler:
                     self._metrics.record_prefill(dt_ms, delivered)
                     self._metrics.record_first_tokens(delivered)
 
-    def _decode(self) -> None:
+    def _decode(self) -> int:
         """One decode dispatch over the whole slot array; deliver each
-        active slot's token. Caller holds ``_step_lock``; the dispatch
+        active slot's token. Returns the iteration's decode token
+        SPEND for the chunked-prefill budget (one per decoded slot;
+        a speculative window counts k + 1 — docs/DESIGN.md §25).
+        Mid-prefill slots (in ``_chunk_state``) are excluded from the
+        active set: their streams own pages but must not emit tokens,
+        and the batched dispatch's garbage write at their cursor row
+        is overwritten by the chunk that commits that position later
+        the same iteration. Caller holds ``_step_lock``; the dispatch
         runs outside ``_lock`` over a snapshot of the slot arrays — a
         slot whose stream was failed mid-dispatch (``close()``, crash)
         skips delivery (its cache row write is masked garbage at
@@ -989,7 +1087,7 @@ class DecodeScheduler:
             with self._lock:
                 active = [
                     i for i, s in enumerate(self._slot_stream)
-                    if s is not None
+                    if s is not None and i not in self._chunk_state
                 ]
                 eligible = (
                     bool(active)
@@ -1006,17 +1104,19 @@ class DecodeScheduler:
                     )
                 )
             if not active:
-                return
+                return 0
             if eligible:
-                self._decode_spec(spec)
-                return
+                return self._decode_spec(spec)
         engine = self._engine
         with self._lock:
             self._ensure_active_rows(1)
             snapshot = list(self._slot_stream)
-            active = [i for i, s in enumerate(snapshot) if s is not None]
+            active = [
+                i for i, s in enumerate(snapshot)
+                if s is not None and i not in self._chunk_state
+            ]
             if not active:
-                return
+                return 0
             tokens = self._slot_tokens.astype(np.int32)
             lengths = self._slot_lengths.astype(np.int32)
             counts = None
@@ -1056,6 +1156,7 @@ class DecodeScheduler:
                 delivered += 1
             if self._metrics is not None:
                 self._metrics.record_decode_step(dt_ms, delivered)
+        return len(active)
 
     def _ensure_active_rows(self, extra: int) -> None:
         """Pre-dispatch page guarantee (paged layout; slot layout:
@@ -1067,7 +1168,11 @@ class DecodeScheduler:
         resubmit lands once other streams release pages). Caller holds
         ``_lock``."""
         for slot, stream in enumerate(self._slot_stream):
-            if stream is None:
+            if stream is None or slot in self._chunk_state:
+                # Mid-prefill slots already hold pages for the FULL
+                # prompt (admit_slot allocates them up front); the
+                # batched dispatch's garbage writes past their cursor
+                # land in those pages or drop via the OOB sentinel.
                 continue
             if self._engine.ensure_rows(
                 slot, int(self._slot_lengths[slot]) + int(extra)
@@ -1109,7 +1214,7 @@ class DecodeScheduler:
                 counts[i] = 1
         return ctokens, counts
 
-    def _decode_spec(self, spec) -> None:
+    def _decode_spec(self, spec) -> int:
         """One speculative window over the whole slot array
         (docs/DESIGN.md §18): the draft proposes ``k`` tokens per slot
         (one width-2 catch-up append + ``k - 1`` draft steps), ONE
@@ -1133,9 +1238,12 @@ class DecodeScheduler:
             # deallocates mid-stream).
             self._ensure_active_rows(spec.window)
             snapshot = list(self._slot_stream)
-            active = [i for i, s in enumerate(snapshot) if s is not None]
+            active = [
+                i for i, s in enumerate(snapshot)
+                if s is not None and i not in self._chunk_state
+            ]
             if not active:
-                return
+                return 0
             cur = self._slot_tokens.astype(np.int32).copy()
             lengths = self._slot_lengths.astype(np.int32).copy()
             dlengths = self._slot_draft_state()
@@ -1241,6 +1349,128 @@ class DecodeScheduler:
                         dt_ms,
                         delivered,
                     )
+        return len(active) * (k + 1)
+
+    def _iteration_budget(self) -> int:
+        """Tokens one scheduler iteration may spend across decode and
+        prefill chunks (docs/DESIGN.md §25). Explicit ``token_budget``
+        wins; 0 auto-sizes to full decode occupancy (every slot's
+        window) plus one whole chunk, so saturated decode still
+        advances exactly one chunk of prefill per iteration."""
+        b = int(self.token_budget)
+        if b > 0:
+            return b
+        spec = getattr(self, "_speculative", None)
+        per = int(spec.window) if spec is not None else 1
+        return int(self._engine.slots) * per + int(
+            self._engine.prefill_chunk_tokens
+        )
+
+    def _prefill_chunks(self, decode_spend: int) -> None:
+        """Spend the iteration's remaining token budget on pending
+        prefill chunks (docs/DESIGN.md §25): after decode took
+        ``decode_spend`` tokens, the remainder is dealt to mid-prefill
+        slots in slot order — up to ``prefill_chunk_tokens`` per lane
+        per dispatch, multiple dispatches while budget and pending
+        lanes remain. The FINAL chunk of a prompt returns its real
+        last-position logits: TTFT is stamped, the first token
+        delivered, the prefix cached, and the slot leaves
+        ``_chunk_state`` to decode next iteration. Caller holds
+        ``_step_lock``; dispatches run outside ``_lock`` with the
+        same identity-checked commit as prefill/decode."""
+        if not getattr(self, "_chunked", False):
+            return
+        engine = self._engine
+        spec = getattr(self, "_speculative", None)
+        chunk_cap = int(engine.prefill_chunk_tokens)
+        lane_cap = max(engine._prefill_buckets)
+        # Progress floor: even a decode-saturated budget grants one
+        # token, so a full slot array can never livelock the pending
+        # prefills it is itself waiting on.
+        budget = max(1, self._iteration_budget() - int(decode_spend))
+        while budget > 0:
+            group = []  # (slot, stream, chunk, offset, is_final)
+            with self._lock:
+                for slot in sorted(self._chunk_state):
+                    if len(group) >= lane_cap or budget < 1:
+                        break
+                    stream = self._slot_stream[slot]
+                    if stream is None:
+                        continue
+                    st = self._chunk_state[slot]
+                    pos = int(st["pos"])
+                    total = int(stream.prompt.shape[0])
+                    c = min(chunk_cap, total - pos, budget)
+                    if c < 1:
+                        continue
+                    budget -= c
+                    group.append((
+                        slot,
+                        stream,
+                        stream.prompt[pos:pos + c],
+                        pos,
+                        pos + c >= total,
+                    ))
+            if not group:
+                return
+            t0 = time.perf_counter()
+            last = engine.prefill_chunk(
+                [g[2] for g in group],
+                [g[0] for g in group],
+                [g[3] for g in group],
+            )
+            finals = [g for g in group if g[4]]
+            if spec is not None and finals:
+                # Seed the DRAFT cache only once the full prompt is
+                # committed — the draft keeps its own slot-layout
+                # cache and prefills monolithically, exactly like the
+                # unchunked admission path (its first-token output is
+                # discarded; the teacher's final-chunk token is
+                # authoritative).
+                spec.draft_engine.prefill(
+                    [g[1].prompt for g in finals],
+                    [g[0] for g in finals],
+                )
+            dt_ms = (time.perf_counter() - t0) * 1e3
+            with self._lock:
+                now = time.perf_counter()
+                finished = 0
+                stalls = []
+                for (slot, stream, chunk, pos, final), tok in zip(
+                    group, last
+                ):
+                    if self._slot_stream[slot] is not stream:
+                        continue  # failed by close()/crash mid-dispatch
+                    st = self._chunk_state.get(slot)
+                    if st is None:
+                        continue
+                    end = pos + int(np.shape(chunk)[0])
+                    st["pos"] = end
+                    self._slot_lengths[slot] = end
+                    if not final:
+                        continue
+                    del self._chunk_state[slot]
+                    stream.ttft_ms = (now - stream._t_submit) * 1e3
+                    stalls.append((now - float(st["admit_t"])) * 1e3)
+                    if self._metrics is not None:
+                        self._metrics.record_ttft(stream.ttft_ms)
+                    if spec is not None:
+                        # Both caches hold exactly the prompt now.
+                        self._draft_lengths[slot] = end
+                        self._draft_pending[slot] = []
+                    # Cache the prompt's pages for future warm hits
+                    # while the slot still references them.
+                    engine.insert_prefix(slot, stream.prompt)
+                    self._slot_tokens[slot] = int(tok)
+                    self._finish_or_continue(slot, int(tok))
+                    finished += 1
+                if self._metrics is not None:
+                    self._metrics.record_prefill_chunks(len(group), dt_ms)
+                    if finished:
+                        self._metrics.record_prefill_finish(
+                            finished, stalls
+                        )
+                        self._metrics.record_first_tokens(finished)
 
     def _update_occupancy(self) -> None:
         if self._metrics is None:
@@ -1289,7 +1519,11 @@ class DecodeScheduler:
                 self._expire_queued()
                 self._expire_active()
             self._admit()
-            self._decode()
+            spent = self._decode()
+            # Chunked prefill rides the SAME iteration after decode:
+            # decode spends the budget first, pending chunks get the
+            # remainder (docs/DESIGN.md §25). No-op when chunking off.
+            self._prefill_chunks(spent)
             with self._lock:
                 self._maybe_apply_swap()  # slot array may have drained
                 self._maybe_apply_brownout()
@@ -1329,6 +1563,9 @@ class DecodeScheduler:
                 # occupant's draft prefill re-seeds it.
                 self._draft_lengths[i] = 0
                 self._draft_pending[i] = []
+            # Mid-prefill cursors die with their streams too (the
+            # pages were released above; nothing left to resume).
+            self._chunk_state.clear()
             object.__setattr__(self, "_worker", None)
             _trace.event(
                 "decode_worker_crash",
@@ -1564,6 +1801,29 @@ class DecodeScheduler:
                     if getattr(self, "_speculative", None) is not None
                     else {"enabled": False}
                 ),
+                # Chunked-prefill planner vitals (docs/DESIGN.md §25):
+                # always present so scrapers need no layout branch;
+                # enabled=False means monolithic prefill.
+                "chunked_prefill": {
+                    "enabled": bool(getattr(self, "_chunked", False)),
+                    "chunk_tokens": int(engine.prefill_chunk_tokens),
+                    "token_budget": (
+                        self._iteration_budget()
+                        if getattr(self, "_chunked", False)
+                        else 0
+                    ),
+                    "pending_prefills": len(
+                        getattr(self, "_chunk_state", {})
+                    ),
+                    "pending_prefill_tokens": sum(
+                        int(self._slot_stream[i].prompt.shape[0])
+                        - int(st["pos"])
+                        for i, st in getattr(
+                            self, "_chunk_state", {}
+                        ).items()
+                        if self._slot_stream[i] is not None
+                    ),
+                },
                 # Overload guardrails (docs/DESIGN.md §24): admission
                 # estimator state + the scheduler's APPLIED brown-out
                 # posture (may lag the guard's intent by one drain).

@@ -96,6 +96,31 @@ class DisaggServingConfig(LMServingConfig):
                 f"{self.max_prompt} and new_tokens={self.new_tokens} "
                 ">= 1."
             )
+        for role, eng in (
+            ("prefill", self.prefill_engine),
+            ("decode", self.engine),
+        ):
+            if int(eng.prefill_chunk_tokens) > 0:
+                # Chunked prefill is the SINGLE-mesh answer to prefill/
+                # decode interference (docs/DESIGN.md §25); disagg
+                # already isolates the roles on separate slices, so
+                # chunking would only fragment the prefill role's
+                # dispatches. Warn-degrade, mirroring the §20 posture.
+                logger.warning(
+                    "prefill_chunk_tokens=%d ignored on the disagg %s "
+                    "role: disaggregation already isolates prefill "
+                    "from decode (docs/DESIGN.md §25) — running "
+                    "monolithic prefill.",
+                    int(eng.prefill_chunk_tokens),
+                    role,
+                )
+                # Post-configure components are immutable; the degrade
+                # writes the instance value store directly (the same
+                # bypass the component runtime itself uses) BEFORE
+                # bind() reads the field.
+                object.__getattribute__(
+                    eng, "__component_values__"
+                )["prefill_chunk_tokens"] = 0
         module, params, model_state = self._build_module_and_weights()
         self.partitioner.setup()
         prefill_part, decode_part = self._role_partitioners()
